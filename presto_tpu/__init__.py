@@ -1,0 +1,23 @@
+"""presto_tpu — a TPU-native pulsar search & analysis framework.
+
+A ground-up JAX/XLA/Pallas re-design of the capabilities of PRESTO
+(reference: /root/reference): RFI excision, dedispersion, FFT,
+Fourier-domain acceleration search, phase-modulation (miniFFT) search,
+single-pulse matched filtering, candidate sifting, and folding —
+expressed as pure, jit-compiled, shardable tensor programs over
+`jax.sharding.Mesh` device meshes.
+
+Layering (bottom-up):
+  utils/    — constants, unit conversions, smooth-length selection
+  io/       — .inf sidecars, SIGPROC filterbank, PSRFITS, .dat/.fft, masks
+  ops/      — device ops: dedispersion, packed real FFT, Fourier response
+              kernels, correlation, statistics, folding, clipping
+  models/   — synthetic signal generation (makedata parity), orbits
+  search/   — accelsearch, single-pulse, phase-modulation, sifting, DDplan
+  parallel/ — mesh construction, DM-sharded plans, sequence-sharded FFT
+  apps/     — CLI entry points with PRESTO flag parity
+"""
+
+__version__ = "0.1.0"
+
+from presto_tpu.utils import psr  # noqa: F401

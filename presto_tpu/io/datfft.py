@@ -1,0 +1,45 @@
+"""`.dat` (float32 time series) and `.fft` (packed complex64) file I/O.
+
+Artifact parity with the reference: a `.dat` is raw little-endian
+float32 samples; a `.fft` is the NR-packed real FFT written by realfft
+(src/fastffts.c:198-270): n/2 complex64 values where element 0 holds
+(DC, Nyquist) packed as (re, im) and elements 1..n/2-1 are the positive
+-frequency amplitudes.  Both carry a `.inf` sidecar.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from presto_tpu.io.infodata import InfoData, read_inf, write_inf
+
+
+def write_dat(path: str, data: np.ndarray, info: InfoData | None = None):
+    data.astype(np.float32).tofile(path)
+    if info is not None:
+        base = path[:-4] if path.endswith(".dat") else path
+        info.name = base
+        info.N = data.size
+        write_inf(info, base + ".inf")
+
+
+def read_dat(path: str) -> np.ndarray:
+    return np.fromfile(path, dtype=np.float32)
+
+
+def read_dat_with_inf(path: str):
+    base = path[:-4] if path.endswith(".dat") else path
+    return np.fromfile(base + ".dat", dtype=np.float32), read_inf(base)
+
+
+def write_fft(path: str, packed: np.ndarray, info: InfoData | None = None):
+    """packed: complex64 array of n/2 NR-packed amplitudes."""
+    packed.astype(np.complex64).tofile(path)
+    if info is not None:
+        base = path[:-4] if path.endswith(".fft") else path
+        info.name = base
+        write_inf(info, base + ".inf")
+
+
+def read_fft(path: str) -> np.ndarray:
+    return np.fromfile(path, dtype=np.complex64)

@@ -1,0 +1,221 @@
+"""Dedispersion: delay planning (host, float64) + shift-and-sum (device).
+
+Parity targets: reference src/dispersion.c.
+  delay_from_dm            dispersion.c:30-39   Δt = DM / (0.000241 f²)
+  dedisp_delays            dispersion.c:54-73
+  subband_delays           dispersion.c:103-121
+  subband_search_delays    dispersion.c:124-162
+  dedisp_subbands          dispersion.c:165-203 (hot loop 1a)
+  float_dedisp             dispersion.c:206-229 (hot loop 1b)
+  combine_subbands         dispersion.c:232-287 (profile-domain, see ops/fold.py)
+
+Streaming convention.  The reference processes blocks with a two-buffer
+(lastdata, data) window: output sample t of a block whose window starts
+at stream position S is  out[t] = Σ_ch  x_ch[S + t + delay_ch]  (delays
+in bins, 0 <= delay < block_len).  Here that becomes: concatenate the
+previous and current block along time and gather each channel at offset
+delay_ch.  The carry (previous block) is explicit state — no statics —
+so the whole stream is a `lax.scan`.
+
+Dtype policy.  Delays are planned in float64 numpy on the host and
+rounded to int32 bins exactly as the reference does; per-sample compute
+is float32 on device.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from presto_tpu.utils.psr import doppler
+
+
+# ----------------------------------------------------------------------
+# Host-side delay planning (float64)
+# ----------------------------------------------------------------------
+
+def delay_from_dm(dm, freq_emitted):
+    """Dispersion delay in seconds. Parity: dispersion.c:30-39."""
+    freq = np.asarray(freq_emitted, dtype=np.float64)
+    with np.errstate(divide="ignore"):
+        d = dm / (0.000241 * freq * freq)
+    return np.where(freq == 0.0, 0.0, d)
+
+
+def dm_from_delay(delay, freq_emitted):
+    """Inverse of delay_from_dm. Parity: dispersion.c:42-51."""
+    freq = np.asarray(freq_emitted, dtype=np.float64)
+    return np.where(freq == 0.0, 0.0, delay * 0.000241 * freq * freq)
+
+
+def dedisp_delays(numchan, dm, lofreq, chanwidth, voverc=0.0):
+    """Per-channel delays (s) at `dm`; lofreq = center freq of lowest channel.
+
+    Parity: dispersion.c:54-73 (including Doppler correction of each
+    channel frequency by the observatory radial velocity).
+    """
+    freqs = doppler(lofreq + np.arange(numchan, dtype=np.float64) * chanwidth,
+                    voverc)
+    return delay_from_dm(dm, freqs)
+
+
+def subband_delays(numchan, numsubbands, dm, lofreq, chanwidth, voverc=0.0):
+    """Delays (s) for the highest-frequency channel of each subband.
+
+    Parity: dispersion.c:103-121.
+    """
+    chan_per_subband = numchan // numsubbands
+    subbandwidth = chanwidth * chan_per_subband
+    losub_hifreq = lofreq + subbandwidth - chanwidth
+    return dedisp_delays(numsubbands, dm, losub_hifreq, subbandwidth, voverc)
+
+
+def subband_search_delays(numchan, numsubbands, dm, lofreq, chanwidth,
+                          voverc=0.0):
+    """Per-channel delays for subband dedispersion at a nominal `dm`.
+
+    Each channel's full delay minus the delay of the *highest* channel in
+    its subband, so subbands stay internally dedispersed but offset as
+    wholes — ready for a later float_dedisp over subbands.
+    Parity: dispersion.c:124-162.
+    """
+    chan_per_subband = numchan // numsubbands
+    sdelays = subband_delays(numchan, numsubbands, dm, lofreq, chanwidth,
+                             voverc)
+    delays = dedisp_delays(numchan, dm, lofreq, chanwidth, voverc)
+    return delays - np.repeat(sdelays, chan_per_subband)
+
+
+def delays_to_bins(delays_sec, dt):
+    """Seconds -> integer sample bins, rounded half-up like the reference
+    (prepsubband.c uses (int)(delay/dt + 0.5))."""
+    return np.floor(np.asarray(delays_sec, dtype=np.float64) / dt
+                    + 0.5).astype(np.int32)
+
+
+# ----------------------------------------------------------------------
+# Device ops (jit-compiled, float32)
+# ----------------------------------------------------------------------
+
+def _gather_shifted(x2, delays, numpts):
+    """x2: [C, 2*T] channel-major two-block window; delays: [C] int32.
+
+    Returns [C, T] where out[c, t] = x2[c, t + delays[c]].
+    """
+    t = jnp.arange(numpts, dtype=jnp.int32)
+    idx = delays[:, None] + t[None, :]
+    return jnp.take_along_axis(x2, idx, axis=1)
+
+
+def dedisp_subbands_block(lastdata, data, delays, numsubbands):
+    """Channels -> subbands shift-and-add for one streaming block.
+
+    lastdata, data: [numchan, numpts] float32, channel-major (all of a
+    channel's samples contiguous), ascending frequency — the same layout
+    the reference's prep_subbands produces after its r2r transpose.
+    delays: [numchan] int32 bins, each < numpts.
+
+    Returns [numsubbands, numpts]: out[s, t] = Σ_{c in s} window_c[t+d_c]
+    with the window starting at the lastdata block.
+    Parity: dispersion.c:165-203.
+    """
+    numchan, numpts = lastdata.shape
+    x2 = jnp.concatenate([lastdata, data], axis=1)
+    shifted = _gather_shifted(x2, delays, numpts)
+    return shifted.reshape(numsubbands, numchan // numsubbands,
+                           numpts).sum(axis=1)
+
+
+def float_dedisp_block(lastdata, data, delays, approx_mean=0.0):
+    """Subbands (or channels) -> one dedispersed series for one block.
+
+    lastdata, data: [numchan, numpts] float32 channel-major.
+    delays: [numchan] int32.  Returns [numpts].
+    Parity: dispersion.c:206-229 (which takes time-major input; layout
+    here is channel-major for TPU-friendly contiguity — semantics equal).
+    """
+    numchan, numpts = lastdata.shape
+    x2 = jnp.concatenate([lastdata, data], axis=1)
+    shifted = _gather_shifted(x2, delays, numpts)
+    return shifted.sum(axis=0) - approx_mean
+
+
+def float_dedisp_many_block(lastdata, data, delays_dm, approx_mean=0.0):
+    """float_dedisp over many DM trials at once.
+
+    lastdata, data: [nsub, numpts]; delays_dm: [numdms, nsub] int32.
+    Returns [numdms, numpts].  This is hot loop 1b batched over the DM
+    axis — the axis the sharded plan splits over devices.
+    """
+    nsub, numpts = lastdata.shape
+    x2 = jnp.concatenate([lastdata, data], axis=1)       # [nsub, 2T]
+    t = jnp.arange(numpts, dtype=jnp.int32)
+    idx = delays_dm[:, :, None] + t[None, None, :]       # [numdms, nsub, T]
+    x2b = jnp.broadcast_to(x2[None], (delays_dm.shape[0],) + x2.shape)
+    shifted = jnp.take_along_axis(x2b, idx, axis=2)
+    return shifted.sum(axis=1) - approx_mean
+
+
+def dedisperse_series(data, delays):
+    """Whole-series dedispersion of an in-memory [numchan, N] array.
+
+    out[t] = Σ_c data[c, t + d_c], zero beyond the end; valid for
+    t < N - max(d).  Equivalent to streaming the block ops over the
+    series with a zero final block.
+    """
+    numchan, N = data.shape
+    maxd = int(jnp.max(delays)) if not isinstance(delays, np.ndarray) \
+        else int(np.max(delays))
+    pad = jnp.zeros((numchan, maxd), dtype=data.dtype)
+    x = jnp.concatenate([data, pad], axis=1)
+    t = jnp.arange(N, dtype=jnp.int32)
+    idx = jnp.asarray(delays, dtype=jnp.int32)[:, None] + t[None, :]
+    return jnp.take_along_axis(x, idx, axis=1).sum(axis=0)
+
+
+def downsample_block(x, factor):
+    """Time-average consecutive groups of `factor` samples.
+
+    x: [..., T] with T divisible by factor.  The reference *sums* then
+    divides by the downsample factor in prepsubband.c:967-984 — i.e. a
+    mean, preserved here.
+    """
+    if factor == 1:
+        return x
+    shape = x.shape[:-1] + (x.shape[-1] // factor, factor)
+    return x.reshape(shape).mean(axis=-1)
+
+
+def dedisperse_scan(blocks, delays_dm, numsubbands, approx_mean=0.0,
+                    downsamp=1):
+    """Full streaming pipeline over in-memory blocks via lax.scan.
+
+    blocks: [nblocks, numchan, numpts] channel-major float32 (nblocks>=2).
+    delays_dm: dict with
+        'chan': [numchan] int32 subband_search_delays bins (chan->subband)
+        'dm':   [numdms, nsub] int32 per-DM subband delay bins
+    Returns [numdms, (nblocks-2) * numpts // downsamp], the dedispersed
+    series starting at stream sample 0.
+
+    Stream algebra: subband block j (from raw blocks j-1, j) covers
+    subband-stream window [(j-1)T, jT); output block k (from subband
+    blocks k, k+1) covers [(k-1)T, kT).  So the first output needs raw
+    blocks 0..2 — the first two reads only prime the carry, mirroring
+    the reference's two-buffer SWAP priming (prepsubband.c:985-991).
+    """
+    chan_delays = jnp.asarray(delays_dm["chan"], dtype=jnp.int32)
+    dm_delays = jnp.asarray(delays_dm["dm"], dtype=jnp.int32)
+
+    def step(carry, block):
+        last_raw, last_sub = carry
+        sub = dedisp_subbands_block(last_raw, block, chan_delays, numsubbands)
+        out = float_dedisp_many_block(last_sub, sub, dm_delays, approx_mean)
+        out = downsample_block(out, downsamp)
+        return (block, sub), out
+
+    sub1 = dedisp_subbands_block(blocks[0], blocks[1], chan_delays,
+                                 numsubbands)
+    (_, _), outs = jax.lax.scan(step, (blocks[1], sub1), blocks[2:])
+    # outs: [nblocks-2, numdms, numpts//downsamp] -> [numdms, T]
+    return jnp.moveaxis(outs, 0, 1).reshape(dm_delays.shape[0], -1)

@@ -1,0 +1,1 @@
+from presto_tpu.utils import psr  # noqa: F401

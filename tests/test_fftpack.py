@@ -1,0 +1,52 @@
+"""Packed real FFT parity with the NR convention used by the reference
+(src/fastffts.c:198-270): forward unnormalized e^{-2πi}, X[0]=(DC,Nyq)."""
+
+import numpy as np
+import jax.numpy as jnp
+
+from presto_tpu.ops import fftpack
+
+
+def test_realfft_packed_matches_numpy_rfft():
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=1024).astype(np.float32)
+    packed = np.asarray(fftpack.realfft_packed(jnp.asarray(x)))
+    full = np.fft.rfft(x)
+    assert packed.shape == (512,)
+    np.testing.assert_allclose(packed[0].real, full[0].real, rtol=1e-5)
+    np.testing.assert_allclose(packed[0].imag, full[-1].real, rtol=1e-4,
+                               atol=1e-2)
+    np.testing.assert_allclose(packed[1:], full[1:-1].astype(np.complex64),
+                               rtol=1e-4, atol=1e-2)
+
+
+def test_realfft_roundtrip():
+    rng = np.random.default_rng(1)
+    x = rng.normal(size=512).astype(np.float32)
+    packed = fftpack.realfft_packed(jnp.asarray(x))
+    back = np.asarray(fftpack.irealfft_packed(packed))
+    np.testing.assert_allclose(back, x, rtol=1e-4, atol=1e-4)
+
+
+def test_tone_lands_in_right_bin():
+    n, dt = 4096, 1e-3
+    f0 = 50.0  # Hz -> bin f0 * n * dt = 204.8... use exact bin
+    k = 205
+    f0 = k / (n * dt)
+    t = np.arange(n) * dt
+    x = np.sin(2 * np.pi * f0 * t).astype(np.float32)
+    packed = np.asarray(fftpack.realfft_packed(jnp.asarray(x)))
+    powers = np.asarray(fftpack.spectral_power(jnp.asarray(packed)))
+    assert np.argmax(powers[1:]) + 1 == k
+    # sine of amplitude 1: |X_k| = n/2
+    assert abs(abs(packed[k]) - n / 2) / (n / 2) < 1e-3
+    freqs = fftpack.fourier_freqs(n, dt)
+    assert np.isclose(freqs[k], f0)
+
+
+def test_spectral_power_dc():
+    x = jnp.ones(64)
+    packed = fftpack.realfft_packed(x)
+    p = np.asarray(fftpack.spectral_power(packed))
+    assert np.isclose(p[0], 64.0 ** 2)
+    np.testing.assert_allclose(p[1:], 0.0, atol=1e-6)

@@ -1,0 +1,101 @@
+"""IO round-trips: .inf, SIGPROC .fil, .dat/.fft, bit packing."""
+
+import numpy as np
+import pytest
+
+from presto_tpu.io import infodata as inf
+from presto_tpu.io import sigproc as sp
+from presto_tpu.io import datfft
+
+
+def test_inf_roundtrip_artificial(tmp_path):
+    info = inf.InfoData(name=str(tmp_path / "fake"), N=8192.0, dt=1e-4)
+    inf.write_inf(info)
+    back = inf.read_inf(str(tmp_path / "fake"))
+    assert back.N == 8192
+    assert back.dt == 1e-4
+    assert back.is_artificial
+    assert back.mjd_i == -1
+
+
+def test_inf_roundtrip_radio(tmp_path):
+    info = inf.InfoData(
+        name=str(tmp_path / "obs"), telescope="GBT", instrument="GUPPI",
+        object="J0000+0000", observer="tester", mjd_i=59000,
+        mjd_f=0.25, bary=0, N=1048576.0, dt=72e-6, band="Radio",
+        fov=600.0, dm=62.3, freq=1352.5, freqband=96.0, num_chan=96,
+        chan_wid=1.0, analyzer="presto_tpu")
+    inf.write_inf(info)
+    back = inf.read_inf(str(tmp_path / "obs"))
+    assert back.telescope == "GBT"
+    assert back.mjd_i == 59000
+    assert abs(back.mjd_f - 0.25) < 1e-14
+    assert back.num_chan == 96
+    assert abs(back.dm - 62.3) < 1e-10
+    assert abs(back.freq - 1352.5) < 1e-9
+    assert back.analyzer == "presto_tpu"
+
+
+def test_inf_onoff_pairs(tmp_path):
+    info = inf.InfoData(name=str(tmp_path / "gaps"), N=1000.0, dt=1e-3,
+                        numonoff=2, onoff=[(0, 499), (600, 999)])
+    inf.write_inf(info)
+    back = inf.read_inf(str(tmp_path / "gaps"))
+    assert back.numonoff == 2
+    assert back.onoff == [(0.0, 499.0), (600.0, 999.0)]
+
+
+@pytest.mark.parametrize("nbits", [1, 2, 4, 8, 16])
+def test_bit_pack_roundtrip(nbits):
+    rng = np.random.default_rng(0)
+    n = 256
+    maxv = (1 << min(nbits, 16)) - 1
+    vals = rng.integers(0, maxv + 1, size=n).astype(
+        np.uint16 if nbits == 16 else np.uint8)
+    packed = sp.pack_bits(vals, nbits)
+    unpacked = sp.unpack_bits(packed, nbits)
+    np.testing.assert_array_equal(np.asarray(unpacked, dtype=np.uint16),
+                                  vals.astype(np.uint16))
+
+
+def test_filterbank_roundtrip(tmp_path):
+    rng = np.random.default_rng(1)
+    nsamp, nchan = 64, 16
+    data = rng.integers(0, 255, size=(nsamp, nchan)).astype(np.uint8)
+    hdr = sp.FilterbankHeader(source_name="T", fch1=1500.0, foff=-1.0,
+                              nchans=nchan, nbits=8, tstart=59000.0,
+                              tsamp=1e-4)
+    path = str(tmp_path / "t.fil")
+    sp.write_filterbank(path, hdr, data)
+    with sp.FilterbankFile(path) as fb:
+        assert fb.header.nchans == nchan
+        assert fb.header.N == nsamp
+        assert fb.header.fch1 == 1500.0
+        got = fb.read_spectra(0, nsamp)
+    # read_spectra returns ascending-frequency order == what we wrote
+    np.testing.assert_array_equal(got, data.astype(np.float32))
+
+
+def test_filterbank_read_past_eof_pads(tmp_path):
+    data = np.ones((10, 4), dtype=np.uint8)
+    hdr = sp.FilterbankHeader(fch1=1400.0, foff=-1.0, nchans=4, nbits=8,
+                              tsamp=1e-3)
+    path = str(tmp_path / "p.fil")
+    sp.write_filterbank(path, hdr, data)
+    with sp.FilterbankFile(path) as fb:
+        got = fb.read_spectra(8, 4)
+    assert got.shape == (4, 4)
+    assert np.all(got[:2] == 1)
+    assert np.all(got[2:] == 0)
+
+
+def test_dat_fft_roundtrip(tmp_path):
+    x = np.arange(32, dtype=np.float32)
+    p = str(tmp_path / "a.dat")
+    datfft.write_dat(p, x, inf.InfoData(name="a", N=32, dt=0.001))
+    back = datfft.read_dat(p)
+    np.testing.assert_array_equal(back, x)
+    c = (np.arange(16) + 1j * np.arange(16)).astype(np.complex64)
+    pf = str(tmp_path / "a.fft")
+    datfft.write_fft(pf, c)
+    np.testing.assert_array_equal(datfft.read_fft(pf), c)
