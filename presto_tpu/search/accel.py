@@ -1,0 +1,436 @@
+"""Fourier-domain F–Fdot acceleration search (accelsearch rebuilt TPU-first).
+
+Reference call stack (SURVEY.md §3.2, src/accelsearch.c:134-221,
+src/accel_utils.c): per r-block of ACCEL_USELEN half-bins —
+  subharm_ffdot_plane  (accel_utils.c:879-1051): normalize amplitudes,
+      spread ×2 interbin, FFT, per-z-row complex-multiply by conj
+      z-response kernel, inverse FFT, |·|²/fftlen² into powers[z][r]
+  inmem harmonic sums  (accel_utils.c:1160-1256): powers[z][r] +=
+      plane[zind(frac,z)][round(r*frac)]
+  search_ffdotpows     (accel_utils.c:1259-1298): threshold at
+      powcut[stage], candidate_sigma, sorted insert.
+
+TPU-first redesign (this module):
+  * the whole spectrum's fundamental plane is built as ONE batched
+    tensor program: [nblocks, fftlen] spread segments x [numz, fftlen]
+    kernel bank -> batched IFFT -> [nblocks, numz, uselen] powers,
+    assembled to P[numz, R] in HBM (the reference's `-inmem` plane,
+    accel_utils.c:1651-1670, is the natural TPU layout);
+  * harmonic summing is two chained takes (rows by zind map, columns by
+    rind map) — XLA gathers, no scalar loops;
+  * thresholding is a single top-k over the masked plane per stage
+    (static K, the `omp critical` insert becomes host-side filtering);
+  * candidate sigma/powcut math runs on host in float64 (ops/stats).
+
+All device entry points keep complex internal to jit (float32 pair
+boundaries — see ops/fftpack note on the TPU complex-transfer limit).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List, Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from functools import partial
+
+from presto_tpu.ops import responses as resp
+from presto_tpu.ops import stats as st
+from presto_tpu.utils.psr import next2_to_n
+
+# Search grid constants (include/accel.h:18-31)
+ACCEL_NUMBETWEEN = 2
+ACCEL_DR = 0.5
+ACCEL_RDR = 2
+ACCEL_DZ = 2
+ACCEL_RDZ = 0.5
+ACCEL_CLOSEST_R = 15.0
+ACCEL_USELEN = 7470
+DBLCORRECT = 1e-14
+
+
+def _nearest_int(x: float) -> int:
+    """Round half away from zero — the reference's NEAREST_INT
+    (prepfold.h:14), NOT Python's banker's rounding."""
+    return int(np.ceil(x - 0.5)) if x < 0 else int(np.floor(x + 0.5))
+
+
+def calc_required_z(harm_fract: float, zfull: float) -> float:
+    """z of the subharmonic for fundamental z (accel_utils.c:53-59)."""
+    return _nearest_int(ACCEL_RDZ * zfull * harm_fract) * ACCEL_DZ
+
+
+def calc_required_r(harm_fract: float, rfull: float) -> float:
+    """r of the subharmonic for fundamental r (accel_utils.c:60-66)."""
+    return int(ACCEL_RDR * rfull * harm_fract + 0.5) * ACCEL_DR
+
+
+def index_from_z(z: float, loz: float) -> int:
+    return int((z - loz) * ACCEL_RDZ + DBLCORRECT)
+
+
+def calc_fftlen(numharm: int, harmnum: int, max_zfull: int,
+                uselen: int = ACCEL_USELEN) -> int:
+    """FFT length for a subharmonic block (accel_utils.c:116-131)."""
+    harm_fract = harmnum / numharm
+    bins_needed = uselen * harmnum // numharm + 2
+    end_effects = 2 * ACCEL_NUMBETWEEN * \
+        resp.z_resp_halfwidth(calc_required_z(harm_fract, max_zfull),
+                              resp.LOWACC)
+    return next2_to_n(bins_needed + end_effects)
+
+
+@dataclass
+class AccelConfig:
+    zmax: int = 200              # max |z| searched (fundamental)
+    numharm: int = 8             # max harmonics summed (power of two)
+    sigma: float = 2.0           # candidate sigma cutoff
+    rlo: float = 0.0             # min Fourier freq searched (bins);
+                                 # 0 -> flo * T at plan time
+    rhi: float = 0.0             # 0 -> numbins - 1
+    flo: float = 1.0             # min freq (Hz) if rlo not given
+    uselen: int = ACCEL_USELEN   # half-bins of fundamental per block
+    max_cands_per_stage: int = 2048   # static top-k size
+
+    @property
+    def numharmstages(self) -> int:
+        return int(np.log2(self.numharm)) + 1
+
+    @property
+    def numz(self) -> int:
+        return (self.zmax // ACCEL_DZ) * 2 + 1
+
+
+@dataclass
+class AccelKernels:
+    """The z-response kernel bank for the fundamental (host-built)."""
+    fftlen: int
+    halfwidth: int
+    numz: int
+    zlo: int
+    kern_pairs: np.ndarray       # [numz, fftlen, 2] float32, FFT'd
+
+    @classmethod
+    def build(cls, cfg: AccelConfig) -> "AccelKernels":
+        """Parity: init_kernel (accel_utils.c:133-151) for harm 1/1.
+
+        One kernel per z in [-zmax, zmax] step ACCEL_DZ; each is the
+        float64 z-response placed NR-style into an fftlen array and
+        forward-FFT'd (kernels are shared across all r-blocks).
+        """
+        fftlen = calc_fftlen(1, 1, cfg.zmax, cfg.uselen)
+        halfwidth = resp.z_resp_halfwidth(float(cfg.zmax), resp.LOWACC)
+        numz = cfg.numz
+        kerns = np.empty((numz, fftlen), dtype=np.complex128)
+        for i in range(numz):
+            z = -cfg.zmax + i * ACCEL_DZ
+            hw = resp.z_resp_halfwidth(float(z), resp.LOWACC)
+            numkern = 2 * ACCEL_NUMBETWEEN * hw
+            k = resp.gen_z_response(0.0, ACCEL_NUMBETWEEN, float(z), numkern)
+            kerns[i] = np.fft.fft(resp.place_complex_kernel(k, fftlen))
+        pairs = np.stack([kerns.real, kerns.imag], axis=-1).astype(np.float32)
+        return cls(fftlen=fftlen, halfwidth=halfwidth, numz=numz,
+                   zlo=-cfg.zmax, kern_pairs=pairs)
+
+
+# ----------------------------------------------------------------------
+# Device: fundamental plane construction
+# ----------------------------------------------------------------------
+
+@partial(jax.jit, static_argnames=("uselen", "fftlen", "halfwidth"))
+def _ffdot_blocks(seg_pairs, kern_pairs, uselen, fftlen, halfwidth):
+    """Batched f-fdot power plane for many r-blocks at once.
+
+    seg_pairs: [nblocks, fftlen//2, 2] float32 — normalized Fourier
+        amplitudes for each block's read window (lobin = block_rlo -
+        halfwidth, fftlen//2 whole bins).
+    kern_pairs: [numz, fftlen, 2] float32 — FFT'd kernel bank.
+    Returns [nblocks, numz, uselen] float32 powers.
+
+    Parity with the per-row loop of accel_utils.c:1002-1051: spread ×2,
+    forward FFT, multiply by conj(kernel), inverse FFT, take uselen
+    points starting at halfwidth*NUMBETWEEN, |.|^2 / fftlen^2.
+    """
+    data = seg_pairs[..., 0] + 1j * seg_pairs[..., 1]   # [B, fftlen//2]
+    kern = kern_pairs[..., 0] + 1j * kern_pairs[..., 1]  # [numz, fftlen]
+    B = data.shape[0]
+    spread = jnp.zeros((B, fftlen), dtype=jnp.complex64)
+    spread = spread.at[:, ::ACCEL_NUMBETWEEN].set(data)
+    fdata = jnp.fft.fft(spread, axis=-1)                # [B, fftlen]
+    prod = fdata[:, None, :] * jnp.conj(kern)[None]     # [B, numz, fftlen]
+    corr = jnp.fft.ifft(prod, axis=-1)                  # ifft = fft(-1)/n
+    offset = halfwidth * ACCEL_NUMBETWEEN
+    good = jax.lax.dynamic_slice_in_dim(corr, offset, uselen, axis=2)
+    # reference norm: |x|^2/fftlen^2 with unnormalized inverse FFT; jnp
+    # ifft divides by fftlen already, so only one factor remains... but
+    # the forward FFT here is unnormalized like COMPLEXFFT, so
+    # |ifft_np|^2 = |ifft_ref|^2 / fftlen^2 exactly matches ref norm.
+    return (good.real ** 2 + good.imag ** 2).astype(jnp.float32)
+
+
+@jax.jit
+def _block_median_norms(seg_pairs):
+    """Old-style per-block median power normalization factors.
+
+    norm = 1/sqrt(median(|amps|^2)/ln2) (accel_utils.c:952-967).
+    seg_pairs: [nblocks, numdata, 2] -> [nblocks, 1, 1] scale to apply
+    to amplitudes (the reference scales data before correlating).
+    """
+    pows = seg_pairs[..., 0] ** 2 + seg_pairs[..., 1] ** 2
+    med = jnp.maximum(jnp.median(pows, axis=-1), 1e-30)  # all-zero guard
+    return (1.0 / jnp.sqrt(med / jnp.log(2.0)))[:, None, None]
+
+
+# ----------------------------------------------------------------------
+# Device: harmonic summing + thresholding over the full plane
+# ----------------------------------------------------------------------
+
+def _harm_index_maps(cfg: AccelConfig, numz: int, r0: int, numr: int,
+                     plane_numr: int):
+    """Host-precomputed gather maps, stage by stage.
+
+    For each harmonic fraction j/2^s: row map zind[numz] into the plane
+    and column map rind[numr] (absolute half-bin -> plane column).
+    Parity: inmem_add_ffdotpows index math (accel_utils.c:1160-1207).
+    """
+    maps = []
+    zlo = -cfg.zmax
+    for stage in range(1, cfg.numharmstages):
+        harmtosum = 1 << stage
+        stage_maps = []
+        for harm in range(1, harmtosum, 2):
+            frac = harm / harmtosum
+            zs = zlo + np.arange(numz) * ACCEL_DZ
+            zinds = np.array([index_from_z(calc_required_z(frac, z), zlo)
+                              for z in zs], dtype=np.int32)
+            rr = r0 + np.arange(numr, dtype=np.int64)
+            rinds = np.minimum((rr * frac + 0.5).astype(np.int64),
+                               plane_numr - 1).astype(np.int32)
+            stage_maps.append((zinds, rinds))
+        maps.append(stage_maps)
+    return maps
+
+
+@partial(jax.jit, static_argnames=("k",))
+def _threshold_topk(powers, powcut, k):
+    """Top-k powers above cutoff: returns (vals, flat_idx) with vals
+    masked to 0 where below cutoff. powers: [numz, numr]."""
+    flat = powers.ravel()
+    masked = jnp.where(flat > powcut, flat, 0.0)
+    vals, idx = jax.lax.top_k(masked, k)
+    return vals, idx
+
+
+@dataclass
+class AccelCand:
+    """A raw search candidate (pre-sifting). Mirrors accelcand
+    (accel.h:76-86) minus the optimization fields."""
+    power: float
+    sigma: float
+    numharm: int
+    r: float           # fundamental-search r / numharm (candidate freq bin)
+    z: float
+
+    def freq(self, T: float) -> float:
+        return self.r / T
+
+
+class AccelSearch:
+    """In-memory accelsearch over a packed spectrum.
+
+    Usage:
+        s = AccelSearch(cfg, T=obs_seconds)
+        cands = s.search(fft_pairs)   # [numbins, 2] float32 pairs
+    """
+
+    def __init__(self, cfg: AccelConfig, T: float, numbins: int):
+        self.cfg = cfg
+        self.T = T
+        self.numbins = numbins
+        self.kern = AccelKernels.build(cfg)
+        self.rlo = cfg.rlo if cfg.rlo > 0 else max(cfg.flo * T, 8.0)
+        self.rhi = cfg.rhi if cfg.rhi > 0 else numbins - 1
+        # numindep & powcut per stage (accel_utils.c:1629-1641)
+        self.numindep = []
+        self.powcut = []
+        for ii in range(cfg.numharmstages):
+            harmtosum = 1 << ii
+            if cfg.numz == 1:
+                ni = (self.rhi - self.rlo) / harmtosum
+            else:
+                ni = ((self.rhi - self.rlo) * (cfg.numz + 1) *
+                      (ACCEL_DZ / 6.95) / harmtosum)
+            self.numindep.append(ni)
+            self.powcut.append(float(st.power_for_sigma(
+                cfg.sigma, harmtosum, ni)))
+
+    # -- plane ---------------------------------------------------------
+
+    def _plan_blocks(self):
+        """r-block starts (whole bins) covering [8, rhi] like the
+        reference's inmem pre-population + search loops
+        (accelsearch.c:143-160)."""
+        blocks = []
+        startr = 8.0
+        step = self.cfg.uselen * ACCEL_DR
+        # Only full, in-spectrum blocks are built/searched — same bound
+        # as the reference loop (accelsearch.c:167): a partial block at
+        # the top would be median-normalized against zero padding.
+        while startr + step < self.rhi:
+            blocks.append(startr)
+            startr += step
+        return blocks
+
+    def build_plane(self, fft_pairs: np.ndarray) -> np.ndarray:
+        """Fundamental F-Fdot plane P[numz, plane_numr] (float32, HBM).
+
+        plane column c = absolute half-bin (r = c * ACCEL_DR), starting
+        at column 0 == r 0 (columns below 16 are zero: the search and
+        pre-population start at r=8 as in accelsearch.c:144).
+        fft_pairs: [numbins, 2] float32 (the packed .fft as pairs).
+        """
+        cfg, kern = self.cfg, self.kern
+        starts = self._plan_blocks()
+        numdata = kern.fftlen // 2
+        segs = np.zeros((len(starts), numdata, 2), dtype=np.float32)
+        for i, s0 in enumerate(starts):
+            lobin = int(s0) - kern.halfwidth
+            lo = max(lobin, 0)
+            hi = min(lobin + numdata, self.numbins)
+            if hi > lo:
+                segs[i, lo - lobin:hi - lobin] = fft_pairs[lo:hi]
+        if not starts:
+            # spectrum too short for one full block: empty plane
+            return np.zeros((kern.numz, 0), dtype=np.float32)
+        kern_dev = jnp.asarray(kern.kern_pairs)
+        plane_numr = int(2 * int(starts[-1]) + cfg.uselen)
+        plane = np.zeros((kern.numz, plane_numr), dtype=np.float32)
+        # Chunk the block batch: the [chunk, numz, fftlen] complex
+        # intermediate is the peak memory, so bound it (~0.25 GB/chunk
+        # at zmax=200) — the HBM-ladder analog of meminfo.h.
+        chunk = max(1, int(2 ** 28 // (kern.numz * kern.fftlen * 8)))
+        for c0 in range(0, len(starts), chunk):
+            batch = segs[c0:c0 + chunk]
+            if batch.shape[0] < chunk:     # pad to keep one jit shape
+                pad = np.zeros((chunk - batch.shape[0],) + batch.shape[1:],
+                               dtype=np.float32)
+                pad[:, 0, 0] = 1.0         # avoid 0-median div-by-zero
+                batch = np.concatenate([batch, pad], axis=0)
+            bdev = jnp.asarray(batch)
+            norms = _block_median_norms(bdev)
+            powers = np.asarray(_ffdot_blocks(
+                bdev * norms, kern_dev, cfg.uselen, kern.fftlen,
+                kern.halfwidth))           # [chunk, numz, uselen]
+            for j, s0 in enumerate(starts[c0:c0 + chunk]):
+                col = int(s0) * ACCEL_RDR
+                plane[:, col:col + cfg.uselen] = powers[j]
+        return plane
+
+    # -- search --------------------------------------------------------
+
+    def search(self, fft_pairs: np.ndarray,
+               plane: Optional[np.ndarray] = None) -> List[AccelCand]:
+        """Run the full staged harmonic-summing search."""
+        cfg = self.cfg
+        if plane is None:
+            plane = self.build_plane(fft_pairs)
+        numz, plane_numr = plane.shape
+        r0 = int(self.rlo) * ACCEL_RDR          # first searched column
+        numr = min(int(self.rhi) * ACCEL_RDR, plane_numr) - r0
+        if numr <= 0:
+            return []
+        maps = _harm_index_maps(cfg, numz, r0, numr, plane_numr)
+
+        dplane = jnp.asarray(plane)
+        acc = jax.lax.dynamic_slice_in_dim(dplane, r0, numr, axis=1)
+        cands: List[AccelCand] = []
+        self._collect(acc, 1, r0, cands)
+        for stage in range(1, cfg.numharmstages):
+            harmtosum = 1 << stage
+            for (zinds, rinds) in maps[stage - 1]:
+                sub = jnp.take(dplane, jnp.asarray(zinds), axis=0)
+                sub = jnp.take(sub, jnp.asarray(rinds), axis=1)
+                acc = acc + sub
+            self._collect(acc, harmtosum, r0, cands)
+        return sorted(cands, key=lambda c: (-c.sigma, c.r))
+
+    def _collect(self, acc, numharm: int, r0: int,
+                 out: List[AccelCand]) -> None:
+        """Threshold+top-k on device; sigma + bookkeeping on host.
+        Parity: search_ffdotpows (accel_utils.c:1259-1298)."""
+        cfg = self.cfg
+        stage = int(np.log2(numharm))
+        k = min(cfg.max_cands_per_stage, int(np.prod(acc.shape)))
+        vals, idx = _threshold_topk(acc, self.powcut[stage], k)
+        vals = np.asarray(vals)
+        idx = np.asarray(idx)
+        good = vals > 0.0
+        if not np.any(good):
+            return
+        numr = acc.shape[1]
+        zi = idx[good] // numr
+        ri = idx[good] % numr
+        sigmas = st.candidate_sigma(vals[good], numharm,
+                                    self.numindep[stage])
+        for p, s, z_i, r_i in zip(vals[good], sigmas, zi, ri):
+            rr = (r0 + int(r_i)) * ACCEL_DR / numharm
+            zz = (-cfg.zmax + int(z_i) * ACCEL_DZ) / numharm
+            out.append(AccelCand(power=float(p), sigma=float(s),
+                                 numharm=numharm, r=rr, z=zz))
+
+
+# ----------------------------------------------------------------------
+# Candidate post-processing (host)
+# ----------------------------------------------------------------------
+
+# The reference's fixed list of "other common harmonic ratios"
+# (accel_utils.c:415-439) in addition to r*ii and r/ii, ii = 1..16.
+_HARM_RATIOS = [3 / 2, 5 / 2, 2 / 3, 4 / 3, 5 / 3, 3 / 4, 5 / 4, 2 / 5,
+                3 / 5, 4 / 5, 5 / 6, 2 / 7, 3 / 7, 4 / 7, 3 / 8, 5 / 8,
+                2 / 9, 3 / 10, 2 / 11, 3 / 11, 2 / 13, 3 / 13, 2 / 15]
+
+
+def eliminate_harmonics(cands: List[AccelCand],
+                        tooclose: float = 1.5,
+                        maxharm: int = 16) -> List[AccelCand]:
+    """Remove less-significant harmonically-related candidates.
+
+    Parity: eliminate_harmonics (accel_utils.c:384-460): walking the
+    sigma-sorted list, a later candidate is dropped when its r lies
+    within `tooclose` bins of r_strong*ii, r_strong/ii (ii<=16), or
+    r_strong*ratio for the fixed rational-ratio list.
+    """
+    if not cands:
+        return []
+    cands = sorted(cands, key=lambda c: (-c.sigma, c.r))
+    kept: List[AccelCand] = []
+    for c in cands:
+        is_harm = False
+        for k in kept:
+            rk, rc = k.r, c.r
+            if any(abs(rk / ii - rc) < tooclose or
+                   abs(rk * ii - rc) < tooclose
+                   for ii in range(1, maxharm + 1)):
+                is_harm = True
+            elif any(abs(rk * ratio - rc) < tooclose
+                     for ratio in _HARM_RATIOS):
+                is_harm = True
+            if is_harm:
+                break
+        if not is_harm:
+            kept.append(c)
+    return kept
+
+
+def remove_duplicates(cands: List[AccelCand]) -> List[AccelCand]:
+    """Collapse candidates within ACCEL_CLOSEST_R/2 bins & same numharm
+    family to the strongest (the sorted-insert dedup of
+    insert_new_accelcand, accel_utils.c:294-382)."""
+    kept: List[AccelCand] = []
+    for c in sorted(cands, key=lambda c: -c.sigma):
+        if all(abs(c.r - k.r) > ACCEL_CLOSEST_R / 2 or
+               abs(c.z - k.z) > ACCEL_DZ * 2 for k in kept):
+            kept.append(c)
+    return kept

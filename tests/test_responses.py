@@ -1,0 +1,92 @@
+"""Response kernels vs closed-form expectations (reference responses.c)."""
+
+import numpy as np
+
+from presto_tpu.ops import responses as resp
+
+
+def test_halfwidths():
+    assert resp.r_resp_halfwidth(resp.LOWACC) == 16
+    assert resp.r_resp_halfwidth(resp.HIGHACC) == 16 * 3 + 10 + 5
+    # z=0 gives the plain interpolation width
+    assert resp.z_resp_halfwidth(0.0) == 16
+    # formula check at z=200, LOWACC
+    m = int(200 * (0.00089 * 200 + 0.3131) + 16)
+    assert resp.z_resp_halfwidth(200.0) == m
+    # large-z clamp
+    assert resp.z_resp_halfwidth(1000.0) == int(0.6 * 1000)
+
+
+def test_r_response_center_is_unity():
+    r = resp.gen_r_response(0.0, 2, 64)
+    m = 32
+    assert abs(r[m] - 1.0) < 1e-12
+    # response is a sampled sinc: at integer bin offsets it vanishes
+    # (every 2nd sample away from center for numbetween=2)
+    offints = np.abs(r[m + 2::2])
+    assert np.all(offints < 1e-9)
+
+
+def test_r_response_offset_peak():
+    """Response at roffset=0.5 peaks between bins."""
+    r = resp.gen_r_response(0.5, 2, 64)
+    # |response| at the two center samples should be sinc(0.5±0.25)...
+    # simpler invariant: power sums to ~1 per bin width
+    assert 0.5 < np.max(np.abs(r)) <= 1.0
+
+
+def test_z_response_z0_matches_r_response():
+    a = resp.gen_z_response(0.0, 2, 0.0, 64)
+    b = resp.gen_r_response(0.0, 2, 64)
+    np.testing.assert_allclose(a, b, atol=1e-12)
+
+
+def test_z_response_energy_vs_width():
+    """The z kernel spreads unit response over ~z bins: its peak |value|
+    drops roughly as 1/sqrt(z) while total power stays ~constant."""
+    e = {}
+    for z in (4.0, 16.0, 64.0):
+        hw = resp.z_resp_halfwidth(z, resp.LOWACC)
+        k = resp.gen_z_response(0.0, 2, z, 4 * hw)
+        # integer-bin samples (every 2nd)
+        e[z] = (np.max(np.abs(k)), np.sum(np.abs(k[::2]) ** 2))
+    assert e[4.0][0] > e[16.0][0] > e[64.0][0]
+    # summed power across bins is conserved within ~20%
+    p = [e[z][1] for z in (4.0, 16.0, 64.0)]
+    assert max(p) / min(p) < 1.35
+
+
+def test_place_complex_kernel_wraps():
+    k = np.arange(8) + 0j
+    out = resp.place_complex_kernel(k, 16)
+    np.testing.assert_array_equal(out[:4].real, [4, 5, 6, 7])
+    np.testing.assert_array_equal(out[12:].real, [0, 1, 2, 3])
+    assert np.all(out[4:12] == 0)
+
+
+def test_spread_no_pad():
+    d = np.array([1 + 1j, 2 + 2j, 3 + 3j])
+    out = resp.spread_no_pad(d, 2, 8)
+    np.testing.assert_array_equal(out[::2], [1 + 1j, 2 + 2j, 3 + 3j, 0])
+    assert np.all(out[1::2] == 0)
+
+
+def test_w_response_reduces_to_z_response():
+    """At w→0 (just above the fallback cutoff) the quadrature w-kernel
+    must reproduce the Fresnel z-kernel for all conventions."""
+    for roffset in (0.0, 0.3):
+        for z in (0.0, 50.0):
+            hw = max(resp.z_resp_halfwidth(z), 20)
+            nk = 4 * hw
+            a = resp.gen_w_response(roffset, 2, z, 1.01e-4, nk)
+            b = resp.gen_z_response(roffset, 2, z, nk)
+            assert np.max(np.abs(a - b)) < 1e-3
+
+
+def test_nearest_int_half_away_from_zero():
+    from presto_tpu.search.accel import _nearest_int, calc_required_z
+    assert _nearest_int(0.5) == 1
+    assert _nearest_int(-0.5) == -1
+    assert _nearest_int(2.5) == 3
+    # z=2 at frac 1/2: 0.5*2*0.5 = 0.5 -> NEAREST_INT=1 -> z=2 (not 0)
+    assert calc_required_z(0.5, 2.0) == 2

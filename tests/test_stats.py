@@ -1,0 +1,67 @@
+"""Statistics parity: chi2 tails, sigma conversions, round trips."""
+
+import numpy as np
+from scipy.stats import chi2, norm
+
+from presto_tpu.ops import stats as st
+
+
+def test_chi2_logp_exact_branch():
+    # moderate values use the exact CDF
+    assert np.isclose(st.chi2_logp(10.0, 10), np.log(chi2.sf(10.0, 10)))
+    assert st.chi2_logp(0.0, 2) == -np.inf
+
+
+def test_chi2_logp_asymptotic_matches_scipy():
+    """The reference's A&S asymptotic branch should track scipy's logsf
+    in its domain of use (chi2/dof > 15) — and keep working where
+    scipy's logsf itself underflows to -inf (e.g. chi2=5000, dof=32)."""
+    for c, d in [(400.0, 2), (1000.0, 16)]:
+        got = st.chi2_logp(c, d)
+        want = chi2.logsf(c, d)
+        assert abs(got - want) < 5e-6 * abs(want), (c, d, got, want)
+    deep = st.chi2_logp(5000.0, 32)
+    assert np.isfinite(deep) and deep < -2000
+    assert chi2.logsf(5000.0, 32) == -np.inf  # scipy underflows here
+
+
+def test_equivalent_gaussian_sigma():
+    # sigma of p=0.00135 (1-sided) is ~3
+    logp = np.log(norm.sf(3.0))
+    assert abs(st.equivalent_gaussian_sigma(logp) - 3.0) < 1e-9
+    # extended branch roughly continuous across -600 (the A&S rational
+    # approximation the reference uses carries ~0.06 sigma of error at
+    # sigma~34, so the branch seam has a small jump — parity behavior)
+    a = st.equivalent_gaussian_sigma(-599.0)
+    b = st.equivalent_gaussian_sigma(-601.0)
+    assert abs(a - b) < 0.1
+
+
+def test_power_sigma_roundtrip():
+    for numharm in (1, 2, 4, 8, 16):
+        for sigma in (2.0, 5.0, 10.0):
+            numindep = 1e6
+            p = st.power_for_sigma(sigma, numharm, numindep)
+            back = st.candidate_sigma(p, numharm, numindep)
+            # power_for_sigma uses the exact CDF while candidate_sigma
+            # may route through the A&S asymptotic branch (as in the
+            # reference), so the roundtrip carries ~1e-4 of branch skew
+            assert abs(back - sigma) < 1e-3, (numharm, sigma, p, back)
+
+
+def test_candidate_sigma_known_values():
+    # a single power of 30 with no trial correction: logp = -30
+    # (chi2 with 2 dof: P(>2*30) = exp(-30))
+    s = st.candidate_sigma(30.0, 1, 1)
+    want = st.equivalent_gaussian_sigma(-30.0)
+    assert abs(s - want) < 1e-3  # asymptotic-branch skew, as in reference
+    assert st.candidate_sigma(0.0, 1, 1) == 0.0
+    # trials reduce significance
+    assert st.candidate_sigma(30.0, 1, 1e6) < s
+
+
+def test_candidate_sigma_vectorized():
+    powers = np.array([10.0, 20.0, 40.0])
+    sig = st.candidate_sigma(powers, 1, 1000)
+    assert sig.shape == (3,)
+    assert np.all(np.diff(sig) > 0)
