@@ -1,0 +1,71 @@
+"""Benchmark: accelsearch F-Fdot plane throughput on the current device.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Metric: F-Fdot cells/sec for a zmax=200, numharm=8 in-core search over
+a 2^21-bin spectrum (BASELINE.md config 4 analog).  A "cell" is one
+fundamental-plane (z, r) power: numz * numr_halfbins, divided by the
+full search wall time (plane build + harmonic sums + thresholding +
+host candidate collection), steady-state (after one warmup to exclude
+XLA compile).
+
+vs_baseline: ratio against the CPU reference proxy measured on this
+machine's host CPU — the same spread/FFT/cmul/IFFT/power loop in numpy
+(pocketfft), 5.37e7 cells/sec — standing in for the unbuildable
+FFTW/OpenMP reference build (BASELINE.md: reference publishes no
+numbers; the CPU build must be timed to create them).
+"""
+
+import json
+import sys
+import os
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import numpy as np
+
+CPU_PROXY_CELLS_PER_SEC = 5.37e7  # numpy pocketfft, this host, 2026-07
+
+
+def main():
+    import jax
+    from presto_tpu.search.accel import AccelConfig, AccelSearch
+
+    numbins = 1 << 21
+    T = 1000.0
+    rng = np.random.default_rng(42)
+    # noise spectrum + a few injected tones to exercise candidate paths
+    re = rng.normal(size=numbins).astype(np.float32)
+    im = rng.normal(size=numbins).astype(np.float32)
+    pairs = np.stack([re, im], -1)
+    for r0 in (12345, 123456, 765432):
+        pairs[r0] = (300.0, 0.0)
+
+    cfg = AccelConfig(zmax=200, numharm=8, sigma=6.0)
+    s = AccelSearch(cfg, T=T, numbins=numbins)
+
+    t0 = time.time()
+    cands = s.search(pairs)          # warmup (includes XLA compile)
+    warm = time.time() - t0
+
+    t0 = time.time()
+    cands = s.search(pairs)
+    elapsed = time.time() - t0
+
+    numr = int(s.rhi - s.rlo) * 2
+    cells = cfg.numz * numr
+    value = cells / elapsed
+    print(json.dumps({
+        "metric": "ffdot_cells_per_sec_zmax200_nh8",
+        "value": round(value, 1),
+        "unit": "cells/s",
+        "vs_baseline": round(value / CPU_PROXY_CELLS_PER_SEC, 2),
+    }))
+    print("# device=%s warmup=%.1fs steady=%.1fs cells=%.3g cands=%d"
+          % (jax.devices()[0].platform, warm, elapsed, cells, len(cands)),
+          file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

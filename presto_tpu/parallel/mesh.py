@@ -1,0 +1,53 @@
+"""Device mesh construction for the search pipeline.
+
+The reference's only distributed axis is DM trials over MPI ranks
+(mpiprepsubband, SURVEY.md §2.5/§3.5: rank 0 reads + broadcasts raw
+blocks, workers each own numdms/(numprocs-1) DM trials, no worker-to-
+worker traffic).  TPU-native mapping: one logical jit program over a
+`jax.sharding.Mesh` whose axes are
+
+  'dm'  — DM trials (pure data parallel; the MPI_Bcast becomes a
+          replicated-input sharding, the per-rank .dat writes become a
+          DM-sharded output array)
+  'seq' — time/frequency samples (sequence parallel for huge FFTs:
+          the six-step transpose becomes an ICI all-to-all)
+
+Search stages reuse the same mesh: the F-Fdot plane shards its z-rows
+or r-blocks over 'dm' (both embarrassingly parallel) and candidate
+top-k reduces device-locally before one host gather, mirroring the
+reference's "no inter-worker traffic" property.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def make_mesh(n_devices: Optional[int] = None,
+              axis_names: Sequence[str] = ("dm",),
+              shape: Optional[Sequence[int]] = None) -> Mesh:
+    """Build a mesh over the first n_devices (default: all)."""
+    devs = jax.devices()
+    if n_devices is None:
+        n_devices = len(devs)
+    devs = devs[:n_devices]
+    if shape is None:
+        shape = (n_devices,) + (1,) * (len(axis_names) - 1)
+    arr = np.array(devs).reshape(tuple(shape))
+    return Mesh(arr, tuple(axis_names))
+
+
+def dm_sharding(mesh: Mesh, ndim: int = 2, dm_axis: int = 0):
+    """NamedSharding placing the DM-trial axis across the 'dm' mesh
+    axis; remaining dims replicated."""
+    spec = [None] * ndim
+    spec[dm_axis] = "dm"
+    return NamedSharding(mesh, P(*spec))
+
+
+def replicated(mesh: Mesh):
+    return NamedSharding(mesh, P())

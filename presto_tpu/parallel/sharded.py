@@ -1,0 +1,130 @@
+"""DM-sharded dedispersion + search pipeline steps (pjit over a Mesh).
+
+The mpiprepsubband invariant (SURVEY.md §4.8): sharded output must
+equal unsharded output for the same DMs.  Tests enforce this on an
+8-device virtual CPU mesh; the driver's dryrun validates compile+run.
+
+Sharding layout (mirrors mpiprepsubband.c:288-297's DM partition):
+  raw blocks      [C, T]            replicated  (the MPI_Bcast analog)
+  chan delays     [C]               replicated
+  per-DM delays   [numdms, nsub]    sharded on 'dm'
+  output series   [numdms, T]       sharded on 'dm'
+No inter-device communication is needed after the input replication —
+XLA sees the gather/sum is elementwise in the sharded axis.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from presto_tpu.ops.dedispersion import (dedisp_subbands_block,
+                                         float_dedisp_many_block,
+                                         downsample_block)
+from presto_tpu.parallel.mesh import dm_sharding, replicated
+
+
+def shard_dm_array(arr, mesh: Mesh):
+    """Place [numdms, ...] array with the DM axis across mesh 'dm'."""
+    return jax.device_put(arr, dm_sharding(mesh, np.ndim(arr)))
+
+
+def make_sharded_dedisperse_step(mesh: Mesh, numsubbands: int,
+                                 downsamp: int = 1):
+    """jit-compiled (prev_raw, raw, prev_sub, chan_delays, dm_delays) ->
+    (sub, series[numdms, T//downsamp]) with DM-sharded output.
+
+    One streaming step of the prepsubband pipeline: channels->subbands
+    on replicated data, then the DM fan-out sharded over devices.
+    """
+    out_shardings = (replicated(mesh), dm_sharding(mesh, 2))
+
+    @partial(jax.jit, out_shardings=out_shardings)
+    def step(prev_raw, raw, prev_sub, chan_delays, dm_delays):
+        sub = dedisp_subbands_block(prev_raw, raw, chan_delays, numsubbands)
+        series = float_dedisp_many_block(prev_sub, sub, dm_delays)
+        return sub, downsample_block(series, downsamp)
+
+    return step
+
+
+def sharded_dedisperse_stream(blocks, chan_delays, dm_delays, mesh: Mesh,
+                              numsubbands: int, downsamp: int = 1):
+    """Dedisperse a [nblocks, C, T] stream at [numdms, nsub] delays with
+    the DM axis sharded over `mesh`.  Returns [numdms, (nblocks-2)*T].
+
+    Host-driven block loop (the real pipeline streams from disk); the
+    carry logic matches ops.dedispersion.dedisperse_scan.
+    """
+    step = make_sharded_dedisperse_step(mesh, numsubbands, downsamp)
+    chan_delays = jnp.asarray(chan_delays, dtype=jnp.int32)
+    dm_delays = shard_dm_array(jnp.asarray(dm_delays, dtype=jnp.int32), mesh)
+    prev_raw = jnp.asarray(blocks[0])
+    raw = jnp.asarray(blocks[1])
+    prev_sub = dedisp_subbands_block(prev_raw, raw, chan_delays,
+                                     numsubbands)
+    outs = []
+    for i in range(2, len(blocks)):
+        cur = jnp.asarray(blocks[i])
+        sub, series = step(raw, cur, prev_sub, chan_delays, dm_delays)
+        outs.append(series)
+        prev_sub, raw = sub, cur
+    return jnp.concatenate(outs, axis=1)
+
+
+# ----------------------------------------------------------------------
+# Sequence-sharded six-step FFT (the out-of-core / huge-FFT analog)
+# ----------------------------------------------------------------------
+
+def sixstep_fft(x, rows: int):
+    """Complex DFT of x (length N = rows*cols) via the six-step
+    decomposition (reference fastffts.c:38-195 / twopass_real_fwd.c:10):
+      view x as [rows, cols] row-major -> FFT columns (length rows)
+      -> twiddle W_N^(j2*k1) -> FFT rows (length cols) -> transpose.
+    Shards naturally: with the row axis sharded over 'seq', the column
+    FFT is local, the twiddle is elementwise, and the final transpose
+    is XLA's all-to-all — the disk-transpose of the reference's
+    out-of-core FFT becomes ICI traffic.
+
+    Returns X[k] == jnp.fft.fft(x) (validated in tests).
+    """
+    N = x.shape[-1]
+    cols = N // rows
+    # x[j1*cols + j2] -> A[j1, j2]
+    A = x.reshape(rows, cols)
+    # sum over j1: FFT along axis 0 (length rows) for each j2 -> B[k1, j2]
+    B = jnp.fft.fft(A, axis=0)
+    # twiddle W_N^(j2*k1)
+    k1 = jnp.arange(rows)[:, None]
+    j2 = jnp.arange(cols)[None, :]
+    tw = jnp.exp(-2j * jnp.pi * (k1 * j2) / N).astype(B.dtype)
+    C = B * tw
+    # sum over j2: FFT along axis 1 (length cols) -> D[k1, k2]
+    D = jnp.fft.fft(C, axis=1)
+    # X[k1 + rows*k2] = D[k1, k2] -> transpose then ravel
+    return D.T.reshape(-1)
+
+
+def make_sharded_sixstep_fft(mesh: Mesh, rows: int):
+    """jit'd sequence-sharded FFT: input pairs [N,2] float32 sharded on
+    'seq' (if present, else 'dm'), output pairs sharded the same way.
+
+    The intermediate [rows, cols] matrix is sharded on the row axis;
+    jnp.fft.fft along the sharded axis forces XLA to insert the
+    all-to-all — exactly the six-step communication pattern.
+    """
+    axis = "seq" if "seq" in mesh.axis_names else mesh.axis_names[0]
+    io_sharding = NamedSharding(mesh, P(axis, None))
+
+    @partial(jax.jit, out_shardings=io_sharding)
+    def fft_pairs(xp):
+        x = xp[..., 0] + 1j * xp[..., 1]
+        X = sixstep_fft(x, rows)
+        return jnp.stack([X.real, X.imag], axis=-1).astype(jnp.float32)
+
+    return fft_pairs

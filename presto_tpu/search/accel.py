@@ -187,40 +187,93 @@ def _block_median_norms(seg_pairs):
 # Device: harmonic summing + thresholding over the full plane
 # ----------------------------------------------------------------------
 
-def _harm_index_maps(cfg: AccelConfig, numz: int, r0: int, numr: int,
-                     plane_numr: int):
-    """Host-precomputed gather maps, stage by stage.
+def _harm_fracs_and_zinds(cfg: AccelConfig, numz: int):
+    """Host-precomputed per-stage harmonic fractions and z-row maps.
 
-    For each harmonic fraction j/2^s: row map zind[numz] into the plane
-    and column map rind[numr] (absolute half-bin -> plane column).
-    Parity: inmem_add_ffdotpows index math (accel_utils.c:1160-1207).
+    For each stage s >= 1 and odd harm < 2^s: fraction harm/2^s and the
+    z-row gather map zind[numz] (inmem_add_ffdotpows index math,
+    accel_utils.c:1160-1207).  Column maps are computed on device from
+    the fraction (round-half-up of absolute half-bin * frac).
     """
-    maps = []
+    out = []
     zlo = -cfg.zmax
+    zs = zlo + np.arange(numz) * ACCEL_DZ
     for stage in range(1, cfg.numharmstages):
         harmtosum = 1 << stage
-        stage_maps = []
+        stage_list = []
         for harm in range(1, harmtosum, 2):
             frac = harm / harmtosum
-            zs = zlo + np.arange(numz) * ACCEL_DZ
             zinds = np.array([index_from_z(calc_required_z(frac, z), zlo)
                               for z in zs], dtype=np.int32)
-            rr = r0 + np.arange(numr, dtype=np.int64)
-            rinds = np.minimum((rr * frac + 0.5).astype(np.int64),
-                               plane_numr - 1).astype(np.int32)
-            stage_maps.append((zinds, rinds))
-        maps.append(stage_maps)
-    return maps
+            stage_list.append((harm, harmtosum, zinds))
+        out.append(stage_list)
+    return out
 
 
-@partial(jax.jit, static_argnames=("k",))
-def _threshold_topk(powers, powcut, k):
-    """Top-k powers above cutoff: returns (vals, flat_idx) with vals
-    masked to 0 where below cutoff. powers: [numz, numr]."""
-    flat = powers.ravel()
-    masked = jnp.where(flat > powcut, flat, 0.0)
-    vals, idx = jax.lax.top_k(masked, k)
-    return vals, idx
+def _make_search_scanner(numharmstages, fracs_zinds, powcuts, slab, k,
+                         plane_numr):
+    """One jit'd function running the whole staged search as a lax.scan
+    over slab start columns (a single device dispatch — the tunneled
+    TPU pays ~0.1-0.4 s latency per call, so per-slab calls dominate
+    wall time otherwise).
+
+    Per slab: accumulate the harmonic sums, then per stage reduce each
+    column to its max over z (same-column different-z cells are exact
+    duplicates under the sifter's r-dedup) and top-k the columns above
+    powcut.  Column gather indices use exact int32 round-half-up of
+    (abs_halfbin * harm / htot), equal to the reference's
+    (int)(rrint*frac + 0.5) double math (accel_utils.c:1169-1175), and
+    each harmonic reads only its contiguous source window via
+    dynamic_slice (bounded gather traffic).
+    """
+    powcuts = jnp.asarray(powcuts, dtype=jnp.float32)
+    fz = [(harm, htot, jnp.asarray(zi)) for stage in fracs_zinds
+          for (harm, htot, zi) in stage]
+
+    def slab_body(P, start_col):
+        cols = start_col + jnp.arange(slab, dtype=jnp.int32)
+        acc = jax.lax.dynamic_slice(P, (0, start_col), (P.shape[0], slab))
+
+        def collect(acc, stage):
+            colmax = acc.max(axis=0)
+            colz = acc.argmax(axis=0).astype(jnp.int32)
+            masked = jnp.where(colmax > powcuts[stage], colmax, 0.0)
+            v, ci = jax.lax.top_k(masked, k)
+            return v, ci, jnp.take(colz, ci)
+
+        outs = [collect(acc, 0)]
+        fi = 0
+        for stage in range(1, numharmstages):
+            for _ in range(1 << (stage - 1)):   # odd harmonics
+                harm, htot, zinds = fz[fi]
+                fi += 1
+                # round-half-up of cols*harm/htot without int32 overflow
+                # (split off the quotient so the multiply stays < 2^31
+                # even for billion-bin spectra): exact for htot = 2^s.
+                rind = ((cols // htot) * harm
+                        + ((cols % htot) * harm + (htot >> 1)) // htot)
+                cstart = jnp.minimum(
+                    (start_col // htot) * harm
+                    + ((start_col % htot) * harm + (htot >> 1)) // htot,
+                    plane_numr - slab)
+                src = jax.lax.dynamic_slice(P, (0, cstart),
+                                            (P.shape[0], slab))
+                sub = jnp.take(src, zinds, axis=0)
+                acc = acc + jnp.take(sub, rind - cstart, axis=1)
+            outs.append(collect(acc, stage))
+        vals = jnp.stack([o[0] for o in outs])      # [stages, k]
+        cidx = jnp.stack([o[1] for o in outs])
+        zrow = jnp.stack([o[2] for o in outs])
+        return vals, cidx, zrow
+
+    @jax.jit
+    def scan_all(P, start_cols):
+        def body(carry, start):
+            return carry, slab_body(P, start)
+        _, (vals, cidx, zrow) = jax.lax.scan(body, None, start_cols)
+        return vals, cidx, zrow   # [nslabs, stages, k]
+
+    return scan_all
 
 
 @dataclass
@@ -250,6 +303,8 @@ class AccelSearch:
         self.T = T
         self.numbins = numbins
         self.kern = AccelKernels.build(cfg)
+        self._fn_cache = {}   # compiled build/scan fns (avoid re-jit)
+        self._kern_dev = None  # device copy of the kernel bank (lazy)
         self.rlo = cfg.rlo if cfg.rlo > 0 else max(cfg.flo * T, 8.0)
         self.rhi = cfg.rhi if cfg.rhi > 0 else numbins - 1
         # numindep & powcut per stage (accel_utils.c:1629-1641)
@@ -283,16 +338,24 @@ class AccelSearch:
             startr += step
         return blocks
 
-    def build_plane(self, fft_pairs: np.ndarray) -> np.ndarray:
-        """Fundamental F-Fdot plane P[numz, plane_numr] (float32, HBM).
+    def build_plane(self, fft_pairs: np.ndarray):
+        """Fundamental F-Fdot plane P[numz, plane_numr] — a device
+        array resident in HBM (host transfers of the multi-GB plane
+        through the host<->TPU link would dominate the search time).
 
         plane column c = absolute half-bin (r = c * ACCEL_DR), starting
         at column 0 == r 0 (columns below 16 are zero: the search and
-        pre-population start at r=8 as in accelsearch.c:144).
+        pre-population start at r=8 as in accelsearch.c:144).  Block j
+        occupies the contiguous columns [16 + j*uselen, 16 + (j+1)*
+        uselen): starts are 8 + j*uselen*DR, all integral, so each
+        device chunk writes one contiguous slab via dynamic_update_slice.
         fft_pairs: [numbins, 2] float32 (the packed .fft as pairs).
         """
         cfg, kern = self.cfg, self.kern
         starts = self._plan_blocks()
+        if not starts:
+            # spectrum too short for one full block: empty plane
+            return jnp.zeros((kern.numz, 0), dtype=jnp.float32)
         numdata = kern.fftlen // 2
         segs = np.zeros((len(starts), numdata, 2), dtype=np.float32)
         for i, s0 in enumerate(starts):
@@ -301,38 +364,68 @@ class AccelSearch:
             hi = min(lobin + numdata, self.numbins)
             if hi > lo:
                 segs[i, lo - lobin:hi - lobin] = fft_pairs[lo:hi]
-        if not starts:
-            # spectrum too short for one full block: empty plane
-            return np.zeros((kern.numz, 0), dtype=np.float32)
-        kern_dev = jnp.asarray(kern.kern_pairs)
+        if self._kern_dev is None:   # one upload; reused by cached fns
+            self._kern_dev = jnp.asarray(kern.kern_pairs)
+        kern_dev = self._kern_dev
         plane_numr = int(2 * int(starts[-1]) + cfg.uselen)
-        plane = np.zeros((kern.numz, plane_numr), dtype=np.float32)
+        plane = jnp.zeros((kern.numz, plane_numr), dtype=jnp.float32)
         # Chunk the block batch: the [chunk, numz, fftlen] complex
-        # intermediate is the peak memory, so bound it (~0.25 GB/chunk
-        # at zmax=200) — the HBM-ladder analog of meminfo.h.
+        # intermediate is the peak working memory, so bound it (~0.25 GB
+        # per chunk at zmax=200) — the HBM-ladder analog of meminfo.h.
         chunk = max(1, int(2 ** 28 // (kern.numz * kern.fftlen * 8)))
-        for c0 in range(0, len(starts), chunk):
-            batch = segs[c0:c0 + chunk]
-            if batch.shape[0] < chunk:     # pad to keep one jit shape
-                pad = np.zeros((chunk - batch.shape[0],) + batch.shape[1:],
-                               dtype=np.float32)
-                pad[:, 0, 0] = 1.0         # avoid 0-median div-by-zero
-                batch = np.concatenate([batch, pad], axis=0)
-            bdev = jnp.asarray(batch)
-            norms = _block_median_norms(bdev)
-            powers = np.asarray(_ffdot_blocks(
-                bdev * norms, kern_dev, cfg.uselen, kern.fftlen,
-                kern.halfwidth))           # [chunk, numz, uselen]
-            for j, s0 in enumerate(starts[c0:c0 + chunk]):
-                col = int(s0) * ACCEL_RDR
-                plane[:, col:col + cfg.uselen] = powers[j]
-        return plane
+        col0 = int(starts[0]) * ACCEL_RDR
+
+        def write_chunk(pl, batch, start_col):
+            norms = _block_median_norms(batch)
+            powers = _ffdot_blocks(batch * norms, kern_dev, cfg.uselen,
+                                   kern.fftlen, kern.halfwidth)
+            # [chunk, numz, uselen] -> [numz, chunk*uselen] slab
+            slabv = jnp.moveaxis(powers, 0, 1).reshape(kern.numz, -1)
+            return jax.lax.dynamic_update_slice(pl, slabv, (0, start_col))
+
+        # One device dispatch: scan over chunks inside a single jit,
+        # carrying the plane (per-call tunnel latency would otherwise
+        # dominate — ~0.1-0.4 s per call on the tunneled TPU).
+        nblocks = len(starts)
+        chunk = min(chunk, nblocks)
+        chunk_ids = []
+        c0 = 0
+        while c0 < nblocks:
+            if c0 + chunk > nblocks:
+                c0 = nblocks - chunk   # overlap: rewrites same values
+            chunk_ids.append(c0)
+            c0 += chunk
+        seg_chunks = np.stack([segs[i:i + chunk] for i in chunk_ids])
+        start_cols = np.asarray(
+            [col0 + i * cfg.uselen for i in chunk_ids], dtype=np.int32)
+
+        key = ("build", chunk, len(chunk_ids), plane_numr)
+        if key not in self._fn_cache:
+            @partial(jax.jit, donate_argnums=(0,))
+            def build_all(pl, seg_chunks, start_cols):
+                def body(pl, xs):
+                    batch, start_col = xs
+                    return write_chunk(pl, batch, start_col), None
+                pl, _ = jax.lax.scan(body, pl, (seg_chunks, start_cols))
+                return pl
+            self._fn_cache[key] = build_all
+
+        return self._fn_cache[key](plane, jnp.asarray(seg_chunks),
+                                   jnp.asarray(start_cols))
 
     # -- search --------------------------------------------------------
 
     def search(self, fft_pairs: np.ndarray,
-               plane: Optional[np.ndarray] = None) -> List[AccelCand]:
-        """Run the full staged harmonic-summing search."""
+               plane: Optional[np.ndarray] = None,
+               slab: int = 1 << 19) -> List[AccelCand]:
+        """Run the full staged harmonic-summing search.
+
+        The plane stays resident in HBM; the search region is processed
+        in `slab`-column accumulator slabs (peak extra memory ~
+        numz*slab floats per gather), each slab thresholded+top-k'd per
+        stage on device with candidates collected on host — bounding
+        memory for arbitrarily long spectra.
+        """
         cfg = self.cfg
         if plane is None:
             plane = self.build_plane(fft_pairs)
@@ -341,44 +434,62 @@ class AccelSearch:
         numr = min(int(self.rhi) * ACCEL_RDR, plane_numr) - r0
         if numr <= 0:
             return []
-        maps = _harm_index_maps(cfg, numz, r0, numr, plane_numr)
-
+        slab = min(slab, numr)
+        k = min(cfg.max_cands_per_stage, slab)
+        key = ("scan", slab, k, plane_numr)
+        if key not in self._fn_cache:
+            fz = _harm_fracs_and_zinds(cfg, numz)
+            self._fn_cache[key] = _make_search_scanner(
+                cfg.numharmstages, fz, self.powcut, slab, k, plane_numr)
+        scanner = self._fn_cache[key]
+        start_cols = []
+        for off in range(0, numr, slab):
+            start = r0 + off
+            if off + slab > numr:               # keep one jit shape:
+                start = r0 + numr - slab        # overlap the last slab
+            start_cols.append(start)
         dplane = jnp.asarray(plane)
-        acc = jax.lax.dynamic_slice_in_dim(dplane, r0, numr, axis=1)
+        vals, cidx, zrow = scanner(dplane,
+                                   jnp.asarray(start_cols, dtype=jnp.int32))
+        vals = np.asarray(vals)                  # [nslabs, stages, k]
+        cidx = np.asarray(cidx)
+        zrow = np.asarray(zrow)
         cands: List[AccelCand] = []
-        self._collect(acc, 1, r0, cands)
-        for stage in range(1, cfg.numharmstages):
-            harmtosum = 1 << stage
-            for (zinds, rinds) in maps[stage - 1]:
-                sub = jnp.take(dplane, jnp.asarray(zinds), axis=0)
-                sub = jnp.take(sub, jnp.asarray(rinds), axis=1)
-                acc = acc + sub
-            self._collect(acc, harmtosum, r0, cands)
-        return sorted(cands, key=lambda c: (-c.sigma, c.r))
+        for si, start in enumerate(start_cols):
+            self._collect_slab(vals[si], cidx[si], zrow[si], start, cands)
+        # overlapping the final slab can duplicate candidates: dedup on
+        # exact (numharm, r, z)
+        seen = set()
+        uniq = []
+        for c in cands:
+            key = (c.numharm, c.r, c.z)
+            if key not in seen:
+                seen.add(key)
+                uniq.append(c)
+        return sorted(uniq, key=lambda c: (-c.sigma, c.r))
 
-    def _collect(self, acc, numharm: int, r0: int,
-                 out: List[AccelCand]) -> None:
-        """Threshold+top-k on device; sigma + bookkeeping on host.
-        Parity: search_ffdotpows (accel_utils.c:1259-1298)."""
+    def _collect_slab(self, vals: np.ndarray, cidx: np.ndarray,
+                      zrow: np.ndarray, start_col: int,
+                      out: List[AccelCand]) -> None:
+        """Host-side candidate construction from per-stage top-k.
+        Parity: search_ffdotpows (accel_utils.c:1259-1298); each column
+        contributes its max-over-z cell (same-column lower-z cells are
+        duplicates under the sifter's r-dedup)."""
         cfg = self.cfg
-        stage = int(np.log2(numharm))
-        k = min(cfg.max_cands_per_stage, int(np.prod(acc.shape)))
-        vals, idx = _threshold_topk(acc, self.powcut[stage], k)
-        vals = np.asarray(vals)
-        idx = np.asarray(idx)
-        good = vals > 0.0
-        if not np.any(good):
-            return
-        numr = acc.shape[1]
-        zi = idx[good] // numr
-        ri = idx[good] % numr
-        sigmas = st.candidate_sigma(vals[good], numharm,
-                                    self.numindep[stage])
-        for p, s, z_i, r_i in zip(vals[good], sigmas, zi, ri):
-            rr = (r0 + int(r_i)) * ACCEL_DR / numharm
-            zz = (-cfg.zmax + int(z_i) * ACCEL_DZ) / numharm
-            out.append(AccelCand(power=float(p), sigma=float(s),
-                                 numharm=numharm, r=rr, z=zz))
+        for stage in range(vals.shape[0]):
+            numharm = 1 << stage
+            v = vals[stage]
+            good = v > 0.0
+            if not np.any(good):
+                continue
+            sigmas = st.candidate_sigma(v[good], numharm,
+                                        self.numindep[stage])
+            for p, s, z_i, r_i in zip(v[good], sigmas, zrow[stage][good],
+                                      cidx[stage][good]):
+                rr = (start_col + int(r_i)) * ACCEL_DR / numharm
+                zz = (-cfg.zmax + int(z_i) * ACCEL_DZ) / numharm
+                out.append(AccelCand(power=float(p), sigma=float(s),
+                                     numharm=numharm, r=rr, z=zz))
 
 
 # ----------------------------------------------------------------------
@@ -425,12 +536,13 @@ def eliminate_harmonics(cands: List[AccelCand],
 
 
 def remove_duplicates(cands: List[AccelCand]) -> List[AccelCand]:
-    """Collapse candidates within ACCEL_CLOSEST_R/2 bins & same numharm
-    family to the strongest (the sorted-insert dedup of
-    insert_new_accelcand, accel_utils.c:294-382)."""
+    """Collapse candidates within ACCEL_CLOSEST_R bins of a stronger one
+    to the strongest, regardless of z — the exact dedup rule of
+    insert_new_accelcand (accel_utils.c:294-382), which keys on r alone.
+    This also makes the device search's per-column max-over-z reduction
+    lossless with respect to the final candidate list."""
     kept: List[AccelCand] = []
-    for c in sorted(cands, key=lambda c: -c.sigma):
-        if all(abs(c.r - k.r) > ACCEL_CLOSEST_R / 2 or
-               abs(c.z - k.z) > ACCEL_DZ * 2 for k in kept):
+    for c in sorted(cands, key=lambda c: (-c.sigma, c.r)):
+        if all(abs(c.r - k.r) >= ACCEL_CLOSEST_R for k in kept):
             kept.append(c)
     return kept
