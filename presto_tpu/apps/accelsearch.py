@@ -1,0 +1,136 @@
+"""accelsearch: F-Fdot acceleration search over a .fft or .dat file.
+
+CLI parity with the reference accelsearch (clig/accelsearch_cmd.cli;
+src/accelsearch.c:43-): -zmax, -numharm, -sigma, -flo/-rlo/-rhi,
+-zaplist, -baryv, -inmem (always effectively in-memory here).  Outputs
+<base>_ACCEL_<zmax> (text candidate table, column structure of
+output_fundamentals accel_utils.c:565-718) and
+<base>_ACCEL_<zmax>.cand (binary candidate dump).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import struct
+
+import numpy as np
+import jax.numpy as jnp
+
+from presto_tpu.apps.common import load_spectrum, load_timeseries, ensure_backend
+from presto_tpu.ops import fftpack
+from presto_tpu.ops.rednoise import (deredden, read_birds, zap_bins,
+                                     birds_to_bin_ranges)
+from presto_tpu.search.accel import (AccelConfig, AccelSearch,
+                                     eliminate_harmonics,
+                                     remove_duplicates)
+
+
+def build_parser():
+    p = argparse.ArgumentParser(prog="accelsearch")
+    p.add_argument("-zmax", type=int, default=200)
+    p.add_argument("-numharm", type=int, default=8)
+    p.add_argument("-sigma", type=float, default=2.0)
+    p.add_argument("-flo", type=float, default=1.0)
+    p.add_argument("-rlo", type=float, default=0.0)
+    p.add_argument("-rhi", type=float, default=0.0)
+    p.add_argument("-zaplist", type=str, default=None)
+    p.add_argument("-baryv", type=float, default=0.0)
+    p.add_argument("-inmem", action="store_true",
+                   help="Accepted for parity (search is in-memory)")
+    p.add_argument("-ncpus", type=int, default=1)
+    p.add_argument("infile")
+    return p
+
+
+def write_cand_file(path: str, cands) -> None:
+    """Binary .cand dump: one record per candidate of
+    (power f4, sigma f4, numharm i4, r f8, z f8)."""
+    with open(path, "wb") as f:
+        for c in cands:
+            f.write(struct.pack("<ffidd", c.power, c.sigma, c.numharm,
+                                c.r, c.z))
+
+
+def read_cand_file(path: str):
+    from presto_tpu.search.accel import AccelCand
+    out = []
+    rec = struct.calcsize("<ffidd")
+    with open(path, "rb") as f:
+        while True:
+            b = f.read(rec)
+            if len(b) < rec:
+                break
+            power, sigma, numharm, r, z = struct.unpack("<ffidd", b)
+            out.append(AccelCand(power=power, sigma=sigma,
+                                 numharm=numharm, r=r, z=z))
+    return out
+
+
+def write_accel_file(path: str, cands, T: float) -> None:
+    """Text table with the reference's column structure
+    (output_fundamentals, accel_utils.c:565-718)."""
+    with open(path, "w") as f:
+        f.write("             Summed  Coherent  Num        Period      "
+                "    Frequency         FFT 'r'        Freq Deriv      "
+                "FFT 'z'      Accel    \n")
+        f.write("Cand  Sigma   Power    Power   Harm       (ms)        "
+                "      (Hz)            (bin)           (Hz/s)         "
+                "(bins)      (m/s^2)  \n")
+        f.write("-" * 130 + "\n")
+        for i, c in enumerate(cands, 1):
+            freq = c.r / T
+            period_ms = 1000.0 / freq if freq > 0 else 0.0
+            fdot = c.z / (T * T)
+            accel = c.z * 299792458.0 / (T * T * max(freq, 1e-12))
+            f.write("%-4d  %-5.2f  %-7.2f  %-7.2f  %-3d  %-15.8g  "
+                    "%-15.8g  %-14.4f  %-15.6g  %-10.2f  %-10.4g\n"
+                    % (i, c.sigma, c.power, c.power / c.numharm,
+                       c.numharm, period_ms, freq, c.r, fdot, c.z,
+                       accel))
+
+
+def run(args):
+    ensure_backend()
+    base, ext = os.path.splitext(args.infile)
+    if ext == ".dat" or (not os.path.exists(base + ".fft")
+                         and os.path.exists(base + ".dat")):
+        data, info = load_timeseries(base)
+        n = data.size & ~1
+        pairs = np.asarray(fftpack.realfft_packed_pairs(
+            jnp.asarray(data[:n] - data[:n].mean())))
+        amps = fftpack.np_pairs_to_complex64(pairs)
+        amps = deredden(amps)
+        pairs = fftpack.np_complex64_to_pairs(amps)
+    else:
+        pairs, info = load_spectrum(base)
+    T = info.N * info.dt
+    numbins = pairs.shape[0]
+
+    if args.zaplist:
+        birds = read_birds(args.zaplist)
+        amps = fftpack.np_pairs_to_complex64(pairs)
+        amps = zap_bins(amps, birds_to_bin_ranges(birds, T, args.baryv))
+        pairs = fftpack.np_complex64_to_pairs(amps)
+
+    cfg = AccelConfig(zmax=args.zmax, numharm=args.numharm,
+                      sigma=args.sigma, flo=args.flo, rlo=args.rlo,
+                      rhi=args.rhi)
+    searcher = AccelSearch(cfg, T=T, numbins=numbins)
+    raw = searcher.search(pairs)
+    cands = remove_duplicates(eliminate_harmonics(raw))
+
+    accelnm = "%s_ACCEL_%d" % (base, args.zmax)
+    write_accel_file(accelnm, cands, T)
+    write_cand_file(accelnm + ".cand", cands)
+    print("accelsearch: %d raw -> %d final candidates -> %s"
+          % (len(raw), len(cands), accelnm))
+    return cands
+
+
+def main(argv=None):
+    run(build_parser().parse_args(argv))
+
+
+if __name__ == "__main__":
+    main()

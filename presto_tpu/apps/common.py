@@ -1,0 +1,82 @@
+"""Shared CLI plumbing for the app layer.
+
+The reference generates each tool's parser from clig specs
+(clig/*.cli -> src/*_cmd.c, SURVEY.md §2.4); here argparse parsers are
+built with the same flag names so command lines port over unchanged.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+from typing import Optional, Tuple
+
+import numpy as np
+
+from presto_tpu.io.infodata import InfoData, read_inf, ARTIFICIAL_TELESCOPE
+from presto_tpu.io.sigproc import FilterbankFile
+from presto_tpu.io import datfft
+
+
+def ensure_backend() -> None:
+    """Fall back to an available JAX backend when JAX_PLATFORMS names an
+    unregistered one (e.g. a platform plugin whose sitecustomize didn't
+    load because PYTHONPATH was overridden).  CLI tools should run on
+    whatever device exists rather than crash."""
+    import jax
+    try:
+        jax.devices()
+    except RuntimeError:
+        for plat in ("", "cpu"):
+            try:
+                jax.config.update("jax_platforms", plat)
+                jax.devices()
+                return
+            except RuntimeError:
+                continue
+        raise
+
+
+def add_common_flags(p: argparse.ArgumentParser) -> None:
+    p.add_argument("-o", dest="outfile", type=str, required=False,
+                   help="Root of the output file names")
+    p.add_argument("-ncpus", type=int, default=1,
+                   help="Accepted for parity; XLA manages parallelism")
+
+
+def load_timeseries(path: str) -> Tuple[np.ndarray, InfoData]:
+    """Load a .dat (+ .inf sidecar) time series."""
+    base = path[:-4] if path.endswith(".dat") else path
+    data = datfft.read_dat(base + ".dat")
+    info = read_inf(base)
+    return data, info
+
+
+def load_spectrum(path: str) -> Tuple[np.ndarray, InfoData]:
+    """Load a packed .fft (+ .inf) as float32 [n,2] pairs."""
+    base = path[:-4] if path.endswith(".fft") else path
+    amps = datfft.read_fft(base + ".fft")
+    info = read_inf(base)
+    pairs = np.stack([amps.real, amps.imag], -1).astype(np.float32)
+    return pairs, info
+
+
+def open_raw(path: str) -> FilterbankFile:
+    if not path.endswith(".fil"):
+        raise SystemExit("raw input must be a SIGPROC .fil file "
+                         "(PSRFITS support: presto_tpu.io.psrfits)")
+    return FilterbankFile(path)
+
+
+def fil_to_inf(fb: FilterbankFile, outbase: str, N: int,
+               dm: float = 0.0, bary: int = 0) -> InfoData:
+    hdr = fb.header
+    return InfoData(
+        name=outbase, telescope="Unknown", instrument="Unknown",
+        object=hdr.source_name or "Unknown",
+        mjd_i=int(hdr.tstart), mjd_f=hdr.tstart % 1.0, bary=bary,
+        N=float(N), dt=hdr.tsamp, band="Radio", dm=dm,
+        freq=hdr.lofreq, freqband=abs(hdr.foff) * hdr.nchans,
+        num_chan=hdr.nchans, chan_wid=abs(hdr.foff),
+        analyzer="presto_tpu")

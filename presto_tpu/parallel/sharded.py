@@ -16,8 +16,6 @@ XLA sees the gather/sum is elementwise in the sharded axis.
 from __future__ import annotations
 
 from functools import partial
-from typing import Optional
-
 import numpy as np
 import jax
 import jax.numpy as jnp
