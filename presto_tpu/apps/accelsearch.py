@@ -24,6 +24,7 @@ from presto_tpu.ops.rednoise import (deredden, read_birds, zap_bins,
 from presto_tpu.search.accel import (AccelConfig, AccelSearch,
                                      eliminate_harmonics,
                                      remove_duplicates)
+from presto_tpu.search.optimize import optimize_accelcand
 
 
 def build_parser():
@@ -119,6 +120,21 @@ def run(args):
     searcher = AccelSearch(cfg, T=T, numbins=numbins)
     raw = searcher.search(pairs)
     cands = remove_duplicates(eliminate_harmonics(raw))
+
+    # Fourier-domain refinement of the surviving candidates
+    # (optimize_accelcand, accel_utils.c:465-525) on host float64.
+    amps = fftpack.np_pairs_to_complex64(pairs)
+    refined = []
+    for c in cands:
+        try:
+            oc = optimize_accelcand(amps, c, T, searcher.numindep)
+            c.r, c.z = oc.r, oc.z
+            c.power, c.sigma = oc.power, oc.sigma
+        except Exception as e:
+            print("accelsearch: refinement failed for r=%.1f (%s); "
+                  "keeping unrefined values" % (c.r, e))
+        refined.append(c)
+    cands = remove_duplicates(refined)
 
     accelnm = "%s_ACCEL_%d" % (base, args.zmax)
     write_accel_file(accelnm, cands, T)

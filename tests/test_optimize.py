@@ -1,0 +1,99 @@
+"""Candidate refinement vs closed-form chirps (the reference validates
+this machinery the same way: synthetic (f, fdot) signals with known
+parameters — tests/test_apps.c:11-17, python/testz.mak)."""
+
+import numpy as np
+import pytest
+
+from presto_tpu.search import optimize as op
+from presto_tpu.search.accel import AccelCand
+
+
+N, T = 1 << 16, 100.0
+EXP_POW = (N / 2) ** 2 / 4.0   # amp=0.5 coherent power (see _chirp)
+
+
+def _chirp_spectrum(r_mid, z, amp=1.0, noise=0.0, seed=0):
+    """Spectrum of a chirp whose MID-observation freq bin is r_mid."""
+    dt = T / N
+    r_start = r_mid - z / 2.0
+    f0, fd = r_start / T, z / T ** 2
+    t = np.arange(N) * dt
+    x = amp * np.cos(2 * np.pi * (f0 * t + 0.5 * fd * t * t))
+    if noise > 0:
+        x = x + np.random.default_rng(seed).normal(0, noise, N)
+    return np.fft.rfft(x)
+
+
+class TestRzInterp:
+    def test_full_power_recovery_at_truth(self):
+        X = _chirp_spectrum(1600.37, 7.3)
+        p = op.power_at_rz(X, 1600.37, 7.3)
+        # ~0.5% short of exact: finite HIGHACC kernel truncation
+        assert p / ((N / 2) ** 2) == pytest.approx(1.0, abs=0.01)
+
+    def test_zero_drift_matches_bin_power(self):
+        X = _chirp_spectrum(1600.0, 0.0)
+        assert (op.power_at_rz(X, 1600.0, 0.0)
+                == pytest.approx(abs(X[1600]) ** 2, rel=5e-2))
+
+    def test_wrong_z_loses_power(self):
+        X = _chirp_spectrum(1600.37, 7.3)
+        assert (op.power_at_rz(X, 1600.37, -7.3)
+                < 0.2 * op.power_at_rz(X, 1600.37, 7.3))
+
+    def test_corr_rz_plane_peak_location(self):
+        X = _chirp_spectrum(1600.5, 4.0)
+        P = op.corr_rz_plane(X, 1598.0, 1603.0, 0.5, -8.0, 8.0, 2.0)
+        iz, ir = np.unravel_index(np.argmax(P), P.shape)
+        assert 1598.0 + ir * 0.5 == pytest.approx(1600.5, abs=0.5)
+        assert -8.0 + iz * 2.0 == pytest.approx(4.0, abs=2.0)
+
+
+class TestMaxRz:
+    def test_refines_to_truth_from_grid_point(self):
+        r0, z0 = 1600.37, 7.3
+        X = _chirp_spectrum(r0, z0, noise=0.5)
+        # start from the nearest search-grid point (dr=0.5, dz=2)
+        r, z, p = op.max_rz_arr(X, round(r0 * 2) / 2, round(z0 / 2) * 2)
+        assert r == pytest.approx(r0, abs=0.02)
+        assert z == pytest.approx(z0, abs=0.2)
+        assert p > 0.9 * (N / 2) ** 2
+
+    def test_harmonic_joint_refinement(self):
+        """Two-harmonic signal: joint fit recovers the fundamental."""
+        r0, z0 = 800.23, 3.7
+        X = _chirp_spectrum(r0, z0, amp=1.0)
+        X = X + _chirp_spectrum(2 * r0, 2 * z0, amp=0.5)
+        r, z, pows = op.max_rz_arr_harmonics(X, round(r0 * 2) / 2,
+                                             round(z0 / 2) * 2, 2)
+        assert r == pytest.approx(r0, abs=0.02)
+        assert z == pytest.approx(z0, abs=0.2)
+        assert pows[0] > 0.9 * (N / 2) ** 2
+        assert pows[1] > 0.8 * (N / 4) ** 2
+
+
+class TestProps:
+    def test_pure_tone_props(self):
+        r0 = 1600.25
+        X = _chirp_spectrum(r0, 0.0, noise=1.0, seed=3)
+        locpow = op.get_localpower(X, r0)
+        d = op.get_derivs(X, r0, 0.0, locpow)
+        props = op.calc_props(d, r0, 0.0)
+        # noise spectrum level for unit-variance noise is N/2... locpow
+        # normalization puts the tone's power near (N/2)^2/(N/2) = N/2
+        assert props.pow == pytest.approx(N / 2, rel=0.5)
+        assert 0.7 < props.pur < 1.3
+        assert 0.0 < props.rerr < 0.1
+        assert 0.0 < props.zerr < 1.0
+
+    def test_optimize_accelcand(self):
+        r0, z0 = 1600.37, 7.3
+        X = _chirp_spectrum(r0, z0, noise=1.0, seed=4)
+        cand = AccelCand(power=0.0, sigma=0.0, numharm=1,
+                         r=round(r0 * 2) / 2, z=round(z0 / 2) * 2)
+        oc = op.optimize_accelcand(X, cand, T, [1e5])
+        assert oc.r == pytest.approx(r0, abs=0.05)
+        assert oc.z == pytest.approx(z0, abs=0.3)
+        assert oc.sigma > 20.0
+        assert len(oc.props) == 1
