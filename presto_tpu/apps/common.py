@@ -62,11 +62,18 @@ def load_spectrum(path: str) -> Tuple[np.ndarray, InfoData]:
     return pairs, info
 
 
-def open_raw(path: str) -> FilterbankFile:
-    if not path.endswith(".fil"):
-        raise SystemExit("raw input must be a SIGPROC .fil file "
-                         "(PSRFITS support: presto_tpu.io.psrfits)")
-    return FilterbankFile(path)
+def open_raw(paths):
+    """Open one path or a list of paths as a single observation."""
+    if isinstance(paths, str):
+        paths = [paths]
+    for path in paths:
+        if not path.endswith(".fil"):
+            raise SystemExit("raw input must be SIGPROC .fil file(s) "
+                             "(PSRFITS support: presto_tpu.io.psrfits)")
+    if len(paths) == 1:
+        return FilterbankFile(paths[0])
+    from presto_tpu.io.sigproc import FilterbankSet
+    return FilterbankSet(paths)
 
 
 def fil_to_inf(fb: FilterbankFile, outbase: str, N: int,

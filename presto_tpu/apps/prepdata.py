@@ -24,6 +24,7 @@ from presto_tpu.io.datfft import write_dat
 from presto_tpu.io.maskfile import read_mask, determine_padvals
 from presto_tpu.ops import dedispersion as dd
 from presto_tpu.ops.clipping import clip_times, remove_zerodm, mask_block
+from presto_tpu.utils.ranges import parse_ranges
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -44,13 +45,15 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("-zerodm", action="store_true")
     p.add_argument("-numout", type=int, default=0,
                    help="Output exactly this many samples (pad/truncate)")
+    p.add_argument("-ignorechan", type=str, default=None,
+                   help="Channels to zero out, e.g. '0:5,34'")
     p.add_argument("rawfiles", nargs="+")
     return p
 
 
 def run(args) -> str:
     ensure_backend()
-    fb = open_raw(args.rawfiles[0])
+    fb = open_raw(args.rawfiles)
     hdr = fb.header
     nchan = hdr.nchans
     dt = hdr.tsamp
@@ -66,6 +69,8 @@ def run(args) -> str:
                 args.mask.replace(".mask", ".stats"))
         except OSError:
             pass
+    ignore = (np.asarray(parse_ranges(args.ignorechan), dtype=np.int64)
+              if args.ignorechan else None)
 
     blocklen = max(1024, 1 << (maxd + 1).bit_length())
     out = []
@@ -84,6 +89,8 @@ def run(args) -> str:
             block, _, clip_state = clip_times(block, args.clip, clip_state)
         if args.zerodm:
             block = remove_zerodm(block, padvals if args.mask else None)
+        if ignore is not None:
+            block[:, ignore] = 0.0
         cur = np.ascontiguousarray(block.T)        # [C, T]
         series = np.asarray(dd.float_dedisp_block(
             jnp.asarray(prev), jnp.asarray(cur), jnp.asarray(bins)))
@@ -98,6 +105,9 @@ def run(args) -> str:
     out.append(series[:blocklen - maxd] if maxd else series)
 
     result = np.concatenate(out)
+    # trim zero-padded tail: only N - maxd samples are fully dedispersed
+    # (the prepsubband `valid` truncation, prepsubband.c:703-735 stats)
+    result = result[:max(int(hdr.N) - maxd, 0)]
     if args.downsamp > 1:
         n = result.size // args.downsamp * args.downsamp
         result = result[:n].reshape(-1, args.downsamp).mean(axis=1)

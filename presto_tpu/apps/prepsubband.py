@@ -2,7 +2,7 @@
 
 CLI parity with the reference prepsubband (clig/prepsubband_cmd.cli;
 src/prepsubband.c:51-): -lodm, -dmstep, -numdms, -nsub, -downsamp, -o,
--mask, -clip, -zerodm, -sub (write subbands).  The two-level subband
+-mask, -clip, -zerodm.  The two-level subband
 delay scheme follows dispersion.c:103-162; the DM fan-out runs as one
 batched device program, sharded over the DM axis when multiple devices
 are present (the mpiprepsubband analog, SURVEY.md §2.5).
@@ -60,7 +60,9 @@ def plan_delays(hdr, args):
 
 def run(args):
     ensure_backend()
-    fb = open_raw(args.rawfiles[0])
+    if args.downsamp < 1:
+        raise SystemExit("prepsubband: -downsamp must be >= 1")
+    fb = open_raw(args.rawfiles)
     hdr = fb.header
     nchan, dt = hdr.nchans, hdr.tsamp
     dms, chan_bins, dm_bins = plan_delays(hdr, args)
@@ -77,6 +79,10 @@ def run(args):
 
     blocklen = max(1024, 1 << (max(int(chan_bins.max()),
                                    int(dm_bins.max())) + 1).bit_length())
+    # the per-block downsampler reshapes [.., blocklen/downsamp,
+    # downsamp]: round blocklen up to a multiple of the factor
+    if blocklen % args.downsamp:
+        blocklen += args.downsamp - blocklen % args.downsamp
     clip_state = None
     chan_bins_d = jnp.asarray(chan_bins)
     dm_bins_d = jnp.asarray(dm_bins)

@@ -14,7 +14,7 @@ import numpy as np
 
 from presto_tpu.apps.common import add_common_flags, open_raw, fil_to_inf, ensure_backend
 from presto_tpu.io.infodata import write_inf
-from presto_tpu.search.rfifind import rfifind, write_rfifind_products
+from presto_tpu.search.rfifind import rfifind_stream, write_rfifind_products
 from presto_tpu.utils.ranges import parse_ranges
 
 
@@ -37,17 +37,26 @@ def build_parser():
 
 def run(args):
     ensure_backend()
-    fb = open_raw(args.rawfiles[0])
+    fb = open_raw(args.rawfiles)
     hdr = fb.header
-    data = fb.read_spectra(0, hdr.N)
     zap_chans = parse_ranges(args.zapchan) if args.zapchan else []
     zap_ints = parse_ranges(args.zapints) if args.zapints else []
-    res = rfifind(data, dt=hdr.tsamp, lofreq=hdr.lofreq,
-                  chanwidth=abs(hdr.foff), time_sec=args.time,
-                  timesigma=args.timesig, freqsigma=args.freqsig,
-                  chantrigfrac=args.chanfrac, inttrigfrac=args.intfrac,
-                  mjd=hdr.tstart, zap_chans=zap_chans,
-                  zap_ints=zap_ints)
+    ptsperint = max(1, int(args.time / hdr.tsamp + 0.5))
+    numint = hdr.N // ptsperint
+
+    def intervals():
+        # stream one interval at a time: never the whole file in RAM
+        for i in range(numint):
+            yield fb.read_spectra(i * ptsperint, ptsperint)
+
+    res = rfifind_stream(intervals(), hdr.nchans, ptsperint,
+                         dt=hdr.tsamp, lofreq=hdr.lofreq,
+                         chanwidth=abs(hdr.foff),
+                         timesigma=args.timesig, freqsigma=args.freqsig,
+                         chantrigfrac=args.chanfrac,
+                         inttrigfrac=args.intfrac,
+                         mjd=hdr.tstart, zap_chans=zap_chans,
+                         zap_ints=zap_ints)
     outbase = args.outfile or "rfifind_out"
     write_rfifind_products(res, outbase)
     info = fil_to_inf(fb, outbase + "_rfifind", hdr.N)

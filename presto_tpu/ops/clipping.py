@@ -100,9 +100,12 @@ def remove_zerodm(block: np.ndarray,
     reference's firsttime fallback, zerodm.c:28-38; pass rfifind
     padvals for the preferred behavior).
     """
-    if bandpass is None:
+    if bandpass is None or bandpass.sum() <= 0:
         bandpass = block.mean(axis=0)
-    wts = bandpass / bandpass.sum()
+    tot = bandpass.sum()
+    if tot <= 0:       # all-zero block (e.g. padding): nothing to remove
+        return block.astype(np.float32)
+    wts = bandpass / tot
     zerodm = block.sum(axis=1, keepdims=True)        # [T, 1]
     return (block - wts[None, :] * zerodm
             + bandpass[None, :]).astype(np.float32)

@@ -101,17 +101,40 @@ def rfifind(data: np.ndarray, dt: float, lofreq: float, chanwidth: float,
     numint = N // ptsperint
     if numint < 1:
         raise ValueError("data shorter than one rfifind interval")
-    trimmed = data[:numint * ptsperint]
-    # [numint, ptsperint, numchan] -> [numint*numchan, ptsperint]
-    cells = np.ascontiguousarray(
-        trimmed.reshape(numint, ptsperint, numchan).transpose(0, 2, 1)
-    ).reshape(numint * numchan, ptsperint).astype(np.float32)
 
-    avg, std, maxpow = (np.asarray(a) for a in
-                        _interval_stats(jnp.asarray(cells), ptsperint))
-    dataavg = avg.reshape(numint, numchan)
-    datastd = std.reshape(numint, numchan)
-    datapow = maxpow.reshape(numint, numchan)
+    def intervals():
+        for i in range(numint):
+            yield data[i * ptsperint:(i + 1) * ptsperint]
+
+    return rfifind_stream(intervals(), numchan, ptsperint, dt, lofreq,
+                          chanwidth, timesigma, freqsigma, chantrigfrac,
+                          inttrigfrac, mjd, zap_chans, zap_ints)
+
+
+def rfifind_stream(intervals, numchan: int, ptsperint: int, dt: float,
+                   lofreq: float, chanwidth: float,
+                   timesigma: float = 10.0, freqsigma: float = 4.0,
+                   chantrigfrac: float = 0.7, inttrigfrac: float = 0.3,
+                   mjd: float = 0.0, zap_chans=(), zap_ints=()
+                   ) -> RfifindResult:
+    """Streaming rfifind: one [ptsperint, numchan] block at a time, so
+    the whole observation is never resident on the host (the reference
+    also reads interval-by-interval via get_channel, rfifind.c:323-403).
+    """
+    avgs, stds, pows = [], [], []
+    for block in intervals:
+        cells = np.ascontiguousarray(
+            block.T).astype(np.float32)          # [numchan, ptsperint]
+        a, s, p = _interval_stats(jnp.asarray(cells), ptsperint)
+        avgs.append(np.asarray(a))
+        stds.append(np.asarray(s))
+        pows.append(np.asarray(p))
+    numint = len(avgs)
+    if numint < 1:
+        raise ValueError("data shorter than one rfifind interval")
+    dataavg = np.stack(avgs)
+    datastd = np.stack(stds)
+    datapow = np.stack(pows)
 
     bytemask = _threshold(dataavg, datastd, datapow, ptsperint,
                           timesigma, freqsigma, chantrigfrac, inttrigfrac,
