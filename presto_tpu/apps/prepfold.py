@@ -1,0 +1,231 @@
+"""prepfold: fold a candidate from raw (.fil) or time-series (.dat)
+data, search (DM, p, pd), and write .pfd + .bestprof.
+
+CLI parity with the reference prepfold (clig/prepfold_cmd.cli;
+src/prepfold.c:26-): -p/-pd/-pdd | -f/-fd/-fdd | -accelcand/-accelfile,
+-dm, -n (proflen), -npart, -nsub, -nosearch/-nopsearch/-nopdsearch/
+-nodmsearch, -mask, -o.  Folding of raw data dedisperses to nsub
+subbands at the fold DM first (prepfold.c:1267-1330), so the DM search
+shifts whole subbands exactly like the reference.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+
+import numpy as np
+import jax.numpy as jnp
+
+from presto_tpu.apps.common import (add_common_flags, open_raw,
+                                    load_timeseries, ensure_backend)
+from presto_tpu.io.maskfile import read_mask, determine_padvals
+from presto_tpu.io.pfd import Pfd, write_pfd, write_bestprof
+from presto_tpu.ops import dedispersion as dd
+from presto_tpu.ops.clipping import clip_times, mask_block
+from presto_tpu.search.prepfold import (FoldConfig, fold_subband_series,
+                                        search_fold, fold_errors)
+
+
+def build_parser():
+    p = argparse.ArgumentParser(prog="prepfold")
+    add_common_flags(p)
+    p.add_argument("-p", type=float, default=0.0, help="Period (s)")
+    p.add_argument("-pd", type=float, default=0.0)
+    p.add_argument("-pdd", type=float, default=0.0)
+    p.add_argument("-f", type=float, default=0.0, help="Frequency (Hz)")
+    p.add_argument("-fd", type=float, default=0.0)
+    p.add_argument("-fdd", type=float, default=0.0)
+    p.add_argument("-accelcand", type=int, default=0)
+    p.add_argument("-accelfile", type=str, default=None)
+    p.add_argument("-dm", type=float, default=0.0)
+    p.add_argument("-n", dest="proflen", type=int, default=0,
+                   help="Profile bins (0 = auto)")
+    p.add_argument("-npart", type=int, default=64)
+    p.add_argument("-nsub", type=int, default=32)
+    p.add_argument("-npfact", type=int, default=1)
+    p.add_argument("-ndmfact", type=int, default=2)
+    p.add_argument("-nosearch", action="store_true")
+    p.add_argument("-nopsearch", action="store_true")
+    p.add_argument("-nopdsearch", action="store_true")
+    p.add_argument("-nodmsearch", action="store_true")
+    p.add_argument("-mask", type=str, default=None)
+    p.add_argument("-clip", type=float, default=6.0)
+    p.add_argument("infile")
+    return p
+
+
+def _fold_params(args, T: float):
+    """Resolve (f, fd, fdd) from flags or an accelsearch .cand file."""
+    if args.accelfile:
+        from presto_tpu.apps.accelsearch import read_cand_file
+        cands = read_cand_file(args.accelfile)
+        idx = max(args.accelcand, 1) - 1
+        if idx >= len(cands):
+            raise SystemExit("accelcand %d not in %s"
+                             % (args.accelcand, args.accelfile))
+        c = cands[idx]
+        return c.r / T, c.z / (T * T), 0.0
+    if args.f > 0:
+        return args.f, args.fd, args.fdd
+    if args.p > 0:
+        from presto_tpu.utils.psr import p_to_f
+        return p_to_f(args.p, args.pd, args.pdd)
+    raise SystemExit("prepfold: give -p, -f, or -accelfile/-accelcand")
+
+
+def _auto_proflen(p_sec: float, dt: float) -> int:
+    """Reference heuristic: ~p/dt bins, a power of two in [16, 256]
+    (prepfold.c proflen selection)."""
+    raw = p_sec / dt
+    n = 16
+    while n < raw / 2 and n < 256:
+        n *= 2
+    return n
+
+
+def fold_dat(args, f, fd, fdd):
+    data, info = load_timeseries(args.infile)
+    dt = info.dt
+    proflen = args.proflen or _auto_proflen(1.0 / f, dt)
+    cfg = FoldConfig(proflen=proflen, npart=args.npart, nsub=1,
+                     npfact=args.npfact, ndmfact=args.ndmfact,
+                     search_p=not (args.nosearch or args.nopsearch),
+                     search_pd=not (args.nosearch or args.nopdsearch),
+                     search_dm=False)
+    res = fold_subband_series(data, dt, f, fd, fdd, cfg,
+                              fold_dm=info.dm, tepoch=info.mjd)
+    res.numchan = 1
+    return res, cfg, info.object or "PSR_CAND"
+
+
+def fold_raw(args, f, fd, fdd):
+    fb = open_raw([args.infile])
+    hdr = fb.header
+    nchan, dt = hdr.nchans, hdr.tsamp
+    nsub = min(args.nsub, nchan)
+    while nchan % nsub:        # need equal channels per subband
+        nsub -= 1
+    if nsub != args.nsub:
+        print("prepfold: adjusted -nsub %d -> %d (must divide %d "
+              "channels)" % (args.nsub, nsub, nchan))
+    # FULL per-channel alignment at the fold DM (not the two-level
+    # subband_search_delays): the folded subbands must be mutually
+    # aligned at fold_dm so the DM search models only the residual
+    # (the reference aligns via dispdt at fold time, prepfold.c:1267)
+    chan_del = dd.dedisp_delays(nchan, args.dm, hdr.lofreq,
+                                abs(hdr.foff))
+    chan_bins = dd.delays_to_bins(chan_del - chan_del.min(), dt)
+    maxd = int(chan_bins.max())
+    blocklen = max(1024, 1 << (maxd + 1).bit_length())
+
+    mask = read_mask(args.mask) if args.mask else None
+    padvals = np.zeros(nchan, dtype=np.float32)
+    if args.mask:
+        try:
+            padvals = determine_padvals(args.mask.replace(".mask",
+                                                          ".stats"))
+        except OSError:
+            pass
+
+    clip_state = None
+    prev = None
+    chunks = []
+    nread = 0
+    while nread < hdr.N + blocklen:
+        if nread < hdr.N:
+            block = fb.read_spectra(nread, blocklen)
+            if mask is not None:
+                n, chans = mask.check_mask(nread * dt, blocklen * dt)
+                if n == -1:
+                    block[:] = padvals[None, :]
+                elif n > 0:
+                    block = mask_block(block, chans, padvals)
+            if args.clip > 0:
+                block, _, clip_state = clip_times(block, args.clip,
+                                                  clip_state)
+        else:
+            block = np.zeros((blocklen, nchan), dtype=np.float32)
+        cur = jnp.asarray(np.ascontiguousarray(block.T))
+        if prev is not None:
+            chunks.append(np.asarray(dd.dedisp_subbands_block(
+                prev, cur, jnp.asarray(chan_bins), nsub)))
+        prev = cur
+        nread += blocklen
+    series = np.concatenate(chunks, axis=1)[:, :int(hdr.N) - maxd]
+
+    proflen = args.proflen or _auto_proflen(1.0 / f, dt)
+    cfg = FoldConfig(proflen=proflen, npart=args.npart, nsub=nsub,
+                     npfact=args.npfact, ndmfact=args.ndmfact,
+                     search_p=not (args.nosearch or args.nopsearch),
+                     search_pd=not (args.nosearch or args.nopdsearch),
+                     search_dm=not (args.nosearch or args.nodmsearch))
+    chanpersub = nchan // nsub
+    subfreqs = (hdr.lofreq + (np.arange(nsub) + 0.5) * chanpersub
+                * abs(hdr.foff) - 0.5 * abs(hdr.foff))
+    res = fold_subband_series(series, dt, f, fd, fdd, cfg,
+                              fold_dm=args.dm, subfreqs=subfreqs,
+                              tepoch=hdr.tstart)
+    res.lofreq = hdr.lofreq
+    res.chan_wid = abs(hdr.foff)
+    res.numchan = nchan
+    fb.close()
+    return res, cfg, hdr.source_name or "PSR_CAND"
+
+
+def run(args):
+    ensure_backend()
+    is_dat = args.infile.endswith(".dat")
+    # need T to turn accelcand (r, z) into (f, fd): read N*dt cheaply
+    if is_dat:
+        from presto_tpu.io.infodata import read_inf
+        info = read_inf(args.infile[:-4])
+        T = info.N * info.dt
+    else:
+        fb0 = open_raw([args.infile])
+        T = fb0.header.N * fb0.header.tsamp
+        fb0.close()
+    f, fd, fdd = _fold_params(args, T)
+
+    if is_dat:
+        res, cfg, candnm = fold_dat(args, f, fd, fdd)
+    else:
+        res, cfg, candnm = fold_raw(args, f, fd, fdd)
+
+    res = search_fold(res, cfg)
+    try:
+        perr, pderr = fold_errors(res)
+    except Exception:
+        perr = pderr = 0.0
+
+    outbase = args.outfile or os.path.splitext(args.infile)[0]
+    pfdnm = outbase + ".pfd"
+    pfd = Pfd(
+        numdms=len(res.dms), numperiods=len(res.periods),
+        numpdots=len(res.pdots), nsub=res.nsub, npart=res.npart,
+        proflen=res.proflen, numchan=res.numchan, pstep=cfg.pstep,
+        pdstep=cfg.pdstep, dmstep=cfg.dmstep, ndmfact=cfg.ndmfact,
+        npfact=cfg.npfact, filenm=args.infile, candnm=candnm,
+        telescope="Unknown", pgdev=pfdnm + ".ps/CPS",
+        dt=res.dt, startT=0.0, endT=1.0, tepoch=res.tepoch,
+        lofreq=res.lofreq, chan_wid=res.chan_wid, bestdm=res.best_dm,
+        topo_p1=res.best_p, topo_p2=res.best_pd,
+        fold_p1=res.fold_f, fold_p2=res.fold_fd, fold_p3=res.fold_fdd,
+        dms=res.dms, periods=res.periods, pdots=res.pdots,
+        profs=res.cube, stats=res.stats)
+    write_pfd(pfdnm, pfd)
+    write_bestprof(pfdnm + ".bestprof", pfd, res.best_prof,
+                   res.best_p, res.best_pd, res.best_redchi,
+                   perr, pderr, datnm=args.infile, candnm=candnm)
+    print("prepfold: folded %s  best p=%.9g s  pd=%.3g  DM=%.3f  "
+          "redchi=%.2f -> %s" % (args.infile, res.best_p, res.best_pd,
+                                 res.best_dm, res.best_redchi, pfdnm))
+    return res
+
+
+def main(argv=None):
+    run(build_parser().parse_args(argv))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,323 @@
+"""prepfold: fold-cube construction + (DM x p x pd) search, TPU-batched.
+
+Reference call stack (SURVEY.md §3.4, src/prepfold.c): fold raw/dat
+data into a (npart x nsub x proflen) double cube, then grid-search
+DM, period and p-dot by rotating and summing profiles, maximizing the
+reduced chi-squared of the summed profile (prepfold.c:1415-1700).
+
+TPU-first: the fold is one scatter-add (ops/fold.py); the searches are
+batched two-tap gather/sum trials evaluated with `lax.map` over trial
+chunks — thousands of (p, pd) trials per device dispatch instead of
+the reference's nested host loops.  The search factorizes exactly like
+the reference's: (1) chi2(DM) with parts summed at the fold period,
+(2) chi2(f, fd) at the best DM — both surfaces are kept for the .pfd
+plot panels.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from functools import partial
+from typing import List, Optional, Tuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from presto_tpu.ops import fold as fo
+from presto_tpu.ops.dedispersion import delay_from_dm
+
+
+# ----------------------------------------------------------------------
+# Batched trial machinery
+# ----------------------------------------------------------------------
+
+_interp_shift_sum = fo.rotate_sum
+
+
+@jax.jit
+def _trial_chi2(profs, trial_shifts, prof_avg, prof_var):
+    """profs [n, L]; trial_shifts [ntrial, n].  For each trial, sum the
+    shifted profiles and return its reduced chi2 [ntrial]."""
+    L = profs.shape[1]
+
+    def one(shift):
+        tot = _interp_shift_sum(profs, shift)
+        dev = tot - prof_avg
+        return (dev * dev).sum() / prof_var / (L - 1)
+
+    return jax.lax.map(one, trial_shifts, batch_size=512)
+
+
+@jax.jit
+def _trial_total(profs, shifts):
+    return _interp_shift_sum(profs, shifts)
+
+
+# ----------------------------------------------------------------------
+# Configuration & results
+# ----------------------------------------------------------------------
+
+@dataclass
+class FoldConfig:
+    """prepfold knobs (clig/prepfold_cmd.cli defaults)."""
+    proflen: int = 64
+    npart: int = 64
+    nsub: int = 32
+    pstep: int = 1          # period-search step, profile bins
+    pdstep: int = 2
+    dmstep: int = 1
+    npfact: int = 1         # search +/- npfact*proflen/2 steps
+    ndmfact: int = 2
+    search_p: bool = True
+    search_pd: bool = True
+    search_dm: bool = True
+
+
+@dataclass
+class FoldResult:
+    cube: np.ndarray                 # [npart, nsub, proflen] float64
+    stats: np.ndarray                # [npart, nsub, 7] foldstats rows
+    fold_f: float
+    fold_fd: float
+    fold_fdd: float
+    fold_dm: float
+    dt: float
+    T: float
+    tepoch: float = 0.0
+    subfreqs: Optional[np.ndarray] = None   # [nsub] MHz centers
+    lofreq: float = 0.0
+    chan_wid: float = 0.0
+    numchan: int = 1
+    data_avg: float = 0.0
+    data_var: float = 1.0
+    # search products
+    dms: np.ndarray = field(default_factory=lambda: np.zeros(1))
+    dm_chi2: np.ndarray = field(default_factory=lambda: np.zeros(1))
+    periods: np.ndarray = field(default_factory=lambda: np.zeros(1))
+    pdots: np.ndarray = field(default_factory=lambda: np.zeros(1))
+    ppd_chi2: np.ndarray = field(default_factory=lambda: np.zeros((1, 1)))
+    best_dm: float = 0.0
+    best_f: float = 0.0
+    best_fd: float = 0.0
+    best_prof: Optional[np.ndarray] = None
+    best_redchi: float = 0.0
+
+    @property
+    def npart(self) -> int:
+        return self.cube.shape[0]
+
+    @property
+    def nsub(self) -> int:
+        return self.cube.shape[1]
+
+    @property
+    def proflen(self) -> int:
+        return self.cube.shape[2]
+
+    @property
+    def best_p(self) -> float:
+        return 1.0 / self.best_f
+
+    @property
+    def best_pd(self) -> float:
+        return -self.best_fd / (self.best_f * self.best_f)
+
+    def part_mid_times(self) -> np.ndarray:
+        numdata = self.stats[:, 0, 0]
+        starts = np.concatenate([[0.0], np.cumsum(numdata)[:-1]])
+        return (starts + 0.5 * numdata) * self.dt
+
+
+# ----------------------------------------------------------------------
+# Folding drivers
+# ----------------------------------------------------------------------
+
+def fold_subband_series(series: np.ndarray, dt: float, f: float,
+                        fd: float = 0.0, fdd: float = 0.0,
+                        cfg: Optional[FoldConfig] = None,
+                        fold_dm: float = 0.0,
+                        subfreqs: Optional[np.ndarray] = None,
+                        tepoch: float = 0.0) -> FoldResult:
+    """Fold [nsub, N] (or [N] -> nsub=1) subband series into the cube.
+
+    The phase model is evaluated once (all subbands share it); each
+    (part, sub) profile's foldstats mirror the reference's per-fold
+    bookkeeping (prepfold.c:1376-1394).
+    """
+    cfg = cfg or FoldConfig()
+    arr = np.atleast_2d(np.asarray(series, dtype=np.float32))
+    nsub, N = arr.shape
+    plan = fo.plan_fold(N, dt, f, fd, fdd, phs0=0.0,
+                        proflen=cfg.proflen, npart=cfg.npart)
+    cube = fo.fold_data(arr, plan)            # [npart, nsub, L]
+    stats = np.zeros((cfg.npart, nsub, 7), dtype=np.float64)
+    for p in range(cfg.npart):
+        nd = plan.parts_numdata[p]
+        lo = int(plan.parts_numdata[:p].sum())
+        seg = arr[:, lo:lo + int(nd)]
+        for s in range(nsub):
+            st = fo.fold_stats(cube[p, s], nd, float(seg[s].mean()),
+                               float(seg[s].var()))
+            stats[p, s] = st.to_array()
+    return FoldResult(cube=cube, stats=stats, fold_f=f, fold_fd=fd,
+                      fold_fdd=fdd, fold_dm=fold_dm, dt=dt, T=N * dt,
+                      tepoch=tepoch, subfreqs=subfreqs,
+                      data_avg=float(arr.mean()),
+                      data_var=float(arr.var()))
+
+
+# ----------------------------------------------------------------------
+# The search
+# ----------------------------------------------------------------------
+
+def dm_per_bin(f: float, proflen: int, lofreq: float,
+               hifreq: float) -> float:
+    """DM change that moves the band-edge differential delay by one
+    profile bin."""
+    dd = delay_from_dm(1.0, lofreq) - delay_from_dm(1.0, hifreq)
+    return 1.0 / (f * proflen * dd)
+
+
+def search_fold(res: FoldResult, cfg: Optional[FoldConfig] = None
+                ) -> FoldResult:
+    """Grid-search (DM, f, fd) around the fold values, maximizing the
+    summed-profile reduced chi2.  Fills the search fields of `res`."""
+    cfg = cfg or FoldConfig(proflen=res.proflen, npart=res.npart,
+                            nsub=res.nsub)
+    L, npart, nsub = res.proflen, res.npart, res.nsub
+    Ntot = float(res.stats[:, 0, 0].sum())
+    # pooled expectations for the FULL summed profile (all parts+subs)
+    prof_avg = res.data_avg * Ntot * nsub / L
+    prof_var = res.data_var * Ntot * nsub / L
+    tmid = res.part_mid_times()
+
+    # ---- stage 1: DM --------------------------------------------------
+    if cfg.search_dm and nsub > 1 and res.subfreqs is not None:
+        numdms = 4 * L * cfg.ndmfact + 1
+        ddm = cfg.dmstep * dm_per_bin(res.fold_f, L,
+                                      res.subfreqs.min(),
+                                      res.subfreqs.max())
+        dms = res.fold_dm + (np.arange(numdms) - numdms // 2) * ddm
+        dms = dms[dms >= 0.0] if res.fold_dm > 0 else dms
+        shifts = np.stack([fo.subband_fold_shifts(
+            res.subfreqs, dm, res.fold_dm, res.fold_f, L)
+            for dm in dms])                        # [numdms, nsub]
+        psum = res.cube.sum(axis=0)                # [nsub, L]
+        chi2 = np.asarray(_trial_chi2(
+            jnp.asarray(psum, jnp.float32),
+            jnp.asarray(shifts, jnp.float32),
+            prof_avg, prof_var))
+        best = int(np.argmax(chi2))
+        res.dms, res.dm_chi2 = dms, chi2
+        res.best_dm = float(dms[best])
+    else:
+        res.dms = np.array([res.fold_dm])
+        res.dm_chi2 = np.zeros(1)
+        res.best_dm = res.fold_dm
+
+    # dedisperse the cube at the best DM -> [npart, L]
+    if nsub > 1 and res.subfreqs is not None:
+        dshift = fo.subband_fold_shifts(res.subfreqs, res.best_dm,
+                                        res.fold_dm, res.fold_f, L)
+        ddprofs = fo.combine_subbands(res.cube, dshift)
+    else:
+        ddprofs = res.cube[:, 0, :]
+
+    # ---- stage 2: (f, fd) --------------------------------------------
+    nf = 2 * L * cfg.npfact + 1 if cfg.search_p else 1
+    nfd = 2 * L * cfg.npfact + 1 if cfg.search_pd else 1
+    df = cfg.pstep / (L * res.T)
+    dfd = cfg.pdstep * 2.0 / (L * res.T * res.T)
+    fs = (np.arange(nf) - nf // 2) * df            # offsets from fold_f
+    fds = (np.arange(nfd) - nfd // 2) * dfd
+    # phase shift of part p for trial (df, dfd):
+    #   dphi(t_p) = df*t_p + dfd*t_p^2/2 (turns) -> bins
+    # A signal offset by (df_s, dfd_s) from the fold values drifts the
+    # pulse by -dphi_s(t); the ALIGNING trial is the negative of the
+    # signal offset, so the reported best model is fold - trial
+    # (pinned empirically in tests/test_fold.py).
+    off = (fs[:, None, None] * tmid[None, None, :]
+           + 0.5 * fds[None, :, None] * tmid[None, None, :] ** 2) * L
+    trial_shifts = off.reshape(nf * nfd, npart)
+    chi2 = np.asarray(_trial_chi2(
+        jnp.asarray(ddprofs, jnp.float32),
+        jnp.asarray(trial_shifts, jnp.float32),
+        prof_avg, prof_var)).reshape(nf, nfd)
+    bi, bj = np.unravel_index(np.argmax(chi2), chi2.shape)
+    res.best_f = res.fold_f - float(fs[bi])
+    res.best_fd = res.fold_fd - float(fds[bj])
+    res.ppd_chi2 = chi2
+    res.periods = 1.0 / (res.fold_f - fs)[::-1] if cfg.search_p \
+        else np.array([1.0 / res.fold_f])
+    with np.errstate(divide="ignore"):
+        res.pdots = np.where(
+            res.fold_f != 0.0,
+            -(res.fold_fd - fds) / (res.fold_f ** 2), 0.0) \
+            if cfg.search_pd else np.array([res.best_pd])
+
+    res.best_prof = np.asarray(_trial_total(
+        jnp.asarray(ddprofs, jnp.float32),
+        jnp.asarray(off[bi, bj], jnp.float32))).astype(np.float64)
+    res.best_redchi = float(fo.profile_redchi(res.best_prof, prof_avg,
+                                              prof_var))
+    return res
+
+
+# ----------------------------------------------------------------------
+# Fold error estimates (fold_errors, fold.c:182 analog)
+# ----------------------------------------------------------------------
+
+def fold_errors(res: FoldResult) -> Tuple[float, float]:
+    """(p_err, pd_err) from the per-part phase-drift fit.
+
+    The reference fits per-part Fourier phase offsets against time with
+    weighted least squares (fold.c:182-…, least_squares.f).  Here: each
+    part profile (dedispersed, best-model-aligned) is cross-correlated
+    with the summed template via the profile FFT's fundamental phase;
+    a quadratic numpy lstsq of phase vs part mid-time gives the
+    covariance of (f, fd), converted to (p, pd).
+    """
+    if res.best_prof is None:
+        raise ValueError("run search_fold first")
+    L = res.proflen
+    if res.nsub > 1 and res.subfreqs is not None:
+        dshift = fo.subband_fold_shifts(res.subfreqs, res.best_dm,
+                                        res.fold_dm, res.fold_f, L)
+        parts = fo.combine_subbands(res.cube, dshift)
+    else:
+        parts = res.cube[:, 0, :]
+    tmid = res.part_mid_times()
+    # align parts to the best model (the aligning left-rotation is the
+    # NEGATIVE of the model offset — see the sign note in search_fold)
+    df = res.best_f - res.fold_f
+    dfd = res.best_fd - res.fold_fd
+    off = -(df * tmid + 0.5 * dfd * tmid ** 2) * L
+    parts = np.stack([fo.shift_prof(parts[i], off[i])
+                      for i in range(len(parts))])
+    tpl = np.fft.rfft(res.best_prof)
+    phases, weights = [], []
+    for prof in parts:
+        F = np.fft.rfft(prof)
+        # fundamental-harmonic phase offset vs template (radians)
+        x = F[1] * np.conj(tpl[1])
+        amp = np.abs(F[1])
+        phases.append(np.angle(x) / (2 * np.pi))   # turns
+        weights.append(max(amp, 1e-12))
+    phases = np.unwrap(np.asarray(phases), period=1.0)
+    w = np.asarray(weights)
+    # weighted quadratic fit: phi(t) = c0 + c1 t + c2 t^2
+    A = np.stack([np.ones_like(tmid), tmid, tmid ** 2], axis=1)
+    Aw = A * w[:, None]
+    coef, *_ = np.linalg.lstsq(Aw, phases * w, rcond=None)
+    resid = phases - A @ coef
+    dof = max(len(tmid) - 3, 1)
+    s2 = float((w * resid ** 2).sum() / w.sum()) * len(tmid) / dof
+    cov = np.linalg.inv(Aw.T @ Aw) * s2 * float(w.mean() ** 2)
+    ferr = np.sqrt(abs(cov[1, 1]))
+    fderr = 2.0 * np.sqrt(abs(cov[2, 2]))
+    f = res.best_f
+    perr = ferr / (f * f)
+    pderr = np.sqrt((fderr / f ** 2) ** 2
+                    + (2 * res.best_fd * ferr / f ** 3) ** 2)
+    return float(perr), float(pderr)

@@ -1,0 +1,149 @@
+"""Folding stack vs closed-form pulse trains (the reference's
+testfold.mak ground-truth strategy, SURVEY.md §4.2-4.3)."""
+
+import numpy as np
+import pytest
+
+from presto_tpu.ops import fold as fo
+from presto_tpu.search.prepfold import (FoldConfig, fold_subband_series,
+                                        search_fold, fold_errors)
+
+
+def _pulsetrain(N, dt, f, fd=0.0, phase0=0.3, width=0.05, amp=1.0,
+                noise=0.0, seed=0):
+    t = np.arange(N) * dt
+    ph = (fo.fold_phase(t, f, fd) + phase0) % 1.0
+    x = amp * np.exp(-0.5 * ((ph - 0.5) / width) ** 2)
+    if noise > 0:
+        x = x + np.random.default_rng(seed).normal(0, noise, N)
+    return x.astype(np.float32)
+
+
+class TestDrizzle:
+    def test_peak_position_and_flux_conservation(self):
+        N, dt, f = 1 << 15, 1e-3, 3.7
+        x = _pulsetrain(N, dt, f, phase0=0.0, width=0.04)
+        prof = fo.simplefold(x, dt, f, proflen=64)
+        assert np.argmax(prof) == pytest.approx(32, abs=1)
+        assert prof.sum() == pytest.approx(x.sum(), rel=1e-5)
+
+    def test_occupancy_uniform(self):
+        N, dt, f = 1 << 15, 1e-3, 3.7
+        ones = fo.simplefold(np.ones(N, np.float32), dt, f, proflen=64)
+        assert ones.min() > 0.99 * N / 64
+        assert ones.max() < 1.01 * N / 64
+
+    def test_subdivision_fast_period(self):
+        """f*dt*proflen > 1: samples span several bins; drizzle must
+        subdivide and stay exact."""
+        N, dt, f = 1 << 14, 1e-3, 80.0   # 5.1 bins/sample at 64 bins
+        plan = fo.plan_fold(N, dt, f, proflen=64)
+        assert plan.subdiv >= 6
+        ones = fo.fold_data(np.ones(N, np.float32), plan)[0]
+        assert ones.sum() == pytest.approx(N, rel=1e-4)
+        assert ones.min() > 0.95 * N / 64
+
+    def test_fdot_tracking(self):
+        """With the right fd the profile stays sharp; ignoring it
+        smears the pulse."""
+        N, dt, f, fd = 1 << 16, 1e-3, 3.7, 3e-4
+        x = _pulsetrain(N, dt, f, fd, width=0.02)
+        good = fo.simplefold(x, dt, f, fd, proflen=64)
+        bad = fo.simplefold(x, dt, f, 0.0, proflen=64)
+        assert good.max() > 2.0 * bad.max()
+
+    def test_chi2_discriminates(self):
+        N, dt, f = 1 << 15, 1e-3, 3.7
+        x = _pulsetrain(N, dt, f, width=0.03, amp=2.0, noise=1.0)
+        on = fo.simplefold(x, dt, f, proflen=64)
+        off = fo.simplefold(x, dt, f * 1.07, proflen=64)
+        avg, var = x.mean() * N / 64, x.var() * N / 64
+        c_on = fo.profile_redchi(on, avg, var)
+        c_off = fo.profile_redchi(off, avg, var)
+        assert c_on > 50.0
+        assert c_on > 10.0 * c_off
+
+
+class TestShiftCombine:
+    def test_shift_prof_direction(self):
+        prof = np.zeros(64)
+        prof[20] = 1.0
+        out = fo.shift_prof(prof, 5.0)
+        assert np.argmax(out) == 15            # left rotation
+        out = fo.shift_prof(prof, -4.5)
+        assert np.argmax(out) in (24, 25)
+
+    def test_combine_profs_realigns(self):
+        rng = np.random.default_rng(1)
+        base = rng.normal(size=64)
+        profs = np.stack([fo.shift_prof(base, -2.5 * i)
+                          for i in range(6)])
+        out = fo.combine_profs(profs, 2.5 * np.arange(6))
+        # realigned sum ~ 6x base (interp loss at fractional shifts)
+        assert np.corrcoef(out, 6 * base)[0, 1] > 0.95
+
+
+class TestPrepfoldSearch:
+    def test_p_search_recovers_offset(self):
+        """Fold with a slightly wrong f; the search must find the true
+        one (up to the classic (p, pd) ridge degeneracy: check the
+        implied phase drift rather than each axis independently)."""
+        N, dt, f = 1 << 16, 1e-3, 3.7
+        x = _pulsetrain(N, dt, f, width=0.03, noise=0.5, seed=2)
+        T = N * dt
+        f_wrong = f + 3.0 / (64 * T)           # 3 bins of drift
+        cfg = FoldConfig(proflen=64, npart=32, nsub=1, search_dm=False)
+        res = fold_subband_series(x, dt, f_wrong, cfg=cfg)
+        res = search_fold(res, cfg)
+        # end-of-observation phase error of the best model vs truth,
+        # in profile bins (ridge-invariant measure)
+        dphi = ((res.best_f - f) * T
+                + 0.5 * res.best_fd * T * T) * 64
+        assert abs(dphi) < 2.0
+        assert res.best_redchi > 30.0
+
+    def test_pd_search_recovers_fdot(self):
+        N, dt, f = 1 << 16, 1e-3, 3.7
+        T = N * dt
+        fd = 8.0 * 2.0 / (64 * T * T)          # 8 pdsteps of curvature
+        x = _pulsetrain(N, dt, f, fd, width=0.03, noise=0.5, seed=3)
+        cfg = FoldConfig(proflen=64, npart=32, nsub=1, search_dm=False)
+        res = fold_subband_series(x, dt, f, cfg=cfg)   # fold at fd=0
+        res = search_fold(res, cfg)
+        dphi = ((res.best_f - f) * T
+                + 0.5 * (res.best_fd - fd) * T * T) * 64
+        assert abs(dphi) < 2.0
+        assert res.best_fd > 0.25 * fd          # curvature direction
+
+    def test_dm_search_recovers_dm(self):
+        """Subband series carrying a residual dispersion sweep (folded
+        at a DM 0.5 units low): the DM search must find the truth.
+        Low band (150 MHz) so one DM grid step << the residual."""
+        from presto_tpu.ops.dedispersion import delay_from_dm
+        N, dt, f, nsub = 1 << 15, 1e-3, 3.7, 16
+        dm_fold, dm_miss = 26.5, 0.5
+        subfreqs = 150.0 + 3.0 * np.arange(nsub)
+        t = np.arange(N) * dt
+        series = np.zeros((nsub, N), np.float32)
+        ref = subfreqs.max()
+        for s in range(nsub):
+            extra = (delay_from_dm(dm_miss, subfreqs[s])
+                     - delay_from_dm(dm_miss, ref))
+            ph = (fo.fold_phase(t - extra, f) + 0.3) % 1.0
+            series[s] = np.exp(-0.5 * ((ph - 0.5) / 0.03) ** 2)
+        cfg = FoldConfig(proflen=64, npart=16, nsub=nsub,
+                         search_p=False, search_pd=False, ndmfact=2)
+        res = fold_subband_series(series, dt, f, cfg=cfg,
+                                  fold_dm=dm_fold,
+                                  subfreqs=subfreqs)
+        res = search_fold(res, cfg)
+        assert res.best_dm == pytest.approx(dm_fold + dm_miss, abs=0.1)
+
+    def test_fold_errors_sane(self):
+        N, dt, f = 1 << 16, 1e-3, 3.7
+        x = _pulsetrain(N, dt, f, width=0.03, noise=0.5, seed=4)
+        cfg = FoldConfig(proflen=64, npart=32, nsub=1, search_dm=False)
+        res = search_fold(fold_subband_series(x, dt, f, cfg=cfg), cfg)
+        perr, pderr = fold_errors(res)
+        assert 0.0 < perr < 1e-3
+        assert 0.0 <= pderr < 1e-5
