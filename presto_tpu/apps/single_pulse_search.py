@@ -1,0 +1,85 @@
+"""single_pulse_search: matched-filter burst search over .dat series.
+
+CLI parity with bin/single_pulse_search.py (options -m/-t/-s/-e/-b/-d/-f);
+reads one or more .dat (+.inf) files — typically the prepsubband DM
+fan-out — and writes a .singlepulse event list per file.  Plotting is a
+separate concern (presto_tpu.plotting); pass .singlepulse files to
+aggregate previous results like the reference's read-only mode.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+import numpy as np
+
+from presto_tpu.apps.common import ensure_backend, load_timeseries
+from presto_tpu.search.singlepulse import (SinglePulseSearch,
+                                           read_singlepulse,
+                                           write_singlepulse)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="single_pulse_search",
+        description="Search dedispersed time series for single pulses")
+    p.add_argument("-m", "--maxwidth", type=float, default=0.0,
+                   help="Max boxcar width in seconds (default: 30 bins)")
+    p.add_argument("-t", "--threshold", type=float, default=5.0)
+    p.add_argument("-s", "--start", type=float, default=0.0,
+                   help="Ignore events before this time (s)")
+    p.add_argument("-e", "--end", type=float, default=1e9,
+                   help="Ignore events after this time (s)")
+    p.add_argument("-b", "--nobadblocks", action="store_true",
+                   help="Disable bad-block detection")
+    p.add_argument("-f", "--fast", action="store_true",
+                   help="Median removal instead of linear detrend")
+    p.add_argument("-d", "--detrendfact", type=int, default=1,
+                   choices=[1, 2, 4, 8, 16, 32],
+                   help="Detrend chunk size in 1000s of samples")
+    p.add_argument("datfiles", nargs="+")
+    return p
+
+
+def run(args) -> list:
+    ensure_backend()
+    allcands = []
+    sp = SinglePulseSearch(threshold=args.threshold,
+                           maxwidth=args.maxwidth,
+                           detrendlen=1000 * args.detrendfact,
+                           fast_detrend=args.fast,
+                           badblocks=not args.nobadblocks)
+    for fn in args.datfiles:
+        if fn.endswith(".singlepulse"):
+            allcands.extend([c for c in read_singlepulse(fn)
+                             if args.start <= c.time <= args.end
+                             and c.sigma >= args.threshold])
+            continue
+        base = fn[:-4] if fn.endswith(".dat") else fn
+        ts, info = load_timeseries(fn)
+        offregions = []
+        if info.numonoff > 1:
+            ons = [int(a) for a, b in info.onoff]
+            offs = [int(b) for a, b in info.onoff]
+            offregions = list(zip(offs[:-1], ons[1:]))
+            if offregions and offregions[-1][1] >= info.N - 1:
+                ts = ts[:offregions[-1][0] + 1]
+        cands, stds, bad = sp.search(
+            np.asarray(ts, np.float32), info.dt, dm=info.dm,
+            offregions=offregions)
+        cands = [c for c in cands if args.start <= c.time <= args.end]
+        write_singlepulse(base + ".singlepulse", cands)
+        print("%s: %d pulse candidates (%d bad blocks)" %
+              (fn, len(cands), len(bad)))
+        allcands.extend(cands)
+    return allcands
+
+
+def main(argv=None):
+    args = build_parser().parse_args(argv)
+    run(args)
+
+
+if __name__ == "__main__":
+    main(sys.argv[1:])

@@ -237,6 +237,8 @@ def rotate_sum(profs, shifts):
 
 
 _combine_shifted = jax.jit(rotate_sum)
+# one dispatch for a whole [npart, nsub, L] cube sharing one shift set
+_combine_shifted_batch = jax.jit(jax.vmap(rotate_sum, in_axes=(0, None)))
 
 
 def combine_profs(profs: np.ndarray, shifts: np.ndarray) -> np.ndarray:
@@ -253,9 +255,9 @@ def combine_subbands(profs: np.ndarray, dm_shifts: np.ndarray
     """Profile-domain dedispersion: profs [npart, nsub, L] summed over
     subbands with per-subband phase-bin rotations
     (dispersion.c:232-287).  Returns [npart, L]."""
-    npart = profs.shape[0]
-    return np.stack([combine_profs(profs[p], dm_shifts)
-                     for p in range(npart)])
+    return np.asarray(_combine_shifted_batch(
+        jnp.asarray(profs, dtype=jnp.float32),
+        jnp.asarray(dm_shifts, dtype=jnp.float32))).astype(np.float64)
 
 
 def subband_fold_shifts(subfreqs: np.ndarray, dm: float, fold_dm: float,

@@ -248,7 +248,9 @@ def search_fold(res: FoldResult, cfg: Optional[FoldConfig] = None
     res.best_f = res.fold_f - float(fs[bi])
     res.best_fd = res.fold_fd - float(fds[bj])
     res.ppd_chi2 = chi2
-    res.periods = 1.0 / (res.fold_f - fs)[::-1] if cfg.search_p \
+    # ascending AND index-matched with ppd_chi2 rows: row i's model
+    # period is 1/(fold_f - fs[i])
+    res.periods = 1.0 / (res.fold_f - fs) if cfg.search_p \
         else np.array([1.0 / res.fold_f])
     with np.errstate(divide="ignore"):
         res.pdots = np.where(

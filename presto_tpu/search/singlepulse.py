@@ -1,0 +1,363 @@
+"""Single-pulse (matched-filter) search, TPU-batched.
+
+Reference algorithm (bin/single_pulse_search.py:252-516): per .dat file,
+linear-detrend 1000-sample blocks, robust per-block stds with a
+4-sigma bad-block cut, normalize to RMS=1, then slide fftlen=8192
+chunks (chunklen=8000 + overlap) over the series convolving each with
+boxcar kernels of widths [1,2,3,4,6,9,14,20,30,...] via rfft
+multiply (make_fftd_kerns / fft_convolve, :29-61), threshold > sigma,
+and greedily prune nearby weaker events (prune_related1/2 :63-117).
+
+TPU-first redesign: the per-chunk, per-width Python loop becomes ONE
+batched device program — [nchunks, fftlen] rfft, broadcast multiply
+against the [nwidths, nf] kernel bank, batched irfft, and a
+lax.top_k per (chunk, width) row so only O(k) candidates ever cross
+the device->host boundary (the reference's flatnonzero pulls the full
+smoothed series to host).  Detrending is a closed-form batched
+least-squares over [nblocks, detrendlen] instead of a per-block
+scipy.signal.detrend loop.  Candidate pruning (tiny lists) stays on
+host, matching the reference's semantics exactly.
+
+Unlike PRESTO's packed-format rfft, numpy/jax rfft keeps the Nyquist
+bin separate, so fft_convolve's real[0]/imag[0] patch
+(single_pulse_search.py:40-42) is unnecessary here.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from functools import partial
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+DEFAULT_DOWNFACTS = (2, 3, 4, 6, 9, 14, 20, 30, 45, 70, 100, 150, 220, 300)
+MAX_DOWNFACT = 30
+
+
+@dataclass(order=True)
+class SPCandidate:
+    """One single-pulse event (sorted by sample bin, like the reference)."""
+    bin: int
+    sigma: float = field(compare=False)
+    time: float = field(compare=False)
+    downfact: int = field(compare=False)
+    dm: float = field(compare=False, default=0.0)
+
+    def __str__(self) -> str:
+        return "%7.2f %7.2f %13.6f %10d     %3d\n" % (
+            self.dm, self.sigma, self.time, self.bin, self.downfact)
+
+
+def boxcar_kernels(downfacts: Sequence[int], fftlen: int) -> np.ndarray:
+    """Circular centered boxcar kernels, RMS-preserving 1/sqrt(w) norm.
+
+    Parity: make_fftd_kerns (bin/single_pulse_search.py:45-61); the
+    tap layout reproduces scipy.signal.convolve centering.  Width 1 is
+    the identity (raw, un-smoothed search path).
+    """
+    kerns = np.zeros((len(downfacts), fftlen), dtype=np.float32)
+    for i, df in enumerate(downfacts):
+        if df == 1:
+            kerns[i, 0] = 1.0
+            continue
+        if df % 2:
+            kerns[i, :df // 2 + 1] = 1.0
+            kerns[i, -(df // 2):] = 1.0
+        else:
+            kerns[i, :df // 2 + 1] = 1.0
+            if df > 2:
+                kerns[i, -(df // 2 - 1):] = 1.0
+        kerns[i] /= np.sqrt(df)
+    return kerns
+
+
+@partial(jax.jit, static_argnames=("detrendlen", "fast"))
+def _detrend_blocks(blocks, detrendlen, fast):
+    """Batched per-block detrend + robust std.
+
+    blocks: [nblocks, detrendlen] float32.
+    fast=False: remove per-block linear least-squares fit (reference's
+    scipy.signal.detrend(type='linear') loop).  fast=True: remove the
+    per-block median only (the -f/--fast path).
+    Robust std: central 95% of the sorted residuals, with the 1.148
+    clipped-Gaussian correction (single_pulse_search.py:380-393).
+    """
+    n = detrendlen
+    if fast:
+        med = jnp.median(blocks, axis=-1, keepdims=True)
+        resid = blocks - med
+    else:
+        t = jnp.arange(n, dtype=jnp.float32)
+        tbar = (n - 1) / 2.0
+        tvar = jnp.sum((t - tbar) ** 2)
+        xbar = blocks.mean(axis=-1, keepdims=True)
+        slope = ((blocks - xbar) @ (t - tbar)) / tvar
+        resid = blocks - xbar - slope[:, None] * (t - tbar)
+    s = jnp.sort(resid, axis=-1)
+    inner = s[:, n // 40: n - n // 40]
+    stds = jnp.sqrt((inner ** 2).sum(axis=-1) / (0.95 * n)) * 1.148
+    return resid, stds
+
+
+def flag_bad_blocks(stds: np.ndarray) -> Tuple[np.ndarray, float, float]:
+    """Identify blocks with outlying stds (dropouts / bursts of RFI).
+
+    Parity: the locut/hicut split-off of the sorted stds and the
+    +/-4 sigma cut (single_pulse_search.py:395-416).  Returns
+    (bad_block_indices, median_stds, std_stds).
+    """
+    nb = len(stds)
+    if nb < 4:
+        return np.empty(0, dtype=np.int64), float(np.median(stds)), 0.0
+    ss = np.sort(stds.astype(np.float64))
+    locut = int(np.argmax(ss[1:nb // 2 + 1] - ss[:nb // 2])) + 1
+    hicut = int(np.argmax(ss[nb // 2 + 1:] - ss[nb // 2:-1])) + nb // 2 - 2
+    if hicut <= locut:
+        locut, hicut = 0, nb
+    std_stds = float(np.std(ss[locut:hicut]))
+    median_stds = float(ss[(locut + hicut) // 2])
+    lo, hi = median_stds - 4.0 * std_stds, median_stds + 4.0 * std_stds
+    bad = np.flatnonzero((stds < lo) | (stds > hi))
+    return bad, median_stds, std_stds
+
+
+@partial(jax.jit, static_argnames=("fftlen", "overlap", "k"))
+def _convolve_topk(chunks, kern_pairs, threshold, fftlen, overlap, k):
+    """Batched boxcar matched filter + per-row candidate extraction.
+
+    chunks: [B, fftlen] normalized data; kern_pairs: [W, nf, 2] float32
+    (re/im pairs — complex never crosses the host<->device boundary,
+    the tunneled-TPU transfer limitation shared with search/accel.py).
+    Returns (vals[B,W,k], idx[B,W,k], counts[B,W]) where (vals, idx)
+    are the top-k smoothed samples of the central chunklen window and
+    counts is the exact number above threshold (overflow detector for
+    the fixed-capacity extraction).
+    """
+    kern_rfft = jax.lax.complex(kern_pairs[..., 0], kern_pairs[..., 1])
+    cf = jnp.fft.rfft(chunks, axis=-1)
+    prod = cf[:, None, :] * kern_rfft[None, :, :]
+    sm = jnp.fft.irfft(prod, n=fftlen, axis=-1)
+    good = sm[..., overlap:fftlen - overlap]
+    vals, idx = jax.lax.top_k(good, k)
+    counts = (good > threshold).sum(axis=-1)
+    return vals, idx, counts
+
+
+def prune_related1(bins: List[int], vals: List[float],
+                   downfact: int) -> Tuple[List[int], List[float]]:
+    """Drop weaker events within downfact/2 bins of a stronger one
+    (same width).  Parity: prune_related1
+    (bin/single_pulse_search.py:63-88)."""
+    toremove = set()
+    for i in range(len(bins) - 1):
+        if i in toremove:
+            continue
+        for j in range(i + 1, len(bins)):
+            if abs(bins[j] - bins[i]) > downfact // 2:
+                break
+            if j in toremove:
+                continue
+            if vals[i] > vals[j]:
+                toremove.add(j)
+            else:
+                toremove.add(i)
+    keepb = [b for i, b in enumerate(bins) if i not in toremove]
+    keepv = [v for i, v in enumerate(vals) if i not in toremove]
+    return keepb, keepv
+
+
+def prune_related2(cands: List[SPCandidate],
+                   downfacts: Sequence[int]) -> List[SPCandidate]:
+    """Cross-width pruning over the merged, bin-sorted candidate list.
+    Parity: prune_related2 (bin/single_pulse_search.py:90-117)."""
+    maxdf = max(downfacts) if downfacts else 1
+    toremove = set()
+    for i in range(len(cands) - 1):
+        if i in toremove:
+            continue
+        x = cands[i]
+        for j in range(i + 1, len(cands)):
+            y = cands[j]
+            if abs(y.bin - x.bin) > maxdf // 2:
+                break
+            if j in toremove:
+                continue
+            prox = max(x.downfact // 2, y.downfact // 2, 1)
+            if abs(y.bin - x.bin) <= prox:
+                if x.sigma > y.sigma:
+                    toremove.add(j)
+                else:
+                    toremove.add(i)
+    return [c for i, c in enumerate(cands) if i not in toremove]
+
+
+def prune_border_cases(cands: List[SPCandidate],
+                       offregions: Sequence[Tuple[int, int]]
+                       ) -> List[SPCandidate]:
+    """Drop events within a half-width of a data/padding boundary.
+    Parity: prune_border_cases (bin/single_pulse_search.py:119-136)."""
+    out = []
+    for c in cands:
+        lo = c.bin - c.downfact // 2
+        hi = c.bin + c.downfact // 2
+        clipped = any(hi > off and lo < on for off, on in offregions)
+        if not clipped:
+            out.append(c)
+    return out
+
+
+@dataclass
+class SinglePulseSearch:
+    """Configured matched-filter search over one normalized series."""
+    threshold: float = 5.0
+    maxwidth: float = 0.0          # seconds; 0 => bin cap MAX_DOWNFACT
+    detrendlen: int = 1000
+    fast_detrend: bool = False
+    badblocks: bool = True
+    chunklen: int = 8000
+    fftlen: int = 8192
+    topk: int = 256
+    batch_chunks: int = 64
+
+    def downfacts_for(self, dt: float) -> List[int]:
+        if self.maxwidth > 0.0:
+            dfs = [x for x in DEFAULT_DOWNFACTS if x * dt <= self.maxwidth]
+        else:
+            dfs = [x for x in DEFAULT_DOWNFACTS if x <= MAX_DOWNFACT]
+        return dfs or [DEFAULT_DOWNFACTS[0]]
+
+    def normalize(self, ts: np.ndarray):
+        """Detrend + normalize; returns (normed series, stds, bad_blocks).
+        Bad blocks are zeroed (they still participate in convolution
+        overlaps, matching single_pulse_search.py:425-430)."""
+        dlen = self.detrendlen
+        roundN = (len(ts) // dlen) * dlen
+        blocks = np.asarray(ts[:roundN], np.float32).reshape(-1, dlen)
+        resid, stds = _detrend_blocks(jnp.asarray(blocks), dlen,
+                                      self.fast_detrend)
+        resid = np.asarray(resid)
+        stds = np.asarray(stds)
+        # Constant (zero-variance) blocks — padding, dropouts — are
+        # always bad: without the guard 0/0 NaNs (or huge roundoff
+        # amplification) would poison every chunk whose convolution
+        # window overlaps them.  Detrend roundoff leaves std ~1e-7
+        # rather than exact 0, so the cut is relative to the median.
+        medstd = float(np.median(stds))
+        zerostd = np.flatnonzero(stds <= 1e-4 * medstd)
+        if self.badblocks:
+            bad, med, _ = flag_bad_blocks(stds)
+            bad = np.union1d(bad, zerostd)
+            stds = stds.copy()
+            stds[bad] = med if med > 0.0 else 1.0
+        else:
+            bad = zerostd
+            stds = np.where(stds <= 0.0, 1.0, stds)
+        normed = resid / stds[:, None]
+        normed[bad] = 0.0
+        return normed.reshape(-1), stds, bad
+
+    def search_normalized(self, normed: np.ndarray, dt: float,
+                          dm: float = 0.0,
+                          downfacts: Optional[Sequence[int]] = None
+                          ) -> List[SPCandidate]:
+        """Run the batched matched filter over an RMS=1 series."""
+        if downfacts is None:
+            downfacts = self.downfacts_for(dt)
+        widths = [1] + list(downfacts)
+        chunklen, fftlen = self.chunklen, self.fftlen
+        if self.detrendlen > chunklen:
+            chunklen = self.detrendlen
+            fftlen = int(2 ** np.ceil(np.log2(chunklen)))
+        overlap = (fftlen - chunklen) // 2
+        N = len(normed)
+        numchunks = max(N // chunklen, 1)
+
+        kf = np.fft.rfft(boxcar_kernels(widths, fftlen))
+        kern_pairs = np.stack([kf.real, kf.imag], -1).astype(np.float32)
+
+        # Assemble overlapped chunks on host (zero-padded ends).
+        padded = np.zeros(overlap + numchunks * chunklen + overlap,
+                          dtype=np.float32)
+        padded[overlap:overlap + min(N, numchunks * chunklen)] = \
+            normed[:numchunks * chunklen]
+        cands: List[SPCandidate] = []
+        # numpy scalar (not a device put): the tunneled-TPU backend
+        # rejects bare out-of-jit scalar conversions.
+        thr = np.float32(self.threshold)
+        for c0 in range(0, numchunks, self.batch_chunks):
+            c1 = min(c0 + self.batch_chunks, numchunks)
+            rows = np.stack([padded[c * chunklen:c * chunklen + fftlen]
+                             for c in range(c0, c1)])
+            vals, idx, counts = _convolve_topk(
+                rows, kern_pairs, thr, fftlen, overlap,
+                min(self.topk, chunklen))
+            vals = np.asarray(vals)
+            idx = np.asarray(idx)
+            counts = np.asarray(counts)
+            for ci in range(c1 - c0):
+                chunknum = c0 + ci
+                for wi, df in enumerate(widths):
+                    nhit = int(counts[ci, wi])
+                    if nhit == 0:
+                        continue
+                    if nhit > vals.shape[-1]:
+                        # Capacity overflow: pathological chunk (heavy
+                        # RFI). Keep the top-k strongest; the bad-block
+                        # cut should normally have zeroed such data.
+                        nhit = vals.shape[-1]
+                    v = vals[ci, wi, :nhit]
+                    b = idx[ci, wi, :nhit] + chunknum * chunklen
+                    order = np.argsort(b)
+                    bl, vl = prune_related1(
+                        [int(x) for x in b[order]],
+                        [float(x) for x in v[order]], df)
+                    for bb, vv in zip(bl, vl):
+                        if bb >= N:
+                            continue
+                        cands.append(SPCandidate(
+                            bin=bb, sigma=vv, time=bb * dt,
+                            downfact=df, dm=dm))
+        cands.sort()
+        cands = prune_related2(cands, widths)
+        return cands
+
+    def search(self, ts: np.ndarray, dt: float, dm: float = 0.0,
+               offregions: Sequence[Tuple[int, int]] = ()
+               ) -> Tuple[List[SPCandidate], np.ndarray, np.ndarray]:
+        """Full pipeline: detrend/normalize -> matched filter -> prune.
+        Returns (candidates, per-block stds, bad block indices)."""
+        normed, stds, bad = self.normalize(ts)
+        cands = self.search_normalized(normed, dt, dm=dm)
+        if len(bad):
+            badset = set(int(b) for b in bad)
+            dlen = self.detrendlen
+            cands = [c for c in cands if (c.bin // dlen) not in badset]
+        if offregions:
+            cands = prune_border_cases(cands, offregions)
+        return cands, stds, bad
+
+
+def write_singlepulse(path: str, cands: Sequence[SPCandidate]) -> None:
+    """Write the .singlepulse ASCII artifact (reference column format)."""
+    with open(path, "w") as f:
+        if cands:
+            f.write("# DM      Sigma      Time (s)     Sample    Downfact\n")
+            for c in cands:
+                f.write(str(c))
+
+
+def read_singlepulse(path: str, dm: float = 0.0) -> List[SPCandidate]:
+    cands = []
+    with open(path) as f:
+        for line in f:
+            if line.startswith("#") or not line.strip():
+                continue
+            parts = line.split()
+            cands.append(SPCandidate(
+                dm=float(parts[0]), sigma=float(parts[1]),
+                time=float(parts[2]), bin=int(parts[3]),
+                downfact=int(parts[4])))
+    return cands
