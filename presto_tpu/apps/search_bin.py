@@ -1,0 +1,80 @@
+"""search_bin: phase-modulation (miniFFT) binary pulsar search CLI.
+
+Flag parity with clig/search_bin_cmd.cli; reads a .fft (+.inf) file,
+writes <base>_bin<harmsum>.cand (binary rawbincand records) and
+<base>_bin<harmsum>.txt (candidate table).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+import numpy as np
+
+from presto_tpu.apps.common import ensure_backend, load_spectrum
+from presto_tpu.search.phasemod import (PhaseModConfig, search_phasemod,
+                                        write_bincands, rawbin_report)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="search_bin",
+        description="Phase-modulation binary search of a long FFT")
+    p.add_argument("-ncand", type=int, default=100)
+    p.add_argument("-minfft", type=int, default=32)
+    p.add_argument("-maxfft", type=int, default=65536)
+    p.add_argument("-flo", type=float, default=None,
+                   help="Lowest freq (Hz) to search")
+    p.add_argument("-fhi", type=float, default=None)
+    p.add_argument("-rlo", type=float, default=1.0)
+    p.add_argument("-rhi", type=float, default=None)
+    p.add_argument("-lobin", type=int, default=0)
+    p.add_argument("-overlap", type=float, default=0.25)
+    p.add_argument("-harmsum", type=int, default=3)
+    p.add_argument("-stack", type=int, default=0)
+    p.add_argument("-interbin", action="store_true")
+    p.add_argument("-noalias", action="store_true")
+    p.add_argument("fftfile")
+    return p
+
+
+def run(args):
+    ensure_backend()
+    if args.stack > 0:
+        # stacked mode: the file holds pre-summed float32 POWERS, not
+        # complex amplitudes (search_bin.c:243-246 read_float_file)
+        from presto_tpu.io.infodata import read_inf
+        base = args.fftfile[:-4] if args.fftfile.endswith(".fft") \
+            else args.fftfile
+        spec = np.fromfile(base + ".fft", dtype=np.float32)
+        info = read_inf(base)
+    else:
+        spec, info = load_spectrum(args.fftfile)
+    N = float(info.N)
+    T = N * info.dt
+    rlo = args.rlo if args.flo is None else np.floor(args.flo * T)
+    rhi = args.rhi if args.fhi is None else np.ceil(args.fhi * T)
+    cfg = PhaseModConfig(ncand=args.ncand, minfft=args.minfft,
+                         maxfft=args.maxfft, rlo=rlo, rhi=rhi,
+                         lobin=args.lobin, overlap=args.overlap,
+                         harmsum=args.harmsum, interbin=args.interbin,
+                         noalias=args.noalias, stack=args.stack)
+    cands = search_phasemod(spec, N, info.dt, cfg)
+    base = args.fftfile[:-4] if args.fftfile.endswith(".fft") \
+        else args.fftfile
+    write_bincands("%s_bin%d.cand" % (base, args.harmsum), cands)
+    with open("%s_bin%d.txt" % (base, args.harmsum), "w") as f:
+        f.write(rawbin_report(cands))
+    print("search_bin: %d candidates -> %s_bin%d.cand" %
+          (len(cands), base, args.harmsum))
+    return cands
+
+
+def main(argv=None):
+    args = build_parser().parse_args(argv)
+    run(args)
+
+
+if __name__ == "__main__":
+    main(sys.argv[1:])
