@@ -62,18 +62,75 @@ def load_spectrum(path: str) -> Tuple[np.ndarray, InfoData]:
     return pairs, info
 
 
+def identify_datatype(path: str) -> str:
+    """Sniff the raw-data format (identify_psrdatatype,
+    backend_common.c:102-143: suffix first, then content)."""
+    if path.endswith((".fits", ".sf", ".fit")):
+        return "psrfits"
+    if path.endswith(".fil"):
+        return "sigproc"
+    with open(path, "rb") as f:
+        magic = f.read(80)
+    if magic.startswith(b"SIMPLE  ="):
+        return "psrfits"
+    return "sigproc"
+
+
 def open_raw(paths):
-    """Open one path or a list of paths as a single observation."""
+    """Open one path or a list of paths as a single observation.
+    Dispatches on format like read_rawdata_files
+    (backend_common.c:77-92)."""
     if isinstance(paths, str):
         paths = [paths]
-    for path in paths:
-        if not path.endswith(".fil"):
-            raise SystemExit("raw input must be SIGPROC .fil file(s) "
-                             "(PSRFITS support: presto_tpu.io.psrfits)")
+    kinds = {identify_datatype(p) for p in paths}
+    if len(kinds) > 1:
+        raise SystemExit("cannot mix raw data formats: %s" % kinds)
+    kind = kinds.pop()
+    if kind == "psrfits":
+        from presto_tpu.io.psrfits import PsrfitsFile
+        return PsrfitsFile(paths)
     if len(paths) == 1:
         return FilterbankFile(paths[0])
     from presto_tpu.io.sigproc import FilterbankSet
     return FilterbankSet(paths)
+
+
+def pad_to_good_N(series: np.ndarray, numout: int = 0):
+    """Pad (with the per-series mean) or truncate the LAST axis to a
+    highly-factorable length.
+
+    numout=0 picks choose_N(valid) like the reference tutorial's
+    `prepsubband -numout $(choose_N ...)` flow.  A smooth length is a
+    hard requirement here, not just a speed nicety: XLA:TPU lowers
+    FFTs with large prime factors to a dense DFT matmul, so an
+    unpadded 2x65441-sample series would allocate an n^2 matrix (68 GB
+    at the tutorial scale).  Returns (padded, valid, numout) where
+    valid is the original length — callers record the (0, valid-1)
+    onoff pair in the .inf so downstream tools know where data ends.
+    """
+    from presto_tpu.utils.psr import choose_N, good_fft_size
+    valid = series.shape[-1]
+    if not numout:
+        numout = choose_N(valid) or good_fft_size(valid, multiple_of=2)
+    if numout > valid:
+        pad_shape = series.shape[:-1] + (numout - valid,)
+        mean = series.mean(axis=-1, keepdims=True)
+        series = np.concatenate(
+            [series, np.broadcast_to(mean.astype(series.dtype),
+                                     pad_shape)], axis=-1)
+    else:
+        series = series[..., :numout]
+        valid = numout
+    return series, valid, numout
+
+
+def set_onoff(info: InfoData, valid: int, numout: int) -> None:
+    """Record the data/padding boundary in the .inf (makeinf.h:38,46
+    onoff semantics) when padding was added."""
+    if numout > valid:
+        info.numonoff = 2
+        info.onoff = [(0.0, float(valid - 1)),
+                      (float(numout - 1), float(numout - 1))]
 
 
 def fil_to_inf(fb: FilterbankFile, outbase: str, N: int,

@@ -1,0 +1,61 @@
+"""DDplan: print the optimal dedispersion plan for an observation.
+
+Parity: bin/DDplan.py CLI (-l/-d lo/hi DM, -f/-b/-n obs params,
+-t dt, -s numsub, -r ok_smearing, or read them from a .fil/.inf).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from presto_tpu.pipeline.ddplan import (Observation, plan_dedispersion)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="DDplan", description="Dedispersion planning")
+    p.add_argument("-l", "--lodm", type=float, default=0.0)
+    p.add_argument("-d", "--hidm", type=float, default=1000.0)
+    p.add_argument("-f", "--fctr", type=float, default=1400.0,
+                   help="Center frequency (MHz)")
+    p.add_argument("-b", "--bw", type=float, default=300.0,
+                   help="Bandwidth (MHz)")
+    p.add_argument("-n", "--numchan", type=int, default=1024)
+    p.add_argument("-t", "--dt", type=float, default=64e-6,
+                   help="Sample time (s)")
+    p.add_argument("-c", "--cdm", type=float, default=0.0,
+                   help="Coherently-removed DM")
+    p.add_argument("-s", "--numsub", type=int, default=0)
+    p.add_argument("-r", "--res", type=float, default=0.0,
+                   help="Acceptable smearing (ms)")
+    p.add_argument("rawfile", nargs="?", default=None,
+                   help="Optional .fil to take obs params from")
+    return p
+
+
+def run(args):
+    if args.rawfile:
+        from presto_tpu.io.sigproc import FilterbankFile
+        with FilterbankFile(args.rawfile) as fb:
+            h = fb.header
+            args.dt = h.tsamp
+            args.numchan = h.nchans
+            bw = abs(h.foff) * h.nchans
+            args.bw = bw
+            args.fctr = h.fch1 + (h.foff * (h.nchans - 1)) / 2.0
+    obs = Observation(dt=args.dt, f_ctr=args.fctr, bw=args.bw,
+                      numchan=args.numchan, cdm=args.cdm)
+    plan = plan_dedispersion(obs, args.lodm, args.hidm,
+                             numsub=args.numsub, ok_smearing=args.res)
+    print(plan)
+    print("Total number of DM trials: %d" % plan.total_numdms)
+    return plan
+
+
+def main(argv=None):
+    run(build_parser().parse_args(argv))
+
+
+if __name__ == "__main__":
+    main(sys.argv[1:])

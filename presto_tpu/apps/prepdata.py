@@ -19,7 +19,9 @@ import sys
 import numpy as np
 import jax.numpy as jnp
 
-from presto_tpu.apps.common import add_common_flags, open_raw, fil_to_inf, ensure_backend
+from presto_tpu.apps.common import (add_common_flags, open_raw,
+                                    fil_to_inf, ensure_backend,
+                                    pad_to_good_N, set_onoff)
 from presto_tpu.io.datfft import write_dat
 from presto_tpu.io.maskfile import read_mask, determine_padvals
 from presto_tpu.ops import dedispersion as dd
@@ -111,16 +113,12 @@ def run(args) -> str:
     if args.downsamp > 1:
         n = result.size // args.downsamp * args.downsamp
         result = result[:n].reshape(-1, args.downsamp).mean(axis=1)
-    if args.numout:
-        if result.size < args.numout:
-            result = np.concatenate(
-                [result, np.full(args.numout - result.size,
-                                 result.mean(), np.float32)])
-        result = result[:args.numout]
+    result, valid, numout = pad_to_good_N(result, args.numout)
 
     outbase = args.outfile or "prepdata_out"
     info = fil_to_inf(fb, outbase, result.size, dm=args.dm, bary=0)
     info.dt = dt * args.downsamp
+    set_onoff(info, valid, numout)
     write_dat(outbase + ".dat", result.astype(np.float32), info)
     fb.close()
     print("Wrote %d samples to %s.dat (DM=%g, downsamp=%d)"

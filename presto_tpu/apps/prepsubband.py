@@ -16,7 +16,9 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from presto_tpu.apps.common import add_common_flags, open_raw, fil_to_inf, ensure_backend
+from presto_tpu.apps.common import (add_common_flags, open_raw,
+                                    fil_to_inf, ensure_backend,
+                                    pad_to_good_N, set_onoff)
 from presto_tpu.io.datfft import write_dat
 from presto_tpu.io.maskfile import read_mask, determine_padvals
 from presto_tpu.ops import dedispersion as dd
@@ -37,6 +39,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("-clip", type=float, default=6.0)
     p.add_argument("-zerodm", action="store_true")
     p.add_argument("-nobary", action="store_true")
+    p.add_argument("-numout", type=int, default=0,
+                   help="Output exactly this many samples per DM "
+                        "(default: pad to a highly-factorable length)")
     p.add_argument("rawfiles", nargs="+")
     return p
 
@@ -125,12 +130,14 @@ def run(args):
     result = np.concatenate(outs, axis=1)     # [numdms, T]
     valid = (int(hdr.N) - maxd) // args.downsamp
     result = result[:, :valid]
+    result, valid, numout = pad_to_good_N(result, args.numout)
 
     outbase = args.outfile or "prepsubband_out"
     for i, dmval in enumerate(dms):
         name = "%s_DM%.2f" % (outbase, dmval)
         info = fil_to_inf(fb, name, result.shape[1], dm=float(dmval))
         info.dt = dt * args.downsamp
+        set_onoff(info, valid, numout)
         write_dat(name + ".dat", result[i], info)
     fb.close()
     print("Wrote %d DMs x %d samples (lodm=%g dmstep=%g nsub=%d)"

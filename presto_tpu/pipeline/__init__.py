@@ -1,0 +1,4 @@
+"""Pipeline orchestration: DDplan, candidate sifting, survey drivers.
+
+The analog of the reference's bin/ scripts layer (SURVEY.md L7).
+"""

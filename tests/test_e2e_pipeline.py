@@ -1,0 +1,110 @@
+"""End-to-end tutorial-pipeline acceptance test.
+
+The analog of the reference's GBT_Lband_PSR_cmd_history.txt acceptance
+run (SURVEY.md §4.6): synthesize a dispersed pulsar filterbank, then
+  rfifind -> DDplan -> prepsubband -> realfft -> accelsearch ->
+  ACCEL_sift -> prepfold
+driven through the real CLI apps, and require the injected pulsar to
+be recovered at the right DM and period with folding chi2 >> 1.
+"""
+
+import glob
+import os
+
+import numpy as np
+import pytest
+
+F0 = 7.8125            # injected pulsar spin frequency (Hz)
+DM = 60.0
+N = 1 << 17
+DT = 5e-4
+NCHAN = 64
+LOFREQ, CHANWID = 1400.0, 1.5
+
+
+@pytest.fixture(scope="module")
+def workdir(tmp_path_factory):
+    d = tmp_path_factory.mktemp("e2e")
+    old = os.getcwd()
+    os.chdir(d)
+    from presto_tpu.models.synth import FakeSignal, fake_filterbank_file
+    # amp is tuned WEAK per channel (per-cell rfifind power ~0.6, so
+    # the mask stays clean) but strong after the 64-channel sum
+    # (fundamental spectral power ~1e3)
+    sig = FakeSignal(f=F0, dm=DM, shape="gauss", width=0.1, amp=0.5)
+    fake_filterbank_file("psr.fil", N, DT, NCHAN, LOFREQ, CHANWID, sig,
+                         noise_sigma=2.0, nbits=8, seed=7)
+    yield d
+    os.chdir(old)
+
+
+def test_stage1_rfifind(workdir):
+    from presto_tpu.apps import rfifind as app
+    app.run(app.build_parser().parse_args(
+        ["-o", "e2e", "-time", "2.0", "psr.fil"]))
+    assert os.path.exists("e2e_rfifind.mask")
+
+
+def test_stage2_prepsubband(workdir):
+    from presto_tpu.apps import prepsubband as app
+    from presto_tpu.pipeline.ddplan import Observation, plan_dedispersion
+    obs = Observation(dt=DT, f_ctr=LOFREQ + CHANWID * (NCHAN - 1) / 2,
+                      bw=CHANWID * NCHAN, numchan=NCHAN)
+    plan = plan_dedispersion(obs, 40.0, 80.0)
+    m = plan.methods[0]
+    # plan sanity, then a manageable fan-out bracketing the true DM
+    assert m.numdms > 0 and m.ddm > 0
+    app.run(app.build_parser().parse_args(
+        ["-o", "e2e", "-lodm", "40.0", "-dmstep", "5.0", "-numdms",
+         "9", "-nsub", "16", "-mask", "e2e_rfifind.mask", "psr.fil"]))
+    dats = sorted(glob.glob("e2e_DM*.dat"))
+    assert len(dats) == 9
+    assert os.path.exists("e2e_DM60.00.dat")
+
+
+def test_stage3_realfft(workdir):
+    from presto_tpu.apps import realfft as app
+    for f in sorted(glob.glob("e2e_DM*.dat")):
+        app.run_one(f, forward=True, delete=False)
+    assert len(glob.glob("e2e_DM*.fft")) == 9
+
+
+def test_stage4_accelsearch(workdir):
+    from presto_tpu.apps import accelsearch as app
+    for f in sorted(glob.glob("e2e_DM*.fft")):
+        app.run(app.build_parser().parse_args(
+            ["-zmax", "0", "-numharm", "8", "-sigma", "3.0", f]))
+    accels = sorted(f for f in glob.glob("e2e_DM*_ACCEL_0")
+                    if not f.endswith(".cand"))
+    assert len(accels) == 9
+
+
+def test_stage5_sift_finds_pulsar(workdir):
+    from presto_tpu.apps import accel_sift as app
+    cl = app.run(app.build_parser().parse_args(
+        ["-g", "e2e_DM*_ACCEL_0", "-o", "e2e_sifted.txt",
+         "--min-dm-hits", "3"]))
+    assert cl is not None and len(cl) >= 1
+    best = cl[0]
+    T = N * DT
+    # recovered frequency within half a Fourier bin of a harmonic of F0
+    fdet = best.r / T
+    harm = fdet / F0
+    assert abs(harm - round(harm)) * F0 * T < 1.0, fdet
+    # strongest hit near the injected DM.  The DM resolution here is
+    # coarse (12.8 ms pulse vs 2.6 ms smearing per 10 DM units over
+    # this 96 MHz band), so the sigma(DM) curve is flat over ~+-10.
+    imax = int(np.argmax([h[2] for h in best.hits]))
+    assert abs(best.hits[imax][0] - DM) <= 15.0
+    assert best.sigma > 6.0
+    assert len(best.hits) >= 5
+
+
+def test_stage6_prepfold_confirms(workdir):
+    from presto_tpu.apps import prepfold as app
+    res = app.run(app.build_parser().parse_args(
+        ["-p", str(1.0 / F0), "-dm", str(DM), "-nosearch", "-npart",
+         "16", "-n", "32", "e2e_DM60.00.dat"]))
+    assert res.best_redchi > 3.0, res.best_redchi
+    assert os.path.exists("e2e_DM60.00.pfd")
+    assert os.path.exists("e2e_DM60.00.pfd.bestprof")

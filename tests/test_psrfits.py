@@ -1,0 +1,168 @@
+"""PSRFITS reader tests over a synthesized degenerate-file corpus
+(SURVEY.md §7.3 hard part 6)."""
+
+import numpy as np
+import pytest
+
+from presto_tpu.io.fitsio import FitsFile, write_fits
+from presto_tpu.io.psrfits import (PsrfitsFile, unpack_samples,
+                                   write_psrfits)
+
+
+def make_data(nspec=1024, nchan=32, seed=0, lo=0, hi=250):
+    rng = np.random.default_rng(seed)
+    return rng.integers(lo, hi, size=(nspec, nchan)).astype(np.float32)
+
+
+FREQS = 1400.0 + 1.5 * np.arange(32)
+
+
+def test_fitsio_roundtrip(tmp_path):
+    p = str(tmp_path / "t.fits")
+    rows = [{"X": np.float64(i), "V": np.arange(4) + i}
+            for i in range(3)]
+    write_fits(p, [("FOO", 42), ("BAR", "hello"), ("PI", 3.5)],
+               [{"extname": "TAB", "cards": [("BAZ", 7)],
+                 "columns": [("X", "1D", "s"), ("V", "4J", "")],
+                 "rows": rows}])
+    with FitsFile(p) as ff:
+        assert ff.primary["FOO"] == 42
+        assert ff.primary["BAR"] == "hello"
+        assert ff.primary["PI"] == 3.5
+        tab = ff.hdu("TAB")
+        assert tab.header["BAZ"] == 7
+        assert tab.naxis2 == 3
+        assert float(tab.read_col("X", 1)[0]) == 1.0
+        np.testing.assert_array_equal(tab.read_col("V", 2),
+                                      np.arange(4) + 2)
+
+
+def test_unpack_samples_all_widths():
+    byte = np.array([0b10110100], np.uint8)
+    np.testing.assert_array_equal(unpack_samples(byte, 1),
+                                  [1, 0, 1, 1, 0, 1, 0, 0])
+    np.testing.assert_array_equal(unpack_samples(byte, 2), [2, 3, 1, 0])
+    np.testing.assert_array_equal(unpack_samples(byte, 4), [0xB, 0x4])
+    np.testing.assert_array_equal(unpack_samples(byte, 8), [0xB4])
+
+
+@pytest.mark.parametrize("nbits", [1, 2, 4, 8, 16, 32])
+def test_psrfits_roundtrip_bitdepths(tmp_path, nbits):
+    hi = min(250, (1 << nbits) if nbits < 16 else 250)
+    data = make_data(hi=max(hi, 2))
+    if nbits < 16:
+        data = np.minimum(data, (1 << nbits) - 1)
+    p = str(tmp_path / ("t%d.fits" % nbits))
+    write_psrfits(p, data, dt=1e-3, freqs=FREQS, nsblk=256, nbits=nbits)
+    with PsrfitsFile(p) as pf:
+        assert pf.nspectra == 1024
+        assert pf.header.nchans == 32
+        got = pf.read_spectra(0, 1024)
+    np.testing.assert_allclose(got, data, atol=0.5)
+
+
+def test_psrfits_scales_offsets_weights(tmp_path):
+    # lo/hi and offsets chosen so (data-offset)/scale stays in [0,255]
+    data = make_data(lo=30, hi=100)
+    scales = np.linspace(0.5, 2.0, 32).astype(np.float32)
+    offsets = np.linspace(0.0, 20.0, 32).astype(np.float32)
+    weights = np.ones(32, np.float32)
+    weights[5] = 0.0            # a zapped channel
+    p = str(tmp_path / "t.fits")
+    write_psrfits(p, data, dt=1e-3, freqs=FREQS, nbits=8,
+                  scales=scales, offsets=offsets, weights=weights,
+                  zero_off=0.0)
+    with PsrfitsFile(p) as pf:
+        assert pf.apply_scale and pf.apply_offset and pf.apply_weight
+        got = pf.read_spectra(0, 1024)
+    want = data.copy()
+    want[:, 5] = 0.0
+    # quantization error scaled by per-channel scale
+    err = np.abs(got - want)
+    assert np.all(err <= 0.5 * scales[None, :] + 1e-4)
+
+
+def test_psrfits_descending_band_flipped(tmp_path):
+    data = make_data()
+    freqs_desc = FREQS[::-1].copy()
+    p = str(tmp_path / "t.fits")
+    write_psrfits(p, data, dt=1e-3, freqs=freqs_desc, nbits=8)
+    with PsrfitsFile(p) as pf:
+        assert pf.df < 0
+        got = pf.read_spectra(0, 1024)
+        hdr = pf.header
+    assert hdr.foff > 0 and hdr.fch1 == FREQS[0]
+    # writer stored channel i at freqs_desc[i]; reader presents
+    # ascending => column j corresponds to freqs_desc reversed
+    np.testing.assert_allclose(got, data[:, ::-1], atol=0.5)
+
+
+def test_psrfits_dropped_rows_padded(tmp_path):
+    data = make_data(nspec=1280)
+    p = str(tmp_path / "t.fits")
+    write_psrfits(p, data, dt=1e-3, freqs=FREQS, nsblk=256,
+                  drop_rows=[2])
+    with PsrfitsFile(p) as pf:
+        # total span still covers all 5 subints
+        assert pf.nspectra == 1280
+        got = pf.read_spectra(0, 1280)
+    # rows 0,1 fine; row 2 (spectra 512:768) padded with padvals (0)
+    np.testing.assert_allclose(got[:512], data[:512], atol=0.5)
+    assert np.all(got[512:768] == 0.0)
+    np.testing.assert_allclose(got[768:], data[768:], atol=0.5)
+
+
+def test_psrfits_multifile_stitch_with_gap(tmp_path):
+    data = make_data(nspec=1024)
+    dt, nsblk = 1e-3, 256
+    p1 = str(tmp_path / "a.fits")
+    p2 = str(tmp_path / "b.fits")
+    mjd0 = 55555.0
+    write_psrfits(p1, data[:512], dt=dt, freqs=FREQS, nsblk=nsblk,
+                  start_mjd=mjd0)
+    # second file starts 768 spectra after obs start: 256-spectra gap
+    mjd1 = mjd0 + (768 * dt) / 86400.0
+    write_psrfits(p2, data[768:], dt=dt, freqs=FREQS, nsblk=nsblk,
+                  start_mjd=mjd1)
+    with PsrfitsFile([p1, p2]) as pf:
+        assert pf.nspectra == 1024
+        got = pf.read_spectra(0, 1024)
+    np.testing.assert_allclose(got[:512], data[:512], atol=0.5)
+    assert np.all(got[512:768] == 0.0)       # the gap -> padvals
+    np.testing.assert_allclose(got[768:], data[768:], atol=0.5)
+
+
+def test_psrfits_polarization_sum(tmp_path):
+    data = make_data(hi=100)
+    p = str(tmp_path / "t.fits")
+    write_psrfits(p, data, dt=1e-3, freqs=FREQS, nbits=8, npol=2)
+    with PsrfitsFile(p) as pf:
+        got = pf.read_spectra(0, 1024)
+    # writer duplicates the data per poln; AA+BB sum = 2x
+    np.testing.assert_allclose(got, 2 * data, atol=1.0)
+
+
+def test_psrfits_through_prepdata_pipeline(tmp_path, monkeypatch):
+    """A dispersed pulse in PSRFITS recovered through the standard app
+    dispatch (open_raw -> prepdata)."""
+    monkeypatch.chdir(tmp_path)
+    from presto_tpu.apps import prepdata
+    from presto_tpu.ops import dedispersion as dd
+    rng = np.random.default_rng(3)
+    nspec, nchan, dt = 1 << 14, 32, 5e-4
+    dm = 100.0
+    data = rng.normal(30.0, 3.0, size=(nspec, nchan)).astype(np.float32)
+    delays = dd.dedisp_delays(nchan, dm, FREQS[0], 1.5)
+    delays = delays - delays.min()
+    t0 = 3.0
+    for c in range(nchan):
+        b = int(round((t0 + float(delays[c])) / dt))
+        if b < nspec:
+            data[b, c] += 40.0
+    write_psrfits("obs.fits", data, dt=dt, freqs=FREQS, nsblk=256,
+                  nbits=8)
+    prepdata.run(prepdata.build_parser().parse_args(
+        ["-o", "out", "-dm", str(dm), "-nobary", "obs.fits"]))
+    ts = np.fromfile("out.dat", np.float32)
+    peak = int(np.argmax(ts))
+    assert abs(peak - int(t0 / dt)) <= 2

@@ -1,0 +1,162 @@
+"""Sifting + DDplan tests."""
+
+import numpy as np
+import pytest
+
+from presto_tpu.pipeline.ddplan import (Observation, bw_smear, dm_smear,
+                                        plan_dedispersion)
+from presto_tpu.pipeline.sifting import (Candidate, Candlist,
+                                         sift_candidates)
+
+
+def mkcand(r=1000.0, sigma=8.0, dm=50.0, numharm=4, T=100.0,
+           candnum=1, ipow=40.0, cpow=15.0, z=0.0, fn=None,
+           harm_pows=None):
+    c = Candidate(candnum=candnum, sigma=sigma, numharm=numharm,
+                  ipow_det=ipow, cpow=cpow, r=r, z=z,
+                  DMstr="%.2f" % dm,
+                  filename=fn or ("fake_DM%.2f_ACCEL_0" % dm), T=T)
+    c.snr = np.sqrt(max(ipow - numharm, 0))
+    c.hits = [(c.DM, c.snr, c.sigma)]
+    if harm_pows is not None:
+        c.harm_pows = np.asarray(harm_pows, float)
+    return c
+
+
+def test_reject_period_range():
+    cl = Candlist([mkcand(r=2.0, T=100.0),       # p = 50 s (too long)
+                   mkcand(r=500000.0, T=100.0),  # p = 0.2 ms (too short)
+                   mkcand(r=1000.0, T=100.0)])   # p = 0.1 s (fine)
+    cl.reject_longperiod()
+    cl.reject_shortperiod()
+    assert len(cl) == 1 and abs(cl[0].p - 0.1) < 1e-9
+    assert len(cl.badcands["longperiod"]) == 1
+    assert len(cl.badcands["shortperiod"]) == 1
+
+
+def test_reject_knownbirds_and_threshold():
+    cl = Candlist([mkcand(r=6000.0, T=100.0),          # 60 Hz birdie
+                   mkcand(r=1000.0, sigma=3.0, numharm=4),
+                   mkcand(r=2000.0, sigma=3.0, numharm=1, cpow=500.0),
+                   mkcand(r=3000.0, sigma=9.0)])
+    cl.reject_knownbirds(known_birds_f=[(60.0, 0.01)])
+    cl.reject_threshold(sigma_threshold=6.0)
+    # the numharm=1 low-sigma cand survives on coherent power
+    assert {round(c.r) for c in cl.cands} == {2000, 3000}
+
+
+def test_reject_rogueharmpow():
+    bad = mkcand(r=1000.0, numharm=8,
+                 harm_pows=[1, 1, 1, 1, 1, 1, 40, 1])
+    good = mkcand(r=2000.0, numharm=8,
+                  harm_pows=[30, 20, 10, 5, 3, 2, 1, 1])
+    cl = Candlist([bad, good])
+    cl.reject_rogueharmpow()
+    assert len(cl) == 1 and cl[0].r == 2000.0
+
+
+def test_remove_duplicates_collects_hits():
+    cands = [mkcand(r=1000.0 + 0.2 * i, sigma=5.0 + i, dm=10.0 * (i + 1),
+                    candnum=i + 1) for i in range(4)]
+    cands.append(mkcand(r=5000.0, sigma=7.0, dm=20.0, candnum=9))
+    cl = Candlist(cands)
+    cl.remove_duplicate_candidates()
+    assert len(cl) == 2
+    best = cl[0]
+    assert best.sigma == 8.0 and len(best.hits) == 4
+    assert {h[0] for h in best.hits} == {10.0, 20.0, 30.0, 40.0}
+
+
+def test_remove_harmonics():
+    fund = mkcand(r=1000.0, sigma=12.0)
+    second = mkcand(r=2000.0, sigma=6.5)       # 2nd harmonic, weaker
+    third = mkcand(r=3000.0, sigma=6.2)
+    ratio32 = mkcand(r=1500.0, sigma=6.1)      # 3/2 ratio
+    unrelated = mkcand(r=1717.0, sigma=7.0)
+    cl = Candlist([fund, second, third, ratio32, unrelated])
+    cl.remove_harmonics()
+    rs = sorted(round(c.r) for c in cl.cands)
+    assert rs == [1000, 1717]
+    assert len(cl.badcands["harmonic"]) == 3
+
+
+def test_remove_DM_problems():
+    few = mkcand(r=1000.0, sigma=9.0, dm=30.0)      # 1 hit only
+    low = mkcand(r=2000.0, sigma=9.0, dm=1.0)
+    low.hits = [(0.0, 3.0, 3.0), (1.0, 9.0, 9.0), (2.0, 5.0, 5.0)]
+    gap = mkcand(r=3000.0, sigma=9.0, dm=30.0)
+    gap.hits = [(10.0, 5.0, 5.0), (30.0, 9.0, 9.0)]   # skips DM=20
+    good = mkcand(r=4000.0, sigma=9.0, dm=20.0)
+    good.hits = [(10.0, 5.0, 5.0), (20.0, 9.0, 9.0), (30.0, 6.0, 6.0)]
+    dmlist = ["0.00", "1.00", "2.00", "10.00", "20.00", "30.00"]
+    cl = Candlist([few, low, gap, good])
+    cl.remove_DM_problems(2, dmlist, low_DM_cutoff=2.0)
+    assert len(cl) == 1 and cl[0].r == 4000.0
+    assert len(cl.badcands["dmproblem"]) == 3
+
+
+def test_sift_end_to_end_with_accel_files(tmp_path, monkeypatch):
+    """Full pipeline: write ACCEL files over 3 DMs via the accelsearch
+    writer, sift, expect the common candidate to survive with 3 hits."""
+    from presto_tpu.apps.accelsearch import write_accel_file
+    from presto_tpu.io.infodata import InfoData, write_inf
+    from presto_tpu.search.accel import AccelCand
+
+    T, N, dt = 1000.0, 1 << 20, 1000.0 / (1 << 20)
+    monkeypatch.chdir(tmp_path)
+    for dm, sig in [(10.0, 7.0), (20.0, 11.0), (30.0, 8.0)]:
+        base = "fake_DM%.2f" % dm
+        info = InfoData(name=base, N=N, dt=dt)
+        write_inf(info, base + ".inf")
+        cands = [AccelCand(power=60.0, sigma=sig, numharm=4,
+                           r=12345.0, z=2.0),
+                 AccelCand(power=20.0, sigma=6.5, numharm=2,
+                           r=777.0 + dm, z=0.0)]  # DM-dependent junk
+        write_accel_file(base + "_ACCEL_200", cands, T)
+    files = sorted(str(p) for p in tmp_path.glob("*_ACCEL_200"))
+    cl = sift_candidates(files, numdms_min=2, low_DM_cutoff=2.0)
+    assert len(cl) >= 1
+    best = cl[0]
+    assert abs(best.r - 12345.0) < 1.2
+    assert len(best.hits) == 3
+    assert best.sigma == 11.0
+    # the DM-dependent junk (one hit each) must be gone
+    assert all(abs(c.r - 777.0) > 100 for c in cl.cands)
+
+
+def test_ddplan_basic_properties():
+    obs = Observation(dt=72e-6, f_ctr=1400.0, bw=300.0, numchan=1024)
+    plan = plan_dedispersion(obs, 0.0, 500.0)
+    assert plan.methods, "no methods in plan"
+    # plan covers the range contiguously
+    assert plan.methods[0].lodm == 0.0
+    for a, b in zip(plan.methods[:-1], plan.methods[1:]):
+        assert abs(a.hidm - b.lodm) < 1e-9
+    assert plan.methods[-1].hidm >= 500.0
+    # dDM and downsamp increase monotonically across methods
+    ddms = [m.ddm for m in plan.methods]
+    dss = [m.downsamp for m in plan.methods]
+    assert ddms == sorted(ddms) and dss == sorted(dss)
+    assert plan.total_numdms == len(plan.dms)
+    # smearing near the floor at low DM: within 3x of ideal
+    m0 = plan.methods[0]
+    assert m0.total_smear(m0.lodm + m0.ddm) < 10.0
+
+
+def test_ddplan_subband_mode():
+    obs = Observation(dt=72e-6, f_ctr=1400.0, bw=300.0, numchan=1024)
+    plan = plan_dedispersion(obs, 0.0, 300.0, numsub=32)
+    for m in plan.methods:
+        assert m.dsub_dm >= m.ddm
+        assert m.numdms == m.numprepsub * m.dms_per_prepsub
+        # subband smearing subdominant by construction
+        from presto_tpu.pipeline.ddplan import subband_smear
+        ss = subband_smear(m.dsub_dm, 32, obs.bw, obs.f_ctr)
+        assert ss <= max(m.bw_smearing, 1000.0 * obs.dt * m.downsamp)
+
+
+def test_ddplan_smearing_formulas():
+    # closed-form check: dm_smear(1, 300, 1400) in ms
+    v = dm_smear(1.0, 300.0, 1400.0)
+    assert abs(v - 1000.0 * 300.0 / (0.0001205 * 1400.0 ** 3)) < 1e-12
+    assert bw_smear(2.0, 300.0, 1400.0) == dm_smear(1.0, 300.0, 1400.0)
