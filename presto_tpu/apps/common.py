@@ -133,11 +133,82 @@ def set_onoff(info: InfoData, valid: int, numout: int) -> None:
                       (float(numout - 1), float(numout - 1))]
 
 
+# sigproc telescope_id -> name (get_telescope_name, sigproc_fb.c:70-140)
+SIGPROC_TELESCOPES = {
+    0: "Fake", 1: "Arecibo", 2: "Ooty", 3: "Nancay", 4: "Parkes",
+    5: "Jodrell", 6: "GBT", 7: "GMRT", 8: "Effelsberg", 9: "ATA",
+    10: "SRT", 11: "LOFAR", 12: "VLA", 64: "MeerKAT", 65: "KAT-7",
+}
+
+
+def sigproc_coord_to_str(coord: float) -> str:
+    """sigproc packed coordinate (hhmmss.s / ddmmss.s float) ->
+    'hh:mm:ss.ssss' string."""
+    sign = "-" if coord < 0 else ""
+    c = abs(float(coord))
+    hh = int(c / 10000.0)
+    mm = int((c - hh * 10000.0) / 100.0)
+    ss = c - hh * 10000.0 - mm * 100.0
+    return "%s%.2d:%.2d:%07.4f" % (sign, hh, mm, ss)
+
+
+def obs_metadata(fb) -> Tuple[str, str, str]:
+    """(telescope name, ra 'hh:mm:ss', dec 'dd:mm:ss') for any reader."""
+    if hasattr(fb, "ra_str"):  # PsrfitsFile carries strings natively
+        return (fb.telescope or "Unknown",
+                fb.ra_str or "00:00:00.0000",
+                fb.dec_str or "00:00:00.0000")
+    hdr = fb.header
+    tel = SIGPROC_TELESCOPES.get(getattr(hdr, "telescope_id", -1),
+                                 "Unknown")
+    return (tel,
+            sigproc_coord_to_str(getattr(hdr, "src_raj", 0.0)),
+            sigproc_coord_to_str(getattr(hdr, "src_dej", 0.0)))
+
+
+def make_bary_plan(fb, dsdt: float, ephem: str = "DE405"):
+    """Build the barycentering plan for an open observation, or return
+    None (with a warning) when the file carries no usable position —
+    silently barycentering RA=DEC=0 junk would corrupt the output while
+    claiming bary=1.
+
+    Shared by prepdata/prepsubband (the duplicated TEMPO-call setup in
+    prepdata.c:408-467 / prepsubband.c:420-505)."""
+    from presto_tpu.astro.observatory import telescope_to_tempocode
+    from presto_tpu.astro.baryshift import BaryPlan
+    from presto_tpu.astro.bary import parse_ra, parse_dec
+    hdr = fb.header
+    tel, ra_str, dec_str = obs_metadata(fb)
+    obscode, _ = telescope_to_tempocode(tel)
+    have_pos = (parse_ra(ra_str) != 0.0 or parse_dec(dec_str) != 0.0)
+    if not have_pos:
+        print("WARNING: no source position in the raw data header -- "
+              "writing topocentric output (bary=0). Use real "
+              "coordinates or -nobary to silence this.")
+        return None
+    if obscode == "EC" and tel.strip().lower() != "geocenter":
+        print("WARNING: unrecognized telescope %r -- barycentering "
+              "from the geocenter (up to ~21 ms Roemer error)." % tel)
+    plan = BaryPlan(hdr.tstart, float(hdr.N) * hdr.tsamp, dsdt,
+                    ra_str, dec_str, obscode, ephem)
+    print("Average topocentric velocity (c) = %.7g" % plan.avgvoverc)
+    return plan
+
+
+def set_bary_epoch(info: InfoData, plan) -> None:
+    """Stamp the barycentric epoch of the first sample into the .inf."""
+    info.bary = 1
+    info.mjd_i = int(plan.blotoa)
+    info.mjd_f = plan.blotoa % 1.0
+
+
 def fil_to_inf(fb: FilterbankFile, outbase: str, N: int,
                dm: float = 0.0, bary: int = 0) -> InfoData:
     hdr = fb.header
+    tel, ra_str, dec_str = obs_metadata(fb)
     return InfoData(
-        name=outbase, telescope="Unknown", instrument="Unknown",
+        name=outbase, telescope=tel, instrument="Unknown",
+        ra_str=ra_str, dec_str=dec_str,
         object=hdr.source_name or "Unknown",
         mjd_i=int(hdr.tstart), mjd_f=hdr.tstart % 1.0, bary=bary,
         N=float(N), dt=hdr.tsamp, band="Radio", dm=dm,

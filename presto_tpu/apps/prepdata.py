@@ -2,9 +2,12 @@
 
 CLI parity with the reference prepdata (clig/prepdata_cmd.cli;
 src/prepdata.c:34-): -o, -dm, -downsamp, -nobary, -mask, -clip,
--zerodm, -ignorechan.  Barycentering requires TEMPO (the reference
-shells out to it, barycenter.c:156); without TEMPO available we write
-topocentric output and mark bary=0 (the -nobary path).
+-zerodm, -ignorechan.  Barycentering is on by default and uses the
+built-in analytic ephemeris (presto_tpu.astro replaces the reference's
+TEMPO subprocess, barycenter.c:156): dispersion delays are computed at
+Doppler-shifted frequencies and single bins are added/removed on the
+diffbins schedule (prepdata.c:469-505) so the output is uniformly
+sampled in barycentric time, epoch = bary MJD of the first sample.
 
 Pipeline (reference read_psrdata, backend_common.c:505-604):
   read block -> [mask] -> [clip] -> [zerodm] -> dedisperse at -dm ->
@@ -21,7 +24,8 @@ import jax.numpy as jnp
 
 from presto_tpu.apps.common import (add_common_flags, open_raw,
                                     fil_to_inf, ensure_backend,
-                                    pad_to_good_N, set_onoff)
+                                    pad_to_good_N, set_onoff,
+                                    make_bary_plan, set_bary_epoch)
 from presto_tpu.io.datfft import write_dat
 from presto_tpu.io.maskfile import read_mask, determine_padvals
 from presto_tpu.ops import dedispersion as dd
@@ -38,8 +42,11 @@ def build_parser() -> argparse.ArgumentParser:
                    help="Dispersion measure (cm-3 pc)")
     p.add_argument("-downsamp", type=int, default=1)
     p.add_argument("-nobary", action="store_true",
-                   help="Do not barycenter (currently always topocentric "
-                        "unless TEMPO is installed)")
+                   help="Do not barycenter the output (default is to "
+                        "barycenter via the built-in ephemeris)")
+    p.add_argument("-ephem", type=str, default="DE405",
+                   help="Ephemeris: DE200/DE405 (analytic model) or a "
+                        "path to a tabulated .npz ephemeris")
     p.add_argument("-mask", type=str, default=None,
                    help="rfifind .mask file to apply")
     p.add_argument("-clip", type=float, default=6.0,
@@ -59,7 +66,12 @@ def run(args) -> str:
     hdr = fb.header
     nchan = hdr.nchans
     dt = hdr.tsamp
-    delays = dd.dedisp_delays(nchan, args.dm, hdr.lofreq, abs(hdr.foff))
+
+    plan = (make_bary_plan(fb, dt * args.downsamp, args.ephem)
+            if not args.nobary else None)
+    avgvoverc = plan.avgvoverc if plan is not None else 0.0
+    delays = dd.dedisp_delays(nchan, args.dm, hdr.lofreq, abs(hdr.foff),
+                              voverc=avgvoverc)
     bins = dd.delays_to_bins(delays - delays.min(), dt)
     maxd = int(bins.max())
 
@@ -113,10 +125,14 @@ def run(args) -> str:
     if args.downsamp > 1:
         n = result.size // args.downsamp * args.downsamp
         result = result[:n].reshape(-1, args.downsamp).mean(axis=1)
+    if plan is not None:
+        result = plan.apply(result)
     result, valid, numout = pad_to_good_N(result, args.numout)
 
     outbase = args.outfile or "prepdata_out"
-    info = fil_to_inf(fb, outbase, result.size, dm=args.dm, bary=0)
+    info = fil_to_inf(fb, outbase, result.size, dm=args.dm)
+    if plan is not None:
+        set_bary_epoch(info, plan)
     info.dt = dt * args.downsamp
     set_onoff(info, valid, numout)
     write_dat(outbase + ".dat", result.astype(np.float32), info)

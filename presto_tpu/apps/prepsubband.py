@@ -18,7 +18,8 @@ import jax.numpy as jnp
 
 from presto_tpu.apps.common import (add_common_flags, open_raw,
                                     fil_to_inf, ensure_backend,
-                                    pad_to_good_N, set_onoff)
+                                    pad_to_good_N, set_onoff,
+                                    make_bary_plan, set_bary_epoch)
 from presto_tpu.io.datfft import write_dat
 from presto_tpu.io.maskfile import read_mask, determine_padvals
 from presto_tpu.ops import dedispersion as dd
@@ -39,6 +40,7 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("-clip", type=float, default=6.0)
     p.add_argument("-zerodm", action="store_true")
     p.add_argument("-nobary", action="store_true")
+    p.add_argument("-ephem", type=str, default="DE405")
     p.add_argument("-numout", type=int, default=0,
                    help="Output exactly this many samples per DM "
                         "(default: pad to a highly-factorable length)")
@@ -46,17 +48,21 @@ def build_parser() -> argparse.ArgumentParser:
     return p
 
 
-def plan_delays(hdr, args):
+def plan_delays(hdr, args, avgvoverc=0.0):
     """Two-level delays: channel->subband at the center DM, then
-    per-DM subband offsets (prepsubband.c:353-372)."""
+    per-DM subband offsets (prepsubband.c:353-372; the barycentric
+    branch computes the same delays at Doppler-shifted frequencies,
+    prepsubband.c:477-498)."""
     nchan, dt = hdr.nchans, hdr.tsamp
     dms = args.lodm + np.arange(args.numdms) * args.dmstep
     center_dm = args.lodm + 0.5 * (args.numdms - 1) * args.dmstep
     chan_del = dd.subband_search_delays(nchan, args.nsub, center_dm,
-                                        hdr.lofreq, abs(hdr.foff))
+                                        hdr.lofreq, abs(hdr.foff),
+                                        voverc=avgvoverc)
     chan_bins = dd.delays_to_bins(chan_del, dt)
     sub_del = np.stack([dd.subband_delays(nchan, args.nsub, dm,
-                                          hdr.lofreq, abs(hdr.foff))
+                                          hdr.lofreq, abs(hdr.foff),
+                                          voverc=avgvoverc)
                         for dm in dms])
     sub_del -= sub_del.min()
     dm_bins = dd.delays_to_bins(sub_del, dt)
@@ -70,7 +76,11 @@ def run(args):
     fb = open_raw(args.rawfiles)
     hdr = fb.header
     nchan, dt = hdr.nchans, hdr.tsamp
-    dms, chan_bins, dm_bins = plan_delays(hdr, args)
+
+    plan = (make_bary_plan(fb, dt * args.downsamp, args.ephem)
+            if not args.nobary else None)
+    avgvoverc = plan.avgvoverc if plan is not None else 0.0
+    dms, chan_bins, dm_bins = plan_delays(hdr, args, avgvoverc)
     maxd = int(chan_bins.max()) + int(dm_bins.max())
 
     mask = read_mask(args.mask) if args.mask else None
@@ -130,12 +140,18 @@ def run(args):
     result = np.concatenate(outs, axis=1)     # [numdms, T]
     valid = (int(hdr.N) - maxd) // args.downsamp
     result = result[:, :valid]
+    if plan is not None and plan.diffbins.size:
+        # same diffbin schedule applies to every DM series
+        result = np.stack([plan.apply(result[i])
+                           for i in range(result.shape[0])])
     result, valid, numout = pad_to_good_N(result, args.numout)
 
     outbase = args.outfile or "prepsubband_out"
     for i, dmval in enumerate(dms):
         name = "%s_DM%.2f" % (outbase, dmval)
         info = fil_to_inf(fb, name, result.shape[1], dm=float(dmval))
+        if plan is not None:
+            set_bary_epoch(info, plan)
         info.dt = dt * args.downsamp
         set_onoff(info, valid, numout)
         write_dat(name + ".dat", result[i], info)

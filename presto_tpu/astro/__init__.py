@@ -1,0 +1,27 @@
+"""Astronomy services layer (reference L4, SURVEY.md §1 L4).
+
+The reference outsources barycentering and polyco generation to the
+external TEMPO Fortran program via subprocess (src/barycenter.c:156,
+src/polycos.c:44) and carries 197 SLALIB Fortran files for positional
+astronomy.  This package replaces all of that with a self-contained,
+vectorized numpy implementation:
+
+  time.py        — UTC/TAI/TT/TDB scales, GMST/GAST
+  ephem.py       — analytic solar-system ephemeris: Earth position and
+                   velocity w.r.t. the solar-system barycenter
+  observatory.py — observatory ITRF coordinates and GCRS posvel
+  bary.py        — barycenter(): topocentric UTC MJDs -> barycentric
+                   TDB MJDs + v/c  (API parity with barycenter.c:87)
+
+Accuracy envelope (documented, by design): the analytic ephemeris is
+built from Keplerian mean elements plus a truncated lunar series, so
+absolute Roemer delays are good to ~50 ms while *differential* delays
+across an observation (what search-mode dedispersion, folding, and
+acceleration searches consume) are good to ~microseconds/hour.  For
+timing-grade work a tabulated JPL ephemeris can be dropped in through
+the same interface (ephem.TabulatedEphemeris).
+"""
+
+from presto_tpu.astro.bary import barycenter  # noqa: F401
+from presto_tpu.astro.time import utc_to_tdb, gmst  # noqa: F401
+from presto_tpu.astro.ephem import earth_posvel_ssb  # noqa: F401

@@ -152,6 +152,14 @@ class PsrfitsFile:
                 path=path, nsubint=nsub, start_subint=start_subint,
                 start_spec=start_spec, start_mjd=start_mjd))
             first = False
+        # Cache every row's absolute start spectrum once (one pass per
+        # file) so read_spectra can binary-search instead of re-reading
+        # OFFS_SUB per row per call (O(nsubint * nblocks) otherwise).
+        self._row_specs = []
+        for fi, m in enumerate(self.meta):
+            self._row_specs.append(np.asarray(
+                [self._row_start_spec_uncached(fi, r)
+                 for r in range(m.nsubint)], dtype=np.int64))
         last = self.meta[-1]
         self.N = last.start_spec + self._last_spec_of(len(self.meta) - 1)
         self.padvals = np.zeros(self.nchan, np.float32)
@@ -210,7 +218,7 @@ class PsrfitsFile:
         self.close()
 
     # -- row geometry -------------------------------------------------
-    def _row_start_spec(self, fi: int, row: int) -> int:
+    def _row_start_spec_uncached(self, fi: int, row: int) -> int:
         """Absolute starting spectrum of (file, row), via OFFS_SUB when
         present (get_PSRFITS_rawblock, psrfits.c:690-705)."""
         m = self.meta[fi]
@@ -221,6 +229,11 @@ class PsrfitsFile:
         offs_sub = float(sub.read_col("OFFS_SUB", row)[0])
         return m.start_spec + int(round(
             (offs_sub - (m.start_subint + 0.5) * tsub) / self.dt))
+
+    def _row_start_spec(self, fi: int, row: int) -> int:
+        if hasattr(self, "_row_specs"):
+            return int(self._row_specs[fi][row])
+        return self._row_start_spec_uncached(fi, row)
 
     # -- decoding -----------------------------------------------------
     def _decode_row(self, fi: int, row: int) -> np.ndarray:
@@ -278,13 +291,16 @@ class PsrfitsFile:
         out[:] = self.padvals[None, :]
         want_lo, want_hi = start, start + count
         for fi, m in enumerate(self.meta):
-            for row in range(m.nsubint):
-                row_lo = self._row_start_spec(fi, row)
+            specs = self._row_specs[fi]
+            # only rows whose window can intersect [want_lo, want_hi)
+            r0 = int(np.searchsorted(specs, want_lo - self.nsblk,
+                                     side="right"))
+            r1 = int(np.searchsorted(specs, want_hi, side="left"))
+            for row in range(r0, r1):
+                row_lo = int(specs[row])
                 row_hi = row_lo + self.nsblk
-                if row_hi <= want_lo:
+                if row_hi <= want_lo or row_lo >= want_hi:
                     continue
-                if row_lo >= want_hi:
-                    break
                 data = self._decode_row(fi, row)
                 lo = max(row_lo, want_lo)
                 hi = min(row_hi, want_hi)

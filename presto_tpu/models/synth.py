@@ -115,7 +115,10 @@ def fake_filterbank_file(path: str, N: int, dt: float, nchan: int,
         q = np.clip(np.round(data * maxv / data.max()), 0, maxv).astype(
             np.uint16 if nbits == 16 else np.uint8)
     hdr = FilterbankHeader(
-        source_name="FAKEPSR", machine_id=10, telescope_id=0,
+        # GBT + a real sky position (the Crab) so the default
+        # barycentering path in the prep tools is exercised end-to-end
+        source_name="FAKEPSR", machine_id=10, telescope_id=6,
+        src_raj=53431.97, src_dej=220052.1,
         fch1=lofreq + (nchan - 1) * chanwidth, foff=-chanwidth,
         nchans=nchan, nbits=nbits, tstart=tstart_mjd, tsamp=dt, nifs=1,
         rawdatafile=path.split("/")[-1])

@@ -145,8 +145,11 @@ def make_method(obs: Observation, downsamp: int, lodm: float,
             dms_per += 2
     else:
         m.dsub_dm = ddm
-    cross = min(m.dm_for_smearfact(smearfact), hidm)
-    m.numdms = int(np.ceil((cross - lodm) / ddm))
+    # The crossover may fall below lodm when channel smearing already
+    # dominates there — clamp so every regime covers at least one step
+    # (otherwise numdms goes negative and the plan is empty).
+    cross = min(max(m.dm_for_smearfact(smearfact), lodm + ddm), hidm)
+    m.numdms = max(int(np.ceil((cross - lodm) / ddm)), 1)
     if numsub:
         m.numprepsub = int(np.ceil(m.numdms * ddm / m.dsub_dm))
         m.numdms = m.numprepsub * m.dms_per_prepsub

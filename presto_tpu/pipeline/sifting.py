@@ -102,10 +102,19 @@ class Candlist:
 
     def __add__(self, other):
         out = Candlist(self.cands + other.cands)
+        out.badcands = {k: list(v) for k, v in self.badcands.items()}
+        for k, v in other.badcands.items():
+            out.badcands.setdefault(k, []).extend(v)
+        out.duplicates = self.duplicates + other.duplicates
         return out
 
     def extend(self, other):
+        # carry rejected/duplicate candidates too, so aggregated lists
+        # keep the full rejection bookkeeping (sifting.py semantics)
         self.cands.extend(other.cands)
+        for k, v in other.badcands.items():
+            self.badcands.setdefault(k, []).extend(v)
+        self.duplicates.extend(other.duplicates)
 
     def sort_by_sigma(self):
         self.cands.sort(key=lambda c: (-c.sigma, -c.ipow_det))

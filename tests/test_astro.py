@@ -1,0 +1,214 @@
+"""Astronomy services (presto_tpu.astro) validation.
+
+Strategy (SURVEY.md §4 implication 1): closed-form/physical bounds and
+internal consistency instead of golden files — the reference's own
+barycentering is untestable here (external TEMPO), so correctness rests
+on physics: orbit geometry, known epochs, and the analytic relation
+d(Roemer)/dt = -voverc that ties the whole sign chain together.
+"""
+
+import numpy as np
+import pytest
+
+from presto_tpu.astro import time as ptime
+from presto_tpu.astro import ephem, bary, observatory as obsmod
+
+MJD_2026 = 61041.0  # 2026-01-01
+
+
+class TestTime:
+    def test_leap_seconds(self):
+        assert ptime.tai_minus_utc(58000.0) == 37.0
+        assert ptime.tai_minus_utc(50000.0) == 29.0
+        assert ptime.tai_minus_utc(41317.0) == 10.0
+
+    def test_tt_offset(self):
+        # TT-UTC = 37 + 32.184 s after 2017
+        tt = ptime.utc_to_tt(60000.0)
+        assert abs((tt - 60000.0) * 86400.0 - 69.184) < 1e-6
+
+    def test_tdb_tt_small(self):
+        # |TDB-TT| < 2 ms always
+        mjds = np.linspace(50000, 62000, 500)
+        assert np.max(np.abs(ptime.tdb_minus_tt(mjds))) < 2e-3
+
+    def test_gmst_j2000(self):
+        # GMST at 2000 Jan 1 12h UT = 18h41m50.548s = 280.4606 deg
+        g = ptime.gmst(51544.5)
+        assert abs(np.rad2deg(g) - 280.46061837) < 1e-6
+
+    def test_gmst_rate(self):
+        # sidereal day = 86164.1 s: GMST advances 2pi in that time
+        g0 = ptime.gmst(60000.0)
+        g1 = ptime.gmst(60000.0 + 86164.0905 / 86400.0)
+        assert abs((g1 - g0) % (2 * np.pi)) < 1e-5 or \
+            abs((g1 - g0) % (2 * np.pi) - 2 * np.pi) < 1e-5
+
+    def test_calendar_roundtrip(self):
+        for mjd in (40000, 51544, 60000, 61041):
+            y, m, d, f = ptime.mjd_to_calendar(mjd)
+            assert ptime.calendar_to_mjd(y, m, d, f) == mjd
+
+    def test_known_date(self):
+        assert ptime.calendar_to_mjd(2000, 1, 1) == 51544
+        assert ptime.calendar_to_mjd(2026, 1, 1) == MJD_2026
+
+
+class TestEphemeris:
+    def test_earth_sun_distance_range(self):
+        # heliocentric distance over one year: [0.98329, 1.01671] AU
+        T = np.linspace(0.25, 0.26, 400)  # ~2025
+        emb = ephem.planet_helio_ecl(T * 100 / 100, "emb")
+        # use a full year sampled densely
+        T = np.linspace(0.25, 0.2601, 600)
+        emb = ephem.planet_helio_ecl(T, "emb")
+        r = np.linalg.norm(emb, axis=-1)
+        assert abs(r.min() - 0.98329) < 7e-4
+        assert abs(r.max() - 1.01671) < 7e-4
+
+    def test_perihelion_epoch(self):
+        # Earth perihelion falls in the first days of January.
+        mjds = MJD_2026 + np.arange(0.0, 366.0, 0.25)
+        T = (mjds - ptime.MJD_J2000) / 36525.0
+        r = np.linalg.norm(ephem.planet_helio_ecl(T, "emb"), axis=-1)
+        peri_day = mjds[np.argmin(r)] - MJD_2026  # days after Jan 1
+        assert -1 <= peri_day <= 8
+
+    def test_earth_speed(self):
+        jd = 2451545.0 + np.arange(0, 366, 1.0)
+        _, vel = ephem.earth_posvel_ssb(jd)
+        speed = np.linalg.norm(vel, axis=-1) * ephem.AU_M / 86400 / 1e3
+        assert speed.min() > 29.1 and speed.max() < 30.5  # km/s
+
+    def test_march_equinox(self):
+        # Sun's ecliptic longitude *of date* crosses 0 near the known
+        # 2026 March equinox (Mar 20 ~14:46 UTC = MJD 61119.6).  The
+        # elements are fixed-J2000-equinox, so precess the longitude
+        # forward by 1.397 deg/century before finding the crossing.
+        mjds = np.arange(MJD_2026 + 70, MJD_2026 + 90, 0.02)
+        T = (mjds - ptime.MJD_J2000) / 36525.0
+        earth = ephem._earth_pos_ecl(T) + ephem.ssb_offset_ecl(T)
+        lon = np.rad2deg(np.arctan2(-earth[:, 1], -earth[:, 0]))
+        lon_date = lon + 1.3969713 * T
+        equinox_mjd = mjds[np.argmin(np.abs(lon_date))]
+        assert abs(equinox_mjd - 61119.6) < 0.1
+
+    def test_ssb_offset_magnitude(self):
+        # Sun-SSB distance stays within ~2.2 solar radii (0.0102 AU)
+        T = np.linspace(-0.5, 0.5, 200)
+        off = np.linalg.norm(ephem.ssb_offset_ecl(T), axis=-1)
+        assert off.max() < 0.0125 and off.max() > 0.004
+
+    def test_moon_distance(self):
+        T = np.linspace(0.25, 0.253, 500)  # ~1 month span
+        _, _, dist = ephem.moon_geo_ecl_date(T)
+        assert dist.min() > 354000 and dist.max() < 407500
+        assert dist.max() - dist.min() > 20000  # sees the ellipticity
+
+    def test_tabulated_ephemeris_roundtrip(self, tmp_path):
+        # A table sampled from the analytic model must reproduce it.
+        jd = 2461041.5 + np.arange(-5.0, 5.0, 0.25)
+        pos, vel = ephem.earth_posvel_ssb(jd)
+        sun = ephem.AnalyticEphemeris().sun_pos(jd)
+        path = str(tmp_path / "tab.npz")
+        np.savez(path, jd_tdb=jd, earth_pos=pos, earth_vel=vel, sun_pos=sun)
+        tab = ephem.TabulatedEphemeris(path)
+        q = 2461041.5 + np.array([0.1, 1.37, 3.9])
+        p2, v2 = tab.earth_posvel(q)
+        p1, v1 = ephem.earth_posvel_ssb(q)
+        assert np.max(np.abs(p2 - p1)) < 1e-9       # AU
+        assert np.max(np.abs(v2 - v1)) < 1e-7       # AU/day
+
+
+class TestObservatory:
+    def test_itrf_radius(self):
+        for code in ("GB", "PK", "FA", "MK"):
+            r = np.linalg.norm(obsmod.OBSERVATORIES[code][1])
+            assert 6.33e6 < r < 6.39e6
+
+    def test_geodetic_roundtrip_equator(self):
+        xyz = obsmod.geodetic_to_itrf(0.0, 0.0, 0.0)
+        assert abs(xyz[0] - obsmod.WGS84_A) < 1e-6
+        assert abs(xyz[1]) < 1e-6 and abs(xyz[2]) < 1e-6
+
+    def test_site_velocity(self):
+        # GBT (lat 38.43): spin speed = omega * R * cos(lat) ~ 364 m/s
+        pos, vel = obsmod.obs_posvel_gcrs(np.array([60000.0]), "GB")
+        speed = np.linalg.norm(vel)
+        assert 340 < speed < 380
+        # velocity perpendicular to position's z-projection
+        assert abs(vel[0] @ pos[0]) / np.linalg.norm(pos) < 1.0
+
+    def test_telescope_codes(self):
+        assert obsmod.telescope_to_tempocode("GBT") == ("GB", "GBT")
+        assert obsmod.telescope_to_tempocode("parkes")[0] == "PK"
+        assert obsmod.telescope_to_tempocode("nosuchscope")[0] == "EC"
+
+
+class TestBarycenter:
+    RA, DEC = "05:34:31.97", "22:00:52.1"  # Crab: ecliptic lat -1.3 deg
+
+    def test_roemer_amplitude(self):
+        # Over a year the infinite-freq delay for a low-ecliptic-lat
+        # source swings close to +-499 s.
+        topo = MJD_2026 + np.arange(0.0, 366.0, 2.0)
+        b, v = bary.barycenter(topo, self.RA, self.DEC, "EC")
+        delay = (b - ptime.utc_to_tdb(topo)) * 86400.0
+        # amplitude ~ (Earth-SSB distance) * 499s * cos(beta): up to
+        # ~1.017 AU * 499 s at aphelion for beta ~ -1.3 deg
+        assert np.max(np.abs(delay)) < 512.0
+        assert np.max(np.abs(delay)) > 480.0
+
+    def test_ecliptic_pole_small_roemer(self):
+        # Ecliptic north pole: RA 18h, Dec +66.56 — orbital Roemer ~ 0.
+        topo = MJD_2026 + np.arange(0.0, 366.0, 2.0)
+        b, v = bary.barycenter(topo, "18:00:00", "66:33:39", "EC")
+        delay = (b - ptime.utc_to_tdb(topo)) * 86400.0
+        assert np.max(np.abs(delay)) < 8.0  # SSB offset + eccentricity
+
+    def test_voverc_amplitude(self):
+        topo = MJD_2026 + np.arange(0.0, 366.0, 1.0)
+        _, v = bary.barycenter(topo, self.RA, self.DEC, "GB")
+        assert np.max(np.abs(v)) < 1.05e-4
+        assert np.max(np.abs(v)) > 0.9e-4
+
+    def test_sign_consistency(self):
+        # d(bary - topo)/dt must equal -voverc (the radial velocity
+        # convention of barycenter.c:232-234).
+        topo = 60000.0 + np.arange(0.0, 2.0, 0.01)
+        b, v = bary.barycenter(topo, self.RA, self.DEC, "GB")
+        delay = (b - topo) * 86400.0
+        ddt = np.gradient(delay, topo * 86400.0)
+        # remove the constant TT-UTC offset effect: gradient already does
+        mid = slice(5, -5)
+        assert np.max(np.abs(ddt[mid] + v[mid])) < 3e-7
+
+    def test_diurnal_term(self):
+        # Site vs geocenter differ by <= earth-radius light time 21.3ms
+        topo = 60000.0 + np.arange(0.0, 1.0, 1.0 / 288)
+        bg, _ = bary.barycenter(topo, self.RA, self.DEC, "GB")
+        be, _ = bary.barycenter(topo, self.RA, self.DEC, "EC")
+        diff = (bg - be) * 86400.0
+        assert np.max(np.abs(diff)) < 0.0214
+        assert np.max(np.abs(diff)) > 0.005  # GBT sees the source
+
+    def test_monotonic(self):
+        topo = 60000.0 + np.arange(0.0, 30.0, 0.1)
+        b, _ = bary.barycenter(topo, self.RA, self.DEC, "GB")
+        assert np.all(np.diff(b) > 0)
+
+    def test_scalar_api(self):
+        b, v = bary.barycenter(60000.0, self.RA, self.DEC, "GB")
+        assert isinstance(b, float) and isinstance(v, float)
+
+    def test_parse_radec(self):
+        assert abs(bary.parse_ra("12:00:00") - np.pi) < 1e-12
+        assert abs(bary.parse_dec("-90:00:00") + np.pi / 2) < 1e-12
+        assert abs(bary.parse_dec("+45:30:00") -
+                   np.deg2rad(45.5)) < 1e-12
+
+    def test_average_voverc(self):
+        avg, vmax, vmin = bary.average_voverc(60000.0, 3600.0,
+                                              self.RA, self.DEC, "GB")
+        assert vmin <= avg <= vmax
+        assert abs(avg) < 1.05e-4

@@ -1,0 +1,106 @@
+"""Barycentric resampling (astro.baryshift) + prepdata -nobary parity."""
+
+import os
+
+import numpy as np
+import pytest
+
+from presto_tpu.astro import baryshift
+from presto_tpu.astro.baryshift import (apply_diffbins, diffbin_schedule,
+                                        BaryPlan)
+
+
+class TestDiffbinSchedule:
+    def test_linear_positive_drift(self):
+        # drift grows linearly to +5 bins over the grid -> 5 additions
+        dsdt = 1e-3
+        ttoa = 50000.0 + np.arange(200) * baryshift.TDT / 86400.0
+        drift_bins = np.linspace(0.0, 5.4, 200)
+        btoa = ttoa + drift_bins * dsdt / 86400.0
+        sched = diffbin_schedule(ttoa, btoa, dsdt)
+        assert (sched > 0).all()
+        assert len(sched) == 5
+        # crossings roughly uniformly spaced in output bins
+        assert np.all(np.diff(sched) > 0)
+
+    def test_linear_negative_drift(self):
+        dsdt = 1e-3
+        ttoa = 50000.0 + np.arange(200) * baryshift.TDT / 86400.0
+        drift_bins = np.linspace(0.0, -3.4, 200)
+        btoa = ttoa + drift_bins * dsdt / 86400.0
+        sched = diffbin_schedule(ttoa, btoa, dsdt)
+        assert (sched < 0).all()
+        assert len(sched) == 3
+
+    def test_no_drift(self):
+        ttoa = 50000.0 + np.arange(50) * baryshift.TDT / 86400.0
+        sched = diffbin_schedule(ttoa, ttoa.copy(), 1e-3)
+        assert sched.size == 0
+
+
+class TestApplyDiffbins:
+    def test_insertions_lengthen(self):
+        x = np.arange(1000, dtype=np.float32)
+        out = apply_diffbins(x, np.array([100, 500, 900]))
+        assert out.size == 1003
+        # first stretch is untouched
+        assert np.array_equal(out[:100], x[:100])
+        # the inserted bin is a local average, i.e. finite & nearby
+        assert abs(out[100] - 100.0) < 500.0
+
+    def test_removals_shorten(self):
+        x = np.arange(1000, dtype=np.float32)
+        out = apply_diffbins(x, np.array([-100, -500]))
+        assert out.size == 998
+        assert np.array_equal(out[:100], x[:100])
+        # bin 100 dropped: output[100] is input[101]
+        assert out[100] == x[101]
+
+    def test_empty_schedule(self):
+        x = np.arange(10, dtype=np.float32)
+        assert np.array_equal(apply_diffbins(x, np.array([], np.int64)), x)
+
+
+class TestBaryPlan:
+    def test_plan_on_real_source(self):
+        plan = BaryPlan(60000.0, 600.0, 1e-3, "05:34:31.97",
+                        "22:00:52.1", "GB")
+        assert abs(plan.avgvoverc) < 1.1e-4
+        assert plan.minvoverc <= plan.avgvoverc <= plan.maxvoverc
+        # bary epoch differs from topo start by |Roemer| <= ~510 s
+        assert abs(plan.blotoa - 60000.0) * 86400.0 < 510.0
+        # grid spans 1.1*600s + ~115s margin: drift <= |v/c|*775s/1ms
+        assert len(plan.diffbins) <= 85
+        series = np.random.default_rng(0).normal(
+            size=600_000).astype(np.float32)
+        out = plan.apply(series)
+        # schedule entries beyond the series end are skipped
+        n_inside = int(np.sum(np.abs(plan.diffbins) < series.size))
+        assert abs(out.size - series.size) <= len(plan.diffbins)
+        assert abs(out.size - series.size) >= n_inside - 1
+
+
+class TestPrepdataBary:
+    def test_bary_flag_and_epoch(self, tmp_path):
+        from presto_tpu.models.synth import fake_filterbank_file, FakeSignal
+        from presto_tpu.apps import prepdata
+        from presto_tpu.io.infodata import read_inf
+        path = str(tmp_path / "fake.fil")
+        sig = FakeSignal(f=10.0, dm=30.0, shape="gauss", width=0.1,
+                         amp=1.0)
+        fake_filterbank_file(path, N=1 << 14, dt=1e-3, nchan=16,
+                             lofreq=1400.0, chanwidth=2.0, signal=sig,
+                             noise_sigma=1.0, nbits=8)
+        topo = str(tmp_path / "topo")
+        bary = str(tmp_path / "bary")
+        prepdata.run(prepdata.build_parser().parse_args(
+            ["-dm", "30.0", "-nobary", "-o", topo, path]))
+        prepdata.run(prepdata.build_parser().parse_args(
+            ["-dm", "30.0", "-o", bary, path]))
+        it = read_inf(topo)
+        ib = read_inf(bary)
+        assert it.bary == 0 and ib.bary == 1
+        # epochs differ by a plausible Roemer delay
+        dt_days = abs((ib.mjd_i + ib.mjd_f) - (it.mjd_i + it.mjd_f))
+        assert dt_days * 86400.0 < 510.0
+        assert dt_days > 0.0
