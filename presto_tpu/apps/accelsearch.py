@@ -19,7 +19,7 @@ import jax.numpy as jnp
 
 from presto_tpu.apps.common import load_spectrum, load_timeseries, ensure_backend
 from presto_tpu.ops import fftpack
-from presto_tpu.ops.rednoise import (deredden, read_birds, zap_bins,
+from presto_tpu.ops.rednoise import (deredden, read_birds_bary, zap_bins,
                                      birds_to_bin_ranges)
 from presto_tpu.search.accel import (AccelConfig, AccelSearch,
                                      eliminate_harmonics,
@@ -109,7 +109,7 @@ def run(args):
     numbins = pairs.shape[0]
 
     if args.zaplist:
-        birds = read_birds(args.zaplist)
+        birds = read_birds_bary(args.zaplist)
         amps = fftpack.np_pairs_to_complex64(pairs)
         amps = zap_bins(amps, birds_to_bin_ranges(birds, T, args.baryv))
         pairs = fftpack.np_complex64_to_pairs(amps)

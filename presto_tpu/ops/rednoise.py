@@ -69,17 +69,30 @@ def deredden(amps: np.ndarray, inplace: bool = False) -> np.ndarray:
 def read_birds(path: str) -> List[Tuple[float, float]]:
     """Parse a .birds zap file: lines of 'freq width' (Hz), '#' comments.
     Parity: the zapfile format consumed by zapbirds (zapbirds.c /
-    lib/parkes_birds.txt)."""
+    lib/parkes_birds.txt).  'B'-prefixed lines (already-barycentric
+    birds, get_birdies birdzap.c:52-56) are folded in here with their
+    prefix stripped; use read_birds_bary when the flag matters."""
+    return [(f, w) for f, w, _ in read_birds_bary(path)]
+
+
+def read_birds_bary(path: str) -> List[Tuple[float, float, bool]]:
+    """Like read_birds but keeps the barycentric flag: returns
+    (freq_hz, width_hz, is_bary) per line.  Lines starting with 'B'
+    mark frequencies already in the barycentric frame (no topo->bary
+    velocity shift should be applied to them — birdzap.c:52-62)."""
     out = []
     with open(path) as f:
         for line in f:
             line = line.strip()
             if not line or line.startswith("#"):
                 continue
+            bary = line.startswith("B")
+            if bary:
+                line = line[1:]
             parts = line.split()
             freq = float(parts[0])
             width = float(parts[1]) if len(parts) > 1 else 0.0
-            out.append((freq, width))
+            out.append((freq, width, bary))
     return out
 
 
@@ -110,15 +123,17 @@ def zap_bins(amps: np.ndarray, ranges: Iterable[Tuple[float, float]],
     return out
 
 
-def birds_to_bin_ranges(birds: Iterable[Tuple[float, float]], T: float,
-                        baryv: float = 0.0):
-    """(freq, width) Hz -> (lobin, hibin) in Fourier bins, shifting the
-    topocentric birdie frequencies by the average barycentric velocity
-    as zapbirds does (zapbirds.c applies f *= 1+baryv to match a
-    barycentered FFT)."""
+def birds_to_bin_ranges(birds, T: float, baryv: float = 0.0):
+    """(freq, width[, is_bary]) Hz -> sorted (lobin, hibin) Fourier-bin
+    ranges, shifting topocentric birdie frequencies by the average
+    barycentric velocity as zapbirds does (get_birdies birdzap.c:52-68:
+    topo lines get f *= 1+baryv to match a barycentered FFT; 'B' lines
+    are already barycentric and pass through unshifted)."""
     out = []
-    for freq, width in birds:
-        f = freq * (1.0 + baryv)
+    for bird in birds:
+        freq, width = bird[0], bird[1]
+        is_bary = bird[2] if len(bird) > 2 else False
+        f = freq if is_bary else freq * (1.0 + baryv)
         half = max(width / 2.0, 0.0)
         out.append(((f - half) * T, (f + half) * T))
-    return out
+    return sorted(out)

@@ -1,0 +1,198 @@
+"""Pulsar catalog, .par parsing, binary_psr orbital calculations."""
+
+import numpy as np
+import pytest
+
+from presto_tpu.utils.catalog import (default_catalog, psrepoch,
+                                      binary_velocity, parse_atnf_catalog,
+                                      Catalog)
+from presto_tpu.io.parfile import Parfile
+from presto_tpu.astro.binary import BinaryPsr, shapiro_S
+
+
+class TestCatalog:
+    def test_lookup_with_and_without_prefix(self):
+        cat = default_catalog()
+        for name in ("B0329+54", "0329+54", "J0332+5434", "0332+5434"):
+            assert cat.lookup(name) is not None, name
+
+    def test_psrepoch_spin_advance(self):
+        # f(epoch) = f + fd*dt: over ~27 yr the Crab slows measurably
+        psr0 = psrepoch("B0531+21", 40000.0)
+        psr1 = psrepoch("B0531+21", 50000.0)
+        assert psr1.p > psr0.p
+        # frequency advance is the exact contract (database.c:193-196)
+        dt = 10000.0 * 86400.0
+        expect_f = psr0.f + psr0.fd * dt + 0.5 * psr0.fdd * dt * dt
+        assert abs(psr1.f - expect_f) / expect_f < 1e-12
+        assert abs(psr1.p - 1.0 / expect_f) / psr1.p < 1e-12
+
+    def test_psrepoch_binary_orbit_seconds(self):
+        psr = psrepoch("B1913+16", 52145.5)
+        assert psr.orb is not None
+        assert abs(psr.orb.p - 0.322997448918 * 86400) < 1.0
+        assert 0.0 <= psr.orb.t < psr.orb.p
+
+    def test_unknown_raises(self):
+        with pytest.raises(KeyError):
+            psrepoch("J9999+9999", 50000.0)
+
+    def test_dm_values(self):
+        cat = default_catalog()
+        assert abs(cat.params("B0329+54").dm - 26.7641) < 1e-3
+
+
+class TestBinaryVelocity:
+    def test_long_obs_closed_form(self):
+        # T >= Porb: closed form (responses.c:103-110)
+        psr = psrepoch("B1913+16", 52145.5)
+        minv, maxv = binary_velocity(psr.orb.p * 2, psr.orb)
+        c1 = (2 * np.pi * psr.orb.x
+              / (psr.orb.p * np.sqrt(1 - psr.orb.e ** 2)))
+        c2 = psr.orb.e * np.cos(np.deg2rad(psr.orb.w))
+        assert abs(maxv - c1 * (c2 + 1)) < 1e-12
+        assert abs(minv - c1 * (c2 - 1)) < 1e-12
+
+    def test_short_obs_subset(self):
+        psr = psrepoch("B1913+16", 52145.5)
+        lo_f, hi_f = binary_velocity(psr.orb.p * 1.5, psr.orb)
+        lo_s, hi_s = binary_velocity(psr.orb.p * 0.1, psr.orb)
+        assert lo_s >= lo_f - 1e-9 and hi_s <= hi_f + 1e-9
+        assert hi_s - lo_s < hi_f - lo_f
+
+
+PAR_TEXT = """\
+PSRJ           J1915+1606
+RAJ            19:15:27.99942          1  0.00003
+DECJ           +16:06:27.3868          1  0.0005
+F0             16.940537785677         1  1.8D-12
+F1             -2.4733D-15             1  2.0D-19
+PEPOCH         52984.0
+DM             168.77
+BINARY         BT
+PB             0.322997448918          1  3.0D-12
+A1             2.341782                1  3.0e-6
+ECC            0.6171338               1  4.0e-7
+OM             292.54450               1  8.0e-5
+T0             52144.90097844          1  5.0e-8
+"""
+
+
+class TestParfile:
+    @pytest.fixture
+    def par(self, tmp_path):
+        p = tmp_path / "b1913.par"
+        p.write_text(PAR_TEXT)
+        return Parfile(str(p))
+
+    def test_basic_and_d_exponents(self, par):
+        assert par.PSRJ == "J1915+1606"
+        assert abs(par.F0 - 16.940537785677) < 1e-12
+        assert abs(par.F1 - -2.4733e-15) < 1e-19
+        assert abs(par.F0_ERR - 1.8e-12) < 1e-15
+
+    def test_p_from_f(self, par):
+        assert abs(par.P0 - 1.0 / par.F0) < 1e-15
+        assert abs(par.P1 - -par.F1 / par.F0 ** 2) < 1e-20
+
+    def test_coords(self, par):
+        assert abs(par.RA_RAD - (19 + 15 / 60 + 27.99942 / 3600)
+                   * np.pi / 12) < 1e-10
+        assert par.DEC_RAD > 0
+
+    def test_orbit_export(self, par):
+        orb = par.orbit(epoch=52145.5)
+        assert abs(orb.p - 0.322997448918 * 86400) < 1e-6
+        assert abs(orb.e - 0.6171338) < 1e-10
+        assert 0 <= orb.t < orb.p
+
+    def test_ell1_conversion(self, tmp_path):
+        p = tmp_path / "ell1.par"
+        p.write_text("PSRJ J0000+0000\nF0 300.0\nPEPOCH 55000\n"
+                     "BINARY ELL1\nPB 1.0\nA1 2.0\n"
+                     "TASC 55000.0\nEPS1 0.001\nEPS2 0.001\n")
+        par = Parfile(str(p))
+        assert abs(par.E - np.hypot(0.001, 0.001)) < 1e-12
+        assert abs(par.OM - 45.0) < 1e-9
+        assert abs(par.T0 - (55000.0 + 1.0 * (np.pi / 4) / (2 * np.pi))) \
+            < 1e-9
+
+    def test_spin_at(self, par):
+        f, fd, fdd = par.spin_at(52984.0 + 365.25)
+        dt = 365.25 * 86400
+        assert abs(f - (par.F0 + par.F1 * dt)) < 1e-12
+
+
+class TestBinaryPsr:
+    @pytest.fixture
+    def bpsr(self, tmp_path):
+        p = tmp_path / "b1913.par"
+        p.write_text(PAR_TEXT)
+        return BinaryPsr(str(p))
+
+    def test_anomalies_at_periastron(self, bpsr):
+        ma, ea, ta = bpsr.calc_anoms(bpsr.T0)
+        assert abs(ma[0]) < 1e-8 and abs(ea[0]) < 1e-8
+
+    def test_anomaly_kepler_consistency(self, bpsr):
+        mjds = bpsr.T0 + np.linspace(0, bpsr.par.PB, 50)
+        ma, ea, ta = bpsr.calc_anoms(mjds)
+        np.testing.assert_allclose(ea - bpsr.par.E * np.sin(ea), ma,
+                                   atol=1e-12)
+
+    def test_radial_velocity_range(self, bpsr):
+        # B1913+16 radial velocities swing by hundreds of km/s
+        mjds = bpsr.T0 + np.linspace(0, bpsr.par.PB, 200)
+        v = bpsr.radial_velocity(mjds)
+        assert v.max() > 100 and v.min() < -100
+
+    def test_doppler_period_mean(self, bpsr):
+        mjds = bpsr.T0 + np.linspace(0, bpsr.par.PB, 500)
+        p = bpsr.doppler_period(mjds)
+        assert abs(np.mean(p) / bpsr.par.P0 - 1.0) < 1e-3
+
+    def test_demodulate_then_position_zero(self, bpsr):
+        mjds = bpsr.T0 + np.linspace(0.01, 0.3, 5)
+        demod = bpsr.demodulate_TOAs(mjds)
+        # emitted + light travel == observed
+        xs = -bpsr.position(demod, inc=90.0)[0] / 86400.0
+        np.testing.assert_allclose(demod + xs, mjds, atol=1e-9)
+
+    def test_shapiro_sini(self):
+        # S == sin(i); for edge-on double pulsar-ish params S ~= 1
+        S = shapiro_S(1.34, 1.25, 1.415032, 0.10225156248)
+        assert 0.9 < S <= 1.01
+
+    def test_non_binary_raises(self, tmp_path):
+        p = tmp_path / "iso.par"
+        p.write_text("PSRJ J0000+0000\nF0 10.0\nPEPOCH 55000\n")
+        with pytest.raises(ValueError):
+            BinaryPsr(str(p))
+
+
+class TestAtnfParser:
+    def test_parse_reference_style_line(self, tmp_path):
+        # same column layout as lib/psr_catalog.txt (value+error pairs,
+        # '*' for missing)
+        line = ("4     J0023+0923   J0023+0923   00:23:16.8 2.0e-02  "
+                "+09:23:24.1 2.0e-01          *       0         *       0"
+                "        *       0        *   111.383   -52.849  "
+                "0.003050       0        *       0          *       0"
+                "          *       0        *      14.30       0"
+                "             *       0     2.00       0        *       0 "
+                "BT                *       0     0.1400       0"
+                "     0.0350       0        *       0        *       0"
+                "          *       0          *       0          *       0"
+                "     0.95 OPT:[bvr+13]  FermiAssoc   HE\n")
+        path = tmp_path / "cat.txt"
+        path.write_text("# header\n---\n" + line)
+        recs = parse_atnf_catalog(str(path))
+        assert len(recs) == 1
+        r = recs[0]
+        assert r["jname"] == "J0023+0923"
+        assert abs(r["p0"] - 0.003050) < 1e-9
+        assert abs(r["dm"] - 14.30) < 1e-9
+        assert abs(r["pb"] - 0.1400) < 1e-9
+        cat = Catalog(recs)
+        psr = cat.params("J0023+0923")
+        assert psr.orb is not None and abs(psr.orb.x - 0.0350) < 1e-9

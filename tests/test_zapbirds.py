@@ -1,0 +1,132 @@
+"""zapbirds / makezaplist: zapfile parsing, FFT zapping, width
+measurement, .birds -> .zaplist expansion."""
+
+import numpy as np
+import pytest
+
+from presto_tpu.io import datfft
+from presto_tpu.io.infodata import InfoData, write_inf
+from presto_tpu.ops.rednoise import read_birds_bary, birds_to_bin_ranges
+from presto_tpu.apps import zapbirds as zb
+
+
+def _make_fft(tmp_path, name="zaptest", n=1 << 16, dt=1e-3, tones=()):
+    """Noise spectrum with strong tones at given Fourier bins, written
+    as <name>.fft + .inf.  Returns (base, T)."""
+    rng = np.random.default_rng(7)
+    amps = (rng.normal(size=n) + 1j * rng.normal(size=n)).astype(np.complex64)
+    for b in tones:
+        amps[b] = 500.0 + 0.0j
+    base = str(tmp_path / name)
+    datfft.write_fft(base + ".fft", amps)
+    info = InfoData(name=base, N=float(2 * n), dt=dt)
+    write_inf(info, base + ".inf")
+    return base, 2 * n * dt
+
+
+class TestZapfileParsing:
+    def test_bary_prefix_and_comments(self, tmp_path):
+        p = tmp_path / "x.birds"
+        p.write_text("# comment\n60.0 1.0\nB 407.5 0.5\n")
+        birds = read_birds_bary(str(p))
+        assert birds == [(60.0, 1.0, False), (407.5, 0.5, True)]
+
+    def test_baryv_applied_only_to_topo(self):
+        T = 100.0
+        rngs = birds_to_bin_ranges([(100.0, 0.0, False), (100.0, 0.0, True)],
+                                   T, baryv=1e-3)
+        topo = [r for r in rngs if r[0] > 100.0 * T]
+        bary = [r for r in rngs if r[0] <= 100.0 * T]
+        assert abs(topo[0][0] - 100.0 * 1.001 * T) < 1e-9
+        assert abs(bary[0][0] - 100.0 * T) < 1e-9
+
+    def test_ranges_sorted(self):
+        rngs = birds_to_bin_ranges([(300.0, 1.0), (60.0, 1.0)], 10.0)
+        assert rngs == sorted(rngs)
+
+
+class TestZapFFT:
+    def test_tone_removed(self, tmp_path):
+        base, T = _make_fft(tmp_path, tones=[5000])
+        zf = tmp_path / "z.birds"
+        freq = 5000 / T
+        zf.write_text("%.9f %.9f\n" % (freq, 10 / T))
+        nz = zb.zap_fft_file(base + ".fft", str(zf))
+        assert nz == 1
+        amps = datfft.read_fft(base + ".fft")
+        # tone replaced by ~local-median level noise
+        assert np.abs(amps[5000]) < 10.0
+
+    def test_range_beyond_nyquist_clamped(self, tmp_path):
+        base, T = _make_fft(tmp_path)
+        zf = tmp_path / "z.birds"
+        zf.write_text("%.9f 1.0\n" % (1.0 / (2 * 1e-3) * 10))  # way out
+        nz = zb.zap_fft_file(base + ".fft", str(zf))
+        assert nz == 0
+
+
+class TestMeasureBirds:
+    def test_measures_injected_tone(self, tmp_path):
+        base, T = _make_fft(tmp_path, tones=[5000, 10000])
+        inz = tmp_path / "in.txt"
+        inz.write_text("%.9f 2\n" % (5000 / T))
+        out = tmp_path / "out.txt"
+        nf = zb.measure_birds(base + ".fft", str(inz), str(out))
+        assert nf == 2
+        lines = [l for l in out.read_text().splitlines()
+                 if not l.startswith("#")]
+        freqs = [float(l.split()[0]) for l in lines]
+        assert abs(freqs[0] - 5000 / T) * T < 3.0   # within ~3 bins
+        assert abs(freqs[1] - 10000 / T) * T < 3.0
+
+    def test_no_tone_no_bird(self, tmp_path):
+        base, T = _make_fft(tmp_path)
+        inz = tmp_path / "in.txt"
+        inz.write_text("%.9f 1\n" % (3333 / T))
+        out = tmp_path / "out.txt"
+        nf = zb.measure_birds(base + ".fft", str(inz), str(out))
+        assert nf == 0
+
+
+class TestMakezaplist:
+    def test_harmonic_train_expansion(self, tmp_path):
+        base, T = _make_fft(tmp_path, name="mz")
+        birds = tmp_path / "mz.birds"
+        birds.write_text(
+            "# psr birds\n"
+            "60.0 0.1 3 1\n"       # grow: width scales with harmonic
+            "13.0 0.05\n")
+        out = zb.makezaplist(str(birds))
+        got = read_birds_bary(out)
+        freqs = [b[0] for b in got]
+        widths = [b[1] for b in got]
+        assert freqs == sorted(freqs)
+        assert 13.0 in freqs and 60.0 in freqs and 120.0 in freqs \
+            and 180.0 in freqs
+        i120 = freqs.index(120.0)
+        assert abs(widths[i120] - 0.2) < 1e-12
+
+    def test_zaplist_roundtrips_through_zap(self, tmp_path):
+        base, T = _make_fft(tmp_path, name="rt", tones=[6000])
+        birds = tmp_path / "rt.birds"
+        birds.write_text("%.9f %.9f 1\n" % (6000 / T, 20 / T))
+        out = zb.makezaplist(str(birds))
+        nz = zb.zap_fft_file(base + ".fft", out)
+        assert nz == 1
+        amps = datfft.read_fft(base + ".fft")
+        assert np.abs(amps[6000]) < 10.0
+
+
+class TestCLI:
+    def test_main_zap(self, tmp_path):
+        base, T = _make_fft(tmp_path, name="cli", tones=[4000])
+        zf = tmp_path / "c.birds"
+        zf.write_text("%.9f %.9f\n" % (4000 / T, 10 / T))
+        zb.main(["-zap", "-zapfile", str(zf), base + ".fft"])
+        amps = datfft.read_fft(base + ".fft")
+        assert np.abs(amps[4000]) < 10.0
+
+    def test_main_requires_mode(self, tmp_path):
+        base, T = _make_fft(tmp_path, name="cli2")
+        with pytest.raises(SystemExit):
+            zb.main([base + ".fft"])
