@@ -38,6 +38,12 @@ def build_parser():
     p.add_argument("-fdd", type=float, default=0.0)
     p.add_argument("-accelcand", type=int, default=0)
     p.add_argument("-accelfile", type=str, default=None)
+    p.add_argument("-par", "-timing", dest="parfile", type=str,
+                   default=None,
+                   help="Fold using an ephemeris from a .par file "
+                        "(polycos generated in-framework, no TEMPO)")
+    p.add_argument("-polycos", type=str, default=None,
+                   help="Fold using an existing TEMPO polyco.dat")
     p.add_argument("-dm", type=float, default=0.0)
     p.add_argument("-n", dest="proflen", type=int, default=0,
                    help="Profile bins (0 = auto)")
@@ -55,8 +61,35 @@ def build_parser():
     return p
 
 
-def _fold_params(args, T: float):
-    """Resolve (f, fd, fdd) from flags or an accelsearch .cand file."""
+def _fold_params(args, T: float, obs=None):
+    """Resolve (f, fd, fdd) from flags, an accelsearch .cand file, a
+    .par ephemeris (-par/-timing), or a TEMPO polyco.dat (-polycos)."""
+    if args.parfile or args.polycos:
+        from presto_tpu.astro.polycos import (make_polycos, read_polycos,
+                                              fit_fold_params)
+        obs = obs or {}
+        mjd0 = obs.get("mjd", 0.0)
+        if args.polycos:
+            pcs = read_polycos(args.polycos)
+        else:
+            from presto_tpu.io.parfile import Parfile
+            par = Parfile(args.parfile)
+            dur_min = T / 60.0 + 2.0
+            # barycentered .dat input: the timestamps are already bary
+            # MJDs -- generate bary-frame polycos (no double Doppler)
+            pcs = make_polycos(par, mjd0 - 1.0 / 1440.0, dur_min,
+                               telescope=obs.get("telescope", "GBT"),
+                               obsfreq=obs.get("obsfreq", 0.0),
+                               barytime=obs.get("bary", False))
+            if not args.dm:
+                args.dm = getattr(par, "DM", 0.0)
+        f, fd, fdd, rms = fit_fold_params(pcs, mjd0, T)
+        if rms > 0.01:
+            print("prepfold: WARNING polyco->polynomial fit rms = "
+                  "%.2g rotations (obs too long for one cubic?)" % rms)
+        print("prepfold: ephemeris fold  f=%.12g Hz  fd=%.4g  fdd=%.4g"
+              % (f, fd, fdd))
+        return f, fd, fdd
     if args.accelfile:
         from presto_tpu.apps.accelsearch import read_cand_file
         cands = read_cand_file(args.accelfile)
@@ -181,11 +214,21 @@ def run(args):
         from presto_tpu.io.infodata import read_inf
         info = read_inf(args.infile[:-4])
         T = info.N * info.dt
+        obs = {"mjd": info.mjd, "telescope": info.telescope,
+               "bary": bool(info.bary),
+               "obsfreq": (0.0 if info.bary
+                           else info.freq + 0.5 * info.freqband)}
     else:
+        from presto_tpu.apps.common import obs_metadata
         fb0 = open_raw([args.infile])
-        T = fb0.header.N * fb0.header.tsamp
+        hdr0 = fb0.header
+        T = hdr0.N * hdr0.tsamp
+        tel, _, _ = obs_metadata(fb0)
+        obs = {"mjd": hdr0.tstart, "telescope": tel,
+               "obsfreq": hdr0.lofreq + 0.5 * abs(hdr0.foff)
+               * hdr0.nchans}
         fb0.close()
-    f, fd, fdd = _fold_params(args, T)
+    f, fd, fdd = _fold_params(args, T, obs)
 
     if is_dat:
         res, cfg, candnm = fold_dat(args, f, fd, fdd)

@@ -11,7 +11,7 @@ from __future__ import annotations
 import numpy as np
 
 from presto_tpu.io.parfile import Parfile
-from presto_tpu.ops.orbit import SOL
+from presto_tpu.ops.orbit import SOL, keplers_eqn
 
 TWOPI = 2.0 * np.pi
 SECPERDAY = 86400.0
@@ -65,20 +65,13 @@ class BinaryPsr:
         return mean_anom, ecc_anom, true_anomaly(ecc_anom, self.par.E)
 
     def eccentric_anomaly(self, mean_anomaly):
-        """Solve Kepler's equation by Newton iteration (quadratic
-        convergence vs the reference's fixed-point loop,
-        binary_psr.py:78-93; same 5e-15 tolerance)."""
+        """Solve Kepler's equation (binary_psr.py:78-93) via the shared
+        vectorized solver in ops.orbit (fixed-point warmup + Newton)."""
         ma = np.fmod(np.asarray(mean_anomaly, dtype=np.float64), TWOPI)
         ma = np.where(ma < 0.0, ma + TWOPI, ma)
-        e = self.par.E
-        E = ma + e * np.sin(ma)
-        for _ in range(50):
-            f = E - e * np.sin(E) - ma
-            dE = f / (1.0 - e * np.cos(E))
-            E -= dE
-            if np.max(np.abs(dE)) < 5e-15:
-                break
-        return E
+        return np.atleast_1d(keplers_eqn(ma / TWOPI * self.PBsec,
+                                         self.PBsec, self.par.E,
+                                         acc=5e-15))
 
     def most_recent_peri(self, MJD):
         """MJD(s) of the last periastron before MJD

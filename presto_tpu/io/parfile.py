@@ -31,6 +31,8 @@ FLOAT_KEYS = {
 }
 FLOATN_PREFIXES = ("F", "P", "FB", "FD", "DMX_", "DMXEP_", "DMXR1_",
                    "DMXR2_", "DMXF1_", "DMXF2_")
+# legacy bare spin keys ('P  0.714519' old-style pars) -> numbered form
+LEGACY_ALIASES = {"P": "P0", "PD": "P1", "F": "F0", "FD": "F1"}
 STR_KEYS = {"FILE", "PSR", "PSRJ", "PSRB", "EPHEM", "CLK", "BINARY",
             "RAJ", "DECJ", "UNITS", "TZRSITE"}
 
@@ -55,7 +57,7 @@ class Parfile:
         parts = line.split()
         if not parts:
             return
-        key = parts[0]
+        key = LEGACY_ALIASES.get(parts[0], parts[0])
         if key in STR_KEYS:
             setattr(self, key, parts[1])
         elif key in FLOAT_KEYS or self._is_floatn(key):
@@ -87,8 +89,6 @@ class Parfile:
     # -- derived quantities (parfile.py:110-181) --------------------- #
 
     def _derive(self) -> None:
-        if hasattr(self, "P"):
-            self.P0 = self.P
         if hasattr(self, "P0") and not hasattr(self, "F0"):
             self.F0 = 1.0 / self.P0
         if hasattr(self, "F0") and not hasattr(self, "P0"):
@@ -108,12 +108,13 @@ class Parfile:
         if hasattr(self, "DECJ"):
             self.DEC_RAD = parse_dec(self.DECJ)
         if hasattr(self, "EPS1") and hasattr(self, "EPS2"):
-            ecc = math.hypot(self.EPS1, self.EPS2)
-            omega = math.atan2(self.EPS1, self.EPS2)
-            self.E = ecc
-            self.OM = math.degrees(omega)
+            from presto_tpu.ops.orbit import ell1_to_keplerian
+            tasc = getattr(self, "TASC", 0.0)
+            pb = getattr(self, "PB", 0.0)
+            self.E, self.OM, t0 = ell1_to_keplerian(
+                self.EPS1, self.EPS2, tasc, pb)
             if hasattr(self, "TASC") and hasattr(self, "PB"):
-                self.T0 = self.TASC + self.PB * omega / TWOPI
+                self.T0 = t0
         if hasattr(self, "ECC") and not hasattr(self, "E"):
             self.E = self.ECC
         if hasattr(self, "PB") and hasattr(self, "A1") \

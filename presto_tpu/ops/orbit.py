@@ -138,6 +138,21 @@ def E_to_z(E, p_psr: float, T: float, orb: OrbitParams):
         / (orb.e * cE - 1.0) ** 3
 
 
+def ell1_to_keplerian(eps1: float, eps2: float, tasc: float, pb: float):
+    """ELL1 Laplace parameters -> (ecc, om_deg in [0,360), t0_mjd).
+
+    Shared by the .par parser and the ATNF catalog reader
+    (parfile.py psr_par ELL1 branch): ecc = |(eps1, eps2)|,
+    om = atan2(eps1, eps2), T0 = TASC + PB * om / 2pi (pb in days).
+    """
+    ecc = float(np.hypot(eps1, eps2))
+    w = float(np.arctan2(eps1, eps2))
+    if w < 0.0:
+        w += TWOPI
+    t0 = tasc + pb * w / TWOPI
+    return ecc, np.degrees(w), t0
+
+
 def orbit_delays(times, orb: OrbitParams):
     """Roemer delay (s) at observation times `times` (s), measured
     with orb.t = time since periastron at times[...]==0.  The fused

@@ -210,12 +210,13 @@ def parse_atnf_catalog(path: str) -> List[dict]:
                         pass
             # ELL1 binaries: (TASC, EPS1, EPS2) -> (T0, ECC, OM)
             if "tasc" in rec and "t0" not in rec:
-                e1, e2 = rec.get("eps1", 0.0), rec.get("eps2", 0.0)
-                rec["ecc"] = math.hypot(e1, e2)
-                w = math.atan2(e1, e2)
-                rec["om"] = math.degrees(w) % 360.0
+                from presto_tpu.ops.orbit import ell1_to_keplerian
+                ecc, om, t0 = ell1_to_keplerian(
+                    rec.get("eps1", 0.0), rec.get("eps2", 0.0),
+                    rec["tasc"], rec.get("pb", 0.0))
+                rec["ecc"], rec["om"] = ecc, om
                 if rec.get("pb"):
-                    rec["t0"] = rec["tasc"] + rec["pb"] * w / TWOPI
+                    rec["t0"] = t0
             if rec.get("jname") or rec.get("bname"):
                 records.append(rec)
     return records
@@ -256,7 +257,11 @@ def psrepoch(psrname: str, epoch: float,
     psr.fd = fd + psr.fdd * difft
     psr.p = 1.0 / psr.f
     psr.pd = -psr.fd * psr.p * psr.p
-    psr.pdd = (2.0 * fd * fd / f - psr.fdd) / (f * f) if f else 0.0
+    # note: the reference evaluates pdd with the PRE-advance f/fd
+    # (database.c:199); here the advanced values are used so p/pd/pdd
+    # are all consistent at the returned timepoch
+    psr.pdd = ((2.0 * psr.fd * psr.fd / psr.f - psr.fdd)
+               / (psr.f * psr.f)) if psr.f else 0.0
     psr.timepoch = epoch
     if psr.orb is not None and psr.orb.p:
         difft = SECPERDAY * (epoch - psr.orb.t)   # orb.t held T0 (MJD)

@@ -196,3 +196,14 @@ class TestAtnfParser:
         cat = Catalog(recs)
         psr = cat.params("J0023+0923")
         assert psr.orb is not None and abs(psr.orb.x - 0.0350) < 1e-9
+
+
+class TestLegacyParKeys:
+    def test_bare_p_and_pd(self, tmp_path):
+        p = tmp_path / "old.par"
+        p.write_text("PSR B0329+54\nP 0.714519\nPD 2.05E-15\n"
+                     "PEPOCH 46473.0\nDM 26.76\n")
+        par = Parfile(str(p))
+        assert abs(par.P0 - 0.714519) < 1e-12
+        assert abs(par.F0 - 1.0 / 0.714519) < 1e-12
+        assert abs(par.F1 - -2.05e-15 / 0.714519 ** 2) < 1e-20

@@ -1,0 +1,212 @@
+"""Polycos: generation accuracy, file round-trip, phase evaluation."""
+
+import numpy as np
+import pytest
+
+from presto_tpu.astro.polycos import (make_polycos, read_polycos,
+                                      write_polycos, Polyco, Polycos)
+from presto_tpu.astro.bary import barycenter
+from presto_tpu.io.parfile import Parfile
+
+ISO_PAR = """\
+PSRJ           J0332+5434
+RAJ            03:32:59.4
+DECJ           +54:34:43.6
+F0             1.399541538720
+F1             -4.011970e-15
+PEPOCH         55555.0
+DM             26.7641
+"""
+
+BIN_PAR = """\
+PSRJ           J1915+1606
+RAJ            19:15:27.99942
+DECJ           +16:06:27.3868
+F0             16.940537785677
+F1             -2.4733E-15
+PEPOCH         55555.0
+DM             168.77
+BINARY         BT
+PB             0.322997448918
+A1             2.341782
+ECC            0.6171338
+OM             292.54450
+T0             55555.2
+"""
+
+
+@pytest.fixture
+def iso_par(tmp_path):
+    p = tmp_path / "iso.par"
+    p.write_text(ISO_PAR)
+    return str(p)
+
+
+@pytest.fixture
+def bin_par(tmp_path):
+    p = tmp_path / "bin.par"
+    p.write_text(BIN_PAR)
+    return str(p)
+
+
+class TestGeneration:
+    def test_fit_matches_exact_phase(self, iso_par):
+        """Polyco phase must reproduce the exact bary phase model to
+        ~1e-6 rotations within the span."""
+        par = Parfile(iso_par)
+        mjd0 = 55560.0
+        pcs = make_polycos(par, mjd0, 120.0, telescope="GBT",
+                           numcoeff=12, span_min=60)
+        assert len(pcs) == 2
+        # exact model: phase(t) = f0*dt_bary + 0.5*f1*dt_bary^2
+        for tmjd in mjd0 + np.linspace(0.001, 120 / 1440.0 - 0.001, 13):
+            tb, _ = barycenter(tmjd, par.RAJ, par.DECJ, obs="GB",
+                               ephem="DEANALYTIC")
+            dt = (np.longdouble(tb) - np.longdouble(par.PEPOCH)) * 86400.0
+            exact = (np.longdouble(par.F0) * dt
+                     + np.longdouble(0.5 * par.F1) * dt * dt)
+            exact_frac = float(np.fmod(exact, 1.0))
+            got = pcs.get_phase(int(tmjd), tmjd - int(tmjd))
+            diff = abs(got - exact_frac)
+            diff = min(diff, 1 - diff)
+            assert diff < 1e-5, (tmjd, got, exact_frac)
+
+    def test_freq_is_doppler_shifted(self, iso_par):
+        """Apparent freq differs from F0 by ~voverc*F0."""
+        par = Parfile(iso_par)
+        pcs = make_polycos(par, 55560.0, 60.0, telescope="GBT")
+        b = pcs.blocks[0]
+        expect = par.F0 * (1.0 + b.doppler)
+        # doppler sign convention: apparent freq = f*(1+v/c) with our
+        # voverc (positive = towards); allow either sign convention
+        # but magnitude of shift must match
+        shift = abs(b.f0 - par.F0)
+        assert abs(shift - abs(par.F0 * b.doppler)) / par.F0 < 3e-6
+        assert shift > 1e-7  # the shift is really there
+
+    def test_rms_small(self, iso_par):
+        pcs = make_polycos(iso_par, 55560.0, 60.0)
+        assert pcs.blocks[0].log10rms < -6
+
+    def test_binary_phase_wobble(self, bin_par):
+        """Binary polycos carry orbital phase and a time-varying
+        apparent frequency across the orbit."""
+        par = Parfile(bin_par)
+        # spread spans across a full 7.75-hr orbit
+        pcs = make_polycos(par, 55556.0, 0.33 * 1440, span_min=30)
+        f0s = np.array([b.f0 for b in pcs.blocks])
+        assert np.ptp(f0s) / par.F0 > 1e-4   # B1913+16 swings ~1e-3
+        assert all(b.binphase is not None for b in pcs.blocks)
+
+    def test_obsfreq_dm_delay(self, iso_par):
+        """Finite obsfreq shifts phase by f0 * dm_delay difference."""
+        par = Parfile(iso_par)
+        mjd0 = 55560.0
+        pc_inf = make_polycos(par, mjd0, 60.0, obsfreq=0.0)
+        pc_350 = make_polycos(par, mjd0, 60.0, obsfreq=350.0)
+        t = mjd0 + 0.01
+        dphi = (pc_inf.get_phase(int(t), t % 1)
+                - pc_350.get_phase(int(t), t % 1)) % 1.0
+        delay = 26.7641 / 0.000241 / 350.0 ** 2
+        expect = (par.F0 * delay) % 1.0
+        assert abs(dphi - expect) < 1e-3
+
+
+class TestFileRoundTrip:
+    def test_write_read(self, iso_par, tmp_path):
+        pcs = make_polycos(iso_par, 55560.0, 120.0, telescope="GBT")
+        path = str(tmp_path / "polyco.dat")
+        write_polycos(pcs, path)
+        back = read_polycos(path)
+        assert len(back) == len(pcs)
+        for a, b in zip(pcs.blocks, back.blocks):
+            assert abs(a.tmid - b.tmid) < 1e-10
+            assert abs(a.f0 - b.f0) < 1e-9
+            assert abs(a.rphase - b.rphase) < 1e-6
+            np.testing.assert_allclose(a.coeffs, b.coeffs, rtol=1e-12,
+                                       atol=1e-18)
+            # evaluated phase identical through the file
+            t = a.tmid + 0.01
+            pa = a.phase(int(t), t % 1)
+            pb = b.phase(int(t), t % 1)
+            assert abs(pa - pb) < 1e-6
+
+    def test_select_nearest_block(self, iso_par, tmp_path):
+        pcs = make_polycos(iso_par, 55560.0, 180.0, span_min=60)
+        assert pcs.select(55560, 0.01) == 0
+        assert pcs.select(55560, 110.0 / 1440) == 1
+
+
+class TestEvaluation:
+    def test_phase_freq_consistent(self, iso_par):
+        """Numerical derivative of rotation() equals freq()."""
+        pcs = make_polycos(iso_par, 55560.0, 60.0)
+        b = pcs.blocks[0]
+        t = b.tmid + 0.005
+        eps = 1e-7   # days
+        r1 = b.rotation(int(t), t % 1 - eps)
+        r2 = b.rotation(int(t), t % 1 + eps)
+        deriv = (r2 - r1) / (2 * eps * 86400.0)
+        assert abs(deriv - b.freq(int(t), t % 1)) / deriv < 1e-6
+
+
+class TestPrepfoldPolycos:
+    def test_fold_with_polyco_file(self, tmp_path):
+        """prepfold -polycos folds as well as -f when the polyco phase
+        model is the plain f=const model of the synthetic data."""
+        from presto_tpu.models.synth import FakeSignal, fake_filterbank_file
+        from presto_tpu.apps import prepdata, prepfold as pf_app
+        f0 = 7.8125
+        path = str(tmp_path / "fake.fil")
+        sig = FakeSignal(f=f0, dm=60.0, shape="gauss", width=0.06,
+                         amp=1.2)
+        fake_filterbank_file(path, N=1 << 14, dt=5e-4, nchan=32,
+                             lofreq=1350.0, chanwidth=3.0, signal=sig,
+                             noise_sigma=3.0, nbits=8)
+        base = str(tmp_path / "psr")
+        prepdata.run(prepdata.build_parser().parse_args(
+            ["-dm", "60.0", "-o", base, path]))
+        from presto_tpu.io.infodata import read_inf
+        info = read_inf(base)
+        mjd0 = info.mjd
+        # one polyco block centered on the (short) obs, exact phase
+        # model: rphase=0, f0=const, no higher terms
+        tmid = mjd0 + 0.5 * info.N * info.dt / 86400.0
+        blk = Polyco(psr="FAKE", tmid_i=int(tmid), tmid_f=tmid % 1.0,
+                     dm=60.0, doppler=0.0, log10rms=-9.0, rphase=0.0,
+                     f0=f0, obs="1", dataspan=60, numcoeff=3,
+                     obsfreq=1398.5, coeffs=np.zeros(3))
+        pcfile = str(tmp_path / "polyco.dat")
+        write_polycos(Polycos([blk]), pcfile)
+        res = pf_app.run(pf_app.build_parser().parse_args(
+            ["-polycos", pcfile, "-npart", "16", "-n", "32",
+             "-nosearch", "-o", base + "_pc", base + ".dat"]))
+        assert res.best_redchi > 10.0
+        assert res.fold_f == pytest.approx(f0, rel=1e-6)
+
+    def test_fold_with_par_file(self, tmp_path):
+        """prepfold -par folds synthetic data via in-framework polycos
+        (short obs: ephemeris corrections drift << one profile bin)."""
+        from presto_tpu.models.synth import FakeSignal, fake_filterbank_file
+        from presto_tpu.apps import prepdata, prepfold as pf_app
+        f0 = 7.8125
+        path = str(tmp_path / "fake.fil")
+        sig = FakeSignal(f=f0, dm=60.0, shape="gauss", width=0.06,
+                         amp=1.2)
+        fake_filterbank_file(path, N=1 << 14, dt=5e-4, nchan=32,
+                             lofreq=1350.0, chanwidth=3.0, signal=sig,
+                             noise_sigma=3.0, nbits=8)
+        base = str(tmp_path / "psr")
+        prepdata.run(prepdata.build_parser().parse_args(
+            ["-dm", "60.0", "-o", base, path]))
+        from presto_tpu.io.infodata import read_inf
+        info = read_inf(base)
+        par = tmp_path / "cand.par"
+        par.write_text("PSRJ J0000+0000\nRAJ 12:00:00\nDECJ +05:00:00\n"
+                       "F0 %.10f\nPEPOCH %.6f\nDM 60.0\n"
+                       % (f0, info.mjd))
+        res = pf_app.run(pf_app.build_parser().parse_args(
+            ["-par", str(par), "-npart", "16", "-n", "32",
+             "-nosearch", "-o", base + "_par", base + ".dat"]))
+        assert res.best_redchi > 10.0
+        assert res.fold_f == pytest.approx(f0, rel=1e-5)
