@@ -71,6 +71,8 @@ def _fold_params(args, T: float, obs=None):
         mjd0 = obs.get("mjd", 0.0)
         if args.polycos:
             pcs = read_polycos(args.polycos)
+            if not args.dm and pcs.blocks:
+                args.dm = pcs.blocks[0].dm
         else:
             from presto_tpu.io.parfile import Parfile
             par = Parfile(args.parfile)

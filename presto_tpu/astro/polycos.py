@@ -362,9 +362,15 @@ def fit_fold_params(pcs: Polycos, mjd_start: float, T_sec: float,
     rot = np.array([pcs.get_rotation(int(m), m - int(m)) for m in mjds])
     rot = rot - rot[0]
     # guard against inter-block fractional-rphase jumps: integrate the
-    # per-sample phase increments mod the expected f*dt
-    f_guess = pcs.get_freq(int(mjd_start), mjd_start - int(mjd_start))
-    expect = f_guess * np.diff(ts)
+    # per-sample phase increments mod the expected f*dt.  The expected
+    # step uses the LOCAL instantaneous frequency at each interval
+    # midpoint (not the start-epoch f): for a binary, orbital Doppler
+    # can drift f by more than 0.5 rotations per sample interval over
+    # the start value, which would make a fixed-f re-wrap subtract
+    # spurious integers from genuine phase steps
+    mids = mjds[:-1] + 0.5 * np.diff(ts) / SECPERDAY
+    f_mid = np.array([pcs.get_freq(int(m), m - int(m)) for m in mids])
+    expect = f_mid * np.diff(ts)
     steps = np.diff(rot)
     steps = steps - np.round((steps - expect))   # re-wrap block joins
     rot = np.concatenate([[0.0], np.cumsum(steps)])

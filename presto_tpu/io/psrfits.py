@@ -27,6 +27,7 @@ from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from presto_tpu.io import native
 from presto_tpu.io.fitsio import FitsFile, write_fits
 from presto_tpu.io.sigproc import FilterbankHeader
 
@@ -236,12 +237,43 @@ class PsrfitsFile:
         return self._row_start_spec_uncached(fi, row)
 
     # -- decoding -----------------------------------------------------
+    def _decode_row_native(self, sub, raw: np.ndarray,
+                           row: int) -> Optional[np.ndarray]:
+        """Fused C++ subint decode (csrc/native_io.cpp pt_decode_subint);
+        None when the native library or this geometry is unsupported
+        (16/32-bit stays on the NumPy path)."""
+        if self.nbits not in (1, 2, 4, 8):
+            return None
+        if self.npol > 1:
+            sum_polns = (self.poln_order.startswith("AABB")
+                         or self.npol == 2)
+            if self.use_poln > 0 or (self.npol > 2 and not sum_polns):
+                pol_mode = max(self.use_poln - 1, 0)
+            else:
+                pol_mode = -2
+        else:
+            pol_mode = 0
+        scl = offs = wts = None
+        if self.apply_scale:
+            scl = np.asarray(sub.read_col("DAT_SCL", row), np.float32)
+        if self.apply_offset:
+            offs = np.asarray(sub.read_col("DAT_OFFS", row), np.float32)
+        if self.apply_weight:
+            wts = np.asarray(sub.read_col("DAT_WTS", row), np.float32)
+        return native.decode_subint(
+            raw, self.nsblk, self.npol, self.nchan, self.nbits,
+            self.zero_offset, scl, offs, wts, pol_mode, self.df < 0)
+
     def _decode_row(self, fi: int, row: int) -> np.ndarray:
         """One subint -> [nsblk, nchan] float32 (ascending freq)."""
         if self._cache_row[0] == (fi, row):
             return self._cache_row[1]
         sub = self.files[fi].hdu("SUBINT")
         raw = sub.read_col_raw_bytes("DATA", row)
+        fast = self._decode_row_native(sub, raw, row)
+        if fast is not None:
+            self._cache_row = ((fi, row), fast)
+            return fast
         samples = unpack_samples(raw, self.nbits)
         nspec = self.nsblk
         data = np.asarray(samples, np.float32).reshape(

@@ -20,6 +20,8 @@ from typing import BinaryIO, Iterator, Optional
 
 import numpy as np
 
+from presto_tpu.io import native
+
 _TELESCOPES = {0: "Fake", 1: "Arecibo", 2: "Ooty", 3: "Nancay", 4: "Parkes",
                5: "Jodrell", 6: "GBT", 7: "GMRT", 8: "Effelsberg"}
 
@@ -241,11 +243,15 @@ class FilterbankFile:
         self.f.seek(hdr.headerlen + start * bps)
         navail = max(0, min(count, hdr.N - start))
         raw = np.frombuffer(self.f.read(navail * bps), dtype=np.uint8)
-        vals = unpack_bits(raw, hdr.nbits)
-        arr = vals.astype(np.float32).reshape(navail, hdr.nifs, hdr.nchans)
-        arr = arr.sum(axis=1) if hdr.nifs > 1 else arr[:, 0, :]
-        if hdr.foff < 0:
-            arr = arr[:, ::-1]
+        arr = native.decode_spectra(raw, navail, hdr.nifs, hdr.nchans,
+                                    hdr.nbits, hdr.foff < 0)
+        if arr is None:
+            vals = unpack_bits(raw, hdr.nbits)
+            arr = vals.astype(np.float32).reshape(navail, hdr.nifs,
+                                                  hdr.nchans)
+            arr = arr.sum(axis=1) if hdr.nifs > 1 else arr[:, 0, :]
+            if hdr.foff < 0:
+                arr = arr[:, ::-1]
         if navail < count:
             pad = np.zeros((count - navail, hdr.nchans), dtype=np.float32)
             arr = np.concatenate([arr, pad], axis=0)
