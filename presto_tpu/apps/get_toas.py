@@ -1,0 +1,75 @@
+"""get_TOAs: extract pulse times-of-arrival from .pfd files.
+
+CLI parity with bin/get_TOAs.py: -n TOAs per file, -g Gaussian template
+FWHM (rotations), -t template .bestprof/profile file, -d DM override
+for subband realignment, -2 for tempo2 format, -o output .tim path
+(default stdout).  FFTFIT template matching is the NumPy Taylor-1992
+reimplementation in presto_tpu.timing.fftfit.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+import numpy as np
+
+from presto_tpu.io.pfd import read_pfd
+from presto_tpu.timing import toas_from_pfd, format_princeton, \
+    format_tempo2
+from presto_tpu.timing.toas import write_tim
+
+
+def build_parser():
+    p = argparse.ArgumentParser(prog="get_TOAs")
+    p.add_argument("-n", type=int, default=1,
+                   help="Number of TOAs per .pfd file")
+    p.add_argument("-g", type=float, default=0.1,
+                   help="Gaussian template FWHM in rotations")
+    p.add_argument("-t", type=str, default=None,
+                   help="Template profile file (.bestprof or one value "
+                        "per line)")
+    p.add_argument("-d", type=float, default=None,
+                   help="Realign subbands at this DM before summing")
+    p.add_argument("-2", dest="tempo2", action="store_true",
+                   help="tempo2 .tim output format")
+    p.add_argument("-o", type=str, default=None,
+                   help="Write TOAs to this file instead of stdout")
+    p.add_argument("pfdfiles", nargs="+")
+    return p
+
+
+def _load_template(path: str) -> np.ndarray:
+    if path.endswith(".bestprof"):
+        from presto_tpu.io.bestprof import read_bestprof
+        return read_bestprof(path).profile
+    return np.loadtxt(path, usecols=(-1,))
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    template = _load_template(args.t) if args.t else None
+    all_toas = []
+    name = "unk"
+    for path in args.pfdfiles:
+        p = read_pfd(path)
+        name = p.candnm or name
+        fold_dm = p.bestdm if args.d is not None else None
+        all_toas.extend(toas_from_pfd(
+            p, template=template, ntoa=args.n, dm=args.d,
+            fold_dm=fold_dm, gauss_fwhm=args.g))
+    if args.o:
+        write_tim(args.o, all_toas, name=name,
+                  fmt="tempo2" if args.tempo2 else "princeton")
+    else:
+        if args.tempo2:
+            print("FORMAT 1")
+        for t in all_toas:
+            line = (format_tempo2(t, name) if args.tempo2
+                    else format_princeton(t, name))
+            print(line)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

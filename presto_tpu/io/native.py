@@ -119,6 +119,14 @@ def decode_spectra(raw: np.ndarray, nspec: int, nifs: int, nchan: int,
     return out
 
 
+def can_decode_subint(npol: int, nchan: int, nbits: int) -> bool:
+    """Cheap predicate: native decode_subint supports this geometry.
+    Lets callers skip gathering scale/offset/weight columns when the
+    NumPy fallback would be used anyway."""
+    return (_load() is not None and nbits in (1, 2, 4, 8)
+            and (npol * nchan * nbits) % 8 == 0)
+
+
 def decode_subint(raw: np.ndarray, nspec: int, npol: int, nchan: int,
                   nbits: int, zero_off: float,
                   scl: Optional[np.ndarray], offs: Optional[np.ndarray],
@@ -140,6 +148,13 @@ def decode_subint(raw: np.ndarray, nspec: int, npol: int, nchan: int,
     scl = None if scl is None else np.ascontiguousarray(scl, np.float32)
     offs = None if offs is None else np.ascontiguousarray(offs, np.float32)
     wts = None if wts is None else np.ascontiguousarray(wts, np.float32)
+    # C reads scl/offs[0:npol*nchan] and wts[0:nchan]: short arrays
+    # (malformed TFORM repeat counts) must fall back to the NumPy path,
+    # which raises loudly instead of reading out of bounds
+    if any(a is not None and a.size < npol * nchan for a in (scl, offs)):
+        return None
+    if wts is not None and wts.size < nchan:
+        return None
     out = np.empty((nspec, nchan), np.float32)
     lib.pt_decode_subint(_u8ptr(raw), nspec, npol, nchan, nbits,
                          float(zero_off), _f32ptr(scl), _f32ptr(offs),

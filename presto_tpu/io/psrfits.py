@@ -237,22 +237,26 @@ class PsrfitsFile:
         return self._row_start_spec_uncached(fi, row)
 
     # -- decoding -----------------------------------------------------
+    def _pol_mode(self) -> int:
+        """Polarization handling shared by the native and NumPy decode
+        paths: >=0 select that pol, -2 sum the first two (AA+BB)."""
+        if self.npol == 1:
+            return 0
+        sum_polns = (self.poln_order.startswith("AABB")
+                     or self.npol == 2)
+        if self.use_poln > 0 or (self.npol > 2 and not sum_polns):
+            return max(self.use_poln - 1, 0)
+        return -2
+
     def _decode_row_native(self, sub, raw: np.ndarray,
                            row: int) -> Optional[np.ndarray]:
         """Fused C++ subint decode (csrc/native_io.cpp pt_decode_subint);
         None when the native library or this geometry is unsupported
         (16/32-bit stays on the NumPy path)."""
-        if self.nbits not in (1, 2, 4, 8):
+        if not native.can_decode_subint(self.npol, self.nchan,
+                                        self.nbits):
             return None
-        if self.npol > 1:
-            sum_polns = (self.poln_order.startswith("AABB")
-                         or self.npol == 2)
-            if self.use_poln > 0 or (self.npol > 2 and not sum_polns):
-                pol_mode = max(self.use_poln - 1, 0)
-            else:
-                pol_mode = -2
-        else:
-            pol_mode = 0
+        pol_mode = self._pol_mode()
         scl = offs = wts = None
         if self.apply_scale:
             scl = np.asarray(sub.read_col("DAT_SCL", row), np.float32)
@@ -278,14 +282,13 @@ class PsrfitsFile:
         nspec = self.nsblk
         data = np.asarray(samples, np.float32).reshape(
             nspec, self.npol, self.nchan)
+        pol_mode = self._pol_mode()
         if self.npol > 1:
-            sum_polns = (self.poln_order.startswith("AABB")
-                         or self.npol == 2)
-            if self.use_poln > 0 or (self.npol > 2 and not sum_polns):
-                pol = max(self.use_poln - 1, 0)
-                data = data[:, pol:pol + 1, :]
-                polsl = slice(pol * self.nchan, (pol + 1) * self.nchan)
-            else:
+            if pol_mode >= 0:
+                data = data[:, pol_mode:pol_mode + 1, :]
+                polsl = slice(pol_mode * self.nchan,
+                              (pol_mode + 1) * self.nchan)
+            else:                              # -2: sum AA+BB
                 data = data[:, :2, :]
                 polsl = slice(0, 2 * self.nchan)
         else:

@@ -1,0 +1,13 @@
+"""Pulse timing: template matching (FFTFIT) and TOA extraction.
+
+The reference implements this as the f2py-wrapped Fortran fftfit
+(python/fftfit_src/*.f, Taylor 1992) driven by bin/get_TOAs.py; here it
+is a NumPy/JAX-friendly reimplementation of the same algorithm.
+"""
+
+from presto_tpu.timing.fftfit import FFTFitResult, fftfit, gaussian_template
+from presto_tpu.timing.toas import TOA, format_princeton, format_tempo2, \
+    toas_from_pfd
+
+__all__ = ["FFTFitResult", "fftfit", "gaussian_template", "TOA",
+           "toas_from_pfd", "format_princeton", "format_tempo2"]
