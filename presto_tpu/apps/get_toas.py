@@ -17,7 +17,6 @@ import numpy as np
 from presto_tpu.io.pfd import read_pfd
 from presto_tpu.timing import toas_from_pfd, format_princeton, \
     format_tempo2
-from presto_tpu.timing.toas import write_tim
 
 
 def build_parser():
@@ -48,25 +47,27 @@ def _load_template(path: str) -> np.ndarray:
 
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
+    from presto_tpu.astro.observatory import tempo1_site_code
     template = _load_template(args.t) if args.t else None
-    all_toas = []
-    name = "unk"
+    lines = []
+    if args.tempo2:
+        lines.append("FORMAT 1")
     for path in args.pfdfiles:
         p = read_pfd(path)
-        name = p.candnm or name
+        name = p.candnm or "unk"
+        obs = tempo1_site_code(p.telescope)
         fold_dm = p.bestdm if args.d is not None else None
-        all_toas.extend(toas_from_pfd(
+        toas = toas_from_pfd(
             p, template=template, ntoa=args.n, dm=args.d,
-            fold_dm=fold_dm, gauss_fwhm=args.g))
+            fold_dm=fold_dm, gauss_fwhm=args.g, obs=obs)
+        for t in toas:
+            lines.append(format_tempo2(t, name) if args.tempo2
+                         else format_princeton(t, name))
     if args.o:
-        write_tim(args.o, all_toas, name=name,
-                  fmt="tempo2" if args.tempo2 else "princeton")
+        with open(args.o, "w") as fh:
+            fh.write("\n".join(lines) + "\n")
     else:
-        if args.tempo2:
-            print("FORMAT 1")
-        for t in all_toas:
-            line = (format_tempo2(t, name) if args.tempo2
-                    else format_princeton(t, name))
+        for line in lines:
             print(line)
     return 0
 

@@ -51,6 +51,8 @@ def build_parser():
     p.add_argument("-nsub", type=int, default=32)
     p.add_argument("-npfact", type=int, default=1)
     p.add_argument("-ndmfact", type=int, default=2)
+    p.add_argument("-noplot", "-noxwin", action="store_true",
+                   help="Skip the diagnostic plot")
     p.add_argument("-nosearch", action="store_true")
     p.add_argument("-nopsearch", action="store_true")
     p.add_argument("-nopdsearch", action="store_true")
@@ -265,6 +267,10 @@ def run(args):
     print("prepfold: folded %s  best p=%.9g s  pd=%.3g  DM=%.3f  "
           "redchi=%.2f -> %s" % (args.infile, res.best_p, res.best_pd,
                                  res.best_dm, res.best_redchi, pfdnm))
+    if not args.noplot:
+        from presto_tpu.plotting import plot_pfd
+        plot_pfd(pfd, pfdnm + ".png", best_prof=res.best_prof)
+        print("prepfold: diagnostic plot -> %s.png" % pfdnm)
     return res
 
 

@@ -31,6 +31,8 @@ def build_parser():
                    help="Channels to zap, e.g. '0:3,45'")
     p.add_argument("-zapints", type=str, default=None)
     p.add_argument("-clip", type=float, default=6.0)
+    p.add_argument("-noplot", action="store_true",
+                   help="Skip the mask summary plot")
     p.add_argument("rawfiles", nargs="+")
     return p
 
@@ -65,6 +67,10 @@ def run(args):
     print("rfifind: %d ints x %d chans, %.1f%% masked -> %s_rfifind.mask"
           % (res.mask.numint, res.mask.numchan,
              100 * res.masked_fraction(), outbase))
+    if not getattr(args, "noplot", False):
+        from presto_tpu.plotting import plot_rfifind
+        plot_rfifind(res, outbase + "_rfifind.png")
+        print("rfifind: mask plot -> %s_rfifind.png" % outbase)
     return res
 
 

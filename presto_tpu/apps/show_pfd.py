@@ -1,0 +1,39 @@
+"""show_pfd: re-render a .pfd file's diagnostic plot (src/show_pfd.c).
+
+The reference re-creates the prepfold plot (and optionally modified
+versions) from a saved .pfd; here it renders the matplotlib multi-panel
+plot to <root>.png (or -o path).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+from presto_tpu.io.pfd import read_pfd
+
+
+def build_parser():
+    p = argparse.ArgumentParser(prog="show_pfd")
+    p.add_argument("-o", type=str, default=None,
+                   help="Output image (single input only); default "
+                        "<input>.png")
+    p.add_argument("pfdfiles", nargs="+")
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    from presto_tpu.plotting import plot_pfd
+    if args.o and len(args.pfdfiles) > 1:
+        raise SystemExit("-o only valid with a single .pfd input")
+    for f in args.pfdfiles:
+        out = args.o or (os.path.splitext(f)[0] + ".png")
+        plot_pfd(read_pfd(f), out)
+        print("show_pfd: %s -> %s" % (f, out))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

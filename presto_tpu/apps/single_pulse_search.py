@@ -38,6 +38,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("-d", "--detrendfact", type=int, default=1,
                    choices=[1, 2, 4, 8, 16, 32],
                    help="Detrend chunk size in 1000s of samples")
+    p.add_argument("-p", "--noplot", action="store_true",
+                   help="Skip the summary plot (reference -noplot)")
     p.add_argument("datfiles", nargs="+")
     return p
 
@@ -78,7 +80,19 @@ def run(args) -> list:
 
 def main(argv=None):
     args = build_parser().parse_args(argv)
-    run(args)
+    allcands = run(args)
+    if not args.noplot and allcands:
+        from presto_tpu.plotting import plot_singlepulse
+        base = args.datfiles[0]
+        for suf in (".dat", ".singlepulse"):
+            if base.endswith(suf):
+                base = base[:-len(suf)]
+        out = base + "_singlepulse.png"
+        plot_singlepulse(allcands, out,
+                         title="%s (%d events)" % (base,
+                                                   len(allcands)))
+        print("single_pulse_search: summary plot -> %s" % out)
+    return 0
 
 
 if __name__ == "__main__":

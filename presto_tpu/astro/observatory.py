@@ -66,6 +66,22 @@ _NAME_TO_CODE = {
 }
 
 
+# TEMPO one-character TOA site codes (tempo obsys.dat column; the
+# reference's get_TOAs.py carries the same name->digit map)
+_TEMPO1_SITE = {
+    "GB": "1", "AO": "3", "VL": "6", "PK": "7", "JB": "8",
+    "G1": "a", "NC": "f", "EF": "g", "WT": "i", "FA": "k",
+    "MK": "m", "GM": "r", "LF": "t", "CH": "y", "EC": "@",
+}
+
+
+def tempo1_site_code(name) -> str:
+    """Telescope name -> 1-char TEMPO TOA site code ('@' = barycenter
+    for unknown/geocenter, matching the reference's fallback)."""
+    code = _NAME_TO_CODE.get(str(name).strip().lower())
+    return _TEMPO1_SITE.get(code, "@") if code else "@"
+
+
 def telescope_to_tempocode(name):
     """Telescope name -> (2-letter code, nice name); unknown -> EC
     (same fallback as misc_utils.c:246-250)."""

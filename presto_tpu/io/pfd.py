@@ -96,6 +96,15 @@ class Pfd:
         default_factory=lambda: np.zeros((1, 1, 7)))
 
 
+def pfd_subfreqs(p: Pfd) -> np.ndarray:
+    """Subband center frequencies (MHz), ascending: lofreq is the
+    CENTER of the lowest channel (infodata convention, makeinf.h)."""
+    chan_per_sub = max(p.numchan // max(p.nsub, 1), 1)
+    sub_bw = chan_per_sub * p.chan_wid
+    lo_edge = p.lofreq - 0.5 * p.chan_wid
+    return lo_edge + (np.arange(p.nsub) + 0.5) * sub_bw
+
+
 def write_pfd(path: str, p: Pfd) -> None:
     with open(path, "wb") as f:
         f.write(struct.pack("<5i", p.numdms, p.numperiods, p.numpdots,

@@ -1,0 +1,21 @@
+"""Diagnostic plotting (matplotlib replaces the reference's PGPLOT).
+
+The reference renders its diagnostics in C against PGPLOT
+(src/prepfold_plot.c, src/rfifind_plot.c, xyline.c/powerplot.c) and in
+Python via ppgplot (single-pulse plots, sp_pgplot.py).  Per SURVEY.md
+§7.4 the rebuild uses matplotlib; every entry point here takes data
+objects (Pfd, RfifindResult, SpdData, event lists) and writes a PNG/PS
+file, headless (Agg).
+"""
+
+import matplotlib
+
+matplotlib.use("Agg")
+
+from presto_tpu.plotting.pfdplot import plot_pfd          # noqa: E402
+from presto_tpu.plotting.rfiplot import plot_rfifind      # noqa: E402
+from presto_tpu.plotting.spplot import plot_spd, plot_singlepulse  # noqa: E402
+from presto_tpu.plotting.accelplot import plot_ffdot      # noqa: E402
+
+__all__ = ["plot_pfd", "plot_rfifind", "plot_spd",
+           "plot_singlepulse", "plot_ffdot"]

@@ -1,0 +1,122 @@
+"""prepfold diagnostic plot (src/prepfold_plot.c analog).
+
+The famous multi-panel .pfd plot: best profile over two periods,
+time-vs-phase and subband-vs-phase greyscales, reduced-chi^2 vs DM, and
+the candidate info block.  Input is the Pfd container (io/pfd.py) as
+written by apps/prepfold or read back from disk.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from presto_tpu.io.pfd import Pfd
+from presto_tpu.ops.fold import profile_redchi
+
+
+def _two_periods(prof: np.ndarray) -> np.ndarray:
+    return np.concatenate([prof, prof])
+
+
+def _expected_stats(p: Pfd):
+    """Expected (avg, var) per profile bin of the fully summed profile,
+    from the per-(part,sub) fold stats (fold.c:655-660 convention:
+    stats rows are (numdata, data_avg, data_var, ...))."""
+    numdata = np.asarray(p.stats[:, :, 0], float)
+    data_avg = np.asarray(p.stats[:, :, 1], float)
+    data_var = np.asarray(p.stats[:, :, 2], float)
+    prof_avg = float((data_avg * numdata).sum() / p.proflen)
+    prof_var = float((data_var * numdata).sum() / p.proflen)
+    return prof_avg, prof_var
+
+
+def _dm_chi2_curve(p: Pfd, svph: np.ndarray) -> np.ndarray:
+    """Reduced chi^2 of the summed profile at each trial DM, rotating
+    subbands from the fold DM (prepfold_plot.c DM curve semantics)."""
+    from presto_tpu.io.pfd import pfd_subfreqs
+    from presto_tpu.ops.fold import combine_profs, subband_fold_shifts
+
+    subfreqs = pfd_subfreqs(p)
+    prof_avg, prof_var = _expected_stats(p)
+    chis = np.zeros(len(p.dms))
+    for i, dm in enumerate(np.asarray(p.dms, float)):
+        shifts = subband_fold_shifts(subfreqs, dm, p.bestdm,
+                                     p.fold_p1, p.proflen)
+        prof = np.asarray(combine_profs(svph, shifts))
+        if prof_var > 0:
+            chis[i] = profile_redchi(prof, prof_avg, prof_var)
+        elif prof.var() > 0:        # no stats stored: normalize shape
+            chis[i] = profile_redchi(prof, prof.mean(), prof.var())
+    return chis
+
+
+def plot_pfd(p: Pfd, outfile: str,
+             best_prof: Optional[np.ndarray] = None) -> str:
+    import matplotlib.pyplot as plt
+
+    profs = np.asarray(p.profs, float)          # [npart, nsub, proflen]
+    npart, nsub, proflen = profs.shape
+    tvph = profs.sum(axis=1)                    # [npart, proflen]
+    svph = profs.sum(axis=0)                    # [nsub, proflen]
+    if best_prof is None:
+        best_prof = profs.sum(axis=(0, 1))
+
+    fig = plt.figure(figsize=(10, 7.5))
+    gs = fig.add_gridspec(3, 3, hspace=0.45, wspace=0.35)
+
+    ax = fig.add_subplot(gs[0, :2])
+    x = np.arange(2 * proflen) / proflen
+    ax.plot(x, _two_periods(best_prof), "k-", lw=1)
+    ax.set_xlim(0, 2)
+    ax.set_xlabel("Phase")
+    ax.set_ylabel("Counts")
+    ax.set_title("2 pulses of best profile")
+
+    ax = fig.add_subplot(gs[1:, 0])
+    ax.imshow(tvph, aspect="auto", origin="lower", cmap="viridis",
+              extent=[0, 1, 0, npart])
+    ax.set_xlabel("Phase")
+    ax.set_ylabel("Sub-integration")
+    ax.set_title("Time vs Phase")
+
+    ax = fig.add_subplot(gs[1:, 1])
+    ax.imshow(svph, aspect="auto", origin="lower", cmap="viridis",
+              extent=[0, 1, 0, nsub])
+    ax.set_xlabel("Phase")
+    ax.set_ylabel("Subband")
+    ax.set_title("Freq vs Phase")
+
+    ax = fig.add_subplot(gs[1, 2])
+    dms = np.asarray(p.dms, float)
+    if dms.size > 1 and nsub > 1:
+        ax.plot(dms, _dm_chi2_curve(p, svph), "k-")
+    ax.set_xlabel("DM (pc cm$^{-3}$)")
+    ax.set_ylabel(r"Reduced $\chi^2$")
+    ax.set_title("DM curve")
+
+    ax = fig.add_subplot(gs[0, 2])
+    ax.axis("off")
+    prof_avg, prof_var = _expected_stats(p)
+    if prof_var <= 0:               # no stats stored: normalize shape
+        prof_avg, prof_var = best_prof.mean(), best_prof.var()
+    redchi = (profile_redchi(best_prof, prof_avg, prof_var)
+              if prof_var > 0 else 0.0)
+    info = [
+        "Cand: %s" % (p.candnm or "?"),
+        "Telescope: %s" % p.telescope,
+        "Epoch$_{topo}$ = %.9f" % p.tepoch,
+        "f = %.9g Hz" % p.fold_p1,
+        "fd = %.4g" % p.fold_p2,
+        "DM = %.3f" % p.bestdm,
+        r"$\chi^2_{red}$ = %.2f" % float(np.atleast_1d(redchi)[0]),
+    ]
+    ax.text(0.0, 0.95, "\n".join(info), va="top", fontsize=9,
+            family="monospace")
+
+    fig.suptitle("%s  (%s)" % (p.candnm or p.filenm, "presto_tpu"),
+                 fontsize=11)
+    fig.savefig(outfile, dpi=100)
+    plt.close(fig)
+    return outfile

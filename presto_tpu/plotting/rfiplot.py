@@ -1,0 +1,68 @@
+"""rfifind mask summary plot (src/rfifind_plot.c analog).
+
+Panels: per-(interval x channel) mean/std/max-power images, the
+resulting mask (zapped cells), and per-channel / per-interval zap
+fractions.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def plot_rfifind(result, outfile: str) -> str:
+    """result: search.rfifind.RfifindResult (datapow/dataavg/datastd
+    [nint, nchan] + mask)."""
+    import matplotlib.pyplot as plt
+
+    avg = np.asarray(result.dataavg, float)
+    std = np.asarray(result.datastd, float)
+    pow_ = np.asarray(result.datapow, float)
+    nint, nchan = avg.shape
+    if getattr(result, "bytemask", None) is not None:
+        zap = np.asarray(result.bytemask) != 0
+    else:
+        m = result.mask
+        zap = np.zeros((nint, nchan), bool)
+        for i, chans in enumerate(m.chans_per_int[:nint]):
+            zap[i, np.asarray(chans, int)] = True
+        zap[:, np.asarray(m.zap_chans, int)] = True
+        zap[np.asarray(m.zap_ints, int), :] = True
+
+    fig, axes = plt.subplots(2, 3, figsize=(12, 7))
+    for ax, img, title in (
+            (axes[0, 0], avg, "Mean"),
+            (axes[0, 1], std, "Std dev"),
+            (axes[0, 2], np.log10(np.maximum(pow_, 1e-12)),
+             "log10 max power")):
+        im = ax.imshow(img, aspect="auto", origin="lower",
+                       cmap="viridis",
+                       extent=[0, nchan, 0, nint])
+        ax.set_xlabel("Channel")
+        ax.set_ylabel("Interval")
+        ax.set_title(title)
+        fig.colorbar(im, ax=ax, shrink=0.8)
+
+    ax = axes[1, 0]
+    ax.imshow(zap, aspect="auto", origin="lower", cmap="Reds",
+              extent=[0, nchan, 0, nint], vmin=0, vmax=1)
+    ax.set_xlabel("Channel")
+    ax.set_ylabel("Interval")
+    ax.set_title("Mask (%.1f%% zapped)" % (100 * zap.mean()))
+
+    ax = axes[1, 1]
+    ax.plot(np.arange(nchan), zap.mean(axis=0), "k-", lw=1)
+    ax.set_xlabel("Channel")
+    ax.set_ylabel("Zapped fraction")
+    ax.set_ylim(-0.02, 1.02)
+
+    ax = axes[1, 2]
+    ax.plot(np.arange(nint), zap.mean(axis=1), "k-", lw=1)
+    ax.set_xlabel("Interval")
+    ax.set_ylabel("Zapped fraction")
+    ax.set_ylim(-0.02, 1.02)
+
+    fig.tight_layout()
+    fig.savefig(outfile, dpi=100)
+    plt.close(fig)
+    return outfile

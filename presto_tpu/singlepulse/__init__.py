@@ -1,0 +1,18 @@
+"""Single-pulse diagnostic toolchain.
+
+The reference ships this as lib/python/singlepulse/ (spcand.py, spio.py,
+make_spd.py, plot_spd.py, rrattrap.py, bary_and_topo.py) plus
+bin/waterfaller.py — grouping/rating of .singlepulse events across DM
+trials (the "RRAT trap"), candidate cutout waterfalls, and the .spd
+diagnostic bundle.  The search itself lives in
+presto_tpu.search.singlepulse; this package is the downstream analysis.
+"""
+
+from presto_tpu.singlepulse.grouping import (SinglePulseGroup,
+                                             group_candidates,
+                                             rank_groups)
+from presto_tpu.singlepulse.spd import SpdData, make_spd, read_spd
+from presto_tpu.singlepulse.waterfaller import waterfall
+
+__all__ = ["SinglePulseGroup", "group_candidates", "rank_groups",
+           "waterfall", "SpdData", "make_spd", "read_spd"]

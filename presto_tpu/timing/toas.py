@@ -17,11 +17,12 @@ from typing import List, Optional, Sequence
 
 import numpy as np
 
-from presto_tpu.io.pfd import Pfd
-from presto_tpu.ops.fold import combine_profs, subband_fold_shifts
+from presto_tpu.io.pfd import Pfd, pfd_subfreqs
+from presto_tpu.ops.fold import combine_subbands, subband_fold_shifts
 from presto_tpu.timing.fftfit import fftfit, gaussian_template
 
 SECPERDAY = 86400.0
+
 
 
 @dataclass
@@ -37,15 +38,6 @@ class TOA:
     @property
     def mjd(self) -> float:
         return self.mjdi + self.mjdf
-
-
-def _pfd_subfreqs(p: Pfd) -> np.ndarray:
-    """Subband center frequencies (MHz): lofreq is the CENTER of the
-    lowest channel (infodata convention, makeinf.h)."""
-    chan_per_sub = max(p.numchan // max(p.nsub, 1), 1)
-    sub_bw = chan_per_sub * p.chan_wid
-    lo_edge = p.lofreq - 0.5 * p.chan_wid
-    return lo_edge + (np.arange(p.nsub) + 0.5) * sub_bw
 
 
 def _fold_phase(t: float, f: float, fd: float, fdd: float) -> float:
@@ -75,12 +67,16 @@ def toas_from_pfd(p: Pfd, template: Optional[np.ndarray] = None,
     if f <= 0:
         raise ValueError("pfd has no fold frequency (fold_p1)")
 
+    # the fold cube is dedispersed referenced to the HIGHEST channel
+    # (dedisp_delays/subband_fold_shifts zero the delay at the band
+    # top), so TOAs are quoted at that frequency — get_TOAs.py keeps
+    # the same frame via its sumsubdelays correction
+    freq_ref = p.lofreq + (p.numchan - 1) * p.chan_wid
     if nsub > 1 and dm is not None and fold_dm is not None:
-        subfreqs = _pfd_subfreqs(p)
-        shifts = subband_fold_shifts(subfreqs, dm, fold_dm, f, proflen)
-        part_profs = np.stack([
-            np.asarray(combine_profs(profs[i], shifts))
-            for i in range(npart)])
+        subfreqs = pfd_subfreqs(p)
+        shifts = subband_fold_shifts(subfreqs, dm, fold_dm, f, proflen,
+                                     ref_freq=freq_ref)
+        part_profs = np.asarray(combine_subbands(profs, shifts))
     else:
         part_profs = profs.sum(axis=1)          # [npart, proflen]
 
@@ -96,7 +92,6 @@ def toas_from_pfd(p: Pfd, template: Optional[np.ndarray] = None,
 
     ntoa = max(1, min(ntoa, npart))
     per = npart // ntoa
-    freq_mid = p.lofreq + 0.5 * (p.numchan - 1) * p.chan_wid
 
     out: List[TOA] = []
     for g in range(ntoa):
@@ -118,7 +113,7 @@ def toas_from_pfd(p: Pfd, template: Optional[np.ndarray] = None,
         mjdf -= carry
         out.append(TOA(mjdi=mjdi, mjdf=float(mjdf),
                        err_us=fit.eshift / f_inst * 1e6,
-                       freq_mhz=freq_mid, obs=obs, snr=fit.snr,
+                       freq_mhz=freq_ref, obs=obs, snr=fit.snr,
                        shift=fit.shift))
     return out
 
