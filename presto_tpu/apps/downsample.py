@@ -1,0 +1,44 @@
+"""downsample: average a .dat time series by an integer factor
+(src/downsample.c parity: writes <root>_DS<fact>.dat + .inf).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+import numpy as np
+
+from presto_tpu.io import datfft
+from presto_tpu.io.infodata import read_inf, write_inf
+
+
+def downsample_series(data: np.ndarray, fact: int) -> np.ndarray:
+    keep = (len(data) // fact) * fact
+    return data[:keep].reshape(-1, fact).mean(axis=1).astype(np.float32)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="downsample")
+    p.add_argument("-f", "--factor", type=int, default=2)
+    p.add_argument("datfile")
+    args = p.parse_args(argv)
+    base = os.path.splitext(args.datfile)[0]
+    data = datfft.read_dat(args.datfile)
+    out = downsample_series(data, args.factor)
+    outbase = "%s_DS%d" % (base, args.factor)
+    datfft.write_dat(outbase + ".dat", out)
+    if os.path.exists(base + ".inf"):
+        info = read_inf(base + ".inf")
+        info.name = outbase
+        info.N = len(out)
+        info.dt = info.dt * args.factor
+        write_inf(info, outbase + ".inf")
+    print("downsample: %s x%d -> %s.dat (%d pts)"
+          % (args.datfile, args.factor, outbase, len(out)))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

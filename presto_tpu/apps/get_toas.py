@@ -15,8 +15,7 @@ import sys
 import numpy as np
 
 from presto_tpu.io.pfd import read_pfd
-from presto_tpu.timing import toas_from_pfd, format_princeton, \
-    format_tempo2
+from presto_tpu.timing import toas_from_pfd
 
 
 def build_parser():
@@ -48,21 +47,20 @@ def _load_template(path: str) -> np.ndarray:
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
     from presto_tpu.astro.observatory import tempo1_site_code
+    from presto_tpu.timing.toas import format_tim_lines
     template = _load_template(args.t) if args.t else None
-    lines = []
-    if args.tempo2:
-        lines.append("FORMAT 1")
+    fmt = "tempo2" if args.tempo2 else "princeton"
+    all_toas, names = [], []
     for path in args.pfdfiles:
         p = read_pfd(path)
-        name = p.candnm or "unk"
-        obs = tempo1_site_code(p.telescope)
         fold_dm = p.bestdm if args.d is not None else None
         toas = toas_from_pfd(
             p, template=template, ntoa=args.n, dm=args.d,
-            fold_dm=fold_dm, gauss_fwhm=args.g, obs=obs)
-        for t in toas:
-            lines.append(format_tempo2(t, name) if args.tempo2
-                         else format_princeton(t, name))
+            fold_dm=fold_dm, gauss_fwhm=args.g,
+            obs=tempo1_site_code(p.telescope))
+        all_toas.extend(toas)
+        names.extend([p.candnm or "unk"] * len(toas))
+    lines = format_tim_lines(all_toas, names, fmt)
     if args.o:
         with open(args.o, "w") as fh:
             fh.write("\n".join(lines) + "\n")

@@ -247,13 +247,27 @@ def run(args):
 
     outbase = args.outfile or os.path.splitext(args.infile)[0]
     pfdnm = outbase + ".pfd"
+    # re-align the stored cube at the search-optimized DM so a .pfd's
+    # bestdm is always the DM its profile cube is aligned at (what
+    # show_pfd's DM curve and get_TOAs' subband realignment assume)
+    if (res.nsub > 1 and res.subfreqs is not None
+            and res.best_dm != res.fold_dm):
+        from presto_tpu.ops.fold import shift_prof, subband_fold_shifts
+        shifts = subband_fold_shifts(
+            res.subfreqs, res.best_dm, res.fold_dm, res.fold_f,
+            res.proflen,
+            ref_freq=res.lofreq + (res.numchan - 1) * res.chan_wid)
+        for j in range(res.nsub):
+            for i in range(res.npart):
+                res.cube[i, j] = shift_prof(res.cube[i, j], shifts[j])
     pfd = Pfd(
         numdms=len(res.dms), numperiods=len(res.periods),
         numpdots=len(res.pdots), nsub=res.nsub, npart=res.npart,
         proflen=res.proflen, numchan=res.numchan, pstep=cfg.pstep,
         pdstep=cfg.pdstep, dmstep=cfg.dmstep, ndmfact=cfg.ndmfact,
         npfact=cfg.npfact, filenm=args.infile, candnm=candnm,
-        telescope="Unknown", pgdev=pfdnm + ".ps/CPS",
+        telescope=obs.get("telescope") or "Unknown",
+        pgdev=pfdnm + ".ps/CPS",
         dt=res.dt, startT=0.0, endT=1.0, tepoch=res.tepoch,
         lofreq=res.lofreq, chan_wid=res.chan_wid, bestdm=res.best_dm,
         topo_p1=res.best_p, topo_p2=res.best_pd,

@@ -1,0 +1,73 @@
+"""readfile: print header + first samples of any supported artifact
+(src/readfile.c parity for the supported formats: .fil/.fits raw data,
+.dat/.fft/.inf/.pfd/.bestprof/.singlepulse sidecars).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+import numpy as np
+
+
+def describe(path: str, nsamp: int = 8) -> str:
+    ext = os.path.splitext(path)[1].lower()
+    out = ["--- %s ---" % path]
+    if ext in (".fil", ".tim"):
+        from presto_tpu.io.sigproc import FilterbankFile
+        with FilterbankFile(path) as fb:
+            h = fb.header
+            for k in ("source_name", "telescope_id", "machine_id",
+                      "nchans", "nifs", "nbits", "tsamp", "tstart",
+                      "fch1", "foff", "N"):
+                out.append("  %-12s = %s" % (k, getattr(h, k)))
+            out.append("  first spectra:\n%s"
+                       % fb.read_spectra(0, min(nsamp, h.N)))
+    elif ext in (".fits", ".sf"):
+        from presto_tpu.io.psrfits import PsrfitsFile
+        with PsrfitsFile([path]) as pf:
+            h = pf.header
+            for k in ("source_name", "nchans", "nbits", "tsamp",
+                      "tstart", "fch1", "foff", "N"):
+                out.append("  %-12s = %s" % (k, getattr(h, k)))
+    elif ext == ".dat":
+        from presto_tpu.io.datfft import read_dat
+        d = read_dat(path)
+        out.append("  N=%d  mean=%.6g  std=%.6g" %
+                   (len(d), d.mean(), d.std()))
+        out.append("  first: %s" % d[:nsamp])
+    elif ext == ".fft":
+        from presto_tpu.io.datfft import read_fft
+        d = read_fft(path)                    # complex64 packed bins
+        out.append("  N=%d complex bins (NR-packed)" % len(d))
+        out.append("  DC=%.6g  Nyquist=%.6g" % (d[0].real, d[0].imag))
+    elif ext == ".inf":
+        out.append(open(path).read())
+    elif ext == ".pfd":
+        from presto_tpu.io.pfd import read_pfd
+        p = read_pfd(path)
+        out.append("  cand=%s  npart=%d nsub=%d proflen=%d  f=%.9g  "
+                   "DM=%.3f" % (p.candnm, p.npart, p.nsub, p.proflen,
+                                p.fold_p1, p.bestdm))
+    elif ext in (".bestprof", ".singlepulse", ".par", ".txtcand"):
+        out.append(open(path).read())
+    else:
+        raise SystemExit("readfile: unknown file type %r" % ext)
+    return "\n".join(out)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="readfile")
+    p.add_argument("-n", type=int, default=8,
+                   help="Samples/spectra to show")
+    p.add_argument("files", nargs="+")
+    args = p.parse_args(argv)
+    for f in args.files:
+        print(describe(f, args.n))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -151,13 +151,24 @@ def fold_subband_series(series: np.ndarray, dt: float, f: float,
     plan = fo.plan_fold(N, dt, f, fd, fdd, phs0=0.0,
                         proflen=cfg.proflen, npart=cfg.npart)
     cube = fo.fold_data(arr, plan)            # [npart, nsub, L]
+    # occupancy correction: when the fold frequency resonates with the
+    # sample grid (samples/period near an integer multiple of proflen),
+    # per-bin sample counts quantize unevenly and the DATA BASELINE
+    # imprints a step pattern ~avg*(count-N/L) that dwarfs real pulse
+    # structure and derails the chi2 search.  Folding a ones-array
+    # gives the exact per-bin occupancy; flatten the baseline to the
+    # uniform expectation (the chi2 model's assumption).
+    occ = fo.fold_data(np.ones(N, np.float32), plan)  # [npart, L]
     stats = np.zeros((cfg.npart, nsub, 7), dtype=np.float64)
     for p in range(cfg.npart):
         nd = plan.parts_numdata[p]
         lo = int(plan.parts_numdata[:p].sum())
         seg = arr[:, lo:lo + int(nd)]
+        occ_dev = occ[p] - nd / cfg.proflen
         for s in range(nsub):
-            st = fo.fold_stats(cube[p, s], nd, float(seg[s].mean()),
+            seg_avg = float(seg[s].mean())
+            cube[p, s] -= seg_avg * occ_dev
+            st = fo.fold_stats(cube[p, s], nd, seg_avg,
                                float(seg[s].var()))
             stats[p, s] = st.to_array()
     return FoldResult(cube=cube, stats=stats, fold_f=f, fold_fd=fd,

@@ -141,13 +141,20 @@ def format_tempo2(toa: TOA, name: str = "unk") -> str:
         name, toa.freq_mhz, toa.mjdi, frac[2:], toa.err_us, toa.obs)
 
 
-def write_tim(path: str, toas: Sequence[TOA], name: str = "unk",
+def format_tim_lines(toas: Sequence[TOA], names,
+                     fmt: str = "princeton") -> List[str]:
+    """.tim lines for TOAs; `names` is one name or a per-TOA sequence.
+    The single source of the .tim convention (CLI and write_tim)."""
+    if isinstance(names, str):
+        names = [names] * len(toas)
+    lines = ["FORMAT 1"] if fmt == "tempo2" else []
+    for t, nm in zip(toas, names):
+        lines.append(format_tempo2(t, nm) if fmt == "tempo2"
+                     else format_princeton(t, nm))
+    return lines
+
+
+def write_tim(path: str, toas: Sequence[TOA], name="unk",
               fmt: str = "princeton") -> None:
     with open(path, "w") as fh:
-        if fmt == "tempo2":
-            fh.write("FORMAT 1\n")
-            for t in toas:
-                fh.write(format_tempo2(t, name) + "\n")
-        else:
-            for t in toas:
-                fh.write(format_princeton(t, name) + "\n")
+        fh.write("\n".join(format_tim_lines(toas, name, fmt)) + "\n")

@@ -147,3 +147,39 @@ class TestPrepfoldSearch:
         perr, pderr = fold_errors(res)
         assert 0.0 < perr < 1e-3
         assert 0.0 <= pderr < 1e-5
+
+
+def test_resonant_fold_occupancy_correction():
+    """A fold frequency resonant with the sample grid (integer samples
+    per period AND per bin) must not imprint baseline count-steps on
+    the profiles — regression for the occupancy artifact that derailed
+    the (f, fd) search (chi2 chased per-part bin-count patterns of a
+    DC-heavy series instead of the pulse)."""
+    import numpy as np
+    from presto_tpu.search.prepfold import FoldConfig, \
+        fold_subband_series, search_fold
+    rng = np.random.default_rng(3)
+    N, dt = 32121, 5e-4            # NOT a multiple of the 256-sample
+    f = 7.8125                     # period: parts straddle periods
+    baseline = 1283.0
+    series = (baseline + rng.normal(0, 10, N)).astype(np.float32)
+    t = (np.arange(N) + 0.5) * dt
+    series += 40.0 * np.exp(-0.5 * ((((f * t) % 1.0) - 0.5) / 0.02) ** 2
+                            ).astype(np.float32)
+    cfg = FoldConfig(proflen=64, npart=8, nsub=1, search_p=True,
+                     search_pd=True, search_dm=False)
+    res = fold_subband_series(series[None, :], dt, f, 0.0, 0.0, cfg,
+                              fold_dm=0.0)
+    # profiles must be flat apart from the pulse: off-pulse peak-to-peak
+    # much smaller than the pulse amplitude
+    prof = res.cube.sum(axis=(0, 1))
+    onpulse = np.argmax(prof)
+    mask = np.ones(64, bool)
+    mask[(onpulse + np.arange(-3, 4)) % 64] = False
+    off_ptp = np.ptp(prof[mask])
+    pulse_amp = prof[onpulse] - np.median(prof[mask])
+    assert off_ptp < 0.3 * pulse_amp
+    # and the search must stay at the true parameters
+    res = search_fold(res, cfg)
+    assert abs(res.best_f - f) < 2e-3
+    assert abs(res.best_fd) < 1e-4

@@ -1,0 +1,189 @@
+"""Converters & small utilities (SURVEY §2.3 small-utils row)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from presto_tpu.io import datfft
+from presto_tpu.io.infodata import InfoData, read_inf, write_inf
+from presto_tpu.io.sigproc import FilterbankFile, FilterbankHeader, \
+    write_filterbank
+
+RNG = np.random.default_rng(21)
+
+
+def _dat(tmp_path, name="x", N=4096, dt=1e-3, with_inf=True,
+         data=None):
+    base = str(tmp_path / name)
+    if data is None:
+        data = RNG.normal(5, 1, N).astype(np.float32)
+    datfft.write_dat(base + ".dat", data)
+    if with_inf:
+        info = InfoData(name=base, telescope="GBT", N=len(data), dt=dt,
+                        freq=1400.0, chan_wid=1.0, num_chan=1,
+                        freqband=1.0, mjd_i=58000, mjd_f=0.25)
+        write_inf(info, base + ".inf")
+    return base, data
+
+
+def test_downsample(tmp_path):
+    from presto_tpu.apps.downsample import main
+    base, data = _dat(tmp_path)
+    assert main(["-f", "4", base + ".dat"]) == 0
+    out = datfft.read_dat(base + "_DS4.dat")
+    assert len(out) == len(data) // 4
+    np.testing.assert_allclose(out[0], data[:4].mean(), rtol=1e-6)
+    info = read_inf(base + "_DS4.inf")
+    assert abs(info.dt - 4e-3) < 1e-12
+
+
+def test_dat_tim_roundtrip(tmp_path):
+    from presto_tpu.apps.dat2tim import main as d2t
+    from presto_tpu.apps.tim2dat import main as t2d
+    base, data = _dat(tmp_path)
+    assert d2t([base + ".dat"]) == 0
+    assert os.path.exists(base + ".tim")
+    os.remove(base + ".dat")
+    os.remove(base + ".inf")
+    assert t2d([base + ".tim"]) == 0
+    out = datfft.read_dat(base + ".dat")
+    np.testing.assert_array_equal(out, data)
+    info = read_inf(base + ".inf")
+    assert abs(info.mjd - 58000.25) < 1e-9
+    assert info.dt == 1e-3
+
+
+def test_psrfits2fil(tmp_path):
+    from presto_tpu.apps.psrfits2fil import main
+    from presto_tpu.io.psrfits import write_psrfits
+    nchan, nspec = 8, 256
+    data = RNG.uniform(0, 100, (nspec, nchan)).astype(np.float32)
+    fits = str(tmp_path / "t.fits")
+    write_psrfits(fits, data, dt=1e-3,
+                  freqs=1400.0 - np.arange(nchan), nsblk=64, nbits=8)
+    out = str(tmp_path / "t.fil")
+    assert main(["-o", out, fits]) == 0
+    with FilterbankFile(out) as fb:
+        assert fb.header.nchans == nchan
+        assert fb.header.N == nspec
+        blk = fb.read_spectra(0, nspec)
+    # requantized: correlation with the original must be high
+    a = blk.ravel() - blk.mean()
+    with np.errstate(all="ignore"):
+        from presto_tpu.io.psrfits import PsrfitsFile
+        with PsrfitsFile([fits]) as pf:
+            orig = pf.read_spectra(0, nspec)
+    b = orig.ravel() - orig.mean()
+    r = (a * b).sum() / np.sqrt((a * a).sum() * (b * b).sum())
+    assert r > 0.99
+
+
+def test_fb_truncate(tmp_path):
+    from presto_tpu.apps.fb_truncate import main
+    nchan, N, dt = 16, 1024, 1e-3
+    data = RNG.uniform(0, 200, (N, nchan)).astype(np.float32)
+    hdr = FilterbankHeader(nchans=nchan, nifs=1, nbits=8, tsamp=dt,
+                           fch1=415.0, foff=-1.0, tstart=58000.0,
+                           source_name="T")
+    inp = str(tmp_path / "a.fil")
+    write_filterbank(inp, hdr, np.clip(data, 0, 255))
+    out = str(tmp_path / "b.fil")
+    assert main(["-L", "0.1", "-R", "0.6", "-B", "405.0", "-T",
+                 "410.0", "-o", out, inp]) == 0
+    with FilterbankFile(out) as fb:
+        h = fb.header
+        assert h.nchans == 6            # 405..410 inclusive
+        assert h.N == 500
+        assert abs(h.lofreq - 405.0) < 1e-9
+        assert abs(h.tstart - (58000.0 + 0.1 / 86400.0)) < 1e-12
+
+
+def test_quicklook_finds_tone(tmp_path, capsys):
+    from presto_tpu.apps.quicklook import main
+    N, dt, f0 = 4096, 1e-3, 50.0
+    t = np.arange(N) * dt
+    data = (np.sin(2 * np.pi * f0 * t) * 5 +
+            RNG.normal(0, 1, N)).astype(np.float32)
+    base, _ = _dat(tmp_path, "tone", data=data)
+    assert main([base + ".dat"]) == 0
+    out = capsys.readouterr().out
+    top = out.strip().splitlines()[2].split()
+    assert abs(float(top[1]) - f0) < 0.5
+
+
+def test_dftfold_phase_and_power(tmp_path):
+    from presto_tpu.apps.dftfold import dft_at
+    N, dt, f0 = 8192, 1e-3, 25.0
+    t = np.arange(N) * dt
+    data = np.cos(2 * np.pi * f0 * t).astype(np.float32)
+    amp, phase, norm = dft_at(data, dt, f0)
+    assert abs(amp - N / 2) < 1.0       # coherent sum
+    assert norm > 100                    # wildly significant
+    _, _, norm_off = dft_at(data, dt, f0 * 1.37)
+    assert norm_off < 5
+
+
+def test_rednoise_cli(tmp_path):
+    from presto_tpu.apps.rednoise import main
+    # strongly red spectrum: 1/f amplitudes + flat tail
+    n = 1 << 12
+    amps = (RNG.normal(0, 1, 2 * n).astype(np.float32)
+            .view(np.complex64))
+    amps[1:] *= (1.0 / np.sqrt(np.arange(1, n))).astype(np.float32) * 30 + 1
+    base = str(tmp_path / "red")
+    datfft.write_fft(base + ".fft", amps)
+    assert main([base + ".fft"]) == 0
+    out = datfft.read_fft(base + "_red.fft")
+    pow_in = np.abs(amps[10:]) ** 2
+    pow_out = np.abs(out[10:]) ** 2
+    # whitened: low-freq excess removed -> flat median level
+    lo_in = np.median(pow_in[:100]) / np.median(pow_in[-100:])
+    lo_out = np.median(pow_out[:100]) / np.median(pow_out[-100:])
+    assert lo_in > 10
+    assert lo_out < 3
+
+
+def test_timeconv_roundtrip(capsys):
+    from presto_tpu.apps.timeconv import main
+    assert main(["mjd2cal", "58849.5"]) == 0
+    out = capsys.readouterr().out
+    assert "2020-01-01 12:00" in out
+    assert main(["cal2mjd", "2020", "1", "1", "12"]) == 0
+    out = capsys.readouterr().out
+    assert "58849.5" in out
+
+
+def test_datutils_shift_patch_sdat_toas(tmp_path):
+    from presto_tpu.apps.datutils import (dat2sdat, patchdata,
+                                          sdat2dat, shiftdata, toas2dat)
+    base, data = _dat(tmp_path, with_inf=False)
+    # shift by whole bins is exact
+    s = shiftdata(base + ".dat", 3.0)
+    np.testing.assert_allclose(datfft.read_dat(s),
+                               np.roll(data, 3), rtol=1e-6)
+    # patch: region replaced by local median
+    ppath = patchdata(base + ".dat", 100, 200)
+    patched = datfft.read_dat(ppath)
+    assert np.all(patched[100:200] == patched[100])
+    assert np.array_equal(patched[:100], data[:100])
+    # sdat roundtrip within quantization error
+    sd = dat2sdat(base + ".dat")
+    back = datfft.read_dat(sdat2dat(sd))
+    span = data.max() - data.min()
+    assert np.abs(back - data).max() < span / 65000.0 * 2
+    # toas2dat: events land in the right bins
+    toafile = str(tmp_path / "ev.txt")
+    np.savetxt(toafile, [0.0105, 0.0105, 0.5001])
+    out = toas2dat(toafile, dt=1e-3, numout=1000)
+    d = datfft.read_dat(out)
+    assert d[10] == 2.0 and d[500] == 1.0 and d.sum() == 3.0
+
+
+def test_readfile_cli(tmp_path, capsys):
+    from presto_tpu.apps.readfile import main
+    base, _ = _dat(tmp_path)
+    assert main([base + ".dat", base + ".inf"]) == 0
+    out = capsys.readouterr().out
+    assert "N=4096" in out
+    assert "Telescope" in out
