@@ -22,8 +22,12 @@ def dat_to_tim(datfile: str, outfile: str = "") -> str:
     outfile = outfile or base + ".tim"
     data = datfft.read_dat(datfile)
     info = read_inf(base + ".inf")
+    from presto_tpu.apps.common import SIGPROC_TELESCOPES
+    name_to_id = {v.lower(): k for k, v in SIGPROC_TELESCOPES.items()}
     hdr = FilterbankHeader(
         source_name=info.object or "unknown", data_type=2,
+        telescope_id=name_to_id.get(
+            (info.telescope or "").strip().lower(), 0),
         fch1=info.freq + (info.num_chan - 1) * info.chan_wid,
         foff=-abs(info.chan_wid) if info.chan_wid else -1.0,
         nchans=1, nbits=32, tstart=info.mjd, tsamp=info.dt, nifs=1)

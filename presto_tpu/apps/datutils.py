@@ -14,8 +14,6 @@ import numpy as np
 
 from presto_tpu.io import datfft
 
-SDAT_SCALE_HDR = np.float32
-
 
 def shiftdata(datfile: str, shift: float, outfile: str = "") -> str:
     """Shift a time series by a FRACTIONAL number of bins via linear

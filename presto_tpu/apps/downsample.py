@@ -34,6 +34,11 @@ def main(argv=None) -> int:
         info.name = outbase
         info.N = len(out)
         info.dt = info.dt * args.factor
+        # on/off bin pairs reference sample indices: rescale them
+        # (downsample.c divides by the factor the same way)
+        info.onoff = [(a // args.factor,
+                       min(b // args.factor, len(out) - 1))
+                      for a, b in info.onoff]
         write_inf(info, outbase + ".inf")
     print("downsample: %s x%d -> %s.dat (%d pts)"
           % (args.datfile, args.factor, outbase, len(out)))

@@ -19,6 +19,9 @@ def truncate(inpath: str, outpath: str, tlo: float = 0.0,
              fhi: float = 1e30, block: int = 1 << 14) -> str:
     with sigproc.FilterbankFile(inpath) as fb:
         h = fb.header
+        if h.nifs != 1:
+            raise SystemExit("fb_truncate: multi-IF input would be "
+                             "summed and clipped; split pols first")
         freqs = h.lofreq + np.arange(h.nchans) * abs(h.foff)
         keep = (freqs >= flo) & (freqs <= fhi)
         if not keep.any():

@@ -53,8 +53,10 @@ def main(argv=None) -> int:
     orbit = None
     if args.porb > 0:
         from presto_tpu.ops.orbit import OrbitParams
+        # -torb: time OF periastron (obs seconds); OrbitParams.t is
+        # time SINCE periastron at t=0, hence the sign flip
         orbit = OrbitParams(p=args.porb, x=args.xorb, e=0.0, w=0.0,
-                            t=args.torb)
+                            t=-args.torb)
     params = InjectParams(f=f, fdot=args.fdot, phase0=args.phase,
                           dm=args.dm, shape="gauss", width=args.width,
                           profile=profile, orbit=orbit)

@@ -26,10 +26,13 @@ def psrfits_to_fil(paths, outfile: str, nbits: int = 8,
             src_dej=hdr.src_dej,
             rawdatafile=os.path.basename(outfile))
         N = pf.nspectra
-        # requantization scale from the first block (psrfits2fil.py
-        # uses the global min/max of the scaled data)
-        first = pf.read_spectra(0, min(block, N))
-        lo, hi = float(first.min()), float(first.max())
+        # requantization scale from the global min/max (streamed
+        # pre-pass so later bright transients are never clipped)
+        lo, hi = np.inf, -np.inf
+        for start in range(0, N, block):
+            blk = pf.read_spectra(start, min(block, N - start))
+            lo = min(lo, float(blk.min()))
+            hi = max(hi, float(blk.max()))
         span = (hi - lo) or 1.0
         maxq = (1 << nbits) - 1 if nbits < 32 else 0
         with open(outfile, "wb") as f:

@@ -22,12 +22,14 @@ def tim_to_dat(timfile: str, outbase: str = "") -> str:
         f.seek(hdr.headerlen)
         data = np.fromfile(f, dtype=np.float32)
     datfft.write_dat(outbase + ".dat", data)
+    from presto_tpu.apps.common import SIGPROC_TELESCOPES
+    tel = SIGPROC_TELESCOPES.get(hdr.telescope_id, "Unknown")
     info = InfoData(name=outbase, object=hdr.source_name,
                     N=len(data), dt=hdr.tsamp, mjd_i=int(hdr.tstart),
                     mjd_f=hdr.tstart - int(hdr.tstart),
                     freq=hdr.lofreq, chan_wid=abs(hdr.foff),
                     num_chan=1, freqband=abs(hdr.foff),
-                    telescope="GBT")
+                    telescope=tel)
     write_inf(info, outbase + ".inf")
     return outbase + ".dat"
 

@@ -41,6 +41,9 @@ def _base_profile(params: InjectParams) -> np.ndarray:
     ph = np.arange(_NFINE) / _NFINE
     if params.profile is not None:
         prof = np.asarray(params.profile, float)
+        peak = np.abs(prof).max()
+        if peak > 0:
+            prof = prof / peak          # unit peak: amp semantics hold
         x = np.arange(len(prof)) / len(prof)
         return np.interp(ph, x, prof, period=1.0)
     # pulse_shape centers gauss at 0.5; shift so peak sits at phase 0

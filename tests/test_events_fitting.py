@@ -1,0 +1,181 @@
+"""Event statistics, orbit fitting, gaussian profile fitting,
+sum_profiles, psrorbit/window tools (SURVEY §2.6 binary utils row)."""
+
+import numpy as np
+import pytest
+
+from presto_tpu.utils.events import (fold_events, htest,
+                                     kuiper_uniform_test, rayleigh,
+                                     z2m, z2m_prob)
+
+RNG = np.random.default_rng(31)
+
+
+# ----------------------------------------------------------------------
+# events
+# ----------------------------------------------------------------------
+
+def _pulsed_phases(n, frac, width=0.03, rng=RNG):
+    npulse = int(n * frac)
+    ph = rng.uniform(0, 1, n - npulse)
+    pulse = np.mod(rng.normal(0.3, width, npulse), 1.0)
+    return np.concatenate([ph, pulse])
+
+
+def test_z2m_uniform_and_pulsed():
+    uni = RNG.uniform(0, 1, 2000)
+    z_uni = z2m(uni, 2)
+    assert z2m_prob(z_uni, 2) > 1e-3        # not significant
+    pulsed = _pulsed_phases(2000, 0.2)
+    z_p = z2m(pulsed, 2)
+    assert z_p > 100
+    assert z2m_prob(z_p, 2) < 1e-10
+
+
+def test_htest_picks_harmonics():
+    """A narrow pulse needs many harmonics: H-test m > 1 and huge H."""
+    pulsed = _pulsed_phases(3000, 0.15, width=0.01)
+    H, m, prob = htest(pulsed)
+    assert H > 100
+    assert m > 1
+    assert prob < 1e-10
+    H0, _, prob0 = htest(RNG.uniform(0, 1, 3000))
+    assert prob0 > 1e-3
+
+
+def test_rayleigh_is_z21():
+    ph = _pulsed_phases(500, 0.3)
+    assert np.isclose(rayleigh(ph), z2m(ph, 1))
+
+
+def test_kuiper():
+    V, p_uni = kuiper_uniform_test(RNG.uniform(0, 1, 1000))
+    assert p_uni > 1e-3
+    V2, p_pulsed = kuiper_uniform_test(_pulsed_phases(1000, 0.3))
+    assert V2 > V
+    assert p_pulsed < 1e-6
+
+
+def test_fold_events_phases():
+    f = 2.5
+    times = np.arange(100) / f + 0.1    # all at phase 0.25
+    ph = fold_events(times, f)
+    np.testing.assert_allclose(ph, 0.25, atol=1e-9)
+
+
+# ----------------------------------------------------------------------
+# orbit fitting
+# ----------------------------------------------------------------------
+
+def test_fit_circular_orbit_recovers_parameters():
+    from presto_tpu.search.orbitfit import (OrbitFit, fit_circular_orbit,
+                                            predicted_period)
+    true = OrbitFit(p_psr=0.0045, p_orb=8.1 * 3600, x=2.3,
+                    T0=1200.0)
+    t = np.sort(RNG.uniform(0, 3 * true.p_orb, 40))
+    p_meas = predicted_period(t, true) + RNG.normal(0, 2e-9, t.size)
+    fit = fit_circular_orbit(t, p_meas, p_orb_guess=8.0 * 3600,
+                             x_guess=2.0)
+    assert abs(fit.p_psr - true.p_psr) / true.p_psr < 1e-6
+    assert abs(fit.p_orb - true.p_orb) / true.p_orb < 1e-3
+    assert abs(fit.x - true.x) / true.x < 0.05
+    assert fit.rms < 1e-8
+
+
+def test_fit_eccentric_orbit():
+    from presto_tpu.search.orbitfit import (OrbitFit,
+                                            fit_eccentric_orbit,
+                                            predicted_period)
+    true = OrbitFit(p_psr=0.012, p_orb=20000.0, x=5.0, T0=3000.0,
+                    e=0.3, w=45.0)
+    t = np.sort(RNG.uniform(0, 3 * true.p_orb, 80))
+    p_meas = predicted_period(t, true) + RNG.normal(0, 5e-9, t.size)
+    fit = fit_eccentric_orbit(t, p_meas, p_orb_guess=19000.0,
+                              x_guess=4.0, e_guess=0.2, w_guess=30.0)
+    assert abs(fit.p_psr - true.p_psr) / true.p_psr < 1e-5
+    assert abs(fit.p_orb - true.p_orb) / true.p_orb < 5e-3
+    assert abs(fit.e - true.e) < 0.05
+
+
+# ----------------------------------------------------------------------
+# gaussian profile fitting
+# ----------------------------------------------------------------------
+
+def test_fit_gaussians_two_components(tmp_path):
+    from presto_tpu.utils.gaussfit import (GaussComponent, fit_gaussians,
+                                           gauss_profile, read_gaussians,
+                                           write_gaussians)
+    truth = [GaussComponent(phase=0.3, fwhm=0.05, ampl=10.0),
+             GaussComponent(phase=0.62, fwhm=0.12, ampl=4.0)]
+    prof = gauss_profile(128, truth, dc=5.0)
+    prof += RNG.normal(0, 0.05, 128)
+    comps, dc, rms = fit_gaussians(prof, ngauss=2)
+    assert rms < 0.1
+    assert abs(dc - 5.0) < 0.2
+    comps.sort(key=lambda c: c.phase)
+    assert abs(comps[0].phase - 0.3) < 0.01
+    assert abs(comps[0].fwhm - 0.05) < 0.01
+    assert abs(comps[0].ampl - 10.0) < 0.5
+    assert abs(comps[1].phase - 0.62) < 0.02
+    # round-trip the .gaussians artifact
+    path = str(tmp_path / "x.gaussians")
+    write_gaussians(path, comps, dc)
+    back, dc2 = read_gaussians(path)
+    assert len(back) == 2
+    assert abs(dc2 - dc) < 1e-4   # %.6g text precision
+
+
+# ----------------------------------------------------------------------
+# CLI tools
+# ----------------------------------------------------------------------
+
+def test_sum_profiles_cli(tmp_path):
+    from presto_tpu.utils.gaussfit import GaussComponent, gauss_profile
+    from presto_tpu.timing.fftfit import gaussian_template
+    from presto_tpu.apps.sum_profiles import main
+    n = 64
+    base = gaussian_template(n, 0.08)
+    paths = []
+    for i, shift in enumerate((0.0, 0.2, -0.15)):
+        prof = 5.0 * np.roll(base, int(shift * n)) + \
+            RNG.normal(0, 0.05, n)
+        path = str(tmp_path / ("p%d.bestprof" % i))
+        with open(path, "w") as f:
+            f.write("# Input file       =  x\n")
+            f.write("######\n")
+            for j, v in enumerate(prof):
+                f.write("%4d  %.7g\n" % (j, v))
+        paths.append(path)
+    out = str(tmp_path / "sum.prof")
+    assert main(["-o", out] + paths) == 0
+    total = np.loadtxt(out)[:, 1]
+    # aligned sum: peak ~3x a single profile's, width preserved
+    assert total.max() > 2.2 * 5.0
+    assert (total > total.max() / 2).sum() < 12
+
+
+def test_psrorbit_and_window_cli(tmp_path):
+    from presto_tpu.apps.psrorbit import main as orbmain
+    from presto_tpu.apps.window import main as winmain
+    out1 = str(tmp_path / "orb.png")
+    assert orbmain(["-p", "0.005", "-porb", "7200", "-x", "1.2",
+                    "-o", out1]) == 0
+    out2 = str(tmp_path / "win.png")
+    assert winmain(["-o", out2]) == 0
+    for f in (out1, out2):
+        with open(f, "rb") as fh:
+            assert fh.read(4) == b"\x89PNG"
+
+
+def test_fit_circular_orbit_cli(tmp_path, capsys):
+    from presto_tpu.search.orbitfit import OrbitFit, predicted_period
+    from presto_tpu.apps.fit_circular_orbit import main
+    true = OrbitFit(p_psr=0.003, p_orb=6.0 * 3600, x=1.5, T0=500.0)
+    t = np.sort(RNG.uniform(0, 2 * true.p_orb, 30))
+    p_meas = predicted_period(t, true)
+    path = str(tmp_path / "meas.txt")
+    np.savetxt(path, np.column_stack([55000.0 + t / 86400.0, p_meas]))
+    assert main(["-porb", "6.2", "-x", "1.0", path]) == 0
+    out = capsys.readouterr().out
+    porb_line = [l for l in out.splitlines() if l.startswith("P_orb")][0]
+    assert abs(float(porb_line.split()[2]) - true.p_orb) < 60.0

@@ -1,0 +1,77 @@
+"""Spectra 2-D dynamic-spectra container (lib/python/spectra.py
+parity)."""
+
+import numpy as np
+import pytest
+
+from presto_tpu.io.spectra import Spectra
+from presto_tpu.ops.dedispersion import delay_from_dm
+
+RNG = np.random.default_rng(41)
+
+
+def _dispersed(nchan=32, nspec=2048, dt=1e-3, lof=400.0, cw=1.0,
+               dm=60.0, t0=0.8):
+    freqs = lof + np.arange(nchan) * cw
+    data = RNG.normal(0, 0.1, (nchan, nspec)).astype(np.float32)
+    delays = np.asarray(delay_from_dm(dm, freqs))
+    delays -= delays.min()
+    for c in range(nchan):
+        k = int(round((t0 + delays[c]) / dt))
+        if k < nspec:
+            data[c, k] += 10.0
+    return Spectra(freqs, dt, data), t0
+
+
+def test_dedisperse_aligns_pulse():
+    sp, t0 = _dispersed()
+    sp.dedisperse(60.0)
+    cols = np.argmax(sp.data, axis=1)
+    assert np.ptp(cols) <= 1
+    assert abs(cols[0] * sp.dt - t0) < 3 * sp.dt
+    assert sp.dm == 60.0
+
+
+def test_dedisperse_is_relative():
+    sp, _ = _dispersed()
+    sp.dedisperse(30.0)
+    sp.dedisperse(60.0)     # incremental: 30 then +30 more
+    cols = np.argmax(sp.data, axis=1)
+    # two rounding steps can differ from one by +/-1 sample per step
+    assert np.ptp(cols) <= 2
+
+
+def test_subband_and_downsample():
+    sp, _ = _dispersed()
+    sub = sp.subband(8, subdm=60.0)
+    assert sub.numchans == 8
+    assert sub.numspectra == sp.numspectra
+    assert np.all(np.diff(sub.freqs) > 0)
+    ds = sub.downsample(4)
+    assert ds.numspectra == sp.numspectra // 4
+    assert abs(ds.dt - 4e-3) < 1e-12
+
+
+def test_trim_scaled_mask():
+    sp, _ = _dispersed()
+    tr = sp.trim(100, 600)
+    assert tr.numspectra == 500
+    assert abs(tr.starttime - 0.1) < 1e-9
+    sc = sp.scaled(indep=True)
+    assert np.allclose(sc.data.mean(axis=1), 0.0, atol=1e-4)
+    assert np.allclose(sc.data.std(axis=1), 1.0, atol=1e-3)
+    sp.mask_channels([3, 5])
+    assert np.all(sp.data[3] == 0)
+
+
+def test_timeseries_snr_peaks_at_dm():
+    sp, t0 = _dispersed()
+    ts0 = sp.timeseries().copy()
+    sp.dedisperse(60.0)
+    ts = sp.timeseries()
+    assert ts.max() > 3 * ts0.max()
+
+
+def test_shape_mismatch_raises():
+    with pytest.raises(ValueError):
+        Spectra(np.arange(4), 1e-3, np.zeros((5, 10)))
