@@ -146,6 +146,7 @@ def run(args):
 
 def main(argv=None):
     run(build_parser().parse_args(argv))
+    return 0
 
 
 if __name__ == "__main__":

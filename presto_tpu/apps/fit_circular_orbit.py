@@ -17,8 +17,10 @@ SECPERDAY = 86400.0
 
 
 def _load_measurements(paths):
-    """(times_sec, periods_sec).  .bestprof inputs use their topo epoch
-    and period; a text file is 'MJD period_s' per line."""
+    """(times_sec_from_first, periods_sec, t0_sec).  t0 is the first
+    epoch in seconds (MJD*86400) so T0 can be reported as an MJD.
+    .bestprof inputs use their topo epoch and period; a text file is
+    'MJD period_s' per line."""
     ts, ps = [], []
     for path in paths:
         if path.endswith(".bestprof"):

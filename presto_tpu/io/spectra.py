@@ -46,7 +46,7 @@ class Spectra:
         bins = np.asarray(bins)
         n = self.numspectra
         for i in range(self.numchans):
-            b = int(bins[i])
+            b = int(np.clip(bins[i], -n, n))   # |shift| >= n: all pad
             if b == 0:
                 continue
             if b > 0:

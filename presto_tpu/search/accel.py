@@ -357,37 +357,79 @@ class AccelSearch:
             # spectrum too short for one full block: empty plane
             return jnp.zeros((kern.numz, 0), dtype=jnp.float32)
         numdata = kern.fftlen // 2
-        segs = np.zeros((len(starts), numdata, 2), dtype=np.float32)
-        for i, s0 in enumerate(starts):
-            lobin = int(s0) - kern.halfwidth
-            lo = max(lobin, 0)
-            hi = min(lobin + numdata, self.numbins)
-            if hi > lo:
-                segs[i, lo - lobin:hi - lobin] = fft_pairs[lo:hi]
         if self._kern_dev is None:   # one upload; reused by cached fns
             self._kern_dev = jnp.asarray(kern.kern_pairs)
         kern_dev = self._kern_dev
         plane_numr = int(2 * int(starts[-1]) + cfg.uselen)
-        plane = jnp.zeros((kern.numz, plane_numr), dtype=jnp.float32)
         # Chunk the block batch: the [chunk, numz, fftlen] complex
-        # intermediate is the peak working memory, so bound it (~0.25 GB
+        # intermediate is the peak working memory, so bound it (~1 GB
         # per chunk at zmax=200) — the HBM-ladder analog of meminfo.h.
-        chunk = max(1, int(2 ** 28 // (kern.numz * kern.fftlen * 8)))
+        # Larger chunks amortize per-step FFT launch overhead; v5e has
+        # 16 GB HBM and the plane itself is the other big resident.
+        chunk = max(1, int(2 ** 30 // (kern.numz * kern.fftlen * 8)))
         col0 = int(starts[0]) * ACCEL_RDR
 
-        def write_chunk(pl, batch, start_col):
+        # Host uploads ONLY the raw spectrum; the per-block read
+        # windows are gathered on device (the tunneled host->TPU link
+        # runs ~tens of MB/s for real payloads, so shipping the ~10%-
+        # overlapping window tensor costs more than the whole device
+        # compute).  Window j = fft_pad[lobins[j] : +numdata]; padded
+        # (beyond-nblocks) windows point at a zero region.
+        nblocks = len(starts)
+        chunk = min(chunk, nblocks)
+        nsteps = (nblocks + chunk - 1) // chunk
+        npad_blocks = nsteps * chunk - nblocks
+        lobin0 = int(starts[0]) - kern.halfwidth
+        pad_lo = max(0, -lobin0)
+        pad_hi = numdata + max(
+            0, int(starts[-1]) - kern.halfwidth + numdata - self.numbins)
+        lobins = np.asarray(
+            [int(s0) - kern.halfwidth for s0 in starts]
+            + [self.numbins] * npad_blocks, np.int32) + pad_lo
+        lobin_chunks = lobins.reshape(nsteps, chunk)
+        body_numr = nsteps * chunk * cfg.uselen
+
+        def gather_windows(fft_pad, lobin_chunk):
+            idx = lobin_chunk[:, None] + jnp.arange(numdata)
+            return fft_pad[idx]                 # [chunk, numdata, 2]
+
+        def chunk_slab(fft_pad, lobin_chunk):
+            batch = gather_windows(fft_pad, lobin_chunk)
             norms = _block_median_norms(batch)
             powers = _ffdot_blocks(batch * norms, kern_dev, cfg.uselen,
                                    kern.fftlen, kern.halfwidth)
             # [chunk, numz, uselen] -> [numz, chunk*uselen] slab
-            slabv = jnp.moveaxis(powers, 0, 1).reshape(kern.numz, -1)
-            return jax.lax.dynamic_update_slice(pl, slabv, (0, start_col))
+            return jnp.moveaxis(powers, 0, 1).reshape(kern.numz, -1)
 
-        # One device dispatch: scan over chunks inside a single jit,
-        # carrying the plane (per-call tunnel latency would otherwise
-        # dominate — ~0.1-0.4 s per call on the tunneled TPU).
-        nblocks = len(starts)
-        chunk = min(chunk, nblocks)
+        fft_dev = jnp.asarray(np.ascontiguousarray(fft_pairs))
+        pads = ((pad_lo, pad_hi), (0, 0))
+
+        # One device dispatch: scan over chunks inside a single jit.
+        # Preferred shape: a carry-free scan stacking per-chunk slabs
+        # (ys), placed into the plane with ONE transpose-pad copy — a
+        # carried-plane dynamic_update_slice costs a large fraction of
+        # a plane traversal per scan step.  The stacked ys is a second
+        # plane-sized buffer, so fall back to the carry variant when
+        # 2x plane would crowd HBM (~16 GB on v5e).
+        if (kern.numz * (plane_numr + body_numr) * 4) < 9 * 2 ** 30:
+            key = ("build_ys", chunk, nsteps, plane_numr)
+            if key not in self._fn_cache:
+                @jax.jit
+                def build_ys(fft_raw, lobin_chunks):
+                    fft_pad = jnp.pad(fft_raw, pads)
+                    def body(_, lc):
+                        return None, chunk_slab(fft_pad, lc)
+                    _, ys = jax.lax.scan(body, None, lobin_chunks)
+                    body_arr = jnp.moveaxis(ys, 0, 1).reshape(
+                        kern.numz, -1)[:, :plane_numr - col0]
+                    return jnp.pad(body_arr, ((0, 0), (col0, 0)))
+                self._fn_cache[key] = build_ys
+            return self._fn_cache[key](fft_dev,
+                                       jnp.asarray(lobin_chunks))
+
+        # carry fallback: per-step in-place slab writes over REAL
+        # blocks only (the final chunk overlaps backwards so no padded
+        # zero-windows ever overwrite computed columns)
         chunk_ids = []
         c0 = 0
         while c0 < nblocks:
@@ -395,22 +437,29 @@ class AccelSearch:
                 c0 = nblocks - chunk   # overlap: rewrites same values
             chunk_ids.append(c0)
             c0 += chunk
-        seg_chunks = np.stack([segs[i:i + chunk] for i in chunk_ids])
+        nsteps = len(chunk_ids)
+        lobin_chunks = np.stack([lobins[i:i + chunk] for i in chunk_ids])
         start_cols = np.asarray(
             [col0 + i * cfg.uselen for i in chunk_ids], dtype=np.int32)
+        plane = jnp.zeros((kern.numz, plane_numr), dtype=jnp.float32)
 
-        key = ("build", chunk, len(chunk_ids), plane_numr)
+        key = ("build", chunk, nsteps, plane_numr)
         if key not in self._fn_cache:
             @partial(jax.jit, donate_argnums=(0,))
-            def build_all(pl, seg_chunks, start_cols):
+            def build_all(pl, fft_raw, lobin_chunks, start_cols):
+                fft_pad = jnp.pad(fft_raw, pads)
                 def body(pl, xs):
-                    batch, start_col = xs
-                    return write_chunk(pl, batch, start_col), None
-                pl, _ = jax.lax.scan(body, pl, (seg_chunks, start_cols))
+                    lc, start_col = xs
+                    slabv = chunk_slab(fft_pad, lc)
+                    return jax.lax.dynamic_update_slice(
+                        pl, slabv, (0, start_col)), None
+                pl, _ = jax.lax.scan(body, pl,
+                                     (lobin_chunks, start_cols))
                 return pl
             self._fn_cache[key] = build_all
 
-        return self._fn_cache[key](plane, jnp.asarray(seg_chunks),
+        return self._fn_cache[key](plane, fft_dev,
+                                   jnp.asarray(lobin_chunks),
                                    jnp.asarray(start_cols))
 
     # -- search --------------------------------------------------------
@@ -435,6 +484,9 @@ class AccelSearch:
         if numr <= 0:
             return []
         slab = min(slab, numr)
+        # top-k cost grows steeply with k on TPU: keep k fixed and
+        # scale the number of slabs instead (per-slab top-k truncates
+        # only the weakest noise candidates)
         k = min(cfg.max_cands_per_stage, slab)
         key = ("scan", slab, k, plane_numr)
         if key not in self._fn_cache:

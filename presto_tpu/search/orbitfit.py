@@ -89,12 +89,16 @@ def fit_eccentric_orbit(times: np.ndarray, periods: np.ndarray,
 
     def resid(theta):
         p_psr, p_orb, x, T0, e, w = theta
-        e = np.clip(e, 0.0, 0.95)
         return p_psr * (1.0 + _vc_over_c(t, p_orb, x, T0, e, w)) - p
 
     theta0 = [circ.p_psr, circ.p_orb, circ.x, circ.T0,
               max(e_guess, 1e-3), w_guess]
-    sol = least_squares(resid, theta0, max_nfev=40000)
+    # bound e in [0, 0.95] via the solver (clipping inside the residual
+    # would flatten the Jacobian at the boundary and stall the fit)
+    inf = np.inf
+    sol = least_squares(resid, theta0, max_nfev=40000,
+                        bounds=([0.0, 0.0, 0.0, -inf, 0.0, -inf],
+                                [inf, inf, inf, inf, 0.95, inf]))
     p_psr, p_orb, x, T0, e, w = sol.x
     return OrbitFit(p_psr=float(p_psr), p_orb=float(abs(p_orb)),
                     x=float(abs(x)), T0=float(T0 % abs(p_orb)),
