@@ -144,10 +144,11 @@ def run(args):
     return cands
 
 
-def main(argv=None):
+def main(argv=None) -> int:
     run(build_parser().parse_args(argv))
     return 0
 
 
 if __name__ == "__main__":
-    main()
+    import sys
+    sys.exit(main())

@@ -1,0 +1,53 @@
+"""pipeline: the full search flow as one command
+(rfifind -> DDplan -> prepsubband -> realfft -> [zapbirds] ->
+accelsearch -> sift -> prepfold -> single_pulse_search), the analog of
+the reference's survey drivers (bin/PALFA_presto_search.py etc.).
+Restartable: stages with existing artifacts are skipped.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from presto_tpu.pipeline.survey import SurveyConfig, run_survey
+
+
+def build_parser():
+    p = argparse.ArgumentParser(prog="pipeline")
+    p.add_argument("-lodm", type=float, default=0.0)
+    p.add_argument("-hidm", type=float, default=100.0)
+    p.add_argument("-nsub", type=int, default=32)
+    p.add_argument("-zmax", type=int, default=0)
+    p.add_argument("-numharm", type=int, default=8)
+    p.add_argument("-sigma", type=float, default=4.0)
+    p.add_argument("-rfitime", type=float, default=2.0)
+    p.add_argument("-zaplist", type=str, default=None)
+    p.add_argument("-foldtop", type=int, default=3)
+    p.add_argument("-nosp", action="store_true",
+                   help="Skip the single-pulse search stage")
+    p.add_argument("-norfi", action="store_true",
+                   help="Skip rfifind masking")
+    p.add_argument("-workdir", type=str, default=".")
+    p.add_argument("rawfiles", nargs="+")
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    cfg = SurveyConfig(
+        lodm=args.lodm, hidm=args.hidm, nsub=args.nsub,
+        zmax=args.zmax, numharm=args.numharm, sigma=args.sigma,
+        rfi_time=args.rfitime, zaplist=args.zaplist,
+        fold_top=args.foldtop, singlepulse=not args.nosp,
+        skip_rfifind=args.norfi)
+    res = run_survey(args.rawfiles, cfg, workdir=args.workdir)
+    print("pipeline: done — %d DMs, %d sifted cands, %d folds, "
+          "%d SP events" % (len(res.datfiles),
+                            len(res.sifted) if res.sifted else 0,
+                            len(res.folded), res.sp_events))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,184 @@
+"""One-command search pipeline (the survey-script layer, SURVEY §L7).
+
+The reference orchestrates its searches with per-survey Python drivers
+(bin/PALFA_presto_search.py, GBT350_drift_search.py, GBNCC_search.py)
+that all run the same canonical flow — the tutorial command history
+(docs/GBT_Lband_PSR_cmd_history.txt):
+
+  rfifind -> DDplan -> prepsubband -> realfft -> [zapbirds] ->
+  accelsearch -> ACCEL_sift -> prepfold (top cands) ->
+  single_pulse_search
+
+This module is that flow as one restartable driver.  Every stage
+writes the standard durable artifacts (.mask/.dat/.inf/.fft/
+ACCEL_*/cands_sifted.txt/.pfd/.singlepulse), and a stage is skipped
+when its outputs already exist (the artifact-per-stage contract IS the
+checkpoint system, SURVEY §5.4).
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+from dataclasses import dataclass, field
+from typing import List, Optional, Sequence
+
+
+@dataclass
+class SurveyConfig:
+    # DM plan
+    lodm: float = 0.0
+    hidm: float = 100.0
+    nsub: int = 32
+    # rfifind
+    rfi_time: float = 2.0
+    # accelsearch
+    zmax: int = 0
+    numharm: int = 8
+    sigma: float = 4.0
+    zaplist: Optional[str] = None
+    # sifting / folding
+    min_dm_hits: int = 2
+    low_dm_cutoff: float = 2.0
+    fold_top: int = 3
+    # single pulse
+    sp_threshold: float = 5.0
+    singlepulse: bool = True
+    skip_rfifind: bool = False
+
+
+@dataclass
+class SurveyResult:
+    workdir: str
+    maskfile: Optional[str] = None
+    datfiles: List[str] = field(default_factory=list)
+    candfile: str = ""
+    folded: List[str] = field(default_factory=list)
+    sp_events: int = 0
+    sifted: Optional[object] = None      # sifting.Candlist
+
+
+def _stage(done_glob: str, workdir: str) -> List[str]:
+    return sorted(glob.glob(os.path.join(workdir, done_glob)))
+
+
+def run_survey(rawfiles: Sequence[str], cfg: SurveyConfig,
+               workdir: str = ".") -> SurveyResult:
+    os.makedirs(workdir, exist_ok=True)
+    rawfiles = [os.path.abspath(f) for f in rawfiles]
+    base = os.path.join(
+        workdir, os.path.splitext(os.path.basename(rawfiles[0]))[0])
+    res = SurveyResult(workdir=workdir)
+
+    # ---- 1. rfifind ---------------------------------------------------
+    mask = base + "_rfifind.mask"
+    if not cfg.skip_rfifind:
+        if not os.path.exists(mask):
+            from presto_tpu.apps.rfifind import main as rfifind_main
+            rfifind_main(["-time", str(cfg.rfi_time), "-o", base]
+                         + rawfiles)
+        res.maskfile = mask
+
+    # ---- 2. DDplan ----------------------------------------------------
+    from presto_tpu.apps.common import open_raw
+    from presto_tpu.pipeline.ddplan import Observation, plan_dedispersion
+    fb = open_raw(rawfiles)
+    hdr = fb.header
+    fb.close()
+    obs = Observation(dt=hdr.tsamp, f_ctr=hdr.lofreq
+                      + 0.5 * (hdr.nchans - 1) * abs(hdr.foff),
+                      bw=hdr.nchans * abs(hdr.foff),
+                      numchan=hdr.nchans)
+    plan = plan_dedispersion(obs, cfg.lodm, cfg.hidm, numsub=cfg.nsub)
+    print("survey: DDplan -> %d methods, %d total DMs"
+          % (len(plan.methods), plan.total_numdms))
+
+    # ---- 3. prepsubband per method ------------------------------------
+    from presto_tpu.apps.prepsubband import main as prepsubband_main
+    for m in plan.methods:
+        have = _stage(os.path.basename(base) + "_DM*.dat", workdir)
+        missing = [dm for dm in m.dms
+                   if not any("_DM%.2f.dat" % dm in f for f in have)]
+        if not missing:
+            continue
+        argv = ["-lodm", str(m.lodm), "-dmstep", str(m.ddm),
+                "-numdms", str(m.numdms), "-nsub", str(cfg.nsub),
+                "-downsamp", str(m.downsamp), "-nobary",
+                "-o", base]
+        if res.maskfile and os.path.exists(res.maskfile):
+            argv += ["-mask", res.maskfile]
+        prepsubband_main(argv + rawfiles)
+    res.datfiles = _stage(os.path.basename(base) + "_DM*.dat", workdir)
+    print("survey: %d dedispersed time series" % len(res.datfiles))
+
+    # ---- 4. realfft ---------------------------------------------------
+    from presto_tpu.apps.realfft import main as realfft_main
+    todo = [f for f in res.datfiles
+            if not os.path.exists(f[:-4] + ".fft")]
+    if todo:
+        realfft_main(todo)
+    fftfiles = [f[:-4] + ".fft" for f in res.datfiles]
+
+    # ---- 5. zapbirds --------------------------------------------------
+    if cfg.zaplist:
+        from presto_tpu.apps.zapbirds import main as zap_main
+        for f in fftfiles:
+            zap_main(["-zapfile", cfg.zaplist, f])
+
+    # ---- 6. accelsearch ----------------------------------------------
+    from presto_tpu.apps.accelsearch import main as accel_main
+    for f in fftfiles:
+        accfile = f[:-4] + "_ACCEL_%d" % cfg.zmax
+        if not os.path.exists(accfile):
+            accel_main(["-zmax", str(cfg.zmax),
+                        "-numharm", str(cfg.numharm),
+                        "-sigma", str(cfg.sigma), f])
+
+    # ---- 7. sift ------------------------------------------------------
+    from presto_tpu.pipeline.sifting import sift_candidates
+    accfiles = _stage(os.path.basename(base)
+                      + "_DM*_ACCEL_%d" % cfg.zmax, workdir)
+    res.candfile = os.path.join(workdir, "cands_sifted.txt")
+    cl = sift_candidates(accfiles, numdms_min=cfg.min_dm_hits,
+                         low_DM_cutoff=cfg.low_dm_cutoff)
+    cl.to_file(res.candfile)
+    res.sifted = cl
+    print("survey: %d sifted candidates -> %s"
+          % (len(cl), res.candfile))
+
+    # ---- 8. fold the top candidates -----------------------------------
+    from presto_tpu.apps.prepfold import main as prepfold_main
+    top = sorted(cl.cands, key=lambda c: -c.sigma)[:cfg.fold_top]
+    for i, c in enumerate(top):
+        accpath = os.path.join(workdir, c.filename) \
+            if not os.path.dirname(c.filename) else c.filename
+        if c.path:
+            accpath = os.path.join(c.path, c.filename)
+        candfile = accpath + ".cand"
+        datfile = accpath.split("_ACCEL_")[0] + ".dat"
+        outbase = os.path.join(workdir, "fold_cand%d" % (i + 1))
+        if os.path.exists(outbase + ".pfd"):
+            res.folded.append(outbase + ".pfd")
+            continue
+        try:
+            prepfold_main(["-accelfile", candfile,
+                           "-accelcand", str(c.candnum),
+                           "-dm", "%.2f" % c.DM, "-nosearch",
+                           "-o", outbase, datfile])
+            res.folded.append(outbase + ".pfd")
+        except SystemExit as e:
+            print("survey: fold of cand %d failed: %s" % (i + 1, e))
+    print("survey: folded %d candidates" % len(res.folded))
+
+    # ---- 9. single-pulse search --------------------------------------
+    if cfg.singlepulse and res.datfiles:
+        from presto_tpu.apps.single_pulse_search import main as sp_main
+        sp_main(["-t", str(cfg.sp_threshold)] + res.datfiles)
+        from presto_tpu.search.singlepulse import read_singlepulse
+        for f in res.datfiles:
+            spf = f[:-4] + ".singlepulse"
+            if os.path.exists(spf):
+                res.sp_events += len(read_singlepulse(spf))
+        print("survey: %d single-pulse events" % res.sp_events)
+
+    return res

@@ -91,10 +91,13 @@ def fit_eccentric_orbit(times: np.ndarray, periods: np.ndarray,
         p_psr, p_orb, x, T0, e, w = theta
         return p_psr * (1.0 + _vc_over_c(t, p_orb, x, T0, e, w)) - p
 
-    theta0 = [circ.p_psr, circ.p_orb, circ.x, circ.T0,
-              max(e_guess, 1e-3), w_guess]
     # bound e in [0, 0.95] via the solver (clipping inside the residual
-    # would flatten the Jacobian at the boundary and stall the fit)
+    # would flatten the Jacobian at the boundary and stall the fit);
+    # clamp the seed strictly inside the bounds so least_squares never
+    # rejects theta0 as infeasible
+    theta0 = [max(circ.p_psr, 1e-9), max(circ.p_orb, 1e-3),
+              max(circ.x, 1e-9), circ.T0,
+              float(np.clip(e_guess, 1e-3, 0.949)), w_guess]
     inf = np.inf
     sol = least_squares(resid, theta0, max_nfev=40000,
                         bounds=([0.0, 0.0, 0.0, -inf, 0.0, -inf],

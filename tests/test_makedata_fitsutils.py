@@ -1,0 +1,191 @@
+"""makedata (.mak ground truth), bincand CLI, pyplotres, FITS surgery."""
+
+import os
+
+import numpy as np
+import pytest
+
+from presto_tpu.io import datfft
+from presto_tpu.io.makfile import MakParams, read_mak, write_mak
+
+RNG = np.random.default_rng(51)
+
+
+def test_mak_roundtrip(tmp_path):
+    mk = MakParams(N=131072, dt=7.62939453125e-06, shape="Sine",
+                   f=2334.0216055, fdot=23.456789, amp=1.0,
+                   noise_type="Other", noise_sigma=0.0,
+                   onoff=[(0.0, 1.0)])
+    path = str(tmp_path / "t.mak")
+    write_mak(path, mk)
+    back = read_mak(path)
+    assert back.N == mk.N
+    assert abs(back.dt - mk.dt) < 1e-18
+    assert abs(back.f - mk.f) < 1e-6
+    assert abs(back.fdot - mk.fdot) < 1e-6
+    assert back.shape == "Sine"
+
+
+def test_mak_reads_reference_format(tmp_path):
+    """Parse the exact reference .mak layout (tests/test_fdot.mak)."""
+    text = """Tests f/fdot interpolation in program test_apps.c.
+Num data pts      = 131072
+dt per bin (s)    = 7.62939453125e-06
+Pulse shape       = Sine
+Rounding format   = Whole Numbers
+Pulse freq (hz)   = 2334.0216055
+fdot (s-2)        = 23.456789
+fdotdot (s-3)     = 0
+Pulse amp         = 1
+Pulse phs (deg)   = 0
+DC backgrnd level = 0
+Binary period (s) = 0
+Bin asini/c (s)   = 0
+Bin eccentricity  = 0
+Ang of Peri (deg) = 0
+Tm since peri (s) = 0
+Amp Mod amplitude = 0
+Amp Mod phs (deg) = 0
+Amp Mod freq (hz) = 0
+Noise type        = Other
+Noise sigma       = 0
+On/Off Pair  1    = 0 1
+"""
+    path = str(tmp_path / "ref.mak")
+    open(path, "w").write(text)
+    mk = read_mak(path)
+    assert mk.N == 131072
+    assert abs(mk.f - 2334.0216055) < 1e-7
+    assert abs(mk.fdot - 23.456789) < 1e-7
+    assert mk.roundformat == "Whole Numbers"
+    assert mk.noise_sigma == 0.0
+
+
+def test_makedata_renders_exact_signal(tmp_path):
+    """Noise-free .mak -> .dat whose spectrum peaks exactly at f+fd*T/2
+    (the test_apps.c ground-truth property)."""
+    from presto_tpu.apps.makedata import main
+    N, dt, f0 = 1 << 16, 1e-4, 3278.0 / 6.5536   # near-bin-center
+    mk = MakParams(N=N, dt=dt, shape="Gaussian", fwhm=0.1, f=f0,
+                   amp=10.0, dc=0.0, noise_type="Other",
+                   noise_sigma=0.0, roundformat="Fractional")
+    base = str(tmp_path / "sig")
+    write_mak(base + ".mak", mk)
+    assert main([base]) == 0
+    data = datfft.read_dat(base + ".dat")
+    assert len(data) == N
+    P = np.abs(np.fft.rfft(data - data.mean())) ** 2
+    k = np.argmax(P)
+    assert abs(k / (N * dt) - f0) < 1.0 / (N * dt)
+    assert os.path.exists(base + ".inf")
+
+
+def test_makedata_binary_orbit(tmp_path):
+    """Binary .mak: the fundamental is phase-modulated (wider line)."""
+    from presto_tpu.apps.makedata import main
+    N, dt, f0 = 1 << 16, 1e-3, 30.0
+    base_i = str(tmp_path / "iso")
+    base_b = str(tmp_path / "bin")
+    for base, porb, x in ((base_i, 0.0, 0.0), (base_b, 20.0, 0.003)):
+        mk = MakParams(N=N, dt=dt, shape="Sine", f=f0, amp=5.0,
+                       orb_p=porb, orb_x=x, noise_type="Other",
+                       noise_sigma=0.0, roundformat="Fractional")
+        write_mak(base + ".mak", mk)
+        assert main([base]) == 0
+
+    def linewidth(base):
+        d = datfft.read_dat(base + ".dat")
+        P = np.abs(np.fft.rfft(d - d.mean())) ** 2
+        k0 = int(round(f0 * N * dt))
+        w = P[k0 - 30:k0 + 31]
+        return (w > w.max() * 0.02).sum()
+
+    assert linewidth(base_b) > 2 * linewidth(base_i)
+
+
+def test_pyplotres_cli(tmp_path):
+    from presto_tpu.io.residuals import write_residuals
+    from presto_tpu.apps.pyplotres import main
+    n = 25
+    path = str(tmp_path / "resid2.tmp")
+    write_residuals(path, 55000 + np.arange(n) * 0.5,
+                    RNG.normal(0, 0.01, n), RNG.normal(0, 1e-4, n),
+                    orbit_phs=np.linspace(0, 2, n) % 1.0,
+                    uncertainty=np.full(n, 3.0))
+    out = str(tmp_path / "res.png")
+    assert main(["-o", out, path]) == 0
+    with open(out, "rb") as f:
+        assert f.read(4) == b"\x89PNG"
+
+
+@pytest.fixture()
+def psrfits_file(tmp_path):
+    from presto_tpu.io.psrfits import write_psrfits
+    nchan, nspec = 8, 256
+    data = RNG.uniform(0, 100, (nspec, nchan)).astype(np.float32)
+    path = str(tmp_path / "t.fits")
+    write_psrfits(path, data, dt=1e-3,
+                  freqs=1400.0 - np.arange(nchan), nsblk=64, nbits=8)
+    return path, nchan, nspec
+
+
+def test_fits_dumparrays(psrfits_file, capsys):
+    from presto_tpu.apps.fitsutils import main
+    path, nchan, _ = psrfits_file
+    assert main(["dumparrays", path]) == 0
+    out = capsys.readouterr().out
+    assert "DAT_WTS[row 0]" in out
+    assert "DAT_SCL" in out
+
+
+def test_fits_weight(psrfits_file, tmp_path):
+    from presto_tpu.apps.fitsutils import main
+    from presto_tpu.io.psrfits import PsrfitsFile
+    path, nchan, nspec = psrfits_file
+    wts = np.column_stack([np.arange(nchan),
+                           np.linspace(0, 1, nchan)])
+    wtsfile = str(tmp_path / "w.txt")
+    np.savetxt(wtsfile, wts)
+    assert main(["weight", "-wts", wtsfile, path]) == 0
+    with PsrfitsFile([path]) as pf:
+        sub = pf.files[0].hdu("SUBINT")
+        got = np.asarray(sub.read_col("DAT_WTS", 0), np.float32)
+    np.testing.assert_allclose(got, np.linspace(0, 1, nchan),
+                               rtol=1e-6)
+
+
+def test_fits_delrow(psrfits_file, tmp_path):
+    from presto_tpu.apps.fitsutils import main
+    from presto_tpu.io.fitsio import FitsFile
+    path, nchan, nspec = psrfits_file
+    out = str(tmp_path / "cut.fits")
+    assert main(["delrow", "2", "3", path, "-o", out]) == 0
+    with FitsFile(path) as a, FitsFile(out) as b:
+        n0 = a.hdu("SUBINT").naxis2
+        n1 = b.hdu("SUBINT").naxis2
+        assert n1 == n0 - 2
+        # first row unchanged
+        # copy: read_col_raw_bytes returns views into the file mmap
+        r0 = np.array(a.hdu("SUBINT").read_col_raw_bytes("DATA", 0))
+        r1 = np.array(b.hdu("SUBINT").read_col_raw_bytes("DATA", 0))
+        assert np.array_equal(r0, r1)
+        # row 1 of output == row 3 of input (rows 2,3 deleted, 1-based)
+        ra = np.array(a.hdu("SUBINT").read_col_raw_bytes("DATA", 3))
+        rb = np.array(b.hdu("SUBINT").read_col_raw_bytes("DATA", 1))
+        assert np.array_equal(ra, rb)
+
+
+def test_fits_delcol(psrfits_file, tmp_path):
+    from presto_tpu.apps.fitsutils import main
+    from presto_tpu.io.fitsio import FitsFile
+    path, nchan, nspec = psrfits_file
+    out = str(tmp_path / "nocol.fits")
+    assert main(["delcol", "DAT_OFFS", path, "-o", out]) == 0
+    with FitsFile(out) as f:
+        sub = f.hdu("SUBINT")
+        names = [c.name for c in sub.columns]
+        assert "DAT_OFFS" not in names
+        assert "DAT_WTS" in names and "DATA" in names
+        # data still readable (copy: view into the file mmap)
+        raw = np.array(sub.read_col_raw_bytes("DATA", 0))
+        assert raw.size > 0

@@ -1,0 +1,74 @@
+"""End-to-end survey pipeline: inject a pulsar, run the one-command
+flow, find it in the sifted + folded candidates (the tutorial
+acceptance test, SURVEY §4 item 6)."""
+
+import glob
+import os
+
+import numpy as np
+import pytest
+
+from presto_tpu.models.synth import FakeSignal, fake_filterbank_file
+
+
+@pytest.fixture(scope="module")
+def survey_run(tmp_path_factory):
+    work = tmp_path_factory.mktemp("survey")
+    rawfile = str(work / "psr.fil")
+    N, nchan, dt = 1 << 16, 32, 2e-4
+    f0, dm = 17.0, 42.0
+    # faint per-channel (real pulsars are far below the per-sample
+    # noise; a bright one would be flagged by rfifind as RFI)
+    sig = FakeSignal(f=f0, dm=dm, shape="gauss", width=0.08, amp=0.8)
+    fake_filterbank_file(rawfile, N, dt, nchan, 400.0, 1.0, sig,
+                         noise_sigma=2.0, nbits=8)
+    from presto_tpu.pipeline.survey import SurveyConfig, run_survey
+    cfg = SurveyConfig(lodm=20.0, hidm=65.0, nsub=16, zmax=0,
+                       numharm=4, sigma=4.0, fold_top=1,
+                       rfi_time=1.0, singlepulse=True)
+    res = run_survey([rawfile], cfg, workdir=str(work))
+    return res, f0, dm, str(work)
+
+
+def test_survey_produces_all_artifacts(survey_run):
+    res, f0, dm, work = survey_run
+    assert res.maskfile and os.path.exists(res.maskfile)
+    assert len(res.datfiles) > 5
+    assert all(os.path.exists(f[:-4] + ".fft") for f in res.datfiles)
+    assert os.path.exists(res.candfile)
+    assert glob.glob(os.path.join(work, "*_ACCEL_0"))
+
+
+def test_survey_finds_injected_pulsar(survey_run):
+    res, f0, dm, work = survey_run
+    assert res.sifted is not None and len(res.sifted) >= 1
+    best = sorted(res.sifted.cands, key=lambda c: -c.sigma)[0]
+    T = best.T
+    freq = best.r / T
+    # fundamental or a harmonic of the injection
+    ratio = freq / f0
+    assert abs(ratio - round(ratio)) < 0.01, freq
+    assert abs(best.DM - dm) < 5.0
+
+
+def test_survey_folds_top_candidate(survey_run):
+    res, f0, dm, work = survey_run
+    assert len(res.folded) >= 1
+    from presto_tpu.io.pfd import read_pfd
+    p = read_pfd(res.folded[0])
+    ratio = p.fold_p1 / f0
+    assert abs(ratio - round(ratio)) < 0.01
+
+
+def test_survey_is_restartable(survey_run):
+    """Second run over the same workdir reuses every artifact."""
+    res, f0, dm, work = survey_run
+    from presto_tpu.pipeline.survey import SurveyConfig, run_survey
+    mtimes = {f: os.path.getmtime(f) for f in res.datfiles}
+    cfg = SurveyConfig(lodm=20.0, hidm=65.0, nsub=16, zmax=0,
+                       numharm=4, sigma=4.0, fold_top=1,
+                       rfi_time=1.0, singlepulse=False)
+    res2 = run_survey([os.path.join(work, "psr.fil")], cfg,
+                      workdir=work)
+    for f in res2.datfiles:
+        assert os.path.getmtime(f) == mtimes[f], "dat rebuilt"
