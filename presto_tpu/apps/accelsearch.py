@@ -21,6 +21,7 @@ from presto_tpu.apps.common import load_spectrum, load_timeseries, ensure_backen
 from presto_tpu.ops import fftpack
 from presto_tpu.ops.rednoise import (deredden, read_birds_bary, zap_bins,
                                      birds_to_bin_ranges)
+from presto_tpu.ops import stats as st
 from presto_tpu.search.accel import (AccelConfig, AccelSearch,
                                      eliminate_harmonics,
                                      remove_duplicates)
@@ -35,6 +36,9 @@ def build_parser():
     p.add_argument("-flo", type=float, default=1.0)
     p.add_argument("-rlo", type=float, default=0.0)
     p.add_argument("-rhi", type=float, default=0.0)
+    p.add_argument("-wmax", type=int, default=0,
+                   help="Jerk refinement: polish candidates over "
+                        "(r, z, w) with |w| <= wmax (w = fdotdot*T^3)")
     p.add_argument("-zaplist", type=str, default=None)
     p.add_argument("-baryv", type=float, default=0.0)
     p.add_argument("-inmem", action="store_true",
@@ -68,27 +72,33 @@ def read_cand_file(path: str):
     return out
 
 
-def write_accel_file(path: str, cands, T: float) -> None:
+def write_accel_file(path: str, cands, T: float, ws=None) -> None:
     """Text table with the reference's column structure
-    (output_fundamentals, accel_utils.c:565-718)."""
+    (output_fundamentals, accel_utils.c:565-718); jerk runs append an
+    FFT 'w' column."""
     with open(path, "w") as f:
         f.write("             Summed  Coherent  Num        Period      "
                 "    Frequency         FFT 'r'        Freq Deriv      "
-                "FFT 'z'      Accel    \n")
+                "FFT 'z'      Accel    "
+                + ("  FFT 'w'   " if ws is not None else "") + "\n")
         f.write("Cand  Sigma   Power    Power   Harm       (ms)        "
                 "      (Hz)            (bin)           (Hz/s)         "
-                "(bins)      (m/s^2)  \n")
-        f.write("-" * 130 + "\n")
+                "(bins)      (m/s^2)  "
+                + ("  (bins)    " if ws is not None else "") + "\n")
+        f.write("-" * (142 if ws is not None else 130) + "\n")
         for i, c in enumerate(cands, 1):
             freq = c.r / T
             period_ms = 1000.0 / freq if freq > 0 else 0.0
             fdot = c.z / (T * T)
             accel = c.z * 299792458.0 / (T * T * max(freq, 1e-12))
             f.write("%-4d  %-5.2f  %-7.2f  %-7.2f  %-3d  %-15.8g  "
-                    "%-15.8g  %-14.4f  %-15.6g  %-10.2f  %-10.4g\n"
+                    "%-15.8g  %-14.4f  %-15.6g  %-10.2f  %-10.4g"
                     % (i, c.sigma, c.power, c.power / c.numharm,
                        c.numharm, period_ms, freq, c.r, fdot, c.z,
                        accel))
+            if ws is not None:
+                f.write("  %-10.2f" % ws.get(id(c), 0.0))
+            f.write("\n")
 
 
 def run(args):
@@ -125,11 +135,33 @@ def run(args):
     # (optimize_accelcand, accel_utils.c:465-525) on host float64.
     amps = fftpack.np_pairs_to_complex64(pairs)
     refined = []
+    ws = {}
     for c in cands:
         try:
             oc = optimize_accelcand(amps, c, T, searcher.numindep)
             c.r, c.z = oc.r, oc.z
             c.power, c.sigma = oc.power, oc.sigma
+            if args.wmax:
+                from presto_tpu.search.optimize import (
+                    get_localpower, max_rzw_arr, power_at_rzw)
+                r, z, w, _ = max_rzw_arr(amps, c.r, c.z, 0.0)
+                if abs(w) <= args.wmax:
+                    # re-measure power/sigma at the jerk solution with
+                    # the same per-harmonic local normalization the
+                    # w=0 refinement used, so candidates stay ranked in
+                    # consistent units
+                    nh = c.numharm
+                    tot = sum(
+                        power_at_rzw(amps, r * h, z * h, w * h)
+                        / get_localpower(amps, r * h, z * h)
+                        for h in range(1, nh + 1))
+                    if tot > c.power:
+                        stage = int(np.log2(nh))
+                        c.r, c.z = r, z
+                        c.power = float(tot)
+                        c.sigma = float(st.candidate_sigma(
+                            tot, nh, searcher.numindep[stage]))
+                        ws[id(c)] = w
         except Exception as e:
             print("accelsearch: refinement failed for r=%.1f (%s); "
                   "keeping unrefined values" % (c.r, e))
@@ -137,7 +169,11 @@ def run(args):
     cands = remove_duplicates(refined)
 
     accelnm = "%s_ACCEL_%d" % (base, args.zmax)
-    write_accel_file(accelnm, cands, T)
+    if args.wmax:
+        accelnm += "_JERK_%d" % args.wmax
+    write_accel_file(accelnm, cands, T,
+                     ws={id(c): ws.get(id(c), 0.0) for c in cands}
+                     if args.wmax else None)
     write_cand_file(accelnm + ".cand", cands)
     print("accelsearch: %d raw -> %d final candidates -> %s"
           % (len(raw), len(cands), accelnm))

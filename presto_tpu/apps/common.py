@@ -215,3 +215,14 @@ def fil_to_inf(fb: FilterbankFile, outbase: str, N: int,
         freq=hdr.lofreq, freqband=abs(hdr.foff) * hdr.nchans,
         num_chan=hdr.nchans, chan_wid=abs(hdr.foff),
         analyzer="presto_tpu")
+
+def stream_blocklen(nchan: int, maxd: int) -> int:
+    """Streaming block length for the two-block dedispersion window.
+
+    Big blocks amortize the per-dispatch tunnel latency (~0.1-0.4 s),
+    but the [nchan, 2*blocklen] float32 device window must stay within
+    a ~256 MB budget for high-channel-count data; and the window must
+    exceed the max dedispersion delay."""
+    budget = (1 << 25) // max(nchan, 1)
+    base = max(1 << 12, min(1 << 17, budget))
+    return max(base, 1 << (maxd + 1).bit_length())

@@ -71,6 +71,9 @@ def read_hdus(path: str):
         pos = off
         done = False
         while not done:
+            if pos >= len(buf):
+                raise ValueError("truncated FITS file: header block "
+                                 "without END card at offset %d" % off)
             block = buf[pos:pos + BLOCK].decode("ascii", "replace")
             for i in range(0, BLOCK, 80):
                 card = block[i:i + 80]
@@ -164,19 +167,20 @@ def weight_psrfits(path: str, wtsfile: str) -> int:
     nrows = hdu.geti("NAXIS2")
     payload = wts.tobytes()
     with open(path, "r+b") as f:
-        base = _data_offset_of(path, hdu)
+        base = _data_offset_of(hdus, hdu)
         for r in range(nrows):
             f.seek(base + r * naxis1 + off)
             f.write(payload)
     return nrows
 
 
-def _data_offset_of(path: str, target: RawHdu) -> int:
-    """Byte offset of `target`'s data area in the file."""
+def _data_offset_of(hdus, target: RawHdu) -> int:
+    """Byte offset of `target`'s data area, from an already-parsed HDU
+    list (avoids re-reading a possibly huge file)."""
     buf_off = 0
-    for h in read_hdus(path):
+    for h in hdus:
         hdr_bytes = ((len(h.cards) * 80 + BLOCK - 1) // BLOCK) * BLOCK
-        if h.get("EXTNAME") == target.get("EXTNAME"):
+        if h is target or h.get("EXTNAME") == target.get("EXTNAME"):
             return buf_off + hdr_bytes
         dsize = ((len(h.data) + BLOCK - 1) // BLOCK) * BLOCK
         buf_off += hdr_bytes + dsize
@@ -219,9 +223,17 @@ def fitsdelcol(path: str, outpath: str, colname: str) -> None:
         row = hdu.data[r * naxis1:(r + 1) * naxis1]
         out += row[:off] + row[off + nb:]
     hdu.data = out
-    # renumber the TTYPE/TFORM/TUNIT cards above the removed index
+    # renumber EVERY indexed column keyword (TTYPE/TFORM/TUNIT plus
+    # TDIM/TSCAL/TZERO/TNULL/... as real telescope files carry)
     nf = hdu.geti("TFIELDS")
-    for key in ("TTYPE", "TFORM", "TUNIT"):
+    import re
+    prefixes = set()
+    for card in hdu.cards:
+        m = re.match(r"^(T[A-Z]+?)(\d+) *=", card)
+        if m and 1 <= int(m.group(2)) <= nf \
+                and m.group(1) != "TFIELDS":
+            prefixes.add(m.group(1))
+    for key in sorted(prefixes):
         vals = [hdu.get("%s%d" % (key, i)) for i in range(1, nf + 1)]
         for i in range(1, nf + 1):
             hdu.remove("%s%d" % (key, i))

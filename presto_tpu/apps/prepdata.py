@@ -25,7 +25,8 @@ import jax.numpy as jnp
 from presto_tpu.apps.common import (add_common_flags, open_raw,
                                     fil_to_inf, ensure_backend,
                                     pad_to_good_N, set_onoff,
-                                    make_bary_plan, set_bary_epoch)
+                                    make_bary_plan, set_bary_epoch,
+                                    stream_blocklen)
 from presto_tpu.io.datfft import write_dat
 from presto_tpu.io.maskfile import read_mask, determine_padvals
 from presto_tpu.ops import dedispersion as dd
@@ -86,10 +87,11 @@ def run(args) -> str:
     ignore = (np.asarray(parse_ranges(args.ignorechan), dtype=np.int64)
               if args.ignorechan else None)
 
-    blocklen = max(1024, 1 << (maxd + 1).bit_length())
+    blocklen = stream_blocklen(nchan, maxd)
     out = []
     clip_state = None
-    prev = np.zeros((nchan, blocklen), dtype=np.float32)
+    bins_d = jnp.asarray(bins)
+    prev = jnp.zeros((nchan, blocklen), dtype=jnp.float32)
     nread = 0
     while nread < hdr.N:
         block = fb.read_spectra(nread, blocklen)   # [T, C] ascending
@@ -105,20 +107,21 @@ def run(args) -> str:
             block = remove_zerodm(block, padvals if args.mask else None)
         if ignore is not None:
             block[:, ignore] = 0.0
-        cur = np.ascontiguousarray(block.T)        # [C, T]
-        series = np.asarray(dd.float_dedisp_block(
-            jnp.asarray(prev), jnp.asarray(cur), jnp.asarray(bins)))
+        # upload each block ONCE and carry the device array as prev
+        # (re-uploading prev doubled the host->device traffic); results
+        # stay on device and download once at the end — both directions
+        # of the tunnel pay seconds per transfer
+        cur = jnp.asarray(np.ascontiguousarray(block.T))   # [C, T]
+        series = dd.float_dedisp_block(prev, cur, bins_d)
         if nread > 0:
             out.append(series)
         prev = cur
         nread += blocklen
     # flush the final window with a zero block
-    series = np.asarray(dd.float_dedisp_block(
-        jnp.asarray(prev), jnp.zeros_like(jnp.asarray(prev)),
-        jnp.asarray(bins)))
+    series = dd.float_dedisp_block(prev, jnp.zeros_like(prev), bins_d)
     out.append(series[:blocklen - maxd] if maxd else series)
 
-    result = np.concatenate(out)
+    result = np.asarray(jnp.concatenate(out))
     # trim zero-padded tail: only N - maxd samples are fully dedispersed
     # (the prepsubband `valid` truncation, prepsubband.c:703-735 stats)
     result = result[:max(int(hdr.N) - maxd, 0)]

@@ -18,7 +18,8 @@ import numpy as np
 import jax.numpy as jnp
 
 from presto_tpu.apps.common import (add_common_flags, open_raw,
-                                    load_timeseries, ensure_backend)
+                                    load_timeseries, ensure_backend,
+                                    stream_blocklen)
 from presto_tpu.io.maskfile import read_mask, determine_padvals
 from presto_tpu.io.pfd import Pfd, write_pfd, write_bestprof
 from presto_tpu.ops import dedispersion as dd
@@ -154,7 +155,7 @@ def fold_raw(args, f, fd, fdd):
                                 abs(hdr.foff))
     chan_bins = dd.delays_to_bins(chan_del - chan_del.min(), dt)
     maxd = int(chan_bins.max())
-    blocklen = max(1024, 1 << (maxd + 1).bit_length())
+    blocklen = stream_blocklen(nchan, maxd)
 
     mask = read_mask(args.mask) if args.mask else None
     padvals = np.zeros(nchan, dtype=np.float32)
@@ -185,11 +186,14 @@ def fold_raw(args, f, fd, fdd):
             block = np.zeros((blocklen, nchan), dtype=np.float32)
         cur = jnp.asarray(np.ascontiguousarray(block.T))
         if prev is not None:
-            chunks.append(np.asarray(dd.dedisp_subbands_block(
-                prev, cur, jnp.asarray(chan_bins), nsub)))
+            # stays on device: one download at the end (the tunnel
+            # pays seconds of latency per device->host transfer)
+            chunks.append(dd.dedisp_subbands_block(
+                prev, cur, jnp.asarray(chan_bins), nsub))
         prev = cur
         nread += blocklen
-    series = np.concatenate(chunks, axis=1)[:, :int(hdr.N) - maxd]
+    series = np.asarray(
+        jnp.concatenate(chunks, axis=1)[:, :int(hdr.N) - maxd])
 
     proflen = args.proflen or _auto_proflen(1.0 / f, dt)
     cfg = FoldConfig(proflen=proflen, npart=args.npart, nsub=nsub,

@@ -19,7 +19,8 @@ import jax.numpy as jnp
 from presto_tpu.apps.common import (add_common_flags, open_raw,
                                     fil_to_inf, ensure_backend,
                                     pad_to_good_N, set_onoff,
-                                    make_bary_plan, set_bary_epoch)
+                                    make_bary_plan, set_bary_epoch,
+                                    stream_blocklen)
 from presto_tpu.io.datfft import write_dat
 from presto_tpu.io.maskfile import read_mask, determine_padvals
 from presto_tpu.ops import dedispersion as dd
@@ -92,8 +93,8 @@ def run(args):
         except OSError:
             pass
 
-    blocklen = max(1024, 1 << (max(int(chan_bins.max()),
-                                   int(dm_bins.max())) + 1).bit_length())
+    blocklen = stream_blocklen(nchan, max(int(chan_bins.max()),
+                                          int(dm_bins.max())))
     # the per-block downsampler reshapes [.., blocklen/downsamp,
     # downsamp]: round blocklen up to a multiple of the factor
     if blocklen % args.downsamp:
@@ -131,13 +132,15 @@ def run(args):
                 series = dd.float_dedisp_many_block(prev_sub, sub,
                                                     dm_bins_d)
                 series = dd.downsample_block(series, args.downsamp)
-                outs.append(np.asarray(series))
+                # stays on device: one download at the end (the tunnel
+                # pays seconds of latency per device->host transfer)
+                outs.append(series)
             prev_sub = sub
         prev_raw = cur
         nread += blocklen
         nblocks += 1
 
-    result = np.concatenate(outs, axis=1)     # [numdms, T]
+    result = np.asarray(jnp.concatenate(outs, axis=1))  # [numdms, T]
     valid = (int(hdr.N) - maxd) // args.downsamp
     result = result[:, :valid]
     if plan is not None and plan.diffbins.size:

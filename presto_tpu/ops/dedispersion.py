@@ -27,6 +27,7 @@ from __future__ import annotations
 import numpy as np
 import jax
 import jax.numpy as jnp
+from functools import partial
 
 from presto_tpu.utils.psr import doppler
 
@@ -108,6 +109,7 @@ def _gather_shifted(x2, delays, numpts):
     return jnp.take_along_axis(x2, idx, axis=1)
 
 
+@partial(jax.jit, static_argnames=("numsubbands",))
 def dedisp_subbands_block(lastdata, data, delays, numsubbands):
     """Channels -> subbands shift-and-add for one streaming block.
 
@@ -127,6 +129,7 @@ def dedisp_subbands_block(lastdata, data, delays, numsubbands):
                            numpts).sum(axis=1)
 
 
+@jax.jit
 def float_dedisp_block(lastdata, data, delays, approx_mean=0.0):
     """Subbands (or channels) -> one dedispersed series for one block.
 
@@ -141,20 +144,30 @@ def float_dedisp_block(lastdata, data, delays, approx_mean=0.0):
     return shifted.sum(axis=0) - approx_mean
 
 
+@jax.jit
 def float_dedisp_many_block(lastdata, data, delays_dm, approx_mean=0.0):
     """float_dedisp over many DM trials at once.
 
     lastdata, data: [nsub, numpts]; delays_dm: [numdms, nsub] int32.
     Returns [numdms, numpts].  This is hot loop 1b batched over the DM
     axis — the axis the sharded plan splits over devices.
+
+    Accumulated with a scan over subbands: the one-shot gather would
+    materialize a [numdms, nsub, numpts] index tensor (8+ GB for
+    512 DMs x 32 subs x 2^17-sample blocks), while the per-subband
+    gather peaks at [numdms, numpts].
     """
     nsub, numpts = lastdata.shape
     x2 = jnp.concatenate([lastdata, data], axis=1)       # [nsub, 2T]
     t = jnp.arange(numpts, dtype=jnp.int32)
-    idx = delays_dm[:, :, None] + t[None, None, :]       # [numdms, nsub, T]
-    x2b = jnp.broadcast_to(x2[None], (delays_dm.shape[0],) + x2.shape)
-    shifted = jnp.take_along_axis(x2b, idx, axis=2)
-    return shifted.sum(axis=1) - approx_mean
+
+    def add_sub(acc, xs):
+        row, dly = xs                                    # [2T], [numdms]
+        return acc + row[dly[:, None] + t[None, :]], None
+
+    acc0 = jnp.zeros((delays_dm.shape[0], numpts), x2.dtype)
+    out, _ = jax.lax.scan(add_sub, acc0, (x2, delays_dm.T))
+    return out - approx_mean
 
 
 def dedisperse_series(data, delays):
@@ -174,6 +187,7 @@ def dedisperse_series(data, delays):
     return jnp.take_along_axis(x, idx, axis=1).sum(axis=0)
 
 
+@partial(jax.jit, static_argnames=("factor",))
 def downsample_block(x, factor):
     """Time-average consecutive groups of `factor` samples.
 

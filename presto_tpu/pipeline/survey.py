@@ -123,7 +123,7 @@ def run_survey(rawfiles: Sequence[str], cfg: SurveyConfig,
     if cfg.zaplist:
         from presto_tpu.apps.zapbirds import main as zap_main
         for f in fftfiles:
-            zap_main(["-zapfile", cfg.zaplist, f])
+            zap_main(["-zap", "-zapfile", cfg.zaplist, f])
 
     # ---- 6. accelsearch ----------------------------------------------
     from presto_tpu.apps.accelsearch import main as accel_main

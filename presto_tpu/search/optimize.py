@@ -79,6 +79,60 @@ def power_at_rz(amps: np.ndarray, r: float, z: float) -> float:
     return a.real * a.real + a.imag * a.imag
 
 
+def rzw_interp(amps: np.ndarray, r: float, z: float,
+               w: float) -> complex:
+    """Complex amplitude at fractional (r, z, w) — the jerk dimension
+    added via gen_w_response (rzwinterp.c analog; w = fdotdot*T^3)."""
+    if abs(w) < 1e-6:
+        return rz_interp(amps, r, z)
+    rint = int(np.floor(r))
+    frac = r - rint
+    hw = resp.w_resp_halfwidth(z, w, resp.HIGHACC)
+    numkern = 2 * hw
+    kern = resp.gen_w_response(frac, 1, z, w, numkern)
+    lobin = rint - numkern // 2
+    lo, hi = max(lobin, 0), min(lobin + numkern, amps.shape[0])
+    if hi <= lo:
+        return 0.0 + 0.0j
+    seg = np.zeros(numkern, dtype=np.complex128)
+    seg[lo - lobin:hi - lobin] = amps[lo:hi]
+    return complex(np.dot(seg, np.conj(kern)))
+
+
+def power_at_rzw(amps: np.ndarray, r: float, z: float,
+                 w: float) -> float:
+    a = rzw_interp(amps, r, z, w)
+    return a.real * a.real + a.imag * a.imag
+
+
+def max_rzw_arr(amps: np.ndarray, rin: float, zin: float,
+                win: float = 0.0):
+    """Refine (r, z, w) to the local power maximum (maximize_rzw.c's
+    amoeba made a 3-D Nelder-Mead).  Returns (r, z, w, power).
+
+    From a w=0 seed (the accel search's handover) the power surface
+    often has a shoulder, so the simplex is launched with both w-step
+    signs and the better solution wins.
+    """
+    def neg(x):
+        return -power_at_rzw(amps, x[0], x[1], x[2])
+
+    best = None
+    for wstep in (20.0, -20.0):
+        res = minimize(
+            neg, np.array([rin, zin, win]), method="Nelder-Mead",
+            options={"xatol": 1e-5, "fatol": 1e-8,
+                     "initial_simplex": np.array(
+                         [[rin, zin, win],
+                          [rin + 0.4, zin, win],
+                          [rin, zin + 0.8, win],
+                          [rin, zin, win + wstep]])})
+        if best is None or res.fun < best.fun:
+            best = res
+    r, z, w = best.x
+    return float(r), float(z), float(w), float(-best.fun)
+
+
 def corr_rz_plane(amps: np.ndarray, rlo: float, rhi: float, dr: float,
                   zlo: float, zhi: float, dz: float) -> np.ndarray:
     """Power patch P[iz, ir] over an (r, z) grid (explorefft-style zoom;

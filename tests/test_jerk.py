@@ -1,0 +1,95 @@
+"""(r, z, w) jerk interpolation/refinement (rzwinterp.c /
+maximize_rzw.c analog).
+
+Convention (matches gen_w_response / the reference): for a signal with
+phase f0*t + fd*t^2/2 + fdd*t^3/6, the response peaks at
+  r = (f0 + fd*T/2 + fdd*T^2/6) * T    (MEAN frequency x T)
+  z = (fd + fdd*T/2) * T^2             (MEAN fdot x T^2)
+  w = fdd * T^3
+"""
+
+import numpy as np
+import pytest
+
+from presto_tpu.search.optimize import (max_rzw_arr, power_at_rz,
+                                        power_at_rzw)
+
+RNG = np.random.default_rng(61)
+
+N, DT = 1 << 17, 1e-4
+T = N * DT
+
+
+def _jerk_signal(f0=234.567, z_sig=0.0, w_sig=0.0, amp=1.0, noise=0.0):
+    fd = z_sig / (T * T)
+    fdd = w_sig / (T ** 3)
+    t = np.arange(N) * DT
+    ph = 2 * np.pi * (f0 * t + fd * t ** 2 / 2 + fdd * t ** 3 / 6)
+    x = amp * np.cos(ph)
+    if noise:
+        x = x + RNG.normal(0, noise, N)
+    amps = np.fft.rfft(x).astype(np.complex128)
+    r_k = (f0 + fd * T / 2 + fdd * T * T / 6) * T
+    z_k = z_sig + w_sig / 2
+    return amps, r_k, z_k
+
+
+def test_power_at_rzw_reduces_to_rz():
+    amps, r, _ = _jerk_signal()
+    assert power_at_rzw(amps, r, 0.0, 0.0) == \
+        pytest.approx(power_at_rz(amps, r, 0.0), rel=1e-12)
+
+
+def test_jerk_power_recovered_at_w():
+    """At the true (r, z, w) the interpolation recovers essentially the
+    full coherent power (N/2)^2; ignoring w loses most of it."""
+    z_sig, w_sig = 30.0, 60.0
+    amps, r_k, z_k = _jerk_signal(z_sig=z_sig, w_sig=w_sig)
+    p_full = power_at_rzw(amps, r_k, z_k, w_sig)
+    assert p_full > 0.9 * (N / 2) ** 2
+    assert p_full > 10 * power_at_rz(amps, r_k, z_k)
+
+
+def test_max_rzw_recovers_jerk():
+    z_sig, w_sig = 20.0, 40.0
+    amps, r_k, z_k = _jerk_signal(z_sig=z_sig, w_sig=w_sig)
+    # start displaced in w (the accel search hands over w=0 solutions)
+    r, z, w, power = max_rzw_arr(amps, r_k, z_k, 0.7 * w_sig)
+    assert abs(w - w_sig) < 0.15 * w_sig
+    assert abs(r - r_k) < 1.0
+    assert power > 0.9 * (N / 2) ** 2
+
+
+def test_accelsearch_wmax_cli(tmp_path):
+    """-wmax writes the _JERK_ table with the w column and improves the
+    candidate."""
+    import os
+    from presto_tpu.io import datfft
+    from presto_tpu.io.infodata import InfoData, write_inf
+    from presto_tpu.apps.accelsearch import main
+    z_sig, w_sig, f0 = 20.0, 40.0, 234.567
+    fd = z_sig / (T * T)
+    fdd = w_sig / (T ** 3)
+    t = np.arange(N) * DT
+    x = (5.0 * np.cos(2 * np.pi * (f0 * t + fd * t ** 2 / 2
+                                   + fdd * t ** 3 / 6))
+         + RNG.normal(0, 1, N)).astype(np.float32)
+    base = str(tmp_path / "jerk")
+    datfft.write_dat(base + ".dat", x)
+    write_inf(InfoData(name=base, telescope="GBT", N=N, dt=DT,
+                       freq=1400.0, chan_wid=1.0, num_chan=1,
+                       freqband=1.0, mjd_i=58000), base + ".inf")
+    assert main(["-zmax", "50", "-numharm", "1", "-wmax", "100",
+                 base + ".dat"]) == 0
+    out = base + "_ACCEL_50_JERK_100"
+    assert os.path.exists(out)
+    txt = open(out).read()
+    assert "FFT 'w'" in txt
+    rows = [ln for ln in txt.splitlines()
+            if ln.strip() and ln.split()[0].isdigit()]
+    top = rows[0].split()
+    freq = float(top[6])
+    f_mean = f0 + fd * T / 2 + fdd * T * T / 6
+    assert abs(freq - f_mean) < 0.05
+    w_col = float(top[-1])
+    assert abs(w_col - w_sig) < 0.3 * w_sig

@@ -72,3 +72,25 @@ def test_survey_is_restartable(survey_run):
                       workdir=work)
     for f in res2.datfiles:
         assert os.path.getmtime(f) == mtimes[f], "dat rebuilt"
+
+
+def test_survey_zapbirds_stage(tmp_path):
+    """The zapbirds invocation the survey makes must be accepted
+    (regression: the -zap mode flag was omitted)."""
+    import numpy as np
+    from presto_tpu.io import datfft
+    from presto_tpu.io.infodata import InfoData, write_inf
+    from presto_tpu.apps.zapbirds import main as zap_main
+    n = 1 << 14
+    rng = np.random.default_rng(0)
+    amps = (rng.normal(0, 1, 2 * n).astype(np.float32)
+            .view(np.complex64))
+    base = str(tmp_path / "z")
+    datfft.write_fft(base + ".fft", amps)
+    write_inf(InfoData(name=base, telescope="GBT", N=2 * n, dt=1e-4,
+                       freq=1400.0, chan_wid=1.0, num_chan=1,
+                       freqband=1.0, mjd_i=58000), base + ".inf")
+    zapfile = str(tmp_path / "birds.txt")
+    open(zapfile, "w").write("60.0 1.0\n")
+    assert zap_main(["-zap", "-zapfile", zapfile,
+                     base + ".fft"]) in (0, None)
