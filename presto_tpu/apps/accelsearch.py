@@ -50,29 +50,30 @@ def build_parser():
 
 def write_cand_file(path: str, cands) -> None:
     """Binary .cand dump: one record per candidate of
-    (power f4, sigma f4, numharm i4, r f8, z f8)."""
+    (power f4, sigma f4, numharm i4, r f8, z f8, w f8)."""
     with open(path, "wb") as f:
         for c in cands:
-            f.write(struct.pack("<ffidd", c.power, c.sigma, c.numharm,
-                                c.r, c.z))
+            f.write(struct.pack("<ffiddd", c.power, c.sigma, c.numharm,
+                                c.r, c.z, c.w))
 
 
 def read_cand_file(path: str):
     from presto_tpu.search.accel import AccelCand
     out = []
-    rec = struct.calcsize("<ffidd")
+    rec = struct.calcsize("<ffiddd")
     with open(path, "rb") as f:
         while True:
             b = f.read(rec)
             if len(b) < rec:
                 break
-            power, sigma, numharm, r, z = struct.unpack("<ffidd", b)
+            power, sigma, numharm, r, z, w = struct.unpack("<ffiddd", b)
             out.append(AccelCand(power=power, sigma=sigma,
-                                 numharm=numharm, r=r, z=z))
+                                 numharm=numharm, r=r, z=z, w=w))
     return out
 
 
-def write_accel_file(path: str, cands, T: float, ws=None) -> None:
+def write_accel_file(path: str, cands, T: float,
+                     with_w: bool = False) -> None:
     """Text table with the reference's column structure
     (output_fundamentals, accel_utils.c:565-718); jerk runs append an
     FFT 'w' column."""
@@ -80,12 +81,12 @@ def write_accel_file(path: str, cands, T: float, ws=None) -> None:
         f.write("             Summed  Coherent  Num        Period      "
                 "    Frequency         FFT 'r'        Freq Deriv      "
                 "FFT 'z'      Accel    "
-                + ("  FFT 'w'   " if ws is not None else "") + "\n")
+                + ("  FFT 'w'   " if with_w else "") + "\n")
         f.write("Cand  Sigma   Power    Power   Harm       (ms)        "
                 "      (Hz)            (bin)           (Hz/s)         "
                 "(bins)      (m/s^2)  "
-                + ("  (bins)    " if ws is not None else "") + "\n")
-        f.write("-" * (142 if ws is not None else 130) + "\n")
+                + ("  (bins)    " if with_w else "") + "\n")
+        f.write("-" * (142 if with_w else 130) + "\n")
         for i, c in enumerate(cands, 1):
             freq = c.r / T
             period_ms = 1000.0 / freq if freq > 0 else 0.0
@@ -96,8 +97,8 @@ def write_accel_file(path: str, cands, T: float, ws=None) -> None:
                     % (i, c.sigma, c.power, c.power / c.numharm,
                        c.numharm, period_ms, freq, c.r, fdot, c.z,
                        accel))
-            if ws is not None:
-                f.write("  %-10.2f" % ws.get(id(c), 0.0))
+            if with_w:
+                f.write("  %-10.2f" % c.w)
             f.write("\n")
 
 
@@ -124,7 +125,8 @@ def run(args):
         amps = zap_bins(amps, birds_to_bin_ranges(birds, T, args.baryv))
         pairs = fftpack.np_complex64_to_pairs(amps)
 
-    cfg = AccelConfig(zmax=args.zmax, numharm=args.numharm,
+    cfg = AccelConfig(zmax=args.zmax, wmax=args.wmax,
+                      numharm=args.numharm,
                       sigma=args.sigma, flo=args.flo, rlo=args.rlo,
                       rhi=args.rhi)
     searcher = AccelSearch(cfg, T=T, numbins=numbins)
@@ -135,7 +137,6 @@ def run(args):
     # (optimize_accelcand, accel_utils.c:465-525) on host float64.
     amps = fftpack.np_pairs_to_complex64(pairs)
     refined = []
-    ws = {}
     for c in cands:
         try:
             oc = optimize_accelcand(amps, c, T, searcher.numindep)
@@ -144,7 +145,7 @@ def run(args):
             if args.wmax:
                 from presto_tpu.search.optimize import (
                     get_localpower, max_rzw_arr, power_at_rzw)
-                r, z, w, _ = max_rzw_arr(amps, c.r, c.z, 0.0)
+                r, z, w, _ = max_rzw_arr(amps, c.r, c.z, c.w)
                 if abs(w) <= args.wmax:
                     # re-measure power/sigma at the jerk solution with
                     # the same per-harmonic local normalization the
@@ -157,11 +158,10 @@ def run(args):
                         for h in range(1, nh + 1))
                     if tot > c.power:
                         stage = int(np.log2(nh))
-                        c.r, c.z = r, z
+                        c.r, c.z, c.w = r, z, float(w)
                         c.power = float(tot)
                         c.sigma = float(st.candidate_sigma(
                             tot, nh, searcher.numindep[stage]))
-                        ws[id(c)] = w
         except Exception as e:
             print("accelsearch: refinement failed for r=%.1f (%s); "
                   "keeping unrefined values" % (c.r, e))
@@ -171,9 +171,7 @@ def run(args):
     accelnm = "%s_ACCEL_%d" % (base, args.zmax)
     if args.wmax:
         accelnm += "_JERK_%d" % args.wmax
-    write_accel_file(accelnm, cands, T,
-                     ws={id(c): ws.get(id(c), 0.0) for c in cands}
-                     if args.wmax else None)
+    write_accel_file(accelnm, cands, T, with_w=bool(args.wmax))
     write_cand_file(accelnm + ".cand", cands)
     print("accelsearch: %d raw -> %d final candidates -> %s"
           % (len(raw), len(cands), accelnm))

@@ -234,13 +234,21 @@ def fitsdelcol(path: str, outpath: str, colname: str) -> None:
                 and m.group(1) != "TFIELDS":
             prefixes.add(m.group(1))
     for key in sorted(prefixes):
-        vals = [hdu.get("%s%d" % (key, i)) for i in range(1, nf + 1)]
+        # carry each card's RAW value+comment field verbatim so numeric
+        # keywords (TSCAL/TZERO/TNULL/TBCOL) keep their FITS type —
+        # re-quoting them would corrupt the header
+        raws = {}
+        for card in hdu.cards:
+            m = re.match(r"^%s(\d+) *= (.*)$" % key, card)
+            if m and 1 <= int(m.group(1)) <= nf:
+                raws[int(m.group(1))] = m.group(2)
+        vals = [raws.get(i) for i in range(1, nf + 1)]
         for i in range(1, nf + 1):
             hdu.remove("%s%d" % (key, i))
         vals.pop(ci)
         for i, v in enumerate(vals, 1):
             if v is not None:
-                hdu.set("%s%d" % (key, i), "'%s'" % v)
+                hdu.set("%s%d" % (key, i), v.rstrip())
     hdu.set("TFIELDS", nf - 1)
     hdu.set("NAXIS1", naxis1 - nb)
     write_hdus(outpath, hdus)

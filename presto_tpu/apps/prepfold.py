@@ -103,7 +103,14 @@ def _fold_params(args, T: float, obs=None):
             raise SystemExit("accelcand %d not in %s"
                              % (args.accelcand, args.accelfile))
         c = cands[idx]
-        return c.r / T, c.z / (T * T), 0.0
+        # accel candidates quote MEAN values over the observation
+        # (r = mean-f*T, z = mean-fdot*T^2, w = fdd*T^3 — the
+        # gen_z/w_response convention); the fold's phase polynomial
+        # wants the t=0 Taylor coefficients
+        fdd = c.w / (T * T * T)
+        fd0 = (c.z - c.w / 2.0) / (T * T)
+        f0 = (c.r - c.z / 2.0 + c.w / 12.0) / T
+        return f0, fd0, fdd
     if args.f > 0:
         return args.f, args.fd, args.fdd
     if args.p > 0:
@@ -169,6 +176,7 @@ def fold_raw(args, f, fd, fdd):
     clip_state = None
     prev = None
     chunks = []
+    chan_bins_d = jnp.asarray(chan_bins)   # upload the delays once
     nread = 0
     while nread < hdr.N + blocklen:
         if nread < hdr.N:
@@ -189,7 +197,7 @@ def fold_raw(args, f, fd, fdd):
             # stays on device: one download at the end (the tunnel
             # pays seconds of latency per device->host transfer)
             chunks.append(dd.dedisp_subbands_block(
-                prev, cur, jnp.asarray(chan_bins), nsub))
+                prev, cur, chan_bins_d, nsub))
         prev = cur
         nread += blocklen
     series = np.asarray(

@@ -56,10 +56,24 @@ def z_resp_halfwidth(z: float, accuracy: int = LOWACC) -> int:
 
 
 def w_resp_halfwidth(z: float, w: float, accuracy: int = LOWACC) -> int:
-    """Kernel half width for linearly-varying fdot (constant fdotdot)."""
+    """Kernel half width for linearly-varying fdot (constant fdotdot).
+
+    The response spans the instantaneous-frequency excursion of the
+    kernel's phase model nu(u) = (-z/2 + w/12) + (z - w/2) u +
+    (w/2) u^2 over u in [0, 1] (the continuous model gen_w_response
+    integrates), plus the interpolation wings (responses.c:68-141
+    bounds the same excursion)."""
     if abs(w) < 1.0e-7:
         return z_resp_halfwidth(z, accuracy)
-    return int(abs(z)) + r_resp_halfwidth(accuracy)
+    nu0 = -z / 2.0 + w / 12.0
+    nu1 = z / 2.0 + w / 12.0
+    ext = max(abs(nu0), abs(nu1))
+    if abs(w) > 1e-12:
+        ustar = (w / 2.0 - z) / w
+        if 0.0 < ustar < 1.0:
+            nus = nu0 + (z - w / 2.0) * ustar + (w / 2.0) * ustar ** 2
+            ext = max(ext, abs(nus))
+    return int(np.ceil(ext)) + r_resp_halfwidth(accuracy)
 
 
 def gen_r_response(roffset: float, numbetween: int,

@@ -72,19 +72,26 @@ def index_from_z(z: float, loz: float) -> int:
 
 
 def calc_fftlen(numharm: int, harmnum: int, max_zfull: int,
-                uselen: int = ACCEL_USELEN) -> int:
-    """FFT length for a subharmonic block (accel_utils.c:116-131)."""
+                uselen: int = ACCEL_USELEN,
+                max_wfull: int = 0) -> int:
+    """FFT length for a subharmonic block (accel_utils.c:116-131;
+    jerk-search banks size for the widest w kernel)."""
     harm_fract = harmnum / numharm
     bins_needed = uselen * harmnum // numharm + 2
-    end_effects = 2 * ACCEL_NUMBETWEEN * \
-        resp.z_resp_halfwidth(calc_required_z(harm_fract, max_zfull),
-                              resp.LOWACC)
+    z_req = calc_required_z(harm_fract, max_zfull)
+    hw = (resp.w_resp_halfwidth(z_req, max_wfull, resp.LOWACC)
+          if max_wfull else resp.z_resp_halfwidth(z_req, resp.LOWACC))
+    end_effects = 2 * ACCEL_NUMBETWEEN * hw
     return next2_to_n(bins_needed + end_effects)
+
+
+ACCEL_DW = 20                    # w grid step of the jerk search
 
 
 @dataclass
 class AccelConfig:
     zmax: int = 200              # max |z| searched (fundamental)
+    wmax: int = 0                # max |w| of the jerk search (0 = off)
     numharm: int = 8             # max harmonics summed (power of two)
     sigma: float = 2.0           # candidate sigma cutoff
     rlo: float = 0.0             # min Fourier freq searched (bins);
@@ -102,6 +109,14 @@ class AccelConfig:
     def numz(self) -> int:
         return (self.zmax // ACCEL_DZ) * 2 + 1
 
+    @property
+    def ws(self) -> np.ndarray:
+        """Jerk-search w grid (empty when wmax == 0)."""
+        if not self.wmax:
+            return np.zeros(1)
+        nside = self.wmax // ACCEL_DW
+        return (np.arange(2 * nside + 1) - nside) * float(ACCEL_DW)
+
 
 @dataclass
 class AccelKernels:
@@ -113,22 +128,36 @@ class AccelKernels:
     kern_pairs: np.ndarray       # [numz, fftlen, 2] float32, FFT'd
 
     @classmethod
-    def build(cls, cfg: AccelConfig) -> "AccelKernels":
+    def build(cls, cfg: AccelConfig, w: float = 0.0) -> "AccelKernels":
         """Parity: init_kernel (accel_utils.c:133-151) for harm 1/1.
 
         One kernel per z in [-zmax, zmax] step ACCEL_DZ; each is the
-        float64 z-response placed NR-style into an fftlen array and
-        forward-FFT'd (kernels are shared across all r-blocks).
+        float64 z-response (or w-response for the jerk search's w != 0
+        planes) placed NR-style into an fftlen array and forward-FFT'd
+        (kernels are shared across all r-blocks).  All w planes of one
+        search share the fftlen sized for the widest kernel so the
+        plane builder compiles once.
         """
-        fftlen = calc_fftlen(1, 1, cfg.zmax, cfg.uselen)
-        halfwidth = resp.z_resp_halfwidth(float(cfg.zmax), resp.LOWACC)
+        fftlen = calc_fftlen(1, 1, cfg.zmax, cfg.uselen, cfg.wmax)
+        halfwidth = (resp.w_resp_halfwidth(float(cfg.zmax),
+                                           float(cfg.wmax), resp.LOWACC)
+                     if cfg.wmax else
+                     resp.z_resp_halfwidth(float(cfg.zmax), resp.LOWACC))
         numz = cfg.numz
         kerns = np.empty((numz, fftlen), dtype=np.complex128)
         for i in range(numz):
             z = -cfg.zmax + i * ACCEL_DZ
-            hw = resp.z_resp_halfwidth(float(z), resp.LOWACC)
-            numkern = 2 * ACCEL_NUMBETWEEN * hw
-            k = resp.gen_z_response(0.0, ACCEL_NUMBETWEEN, float(z), numkern)
+            if abs(w) < 1e-7:
+                hw = resp.z_resp_halfwidth(float(z), resp.LOWACC)
+                numkern = 2 * ACCEL_NUMBETWEEN * hw
+                k = resp.gen_z_response(0.0, ACCEL_NUMBETWEEN, float(z),
+                                        numkern)
+            else:
+                hw = resp.w_resp_halfwidth(float(z), float(w),
+                                           resp.LOWACC)
+                numkern = min(2 * ACCEL_NUMBETWEEN * hw, fftlen)
+                k = resp.gen_w_response(0.0, ACCEL_NUMBETWEEN, float(z),
+                                        float(w), numkern)
             kerns[i] = np.fft.fft(resp.place_complex_kernel(k, fftlen))
         pairs = np.stack([kerns.real, kerns.imag], axis=-1).astype(np.float32)
         return cls(fftlen=fftlen, halfwidth=halfwidth, numz=numz,
@@ -285,6 +314,7 @@ class AccelCand:
     numharm: int
     r: float           # fundamental-search r / numharm (candidate freq bin)
     z: float
+    w: float = 0.0     # jerk plane of origin (0 unless wmax search)
 
     def freq(self, T: float) -> float:
         return self.r / T
@@ -305,6 +335,7 @@ class AccelSearch:
         self.kern = AccelKernels.build(cfg)
         self._fn_cache = {}   # compiled build/scan fns (avoid re-jit)
         self._kern_dev = None  # device copy of the kernel bank (lazy)
+        self._w_banks = {0.0: self.kern}   # jerk-search kernel banks
         self.rlo = cfg.rlo if cfg.rlo > 0 else max(cfg.flo * T, 8.0)
         self.rhi = cfg.rhi if cfg.rhi > 0 else numbins - 1
         # numindep & powcut per stage (accel_utils.c:1629-1641)
@@ -317,6 +348,9 @@ class AccelSearch:
             else:
                 ni = ((self.rhi - self.rlo) * (cfg.numz + 1) *
                       (ACCEL_DZ / 6.95) / harmtosum)
+            # jerk search: each w plane is (approximately) another set
+            # of independent trials
+            ni *= len(cfg.ws)
             self.numindep.append(ni)
             self.powcut.append(float(st.power_for_sigma(
                 cfg.sigma, harmtosum, ni)))
@@ -338,7 +372,8 @@ class AccelSearch:
             startr += step
         return blocks
 
-    def build_plane(self, fft_pairs: np.ndarray):
+    def build_plane(self, fft_pairs: np.ndarray,
+                    kern_pairs_dev=None):
         """Fundamental F-Fdot plane P[numz, plane_numr] — a device
         array resident in HBM (host transfers of the multi-GB plane
         through the host<->TPU link would dominate the search time).
@@ -357,9 +392,10 @@ class AccelSearch:
             # spectrum too short for one full block: empty plane
             return jnp.zeros((kern.numz, 0), dtype=jnp.float32)
         numdata = kern.fftlen // 2
-        if self._kern_dev is None:   # one upload; reused by cached fns
-            self._kern_dev = jnp.asarray(kern.kern_pairs)
-        kern_dev = self._kern_dev
+        if kern_pairs_dev is None:
+            if self._kern_dev is None:   # one upload, reused
+                self._kern_dev = jnp.asarray(kern.kern_pairs)
+            kern_pairs_dev = self._kern_dev
         plane_numr = int(2 * int(starts[-1]) + cfg.uselen)
         # Chunk the block batch: the [chunk, numz, fftlen] complex
         # intermediate is the peak working memory, so bound it (~1 GB
@@ -393,7 +429,10 @@ class AccelSearch:
             idx = lobin_chunk[:, None] + jnp.arange(numdata)
             return fft_pad[idx]                 # [chunk, numdata, 2]
 
-        def chunk_slab(fft_pad, lobin_chunk):
+        # kern_dev is an ARGUMENT of the jitted builders (not a
+        # closure) so the jerk search's per-w kernel banks share one
+        # compiled function
+        def chunk_slab(fft_pad, lobin_chunk, kern_dev):
             batch = gather_windows(fft_pad, lobin_chunk)
             norms = _block_median_norms(batch)
             powers = _ffdot_blocks(batch * norms, kern_dev, cfg.uselen,
@@ -401,7 +440,10 @@ class AccelSearch:
             # [chunk, numz, uselen] -> [numz, chunk*uselen] slab
             return jnp.moveaxis(powers, 0, 1).reshape(kern.numz, -1)
 
-        fft_dev = jnp.asarray(np.ascontiguousarray(fft_pairs))
+        if isinstance(fft_pairs, jax.Array):
+            fft_dev = fft_pairs          # already uploaded (jerk loop)
+        else:
+            fft_dev = jnp.asarray(np.ascontiguousarray(fft_pairs))
         pads = ((pad_lo, pad_hi), (0, 0))
 
         # One device dispatch: scan over chunks inside a single jit.
@@ -415,17 +457,18 @@ class AccelSearch:
             key = ("build_ys", chunk, nsteps, plane_numr)
             if key not in self._fn_cache:
                 @jax.jit
-                def build_ys(fft_raw, lobin_chunks):
+                def build_ys(fft_raw, lobin_chunks, kern_dev):
                     fft_pad = jnp.pad(fft_raw, pads)
                     def body(_, lc):
-                        return None, chunk_slab(fft_pad, lc)
+                        return None, chunk_slab(fft_pad, lc, kern_dev)
                     _, ys = jax.lax.scan(body, None, lobin_chunks)
                     body_arr = jnp.moveaxis(ys, 0, 1).reshape(
                         kern.numz, -1)[:, :plane_numr - col0]
                     return jnp.pad(body_arr, ((0, 0), (col0, 0)))
                 self._fn_cache[key] = build_ys
             return self._fn_cache[key](fft_dev,
-                                       jnp.asarray(lobin_chunks))
+                                       jnp.asarray(lobin_chunks),
+                                       kern_pairs_dev)
 
         # carry fallback: per-step in-place slab writes over REAL
         # blocks only (the final chunk overlaps backwards so no padded
@@ -446,11 +489,12 @@ class AccelSearch:
         key = ("build", chunk, nsteps, plane_numr)
         if key not in self._fn_cache:
             @partial(jax.jit, donate_argnums=(0,))
-            def build_all(pl, fft_raw, lobin_chunks, start_cols):
+            def build_all(pl, fft_raw, lobin_chunks, start_cols,
+                          kern_dev):
                 fft_pad = jnp.pad(fft_raw, pads)
                 def body(pl, xs):
                     lc, start_col = xs
-                    slabv = chunk_slab(fft_pad, lc)
+                    slabv = chunk_slab(fft_pad, lc, kern_dev)
                     return jax.lax.dynamic_update_slice(
                         pl, slabv, (0, start_col)), None
                 pl, _ = jax.lax.scan(body, pl,
@@ -460,7 +504,8 @@ class AccelSearch:
 
         return self._fn_cache[key](plane, fft_dev,
                                    jnp.asarray(lobin_chunks),
-                                   jnp.asarray(start_cols))
+                                   jnp.asarray(start_cols),
+                                   kern_pairs_dev)
 
     # -- search --------------------------------------------------------
 
@@ -469,6 +514,11 @@ class AccelSearch:
                slab: int = 1 << 19) -> List[AccelCand]:
         """Run the full staged harmonic-summing search.
 
+        With cfg.wmax set this is the JERK search: one F-Fdot plane per
+        w on the ACCEL_DW grid (each with w-response kernels), searched
+        independently and merged — the reference jerk search's
+        (r, z, w) volume, w-plane-at-a-time so HBM holds one plane.
+
         The plane stays resident in HBM; the search region is processed
         in `slab`-column accumulator slabs (peak extra memory ~
         numz*slab floats per gather), each slab thresholded+top-k'd per
@@ -476,8 +526,36 @@ class AccelSearch:
         memory for arbitrarily long spectra.
         """
         cfg = self.cfg
+        if plane is None and cfg.wmax:
+            all_cands: List[AccelCand] = []
+            # upload the spectrum ONCE for all w planes
+            if not isinstance(fft_pairs, jax.Array):
+                fft_pairs = jnp.asarray(
+                    np.ascontiguousarray(fft_pairs))
+            for w in cfg.ws:
+                bank = self._w_banks.get(float(w))
+                if bank is None:
+                    bank = AccelKernels.build(cfg, float(w))
+                    self._w_banks[float(w)] = bank
+                pl = self.build_plane(fft_pairs,
+                                      jnp.asarray(bank.kern_pairs))
+                for c in self._search_plane(pl, slab):
+                    c.w = float(w)
+                    all_cands.append(c)
+            # same (numharm, r) found in neighboring w planes: keep the
+            # strongest (the volume's local max)
+            best = {}
+            for c in sorted(all_cands, key=lambda c: -c.sigma):
+                key = (c.numharm, c.r)
+                if key not in best:
+                    best[key] = c
+            return sorted(best.values(), key=lambda c: (-c.sigma, c.r))
         if plane is None:
             plane = self.build_plane(fft_pairs)
+        return self._search_plane(plane, slab)
+
+    def _search_plane(self, plane, slab: int) -> List[AccelCand]:
+        cfg = self.cfg
         numz, plane_numr = plane.shape
         r0 = int(self.rlo) * ACCEL_RDR          # first searched column
         numr = min(int(self.rhi) * ACCEL_RDR, plane_numr) - r0

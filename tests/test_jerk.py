@@ -93,3 +93,67 @@ def test_accelsearch_wmax_cli(tmp_path):
     assert abs(freq - f_mean) < 0.05
     w_col = float(top[-1])
     assert abs(w_col - w_sig) < 0.3 * w_sig
+
+
+def test_full_jerk_search_finds_what_rz_misses():
+    """A pulsar with w=60 (and modest z) spreads power across the
+    (r,z) plane; the FULL jerk search (one plane per w) must recover
+    it far stronger than the w=0 search."""
+    from presto_tpu.search.accel import AccelConfig, AccelSearch
+    from presto_tpu.ops import fftpack
+    Nj, dtj = 1 << 16, 1e-4
+    Tj = Nj * dtj
+    z_sig, w_sig, f0 = 4.0, 60.0, 391.3
+    fd, fdd = z_sig / (Tj * Tj), w_sig / (Tj ** 3)
+    t = np.arange(Nj) * dtj
+    x = (0.4 * np.cos(2 * np.pi * (f0 * t + fd * t ** 2 / 2
+                                   + fdd * t ** 3 / 6))
+         + RNG.normal(0, 1, Nj)).astype(np.float32)
+    import jax.numpy as jnp
+    pairs = np.asarray(fftpack.realfft_packed_pairs(
+        jnp.asarray(x - x.mean())))
+
+    def top_sigma(wmax):
+        # zmax must cover the apparent z_k = z_sig + w_sig/2 = 34
+        cfg = AccelConfig(zmax=40, wmax=wmax, numharm=1, sigma=1.5,
+                          uselen=1820)
+        s = AccelSearch(cfg, T=Tj, numbins=pairs.shape[0])
+        cands = s.search(pairs)
+        tol = 2.0
+        f_mean = f0 + fd * Tj / 2 + fdd * Tj * Tj / 6
+        mine = [c for c in cands if abs(c.r / Tj - f_mean) < tol]
+        return (mine[0].sigma, mine[0].w) if mine else (0.0, None)
+
+    s0, _ = top_sigma(0)
+    s1, w_found = top_sigma(60)
+    assert s1 > s0 + 10.0, (s0, s1)
+    assert w_found is not None and abs(w_found - w_sig) <= 20.0
+
+
+def test_accel_cand_fold_conversion(tmp_path):
+    """prepfold -accelfile must convert the candidate's MEAN-value
+    (r, z, w) into t=0 Taylor coefficients — folding an accelerated
+    pulsar with -nosearch concentrates the pulse (regression: the old
+    f = r/T mapping smeared it by z/2 turns)."""
+    import os
+    from presto_tpu.io import datfft
+    from presto_tpu.io.infodata import InfoData, write_inf
+    from presto_tpu.apps.accelsearch import main as acc
+    from presto_tpu.apps.prepfold import main as pf
+    from presto_tpu.io.bestprof import read_bestprof
+    z_sig, f0 = 24.0, 171.0
+    fdl = z_sig / (T * T)
+    t = np.arange(N) * DT
+    x = (0.7 * np.cos(2 * np.pi * (f0 * t + fdl * t ** 2 / 2))
+         + RNG.normal(0, 1, N)).astype(np.float32)
+    base = str(tmp_path / "az")
+    datfft.write_dat(base + ".dat", x)
+    write_inf(InfoData(name=base, telescope="GBT", N=N, dt=DT,
+                       freq=1400.0, chan_wid=1.0, num_chan=1,
+                       freqband=1.0, mjd_i=58000), base + ".inf")
+    assert acc(["-zmax", "40", "-numharm", "1", base + ".dat"]) == 0
+    assert pf(["-accelfile", base + "_ACCEL_40.cand", "-accelcand",
+               "1", "-nosearch", "-noplot", "-o", base + "_f",
+               base + ".dat"]) == 0
+    bp = read_bestprof(base + "_f.pfd.bestprof")
+    assert bp.chi_sqr > 5.0, bp.chi_sqr
