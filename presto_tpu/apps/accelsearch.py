@@ -60,13 +60,24 @@ def write_cand_file(path: str, cands) -> None:
 def read_cand_file(path: str):
     from presto_tpu.search.accel import AccelCand
     out = []
-    rec = struct.calcsize("<ffiddd")
+    rec = struct.calcsize("<ffiddd")          # 36: current format
+    legacy = struct.calcsize("<ffidd")        # 28: pre-jerk format
+    size = os.path.getsize(path)
+    if size % rec == 0:
+        fmt, rlen, has_w = "<ffiddd", rec, True
+    elif size % legacy == 0:
+        fmt, rlen, has_w = "<ffidd", legacy, False
+    else:
+        raise ValueError("%s: not a .cand file (size %d fits neither "
+                         "record format)" % (path, size))
     with open(path, "rb") as f:
         while True:
-            b = f.read(rec)
-            if len(b) < rec:
+            b = f.read(rlen)
+            if len(b) < rlen:
                 break
-            power, sigma, numharm, r, z, w = struct.unpack("<ffiddd", b)
+            vals = struct.unpack(fmt, b)
+            power, sigma, numharm, r, z = vals[:5]
+            w = vals[5] if has_w else 0.0
             out.append(AccelCand(power=power, sigma=sigma,
                                  numharm=numharm, r=r, z=z, w=w))
     return out
@@ -146,6 +157,7 @@ def run(args):
                 from presto_tpu.search.optimize import (
                     get_localpower, max_rzw_arr, power_at_rzw)
                 r, z, w, _ = max_rzw_arr(amps, c.r, c.z, c.w)
+                accepted = False
                 if abs(w) <= args.wmax:
                     # re-measure power/sigma at the jerk solution with
                     # the same per-harmonic local normalization the
@@ -162,6 +174,11 @@ def run(args):
                         c.power = float(tot)
                         c.sigma = float(st.candidate_sigma(
                             tot, nh, searcher.numindep[stage]))
+                        accepted = True
+                if not accepted:
+                    # r/z/power now hold the w=0 refined solution:
+                    # keep the triple self-consistent
+                    c.w = 0.0
         except Exception as e:
             print("accelsearch: refinement failed for r=%.1f (%s); "
                   "keeping unrefined values" % (c.r, e))

@@ -29,6 +29,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("-s", "--numsub", type=int, default=0)
     p.add_argument("-r", "--res", type=float, default=0.0,
                    help="Acceptable smearing (ms)")
+    p.add_argument("-o", "--plot", type=str, default=None,
+                   help="Write the smearing-vs-DM plot to this PNG")
     p.add_argument("rawfile", nargs="?", default=None,
                    help="Optional .fil to take obs params from")
     return p
@@ -50,7 +52,33 @@ def run(args):
                              numsub=args.numsub, ok_smearing=args.res)
     print(plan)
     print("Total number of DM trials: %d" % plan.total_numdms)
+    if args.plot:
+        _plot_plan(plan, obs, args.plot)
+        print("DDplan: smearing plot -> %s" % args.plot)
     return plan
+
+
+def _plot_plan(plan, obs, outfile):
+    """Smearing-vs-DM curves per method (the DDplan.py plot panel)."""
+    import numpy as np
+    import matplotlib
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+    fig, ax = plt.subplots(figsize=(8, 5))
+    for m in plan.methods:
+        dms = np.linspace(m.lodm, m.hidm, 200)
+        ax.plot(dms, m.total_smear(dms), lw=1.5,
+                label="dDM=%.3g ds=%d" % (m.ddm, m.downsamp))
+        ax.plot(dms, m.chan_smear(dms), "k:", lw=0.7)
+    ax.set_yscale("log")
+    ax.set_xlabel(r"DM (pc cm$^{-3}$)")
+    ax.set_ylabel("Smearing (ms)")
+    ax.set_title("DDplan: %.0f MHz, BW %.0f MHz, %d chan, dt %.3g us"
+                 % (obs.f_ctr, obs.bw, obs.numchan, obs.dt * 1e6))
+    ax.legend(fontsize=8)
+    fig.tight_layout()
+    fig.savefig(outfile, dpi=100)
+    plt.close(fig)
 
 
 def main(argv=None):

@@ -92,9 +92,14 @@ def run(args) -> str:
     clip_state = None
     bins_d = jnp.asarray(bins)
     prev = jnp.zeros((nchan, blocklen), dtype=jnp.float32)
+    # prefetched sequential reads where the reader supports it (the
+    # native feeder overlaps disk IO with device compute)
+    block_iter = (fb.stream_blocks(blocklen)
+                  if hasattr(fb, "stream_blocks") else None)
     nread = 0
     while nread < hdr.N:
-        block = fb.read_spectra(nread, blocklen)   # [T, C] ascending
+        block = (next(block_iter) if block_iter is not None
+                 else fb.read_spectra(nread, blocklen))  # [T, C] asc
         if mask is not None:
             n, chans = mask.check_mask(nread * dt, blocklen * dt)
             if n == -1:

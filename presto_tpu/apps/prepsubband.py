@@ -105,11 +105,16 @@ def run(args):
     prev_raw = None
     prev_sub = None
     outs = []
+    # prefetched sequential reads where the reader supports it (the
+    # native feeder overlaps disk IO with device compute)
+    block_iter = (fb.stream_blocks(blocklen)
+                  if hasattr(fb, "stream_blocks") else None)
     nread = 0
     nblocks = 0
     while nread < hdr.N + 2 * blocklen:   # two extra flush blocks
         if nread < hdr.N:
-            block = fb.read_spectra(nread, blocklen)
+            block = (next(block_iter) if block_iter is not None
+                     else fb.read_spectra(nread, blocklen))
             if mask is not None:
                 n, chans = mask.check_mask(nread * dt, blocklen * dt)
                 if n == -1:

@@ -519,6 +519,13 @@ class AccelSearch:
         independently and merged — the reference jerk search's
         (r, z, w) volume, w-plane-at-a-time so HBM holds one plane.
 
+        Approximation note: harmonic summing reads subharmonics from
+        the SAME-w plane, i.e. each subharmonic is measured with the
+        stack's w kernel rather than its own w*harm/numharm kernel
+        (the reference builds per-subharmonic w kernels).  High-
+        harmonic jerk sensitivity is therefore below the reference's;
+        numharm=1..2 jerk searches are unaffected.
+
         The plane stays resident in HBM; the search region is processed
         in `slab`-column accumulator slabs (peak extra memory ~
         numz*slab floats per gather), each slab thresholded+top-k'd per
@@ -536,11 +543,14 @@ class AccelSearch:
                 bank = self._w_banks.get(float(w))
                 if bank is None:
                     bank = AccelKernels.build(cfg, float(w))
-                    self._w_banks[float(w)] = bank
+                    if len(self._w_banks) < 8:   # bound host RAM
+                        self._w_banks[float(w)] = bank
                 pl = self.build_plane(fft_pairs,
                                       jnp.asarray(bank.kern_pairs))
                 for c in self._search_plane(pl, slab):
-                    c.w = float(w)
+                    # the plane cell is the numharm-th harmonic: its
+                    # (r, z, w) all scale down to the fundamental
+                    c.w = float(w) / c.numharm
                     all_cands.append(c)
             # same (numharm, r) found in neighboring w planes: keep the
             # strongest (the volume's local max)

@@ -135,3 +135,23 @@ def test_block_feeder_reads_whole_file(tmp_path):
     assert sum(len(b) for b in got) == payload.size
     assert len(got[-1]) == payload.size % 1024
     assert np.array_equal(np.concatenate(got), payload)
+
+
+def test_stream_blocks_matches_read_spectra(tmp_path):
+    """The prefetched stream must deliver exactly what blockwise
+    read_spectra delivers, including the zero-padded tail."""
+    nchan, nspec = 16, 5000
+    hdr = sigproc.FilterbankHeader(
+        nchans=nchan, nifs=1, nbits=8, tsamp=1e-4,
+        fch1=1500.0, foff=-1.0, tstart=55000.0, source_name="s")
+    data = RNG.integers(0, 255, size=(nspec, nchan)).astype(np.float32)
+    path = str(tmp_path / "s.fil")
+    sigproc.write_filterbank(path, hdr, data)
+    blocklen = 1024
+    with sigproc.FilterbankFile(path) as f:
+        streamed = list(f.stream_blocks(blocklen))
+        direct = list(f.iter_blocks(blocklen))
+    assert len(streamed) == len(direct)
+    for a, b in zip(streamed, direct):
+        assert a.shape == b.shape == (blocklen, nchan)
+        assert np.array_equal(a, b)

@@ -187,3 +187,13 @@ def test_readfile_cli(tmp_path, capsys):
     out = capsys.readouterr().out
     assert "N=4096" in out
     assert "Telescope" in out
+
+
+def test_ddplan_plot(tmp_path):
+    from presto_tpu.apps.ddplan import main
+    out = str(tmp_path / "plan.png")
+    assert main(["-l", "0", "-d", "200", "-f", "1400", "-b", "100",
+                 "-n", "128", "-t", "1e-4", "-s", "16",
+                 "-o", out]) in (0, None)
+    with open(out, "rb") as f:
+        assert f.read(4) == b"\x89PNG"
