@@ -63,24 +63,42 @@ def read_cand_file(path: str):
     rec = struct.calcsize("<ffiddd")          # 36: current format
     legacy = struct.calcsize("<ffidd")        # 28: pre-jerk format
     size = os.path.getsize(path)
+
+    def parse(fmt, rlen, has_w):
+        cands = []
+        with open(path, "rb") as f:
+            while True:
+                b = f.read(rlen)
+                if len(b) < rlen:
+                    break
+                vals = struct.unpack(fmt, b)
+                power, sigma, numharm, r, z = vals[:5]
+                w = vals[5] if has_w else 0.0
+                cands.append(AccelCand(power=power, sigma=sigma,
+                                       numharm=numharm, r=r, z=z, w=w))
+        return cands
+
+    def sane(cands):
+        return cands and all(
+            1 <= c.numharm <= 32 and c.r >= 0.0
+            and np.isfinite(c.power) and np.isfinite(c.r)
+            for c in cands)
+
+    # a size divisible by lcm(36, 28) fits both formats: pick the one
+    # whose records are plausible (new format first)
+    candidates = []
     if size % rec == 0:
-        fmt, rlen, has_w = "<ffiddd", rec, True
-    elif size % legacy == 0:
-        fmt, rlen, has_w = "<ffidd", legacy, False
-    else:
+        candidates.append(("<ffiddd", rec, True))
+    if size % legacy == 0:
+        candidates.append(("<ffidd", legacy, False))
+    if not candidates:
         raise ValueError("%s: not a .cand file (size %d fits neither "
                          "record format)" % (path, size))
-    with open(path, "rb") as f:
-        while True:
-            b = f.read(rlen)
-            if len(b) < rlen:
-                break
-            vals = struct.unpack(fmt, b)
-            power, sigma, numharm, r, z = vals[:5]
-            w = vals[5] if has_w else 0.0
-            out.append(AccelCand(power=power, sigma=sigma,
-                                 numharm=numharm, r=r, z=z, w=w))
-    return out
+    for fmt, rlen, has_w in candidates:
+        out = parse(fmt, rlen, has_w)
+        if sane(out):
+            return out
+    return parse(*candidates[-1])
 
 
 def write_accel_file(path: str, cands, T: float,

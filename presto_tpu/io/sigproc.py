@@ -243,19 +243,27 @@ class FilterbankFile:
         self.f.seek(hdr.headerlen + start * bps)
         navail = max(0, min(count, hdr.N - start))
         raw = np.frombuffer(self.f.read(navail * bps), dtype=np.uint8)
-        arr = native.decode_spectra(raw, navail, hdr.nifs, hdr.nchans,
-                                    hdr.nbits, hdr.foff < 0)
-        if arr is None:
-            vals = unpack_bits(raw, hdr.nbits)
-            arr = vals.astype(np.float32).reshape(navail, hdr.nifs,
-                                                  hdr.nchans)
-            arr = arr.sum(axis=1) if hdr.nifs > 1 else arr[:, 0, :]
-            if hdr.foff < 0:
-                arr = arr[:, ::-1]
+        arr = self._decode_raw(raw, navail)
         if navail < count:
             pad = np.zeros((count - navail, hdr.nchans), dtype=np.float32)
             arr = np.concatenate([arr, pad], axis=0)
         return np.ascontiguousarray(arr)
+
+    def _decode_raw(self, raw: np.ndarray, nspec: int) -> np.ndarray:
+        """Packed bytes -> [nspec, nchans] float32 ascending (the ONE
+        decode sequence shared by the random-access and prefetched
+        read paths)."""
+        hdr = self.header
+        arr = native.decode_spectra(raw, nspec, hdr.nifs, hdr.nchans,
+                                    hdr.nbits, hdr.foff < 0)
+        if arr is None:
+            vals = unpack_bits(raw, hdr.nbits)
+            arr = vals.astype(np.float32).reshape(nspec, hdr.nifs,
+                                                  hdr.nchans)
+            arr = arr.sum(axis=1) if hdr.nifs > 1 else arr[:, 0, :]
+            if hdr.foff < 0:
+                arr = np.ascontiguousarray(arr[:, ::-1])
+        return arr
 
     def iter_blocks(self, block_size: int,
                     start: int = 0) -> Iterator[np.ndarray]:
@@ -287,17 +295,7 @@ class FilterbankFile:
                 nspec = min(len(raw) // bps, total - delivered)
                 if nspec <= 0:
                     break
-                arr = native.decode_spectra(
-                    raw[:nspec * bps], nspec, hdr.nifs, hdr.nchans,
-                    hdr.nbits, hdr.foff < 0)
-                if arr is None:      # geometry fell back mid-stream
-                    vals = unpack_bits(raw[:nspec * bps], hdr.nbits)
-                    arr = vals.astype(np.float32).reshape(
-                        nspec, hdr.nifs, hdr.nchans)
-                    arr = (arr.sum(axis=1) if hdr.nifs > 1
-                           else arr[:, 0, :])
-                    if hdr.foff < 0:
-                        arr = np.ascontiguousarray(arr[:, ::-1])
+                arr = self._decode_raw(raw[:nspec * bps], nspec)
                 if nspec < block_size:
                     arr = np.concatenate(
                         [arr, np.zeros((block_size - nspec,

@@ -27,6 +27,36 @@ import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 
+def init_distributed(coordinator_address: Optional[str] = None,
+                     num_processes: Optional[int] = None,
+                     process_id: Optional[int] = None) -> int:
+    """Multi-host (DCN) initialization — the mpirun analog.
+
+    On a TPU pod slice with default env plumbing, call with no
+    arguments (jax.distributed auto-discovers the coordinator); on
+    manual clusters pass coordinator host:port and the process grid.
+    After this, jax.devices() spans every host's chips and make_mesh
+    builds one global mesh: the DM fan-out then scales across hosts
+    with the raw-block replication riding DCN exactly where
+    mpiprepsubband's MPI_Bcast did (mpiprepsubband.c:988-991).
+    Returns the process count.  Safe to call once per process.
+    """
+    manual = (coordinator_address, num_processes, process_id)
+    if any(v is not None for v in manual) and \
+            not all(v is not None for v in manual):
+        raise ValueError(
+            "init_distributed: pass ALL of coordinator_address/"
+            "num_processes/process_id for a manual cluster, or none "
+            "for auto-discovery (got %r)" % (manual,))
+    if coordinator_address is not None:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes, process_id=process_id)
+    else:
+        jax.distributed.initialize()
+    return jax.process_count()
+
+
 def make_mesh(n_devices: Optional[int] = None,
               axis_names: Sequence[str] = ("dm",),
               shape: Optional[Sequence[int]] = None) -> Mesh:
