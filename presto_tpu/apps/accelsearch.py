@@ -59,7 +59,6 @@ def write_cand_file(path: str, cands) -> None:
 
 def read_cand_file(path: str):
     from presto_tpu.search.accel import AccelCand
-    out = []
     rec = struct.calcsize("<ffiddd")          # 36: current format
     legacy = struct.calcsize("<ffidd")        # 28: pre-jerk format
     size = os.path.getsize(path)
@@ -131,6 +130,54 @@ def write_accel_file(path: str, cands, T: float,
             f.write("\n")
 
 
+def refine_and_write(raw_cands, amps, T, searcher, base, zmax,
+                     wmax=0, quiet=False):
+    """Candidate post-processing shared by the CLI and the batched
+    survey path: harmonic elimination, Fourier-domain refinement
+    (+ optional rzw jerk polish), dedup, ACCEL/.cand artifacts."""
+    cands = remove_duplicates(eliminate_harmonics(raw_cands))
+    refined = []
+    for c in cands:
+        try:
+            oc = optimize_accelcand(amps, c, T, searcher.numindep)
+            c.r, c.z = oc.r, oc.z
+            c.power, c.sigma = oc.power, oc.sigma
+            if wmax:
+                from presto_tpu.search.optimize import (
+                    get_localpower, max_rzw_arr, power_at_rzw)
+                r, z, w, _ = max_rzw_arr(amps, c.r, c.z, c.w)
+                accepted = False
+                if abs(w) <= wmax:
+                    nh = c.numharm
+                    tot = sum(
+                        power_at_rzw(amps, r * h, z * h, w * h)
+                        / get_localpower(amps, r * h, z * h)
+                        for h in range(1, nh + 1))
+                    if tot > c.power:
+                        stage = int(np.log2(nh))
+                        c.r, c.z, c.w = r, z, float(w)
+                        c.power = float(tot)
+                        c.sigma = float(st.candidate_sigma(
+                            tot, nh, searcher.numindep[stage]))
+                        accepted = True
+                if not accepted:
+                    c.w = 0.0
+        except Exception as e:
+            print("accelsearch: refinement failed for r=%.1f (%s); "
+                  "keeping unrefined values" % (c.r, e))
+        refined.append(c)
+    cands = remove_duplicates(refined)
+    accelnm = "%s_ACCEL_%d" % (base, zmax)
+    if wmax:
+        accelnm += "_JERK_%d" % wmax
+    write_accel_file(accelnm, cands, T, with_w=bool(wmax))
+    write_cand_file(accelnm + ".cand", cands)
+    if not quiet:
+        print("accelsearch: %d raw -> %d final candidates -> %s"
+              % (len(raw_cands), len(cands), accelnm))
+    return cands, accelnm
+
+
 def run(args):
     ensure_backend()
     base, ext = os.path.splitext(args.infile)
@@ -160,56 +207,9 @@ def run(args):
                       rhi=args.rhi)
     searcher = AccelSearch(cfg, T=T, numbins=numbins)
     raw = searcher.search(pairs)
-    cands = remove_duplicates(eliminate_harmonics(raw))
-
-    # Fourier-domain refinement of the surviving candidates
-    # (optimize_accelcand, accel_utils.c:465-525) on host float64.
     amps = fftpack.np_pairs_to_complex64(pairs)
-    refined = []
-    for c in cands:
-        try:
-            oc = optimize_accelcand(amps, c, T, searcher.numindep)
-            c.r, c.z = oc.r, oc.z
-            c.power, c.sigma = oc.power, oc.sigma
-            if args.wmax:
-                from presto_tpu.search.optimize import (
-                    get_localpower, max_rzw_arr, power_at_rzw)
-                r, z, w, _ = max_rzw_arr(amps, c.r, c.z, c.w)
-                accepted = False
-                if abs(w) <= args.wmax:
-                    # re-measure power/sigma at the jerk solution with
-                    # the same per-harmonic local normalization the
-                    # w=0 refinement used, so candidates stay ranked in
-                    # consistent units
-                    nh = c.numharm
-                    tot = sum(
-                        power_at_rzw(amps, r * h, z * h, w * h)
-                        / get_localpower(amps, r * h, z * h)
-                        for h in range(1, nh + 1))
-                    if tot > c.power:
-                        stage = int(np.log2(nh))
-                        c.r, c.z, c.w = r, z, float(w)
-                        c.power = float(tot)
-                        c.sigma = float(st.candidate_sigma(
-                            tot, nh, searcher.numindep[stage]))
-                        accepted = True
-                if not accepted:
-                    # r/z/power now hold the w=0 refined solution:
-                    # keep the triple self-consistent
-                    c.w = 0.0
-        except Exception as e:
-            print("accelsearch: refinement failed for r=%.1f (%s); "
-                  "keeping unrefined values" % (c.r, e))
-        refined.append(c)
-    cands = remove_duplicates(refined)
-
-    accelnm = "%s_ACCEL_%d" % (base, args.zmax)
-    if args.wmax:
-        accelnm += "_JERK_%d" % args.wmax
-    write_accel_file(accelnm, cands, T, with_w=bool(args.wmax))
-    write_cand_file(accelnm + ".cand", cands)
-    print("accelsearch: %d raw -> %d final candidates -> %s"
-          % (len(raw), len(cands), accelnm))
+    cands, _ = refine_and_write(raw, amps, T, searcher, base,
+                                args.zmax, args.wmax)
     return cands
 
 

@@ -295,13 +295,24 @@ def _make_search_scanner(numharmstages, fracs_zinds, powcuts, slab, k,
         zrow = jnp.stack([o[2] for o in outs])
         return vals, cidx, zrow
 
-    @jax.jit
-    def scan_all(P, start_cols):
+    def _scan_all_py(P, start_cols):
         def body(carry, start):
             return carry, slab_body(P, start)
         _, (vals, cidx, zrow) = jax.lax.scan(body, None, start_cols)
         return vals, cidx, zrow   # [nslabs, stages, k]
 
+    scan_all = jax.jit(_scan_all_py)
+
+    @jax.jit
+    def scan_many(Ps, start_cols):
+        """Batched: Ps [numdms, numz, plane_numr] -> per-DM results in
+        ONE device dispatch (the DM fan-out of a survey search)."""
+        def per_dm(_, P):
+            return None, _scan_all_py(P, start_cols)
+        _, outs = jax.lax.scan(per_dm, None, Ps)
+        return outs               # each [numdms, nslabs, stages, k]
+
+    scan_all.many = scan_many
     return scan_all
 
 
@@ -329,6 +340,13 @@ class AccelSearch:
     """
 
     def __init__(self, cfg: AccelConfig, T: float, numbins: int):
+        # spectra shorter than one ACCEL_USELEN r-block would yield an
+        # empty search (the reference's block loop, accelsearch.c:167,
+        # simply assumes survey-length FFTs): shrink the block to fit
+        max_uselen = max(64, 2 * (numbins - 16))
+        if cfg.uselen > max_uselen:
+            from dataclasses import replace
+            cfg = replace(cfg, uselen=max_uselen)
         self.cfg = cfg
         self.T = T
         self.numbins = numbins
@@ -455,6 +473,7 @@ class AccelSearch:
         # 2x plane would crowd HBM (~16 GB on v5e).
         if (kern.numz * (plane_numr + body_numr) * 4) < 9 * 2 ** 30:
             key = ("build_ys", chunk, nsteps, plane_numr)
+            self._build_plan = (key, lobin_chunks)
             if key not in self._fn_cache:
                 @jax.jit
                 def build_ys(fft_raw, lobin_chunks, kern_dev):
@@ -486,6 +505,7 @@ class AccelSearch:
             [col0 + i * cfg.uselen for i in chunk_ids], dtype=np.int32)
         plane = jnp.zeros((kern.numz, plane_numr), dtype=jnp.float32)
 
+        self._build_plan = None     # carry fallback: no batched build
         key = ("build", chunk, nsteps, plane_numr)
         if key not in self._fn_cache:
             @partial(jax.jit, donate_argnums=(0,))
@@ -564,30 +584,40 @@ class AccelSearch:
             plane = self.build_plane(fft_pairs)
         return self._search_plane(plane, slab)
 
-    def _search_plane(self, plane, slab: int) -> List[AccelCand]:
+    def _slab_plan(self, plane_numr: int, slab: int):
+        """(slab, k, scanner, start_cols) for a plane width — the ONE
+        source of the slab/top-k layout for single and batched paths
+        (the overlap-last-slab trick keeps one jit shape)."""
         cfg = self.cfg
-        numz, plane_numr = plane.shape
-        r0 = int(self.rlo) * ACCEL_RDR          # first searched column
+        r0 = int(self.rlo) * ACCEL_RDR
         numr = min(int(self.rhi) * ACCEL_RDR, plane_numr) - r0
         if numr <= 0:
-            return []
+            return None
         slab = min(slab, numr)
-        # top-k cost grows steeply with k on TPU: keep k fixed and
-        # scale the number of slabs instead (per-slab top-k truncates
-        # only the weakest noise candidates)
         k = min(cfg.max_cands_per_stage, slab)
-        key = ("scan", slab, k, plane_numr)
-        if key not in self._fn_cache:
-            fz = _harm_fracs_and_zinds(cfg, numz)
-            self._fn_cache[key] = _make_search_scanner(
-                cfg.numharmstages, fz, self.powcut, slab, k, plane_numr)
-        scanner = self._fn_cache[key]
+        skey = ("scan", slab, k, plane_numr)
+        if skey not in self._fn_cache:
+            fz = _harm_fracs_and_zinds(cfg, self.cfg.numz)
+            self._fn_cache[skey] = _make_search_scanner(
+                cfg.numharmstages, fz, self.powcut, slab, k,
+                plane_numr)
         start_cols = []
         for off in range(0, numr, slab):
             start = r0 + off
             if off + slab > numr:               # keep one jit shape:
                 start = r0 + numr - slab        # overlap the last slab
             start_cols.append(start)
+        return slab, k, self._fn_cache[skey], start_cols
+
+    def _search_plane(self, plane, slab: int) -> List[AccelCand]:
+        # top-k cost grows steeply with k on TPU: keep k fixed and
+        # scale the number of slabs instead (per-slab top-k truncates
+        # only the weakest noise candidates)
+        numz, plane_numr = plane.shape
+        plan = self._slab_plan(plane_numr, slab)
+        if plan is None:
+            return []
+        slab, k, scanner, start_cols = plan
         dplane = jnp.asarray(plane)
         vals, cidx, zrow = scanner(dplane,
                                    jnp.asarray(start_cols, dtype=jnp.int32))
@@ -597,6 +627,10 @@ class AccelSearch:
         cands: List[AccelCand] = []
         for si, start in enumerate(start_cols):
             self._collect_slab(vals[si], cidx[si], zrow[si], start, cands)
+        return self._dedup_sort(cands)
+
+    @staticmethod
+    def _dedup_sort(cands: List[AccelCand]) -> List[AccelCand]:
         # overlapping the final slab can duplicate candidates: dedup on
         # exact (numharm, r, z)
         seen = set()
@@ -607,6 +641,74 @@ class AccelSearch:
                 seen.add(key)
                 uniq.append(c)
         return sorted(uniq, key=lambda c: (-c.sigma, c.r))
+
+    def search_many(self, pairs_batch: np.ndarray,
+                    slab: int = 1 << 19) -> List[List[AccelCand]]:
+        """Batched search over many same-length spectra — the survey's
+        DM fan-out (one plane build + one scanned search dispatch per
+        memory-budgeted DM group instead of per-trial dispatch storms;
+        the mpiprepsubband-scale path of SURVEY §2.5).
+
+        pairs_batch: [numdms, numbins, 2] float32.  Returns per-DM
+        candidate lists (same semantics as search() per spectrum).
+        """
+        cfg = self.cfg
+        batch = np.ascontiguousarray(np.asarray(pairs_batch,
+                                                np.float32))
+        nd = batch.shape[0]
+        if nd == 0:
+            return []
+        # first spectrum primes the caches and fixes the geometry
+        p0 = self.build_plane(batch[0])
+        numz, plane_numr = p0.shape
+        plan = getattr(self, "_build_plan", None)
+        if plane_numr == 0:
+            return [[] for _ in range(nd)]
+        if plan is None or cfg.wmax:
+            # carry-fallback geometry or jerk search: per-DM loop
+            return [self.search(batch[i], slab=slab)
+                    for i in range(nd)]
+        key, lobin_chunks = plan
+        build_one = self._fn_cache[key]
+        mkey = ("build_many",) + key[1:]
+        if mkey not in self._fn_cache:
+            self._fn_cache[mkey] = jax.jit(
+                jax.vmap(build_one, in_axes=(0, None, None)))
+        build_many = self._fn_cache[mkey]
+
+        splan = self._slab_plan(plane_numr, slab)
+        if splan is None:
+            return [[] for _ in range(nd)]
+        slab, k, scanner, start_cols = splan
+        scols = jnp.asarray(start_cols, dtype=jnp.int32)
+        lob = jnp.asarray(lobin_chunks)
+        if self._kern_dev is None:
+            self._kern_dev = jnp.asarray(self.kern.kern_pairs)
+
+        def collect_dm(vals, cidx, zrow):
+            cands: List[AccelCand] = []
+            for si, start in enumerate(start_cols):
+                self._collect_slab(vals[si], cidx[si], zrow[si],
+                                   start, cands)
+            return self._dedup_sort(cands)
+
+        # the priming plane p0 serves as spectrum 0's search (no
+        # discarded build)
+        out: List[List[AccelCand]] = [
+            collect_dm(*(np.asarray(a) for a in scanner(p0, scols)))]
+        del p0
+        plane_bytes = numz * plane_numr * 4
+        group = max(1, int(6 * 2 ** 30 // max(plane_bytes * 2, 1)))
+        for g0 in range(1, nd, group):
+            sub = jnp.asarray(batch[g0:g0 + group])
+            planes = build_many(sub, lob, self._kern_dev)
+            vals, cidx, zrow = scanner.many(planes, scols)
+            vals = np.asarray(vals)
+            cidx = np.asarray(cidx)
+            zrow = np.asarray(zrow)
+            for d in range(vals.shape[0]):
+                out.append(collect_dm(vals[d], cidx[d], zrow[d]))
+        return out
 
     def _collect_slab(self, vals: np.ndarray, cidx: np.ndarray,
                       zrow: np.ndarray, start_col: int,
