@@ -52,6 +52,10 @@ def run(args) -> list:
                            detrendlen=1000 * args.detrendfact,
                            fast_detrend=args.fast,
                            badblocks=not args.nobadblocks)
+    # load everything first, then batch same-(length, dt) groups
+    # through one set of device dispatches (the survey DM fan-out pays
+    # seconds of tunnel latency per dispatch otherwise)
+    loaded = []                # (fn, base, ts, info, offregions)
     for fn in args.datfiles:
         if fn.endswith(".singlepulse"):
             allcands.extend([c for c in read_singlepulse(fn)
@@ -67,14 +71,32 @@ def run(args) -> list:
             offregions = list(zip(offs[:-1], ons[1:]))
             if offregions and offregions[-1][1] >= info.N - 1:
                 ts = ts[:offregions[-1][0] + 1]
-        cands, stds, bad = sp.search(
-            np.asarray(ts, np.float32), info.dt, dm=info.dm,
-            offregions=offregions)
-        cands = [c for c in cands if args.start <= c.time <= args.end]
-        write_singlepulse(base + ".singlepulse", cands)
-        print("%s: %d pulse candidates (%d bad blocks)" %
-              (fn, len(cands), len(bad)))
-        allcands.extend(cands)
+        loaded.append((fn, base, np.asarray(ts, np.float32), info,
+                       offregions))
+
+    groups = {}
+    for item in loaded:
+        groups.setdefault((len(item[2]), item[3].dt),
+                          []).append(item)
+    for (n, dt), items in groups.items():
+        # memory budget: keep at most ~1 GB of series per batched call
+        # (the batch path holds ~3x the data in normalized/padded
+        # copies)
+        per = max(1, int(2 ** 30 // max(n * 4, 1)))
+        for g0 in range(0, len(items), per):
+            chunk = items[g0:g0 + per]
+            results = sp.search_many(
+                [it[2] for it in chunk], dt,
+                dms=[it[3].dm for it in chunk],
+                offregions_list=[it[4] for it in chunk])
+            for (fn, base, _, info, _), (cands, stds, bad) in \
+                    zip(chunk, results):
+                cands = [c for c in cands
+                         if args.start <= c.time <= args.end]
+                write_singlepulse(base + ".singlepulse", cands)
+                print("%s: %d pulse candidates (%d bad blocks)" %
+                      (fn, len(cands), len(bad)))
+                allcands.extend(cands)
     return allcands
 
 

@@ -658,14 +658,19 @@ class AccelSearch:
         nd = batch.shape[0]
         if nd == 0:
             return []
+        if cfg.wmax:
+            # jerk searches never take the batched path: go straight
+            # to the per-DM loop (no wasted priming plane build)
+            return [self.search(batch[i], slab=slab)
+                    for i in range(nd)]
         # first spectrum primes the caches and fixes the geometry
         p0 = self.build_plane(batch[0])
         numz, plane_numr = p0.shape
         plan = getattr(self, "_build_plan", None)
         if plane_numr == 0:
             return [[] for _ in range(nd)]
-        if plan is None or cfg.wmax:
-            # carry-fallback geometry or jerk search: per-DM loop
+        if plan is None:
+            # carry-fallback geometry (huge planes): per-DM loop
             return [self.search(batch[i], slab=slab)
                     for i in range(nd)]
         key, lobin_chunks = plan
@@ -699,7 +704,16 @@ class AccelSearch:
         del p0
         plane_bytes = numz * plane_numr * 4
         group = max(1, int(6 * 2 ** 30 // max(plane_bytes * 2, 1)))
-        for g0 in range(1, nd, group):
+        group = min(group, max(nd - 1, 1))
+        # back-overlap the final group so every dispatch shares ONE jit
+        # shape (the tail would otherwise retrace the two heaviest
+        # compiled programs); overlapped DMs are recomputed and their
+        # duplicate results skipped
+        starts = list(range(1, nd, group))
+        if starts and starts[-1] + group > nd:
+            starts[-1] = max(nd - group, 1)
+        done = 1
+        for g0 in starts:
             sub = jnp.asarray(batch[g0:g0 + group])
             planes = build_many(sub, lob, self._kern_dev)
             vals, cidx, zrow = scanner.many(planes, scols)
@@ -707,7 +721,10 @@ class AccelSearch:
             cidx = np.asarray(cidx)
             zrow = np.asarray(zrow)
             for d in range(vals.shape[0]):
+                if g0 + d < done:
+                    continue               # overlap: already collected
                 out.append(collect_dm(vals[d], cidx[d], zrow[d]))
+                done = g0 + d + 1
         return out
 
     def _collect_slab(self, vals: np.ndarray, cidx: np.ndarray,

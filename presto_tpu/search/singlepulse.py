@@ -259,6 +259,29 @@ class SinglePulseSearch:
         normed[bad] = 0.0
         return normed.reshape(-1), stds, bad
 
+    def _chunk_geometry(self, widths):
+        """(widths, chunklen, fftlen, overlap, kern_pairs) — the one
+        source of chunk layout for the single and batched paths."""
+        chunklen, fftlen = self.chunklen, self.fftlen
+        if self.detrendlen > chunklen:
+            chunklen = self.detrendlen
+            fftlen = int(2 ** np.ceil(np.log2(chunklen)))
+        overlap = (fftlen - chunklen) // 2
+        kf = np.fft.rfft(boxcar_kernels(widths, fftlen))
+        kern_pairs = np.stack([kf.real, kf.imag],
+                              -1).astype(np.float32)
+        return widths, chunklen, fftlen, overlap, kern_pairs
+
+    @staticmethod
+    def _padded_chunks(normed, numchunks, chunklen, overlap):
+        """Overlap-padded copy of the series for chunk extraction."""
+        N = len(normed)
+        padded = np.zeros(overlap + numchunks * chunklen + overlap,
+                          dtype=np.float32)
+        padded[overlap:overlap + min(N, numchunks * chunklen)] = \
+            normed[:numchunks * chunklen]
+        return padded
+
     def search_normalized(self, normed: np.ndarray, dt: float,
                           dm: float = 0.0,
                           downfacts: Optional[Sequence[int]] = None
@@ -266,23 +289,12 @@ class SinglePulseSearch:
         """Run the batched matched filter over an RMS=1 series."""
         if downfacts is None:
             downfacts = self.downfacts_for(dt)
-        widths = [1] + list(downfacts)
-        chunklen, fftlen = self.chunklen, self.fftlen
-        if self.detrendlen > chunklen:
-            chunklen = self.detrendlen
-            fftlen = int(2 ** np.ceil(np.log2(chunklen)))
-        overlap = (fftlen - chunklen) // 2
+        widths, chunklen, fftlen, overlap, kern_pairs = \
+            self._chunk_geometry(widths=[1] + list(downfacts))
         N = len(normed)
         numchunks = max(N // chunklen, 1)
-
-        kf = np.fft.rfft(boxcar_kernels(widths, fftlen))
-        kern_pairs = np.stack([kf.real, kf.imag], -1).astype(np.float32)
-
-        # Assemble overlapped chunks on host (zero-padded ends).
-        padded = np.zeros(overlap + numchunks * chunklen + overlap,
-                          dtype=np.float32)
-        padded[overlap:overlap + min(N, numchunks * chunklen)] = \
-            normed[:numchunks * chunklen]
+        padded = self._padded_chunks(normed, numchunks, chunklen,
+                                     overlap)
         cands: List[SPCandidate] = []
         # numpy scalar (not a device put): the tunneled-TPU backend
         # rejects bare out-of-jit scalar conversions.
@@ -298,31 +310,79 @@ class SinglePulseSearch:
             idx = np.asarray(idx)
             counts = np.asarray(counts)
             for ci in range(c1 - c0):
-                chunknum = c0 + ci
-                for wi, df in enumerate(widths):
-                    nhit = int(counts[ci, wi])
-                    if nhit == 0:
-                        continue
-                    if nhit > vals.shape[-1]:
-                        # Capacity overflow: pathological chunk (heavy
-                        # RFI). Keep the top-k strongest; the bad-block
-                        # cut should normally have zeroed such data.
-                        nhit = vals.shape[-1]
-                    v = vals[ci, wi, :nhit]
-                    b = idx[ci, wi, :nhit] + chunknum * chunklen
-                    order = np.argsort(b)
-                    bl, vl = prune_related1(
-                        [int(x) for x in b[order]],
-                        [float(x) for x in v[order]], df)
-                    for bb, vv in zip(bl, vl):
-                        if bb >= N:
-                            continue
-                        cands.append(SPCandidate(
-                            bin=bb, sigma=vv, time=bb * dt,
-                            downfact=df, dm=dm))
+                _collect_chunk_hits(vals[ci], idx[ci], counts[ci],
+                                    c0 + ci, widths, chunklen, N, dt,
+                                    dm, cands)
         cands.sort()
         cands = prune_related2(cands, widths)
         return cands
+
+    def search_many(self, series_list, dt: float,
+                    dms: Sequence[float],
+                    offregions_list=None):
+        """Batched matched filter over MANY series (the survey's DM
+        fan-out): the overlapped chunks of every file share the device
+        dispatches, so per-file tunnel latency is paid once per chunk
+        GROUP instead of once per file.  Per-file results match
+        search() exactly (same chunking, pruning, bad-block cuts).
+
+        Returns a list of (cands, stds, bad) triples.
+        """
+        nf = len(series_list)
+        if offregions_list is None:
+            offregions_list = [()] * nf
+        preps = [self.normalize(np.asarray(ts, np.float32))
+                 for ts in series_list]
+        widths, chunklen, fftlen, overlap, kern_pairs = \
+            self._chunk_geometry(
+                widths=[1] + list(self.downfacts_for(dt)))
+
+        rows = []
+        owners = []                       # (file_idx, chunknum)
+        Ns = []
+        for fi, (normed, stds, bad) in enumerate(preps):
+            N = len(normed)
+            Ns.append(N)
+            numchunks = max(N // chunklen, 1)
+            padded = self._padded_chunks(normed, numchunks, chunklen,
+                                         overlap)
+            for c in range(numchunks):
+                rows.append(padded[c * chunklen:c * chunklen + fftlen])
+                owners.append((fi, c))
+
+        per_file: List[List[SPCandidate]] = [[] for _ in range(nf)]
+        thr = np.float32(self.threshold)
+        k = min(self.topk, chunklen)
+        B = self.batch_chunks
+        for g0 in range(0, len(rows), B):
+            group = rows[g0:g0 + B]
+            npad = B - len(group)
+            if npad:                      # keep ONE jit shape
+                group = group + [np.zeros(fftlen, np.float32)] * npad
+            vals, idx, counts = _convolve_topk(
+                np.stack(group), kern_pairs, thr, fftlen, overlap, k)
+            vals = np.asarray(vals)
+            idx = np.asarray(idx)
+            counts = np.asarray(counts)
+            for ri in range(len(group) - npad):
+                fi, chunknum = owners[g0 + ri]
+                _collect_chunk_hits(vals[ri], idx[ri], counts[ri],
+                                    chunknum, widths, chunklen,
+                                    Ns[fi], dt, dms[fi], per_file[fi])
+
+        out = []
+        for fi, (normed, stds, bad) in enumerate(preps):
+            cands = sorted(per_file[fi])
+            cands = prune_related2(cands, widths)
+            if len(bad):
+                badset = set(int(b) for b in bad)
+                dlen = self.detrendlen
+                cands = [c for c in cands
+                         if (c.bin // dlen) not in badset]
+            if offregions_list[fi]:
+                cands = prune_border_cases(cands, offregions_list[fi])
+            out.append((cands, stds, bad))
+        return out
 
     def search(self, ts: np.ndarray, dt: float, dm: float = 0.0,
                offregions: Sequence[Tuple[int, int]] = ()
@@ -338,6 +398,31 @@ class SinglePulseSearch:
         if offregions:
             cands = prune_border_cases(cands, offregions)
         return cands, stds, bad
+
+
+def _collect_chunk_hits(vals_c, idx_c, counts_c, chunknum, widths,
+                        chunklen, N, dt, dm, cands):
+    """Turn one chunk's top-k device results into pruned candidates
+    (shared by the single and batched search paths)."""
+    for wi, df in enumerate(widths):
+        nhit = int(counts_c[wi])
+        if nhit == 0:
+            continue
+        if nhit > vals_c.shape[-1]:
+            # Capacity overflow: pathological chunk (heavy RFI).
+            # Keep the top-k strongest; the bad-block cut should
+            # normally have zeroed such data.
+            nhit = vals_c.shape[-1]
+        v = vals_c[wi, :nhit]
+        b = idx_c[wi, :nhit] + chunknum * chunklen
+        order = np.argsort(b)
+        bl, vl = prune_related1([int(x) for x in b[order]],
+                                [float(x) for x in v[order]], df)
+        for bb, vv in zip(bl, vl):
+            if bb >= N:
+                continue
+            cands.append(SPCandidate(bin=bb, sigma=vv, time=bb * dt,
+                                     downfact=df, dm=dm))
 
 
 def write_singlepulse(path: str, cands: Sequence[SPCandidate]) -> None:

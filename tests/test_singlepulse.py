@@ -146,3 +146,27 @@ def test_singlepulse_roundtrip(tmp_path):
     assert back[0].bin == 123 and back[0].downfact == 3
     assert abs(back[0].dm - 56.78) < 1e-6
     assert abs(back[0].sigma - 7.5) < 1e-6
+
+
+def test_search_many_matches_search():
+    """Batched multi-file SP search must match per-file search exactly
+    (the survey fan-out invariant)."""
+    import numpy as np
+    from presto_tpu.search.singlepulse import SinglePulseSearch
+    rng = np.random.default_rng(12)
+    dt, N = 1e-3, 12000
+    series = []
+    for i in range(4):
+        ts = rng.normal(0, 1.0, N).astype(np.float32)
+        ts[2000 + 500 * i:2000 + 500 * i + 5] += 9.0
+        series.append(ts)
+    sp = SinglePulseSearch(threshold=5.0, badblocks=False)
+    many = sp.search_many(series, dt, dms=[10.0 * i for i in range(4)])
+    for i, ts in enumerate(series):
+        single, stds, bad = sp.search(ts, dt, dm=10.0 * i)
+        mcands = many[i][0]
+        assert len(mcands) == len(single)
+        for a, b in zip(mcands, single):
+            assert a.bin == b.bin and a.downfact == b.downfact
+            assert abs(a.sigma - b.sigma) < 1e-4
+        assert any(abs(c.bin - (2000 + 500 * i)) < 10 for c in mcands)
