@@ -2,10 +2,12 @@
 
 CLI parity with the reference realfft (src/realfft.c:32-): positional
 data files, -fwd/-inv to force direction (default: .dat -> forward,
-.fft -> inverse), -del to remove the input after success.  The
-reference's in-core/out-of-core crossover (MAXREALFFT, meminfo.h) is
-replaced by XLA's FFT + (for multi-device scale) the sharded six-step
-path in parallel.sharded.
+.fft -> inverse), -del to remove the input after success, -disk/-mem
+to force the out-of-core vs in-core path.  Like the reference
+(src/realfft.c:179, include/meminfo.h:4), series longer than a
+MAXREALFFT-analog threshold automatically divert to the two-pass disk
+FFT (ops/oocfft); multi-device scale goes through the sharded
+six-step path in parallel.sharded instead.
 """
 
 from __future__ import annotations
@@ -29,36 +31,79 @@ def build_parser():
     p.add_argument("-del", dest="delete", action="store_true",
                    help="Remove the input file on success")
     p.add_argument("-disk", action="store_true",
-                   help="Accepted for parity (XLA handles large FFTs)")
+                   help="Force the out-of-core two-pass disk FFT")
     p.add_argument("-mem", action="store_true",
-                   help="Accepted for parity")
+                   help="Force the in-core FFT regardless of size")
     p.add_argument("datafiles", nargs="+")
     return p
 
 
-def run_one(path: str, forward: bool, delete: bool) -> str:
+def _xla_friendly(n: int) -> bool:
+    """XLA's FFT is fast for 7-smooth lengths; a larger prime factor
+    can make it materialize a dense DFT matrix (O(n^2) HBM — observed
+    OOM at ~5e5 points).  Such lengths go through host pocketfft,
+    which like the reference's FFTW handles any n."""
+    from presto_tpu.utils.psr import _is_smooth
+    return _is_smooth(n)
+
+
+def _host_realfft_packed(x: np.ndarray) -> np.ndarray:
+    full = np.fft.rfft(x.astype(np.float64))
+    return np.concatenate(
+        [[full[0].real + 1j * full[-1].real], full[1:-1]]
+    ).astype(np.complex64)
+
+
+def _host_irealfft_packed(amps: np.ndarray) -> np.ndarray:
+    full = np.concatenate([[amps[0].real], amps[1:],
+                           [amps[0].imag]]).astype(np.complex128)
+    return np.fft.irfft(full, n=2 * amps.size).astype(np.float32)
+
+
+def run_one(path: str, forward: bool, delete: bool,
+            disk: bool = False, mem: bool = False) -> str:
+    from presto_tpu.ops import oocfft
     base, ext = os.path.splitext(path)
     info = read_inf(base)
     if forward:
-        data = datfft.read_dat(base + ".dat")
-        n = data.size & ~1
-        pairs = np.asarray(fftpack.realfft_packed_pairs(
-            jnp.asarray(data[:n])))
+        src = base + ".dat"
         out = base + ".fft"
-        datfft.write_fft(out, fftpack.np_pairs_to_complex64(pairs))
+        nfloats = os.path.getsize(src) // 4
+        if not mem and nfloats >= 8 and (disk or
+                                         nfloats > oocfft.MAXREALFFT):
+            oocfft.realfft_ooc(src, out, forward=True)
+        else:
+            data = datfft.read_dat(src)
+            n = data.size & ~1
+            if _xla_friendly(n):
+                pairs = np.asarray(fftpack.realfft_packed_pairs(
+                    jnp.asarray(data[:n])))
+                packed = fftpack.np_pairs_to_complex64(pairs)
+            else:
+                packed = _host_realfft_packed(data[:n])
+            datfft.write_fft(out, packed)
         write_inf(info, base + ".inf")
         if delete:
-            os.remove(base + ".dat")
+            os.remove(src)
     else:
-        amps = datfft.read_fft(base + ".fft")
-        pairs = fftpack.np_complex64_to_pairs(amps)
-        data = np.asarray(fftpack.irealfft_packed_pairs(
-            jnp.asarray(pairs)))
+        src = base + ".fft"
         out = base + ".dat"
-        datfft.write_dat(out, data)
+        namps = os.path.getsize(src) // 8
+        if not mem and namps >= 4 and (disk or
+                                       2 * namps > oocfft.MAXREALFFT):
+            oocfft.realfft_ooc(src, out, forward=False)
+        else:
+            amps = datfft.read_fft(src)
+            if _xla_friendly(2 * amps.size):
+                pairs = fftpack.np_complex64_to_pairs(amps)
+                data = np.asarray(fftpack.irealfft_packed_pairs(
+                    jnp.asarray(pairs)))
+            else:
+                data = _host_irealfft_packed(amps)
+            datfft.write_dat(out, data)
         write_inf(info, base + ".inf")
         if delete:
-            os.remove(base + ".fft")
+            os.remove(src)
     print("realfft: wrote %s" % out)
     return out
 
@@ -69,7 +114,7 @@ def main(argv=None):
     for path in args.datafiles:
         ext = os.path.splitext(path)[1]
         forward = args.fwd or (ext == ".dat" and not args.inv)
-        run_one(path, forward, args.delete)
+        run_one(path, forward, args.delete, disk=args.disk, mem=args.mem)
 
 
 if __name__ == "__main__":

@@ -52,10 +52,17 @@ def run(args) -> list:
                            detrendlen=1000 * args.detrendfact,
                            fast_detrend=args.fast,
                            badblocks=not args.nobadblocks)
-    # load everything first, then batch same-(length, dt) groups
-    # through one set of device dispatches (the survey DM fan-out pays
-    # seconds of tunnel latency per dispatch otherwise)
-    loaded = []                # (fn, base, ts, info, offregions)
+    # plan from .inf metadata + file sizes only, then batch
+    # same-(length, dt) groups through one set of device dispatches
+    # (the survey DM fan-out pays seconds of tunnel latency per
+    # dispatch otherwise); each chunk's series are loaded lazily so
+    # host RAM holds one memory-budgeted chunk at a time, not the
+    # whole fan-out
+    import os
+
+    from presto_tpu.io.infodata import read_inf
+
+    planned = []               # (fn, base, nuse, info, offregions)
     for fn in args.datfiles:
         if fn.endswith(".singlepulse"):
             allcands.extend([c for c in read_singlepulse(fn)
@@ -63,21 +70,21 @@ def run(args) -> list:
                              and c.sigma >= args.threshold])
             continue
         base = fn[:-4] if fn.endswith(".dat") else fn
-        ts, info = load_timeseries(fn)
+        info = read_inf(base)
+        nraw = os.path.getsize(base + ".dat") // 4
         offregions = []
+        nuse = nraw
         if info.numonoff > 1:
             ons = [int(a) for a, b in info.onoff]
             offs = [int(b) for a, b in info.onoff]
             offregions = list(zip(offs[:-1], ons[1:]))
             if offregions and offregions[-1][1] >= info.N - 1:
-                ts = ts[:offregions[-1][0] + 1]
-        loaded.append((fn, base, np.asarray(ts, np.float32), info,
-                       offregions))
+                nuse = min(nraw, offregions[-1][0] + 1)
+        planned.append((fn, base, nuse, info, offregions))
 
     groups = {}
-    for item in loaded:
-        groups.setdefault((len(item[2]), item[3].dt),
-                          []).append(item)
+    for item in planned:
+        groups.setdefault((item[2], item[3].dt), []).append(item)
     for (n, dt), items in groups.items():
         # memory budget: keep at most ~1 GB of series per batched call
         # (the batch path holds ~3x the data in normalized/padded
@@ -85,10 +92,15 @@ def run(args) -> list:
         per = max(1, int(2 ** 30 // max(n * 4, 1)))
         for g0 in range(0, len(items), per):
             chunk = items[g0:g0 + per]
+            series = []
+            for _, base, nuse, _, _ in chunk:
+                ts, _ = load_timeseries(base + ".dat")
+                series.append(np.asarray(ts[:nuse], np.float32))
             results = sp.search_many(
-                [it[2] for it in chunk], dt,
+                series, dt,
                 dms=[it[3].dm for it in chunk],
                 offregions_list=[it[4] for it in chunk])
+            del series
             for (fn, base, _, info, _), (cands, stds, bad) in \
                     zip(chunk, results):
                 cands = [c for c in cands

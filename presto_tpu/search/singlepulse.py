@@ -229,17 +229,16 @@ class SinglePulseSearch:
             dfs = [x for x in DEFAULT_DOWNFACTS if x <= MAX_DOWNFACT]
         return dfs or [DEFAULT_DOWNFACTS[0]]
 
-    def normalize(self, ts: np.ndarray):
-        """Detrend + normalize; returns (normed series, stds, bad_blocks).
-        Bad blocks are zeroed (they still participate in convolution
-        overlaps, matching single_pulse_search.py:425-430)."""
+    def _blocks_for(self, ts: np.ndarray) -> np.ndarray:
         dlen = self.detrendlen
         roundN = (len(ts) // dlen) * dlen
-        blocks = np.asarray(ts[:roundN], np.float32).reshape(-1, dlen)
-        resid, stds = _detrend_blocks(jnp.asarray(blocks), dlen,
-                                      self.fast_detrend)
-        resid = np.asarray(resid)
-        stds = np.asarray(stds)
+        return np.asarray(ts[:roundN], np.float32).reshape(-1, dlen)
+
+    def _finish_normalize(self, resid: np.ndarray, stds: np.ndarray):
+        """Host-side half of normalize: bad-block logic + scaling."""
+        if stds.size == 0:
+            return (np.zeros(0, np.float32), stds,
+                    np.empty(0, dtype=np.int64))
         # Constant (zero-variance) blocks — padding, dropouts — are
         # always bad: without the guard 0/0 NaNs (or huge roundoff
         # amplification) would poison every chunk whose convolution
@@ -258,6 +257,40 @@ class SinglePulseSearch:
         normed = resid / stds[:, None]
         normed[bad] = 0.0
         return normed.reshape(-1), stds, bad
+
+    def normalize(self, ts: np.ndarray):
+        """Detrend + normalize; returns (normed series, stds, bad_blocks).
+        Bad blocks are zeroed (they still participate in convolution
+        overlaps, matching single_pulse_search.py:425-430)."""
+        blocks = self._blocks_for(ts)
+        resid, stds = _detrend_blocks(jnp.asarray(blocks),
+                                      self.detrendlen,
+                                      self.fast_detrend)
+        return self._finish_normalize(np.asarray(resid),
+                                      np.asarray(stds))
+
+    def normalize_many(self, series_list):
+        """normalize() for many series in ONE detrend dispatch (blocks
+        are independent, so all files' blocks stack along axis 0 —
+        the per-file dispatch otherwise dominates a survey fan-out on
+        the tunneled TPU)."""
+        blist = [self._blocks_for(ts) for ts in series_list]
+        counts = [b.shape[0] for b in blist]
+        if sum(counts) == 0:
+            return [self._finish_normalize(
+                np.zeros((0, self.detrendlen), np.float32),
+                np.zeros(0, np.float32)) for _ in blist]
+        resid, stds = _detrend_blocks(
+            jnp.asarray(np.concatenate(blist, axis=0)),
+            self.detrendlen, self.fast_detrend)
+        resid = np.asarray(resid)
+        stds = np.asarray(stds)
+        out, o = [], 0
+        for c in counts:
+            out.append(self._finish_normalize(resid[o:o + c],
+                                              stds[o:o + c]))
+            o += c
+        return out
 
     def _chunk_geometry(self, widths):
         """(widths, chunklen, fftlen, overlap, kern_pairs) — the one
@@ -331,8 +364,8 @@ class SinglePulseSearch:
         nf = len(series_list)
         if offregions_list is None:
             offregions_list = [()] * nf
-        preps = [self.normalize(np.asarray(ts, np.float32))
-                 for ts in series_list]
+        preps = self.normalize_many([np.asarray(ts, np.float32)
+                                     for ts in series_list])
         widths, chunklen, fftlen, overlap, kern_pairs = \
             self._chunk_geometry(
                 widths=[1] + list(self.downfacts_for(dt)))
@@ -374,15 +407,20 @@ class SinglePulseSearch:
         for fi, (normed, stds, bad) in enumerate(preps):
             cands = sorted(per_file[fi])
             cands = prune_related2(cands, widths)
-            if len(bad):
-                badset = set(int(b) for b in bad)
-                dlen = self.detrendlen
-                cands = [c for c in cands
-                         if (c.bin // dlen) not in badset]
-            if offregions_list[fi]:
-                cands = prune_border_cases(cands, offregions_list[fi])
+            cands = self._post_filter(cands, bad, offregions_list[fi])
             out.append((cands, stds, bad))
         return out
+
+    def _post_filter(self, cands, bad, offregions):
+        """Bad-block cut + off-region border pruning (shared by the
+        single and batched search paths)."""
+        if len(bad):
+            badset = set(int(b) for b in bad)
+            dlen = self.detrendlen
+            cands = [c for c in cands if (c.bin // dlen) not in badset]
+        if offregions:
+            cands = prune_border_cases(cands, offregions)
+        return cands
 
     def search(self, ts: np.ndarray, dt: float, dm: float = 0.0,
                offregions: Sequence[Tuple[int, int]] = ()
@@ -391,13 +429,7 @@ class SinglePulseSearch:
         Returns (candidates, per-block stds, bad block indices)."""
         normed, stds, bad = self.normalize(ts)
         cands = self.search_normalized(normed, dt, dm=dm)
-        if len(bad):
-            badset = set(int(b) for b in bad)
-            dlen = self.detrendlen
-            cands = [c for c in cands if (c.bin // dlen) not in badset]
-        if offregions:
-            cands = prune_border_cases(cands, offregions)
-        return cands, stds, bad
+        return self._post_filter(cands, bad, offregions), stds, bad
 
 
 def _collect_chunk_hits(vals_c, idx_c, counts_c, chunknum, widths,

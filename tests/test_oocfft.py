@@ -1,0 +1,122 @@
+"""Out-of-core two-pass FFT vs in-core results (the reference's
+realfft disk == memory invariant, SURVEY.md §4 item 8)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from presto_tpu.ops import oocfft
+
+
+TINY = 1 << 12          # force many blocks: a few KB of buffer
+
+
+def _write(path, arr):
+    np.ascontiguousarray(arr).tofile(path)
+
+
+def test_ooc_complex_fft_matches_numpy(tmp_path):
+    rng = np.random.default_rng(1)
+    for n in (1 << 10, 3 * (1 << 8), 10 * 36):
+        z = (rng.normal(size=n) + 1j * rng.normal(size=n)
+             ).astype(np.complex64)
+        src = str(tmp_path / f"z{n}.bin")
+        dst = str(tmp_path / f"Z{n}.bin")
+        _write(src, z)
+        oocfft.ooc_complex_fft(src, dst, n, forward=True, max_mem=TINY)
+        got = np.fromfile(dst, dtype=np.complex64)
+        ref = np.fft.fft(z.astype(np.complex128))
+        scale = np.sqrt(np.mean(np.abs(ref) ** 2))
+        np.testing.assert_allclose(got, ref.astype(np.complex64),
+                                   atol=2e-4 * scale, rtol=0)
+
+
+def test_ooc_complex_ifft_roundtrip(tmp_path):
+    rng = np.random.default_rng(2)
+    n = 1 << 10
+    z = (rng.normal(size=n) + 1j * rng.normal(size=n)).astype(np.complex64)
+    a = str(tmp_path / "a.bin")
+    b = str(tmp_path / "b.bin")
+    c = str(tmp_path / "c.bin")
+    _write(a, z)
+    oocfft.ooc_complex_fft(a, b, n, forward=True, max_mem=TINY)
+    oocfft.ooc_complex_fft(b, c, n, forward=False, max_mem=TINY)
+    got = np.fromfile(c, dtype=np.complex64)
+    np.testing.assert_allclose(got, z, atol=1e-4, rtol=0)
+
+
+def test_ooc_odd_halflength(tmp_path):
+    """nfloats = 2 (mod 4) gives an odd complex half-length; the
+    two-pass split must still work (review regression)."""
+    rng = np.random.default_rng(9)
+    for n in (10, (1 << 16) + 2, 2 * 3 * 5 * 7 * 11):
+        x = rng.normal(size=n).astype(np.float32)
+        src = str(tmp_path / f"odd{n}.dat")
+        dst = str(tmp_path / f"odd{n}.fft")
+        _write(src, x)
+        oocfft.realfft_ooc(src, dst, forward=True, max_mem=TINY)
+        got = np.fromfile(dst, dtype=np.complex64)
+        full = np.fft.rfft(x.astype(np.float64))
+        ref = np.concatenate([[full[0].real + 1j * full[-1].real],
+                              full[1:-1]]).astype(np.complex64)
+        scale = np.sqrt(np.mean(np.abs(ref) ** 2))
+        np.testing.assert_allclose(got, ref, atol=3e-4 * scale, rtol=0)
+
+
+@pytest.mark.parametrize("n", [1 << 12, 1 << 14])
+def test_realfft_ooc_forward_matches_incore(tmp_path, n):
+    rng = np.random.default_rng(3)
+    x = rng.normal(size=n).astype(np.float32)
+    src = str(tmp_path / "t.dat")
+    dst = str(tmp_path / "t.fft")
+    _write(src, x)
+    oocfft.realfft_ooc(src, dst, forward=True, max_mem=TINY)
+    got = np.fromfile(dst, dtype=np.complex64)
+
+    full = np.fft.rfft(x.astype(np.float64))
+    ref = np.concatenate([[full[0].real + 1j * full[-1].real],
+                          full[1:-1]]).astype(np.complex64)
+    scale = np.sqrt(np.mean(np.abs(ref) ** 2))
+    np.testing.assert_allclose(got, ref, atol=3e-4 * scale, rtol=0)
+
+
+def test_realfft_ooc_roundtrip(tmp_path):
+    rng = np.random.default_rng(4)
+    n = 1 << 13
+    x = rng.normal(size=n).astype(np.float32)
+    src = str(tmp_path / "r.dat")
+    mid = str(tmp_path / "r.fft")
+    back = str(tmp_path / "r2.dat")
+    _write(src, x)
+    oocfft.realfft_ooc(src, mid, forward=True, max_mem=TINY)
+    oocfft.realfft_ooc(mid, back, forward=False, max_mem=TINY)
+    got = np.fromfile(back, dtype=np.float32)
+    np.testing.assert_allclose(got, x, atol=2e-3, rtol=0)
+
+
+def test_realfft_app_disk_matches_mem(tmp_path):
+    """App-level: `realfft -disk` output == in-core output, and the
+    inverse -disk path round-trips (disk == memory invariant)."""
+    from presto_tpu.apps import realfft as app
+    from presto_tpu.io.infodata import InfoData, write_inf
+
+    rng = np.random.default_rng(5)
+    n = 1 << 12
+    x = rng.normal(size=n).astype(np.float32)
+    base = str(tmp_path / "obs")
+    _write(base + ".dat", x)
+    info = InfoData(name=base, N=n, dt=1e-4)
+    write_inf(info, base + ".inf")
+
+    app.run_one(base + ".dat", forward=True, delete=False, mem=True)
+    incore = np.fromfile(base + ".fft", dtype=np.complex64)
+    os.remove(base + ".fft")
+    app.run_one(base + ".dat", forward=True, delete=False, disk=True)
+    disk = np.fromfile(base + ".fft", dtype=np.complex64)
+    scale = np.sqrt(np.mean(np.abs(incore) ** 2))
+    np.testing.assert_allclose(disk, incore, atol=3e-4 * scale, rtol=0)
+
+    app.run_one(base + ".fft", forward=False, delete=False, disk=True)
+    back = np.fromfile(base + ".dat", dtype=np.float32)
+    np.testing.assert_allclose(back, x, atol=2e-3, rtol=0)
