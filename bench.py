@@ -49,9 +49,12 @@ def main():
     cands = s.search(pairs)          # warmup (includes XLA compile)
     warm = time.time() - t0
 
-    t0 = time.time()
-    cands = s.search(pairs)
-    elapsed = time.time() - t0
+    # best of 3: the tunneled chip shows 20-30% run-to-run variance
+    elapsed = float("inf")
+    for _ in range(3):
+        t0 = time.time()
+        cands = s.search(pairs)
+        elapsed = min(elapsed, time.time() - t0)
 
     numr = int(s.rhi - s.rlo) * 2
     cells = cfg.numz * numr

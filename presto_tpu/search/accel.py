@@ -16,10 +16,14 @@ TPU-first redesign (this module):
     kernel bank -> batched IFFT -> [nblocks, numz, uselen] powers,
     assembled to P[numz, R] in HBM (the reference's `-inmem` plane,
     accel_utils.c:1651-1670, is the natural TPU layout);
-  * harmonic summing is two chained takes (rows by zind map, columns by
-    rind map) — XLA gathers, no scalar loops;
-  * thresholding is a single top-k over the masked plane per stage
-    (static K, the `omp critical` insert becomes host-side filtering);
+  * harmonic summing is a z-row take plus a PHASE-DECOMPOSED column
+    read (static strided views when slab starts are numharm-aligned —
+    no minor-axis gather, the TPU scan-time hot spot), accumulated
+    stage by stage;
+  * thresholding is a segment-max (lossless under the r-dedup rule)
+    followed by a top-k per stage (static K, the `omp critical` insert
+    becomes host-side filtering), returned as ONE packed int32 tensor
+    so the host pays a single D2H;
   * candidate sigma/powcut math runs on host in float64 (ops/stats).
 
 All device entry points keep complex internal to jit (float32 pair
@@ -120,22 +124,29 @@ class AccelConfig:
 
 @dataclass
 class AccelKernels:
-    """The z-response kernel bank for the fundamental (host-built)."""
+    """The z-response kernel bank for the fundamental (host-built).
+
+    Kernels are stored TIME-DOMAIN, centered in a common kmax-tap
+    window (kmax = 2*NUMBETWEEN*halfwidth of the widest kernel); the
+    host uploads this compact bank and _fft_kernel_bank expands it to
+    the FFT'd fftlen bank on device (a ~20x upload saving through the
+    tunneled link; one bank per w plane in the jerk search).
+    """
     fftlen: int
     halfwidth: int
     numz: int
     zlo: int
-    kern_pairs: np.ndarray       # [numz, fftlen, 2] float32, FFT'd
+    kmax: int
+    kern_pairs: np.ndarray       # [numz, kmax, 2] float32, centered
 
     @classmethod
     def build(cls, cfg: AccelConfig, w: float = 0.0) -> "AccelKernels":
         """Parity: init_kernel (accel_utils.c:133-151) for harm 1/1.
 
-        One kernel per z in [-zmax, zmax] step ACCEL_DZ; each is the
-        float64 z-response (or w-response for the jerk search's w != 0
-        planes) placed NR-style into an fftlen array and forward-FFT'd
-        (kernels are shared across all r-blocks).  All w planes of one
-        search share the fftlen sized for the widest kernel so the
+        One kernel per z in [-zmax, zmax] step ACCEL_DZ: the float64
+        z-response (or w-response for the jerk search's w != 0 planes),
+        kernels shared across all r-blocks.  All w planes of one
+        search share the kmax sized for the widest kernel so the
         plane builder compiles once.
         """
         fftlen = calc_fftlen(1, 1, cfg.zmax, cfg.uselen, cfg.wmax)
@@ -144,29 +155,64 @@ class AccelKernels:
                      if cfg.wmax else
                      resp.z_resp_halfwidth(float(cfg.zmax), resp.LOWACC))
         numz = cfg.numz
-        kerns = np.empty((numz, fftlen), dtype=np.complex128)
+        kmax = 2 * ACCEL_NUMBETWEEN * halfwidth
+        kerns = np.zeros((numz, kmax), dtype=np.complex128)
         for i in range(numz):
             z = -cfg.zmax + i * ACCEL_DZ
             if abs(w) < 1e-7:
                 hw = resp.z_resp_halfwidth(float(z), resp.LOWACC)
-                numkern = 2 * ACCEL_NUMBETWEEN * hw
+                numkern = min(2 * ACCEL_NUMBETWEEN * hw, kmax)
                 k = resp.gen_z_response(0.0, ACCEL_NUMBETWEEN, float(z),
                                         numkern)
             else:
                 hw = resp.w_resp_halfwidth(float(z), float(w),
                                            resp.LOWACC)
-                numkern = min(2 * ACCEL_NUMBETWEEN * hw, fftlen)
+                numkern = min(2 * ACCEL_NUMBETWEEN * hw, kmax)
                 k = resp.gen_w_response(0.0, ACCEL_NUMBETWEEN, float(z),
                                         float(w), numkern)
-            kerns[i] = np.fft.fft(resp.place_complex_kernel(k, fftlen))
+            start = kmax // 2 - numkern // 2
+            kerns[i, start:start + numkern] = k[:numkern]
         pairs = np.stack([kerns.real, kerns.imag], axis=-1).astype(np.float32)
         return cls(fftlen=fftlen, halfwidth=halfwidth, numz=numz,
-                   zlo=-cfg.zmax, kern_pairs=pairs)
+                   zlo=-cfg.zmax, kmax=kmax, kern_pairs=pairs)
 
 
 # ----------------------------------------------------------------------
 # Device: fundamental plane construction
 # ----------------------------------------------------------------------
+
+def fft_kernel_bank_np(kern: "AccelKernels") -> np.ndarray:
+    """Host-side expansion of the compact time-domain bank to the
+    FFT'd [numz, fftlen, 2] bank _ffdot_blocks consumes (the numpy
+    twin of _fft_kernel_bank, for driver entry points and referee
+    paths that want plain arrays)."""
+    kc = kern.kern_pairs[..., 0] + 1j * kern.kern_pairs[..., 1]
+    half = kern.kmax // 2
+    placed = np.zeros((kc.shape[0], kern.fftlen), dtype=np.complex128)
+    placed[:, :half] = kc[:, half:]
+    placed[:, kern.fftlen - half:] = kc[:, :half]
+    k = np.fft.fft(placed, axis=-1)
+    return np.stack([k.real, k.imag], axis=-1).astype(np.float32)
+
+
+@partial(jax.jit, static_argnames=("fftlen",))
+def _fft_kernel_bank(kern_tpairs, fftlen):
+    """Device prep of the FFT'd kernel bank from the compact centered
+    time-domain bank: NR wrap placement (place_complex_kernel,
+    corr_prep.c:58-80) + forward FFT.  Runs once per bank — the host
+    uploads only numz*kmax*8 bytes instead of numz*fftlen*8 (a ~20x
+    saving through the tunneled host->TPU link; the jerk search
+    uploads one bank per w plane)."""
+    kc = kern_tpairs[..., 0] + 1j * kern_tpairs[..., 1]  # [numz, kmax]
+    kmax = kc.shape[-1]
+    half = kmax // 2
+    numz = kc.shape[0]
+    placed = jnp.zeros((numz, fftlen), dtype=jnp.complex64)
+    placed = placed.at[:, :half].set(kc[:, half:])
+    placed = placed.at[:, fftlen - half:].set(kc[:, :half])
+    kern = jnp.fft.fft(placed, axis=-1)
+    return jnp.stack([kern.real, kern.imag], axis=-1).astype(jnp.float32)
+
 
 @partial(jax.jit, static_argnames=("uselen", "fftlen", "halfwidth"))
 def _ffdot_blocks(seg_pairs, kern_pairs, uselen, fftlen, halfwidth):
@@ -175,12 +221,16 @@ def _ffdot_blocks(seg_pairs, kern_pairs, uselen, fftlen, halfwidth):
     seg_pairs: [nblocks, fftlen//2, 2] float32 — normalized Fourier
         amplitudes for each block's read window (lobin = block_rlo -
         halfwidth, fftlen//2 whole bins).
-    kern_pairs: [numz, fftlen, 2] float32 — FFT'd kernel bank.
+    kern_pairs: [numz, fftlen, 2] float32 — FFT'd kernel bank (device,
+        from _fft_kernel_bank).
     Returns [nblocks, numz, uselen] float32 powers.
 
     Parity with the per-row loop of accel_utils.c:1002-1051: spread ×2,
     forward FFT, multiply by conj(kernel), inverse FFT, take uselen
     points starting at halfwidth*NUMBETWEEN, |.|^2 / fftlen^2.
+    (A direct-conv MXU formulation was benchmarked at parity with this
+    on v5e at float32 precision and abandoned — batched FFTs through
+    XLA already saturate the same ~25 ms/chunk.)
     """
     data = seg_pairs[..., 0] + 1j * seg_pairs[..., 1]   # [B, fftlen//2]
     kern = kern_pairs[..., 0] + 1j * kern_pairs[..., 1]  # [numz, fftlen]
@@ -239,8 +289,14 @@ def _harm_fracs_and_zinds(cfg: AccelConfig, numz: int):
     return out
 
 
+SEARCH_SEG = 16     # columns per segment-max before top-k: 16 columns
+                    # = 8 r-bins < ACCEL_CLOSEST_R, so candidates
+                    # merged here are exactly those the r-dedup
+                    # (insert_new_accelcand semantics) collapses anyway
+
+
 def _make_search_scanner(numharmstages, fracs_zinds, powcuts, slab, k,
-                         plane_numr):
+                         plane_numr, aligned=False):
     """One jit'd function running the whole staged search as a lax.scan
     over slab start columns (a single device dispatch — the tunneled
     TPU pays ~0.1-0.4 s latency per call, so per-slab calls dominate
@@ -248,16 +304,25 @@ def _make_search_scanner(numharmstages, fracs_zinds, powcuts, slab, k,
 
     Per slab: accumulate the harmonic sums, then per stage reduce each
     column to its max over z (same-column different-z cells are exact
-    duplicates under the sifter's r-dedup) and top-k the columns above
-    powcut.  Column gather indices use exact int32 round-half-up of
-    (abs_halfbin * harm / htot), equal to the reference's
-    (int)(rrint*frac + 0.5) double math (accel_utils.c:1169-1175), and
-    each harmonic reads only its contiguous source window via
-    dynamic_slice (bounded gather traffic).
+    duplicates under the sifter's r-dedup), segment-max groups of
+    SEARCH_SEG columns (duplicates under the same rule — the
+    reference's own insert-time dedup, accel_utils.c:294-382, collapses
+    candidates within ACCEL_CLOSEST_R=15 bins), and top-k the segments
+    above powcut (TPU top-k cost scales with the input length; the
+    16x shrink is the big win).  Column gather indices use exact int32
+    round-half-up of (abs_halfbin * harm / htot), equal to the
+    reference's (int)(rrint*frac + 0.5) double math
+    (accel_utils.c:1169-1175), and each harmonic reads only its
+    contiguous source window via dynamic_slice (bounded gather
+    traffic).  Returns ONE packed int32 array [3, nslabs, stages, k]
+    (power bits, column, zrow) so the host pays a single D2H transfer.
     """
     powcuts = jnp.asarray(powcuts, dtype=jnp.float32)
     fz = [(harm, htot, jnp.asarray(zi)) for stage in fracs_zinds
           for (harm, htot, zi) in stage]
+    nseg = -(-slab // SEARCH_SEG)
+    segpad = nseg * SEARCH_SEG - slab
+    kk = min(k, nseg)
 
     def slab_body(P, start_col):
         cols = start_col + jnp.arange(slab, dtype=jnp.int32)
@@ -267,8 +332,13 @@ def _make_search_scanner(numharmstages, fracs_zinds, powcuts, slab, k,
             colmax = acc.max(axis=0)
             colz = acc.argmax(axis=0).astype(jnp.int32)
             masked = jnp.where(colmax > powcuts[stage], colmax, 0.0)
-            v, ci = jax.lax.top_k(masked, k)
-            return v, ci, jnp.take(colz, ci)
+            segs = jnp.pad(masked, (0, segpad)).reshape(nseg,
+                                                        SEARCH_SEG)
+            v, si = jax.lax.top_k(segs.max(axis=1), kk)
+            ci = si * SEARCH_SEG + \
+                jnp.take(segs.argmax(axis=1).astype(jnp.int32), si)
+            # padded-segment hits have v == 0 and are filtered on host
+            return v, ci, jnp.take(colz, ci, mode="clip")
 
         outs = [collect(acc, 0)]
         fi = 0
@@ -276,32 +346,65 @@ def _make_search_scanner(numharmstages, fracs_zinds, powcuts, slab, k,
             for _ in range(1 << (stage - 1)):   # odd harmonics
                 harm, htot, zinds = fz[fi]
                 fi += 1
-                # round-half-up of cols*harm/htot without int32 overflow
-                # (split off the quotient so the multiply stays < 2^31
-                # even for billion-bin spectra): exact for htot = 2^s.
-                rind = ((cols // htot) * harm
-                        + ((cols % htot) * harm + (htot >> 1)) // htot)
-                cstart = jnp.minimum(
-                    (start_col // htot) * harm
-                    + ((start_col % htot) * harm + (htot >> 1)) // htot,
-                    plane_numr - slab)
-                src = jax.lax.dynamic_slice(P, (0, cstart),
-                                            (P.shape[0], slab))
-                sub = jnp.take(src, zinds, axis=0)
-                acc = acc + jnp.take(sub, rind - cstart, axis=1)
+                if (aligned and slab % htot == 0
+                        and (slab // htot + 1) * harm <= slab):
+                    # Phase-decomposed subharmonic read — NO gather.
+                    # With start_col % htot == 0 (the _slab_plan
+                    # alignment contract), column j = q*htot + ph maps
+                    # to source column cstart + q*harm + off(ph),
+                    # off(ph) = (ph*harm + htot//2)//htot <= harm: all
+                    # phases are STATIC slices of a [nq+1, harm]
+                    # reshape, replacing the minor-axis gather that
+                    # dominated scan time on TPU (~6x the slice cost).
+                    nq = slab // htot
+                    cstart = (start_col // htot) * harm
+                    src = jax.lax.dynamic_slice(
+                        P, (0, cstart), (P.shape[0], slab))
+                    sub = jnp.take(src, zinds, axis=0)
+                    src3 = sub[:, :(nq + 1) * harm].reshape(
+                        -1, nq + 1, harm)
+                    pieces = []
+                    for ph in range(htot):
+                        off = (ph * harm + (htot >> 1)) // htot
+                        if off < harm:
+                            pieces.append(src3[:, :nq, off])
+                        else:            # off == harm: next q, tap 0
+                            pieces.append(src3[:, 1:nq + 1, 0])
+                    acc = acc + jnp.stack(pieces, axis=-1).reshape(
+                        acc.shape[0], slab)
+                else:
+                    # round-half-up of cols*harm/htot without int32
+                    # overflow (split off the quotient so the multiply
+                    # stays < 2^31 even for billion-bin spectra):
+                    # exact for htot = 2^s.
+                    rind = ((cols // htot) * harm
+                            + ((cols % htot) * harm + (htot >> 1))
+                            // htot)
+                    cstart = jnp.minimum(
+                        (start_col // htot) * harm
+                        + ((start_col % htot) * harm + (htot >> 1))
+                        // htot,
+                        plane_numr - slab)
+                    src = jax.lax.dynamic_slice(P, (0, cstart),
+                                                (P.shape[0], slab))
+                    sub = jnp.take(src, zinds, axis=0)
+                    acc = acc + jnp.take(sub, rind - cstart, axis=1)
             outs.append(collect(acc, stage))
         vals = jnp.stack([o[0] for o in outs])      # [stages, k]
         cidx = jnp.stack([o[1] for o in outs])
         zrow = jnp.stack([o[2] for o in outs])
-        return vals, cidx, zrow
+        # one int32 tensor (power bits / column / zrow) -> one D2H
+        return jnp.stack([jax.lax.bitcast_convert_type(vals, jnp.int32),
+                          cidx, zrow])
 
     def _scan_all_py(P, start_cols):
         def body(carry, start):
             return carry, slab_body(P, start)
-        _, (vals, cidx, zrow) = jax.lax.scan(body, None, start_cols)
-        return vals, cidx, zrow   # [nslabs, stages, k]
+        _, packed = jax.lax.scan(body, None, start_cols)
+        return jnp.moveaxis(packed, 1, 0)  # [3, nslabs, stages, k]
 
     scan_all = jax.jit(_scan_all_py)
+    scan_all.body = _scan_all_py     # unjitted, for fused build+search
 
     @jax.jit
     def scan_many(Ps, start_cols):
@@ -310,10 +413,17 @@ def _make_search_scanner(numharmstages, fracs_zinds, powcuts, slab, k,
         def per_dm(_, P):
             return None, _scan_all_py(P, start_cols)
         _, outs = jax.lax.scan(per_dm, None, Ps)
-        return outs               # each [numdms, nslabs, stages, k]
+        return jnp.moveaxis(outs, 1, 0)   # [3, numdms, nslabs, stages, k]
 
     scan_all.many = scan_many
     return scan_all
+
+
+def _unpack_scan(packed: np.ndarray):
+    """Host side of the packed scanner output: float32 powers + int32
+    column/zrow indices."""
+    arr = np.asarray(packed)
+    return arr[0].view(np.float32), arr[1], arr[2]
 
 
 @dataclass
@@ -409,12 +519,48 @@ class AccelSearch:
         if not starts:
             # spectrum too short for one full block: empty plane
             return jnp.zeros((kern.numz, 0), dtype=jnp.float32)
-        numdata = kern.fftlen // 2
         if kern_pairs_dev is None:
-            if self._kern_dev is None:   # one upload, reused
-                self._kern_dev = jnp.asarray(kern.kern_pairs)
-            kern_pairs_dev = self._kern_dev
+            kern_pairs_dev = self._kern_bank_dev()
+        yp = self._ys_plan()
+        if yp is not None:
+            key = ("build_ys",) + yp.key
+            self._build_plan = (key, yp.lobin_chunks)
+            if key not in self._fn_cache:
+                self._fn_cache[key] = jax.jit(yp.build_body)
+            return self._fn_cache[key](self._to_dev(fft_pairs),
+                                       jnp.asarray(yp.lobin_chunks),
+                                       kern_pairs_dev)
+        return self._build_carry(fft_pairs, kern_pairs_dev)
+
+    def _kern_bank_dev(self):
+        if self._kern_dev is None:   # one small upload, reused
+            self._kern_dev = _fft_kernel_bank(
+                jnp.asarray(self.kern.kern_pairs), self.kern.fftlen)
+        return self._kern_dev
+
+    @staticmethod
+    def _to_dev(fft_pairs):
+        if isinstance(fft_pairs, jax.Array):
+            return fft_pairs             # already uploaded (jerk loop)
+        return jnp.asarray(np.ascontiguousarray(fft_pairs))
+
+    def _plane_geom(self):
+        """Block/window geometry of the plane build (host-side ints),
+        cached — it depends only on (cfg, numbins)."""
+        if getattr(self, "_geom", None) is not None:
+            return self._geom
+        cfg, kern = self.cfg, self.kern
+        starts = self._plan_blocks()
+        if not starts:
+            self._geom = False
+            return False
+        numdata = kern.fftlen // 2
+        # plane width padded (zero columns) to a multiple of the
+        # scanner's alignment so every aligned slab fits inside the
+        # plane; zero columns can never exceed powcut
+        align = max(16, cfg.numharm)
         plane_numr = int(2 * int(starts[-1]) + cfg.uselen)
+        plane_numr += (-plane_numr) % align
         # Chunk the block batch: the [chunk, numz, fftlen] complex
         # intermediate is the peak working memory, so bound it (~1 GB
         # per chunk at zmax=200) — the HBM-ladder analog of meminfo.h.
@@ -422,7 +568,6 @@ class AccelSearch:
         # 16 GB HBM and the plane itself is the other big resident.
         chunk = max(1, int(2 ** 30 // (kern.numz * kern.fftlen * 8)))
         col0 = int(starts[0]) * ACCEL_RDR
-
         # Host uploads ONLY the raw spectrum; the per-block read
         # windows are gathered on device (the tunneled host->TPU link
         # runs ~tens of MB/s for real payloads, so shipping the ~10%-
@@ -440,58 +585,74 @@ class AccelSearch:
         lobins = np.asarray(
             [int(s0) - kern.halfwidth for s0 in starts]
             + [self.numbins] * npad_blocks, np.int32) + pad_lo
-        lobin_chunks = lobins.reshape(nsteps, chunk)
-        body_numr = nsteps * chunk * cfg.uselen
+        from types import SimpleNamespace
+        self._geom = SimpleNamespace(
+            starts=starts, numdata=numdata, plane_numr=plane_numr,
+            chunk=chunk, nsteps=nsteps, col0=col0, nblocks=nblocks,
+            lobins=lobins, lobin_chunks=lobins.reshape(nsteps, chunk),
+            pads=((pad_lo, pad_hi), (0, 0)),
+            body_numr=nsteps * chunk * cfg.uselen)
+        return self._geom
 
-        def gather_windows(fft_pad, lobin_chunk):
-            idx = lobin_chunk[:, None] + jnp.arange(numdata)
-            return fft_pad[idx]                 # [chunk, numdata, 2]
+    def _chunk_slab_fn(self, g):
+        """Per-chunk slab computation.  kern_dev is an ARGUMENT (not a
+        closure) so the jerk search's per-w kernel banks share one
+        compiled function."""
+        cfg, kern = self.cfg, self.kern
 
-        # kern_dev is an ARGUMENT of the jitted builders (not a
-        # closure) so the jerk search's per-w kernel banks share one
-        # compiled function
         def chunk_slab(fft_pad, lobin_chunk, kern_dev):
-            batch = gather_windows(fft_pad, lobin_chunk)
+            idx = lobin_chunk[:, None] + jnp.arange(g.numdata)
+            batch = fft_pad[idx]            # [chunk, numdata, 2]
             norms = _block_median_norms(batch)
             powers = _ffdot_blocks(batch * norms, kern_dev, cfg.uselen,
                                    kern.fftlen, kern.halfwidth)
             # [chunk, numz, uselen] -> [numz, chunk*uselen] slab
             return jnp.moveaxis(powers, 0, 1).reshape(kern.numz, -1)
+        return chunk_slab
 
-        if isinstance(fft_pairs, jax.Array):
-            fft_dev = fft_pairs          # already uploaded (jerk loop)
-        else:
-            fft_dev = jnp.asarray(np.ascontiguousarray(fft_pairs))
-        pads = ((pad_lo, pad_hi), (0, 0))
+    def _ys_plan(self):
+        """Carry-free plane-build plan: a scan stacking per-chunk slabs
+        (ys), placed into the plane with ONE transpose-pad copy — a
+        carried-plane dynamic_update_slice costs a large fraction of a
+        plane traversal per scan step.  The stacked ys is a second
+        plane-sized buffer, so returns None (-> carry variant) when 2x
+        plane would crowd HBM (~16 GB on v5e)."""
+        g = self._plane_geom()
+        if g is False:
+            return None
+        kern = self.kern
+        if (kern.numz * (g.plane_numr + g.body_numr) * 4) >= 9 * 2 ** 30:
+            return None
+        if getattr(g, "build_body", None) is None:
+            chunk_slab = self._chunk_slab_fn(g)
+            plane_numr, col0, pads = g.plane_numr, g.col0, g.pads
+            numz = kern.numz
 
-        # One device dispatch: scan over chunks inside a single jit.
-        # Preferred shape: a carry-free scan stacking per-chunk slabs
-        # (ys), placed into the plane with ONE transpose-pad copy — a
-        # carried-plane dynamic_update_slice costs a large fraction of
-        # a plane traversal per scan step.  The stacked ys is a second
-        # plane-sized buffer, so fall back to the carry variant when
-        # 2x plane would crowd HBM (~16 GB on v5e).
-        if (kern.numz * (plane_numr + body_numr) * 4) < 9 * 2 ** 30:
-            key = ("build_ys", chunk, nsteps, plane_numr)
-            self._build_plan = (key, lobin_chunks)
-            if key not in self._fn_cache:
-                @jax.jit
-                def build_ys(fft_raw, lobin_chunks, kern_dev):
-                    fft_pad = jnp.pad(fft_raw, pads)
-                    def body(_, lc):
-                        return None, chunk_slab(fft_pad, lc, kern_dev)
-                    _, ys = jax.lax.scan(body, None, lobin_chunks)
-                    body_arr = jnp.moveaxis(ys, 0, 1).reshape(
-                        kern.numz, -1)[:, :plane_numr - col0]
-                    return jnp.pad(body_arr, ((0, 0), (col0, 0)))
-                self._fn_cache[key] = build_ys
-            return self._fn_cache[key](fft_dev,
-                                       jnp.asarray(lobin_chunks),
-                                       kern_pairs_dev)
+            body_w = min(g.body_numr, plane_numr - col0)
 
+            def build_body(fft_raw, lobin_chunks, kern_dev):
+                fft_pad = jnp.pad(fft_raw, pads)
+                def body(_, lc):
+                    return None, chunk_slab(fft_pad, lc, kern_dev)
+                _, ys = jax.lax.scan(body, None, lobin_chunks)
+                body_arr = jnp.moveaxis(ys, 0, 1).reshape(
+                    numz, -1)[:, :body_w]
+                return jnp.pad(
+                    body_arr,
+                    ((0, 0), (col0, plane_numr - col0 - body_w)))
+            g.build_body = build_body
+            g.key = (g.chunk, g.nsteps, g.plane_numr)
+        return g
+
+    def _build_carry(self, fft_pairs, kern_pairs_dev):
         # carry fallback: per-step in-place slab writes over REAL
         # blocks only (the final chunk overlaps backwards so no padded
         # zero-windows ever overwrite computed columns)
+        g = self._plane_geom()
+        cfg, kern = self.cfg, self.kern
+        chunk, nblocks = g.chunk, g.nblocks
+        chunk_slab = self._chunk_slab_fn(g)
+        pads, plane_numr = g.pads, g.plane_numr
         chunk_ids = []
         c0 = 0
         while c0 < nblocks:
@@ -500,9 +661,10 @@ class AccelSearch:
             chunk_ids.append(c0)
             c0 += chunk
         nsteps = len(chunk_ids)
-        lobin_chunks = np.stack([lobins[i:i + chunk] for i in chunk_ids])
+        lobin_chunks = np.stack([g.lobins[i:i + chunk]
+                                 for i in chunk_ids])
         start_cols = np.asarray(
-            [col0 + i * cfg.uselen for i in chunk_ids], dtype=np.int32)
+            [g.col0 + i * cfg.uselen for i in chunk_ids], dtype=np.int32)
         plane = jnp.zeros((kern.numz, plane_numr), dtype=jnp.float32)
 
         self._build_plan = None     # carry fallback: no batched build
@@ -522,7 +684,7 @@ class AccelSearch:
                 return pl
             self._fn_cache[key] = build_all
 
-        return self._fn_cache[key](plane, fft_dev,
+        return self._fn_cache[key](plane, self._to_dev(fft_pairs),
                                    jnp.asarray(lobin_chunks),
                                    jnp.asarray(start_cols),
                                    kern_pairs_dev)
@@ -531,7 +693,7 @@ class AccelSearch:
 
     def search(self, fft_pairs: np.ndarray,
                plane: Optional[np.ndarray] = None,
-               slab: int = 1 << 19) -> List[AccelCand]:
+               slab: int = 1 << 20) -> List[AccelCand]:
         """Run the full staged harmonic-summing search.
 
         With cfg.wmax set this is the JERK search: one F-Fdot plane per
@@ -556,18 +718,20 @@ class AccelSearch:
         if plane is None and cfg.wmax:
             all_cands: List[AccelCand] = []
             # upload the spectrum ONCE for all w planes
-            if not isinstance(fft_pairs, jax.Array):
-                fft_pairs = jnp.asarray(
-                    np.ascontiguousarray(fft_pairs))
+            fft_pairs = self._to_dev(fft_pairs)
             for w in cfg.ws:
                 bank = self._w_banks.get(float(w))
                 if bank is None:
                     bank = AccelKernels.build(cfg, float(w))
                     if len(self._w_banks) < 8:   # bound host RAM
                         self._w_banks[float(w)] = bank
-                pl = self.build_plane(fft_pairs,
-                                      jnp.asarray(bank.kern_pairs))
-                for c in self._search_plane(pl, slab):
+                kern_dev = _fft_kernel_bank(
+                    jnp.asarray(bank.kern_pairs), bank.fftlen)
+                cs = self._search_fused(fft_pairs, slab, kern_dev)
+                if cs is None:
+                    pl = self.build_plane(fft_pairs, kern_dev)
+                    cs = self._search_plane(pl, slab)
+                for c in cs:
                     # the plane cell is the numharm-th harmonic: its
                     # (r, z, w) all scale down to the fundamental
                     c.w = float(w) / c.numharm
@@ -581,8 +745,45 @@ class AccelSearch:
                     best[key] = c
             return sorted(best.values(), key=lambda c: (-c.sigma, c.r))
         if plane is None:
+            cs = self._search_fused(fft_pairs, slab,
+                                    self._kern_bank_dev())
+            if cs is not None:
+                return cs
             plane = self.build_plane(fft_pairs)
         return self._search_plane(plane, slab)
+
+    def _search_fused(self, fft_pairs, slab: int,
+                      kern_dev) -> Optional[List[AccelCand]]:
+        """Plane build + staged search in ONE device dispatch (the
+        plane never surfaces; saves a host<->device round trip, which
+        costs ~0.2-0.4 s through the tunneled TPU link).  Returns None
+        when the carry-free build plan doesn't apply (huge planes or
+        too-short spectra) — callers then take the two-dispatch path."""
+        yp = self._ys_plan()
+        if yp is None:
+            return None
+        splan = self._slab_plan(yp.plane_numr, slab)
+        if splan is None:
+            return []
+        slab_, k, scanner, start_cols = splan
+        key = ("fused",) + yp.key + (slab_, k)
+        if key not in self._fn_cache:
+            build_body, scan_body = yp.build_body, scanner.body
+
+            @jax.jit
+            def fused(fft_raw, lobin_chunks, kern_dev, scols):
+                return scan_body(
+                    build_body(fft_raw, lobin_chunks, kern_dev), scols)
+            self._fn_cache[key] = fused
+        packed = self._fn_cache[key](
+            self._to_dev(fft_pairs), jnp.asarray(yp.lobin_chunks),
+            kern_dev, jnp.asarray(start_cols, dtype=jnp.int32))
+        vals, cidx, zrow = _unpack_scan(packed)
+        cands: List[AccelCand] = []
+        for si, start in enumerate(start_cols):
+            self._collect_slab(vals[si], cidx[si], zrow[si], start,
+                               cands)
+        return self._dedup_sort(cands)
 
     def _slab_plan(self, plane_numr: int, slab: int):
         """(slab, k, scanner, start_cols) for a plane width — the ONE
@@ -590,23 +791,43 @@ class AccelSearch:
         (the overlap-last-slab trick keeps one jit shape)."""
         cfg = self.cfg
         r0 = int(self.rlo) * ACCEL_RDR
+        self._r0min = r0          # candidates below rlo are filtered
         numr = min(int(self.rhi) * ACCEL_RDR, plane_numr) - r0
         if numr <= 0:
             return None
+        top = r0 + numr
+        self._rtop = top          # ... and at/above rhi (alignment
+                                  # may scan a few columns past top)
         slab = min(slab, numr)
+        # Alignment contract for the scanner's phase-decomposed
+        # harmonic reads: every slab start (and the slab length) is a
+        # multiple of numharm, so each subharmonic read is a static
+        # strided view.  Aligning r0 down (and the top slab up, within
+        # the align-padded plane) scans a few out-of-range columns,
+        # filtered in _collect_slab via _r0min/_rtop.
+        align = cfg.numharm
+        aligned = (slab % align == 0 or slab > 4 * align) \
+            and plane_numr % align == 0
+        if aligned and slab % align:
+            slab -= slab % align
+        r0a = r0 - (r0 % align) if aligned else r0
+        top_a = min(top + ((-top) % align), plane_numr) if aligned \
+            else top
         k = min(cfg.max_cands_per_stage, slab)
-        skey = ("scan", slab, k, plane_numr)
+        skey = ("scan", slab, k, plane_numr, aligned)
         if skey not in self._fn_cache:
             fz = _harm_fracs_and_zinds(cfg, self.cfg.numz)
             self._fn_cache[skey] = _make_search_scanner(
                 cfg.numharmstages, fz, self.powcut, slab, k,
-                plane_numr)
+                plane_numr, aligned=aligned)
         start_cols = []
-        for off in range(0, numr, slab):
-            start = r0 + off
-            if off + slab > numr:               # keep one jit shape:
-                start = r0 + numr - slab        # overlap the last slab
-            start_cols.append(start)
+        off = r0a
+        while True:
+            if off + slab >= top_a:             # keep one jit shape:
+                start_cols.append(max(top_a - slab, 0))  # overlap last
+                break
+            start_cols.append(off)
+            off += slab
         return slab, k, self._fn_cache[skey], start_cols
 
     def _search_plane(self, plane, slab: int) -> List[AccelCand]:
@@ -619,11 +840,8 @@ class AccelSearch:
             return []
         slab, k, scanner, start_cols = plan
         dplane = jnp.asarray(plane)
-        vals, cidx, zrow = scanner(dplane,
-                                   jnp.asarray(start_cols, dtype=jnp.int32))
-        vals = np.asarray(vals)                  # [nslabs, stages, k]
-        cidx = np.asarray(cidx)
-        zrow = np.asarray(zrow)
+        vals, cidx, zrow = _unpack_scan(
+            scanner(dplane, jnp.asarray(start_cols, dtype=jnp.int32)))
         cands: List[AccelCand] = []
         for si, start in enumerate(start_cols):
             self._collect_slab(vals[si], cidx[si], zrow[si], start, cands)
@@ -643,7 +861,7 @@ class AccelSearch:
         return sorted(uniq, key=lambda c: (-c.sigma, c.r))
 
     def search_many(self, pairs_batch: np.ndarray,
-                    slab: int = 1 << 19) -> List[List[AccelCand]]:
+                    slab: int = 1 << 20) -> List[List[AccelCand]]:
         """Batched search over many same-length spectra — the survey's
         DM fan-out (one plane build + one scanned search dispatch per
         memory-budgeted DM group instead of per-trial dispatch storms;
@@ -687,8 +905,7 @@ class AccelSearch:
         slab, k, scanner, start_cols = splan
         scols = jnp.asarray(start_cols, dtype=jnp.int32)
         lob = jnp.asarray(lobin_chunks)
-        if self._kern_dev is None:
-            self._kern_dev = jnp.asarray(self.kern.kern_pairs)
+        self._kern_bank_dev()         # ensure the FFT'd device bank
 
         def collect_dm(vals, cidx, zrow):
             cands: List[AccelCand] = []
@@ -700,7 +917,7 @@ class AccelSearch:
         # the priming plane p0 serves as spectrum 0's search (no
         # discarded build)
         out: List[List[AccelCand]] = [
-            collect_dm(*(np.asarray(a) for a in scanner(p0, scols)))]
+            collect_dm(*_unpack_scan(scanner(p0, scols)))]
         del p0
         plane_bytes = numz * plane_numr * 4
         group = max(1, int(6 * 2 ** 30 // max(plane_bytes * 2, 1)))
@@ -716,10 +933,7 @@ class AccelSearch:
         for g0 in starts:
             sub = jnp.asarray(batch[g0:g0 + group])
             planes = build_many(sub, lob, self._kern_dev)
-            vals, cidx, zrow = scanner.many(planes, scols)
-            vals = np.asarray(vals)
-            cidx = np.asarray(cidx)
-            zrow = np.asarray(zrow)
+            vals, cidx, zrow = _unpack_scan(scanner.many(planes, scols))
             for d in range(vals.shape[0]):
                 if g0 + d < done:
                     continue               # overlap: already collected
@@ -735,19 +949,26 @@ class AccelSearch:
         contributes its max-over-z cell (same-column lower-z cells are
         duplicates under the sifter's r-dedup)."""
         cfg = self.cfg
+        r0min = getattr(self, "_r0min", 0)
+        rtop = getattr(self, "_rtop", None)
         for stage in range(vals.shape[0]):
             numharm = 1 << stage
             v = vals[stage]
             good = v > 0.0
+            if start_col < r0min:     # alignment searched below rlo:
+                good &= (start_col + cidx[stage]) >= r0min
+            if rtop is not None:      # ... or a few columns past rhi
+                good &= (start_col + cidx[stage]) < rtop
             if not np.any(good):
                 continue
-            sigmas = st.candidate_sigma(v[good], numharm,
-                                        self.numindep[stage])
-            for p, s, z_i, r_i in zip(v[good], sigmas, zrow[stage][good],
-                                      cidx[stage][good]):
-                rr = (start_col + int(r_i)) * ACCEL_DR / numharm
-                zz = (-cfg.zmax + int(z_i) * ACCEL_DZ) / numharm
-                out.append(AccelCand(power=float(p), sigma=float(s),
+            sigmas = np.atleast_1d(st.candidate_sigma(
+                v[good], numharm, self.numindep[stage]))
+            for p, s, z_i, r_i in zip(v[good].tolist(), sigmas.tolist(),
+                                      zrow[stage][good].tolist(),
+                                      cidx[stage][good].tolist()):
+                rr = (start_col + r_i) * ACCEL_DR / numharm
+                zz = (-cfg.zmax + z_i * ACCEL_DZ) / numharm
+                out.append(AccelCand(power=p, sigma=s,
                                      numharm=numharm, r=rr, z=zz))
 
 
