@@ -71,6 +71,13 @@ def calc_required_r(harm_fract: float, rfull: float) -> float:
     return int(ACCEL_RDR * rfull * harm_fract + 0.5) * ACCEL_DR
 
 
+def calc_required_w(harm_fract: float, wfull: float) -> float:
+    """w of the subharmonic for fundamental w, rounded to the jerk
+    grid (modern PRESTO's calc_required_w; the mounted reference
+    predates the jerk search)."""
+    return _nearest_int(wfull * harm_fract / ACCEL_DW) * ACCEL_DW
+
+
 def index_from_z(z: float, loz: float) -> int:
     return int((z - loz) * ACCEL_RDZ + DBLCORRECT)
 
@@ -324,7 +331,12 @@ def _make_search_scanner(numharmstages, fracs_zinds, powcuts, slab, k,
     segpad = nseg * SEARCH_SEG - slab
     kk = min(k, nseg)
 
-    def slab_body(P, start_col):
+    def slab_body(planes, start_col):
+        """planes: [1 + n_harm_terms] source planes — planes[0] is the
+        fundamental, planes[1 + fi] the source for harmonic term fi.
+        For the z-only search every entry aliases ONE buffer (free);
+        the jerk search passes per-subharmonic-w planes."""
+        P = planes[0]
         cols = start_col + jnp.arange(slab, dtype=jnp.int32)
         acc = jax.lax.dynamic_slice(P, (0, start_col), (P.shape[0], slab))
 
@@ -345,7 +357,8 @@ def _make_search_scanner(numharmstages, fracs_zinds, powcuts, slab, k,
         for stage in range(1, numharmstages):
             for _ in range(1 << (stage - 1)):   # odd harmonics
                 harm, htot, zinds = fz[fi]
-                fi += 1
+                fi += 1        # planes[fi] is now THIS term's source
+                               # (planes[0] is the fundamental)
                 if (aligned and slab % htot == 0
                         and (slab // htot + 1) * harm <= slab):
                     # Phase-decomposed subharmonic read — NO gather.
@@ -359,7 +372,7 @@ def _make_search_scanner(numharmstages, fracs_zinds, powcuts, slab, k,
                     nq = slab // htot
                     cstart = (start_col // htot) * harm
                     src = jax.lax.dynamic_slice(
-                        P, (0, cstart), (P.shape[0], slab))
+                        planes[fi], (0, cstart), (P.shape[0], slab))
                     sub = jnp.take(src, zinds, axis=0)
                     src3 = sub[:, :(nq + 1) * harm].reshape(
                         -1, nq + 1, harm)
@@ -385,7 +398,7 @@ def _make_search_scanner(numharmstages, fracs_zinds, powcuts, slab, k,
                         + ((start_col % htot) * harm + (htot >> 1))
                         // htot,
                         plane_numr - slab)
-                    src = jax.lax.dynamic_slice(P, (0, cstart),
+                    src = jax.lax.dynamic_slice(planes[fi], (0, cstart),
                                                 (P.shape[0], slab))
                     sub = jnp.take(src, zinds, axis=0)
                     acc = acc + jnp.take(sub, rind - cstart, axis=1)
@@ -397,14 +410,22 @@ def _make_search_scanner(numharmstages, fracs_zinds, powcuts, slab, k,
         return jnp.stack([jax.lax.bitcast_convert_type(vals, jnp.int32),
                           cidx, zrow])
 
-    def _scan_all_py(P, start_cols):
+    nterms = len(fz)
+
+    def _scan_planes_py(planes, start_cols):
         def body(carry, start):
-            return carry, slab_body(P, start)
+            return carry, slab_body(planes, start)
         _, packed = jax.lax.scan(body, None, start_cols)
         return jnp.moveaxis(packed, 1, 0)  # [3, nslabs, stages, k]
 
+    def _scan_all_py(P, start_cols):
+        # z-only search: every harmonic reads the fundamental plane
+        return _scan_planes_py((P,) * (1 + nterms), start_cols)
+
     scan_all = jax.jit(_scan_all_py)
     scan_all.body = _scan_all_py     # unjitted, for fused build+search
+    # jerk search: explicit per-subharmonic-w source planes
+    scan_all.planes = jax.jit(_scan_planes_py)
 
     @jax.jit
     def scan_many(Ps, start_cols):
@@ -699,14 +720,12 @@ class AccelSearch:
         With cfg.wmax set this is the JERK search: one F-Fdot plane per
         w on the ACCEL_DW grid (each with w-response kernels), searched
         independently and merged — the reference jerk search's
-        (r, z, w) volume, w-plane-at-a-time so HBM holds one plane.
-
-        Approximation note: harmonic summing reads subharmonics from
-        the SAME-w plane, i.e. each subharmonic is measured with the
-        stack's w kernel rather than its own w*harm/numharm kernel
-        (the reference builds per-subharmonic w kernels).  High-
-        harmonic jerk sensitivity is therefore below the reference's;
-        numharm=1..2 jerk searches are unaffected.
+        (r, z, w) volume.  Harmonic summing reads each subharmonic
+        from the plane at its OWN grid w, w_sub = calc_required_w(
+        harm/numharm, w) — the per-subharmonic w kernels of modern
+        PRESTO's jerk search — via an HBM-budgeted device plane cache
+        (planes are built in |w| order so subharmonic planes usually
+        already exist; evicted ones are rebuilt).
 
         The plane stays resident in HBM; the search region is processed
         in `slab`-column accumulator slabs (peak extra memory ~
@@ -716,34 +735,7 @@ class AccelSearch:
         """
         cfg = self.cfg
         if plane is None and cfg.wmax:
-            all_cands: List[AccelCand] = []
-            # upload the spectrum ONCE for all w planes
-            fft_pairs = self._to_dev(fft_pairs)
-            for w in cfg.ws:
-                bank = self._w_banks.get(float(w))
-                if bank is None:
-                    bank = AccelKernels.build(cfg, float(w))
-                    if len(self._w_banks) < 8:   # bound host RAM
-                        self._w_banks[float(w)] = bank
-                kern_dev = _fft_kernel_bank(
-                    jnp.asarray(bank.kern_pairs), bank.fftlen)
-                cs = self._search_fused(fft_pairs, slab, kern_dev)
-                if cs is None:
-                    pl = self.build_plane(fft_pairs, kern_dev)
-                    cs = self._search_plane(pl, slab)
-                for c in cs:
-                    # the plane cell is the numharm-th harmonic: its
-                    # (r, z, w) all scale down to the fundamental
-                    c.w = float(w) / c.numharm
-                    all_cands.append(c)
-            # same (numharm, r) found in neighboring w planes: keep the
-            # strongest (the volume's local max)
-            best = {}
-            for c in sorted(all_cands, key=lambda c: -c.sigma):
-                key = (c.numharm, c.r)
-                if key not in best:
-                    best[key] = c
-            return sorted(best.values(), key=lambda c: (-c.sigma, c.r))
+            return self._search_jerk(fft_pairs, slab)
         if plane is None:
             cs = self._search_fused(fft_pairs, slab,
                                     self._kern_bank_dev())
@@ -751,6 +743,114 @@ class AccelSearch:
                 return cs
             plane = self.build_plane(fft_pairs)
         return self._search_plane(plane, slab)
+
+    def _harm_fracs(self):
+        """Harmonic fractions in the scanner's term order — derived
+        from the SAME flattened _harm_fracs_and_zinds list the scanner
+        consumes, so the planes[1+fi] <-> fraction pairing cannot
+        drift."""
+        fz = _harm_fracs_and_zinds(self.cfg, self.cfg.numz)
+        return [harm / htot
+                for stage in fz for (harm, htot, _zi) in stage]
+
+    def _collect_packed(self, packed, start_cols) -> List[AccelCand]:
+        vals, cidx, zrow = _unpack_scan(packed)
+        cands: List[AccelCand] = []
+        for si, start in enumerate(start_cols):
+            self._collect_slab(vals[si], cidx[si], zrow[si], start,
+                               cands)
+        return self._dedup_sort(cands)
+
+    def _search_jerk(self, fft_pairs, slab: int) -> List[AccelCand]:
+        """The (r, z, w) jerk search over the ACCEL_DW w grid with
+        per-subharmonic-w source planes (see search() docstring)."""
+        cfg = self.cfg
+        fft_pairs = self._to_dev(fft_pairs)
+        fracs = self._harm_fracs()
+
+        def bank_for(wg: float) -> AccelKernels:
+            bank = self._w_banks.get(wg)
+            if bank is None:
+                bank = AccelKernels.build(cfg, wg)
+                if len(self._w_banks) < 8:      # bound host RAM
+                    self._w_banks[wg] = bank
+            return bank
+
+        all_cands: List[AccelCand] = []
+
+        if not fracs:
+            # numharm == 1: no subharmonic reads — take the fused
+            # build+search dispatch per w (no resident plane at all)
+            for w in (float(x) for x in cfg.ws):
+                kern_dev = _fft_kernel_bank(
+                    jnp.asarray(bank_for(w).kern_pairs),
+                    self.kern.fftlen)
+                cs = self._search_fused(fft_pairs, slab, kern_dev)
+                if cs is None:
+                    cs = self._search_plane(
+                        self.build_plane(fft_pairs, kern_dev), slab)
+                for c in cs:
+                    c.w = w
+                    all_cands.append(c)
+            return self._merge_w_cands(all_cands)
+
+        # Per-subharmonic-w source planes over an HBM-budgeted LRU.
+        # Planes in `keep` are the current scan's working set and are
+        # never evicted — at numharm=16 that is up to 5 distinct
+        # planes, the irreducible footprint of per-subharmonic reads.
+        plane_cache: dict = {}        # grid w -> device plane (LRU)
+        g = self._plane_geom()
+        plane_bytes = max(self.kern.numz * g.plane_numr * 4, 1) \
+            if g else 1
+        max_planes = max(1, int(10 * 2 ** 30 // plane_bytes))
+
+        def plane_for(wg: float, keep: set):
+            pl = plane_cache.pop(wg, None)
+            if pl is None:
+                # evict BEFORE building so peak residency stays at
+                # max_planes (+ the build's own working memory)
+                while len(plane_cache) >= max_planes:
+                    for old in list(plane_cache):   # LRU, spare keep
+                        if old not in keep:
+                            del plane_cache[old]
+                            break
+                    else:
+                        break
+                bank = bank_for(wg)
+                pl = self.build_plane(fft_pairs, _fft_kernel_bank(
+                    jnp.asarray(bank.kern_pairs), bank.fftlen))
+            plane_cache[wg] = pl      # (re)insert most-recent
+            return pl
+
+        for w in sorted((float(x) for x in cfg.ws), key=abs):
+            wsubs = [calc_required_w(f, w) for f in fracs]
+            keep = set(wsubs) | {w}
+            pl = plane_for(w, keep)
+            subs = [plane_for(wg, keep) for wg in wsubs]
+            splan = self._slab_plan(pl.shape[1], slab)
+            if splan is None:
+                return []
+            slab_, k, scanner, start_cols = splan
+            packed = scanner.planes(
+                tuple([pl] + subs),
+                jnp.asarray(start_cols, dtype=jnp.int32))
+            for c in self._collect_packed(packed, start_cols):
+                # the plane cell is the numharm-th harmonic: its
+                # (r, z, w) all scale down to the fundamental
+                c.w = w / c.numharm
+                all_cands.append(c)
+        return self._merge_w_cands(all_cands)
+
+    @staticmethod
+    def _merge_w_cands(all_cands: List[AccelCand]) -> List[AccelCand]:
+        """Same (numharm, r) found in neighboring w planes: keep the
+        strongest (the volume's local max)."""
+        best = {}
+        for c in sorted(all_cands, key=lambda c: -c.sigma):
+            key = (c.numharm, c.r)
+            if key not in best:
+                best[key] = c
+        return sorted(best.values(), key=lambda c: (-c.sigma, c.r))
 
     def _search_fused(self, fft_pairs, slab: int,
                       kern_dev) -> Optional[List[AccelCand]]:
@@ -778,12 +878,7 @@ class AccelSearch:
         packed = self._fn_cache[key](
             self._to_dev(fft_pairs), jnp.asarray(yp.lobin_chunks),
             kern_dev, jnp.asarray(start_cols, dtype=jnp.int32))
-        vals, cidx, zrow = _unpack_scan(packed)
-        cands: List[AccelCand] = []
-        for si, start in enumerate(start_cols):
-            self._collect_slab(vals[si], cidx[si], zrow[si], start,
-                               cands)
-        return self._dedup_sort(cands)
+        return self._collect_packed(packed, start_cols)
 
     def _slab_plan(self, plane_numr: int, slab: int):
         """(slab, k, scanner, start_cols) for a plane width — the ONE
@@ -840,12 +935,9 @@ class AccelSearch:
             return []
         slab, k, scanner, start_cols = plan
         dplane = jnp.asarray(plane)
-        vals, cidx, zrow = _unpack_scan(
-            scanner(dplane, jnp.asarray(start_cols, dtype=jnp.int32)))
-        cands: List[AccelCand] = []
-        for si, start in enumerate(start_cols):
-            self._collect_slab(vals[si], cidx[si], zrow[si], start, cands)
-        return self._dedup_sort(cands)
+        packed = scanner(dplane, jnp.asarray(start_cols,
+                                             dtype=jnp.int32))
+        return self._collect_packed(packed, start_cols)
 
     @staticmethod
     def _dedup_sort(cands: List[AccelCand]) -> List[AccelCand]:

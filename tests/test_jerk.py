@@ -130,6 +130,46 @@ def test_full_jerk_search_finds_what_rz_misses():
     assert w_found is not None and abs(w_found - w_sig) <= 20.0
 
 
+def test_jerk_harmonic_sum_uses_subharmonic_w_planes():
+    """A narrow-pulse (harmonic-rich) pulsar with pure jerk w1 per
+    fundamental: harmonic k lives at (k*r1, k*z1, k*w1), so the
+    numharm=4 stack at plane w=4*w1 must read each subharmonic from
+    its OWN w plane (calc_required_w) — the same-w approximation
+    would misplace them.  The stacked candidate must surface with
+    numharm >= 2 at the right fundamental w."""
+    from presto_tpu.search.accel import (AccelConfig, AccelSearch,
+                                         calc_required_w)
+    from presto_tpu.ops import fftpack
+    import jax.numpy as jnp
+
+    # grid-rounding sanity of the subharmonic w map
+    assert calc_required_w(1 / 2, 80.0) == 40.0
+    assert calc_required_w(3 / 4, 80.0) == 60.0
+    assert calc_required_w(1 / 4, 50.0) == 20.0   # round half up
+
+    Nj, dtj = 1 << 15, 1e-4
+    Tj = Nj * dtj
+    f0, w1 = 100.0, 20.0
+    fdd = w1 / Tj ** 3
+    t = np.arange(Nj) * dtj
+    phi = f0 * t + fdd * t ** 3 / 6.0
+    prof = np.exp(-0.5 * (((phi + 0.5) % 1.0) - 0.5) ** 2 / 0.06 ** 2)
+    x = (0.55 * prof + RNG.normal(0, 1, Nj)).astype(np.float32)
+    pairs = np.asarray(fftpack.realfft_packed_pairs(
+        jnp.asarray(x - x.mean())))
+
+    cfg = AccelConfig(zmax=50, wmax=int(4 * w1), numharm=4, sigma=2.0,
+                      uselen=1820)
+    s = AccelSearch(cfg, T=Tj, numbins=pairs.shape[0])
+    cands = s.search(pairs)
+    f_mean1 = f0 + w1 / (6.0 * Tj)
+    mine = [c for c in cands
+            if abs(c.r / Tj - f_mean1) < 1.0 and c.numharm >= 2]
+    assert mine, "harmonic-stacked jerk candidate not found"
+    best = max(mine, key=lambda c: c.sigma)
+    assert abs(best.w - w1) <= 20.0, best.w
+
+
 def test_accel_cand_fold_conversion(tmp_path):
     """prepfold -accelfile must convert the candidate's MEAN-value
     (r, z, w) into t=0 Taylor coefficients — folding an accelerated
