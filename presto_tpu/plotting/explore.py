@@ -1,0 +1,253 @@
+"""explorefft/exploredat view logic + matplotlib rendering.
+
+The reference ships PGPLOT-based interactive browsers
+(src/explorefft.c:1-1030, src/exploredat.c:1-744): a power spectrum /
+time series is displayed at most DISPLAYNUM=1024 points per screen by
+taking the max (spectrum) or min/avg/max (series) over chunks, with
+keyboard zoom/pan, median normalization, and harmonic markers.  This
+module rebuilds that as a pure-logic view class (testable headless)
+plus matplotlib rendering; the apps attach key bindings when an
+interactive backend is available and write a PNG otherwise.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+DISPLAYNUM = 1024               # max points on screen (explorefft.c:25)
+LOCALCHUNK = 16                 # chunk for local-median norm (:26)
+
+
+def _chunk_reduce(x: np.ndarray, nout: int, how: str) -> np.ndarray:
+    """Reduce x to nout display points chunk-wise (pads the tail)."""
+    n = len(x)
+    if n <= nout:
+        return x
+    csize = -(-n // nout)
+    pad = csize * nout - n
+    if pad:
+        x = np.concatenate([x, np.full(pad, x[-1], x.dtype)])
+    c = x.reshape(nout, csize)
+    if how == "max":
+        return c.max(axis=1)
+    if how == "min":
+        return c.min(axis=1)
+    return c.mean(axis=1)
+
+
+@dataclass
+class SpectrumView:
+    """Windowed view of a packed .fft power spectrum.
+
+    Mirrors explorefft's display model: median-normalized powers
+    (local LOCALCHUNK medians, like the reference's chunked polynomial
+    fit), chunk-max display reduction, power-of-two zoom, harmonic
+    markers.
+    """
+    powers: np.ndarray            # raw |X|^2, k = 0..n/2-1
+    T: float                      # observation length (s)
+    lobin: int = 0
+    numbins: int = 0              # 0 -> initial window (2^17 like ref)
+    harmonics: int = 0            # draw markers at k*f0 for cursor f0
+    cursor_r: float = 0.0
+
+    def __post_init__(self):
+        n = len(self.powers)
+        if self.numbins <= 0:
+            self.numbins = min(n, 1 << 17)
+        self.numbins = max(32, min(self.numbins, n))
+        self.lobin = int(max(0, min(self.lobin, n - self.numbins)))
+
+    # -- navigation ----------------------------------------------------
+    def zoom(self, factor: float) -> None:
+        """factor > 1 zooms out (more bins), < 1 in; recenters."""
+        n = len(self.powers)
+        center = self.lobin + self.numbins // 2
+        newnum = int(max(32, min(n, self.numbins * factor)))
+        self.lobin = max(0, min(center - newnum // 2, n - newnum))
+        self.numbins = newnum
+
+    def pan(self, frac: float) -> None:
+        """Shift the window by frac of its width (+right / -left)."""
+        n = len(self.powers)
+        self.lobin = int(max(0, min(self.lobin + frac * self.numbins,
+                                    n - self.numbins)))
+
+    def goto_freq(self, f_hz: float) -> None:
+        self.lobin = int(max(0, min(f_hz * self.T - self.numbins // 2,
+                                    len(self.powers) - self.numbins)))
+
+    # -- data ----------------------------------------------------------
+    def normalized(self) -> np.ndarray:
+        """Median-normalized powers of the current window (the
+        reference's chunked local normalization, explorefft.c's
+        LOGLOCALCHUNK medians; powers/median * ln2 so chi^2 mean=1)."""
+        w = self.powers[self.lobin:self.lobin + self.numbins]
+        nc = max(1, len(w) // LOCALCHUNK)
+        csize = -(-len(w) // nc)
+        pad = csize * nc - len(w)
+        wp = np.concatenate([w, np.full(pad, w[-1])]) if pad else w
+        med = np.median(wp.reshape(nc, csize), axis=1)
+        med = np.maximum(np.repeat(med, csize)[:len(w)], 1e-30)
+        return (w / med) * np.log(2.0)
+
+    def display(self) -> Tuple[np.ndarray, np.ndarray]:
+        """(freqs_hz, display_powers) with <= DISPLAYNUM chunk-max
+        points (explorefft shows the max so narrow peaks survive)."""
+        norm = self.normalized()
+        nout = min(DISPLAYNUM, len(norm))
+        disp = _chunk_reduce(norm, nout, "max")
+        rs = self.lobin + np.arange(len(disp)) * (len(norm) / len(disp))
+        return rs / self.T, disp
+
+    def harmonic_freqs(self) -> List[float]:
+        if not self.harmonics or self.cursor_r <= 0:
+            return []
+        f0 = self.cursor_r / self.T
+        return [f0 * k for k in range(1, self.harmonics + 1)]
+
+
+@dataclass
+class TimeseriesView:
+    """Windowed view of a .dat time series (exploredat.c model):
+    chunked min/avg/max envelopes."""
+    data: np.ndarray
+    dt: float
+    lobin: int = 0
+    numbins: int = 0
+
+    def __post_init__(self):
+        n = len(self.data)
+        if self.numbins <= 0:
+            self.numbins = min(n, 1 << 16)
+        self.numbins = max(32, min(self.numbins, n))
+        self.lobin = int(max(0, min(self.lobin, n - self.numbins)))
+
+    def zoom(self, factor: float) -> None:
+        n = len(self.data)
+        center = self.lobin + self.numbins // 2
+        newnum = int(max(32, min(n, self.numbins * factor)))
+        self.lobin = max(0, min(center - newnum // 2, n - newnum))
+        self.numbins = newnum
+
+    def pan(self, frac: float) -> None:
+        n = len(self.data)
+        self.lobin = int(max(0, min(self.lobin + frac * self.numbins,
+                                    n - self.numbins)))
+
+    def display(self):
+        """(times_s, avg, mn, mx) chunk envelopes, <= DISPLAYNUM."""
+        w = self.data[self.lobin:self.lobin + self.numbins]
+        nout = min(DISPLAYNUM, len(w))
+        avg = _chunk_reduce(w, nout, "avg")
+        mn = _chunk_reduce(w, nout, "min")
+        mx = _chunk_reduce(w, nout, "max")
+        ts = (self.lobin + np.arange(len(avg)) *
+              (len(w) / len(avg))) * self.dt
+        return ts, avg, mn, mx
+
+    def stats(self) -> Tuple[float, float, float, float]:
+        w = self.data[self.lobin:self.lobin + self.numbins]
+        return (float(w.mean()), float(w.std()),
+                float(w.min()), float(w.max()))
+
+
+HELP = """explore keys:
+  z / Z    zoom in / out (x2)
+  < / >    pan left / right (also arrow keys)
+  h        toggle x16 harmonic markers at the strongest shown peak
+  g        (spectrum) center on strongest displayed peak
+  s        print window stats to stdout
+  q        quit
+"""
+
+
+def render_spectrum(view: SpectrumView, ax) -> None:
+    f, p = view.display()
+    ax.clear()
+    ax.plot(f, p, lw=0.6, color="#2060a0")
+    for i, hf in enumerate(view.harmonic_freqs()):
+        if f[0] <= hf <= f[-1]:
+            ax.axvline(hf, color="#c04040", lw=0.7, alpha=0.6)
+    ax.set_xlabel("Frequency (Hz)")
+    ax.set_ylabel("Normalized power")
+    ax.set_title("bins %d - %d of %d  (max-of-chunk display)"
+                 % (view.lobin, view.lobin + view.numbins,
+                    len(view.powers)))
+    ax.set_xlim(f[0], f[-1])
+
+
+def render_timeseries(view: TimeseriesView, ax) -> None:
+    ts, avg, mn, mx = view.display()
+    ax.clear()
+    if view.numbins > len(avg):          # envelope display
+        ax.fill_between(ts, mn, mx, color="#a0c0e0", alpha=0.7,
+                        label="min/max")
+    ax.plot(ts, avg, lw=0.6, color="#2060a0", label="avg")
+    mean, std, lo, hi = view.stats()
+    ax.set_xlabel("Time (s)")
+    ax.set_ylabel("Amplitude")
+    ax.set_title("bins %d - %d of %d   mean %.3g  std %.3g"
+                 % (view.lobin, view.lobin + view.numbins,
+                    len(view.data), mean, std))
+    ax.set_xlim(ts[0], ts[-1])
+
+
+def run_explorer(view, render, out_png: Optional[str] = None) -> str:
+    """Interactive loop when a GUI backend is up; else render a PNG.
+    Returns the mode used ('interactive' or the png path)."""
+    import matplotlib
+    import matplotlib.pyplot as plt
+
+    interactive = (out_png is None and
+                   matplotlib.get_backend().lower() not in
+                   ("agg", "pdf", "svg", "ps", "cairo", "template"))
+    fig, ax = plt.subplots(figsize=(11, 5))
+    render(view, ax)
+    if not interactive:
+        path = out_png or "explore.png"
+        fig.savefig(path, dpi=110)
+        plt.close(fig)
+        return path
+
+    print(HELP)
+
+    def on_key(event):
+        k = event.key
+        if k == "q":
+            plt.close(fig)
+            return
+        if k == "z":
+            view.zoom(0.5)
+        elif k == "Z":
+            view.zoom(2.0)
+        elif k in ("<", "left"):
+            view.pan(-0.4)
+        elif k in (">", "right"):
+            view.pan(0.4)
+        elif k == "h" and isinstance(view, SpectrumView):
+            if view.harmonics:
+                view.harmonics = 0
+            else:
+                f, p = view.display()
+                view.cursor_r = f[int(np.argmax(p))] * view.T
+                view.harmonics = 16
+        elif k == "g" and isinstance(view, SpectrumView):
+            f, p = view.display()
+            view.goto_freq(f[int(np.argmax(p))])
+        elif k == "s":
+            if isinstance(view, SpectrumView):
+                f, p = view.display()
+                print("window %.6f-%.6f Hz, max norm power %.2f"
+                      % (f[0], f[-1], float(p.max())))
+            else:
+                print("mean/std/min/max:", view.stats())
+        render(view, ax)
+        fig.canvas.draw_idle()
+
+    fig.canvas.mpl_connect("key_press_event", on_key)
+    plt.show()
+    return "interactive"

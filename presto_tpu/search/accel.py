@@ -822,18 +822,19 @@ class AccelSearch:
             plane_cache[wg] = pl      # (re)insert most-recent
             return pl
 
+        # one slab plan for the whole loop: plane width is w-invariant
+        # (fftlen/uselen geometry is shared by every bank)
+        splan = self._slab_plan(g.plane_numr, slab) if g else None
+        if splan is None:
+            return []
+        slab_, k, scanner, start_cols = splan
+        scols = jnp.asarray(start_cols, dtype=jnp.int32)
         for w in sorted((float(x) for x in cfg.ws), key=abs):
             wsubs = [calc_required_w(f, w) for f in fracs]
             keep = set(wsubs) | {w}
             pl = plane_for(w, keep)
             subs = [plane_for(wg, keep) for wg in wsubs]
-            splan = self._slab_plan(pl.shape[1], slab)
-            if splan is None:
-                return []
-            slab_, k, scanner, start_cols = splan
-            packed = scanner.planes(
-                tuple([pl] + subs),
-                jnp.asarray(start_cols, dtype=jnp.int32))
+            packed = scanner.planes(tuple([pl] + subs), scols)
             for c in self._collect_packed(packed, start_cols):
                 # the plane cell is the numharm-th harmonic: its
                 # (r, z, w) all scale down to the fundamental

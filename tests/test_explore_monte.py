@@ -1,0 +1,96 @@
+"""explorefft/exploredat viewers + Monte-Carlo binary campaign."""
+
+import json
+
+import numpy as np
+import pytest
+
+from presto_tpu.plotting.explore import (DISPLAYNUM, SpectrumView,
+                                         TimeseriesView)
+
+
+def test_spectrum_view_navigation_and_display():
+    rng = np.random.default_rng(0)
+    n = 1 << 16
+    powers = rng.exponential(size=n)
+    powers[5000] = 500.0                       # a strong tone
+    v = SpectrumView(powers=powers, T=100.0)
+    f, p = v.display()
+    assert len(p) <= DISPLAYNUM
+    # the chunk-max display must keep the narrow peak visible
+    assert p.max() > 50.0
+    # zoom in, then center on the peak: survives at full res
+    while v.numbins > 64:
+        v.zoom(0.5)
+    v.goto_freq(5000 / 100.0)
+    f, p = v.display()
+    assert f[0] <= 50.0 <= f[-1]
+    v.pan(1.0)
+    assert v.lobin >= 0
+    v.harmonics, v.cursor_r = 4, 5000.0
+    hf = v.harmonic_freqs()
+    assert hf == [50.0, 100.0, 150.0, 200.0]
+
+
+def test_timeseries_view_envelopes():
+    rng = np.random.default_rng(1)
+    data = rng.normal(size=1 << 15).astype(np.float32)
+    data[20000:20010] += 50.0
+    v = TimeseriesView(data=data, dt=1e-3)
+    ts, avg, mn, mx = v.display()
+    assert len(avg) <= DISPLAYNUM
+    assert mx.max() > 40.0                     # spike survives in max
+    assert (mn <= avg).all() and (avg <= mx).all()
+    mean, std, lo, hi = v.stats()
+    assert hi > 40.0
+
+
+def test_explore_apps_render_png(tmp_path):
+    import matplotlib
+    matplotlib.use("Agg")
+    from presto_tpu.apps import exploredat, explorefft
+    from presto_tpu.io.infodata import InfoData, write_inf
+
+    rng = np.random.default_rng(2)
+    n = 1 << 14
+    x = rng.normal(size=n).astype(np.float32)
+    x += 0.5 * np.sin(2 * np.pi * 12.5 * np.arange(n) * 1e-3)
+    base = str(tmp_path / "obs")
+    x.tofile(base + ".dat")
+    write_inf(InfoData(name=base, N=n, dt=1e-3), base + ".inf")
+    amps = np.fft.rfft(x)[:n // 2].astype(np.complex64)
+    amps.tofile(base + ".fft")
+
+    out1 = str(tmp_path / "fft.png")
+    explorefft.main([base + ".fft", "-png", out1])
+    out2 = str(tmp_path / "dat.png")
+    exploredat.main([base + ".dat", "-start", "0.5", "-dur", "4.0",
+                     "-png", out2])
+    import os
+    assert os.path.getsize(out1) > 5000
+    assert os.path.getsize(out2) > 5000
+
+
+def test_monte_campaign_regimes(tmp_path):
+    """The physics check the reference's monte_* scripts encode:
+    acceleration search detects the long-orbit regime, the
+    phase-modulation search the short-orbit regime."""
+    from presto_tpu.pipeline.monte import (MonteConfig, format_table,
+                                           run_campaign, save_json)
+    cfg = MonteConfig(N=1 << 19, dt=1e-2, f_psr=20.0, amp=0.2,
+                      asini_lts=0.2, pb_over_t=(0.1, 20.0),
+                      ntrials=2, sigma_cut=4.0, seed=7)
+    res = run_campaign(cfg, methods=["ffdot", "long"])
+    frac = res["results"]
+    # long orbit (pb/T=20: negligible acceleration): ffdot finds it
+    assert frac["20.0"]["ffdot"] >= 1 / 2
+    # short orbit (pb/T=0.1): phase-modulation sidebands find it
+    assert frac["0.1"]["long"] >= 1 / 2
+    # and ffdot degrades in the short-orbit regime
+    assert frac["0.1"]["ffdot"] < frac["0.1"]["long"]
+    assert frac["0.1"]["ffdot"] <= frac["20.0"]["ffdot"]
+    txt = format_table(res)
+    assert "ffdot" in txt and "0.1" in txt
+    out = str(tmp_path / "monte.json")
+    save_json(res, out)
+    assert json.load(open(out))["results"]
