@@ -214,7 +214,10 @@ def run(args):
 
 
 def main(argv=None) -> int:
-    run(build_parser().parse_args(argv))
+    from presto_tpu.utils.timing import app_timer
+    args = build_parser().parse_args(argv)
+    with app_timer("accelsearch"):
+        run(args)
     return 0
 
 

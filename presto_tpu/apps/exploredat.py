@@ -8,8 +8,7 @@ from __future__ import annotations
 
 import argparse
 
-from presto_tpu.io import datfft
-from presto_tpu.io.infodata import read_inf
+from presto_tpu.io.datfft import read_dat_with_inf
 from presto_tpu.plotting.explore import (TimeseriesView,
                                          render_timeseries,
                                          run_explorer)
@@ -29,10 +28,7 @@ def build_parser():
 
 def main(argv=None):
     args = build_parser().parse_args(argv)
-    base = args.datfile[:-4] if args.datfile.endswith(".dat") \
-        else args.datfile
-    data = datfft.read_dat(base + ".dat")
-    info = read_inf(base)
+    data, info = read_dat_with_inf(args.datfile)
     lobin = int(args.start / info.dt) if args.start else 0
     numbins = int(args.dur / info.dt) if args.dur else 0
     view = TimeseriesView(data=data, dt=info.dt, lobin=lobin,

@@ -151,7 +151,10 @@ def run(args) -> str:
 
 
 def main(argv=None):
-    run(build_parser().parse_args(argv))
+    from presto_tpu.utils.timing import app_timer
+    args = build_parser().parse_args(argv)
+    with app_timer("prepdata"):
+        run(args)
 
 
 if __name__ == "__main__":

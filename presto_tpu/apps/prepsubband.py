@@ -109,9 +109,12 @@ def run(args):
     # native feeder overlaps disk IO with device compute)
     block_iter = (fb.stream_blocks(blocklen)
                   if hasattr(fb, "stream_blocks") else None)
+    from presto_tpu.utils.timing import print_percent_complete
     nread = 0
     nblocks = 0
+    pct = -1
     while nread < hdr.N + 2 * blocklen:   # two extra flush blocks
+        pct = print_percent_complete(min(nread, hdr.N), hdr.N, pct)
         if nread < hdr.N:
             block = (next(block_iter) if block_iter is not None
                      else fb.read_spectra(nread, blocklen))
@@ -171,7 +174,10 @@ def run(args):
 
 
 def main(argv=None):
-    run(build_parser().parse_args(argv))
+    from presto_tpu.utils.timing import app_timer
+    args = build_parser().parse_args(argv)
+    with app_timer("prepsubband"):
+        run(args)
 
 
 if __name__ == "__main__":

@@ -72,8 +72,10 @@ def run(args):
 
 
 def main(argv=None):
+    from presto_tpu.utils.timing import app_timer
     args = build_parser().parse_args(argv)
-    run(args)
+    with app_timer("search_bin"):
+        run(args)
 
 
 if __name__ == "__main__":

@@ -69,7 +69,19 @@ def run_survey(rawfiles: Sequence[str], cfg: SurveyConfig,
     base = os.path.join(
         workdir, os.path.splitext(os.path.basename(rawfiles[0]))[0])
     res = SurveyResult(workdir=workdir)
+    from presto_tpu.utils.timing import StageTimer
+    timer = StageTimer()
+    try:
+        return _run_survey_stages(rawfiles, cfg, workdir, base, res,
+                                  timer)
+    finally:
+        timer.mark(None)
+        timer.report()
 
+
+def _run_survey_stages(rawfiles, cfg, workdir, base, res, timer):
+
+    timer.mark("rfifind")
     # ---- 1. rfifind ---------------------------------------------------
     mask = base + "_rfifind.mask"
     if not cfg.skip_rfifind:
@@ -79,6 +91,7 @@ def run_survey(rawfiles: Sequence[str], cfg: SurveyConfig,
                          + rawfiles)
         res.maskfile = mask
 
+    timer.mark("ddplan")
     # ---- 2. DDplan ----------------------------------------------------
     from presto_tpu.apps.common import open_raw
     from presto_tpu.pipeline.ddplan import Observation, plan_dedispersion
@@ -93,6 +106,7 @@ def run_survey(rawfiles: Sequence[str], cfg: SurveyConfig,
     print("survey: DDplan -> %d methods, %d total DMs"
           % (len(plan.methods), plan.total_numdms))
 
+    timer.mark("prepsubband")
     # ---- 3. prepsubband per method ------------------------------------
     from presto_tpu.apps.prepsubband import main as prepsubband_main
     for m in plan.methods:
@@ -111,6 +125,7 @@ def run_survey(rawfiles: Sequence[str], cfg: SurveyConfig,
     res.datfiles = _stage(os.path.basename(base) + "_DM*.dat", workdir)
     print("survey: %d dedispersed time series" % len(res.datfiles))
 
+    timer.mark("realfft")
     # ---- 4. realfft: BATCHED over the DM fan-out ----------------------
     # per-file FFTs pay the tunnel's seconds-scale device->host latency
     # 264 times; batching turns the stage into one upload, one batched
@@ -143,12 +158,14 @@ def run_survey(rawfiles: Sequence[str], cfg: SurveyConfig,
         print("survey: realfft over %d series (batched)" % len(todo))
     fftfiles = [f[:-4] + ".fft" for f in res.datfiles]
 
+    timer.mark("zapbirds")
     # ---- 5. zapbirds --------------------------------------------------
     if cfg.zaplist:
         from presto_tpu.apps.zapbirds import main as zap_main
         for f in fftfiles:
             zap_main(["-zap", "-zapfile", cfg.zaplist, f])
 
+    timer.mark("accelsearch")
     # ---- 6. accelsearch: BATCHED over the DM fan-out ------------------
     # all trials share length and T, so the whole survey's search runs
     # as grouped device dispatches (search_many) instead of a per-DM
@@ -185,6 +202,7 @@ def run_survey(rawfiles: Sequence[str], cfg: SurveyConfig,
         print("survey: accelsearch over %d trials (batched)"
               % len(todo))
 
+    timer.mark("sift")
     # ---- 7. sift ------------------------------------------------------
     from presto_tpu.pipeline.sifting import sift_candidates
     accfiles = _stage(os.path.basename(base)
@@ -197,6 +215,7 @@ def run_survey(rawfiles: Sequence[str], cfg: SurveyConfig,
     print("survey: %d sifted candidates -> %s"
           % (len(cl), res.candfile))
 
+    timer.mark("prepfold")
     # ---- 8. fold the top candidates -----------------------------------
     from presto_tpu.apps.prepfold import main as prepfold_main
     top = sorted(cl.cands, key=lambda c: -c.sigma)[:cfg.fold_top]
@@ -221,6 +240,7 @@ def run_survey(rawfiles: Sequence[str], cfg: SurveyConfig,
             print("survey: fold of cand %d failed: %s" % (i + 1, e))
     print("survey: folded %d candidates" % len(res.folded))
 
+    timer.mark("single_pulse")
     # ---- 9. single-pulse search --------------------------------------
     if cfg.singlepulse and res.datfiles:
         from presto_tpu.apps.single_pulse_search import main as sp_main

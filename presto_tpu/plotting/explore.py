@@ -21,16 +21,22 @@ DISPLAYNUM = 1024               # max points on screen (explorefft.c:25)
 LOCALCHUNK = 16                 # chunk for local-median norm (:26)
 
 
-def _chunk_reduce(x: np.ndarray, nout: int, how: str) -> np.ndarray:
-    """Reduce x to nout display points chunk-wise (pads the tail)."""
+def _chunks_of(x: np.ndarray, nchunks: int):
+    """x padded (last value) and reshaped to [nchunks, csize] — the
+    one source of the tail-padding convention."""
     n = len(x)
-    if n <= nout:
-        return x
-    csize = -(-n // nout)
-    pad = csize * nout - n
+    csize = -(-n // nchunks)
+    pad = csize * nchunks - n
     if pad:
         x = np.concatenate([x, np.full(pad, x[-1], x.dtype)])
-    c = x.reshape(nout, csize)
+    return x.reshape(nchunks, csize), csize
+
+
+def _chunk_reduce(x: np.ndarray, nout: int, how: str) -> np.ndarray:
+    """Reduce x to nout display points chunk-wise (pads the tail)."""
+    if len(x) <= nout:
+        return x
+    c, _ = _chunks_of(x, nout)
     if how == "max":
         return c.max(axis=1)
     if how == "min":
@@ -39,7 +45,36 @@ def _chunk_reduce(x: np.ndarray, nout: int, how: str) -> np.ndarray:
 
 
 @dataclass
-class SpectrumView:
+class _WindowedView:
+    """Shared zoom/pan/clamp navigation over a 1-D array window."""
+
+    def _n(self) -> int:
+        return len(self._array())
+
+    def _clamp(self, default_bins: int) -> None:
+        n = self._n()
+        if self.numbins <= 0:
+            self.numbins = min(n, default_bins)
+        self.numbins = max(32, min(self.numbins, n))
+        self.lobin = int(max(0, min(self.lobin, n - self.numbins)))
+
+    def zoom(self, factor: float) -> None:
+        """factor > 1 zooms out (more bins), < 1 in; recenters."""
+        n = self._n()
+        center = self.lobin + self.numbins // 2
+        newnum = int(max(32, min(n, self.numbins * factor)))
+        self.lobin = max(0, min(center - newnum // 2, n - newnum))
+        self.numbins = newnum
+
+    def pan(self, frac: float) -> None:
+        """Shift the window by frac of its width (+right / -left)."""
+        n = self._n()
+        self.lobin = int(max(0, min(self.lobin + frac * self.numbins,
+                                    n - self.numbins)))
+
+
+@dataclass
+class SpectrumView(_WindowedView):
     """Windowed view of a packed .fft power spectrum.
 
     Mirrors explorefft's display model: median-normalized powers
@@ -54,27 +89,11 @@ class SpectrumView:
     harmonics: int = 0            # draw markers at k*f0 for cursor f0
     cursor_r: float = 0.0
 
+    def _array(self) -> np.ndarray:
+        return self.powers
+
     def __post_init__(self):
-        n = len(self.powers)
-        if self.numbins <= 0:
-            self.numbins = min(n, 1 << 17)
-        self.numbins = max(32, min(self.numbins, n))
-        self.lobin = int(max(0, min(self.lobin, n - self.numbins)))
-
-    # -- navigation ----------------------------------------------------
-    def zoom(self, factor: float) -> None:
-        """factor > 1 zooms out (more bins), < 1 in; recenters."""
-        n = len(self.powers)
-        center = self.lobin + self.numbins // 2
-        newnum = int(max(32, min(n, self.numbins * factor)))
-        self.lobin = max(0, min(center - newnum // 2, n - newnum))
-        self.numbins = newnum
-
-    def pan(self, frac: float) -> None:
-        """Shift the window by frac of its width (+right / -left)."""
-        n = len(self.powers)
-        self.lobin = int(max(0, min(self.lobin + frac * self.numbins,
-                                    n - self.numbins)))
+        self._clamp(1 << 17)
 
     def goto_freq(self, f_hz: float) -> None:
         self.lobin = int(max(0, min(f_hz * self.T - self.numbins // 2,
@@ -87,10 +106,8 @@ class SpectrumView:
         LOGLOCALCHUNK medians; powers/median * ln2 so chi^2 mean=1)."""
         w = self.powers[self.lobin:self.lobin + self.numbins]
         nc = max(1, len(w) // LOCALCHUNK)
-        csize = -(-len(w) // nc)
-        pad = csize * nc - len(w)
-        wp = np.concatenate([w, np.full(pad, w[-1])]) if pad else w
-        med = np.median(wp.reshape(nc, csize), axis=1)
+        chunks, csize = _chunks_of(w, nc)
+        med = np.median(chunks, axis=1)
         med = np.maximum(np.repeat(med, csize)[:len(w)], 1e-30)
         return (w / med) * np.log(2.0)
 
@@ -111,7 +128,7 @@ class SpectrumView:
 
 
 @dataclass
-class TimeseriesView:
+class TimeseriesView(_WindowedView):
     """Windowed view of a .dat time series (exploredat.c model):
     chunked min/avg/max envelopes."""
     data: np.ndarray
@@ -119,24 +136,11 @@ class TimeseriesView:
     lobin: int = 0
     numbins: int = 0
 
+    def _array(self) -> np.ndarray:
+        return self.data
+
     def __post_init__(self):
-        n = len(self.data)
-        if self.numbins <= 0:
-            self.numbins = min(n, 1 << 16)
-        self.numbins = max(32, min(self.numbins, n))
-        self.lobin = int(max(0, min(self.lobin, n - self.numbins)))
-
-    def zoom(self, factor: float) -> None:
-        n = len(self.data)
-        center = self.lobin + self.numbins // 2
-        newnum = int(max(32, min(n, self.numbins * factor)))
-        self.lobin = max(0, min(center - newnum // 2, n - newnum))
-        self.numbins = newnum
-
-    def pan(self, frac: float) -> None:
-        n = len(self.data)
-        self.lobin = int(max(0, min(self.lobin + frac * self.numbins,
-                                    n - self.numbins)))
+        self._clamp(1 << 16)
 
     def display(self):
         """(times_s, avg, mn, mx) chunk envelopes, <= DISPLAYNUM."""

@@ -585,9 +585,13 @@ class AccelSearch:
         # Chunk the block batch: the [chunk, numz, fftlen] complex
         # intermediate is the peak working memory, so bound it (~1 GB
         # per chunk at zmax=200) — the HBM-ladder analog of meminfo.h.
-        # Larger chunks amortize per-step FFT launch overhead; v5e has
-        # 16 GB HBM and the plane itself is the other big resident.
-        chunk = max(1, int(2 ** 30 // (kern.numz * kern.fftlen * 8)))
+        # Overridable (bytes) for devices with different HBM headroom;
+        # bigger was NOT better in clean A/Bs on v5e (HBM pressure
+        # beside the plane + stacked-ys residents).
+        import os
+        budget = int(os.environ.get("PRESTO_TPU_CHUNK_BUDGET",
+                                    str(2 ** 30)))
+        chunk = max(1, int(budget // (kern.numz * kern.fftlen * 8)))
         col0 = int(starts[0]) * ACCEL_RDR
         # Host uploads ONLY the raw spectrum; the per-block read
         # windows are gathered on device (the tunneled host->TPU link
@@ -1012,8 +1016,14 @@ class AccelSearch:
         out: List[List[AccelCand]] = [
             collect_dm(*_unpack_scan(scanner(p0, scols)))]
         del p0
+        # per-spectrum footprint in the vmapped build: plane + stacked
+        # ys + the [chunk, numz, fftlen] complex FFT intermediate
+        # (vmap multiplies ALL of them by the group size)
+        g = self._plane_geom()
         plane_bytes = numz * plane_numr * 4
-        group = max(1, int(6 * 2 ** 30 // max(plane_bytes * 2, 1)))
+        per_bytes = plane_bytes * 2 + (
+            g.chunk * numz * self.kern.fftlen * 8 if g else 0)
+        group = max(1, int(6 * 2 ** 30 // max(per_bytes, 1)))
         group = min(group, max(nd - 1, 1))
         # back-overlap the final group so every dispatch shares ONE jit
         # shape (the tail would otherwise retrace the two heaviest

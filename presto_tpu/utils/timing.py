@@ -1,0 +1,107 @@
+"""Timing, progress, and profiling instrumentation (SURVEY §5.1).
+
+Every long-running reference tool prints user/system/total times via
+times() (accelsearch.c:56,301-308; realfft.c:62) and a percent-
+complete meter (accelsearch.c:22-41, prepsubband.c:1026-1040).  This
+module provides those behaviors plus the TPU-era additions the rebuild
+plan calls for: named per-stage wall-clock accounting and an optional
+JAX profiler trace (set PRESTO_TPU_PROFILE=<dir> to capture a trace
+viewable in TensorBoard/Perfetto).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+from contextlib import contextmanager
+from typing import Dict, Optional
+
+
+def print_percent_complete(current: int, total: int,
+                           last: int = -1, width: int = 0) -> int:
+    """Throttled percent meter (print_percent_complete,
+    accelsearch.c:22-41): prints at most once per whole percent.
+    Returns the new 'last' value; pass it back on the next call."""
+    pct = int(100.0 * current / max(total, 1))
+    if pct != last:
+        sys.stdout.write("\rAmount complete = %3d%%" % pct)
+        if pct >= 100:
+            sys.stdout.write("\n")
+        sys.stdout.flush()
+    return pct
+
+
+class StageTimer:
+    """Accumulates named per-stage wall times; prints a summary table.
+    The pipeline-driver analog of the reference's per-tool timing."""
+
+    def __init__(self):
+        self.stages: Dict[str, float] = {}
+        self._t0 = time.time()
+        self._cur: Optional[tuple] = None
+
+    def mark(self, name: Optional[str]) -> None:
+        """Sequential accounting: close the current stage (if any) and
+        open `name` (None = just close).  Lighter to wire into an
+        existing driver than the context manager."""
+        now = time.time()
+        if self._cur is not None:
+            cname, t0 = self._cur
+            self.stages[cname] = self.stages.get(cname, 0.0) + now - t0
+        self._cur = (name, now) if name else None
+
+    @contextmanager
+    def stage(self, name: str):
+        t0 = time.time()
+        try:
+            yield
+        finally:
+            self.stages[name] = self.stages.get(name, 0.0) + \
+                (time.time() - t0)
+
+    def report(self, file=None) -> str:
+        total = time.time() - self._t0
+        lines = ["Per-stage wall times:"]
+        for name, dt in self.stages.items():
+            lines.append("  %-24s %8.2f s  (%4.1f%%)"
+                         % (name, dt, 100.0 * dt / max(total, 1e-9)))
+        lines.append("  %-24s %8.2f s" % ("TOTAL", total))
+        text = "\n".join(lines)
+        print(text, file=file or sys.stdout)
+        return text
+
+
+@contextmanager
+def app_timer(prog: str):
+    """Wrap an app main: on exit print the reference's closing block
+    (user/system/total CPU + wall time, accelsearch.c:301-308), and
+    honor PRESTO_TPU_PROFILE=<dir> with a JAX profiler trace."""
+    profile_dir = os.environ.get("PRESTO_TPU_PROFILE")
+    tracing = False
+    if profile_dir:
+        try:
+            import jax
+            jax.profiler.start_trace(profile_dir)
+            tracing = True
+        except Exception as e:           # profiling must never break
+            print("%s: profiler unavailable (%s)" % (prog, e),
+                  file=sys.stderr)
+    t0 = time.time()
+    c0 = os.times()
+    try:
+        yield
+    finally:
+        wall = time.time() - t0
+        c1 = os.times()
+        if tracing:
+            try:
+                import jax
+                jax.profiler.stop_trace()
+                print("%s: JAX profile trace -> %s" % (prog,
+                                                       profile_dir))
+            except Exception:
+                pass
+        print("%s: user %.1f s, system %.1f s, wall %.1f s"
+              % (prog, c1.user - c0.user, c1.system - c0.system,
+                 wall))

@@ -1,0 +1,38 @@
+"""Timing/progress/profiling instrumentation (SURVEY §5.1)."""
+
+import io
+import time
+
+from presto_tpu.utils.timing import (StageTimer, app_timer,
+                                     print_percent_complete)
+
+
+def test_percent_meter_throttles(capsys):
+    last = -1
+    for i in range(0, 101):
+        last = print_percent_complete(i, 100, last)
+    out = capsys.readouterr().out
+    assert out.count("%") == 101       # one print per whole percent
+    assert "100%" in out
+
+
+def test_stage_timer_context_and_marks():
+    t = StageTimer()
+    with t.stage("a"):
+        time.sleep(0.01)
+    t.mark("b")
+    time.sleep(0.01)
+    t.mark("c")
+    t.mark(None)
+    assert set(t.stages) == {"a", "b", "c"}
+    assert t.stages["a"] >= 0.009 and t.stages["b"] >= 0.009
+    buf = io.StringIO()
+    text = t.report(file=buf)
+    assert "TOTAL" in text and "a" in text
+
+
+def test_app_timer_prints_times(capsys):
+    with app_timer("mytool"):
+        time.sleep(0.01)
+    out = capsys.readouterr().out
+    assert "mytool:" in out and "wall" in out
