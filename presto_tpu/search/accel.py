@@ -303,7 +303,8 @@ SEARCH_SEG = 16     # columns per segment-max before top-k: 16 columns
 
 
 def _make_search_scanner(numharmstages, fracs_zinds, powcuts, slab, k,
-                         plane_numr, aligned=False):
+                         plane_numr, aligned=False,
+                         pallas_reducer=None, numz=None):
     """One jit'd function running the whole staged search as a lax.scan
     over slab start columns (a single device dispatch — the tunneled
     TPU pays ~0.1-0.4 s latency per call, so per-slab calls dominate
@@ -418,7 +419,34 @@ def _make_search_scanner(numharmstages, fracs_zinds, powcuts, slab, k,
         _, packed = jax.lax.scan(body, None, start_cols)
         return jnp.moveaxis(packed, 1, 0)  # [3, nslabs, stages, k]
 
+    def _collect_from_reduced(colmax, colz):
+        """Shared threshold + segment-max + top-k over the reduced
+        [nslabs, stages, slab] (colmax, colz) arrays -> packed int32
+        [3, nslabs, stages, k] (same packing as slab_body)."""
+        nslabs = colmax.shape[0]
+        masked = jnp.where(colmax > powcuts[None, :, None], colmax,
+                           0.0)
+        segs = masked.reshape(nslabs, numharmstages, nseg,
+                              SEARCH_SEG)
+        v, si = jax.lax.top_k(segs.max(-1), kk)
+        ci = si * SEARCH_SEG + jnp.take_along_axis(
+            segs.argmax(-1).astype(jnp.int32), si, axis=-1)
+        zrow = jnp.take_along_axis(colz, ci, axis=-1)
+        return jnp.stack([jax.lax.bitcast_convert_type(v, jnp.int32),
+                          ci, zrow])
+
+    def _scan_pallas_py(P, start_cols):
+        """Pallas stage-reduction path: pad the plane to the kernel's
+        tiling contract, reduce on-kernel, finish in XLA."""
+        from presto_tpu.search import accel_pallas as ap
+        Ppad = jnp.pad(P, ((0, ap.pad_rows(numz) - numz),
+                           (0, ap.PLANE_PAD)))
+        colmax, colz = pallas_reducer(Ppad, start_cols)
+        return _collect_from_reduced(colmax, colz)
+
     def _scan_all_py(P, start_cols):
+        if pallas_reducer is not None:
+            return _scan_pallas_py(P, start_cols)
         # z-only search: every harmonic reads the fundamental plane
         return _scan_planes_py((P,) * (1 + nterms), start_cols)
 
@@ -578,8 +606,16 @@ class AccelSearch:
         numdata = kern.fftlen // 2
         # plane width padded (zero columns) to a multiple of the
         # scanner's alignment so every aligned slab fits inside the
-        # plane; zero columns can never exceed powcut
+        # plane; zero columns can never exceed powcut.  On TPU the
+        # pallas stage reducer wants TILE-aligned slab starts, so the
+        # plane pads to that stricter grid.
         align = max(16, cfg.numharm)
+        try:
+            from presto_tpu.search import accel_pallas as ap
+            if ap.pallas_available():
+                align = max(align, ap.TILE)
+        except Exception:
+            pass
         plane_numr = int(2 * int(starts[-1]) + cfg.uselen)
         plane_numr += (-plane_numr) % align
         # Chunk the block batch: the [chunk, numz, fftlen] complex
@@ -906,20 +942,40 @@ class AccelSearch:
         # the align-padded plane) scans a few out-of-range columns,
         # filtered in _collect_slab via _r0min/_rtop.
         align = cfg.numharm
+        # the pallas stage reducer (TPU) wants TILE-aligned starts
+        # and a TILE-multiple slab; fall back to the XLA scanner when
+        # the geometry is too small to align
+        use_pallas = False
+        try:
+            from presto_tpu.search import accel_pallas as ap
+            if (ap.pallas_available() and cfg.numharm <= 16
+                    and plane_numr % ap.TILE == 0
+                    and slab >= 4 * ap.TILE):
+                align = max(align, ap.TILE)
+                use_pallas = True
+        except Exception:
+            pass
         aligned = (slab % align == 0 or slab > 4 * align) \
             and plane_numr % align == 0
         if aligned and slab % align:
             slab -= slab % align
+        use_pallas = use_pallas and aligned and slab % align == 0
         r0a = r0 - (r0 % align) if aligned else r0
         top_a = min(top + ((-top) % align), plane_numr) if aligned \
             else top
         k = min(cfg.max_cands_per_stage, slab)
-        skey = ("scan", slab, k, plane_numr, aligned)
+        skey = ("scan", slab, k, plane_numr, aligned, use_pallas)
         if skey not in self._fn_cache:
             fz = _harm_fracs_and_zinds(cfg, self.cfg.numz)
+            reducer = None
+            if use_pallas:
+                reducer = ap.make_stage_reducer(
+                    cfg.numharmstages, fz, slab, self.cfg.numz,
+                    plane_numr)
             self._fn_cache[skey] = _make_search_scanner(
                 cfg.numharmstages, fz, self.powcut, slab, k,
-                plane_numr, aligned=aligned)
+                plane_numr, aligned=aligned,
+                pallas_reducer=reducer, numz=self.cfg.numz)
         start_cols = []
         off = r0a
         while True:

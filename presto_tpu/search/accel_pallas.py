@@ -1,0 +1,233 @@
+"""Pallas TPU kernel for the accelsearch harmonic-sum stage scan.
+
+The staged harmonic summing (SURVEY §7.2 step 9a: "Pallas kernels for
+the harmonic-sum gather") is HBM-bandwidth-bound in the XLA
+formulation: every subharmonic add materializes plane-sized
+intermediates (z-permuted copy, phase-stacked copy, accumulator
+update).  This kernel keeps one column tile of the accumulator in
+VMEM, DMAs exactly the source windows each harmonic needs from the
+HBM-resident plane, applies the z-row mapping AND the fractional-
+stride column mapping as one-hot MXU matmuls (exact selections;
+Mosaic cannot lower the interleave reshape the XLA phase trick
+uses), and reduces each stage to per-column (max over z, argmax) on
+the spot — the only HBM writes are the [stages, slab] reduction
+outputs, ~1000x smaller than the XLA path's intermediates.
+
+Thresholding / segment-max / top-k stay in XLA outside the kernel
+(they operate on the reduced [stages, slab] arrays, which are cheap).
+
+Alignment contract (enforced by the caller): slab starts and the slab
+length are multiples of TILE, so every tile start j0 is divisible by
+every htot <= 16; DMA starts are floored to 128-lane multiples with
+the residual rolled away in VMEM.  The plane must be padded to
+ceil(numz/8)*8 rows and carry >= PLANE_PAD columns of zero padding at
+the right edge so subharmonic window DMAs never run off the array
+(search/accel.py's _scan_pallas_py applies both pads).
+
+Hardware notes discovered building this: grid-pipelined manual DMAs
+into one scratch get reordered across grid steps (hence the per-term
+x2-parity window banks), and pltpu.roll with a dynamic NEGATIVE
+shift is miscompiled by this Mosaic version (hence the positive-
+equivalent WIN - off shifts).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import List, Tuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+TILE = 256                   # columns per grid tile (lanes)
+WIN = TILE + 128             # DMA window (lane-aligned): covers the
+                             # harmonic-term span for all harm < htot <= 16
+PLANE_PAD = WIN              # right-edge zero padding the plane needs
+
+
+def _stage_terms(fracs_zinds):
+    """Flatten the per-stage (harm, htot, zinds) lists, keeping the
+    stage boundaries: returns (terms, stage_term_counts)."""
+    terms = []
+    counts = []
+    for stage in fracs_zinds:
+        counts.append(len(stage))
+        for harm, htot, zinds in stage:
+            terms.append((harm, htot, np.asarray(zinds)))
+    return terms, counts
+
+
+def make_stage_reducer(numharmstages, fracs_zinds, slab: int,
+                       numz: int, plane_numr: int,
+                       interpret: bool = False):
+    """Build the pallas stage reducer.
+
+    Returns f(P, start_cols) -> (colmax f32, colz i32), each
+    [nslabs, numharmstages, slab]: per search column, the max over z
+    of the stage-summed powers and its z row — the kernel half of the
+    staged search (thresholding/top-k are done by the caller).
+
+    Requires slab % TILE == 0, start_cols % TILE == 0, and P padded
+    to ceil(numz/8)*8 rows (zero rows below; `pad_rows` below).
+    """
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    terms, counts = _stage_terms(fracs_zinds)
+    nterms = len(terms)
+    ntiles = slab // TILE
+    nstages = numharmstages
+    # sublane tiling: the kernel works on a plane padded to 8-row
+    # multiples (zero rows; they never win the argmax since powers
+    # are >= 0 and ties resolve to the lowest row index)
+    numz_pad = -(-numz // 8) * 8
+
+    # one-hot z-permutation matrices: perm[t] @ src == src[zinds_t]
+    onehots = np.zeros((max(nterms, 1), numz_pad, numz_pad),
+                       np.float32)
+    for i, (_h, _t, zinds) in enumerate(terms):
+        onehots[i, np.arange(numz), zinds] = 1.0
+
+    # one-hot column-selection matrices: (src @ colsel[t])[z, j] ==
+    # src[z, (j*harm + htot//2) // htot] of the ROLLED window (max
+    # needed row < TILE for every harm < htot) — Mosaic cannot lower
+    # the phase-interleave reshape the XLA path uses, so the
+    # fractional-stride column map runs on the MXU too (exact:
+    # selectors are 0/1, so the decomposed-f32 passes recover each
+    # power bit-for-bit)
+    colsels = np.zeros((max(nterms, 1), TILE, TILE), np.float32)
+    j = np.arange(TILE)
+    for i, (harm, htot, _z) in enumerate(terms):
+        colsels[i, (j * harm + (htot >> 1)) // htot, j] = 1.0
+
+    def kernel(start_cols_ref, P_ref, onehot_ref, colsel_ref,
+               colmax_ref, colz_ref, acc_ref, src_ref, sems):
+        s = pl.program_id(0)
+        t = pl.program_id(1)
+        j0 = start_cols_ref[s] + t * TILE
+
+        # One DMA buffer + semaphore PER window (fundamental + each
+        # harmonic term) x2 grid-step parity banks: Mosaic pipelines
+        # grid iterations, so the next step's DMAs race this step's
+        # reads unless they land in the other bank; the fan-out also
+        # overlaps all fetches with compute.
+        bank = ((s * ntiles + t) % 2) * (1 + nterms)
+
+        def start_dma(slot, cstart):
+            slot = slot + bank
+            pltpu.make_async_copy(
+                P_ref.at[:, pl.ds(cstart, WIN)],
+                src_ref.at[slot], sems.at[slot]).start()
+
+        def wait_dma(slot, cstart):
+            slot = slot + bank
+            pltpu.make_async_copy(
+                P_ref.at[:, pl.ds(cstart, WIN)],
+                src_ref.at[slot], sems.at[slot]).wait()
+
+        def term_start(fi):
+            harm, htot, _z = terms[fi]
+            cs = (j0 // htot) * harm
+            # DMA starts must be 128-lane-aligned: fetch from the
+            # floor; the residual (0/32/64/96) is rolled away at use
+            off = cs % 128
+            return pl.multiple_of(cs - off, 128), off
+
+        fund_start = pl.multiple_of(j0, 128)
+        start_dma(0, fund_start)
+        for fi in range(nterms):
+            start_dma(1 + fi, term_start(fi)[0])
+
+        wait_dma(0, fund_start)
+        acc_ref[:, :] = src_ref[bank, :, :TILE]
+
+        def collect(stage):
+            a = acc_ref[:, :]
+            m = jnp.max(a, axis=0)
+            iota = jax.lax.broadcasted_iota(jnp.int32, a.shape, 0)
+            z = jnp.min(jnp.where(a == m[None, :], iota, numz_pad),
+                        axis=0).astype(jnp.int32)
+            colmax_ref[0, stage, :] = m
+            colz_ref[0, stage, :] = z
+
+        collect(0)
+        fi = 0
+        for stage in range(1, nstages):
+            for _ in range(counts[stage - 1]):
+                cstart, off = term_start(fi)
+                wait_dma(1 + fi, cstart)
+                # positive-equivalent shift: dynamic NEGATIVE rolls
+                # are miscompiled by this Mosaic version (off by a
+                # lane tile); WIN - off rolls the residual away
+                src = pltpu.roll(src_ref[bank + 1 + fi],
+                                 shift=WIN - off, axis=1)[:, :TILE]
+                # column map then z-row map, both as one-hot MXU
+                # matmuls (exact selections, see colsels note)
+                cols = jax.lax.dot_general(
+                    src, colsel_ref[fi],
+                    (((1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32,
+                    precision=jax.lax.Precision.HIGHEST)
+                add = jax.lax.dot_general(
+                    onehot_ref[fi], cols,
+                    (((1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32,
+                    precision=jax.lax.Precision.HIGHEST)
+                acc_ref[:, :] = acc_ref[:, :] + add
+                fi += 1
+            collect(stage)
+
+    onehots_j = jnp.asarray(onehots)
+    colsels_j = jnp.asarray(colsels)
+
+    @jax.jit
+    def reduce_stages(P, start_cols):
+        nslabs = start_cols.shape[0]
+        gs = pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(nslabs, ntiles),
+            in_specs=[
+                pl.BlockSpec(memory_space=pl.ANY),   # P (HBM)
+                pl.BlockSpec(memory_space=pltpu.VMEM),  # onehots
+                pl.BlockSpec(memory_space=pltpu.VMEM),  # colsels
+            ],
+            out_specs=[
+                pl.BlockSpec((1, nstages, TILE),
+                             lambda s, t, *_: (s, 0, t)),
+                pl.BlockSpec((1, nstages, TILE),
+                             lambda s, t, *_: (s, 0, t)),
+            ],
+            scratch_shapes=[
+                pltpu.VMEM((numz_pad, TILE), jnp.float32),   # acc
+                pltpu.VMEM((2 * (1 + nterms), numz_pad, WIN),
+                           jnp.float32),                     # windows
+                pltpu.SemaphoreType.DMA((2 * (1 + nterms),)),
+            ],
+        )
+        return pl.pallas_call(
+            kernel,
+            grid_spec=gs,
+            out_shape=[
+                jax.ShapeDtypeStruct((nslabs, nstages, slab),
+                                     jnp.float32),
+                jax.ShapeDtypeStruct((nslabs, nstages, slab),
+                                     jnp.int32),
+            ],
+            interpret=interpret,
+        )(start_cols, P, onehots_j, colsels_j)
+
+    return reduce_stages
+
+
+def pad_rows(numz: int) -> int:
+    """Rows the kernel-ready plane must have (8-sublane tiling)."""
+    return -(-numz // 8) * 8
+
+
+def pallas_available() -> bool:
+    """True when the default jax backend can run the TPU kernel."""
+    try:
+        return jax.devices()[0].platform == "tpu"
+    except Exception:
+        return False

@@ -19,7 +19,7 @@ from typing import Dict, Optional
 
 
 def print_percent_complete(current: int, total: int,
-                           last: int = -1, width: int = 0) -> int:
+                           last: int = -1) -> int:
     """Throttled percent meter (print_percent_complete,
     accelsearch.c:22-41): prints at most once per whole percent.
     Returns the new 'last' value; pass it back on the next call."""
