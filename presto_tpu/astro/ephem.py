@@ -329,8 +329,23 @@ def get_ephemeris(name="DEANALYTIC"):
     callers pass DE405); a path ending in .npz loads a table."""
     if name is None:
         return _DEFAULT
-    if str(name).endswith(".npz"):
-        return TabulatedEphemeris(name)
+    s = str(name)
+    if s.lower().endswith(".npz"):
+        return TabulatedEphemeris(s)
+    if s.lower().endswith(".bsp"):
+        from presto_tpu.astro.spk import SPKEphemeris
+        return SPKEphemeris(s)
+    # Path-like names that are not a recognized ephemeris file must NOT
+    # silently fall back to the analytic model — the user believes
+    # their kernel is in use while barycentering runs at search grade.
+    # (Bare names like 'DE405' always select the analytic model, even
+    # if a same-named file happens to exist in the cwd.)
+    import os
+    if os.path.sep in s:
+        raise ValueError(
+            f"unrecognized ephemeris file {s!r}: expected a .bsp (JPL "
+            f"SPK kernel) or .npz table; bare names like 'DE405' select "
+            f"the built-in analytic model")
     return _DEFAULT
 
 

@@ -1,0 +1,226 @@
+"""NumPy/SciPy reference accelsearch — float64 referee and CPU baseline.
+
+This is the same staged harmonic-summing F-Fdot search AccelSearch runs
+on device (plane build per r-block: spread x2 interbin, forward FFT,
+per-z-row multiply by conj(z-response), inverse FFT, |.|^2; then
+per-stage subharmonic adds and powcut thresholding), written in plain
+NumPy + scipy.fft (pocketfft) at selectable precision.  It exists for
+two jobs:
+
+* the **float64 referee** (SURVEY.md s7.3.1 north-star acceptance):
+  the float32 TPU candidate list must match this path after sigma
+  rounding (tests/test_referee.py);
+* the **fair CPU baseline** (bench_cpu.py): the reference's hot loop
+  (src/accel_utils.c:1002-1051) is multithreaded FFTW/OpenMP; this twin
+  runs the identical algorithm through scipy.fft with ``workers`` set
+  to every host core, so bench.py's ``vs_baseline`` compares against an
+  honest all-cores CPU number rather than a single-threaded proxy.
+
+Parity anchors: subharm_ffdot_plane (accel_utils.c:879-1051), inmem
+harmonic sums (accel_utils.c:1160-1256), search_ffdotpows
+(accel_utils.c:1259-1298), powcut/numindep (accel_utils.c:1629-1641).
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+try:
+    from scipy import fft as sfft
+except Exception:                                    # pragma: no cover
+    sfft = None
+
+from presto_tpu.ops import stats as st
+from presto_tpu.search.accel import (
+    ACCEL_DR,
+    ACCEL_DZ,
+    ACCEL_NUMBETWEEN,
+    ACCEL_RDR,
+    AccelCand,
+    AccelConfig,
+    AccelKernels,
+    AccelSearch,
+    _harm_fracs_and_zinds,
+)
+
+
+def _fft(x, workers, axis=-1):
+    if sfft is not None:
+        return sfft.fft(x, axis=axis, workers=workers)
+    return np.fft.fft(x, axis=axis)
+
+
+def _ifft(x, workers, axis=-1):
+    if sfft is not None:
+        return sfft.ifft(x, axis=axis, workers=workers)
+    return np.fft.ifft(x, axis=axis)
+
+
+def kernel_bank_ref(kern: AccelKernels, cdtype=np.complex128) -> np.ndarray:
+    """FFT'd [numz, fftlen] kernel bank at the requested precision.
+
+    Same NR wrap placement as the device's _fft_kernel_bank
+    (place_complex_kernel, corr_prep.c:58-80).  complex128 keeps the
+    float64 referee honest; pass complex64 to reproduce the device bank
+    at float32.
+    """
+    kc = (kern.kern_pairs[..., 0].astype(np.float64)
+          + 1j * kern.kern_pairs[..., 1].astype(np.float64))
+    half = kern.kmax // 2
+    placed = np.zeros((kc.shape[0], kern.fftlen), dtype=np.complex128)
+    placed[:, :half] = kc[:, half:]
+    placed[:, kern.fftlen - half:] = kc[:, :half]
+    return np.fft.fft(placed, axis=-1).astype(cdtype)
+
+
+def build_plane_ref(search: AccelSearch, spectrum: np.ndarray,
+                    dtype=np.float64,
+                    workers: Optional[int] = None) -> Tuple[np.ndarray, int]:
+    """The fundamental F-Fdot power plane, host-side.
+
+    spectrum: [numbins] complex (or [numbins, 2] float pairs).
+    Returns (plane[numz, plane_cols], col0) where column c holds the
+    power at absolute half-bin col0*0 + c (i.e. r = c * ACCEL_DR), with
+    columns below col0 zero — the same layout AccelSearch.build_plane
+    produces on device.
+    """
+    if spectrum.ndim == 2:
+        spectrum = spectrum[..., 0] + 1j * spectrum[..., 1]
+    cdtype = np.complex128 if dtype == np.float64 else np.complex64
+    kern = search.kern
+    cfg = search.cfg
+    bank = np.conj(kernel_bank_ref(kern, cdtype))
+    starts = search._plan_blocks()
+    if not starts:
+        return np.zeros((kern.numz, 0), dtype=dtype), 0
+    numdata = kern.fftlen // 2
+    offset = kern.halfwidth * ACCEL_NUMBETWEEN
+    col0 = int(starts[0]) * ACCEL_RDR
+    plane_cols = col0 + len(starts) * cfg.uselen
+    plane = np.zeros((kern.numz, plane_cols), dtype=dtype)
+    spec = np.asarray(spectrum, dtype=cdtype)
+    nbins = spec.shape[0]
+    for j, s0 in enumerate(starts):
+        lobin = int(s0) - kern.halfwidth
+        win = np.zeros(numdata, dtype=cdtype)
+        lo, hi = max(lobin, 0), min(lobin + numdata, nbins)
+        win[lo - lobin:hi - lobin] = spec[lo:hi]
+        # old-style per-block median normalization (accel_utils.c:952-967)
+        med = max(float(np.median(win.real ** 2 + win.imag ** 2)), 1e-30)
+        norm = 1.0 / np.sqrt(med / np.log(2.0))
+        spread = np.zeros(kern.fftlen, dtype=cdtype)
+        spread[::ACCEL_NUMBETWEEN] = win * dtype(norm)
+        fdata = _fft(spread, workers)
+        corr = _ifft(fdata[None, :] * bank, workers)
+        good = corr[:, offset:offset + cfg.uselen]
+        c = col0 + j * cfg.uselen
+        plane[:, c:c + cfg.uselen] = (good.real ** 2 + good.imag ** 2)
+    return plane, col0
+
+
+def search_plane_ref(search: AccelSearch, plane: np.ndarray,
+                     max_cands_per_stage: int = 1 << 16) -> List[AccelCand]:
+    """Staged harmonic-summing search of a host plane.
+
+    Candidate semantics match AccelSearch: per stage, each column
+    contributes its max-over-z cell when above powcut[stage] (the
+    sifter's r-dedup makes same-column lower-z cells duplicates);
+    callers apply remove_duplicates for the final list, exactly as the
+    reference's insert_new_accelcand (accel_utils.c:294-382) does at
+    insert time.
+    """
+    cfg = search.cfg
+    numz, plane_cols = plane.shape
+    r0 = int(search.rlo) * ACCEL_RDR
+    top = min(int(search.rhi) * ACCEL_RDR, plane_cols)
+    if top <= r0:
+        return []
+    n = top - r0
+    acc = plane[:, r0:top].copy()
+    fz = _harm_fracs_and_zinds(cfg, numz)
+    cands: List[AccelCand] = []
+
+    def collect(acc, stage):
+        numharm = 1 << stage
+        colmax = acc.max(axis=0)
+        good = np.flatnonzero(colmax > search.powcut[stage])
+        if good.size > max_cands_per_stage:       # keep the strongest
+            good = good[np.argsort(colmax[good])[::-1]
+                        [:max_cands_per_stage]]
+        if good.size == 0:
+            return
+        # z row only needed for accepted columns (a full-plane argmax
+        # would cost more than the harmonic sums themselves)
+        colz = acc[:, good].argmax(axis=0)
+        sigmas = np.atleast_1d(st.candidate_sigma(
+            colmax[good], numharm, search.numindep[stage]))
+        for gi, zi, sg in zip(good.tolist(), colz.tolist(),
+                              sigmas.tolist()):
+            rr = (r0 + gi) * ACCEL_DR / numharm
+            zz = (-cfg.zmax + zi * ACCEL_DZ) / numharm
+            cands.append(AccelCand(power=float(colmax[gi]), sigma=sg,
+                                   numharm=numharm, r=rr, z=zz))
+
+    collect(acc, 0)
+    cols = np.arange(r0, top, dtype=np.int64)
+    for stage in range(1, cfg.numharmstages):
+        for (harm, htot, zinds) in fz[stage - 1]:
+            # exact round-half-up of cols*harm/htot (overflow-safe),
+            # as ONE int32 map per term
+            rind = ((cols // htot) * harm +
+                    ((cols % htot) * harm + (htot >> 1)) // htot
+                    ).astype(np.int32)
+            # zinds is nondecreasing with long runs of repeats (the
+            # subharmonic z grid is coarser by 1/frac): gather each
+            # DISTINCT source row once, then one broadcast add per run
+            # — the numpy formulation closest to C-loop speed.
+            zinds = np.asarray(zinds)
+            runs = np.flatnonzero(np.diff(zinds)) + 1
+            starts = np.concatenate([[0], runs])
+            ends = np.concatenate([runs, [len(zinds)]])
+            for g0, g1 in zip(starts, ends):
+                acc[g0:g1] += np.take(plane[zinds[g0]], rind)[None, :]
+        collect(acc, stage)
+    return sorted(cands, key=lambda c: (-c.sigma, c.r))
+
+
+def search_ref(fft_pairs: np.ndarray, cfg: AccelConfig, T: float,
+               numbins: Optional[int] = None, dtype=np.float64,
+               workers: Optional[int] = None) -> List[AccelCand]:
+    """Full reference search: pairs/complex spectrum -> candidate list.
+
+    dtype=np.float64 is the referee configuration; dtype=np.float32
+    reproduces the device arithmetic on host (the CPU-baseline timing
+    configuration, matching the reference's float FFTW build).
+    """
+    if workers is None:
+        workers = os.cpu_count() or 1
+    if numbins is None:
+        numbins = fft_pairs.shape[0]
+    search = AccelSearch(cfg, T=T, numbins=numbins)
+    plane, _ = build_plane_ref(search, fft_pairs, dtype=dtype,
+                               workers=workers)
+    return search_plane_ref(search, plane)
+
+
+def timed_search_ref(fft_pairs: np.ndarray, cfg: AccelConfig, T: float,
+                     dtype=np.float32,
+                     workers: Optional[int] = None):
+    """(candidates, plane_seconds, search_seconds, cells) for bench_cpu."""
+    if workers is None:
+        workers = os.cpu_count() or 1
+    numbins = fft_pairs.shape[0]
+    search = AccelSearch(cfg, T=T, numbins=numbins)
+    t0 = time.perf_counter()
+    plane, _ = build_plane_ref(search, fft_pairs, dtype=dtype,
+                               workers=workers)
+    t1 = time.perf_counter()
+    cands = search_plane_ref(search, plane)
+    t2 = time.perf_counter()
+    numr = int(search.rhi - search.rlo) * ACCEL_RDR
+    cells = cfg.numz * numr
+    return cands, t1 - t0, t2 - t1, cells
