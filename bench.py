@@ -64,20 +64,30 @@ def load_cpu_baseline():
         return FALLBACK_CPU_CELLS_PER_SEC, FALLBACK_CPU_DM_TRIALS_PER_SEC, None
 
 
-def bench_accel():
-    import jax
-    from presto_tpu.search.accel import AccelConfig, AccelSearch
+ACCEL_T = 1000.0
 
+
+def make_accel_input():
+    """The exact accel-bench spectrum BOTH bench scripts must search
+    (part of the workload contract, like WORKLOAD): noise + a few
+    injected tones to exercise candidate paths."""
     numbins = WORKLOAD["accel_numbins"]
-    T = 1000.0
     rng = np.random.default_rng(42)
-    # noise spectrum + a few injected tones to exercise candidate paths
     re = rng.normal(size=numbins).astype(np.float32)
     im = rng.normal(size=numbins).astype(np.float32)
     pairs = np.stack([re, im], -1)
     for r0 in (12345, 123456, 765432):
         pairs[r0] = (300.0, 0.0)
+    return pairs
 
+
+def bench_accel():
+    import jax
+    from presto_tpu.search.accel import AccelConfig, AccelSearch
+
+    numbins = WORKLOAD["accel_numbins"]
+    T = ACCEL_T
+    pairs = make_accel_input()
     cfg = AccelConfig(zmax=WORKLOAD["accel_zmax"],
                       numharm=WORKLOAD["accel_numharm"], sigma=6.0)
     s = AccelSearch(cfg, T=T, numbins=numbins)

@@ -24,7 +24,8 @@ os.environ.setdefault("JAX_PLATFORMS", "cpu")   # no TPU needed here
 
 import numpy as np
 
-from bench import WORKLOAD     # single source for the bench workload
+# single source for the bench workload and input data
+from bench import ACCEL_T, WORKLOAD, make_accel_input
 
 
 def bench_accel_cpu(repeats=2):
@@ -33,14 +34,8 @@ def bench_accel_cpu(repeats=2):
     from presto_tpu.search.accel import AccelConfig
     from presto_tpu.search.accel_ref import timed_search_ref
 
-    numbins = WORKLOAD["accel_numbins"]
-    T = 1000.0
-    rng = np.random.default_rng(42)
-    re = rng.normal(size=numbins).astype(np.float32)
-    im = rng.normal(size=numbins).astype(np.float32)
-    pairs = np.stack([re, im], -1)
-    for r0 in (12345, 123456, 765432):
-        pairs[r0] = (300.0, 0.0)
+    T = ACCEL_T
+    pairs = make_accel_input()
     cfg = AccelConfig(zmax=WORKLOAD["accel_zmax"],
                       numharm=WORKLOAD["accel_numharm"], sigma=6.0)
 

@@ -38,16 +38,19 @@ def _enable_compilation_cache():
         return
     platforms = os.environ.get("JAX_PLATFORMS", "").strip().lower()
     first = platforms.split(",")[0].strip() if platforms else ""
-    if first not in ("tpu", "axon"):
-        # No TPU explicitly requested: enable the cache anyway when a
-        # TPU runtime is installed (the standard TPU-VM deployment
-        # auto-detects tpu with JAX_PLATFORMS unset); otherwise skip —
-        # CPU compiles are fast and XLA:CPU AOT cache entries are
-        # machine-feature-pinned (cross-host loads risk SIGILL).
+    if first in ("tpu", "axon"):
+        pass                       # TPU explicitly requested: enable
+    elif platforms == "":
+        # Unset: the standard TPU-VM deployment auto-detects tpu, so
+        # enable when a TPU runtime is installed; otherwise skip — CPU
+        # compiles are fast and XLA:CPU AOT cache entries are machine-
+        # feature-pinned (cross-host loads risk SIGILL).
         import importlib.util
         if not (importlib.util.find_spec("libtpu")
                 or importlib.util.find_spec("libtpu_nightly")):
             return
+    else:
+        return                     # explicitly non-TPU (e.g. cpu)
     cache_dir = os.environ.get(
         "PRESTO_TPU_CACHE_DIR",
         os.path.join(os.path.expanduser("~"), ".cache", "presto_tpu", "xla"),

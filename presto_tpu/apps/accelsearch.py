@@ -34,8 +34,13 @@ def build_parser():
     p.add_argument("-numharm", type=int, default=8)
     p.add_argument("-sigma", type=float, default=2.0)
     p.add_argument("-flo", type=float, default=1.0)
+    p.add_argument("-fhi", type=float, default=0.0,
+                   help="Highest frequency (Hz) to search")
     p.add_argument("-rlo", type=float, default=0.0)
     p.add_argument("-rhi", type=float, default=0.0)
+    p.add_argument("-lobin", type=int, default=0,
+                   help="The first Fourier frequency in the data file "
+                        "(for spectra chopped out of a longer FFT)")
     p.add_argument("-wmax", type=int, default=0,
                    help="Jerk refinement: polish candidates over "
                         "(r, z, w) with |w| <= wmax (w = fdotdot*T^3)")
@@ -43,6 +48,21 @@ def build_parser():
     p.add_argument("-baryv", type=float, default=0.0)
     p.add_argument("-inmem", action="store_true",
                    help="Accepted for parity (search is in-memory)")
+    norm = p.add_mutually_exclusive_group()
+    norm.add_argument("-median", action="store_true",
+                      help="Block-median power normalization (default)")
+    norm.add_argument("-photon", action="store_true",
+                      help="Poissonian data: normalize by the freq-0 "
+                           "power (photon count)")
+    norm.add_argument("-locpow", action="store_true",
+                      help="Running local-power normalization")
+    p.add_argument("-otheropt", action="store_true",
+                   help="Use the alternative (fundamental-only) "
+                        "optimization, for testing/debugging")
+    p.add_argument("-noharmpolish", action="store_true",
+                   help="Do not jointly optimize the harmonics")
+    p.add_argument("-noharmremove", action="store_true",
+                   help="Do not remove harmonically related candidates")
     p.add_argument("-ncpus", type=int, default=1)
     p.add_argument("infile")
     return p
@@ -131,15 +151,21 @@ def write_accel_file(path: str, cands, T: float,
 
 
 def refine_and_write(raw_cands, amps, T, searcher, base, zmax,
-                     wmax=0, quiet=False):
+                     wmax=0, quiet=False, harmremove=True,
+                     harmpolish=True, lobin=0):
     """Candidate post-processing shared by the CLI and the batched
-    survey path: harmonic elimination, Fourier-domain refinement
-    (+ optional rzw jerk polish), dedup, ACCEL/.cand artifacts."""
-    cands = remove_duplicates(eliminate_harmonics(raw_cands))
+    survey path: harmonic elimination (unless -noharmremove),
+    Fourier-domain refinement (+ optional rzw jerk polish), dedup,
+    ACCEL/.cand artifacts.  lobin shifts reported frequencies for
+    spectra chopped out of a longer FFT (obs->lobin semantics)."""
+    if harmremove:
+        raw_cands = eliminate_harmonics(raw_cands)
+    cands = remove_duplicates(raw_cands)
     refined = []
     for c in cands:
         try:
-            oc = optimize_accelcand(amps, c, T, searcher.numindep)
+            oc = optimize_accelcand(amps, c, T, searcher.numindep,
+                                    harmpolish=harmpolish)
             c.r, c.z = oc.r, oc.z
             c.power, c.sigma = oc.power, oc.sigma
             if wmax:
@@ -167,6 +193,12 @@ def refine_and_write(raw_cands, amps, T, searcher, base, zmax,
                   "keeping unrefined values" % (c.r, e))
         refined.append(c)
     cands = remove_duplicates(refined)
+    if lobin:
+        # candidate r is in fundamental units; the chopped spectrum's
+        # bin 0 is absolute bin `lobin`, so every reported frequency
+        # shifts by lobin whole bins
+        for c in cands:
+            c.r += lobin
     accelnm = "%s_ACCEL_%d" % (base, zmax)
     if wmax:
         accelnm += "_JERK_%d" % wmax
@@ -201,15 +233,38 @@ def run(args):
         amps = zap_bins(amps, birds_to_bin_ranges(birds, T, args.baryv))
         pairs = fftpack.np_complex64_to_pairs(amps)
 
+    norm = "median"
+    if args.photon:
+        # Poissonian normalization: freq-0 power = photon count nph;
+        # scale amplitudes by 1/sqrt(nph) (accel_utils.c:941-950)
+        nph = max(float(pairs[0, 0]), 1.0)
+        pairs = (pairs / np.float32(np.sqrt(nph))).astype(np.float32)
+        norm = "prenorm"
+    elif args.locpow:
+        from presto_tpu.search.optimize import spectrum_local_powers
+        amps = fftpack.np_pairs_to_complex64(pairs)
+        amps = (amps / np.sqrt(spectrum_local_powers(amps))
+                ).astype(np.complex64)
+        pairs = fftpack.np_complex64_to_pairs(amps)
+        norm = "prenorm"
+
+    rlo = args.rlo
+    rhi = args.rhi or (args.fhi * T if args.fhi else 0.0)
+    if args.lobin:       # searched bins are relative to the chop point
+        rlo = max(rlo - args.lobin, 0.0)
+        rhi = max(rhi - args.lobin, 0.0) if rhi else 0.0
     cfg = AccelConfig(zmax=args.zmax, wmax=args.wmax,
                       numharm=args.numharm,
-                      sigma=args.sigma, flo=args.flo, rlo=args.rlo,
-                      rhi=args.rhi)
+                      sigma=args.sigma, flo=args.flo, rlo=rlo,
+                      rhi=rhi, norm=norm)
     searcher = AccelSearch(cfg, T=T, numbins=numbins)
     raw = searcher.search(pairs)
     amps = fftpack.np_pairs_to_complex64(pairs)
-    cands, _ = refine_and_write(raw, amps, T, searcher, base,
-                                args.zmax, args.wmax)
+    cands, _ = refine_and_write(
+        raw, amps, T, searcher, base, args.zmax, args.wmax,
+        harmremove=not args.noharmremove,
+        harmpolish=not (args.noharmpolish or args.otheropt),
+        lobin=args.lobin)
     return cands
 
 

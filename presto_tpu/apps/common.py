@@ -45,6 +45,135 @@ def add_common_flags(p: argparse.ArgumentParser) -> None:
                    help="Accepted for parity; XLA manages parallelism")
 
 
+def add_raw_flags(p: argparse.ArgumentParser,
+                  start_flags: bool = True) -> None:
+    """The raw-data input flags every prep-family tool shares
+    (clig/prepdata_cmd.cli, prepsubband_cmd.cli, rfifind_cmd.cli,
+    prepfold_cmd.cli)."""
+    p.add_argument("-filterbank", action="store_true",
+                   help="Raw data in SIGPROC filterbank format")
+    p.add_argument("-psrfits", action="store_true",
+                   help="Raw data in PSRFITS format")
+    p.add_argument("-noweights", action="store_true",
+                   help="Do not apply PSRFITS weights")
+    p.add_argument("-noscales", action="store_true",
+                   help="Do not apply PSRFITS scales")
+    p.add_argument("-nooffsets", action="store_true",
+                   help="Do not apply PSRFITS offsets")
+    p.add_argument("-invert", action="store_true",
+                   help="For rawdata, flip (or invert) the band")
+    p.add_argument("-noclip", action="store_true",
+                   help="Do not clip the data (default is to clip)")
+    if start_flags:
+        p.add_argument("-offset", type=int, default=0,
+                       help="Number of spectra to offset into as "
+                            "starting data point")
+        p.add_argument("-start", type=float, default=0.0,
+                       help="Starting point of the processing as a "
+                            "fraction of the full obs")
+
+
+def open_raw_args(paths, args):
+    """open_raw honoring the shared raw flags: explicit format
+    selection (-filterbank/-psrfits beat suffix sniffing,
+    backend_common.c identify via cmd flags) and the PSRFITS
+    -noweights/-noscales/-nooffsets decode toggles."""
+    if isinstance(paths, str):
+        paths = [paths]
+    force = None
+    if getattr(args, "psrfits", False):
+        force = "psrfits"
+    elif getattr(args, "filterbank", False):
+        force = "sigproc"
+    kind = force or _sniff_kind(paths)
+    if kind == "psrfits":
+        from presto_tpu.io.psrfits import PsrfitsFile
+        kw = {}
+        if getattr(args, "noweights", False):
+            kw["apply_weight"] = False
+        if getattr(args, "noscales", False):
+            kw["apply_scale"] = False
+        if getattr(args, "nooffsets", False):
+            kw["apply_offset"] = False
+        return PsrfitsFile(paths, **kw)
+    if len(paths) == 1:
+        return FilterbankFile(paths[0])
+    from presto_tpu.io.sigproc import FilterbankSet
+    return FilterbankSet(paths)
+
+
+def clip_sigma_from(args) -> float:
+    """-noclip beats -clip (the reference's noclipP sets clip=0)."""
+    if getattr(args, "noclip", False):
+        return 0.0
+    return getattr(args, "clip", 6.0)
+
+
+def start_skip_spectra(args, N: int) -> int:
+    """First spectra index to process from -offset/-start (spectra
+    count beats fraction when both given, like the reference which
+    applies offset after the start fraction — here they are merged to
+    a single skip)."""
+    skip = int(getattr(args, "offset", 0) or 0)
+    frac = float(getattr(args, "start", 0.0) or 0.0)
+    if frac > 0.0:
+        skip = max(skip, int(frac * N))
+    return min(skip, N)
+
+
+class BlockPrep:
+    """Per-block preprocessing shared by the prep family: band invert,
+    mask substitution, clipping (with carry state), zero-DM removal,
+    running-average subtraction, and ignorechan zeroing — the
+    read->transform stack of read_psrdata/prep_subbands
+    (backend_common.c:505-738) as one reusable object."""
+
+    def __init__(self, nchan, dt, args, mask=None, padvals=None,
+                 ignore=None):
+        from presto_tpu.ops.clipping import (clip_times, remove_zerodm,
+                                             mask_block)
+        self._clip_times = clip_times
+        self._remove_zerodm = remove_zerodm
+        self._mask_block = mask_block
+        self.nchan = nchan
+        self.dt = dt
+        self.invert = bool(getattr(args, "invert", False))
+        self.clip = clip_sigma_from(args)
+        self.zerodm = bool(getattr(args, "zerodm", False))
+        self.runavg = bool(getattr(args, "runavg", False))
+        self.mask = mask
+        self.have_mask = mask is not None
+        self.padvals = (padvals if padvals is not None
+                        else np.zeros(nchan, np.float32))
+        self.ignore = ignore
+        self._clip_state = None
+
+    def __call__(self, block, start_spectra):
+        """block: [T, C] float32 (ascending freq); returns same shape."""
+        if self.invert:
+            block = block[:, ::-1]
+        if self.have_mask:
+            n, chans = self.mask.check_mask(start_spectra * self.dt,
+                                            block.shape[0] * self.dt)
+            if n == -1:
+                block[:] = self.padvals[None, :]
+            elif n > 0:
+                block = self._mask_block(block, chans, self.padvals)
+        if self.clip > 0:
+            block, _, self._clip_state = self._clip_times(
+                block, self.clip, self._clip_state)
+        if self.zerodm:
+            block = self._remove_zerodm(
+                block, self.padvals if self.have_mask else None)
+        if self.runavg:
+            # per-channel block-mean subtraction (the reference's
+            # run_avg in read_PRESTO_subbands, prepsubband.c:838-846)
+            block = block - block.mean(axis=0, keepdims=True)
+        if self.ignore is not None:
+            block[:, self.ignore] = 0.0
+        return block
+
+
 def load_timeseries(path: str) -> Tuple[np.ndarray, InfoData]:
     """Load a .dat (+ .inf sidecar) time series."""
     base = path[:-4] if path.endswith(".dat") else path
@@ -76,23 +205,18 @@ def identify_datatype(path: str) -> str:
     return "sigproc"
 
 
+def _sniff_kind(paths) -> str:
+    kinds = {identify_datatype(p) for p in paths}
+    if len(kinds) > 1:
+        raise SystemExit("cannot mix raw data formats: %s" % kinds)
+    return kinds.pop()
+
+
 def open_raw(paths):
     """Open one path or a list of paths as a single observation.
     Dispatches on format like read_rawdata_files
     (backend_common.c:77-92)."""
-    if isinstance(paths, str):
-        paths = [paths]
-    kinds = {identify_datatype(p) for p in paths}
-    if len(kinds) > 1:
-        raise SystemExit("cannot mix raw data formats: %s" % kinds)
-    kind = kinds.pop()
-    if kind == "psrfits":
-        from presto_tpu.io.psrfits import PsrfitsFile
-        return PsrfitsFile(paths)
-    if len(paths) == 1:
-        return FilterbankFile(paths[0])
-    from presto_tpu.io.sigproc import FilterbankSet
-    return FilterbankSet(paths)
+    return open_raw_args(paths, argparse.Namespace())
 
 
 def pad_to_good_N(series: np.ndarray, numout: int = 0):
@@ -166,7 +290,8 @@ def obs_metadata(fb) -> Tuple[str, str, str]:
             sigproc_coord_to_str(getattr(hdr, "src_dej", 0.0)))
 
 
-def make_bary_plan(fb, dsdt: float, ephem: str = "DE405"):
+def make_bary_plan(fb, dsdt: float, ephem: str = "DE405",
+                   skip_spectra: int = 0):
     """Build the barycentering plan for an open observation, or return
     None (with a warning) when the file carries no usable position —
     silently barycentering RA=DEC=0 junk would corrupt the output while
@@ -189,7 +314,9 @@ def make_bary_plan(fb, dsdt: float, ephem: str = "DE405"):
     if obscode == "EC" and tel.strip().lower() != "geocenter":
         print("WARNING: unrecognized telescope %r -- barycentering "
               "from the geocenter (up to ~21 ms Roemer error)." % tel)
-    plan = BaryPlan(hdr.tstart, float(hdr.N) * hdr.tsamp, dsdt,
+    tstart = hdr.tstart + skip_spectra * hdr.tsamp / 86400.0
+    plan = BaryPlan(tstart,
+                    (float(hdr.N) - skip_spectra) * hdr.tsamp, dsdt,
                     ra_str, dec_str, obscode, ephem)
     print("Average topocentric velocity (c) = %.7g" % plan.avgvoverc)
     return plan

@@ -70,11 +70,26 @@ def sdat2dat(sdatfile: str, outfile: str = "") -> str:
 
 
 def toas2dat(toafile: str, dt: float, numout: int,
-             outfile: str = "") -> str:
-    """Event arrival times (one per line, seconds) -> binned .dat
-    (src/toas2dat.c: histogram events onto the sample grid)."""
-    toas = np.loadtxt(toafile, usecols=(0,), ndmin=1)
-    bins = np.floor(toas / dt).astype(np.int64)
+             outfile: str = "", t0: float = None, text: bool = True,
+             floats: bool = False, sec: bool = True) -> str:
+    """Event arrival times -> binned .dat (src/toas2dat.c: histogram
+    events onto the sample grid).  text=True reads ASCII (one TOA per
+    line); otherwise binary doubles (floats=True: binary float32).
+    sec=False means TOAs are in days.  t0 = time of bin 0 (default:
+    the first TOA)."""
+    if text:
+        toas = np.loadtxt(toafile, usecols=(0,), ndmin=1)
+    else:
+        toas = np.fromfile(toafile,
+                           np.float32 if floats else np.float64)
+    toas = np.asarray(toas, np.float64)
+    if not sec:
+        toas = toas * 86400.0
+    if t0 is None:
+        t0 = float(toas.min()) if toas.size else 0.0
+    elif not sec:
+        t0 = t0 * 86400.0
+    bins = np.floor((toas - t0) / dt).astype(np.int64)
     bins = bins[(bins >= 0) & (bins < numout)]
     data = np.bincount(bins, minlength=numout).astype(np.float32)
     outfile = outfile or (os.path.splitext(toafile)[0] + ".dat")
@@ -101,8 +116,23 @@ def main(argv=None) -> int:
     s.add_argument("sdatfile")
     s.add_argument("-o", type=str, default="")
     s = sub.add_parser("toas2dat")
-    s.add_argument("-dt", type=float, required=True)
-    s.add_argument("-n", type=int, required=True)
+    s.add_argument("-dt", type=float, required=True,
+                   help="Time interval (s) for output bins")
+    s.add_argument("-n", type=int, required=True,
+                   help="Number of bins in the output series")
+    s.add_argument("-t0", type=float, default=None,
+                   help="Time of the start of bin 0 (TOA units)")
+    s.add_argument("-text", action="store_true", default=True,
+                   help="TOAs are ASCII text (default)")
+    s.add_argument("-float", dest="floats", action="store_true",
+                   help="TOAs are binary float32 (implies binary)")
+    s.add_argument("-double", dest="doubles", action="store_true",
+                   help="TOAs are binary float64")
+    s.add_argument("-sec", action="store_true", default=True,
+                   help="TOA unit is seconds (default; clear with "
+                        "-days)")
+    s.add_argument("-days", action="store_true",
+                   help="TOA unit is days")
     s.add_argument("toafile")
     s.add_argument("-o", type=str, default="")
     args = p.parse_args(argv)
@@ -115,7 +145,10 @@ def main(argv=None) -> int:
     elif args.tool == "sdat2dat":
         out = sdat2dat(args.sdatfile, args.o)
     else:
-        out = toas2dat(args.toafile, args.dt, args.n, args.o)
+        binary = args.floats or args.doubles
+        out = toas2dat(args.toafile, args.dt, args.n, args.o,
+                       t0=args.t0, text=not binary,
+                       floats=args.floats, sec=not args.days)
     print("%s -> %s" % (args.tool, out))
     return 0
 

@@ -21,13 +21,18 @@ def downsample_series(data: np.ndarray, fact: int) -> np.ndarray:
 
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="downsample")
-    p.add_argument("-f", "--factor", type=int, default=2)
+    p.add_argument("-factor", "-f", "--factor", type=int, default=2,
+                   help="The factor to downsample the data")
+    p.add_argument("-o", dest="outfile", type=str, default=None,
+                   help="Name of the output time series file "
+                        "(with suffix)")
     p.add_argument("datfile")
     args = p.parse_args(argv)
     base = os.path.splitext(args.datfile)[0]
     data = datfft.read_dat(args.datfile)
     out = downsample_series(data, args.factor)
-    outbase = "%s_DS%d" % (base, args.factor)
+    outbase = (os.path.splitext(args.outfile)[0] if args.outfile
+               else "%s_DS%d" % (base, args.factor))
     datfft.write_dat(outbase + ".dat", out)
     if os.path.exists(base + ".inf"):
         info = read_inf(base + ".inf")

@@ -22,15 +22,15 @@ import sys
 import numpy as np
 import jax.numpy as jnp
 
-from presto_tpu.apps.common import (add_common_flags, open_raw,
+from presto_tpu.apps.common import (add_common_flags, add_raw_flags,
+                                    open_raw_args, BlockPrep,
                                     fil_to_inf, ensure_backend,
                                     pad_to_good_N, set_onoff,
                                     make_bary_plan, set_bary_epoch,
-                                    stream_blocklen)
-from presto_tpu.io.datfft import write_dat
+                                    start_skip_spectra, stream_blocklen)
+from presto_tpu.io.datfft import write_dat, write_sdat
 from presto_tpu.io.maskfile import read_mask, determine_padvals
 from presto_tpu.ops import dedispersion as dd
-from presto_tpu.ops.clipping import clip_times, remove_zerodm, mask_block
 from presto_tpu.utils.ranges import parse_ranges
 
 
@@ -57,18 +57,24 @@ def build_parser() -> argparse.ArgumentParser:
                    help="Output exactly this many samples (pad/truncate)")
     p.add_argument("-ignorechan", type=str, default=None,
                    help="Channels to zero out, e.g. '0:5,34'")
+    p.add_argument("-shorts", action="store_true",
+                   help="Write short ints (.sdat) instead of floats")
+    add_raw_flags(p)
     p.add_argument("rawfiles", nargs="+")
     return p
 
 
 def run(args) -> str:
     ensure_backend()
-    fb = open_raw(args.rawfiles)
+    fb = open_raw_args(args.rawfiles, args)
     hdr = fb.header
     nchan = hdr.nchans
     dt = hdr.tsamp
+    skip = start_skip_spectra(args, int(hdr.N))
+    Ntot = int(hdr.N) - skip
 
-    plan = (make_bary_plan(fb, dt * args.downsamp, args.ephem)
+    plan = (make_bary_plan(fb, dt * args.downsamp, args.ephem,
+                           skip_spectra=skip)
             if not args.nobary else None)
     avgvoverc = plan.avgvoverc if plan is not None else 0.0
     delays = dd.dedisp_delays(nchan, args.dm, hdr.lofreq, abs(hdr.foff),
@@ -86,40 +92,35 @@ def run(args) -> str:
             pass
     ignore = (np.asarray(parse_ranges(args.ignorechan), dtype=np.int64)
               if args.ignorechan else None)
+    prep = BlockPrep(nchan, dt, args, mask=mask,
+                     padvals=padvals if args.mask else None,
+                     ignore=ignore)
 
     blocklen = stream_blocklen(nchan, maxd)
     out = []
-    clip_state = None
     bins_d = jnp.asarray(bins)
     prev = jnp.zeros((nchan, blocklen), dtype=jnp.float32)
     # prefetched sequential reads where the reader supports it (the
-    # native feeder overlaps disk IO with device compute)
+    # native feeder overlaps disk IO with device compute); -offset/
+    # -start fall back to positioned reads
     block_iter = (fb.stream_blocks(blocklen)
-                  if hasattr(fb, "stream_blocks") else None)
-    nread = 0
+                  if skip == 0 and hasattr(fb, "stream_blocks")
+                  else None)
+    nread = skip
+    first = True
     while nread < hdr.N:
         block = (next(block_iter) if block_iter is not None
                  else fb.read_spectra(nread, blocklen))  # [T, C] asc
-        if mask is not None:
-            n, chans = mask.check_mask(nread * dt, blocklen * dt)
-            if n == -1:
-                block[:] = padvals[None, :]
-            elif n > 0:
-                block = mask_block(block, chans, padvals)
-        if args.clip > 0:
-            block, _, clip_state = clip_times(block, args.clip, clip_state)
-        if args.zerodm:
-            block = remove_zerodm(block, padvals if args.mask else None)
-        if ignore is not None:
-            block[:, ignore] = 0.0
+        block = prep(block, nread)
         # upload each block ONCE and carry the device array as prev
         # (re-uploading prev doubled the host->device traffic); results
         # stay on device and download once at the end — both directions
         # of the tunnel pay seconds per transfer
         cur = jnp.asarray(np.ascontiguousarray(block.T))   # [C, T]
         series = dd.float_dedisp_block(prev, cur, bins_d)
-        if nread > 0:
+        if not first:
             out.append(series)
+        first = False
         prev = cur
         nread += blocklen
     # flush the final window with a zero block
@@ -129,7 +130,7 @@ def run(args) -> str:
     result = np.asarray(jnp.concatenate(out))
     # trim zero-padded tail: only N - maxd samples are fully dedispersed
     # (the prepsubband `valid` truncation, prepsubband.c:703-735 stats)
-    result = result[:max(int(hdr.N) - maxd, 0)]
+    result = result[:max(Ntot - maxd, 0)]
     if args.downsamp > 1:
         n = result.size // args.downsamp * args.downsamp
         result = result[:n].reshape(-1, args.downsamp).mean(axis=1)
@@ -141,12 +142,29 @@ def run(args) -> str:
     info = fil_to_inf(fb, outbase, result.size, dm=args.dm)
     if plan is not None:
         set_bary_epoch(info, plan)
+    elif skip:
+        info.mjd_f += skip * dt / 86400.0
+        info.mjd_i += int(info.mjd_f)
+        info.mjd_f %= 1.0
     info.dt = dt * args.downsamp
     set_onoff(info, valid, numout)
-    write_dat(outbase + ".dat", result.astype(np.float32), info)
+    suffix = ".dat"
+    if args.shorts:
+        off = write_sdat(outbase + ".sdat", result.astype(np.float32),
+                         info)
+        if off is None:
+            print("Error: way too much dynamic range for shorts; "
+                  "writing floats instead.")
+            write_dat(outbase + ".dat", result.astype(np.float32), info)
+        else:
+            suffix = ".sdat"
+            if off:
+                print("          Offset applied to data:  %d" % -int(off))
+    else:
+        write_dat(outbase + ".dat", result.astype(np.float32), info)
     fb.close()
-    print("Wrote %d samples to %s.dat (DM=%g, downsamp=%d)"
-          % (result.size, outbase, args.dm, args.downsamp))
+    print("Wrote %d samples to %s%s (DM=%g, downsamp=%d)"
+          % (result.size, outbase, suffix, args.dm, args.downsamp))
     return outbase
 
 

@@ -16,15 +16,16 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from presto_tpu.apps.common import (add_common_flags, open_raw,
+from presto_tpu.apps.common import (add_common_flags, add_raw_flags,
+                                    open_raw_args, BlockPrep,
                                     fil_to_inf, ensure_backend,
                                     pad_to_good_N, set_onoff,
                                     make_bary_plan, set_bary_epoch,
-                                    stream_blocklen)
+                                    start_skip_spectra, stream_blocklen)
 from presto_tpu.io.datfft import write_dat
 from presto_tpu.io.maskfile import read_mask, determine_padvals
 from presto_tpu.ops import dedispersion as dd
-from presto_tpu.ops.clipping import clip_times, remove_zerodm, mask_block
+from presto_tpu.utils.ranges import parse_ranges
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -45,18 +46,32 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("-numout", type=int, default=0,
                    help="Output exactly this many samples per DM "
                         "(default: pad to a highly-factorable length)")
+    p.add_argument("-runavg", action="store_true",
+                   help="Running mean subtraction from the input data")
+    p.add_argument("-sub", action="store_true",
+                   help="Write subbands instead of de-dispersed data")
+    p.add_argument("-subdm", type=float, default=None,
+                   help="The DM to use when de-dispersing subbands "
+                        "for -sub (default: center of the DM range)")
+    p.add_argument("-dmprec", type=int, default=2,
+                   help="Decimals of DM precision in output filenames")
+    p.add_argument("-ignorechan", type=str, default=None,
+                   help="Channels to zero out, e.g. '0:5,34'")
+    add_raw_flags(p)
     p.add_argument("rawfiles", nargs="+")
     return p
 
 
 def plan_delays(hdr, args, avgvoverc=0.0):
-    """Two-level delays: channel->subband at the center DM, then
-    per-DM subband offsets (prepsubband.c:353-372; the barycentric
-    branch computes the same delays at Doppler-shifted frequencies,
-    prepsubband.c:477-498)."""
+    """Two-level delays: channel->subband at the center DM (or -subdm
+    when given), then per-DM subband offsets (prepsubband.c:353-372;
+    the barycentric branch computes the same delays at Doppler-shifted
+    frequencies, prepsubband.c:477-498)."""
     nchan, dt = hdr.nchans, hdr.tsamp
     dms = args.lodm + np.arange(args.numdms) * args.dmstep
     center_dm = args.lodm + 0.5 * (args.numdms - 1) * args.dmstep
+    if getattr(args, "subdm", None) is not None:
+        center_dm = args.subdm
     chan_del = dd.subband_search_delays(nchan, args.nsub, center_dm,
                                         hdr.lofreq, abs(hdr.foff),
                                         voverc=avgvoverc)
@@ -74,11 +89,14 @@ def run(args):
     ensure_backend()
     if args.downsamp < 1:
         raise SystemExit("prepsubband: -downsamp must be >= 1")
-    fb = open_raw(args.rawfiles)
+    fb = open_raw_args(args.rawfiles, args)
     hdr = fb.header
     nchan, dt = hdr.nchans, hdr.tsamp
+    skip = start_skip_spectra(args, int(hdr.N))
+    Neff = int(hdr.N) - skip
 
-    plan = (make_bary_plan(fb, dt * args.downsamp, args.ephem)
+    plan = (make_bary_plan(fb, dt * args.downsamp, args.ephem,
+                           skip_spectra=skip)
             if not args.nobary else None)
     avgvoverc = plan.avgvoverc if plan is not None else 0.0
     dms, chan_bins, dm_bins = plan_delays(hdr, args, avgvoverc)
@@ -92,6 +110,11 @@ def run(args):
                                                           ".stats"))
         except OSError:
             pass
+    ignore = (np.asarray(parse_ranges(args.ignorechan), dtype=np.int64)
+              if args.ignorechan else None)
+    prep = BlockPrep(nchan, dt, args, mask=mask,
+                     padvals=padvals if args.mask else None,
+                     ignore=ignore)
 
     blocklen = stream_blocklen(nchan, max(int(chan_bins.max()),
                                           int(dm_bins.max())))
@@ -99,44 +122,36 @@ def run(args):
     # downsamp]: round blocklen up to a multiple of the factor
     if blocklen % args.downsamp:
         blocklen += args.downsamp - blocklen % args.downsamp
-    clip_state = None
     chan_bins_d = jnp.asarray(chan_bins)
     dm_bins_d = jnp.asarray(dm_bins)
     prev_raw = None
     prev_sub = None
     outs = []
+    subouts = []
     # prefetched sequential reads where the reader supports it (the
     # native feeder overlaps disk IO with device compute)
     block_iter = (fb.stream_blocks(blocklen)
-                  if hasattr(fb, "stream_blocks") else None)
+                  if skip == 0 and hasattr(fb, "stream_blocks")
+                  else None)
     from presto_tpu.utils.timing import print_percent_complete
-    nread = 0
+    nread = skip
     nblocks = 0
     pct = -1
     while nread < hdr.N + 2 * blocklen:   # two extra flush blocks
-        pct = print_percent_complete(min(nread, hdr.N), hdr.N, pct)
+        pct = print_percent_complete(min(nread - skip, Neff), Neff, pct)
         if nread < hdr.N:
             block = (next(block_iter) if block_iter is not None
                      else fb.read_spectra(nread, blocklen))
-            if mask is not None:
-                n, chans = mask.check_mask(nread * dt, blocklen * dt)
-                if n == -1:
-                    block[:] = padvals[None, :]
-                elif n > 0:
-                    block = mask_block(block, chans, padvals)
-            if args.clip > 0:
-                block, _, clip_state = clip_times(block, args.clip,
-                                                  clip_state)
-            if args.zerodm:
-                block = remove_zerodm(block,
-                                      padvals if args.mask else None)
+            block = prep(block, nread)
         else:
             block = np.zeros((blocklen, nchan), dtype=np.float32)
         cur = jnp.asarray(np.ascontiguousarray(block.T))
         if prev_raw is not None:
             sub = dd.dedisp_subbands_block(prev_raw, cur, chan_bins_d,
                                            args.nsub)
-            if prev_sub is not None:
+            if args.sub:
+                subouts.append(sub)
+            elif prev_sub is not None:
                 series = dd.float_dedisp_many_block(prev_sub, sub,
                                                     dm_bins_d)
                 series = dd.downsample_block(series, args.downsamp)
@@ -148,8 +163,12 @@ def run(args):
         nread += blocklen
         nblocks += 1
 
+    if args.sub:
+        return _write_subbands(args, fb, plan, subouts, dms, dt,
+                               int(chan_bins.max()), Neff, skip)
+
     result = np.asarray(jnp.concatenate(outs, axis=1))  # [numdms, T]
-    valid = (int(hdr.N) - maxd) // args.downsamp
+    valid = (Neff - maxd) // args.downsamp
     result = result[:, :valid]
     if plan is not None and plan.diffbins.size:
         # same diffbin schedule applies to every DM series
@@ -159,10 +178,14 @@ def run(args):
 
     outbase = args.outfile or "prepsubband_out"
     for i, dmval in enumerate(dms):
-        name = "%s_DM%.2f" % (outbase, dmval)
+        name = "%s_DM%.*f" % (outbase, args.dmprec, dmval)
         info = fil_to_inf(fb, name, result.shape[1], dm=float(dmval))
         if plan is not None:
             set_bary_epoch(info, plan)
+        elif skip:
+            info.mjd_f += skip * dt / 86400.0
+            info.mjd_i += int(info.mjd_f)
+            info.mjd_f %= 1.0
         info.dt = dt * args.downsamp
         set_onoff(info, valid, numout)
         write_dat(name + ".dat", result[i], info)
@@ -171,6 +194,44 @@ def run(args):
           % (args.numdms, result.shape[1], args.lodm, args.dmstep,
              args.nsub))
     return outbase, dms
+
+
+def _write_subbands(args, fb, plan, subouts, dms, dt, maxd, Neff,
+                    skip=0):
+    """-sub output: one int16 stream per subband, outbase.sub0000...
+    (the short-int subband files read_PRESTO_subbands consumes,
+    prepsubband.c:825-846), each with a .sub.inf sidecar carrying the
+    subband layout (num_chan = nsub)."""
+    import jax.numpy as jnp
+    from presto_tpu.apps.common import fil_to_inf
+    from presto_tpu.io.infodata import write_inf
+
+    subs = np.asarray(jnp.concatenate(subouts, axis=1))  # [nsub, T]
+    valid = max(Neff - maxd, 0)
+    subs = subs[:, :valid]
+    outbase = args.outfile or "prepsubband_out"
+    subdm = (args.subdm if args.subdm is not None
+             else float(np.mean(dms)))
+    name = "%s_DM%.*f" % (outbase, args.dmprec, subdm)
+    for s in range(subs.shape[0]):
+        q = np.clip(np.trunc(subs[s]), -32768, 32767).astype("<i2")
+        q.tofile("%s.sub%04d" % (name, s))
+    info = fil_to_inf(fb, name, valid, dm=subdm)
+    if plan is not None:
+        set_bary_epoch(info, plan)
+    elif skip:
+        info.mjd_f += skip * dt / 86400.0
+        info.mjd_i += int(info.mjd_f)
+        info.mjd_f %= 1.0
+    info.dt = dt
+    info.num_chan = subs.shape[0]
+    info.chan_wid = abs(fb.header.foff) * (fb.header.nchans
+                                           // subs.shape[0])
+    write_inf(info, name + ".sub.inf")
+    fb.close()
+    print("Wrote %d subbands x %d samples at subdm=%g to %s.sub****"
+          % (subs.shape[0], valid, subdm, name))
+    return name, dms
 
 
 def main(argv=None):

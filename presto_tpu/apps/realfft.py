@@ -34,6 +34,10 @@ def build_parser():
                    help="Force the out-of-core two-pass disk FFT")
     p.add_argument("-mem", action="store_true",
                    help="Force the in-core FFT regardless of size")
+    p.add_argument("-tmpdir", type=str, default=None,
+                   help="Scratch directory for out-of-core temp files")
+    p.add_argument("-outdir", type=str, default=None,
+                   help="Directory where result files will reside")
     p.add_argument("datafiles", nargs="+")
     return p
 
@@ -61,17 +65,21 @@ def _host_irealfft_packed(amps: np.ndarray) -> np.ndarray:
 
 
 def run_one(path: str, forward: bool, delete: bool,
-            disk: bool = False, mem: bool = False) -> str:
+            disk: bool = False, mem: bool = False,
+            tmpdir: str | None = None,
+            outdir: str | None = None) -> str:
     from presto_tpu.ops import oocfft
     base, ext = os.path.splitext(path)
     info = read_inf(base)
+    obase = (os.path.join(outdir, os.path.basename(base)) if outdir
+             else base)
     if forward:
         src = base + ".dat"
-        out = base + ".fft"
+        out = obase + ".fft"
         nfloats = os.path.getsize(src) // 4
         if not mem and nfloats >= 8 and (disk or
                                          nfloats > oocfft.MAXREALFFT):
-            oocfft.realfft_ooc(src, out, forward=True)
+            oocfft.realfft_ooc(src, out, forward=True, tmpdir=tmpdir)
         else:
             data = datfft.read_dat(src)
             n = data.size & ~1
@@ -82,16 +90,16 @@ def run_one(path: str, forward: bool, delete: bool,
             else:
                 packed = _host_realfft_packed(data[:n])
             datfft.write_fft(out, packed)
-        write_inf(info, base + ".inf")
+        write_inf(info, obase + ".inf")
         if delete:
             os.remove(src)
     else:
         src = base + ".fft"
-        out = base + ".dat"
+        out = obase + ".dat"
         namps = os.path.getsize(src) // 8
         if not mem and namps >= 4 and (disk or
                                        2 * namps > oocfft.MAXREALFFT):
-            oocfft.realfft_ooc(src, out, forward=False)
+            oocfft.realfft_ooc(src, out, forward=False, tmpdir=tmpdir)
         else:
             amps = datfft.read_fft(src)
             if _xla_friendly(2 * amps.size):
@@ -101,7 +109,7 @@ def run_one(path: str, forward: bool, delete: bool,
             else:
                 data = _host_irealfft_packed(amps)
             datfft.write_dat(out, data)
-        write_inf(info, base + ".inf")
+        write_inf(info, obase + ".inf")
         if delete:
             os.remove(src)
     print("realfft: wrote %s" % out)
@@ -114,7 +122,8 @@ def main(argv=None):
     for path in args.datafiles:
         ext = os.path.splitext(path)[1]
         forward = args.fwd or (ext == ".dat" and not args.inv)
-        run_one(path, forward, args.delete, disk=args.disk, mem=args.mem)
+        run_one(path, forward, args.delete, disk=args.disk,
+                mem=args.mem, tmpdir=args.tmpdir, outdir=args.outdir)
 
 
 if __name__ == "__main__":

@@ -33,6 +33,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("-overlap", type=float, default=0.25)
     p.add_argument("-harmsum", type=int, default=3)
     p.add_argument("-stack", type=int, default=0)
+    p.add_argument("-numbetween", type=int, default=2, choices=(1, 2),
+                   help="Points to interpolate per Fourier bin (2 = "
+                        "bins + interbins, 1 = raw bins only)")
     p.add_argument("-interbin", action="store_true")
     p.add_argument("-noalias", action="store_true")
     p.add_argument("fftfile")
@@ -59,6 +62,7 @@ def run(args):
                          maxfft=args.maxfft, rlo=rlo, rhi=rhi,
                          lobin=args.lobin, overlap=args.overlap,
                          harmsum=args.harmsum, interbin=args.interbin,
+                         numbetween=args.numbetween,
                          noalias=args.noalias, stack=args.stack)
     cands = search_phasemod(spec, N, info.dt, cfg)
     base = args.fftfile[:-4] if args.fftfile.endswith(".fft") \

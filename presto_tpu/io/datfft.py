@@ -23,6 +23,32 @@ def write_dat(path: str, data: np.ndarray, info: InfoData | None = None):
         write_inf(info, base + ".inf")
 
 
+def write_sdat(path: str, data: np.ndarray,
+               info: InfoData | None = None):
+    """Raw int16 `.sdat` with prepdata -shorts semantics
+    (prepdata.c:696-744): subtract offset = floor(mean); if the dynamic
+    range slightly exceeds int16 (< 1.5x) clip the low values by using
+    offset = max - SHRT_MAX; if it is way too large, refuse (return
+    None so the caller keeps floats).  Returns the applied offset.
+    C-cast truncation toward zero is preserved via np.trunc."""
+    avg, mx, mn = float(data.mean()), float(data.max()), float(data.min())
+    offset = float(np.floor(avg))
+    if (mx - mn) > 65535.0:
+        if (mx - mn) < 1.5 * 65535.0:
+            offset = mx - 32767.0
+        else:
+            return None
+    q = np.trunc(data.astype(np.float64) + 1e-20 - offset)
+    q = np.clip(q, -32768, 32767).astype("<i2")
+    q.tofile(path)
+    if info is not None:
+        base = path[:-5] if path.endswith(".sdat") else path
+        info.name = base
+        info.N = data.size
+        write_inf(info, base + ".inf")
+    return offset
+
+
 def read_dat(path: str) -> np.ndarray:
     return np.fromfile(path, dtype=np.float32)
 

@@ -177,7 +177,8 @@ def _real_fixup_inverse(path: str, nc: int, max_mem: int) -> None:
 
 
 def realfft_ooc(src_path: str, dst_path: str, forward: bool = True,
-                max_mem: int = _DEF_MAX_MEM) -> None:
+                max_mem: int = _DEF_MAX_MEM,
+                tmpdir: str | None = None) -> None:
     """Out-of-core packed real FFT: .dat (float32[n]) <-> .fft
     (packed complex64[n/2]), matching fftpack.realfft_packed /
     irealfft_packed to float32 tolerance.
@@ -187,17 +188,22 @@ def realfft_ooc(src_path: str, dst_path: str, forward: bool = True,
     inverse: copy src -> dst, inverse-separate in place, inverse
     two-pass FFT in place; dst bytes are then the float32 series.
     """
+    scratch = None
+    if tmpdir:
+        scratch = os.path.join(
+            tmpdir, os.path.basename(dst_path) + ".scratch")
     if forward:
         nbytes = os.path.getsize(src_path)
         n = (nbytes // 4) & ~1
         nc = n // 2
         ooc_complex_fft(src_path, dst_path, nc, forward=True,
-                        max_mem=max_mem)
+                        max_mem=max_mem, scratch_path=scratch)
         _real_fixup_forward(dst_path, nc, max_mem)
     else:
         nbytes = os.path.getsize(src_path)
         nc = nbytes // 8
-        tmp = dst_path + ".zfile"
+        tmp = (os.path.join(tmpdir, os.path.basename(dst_path) + ".zfile")
+               if tmpdir else dst_path + ".zfile")
         # copy packed spectrum (blocked) then work in place
         with open(src_path, "rb") as fi, open(tmp, "wb") as fo:
             while True:
@@ -206,5 +212,6 @@ def realfft_ooc(src_path: str, dst_path: str, forward: bool = True,
                     break
                 fo.write(chunk)
         _real_fixup_inverse(tmp, nc, max_mem)
-        ooc_complex_fft(tmp, dst_path, nc, forward=False, max_mem=max_mem)
+        ooc_complex_fft(tmp, dst_path, nc, forward=False,
+                        max_mem=max_mem, scratch_path=scratch)
         os.remove(tmp)

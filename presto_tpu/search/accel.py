@@ -111,6 +111,10 @@ class AccelConfig:
     flo: float = 1.0             # min freq (Hz) if rlo not given
     uselen: int = ACCEL_USELEN   # half-bins of fundamental per block
     max_cands_per_stage: int = 2048   # static top-k size
+    norm: str = "median"         # "median" (accel_utils.c:952-967) or
+                                 # "prenorm" (spectrum already
+                                 # normalized: -photon/-locpow modes
+                                 # prescale on host)
 
     @property
     def numharmstages(self) -> int:
@@ -664,8 +668,9 @@ class AccelSearch:
         def chunk_slab(fft_pad, lobin_chunk, kern_dev):
             idx = lobin_chunk[:, None] + jnp.arange(g.numdata)
             batch = fft_pad[idx]            # [chunk, numdata, 2]
-            norms = _block_median_norms(batch)
-            powers = _ffdot_blocks(batch * norms, kern_dev, cfg.uselen,
+            if cfg.norm == "median":
+                batch = batch * _block_median_norms(batch)
+            powers = _ffdot_blocks(batch, kern_dev, cfg.uselen,
                                    kern.fftlen, kern.halfwidth)
             # [chunk, numz, uselen] -> [numz, chunk*uselen] slab
             return jnp.moveaxis(powers, 0, 1).reshape(kern.numz, -1)

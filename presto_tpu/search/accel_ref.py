@@ -110,8 +110,12 @@ def build_plane_ref(search: AccelSearch, spectrum: np.ndarray,
         lo, hi = max(lobin, 0), min(lobin + numdata, nbins)
         win[lo - lobin:hi - lobin] = spec[lo:hi]
         # old-style per-block median normalization (accel_utils.c:952-967)
-        med = max(float(np.median(win.real ** 2 + win.imag ** 2)), 1e-30)
-        norm = 1.0 / np.sqrt(med / np.log(2.0))
+        if cfg.norm == "median":
+            med = max(float(np.median(win.real ** 2 + win.imag ** 2)),
+                      1e-30)
+            norm = 1.0 / np.sqrt(med / np.log(2.0))
+        else:
+            norm = 1.0
         spread = np.zeros(kern.fftlen, dtype=cdtype)
         spread[::ACCEL_NUMBETWEEN] = win * dtype(norm)
         fdata = _fft(spread, workers)

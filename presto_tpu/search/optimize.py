@@ -228,6 +228,33 @@ def get_localpower(amps: np.ndarray, r: float, z: float = 0.0,
     return max(tot / (2 * half), 1e-30)
 
 
+def spectrum_local_powers(amps: np.ndarray,
+                          numavg: int = resp.NUMLOCPOWAVG,
+                          delta: int = resp.DELTAAVGBINS) -> np.ndarray:
+    """Running local power for EVERY bin: mean raw power of the
+    numavg/2 bins on each side offset by >= delta — the
+    get_localpower window applied spectrum-wide at integer bins
+    (the -locpow normalization; reference corr_loc_pow,
+    corr_routines.c:309).  Out-of-range taps contribute zero and the
+    divisor stays numavg, matching pow_at's edge behavior."""
+    p = (amps.real.astype(np.float64) ** 2
+         + amps.imag.astype(np.float64) ** 2)
+    n = p.size
+    c = np.concatenate([[0.0], np.cumsum(p)])
+    half = numavg // 2
+    i = np.arange(n)
+
+    def winsum(lo, hi):
+        """sum p[lo..hi] inclusive with clipping."""
+        lo = np.clip(lo, 0, n)
+        hi = np.clip(hi + 1, 0, n)
+        return c[np.maximum(hi, lo)] - c[lo]
+
+    tot = winsum(i - delta - half + 1, i - delta) \
+        + winsum(i + delta, i + delta + half - 1)
+    return np.maximum(tot / numavg, 1e-30)
+
+
 @dataclass
 class RDerivs:
     """Local derivatives of power/phase at a peak
@@ -347,17 +374,24 @@ class OptimizedCand:
 
 
 def optimize_accelcand(amps: np.ndarray, cand, T: float,
-                       numindep: Sequence[float]) -> OptimizedCand:
+                       numindep: Sequence[float],
+                       harmpolish: bool = True) -> OptimizedCand:
     """Refine one raw search candidate: joint harmonic (r, z) max,
     per-harmonic local powers and properties, final summed-power sigma.
 
     cand: search.accel.AccelCand (fundamental r, z, numharm).
     numindep: per-stage independent-trial counts from the search.
+    harmpolish=False optimizes the fundamental's power only (the
+    reference's -noharmpolish; the joint harmonic simplex is default).
     """
     nh = cand.numharm
     locpows = [get_localpower(amps, cand.r * h, cand.z * h)
                for h in range(1, nh + 1)]
-    r, z, _ = max_rz_arr_harmonics(amps, cand.r, cand.z, nh, locpows)
+    if harmpolish:
+        r, z, _ = max_rz_arr_harmonics(amps, cand.r, cand.z, nh,
+                                       locpows)
+    else:
+        r, z, _ = max_rz_arr(amps, cand.r, cand.z)
     # re-measure local powers at the refined peak before the final
     # normalization (the pre-refinement windows can sit several bins off)
     locpows = [get_localpower(amps, r * h, z * h)

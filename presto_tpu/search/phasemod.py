@@ -97,18 +97,23 @@ def prune_powers(powers: np.ndarray, numsumpow: int = 1) -> np.ndarray:
 # ----------------------------------------------------------------------
 
 @partial(jax.jit, static_argnames=("fftlen", "interbin", "checkaliased",
-                                   "numharm", "lobin", "hibin", "k"))
+                                   "numharm", "lobin", "hibin", "k",
+                                   "numbetween"))
 def _minifft_topk(windows, numsumpow, fftlen, interbin, checkaliased,
-                  numharm, lobin, hibin, k):
+                  numharm, lobin, hibin, k, numbetween=2):
     """windows: [B, fftlen] float32 (pruned big-FFT powers).
 
     Returns (vals[B, numharm, k], idx[B, numharm, k]): per harmonic
     stage, the k strongest summed powers and their spread-bin indices
     (stage s sums s+1 harmonics).  Bin index jj at stage h means
-    mini_r = (jj/numbetween)/h with numbetween=2.
+    mini_r = (jj/numbetween)/h (numbetween=1: raw bins only, no
+    interpolation — the reference's -numbetween 1).
     """
     B = windows.shape[0]
-    if interbin:
+    if numbetween == 1:
+        sp = jnp.fft.rfft(windows, axis=-1)
+        spread = sp[:, :fftlen // 2]
+    elif interbin:
         # rfft of the raw window: fftlen/2+1 bins; spread even bins are
         # the amplitudes, odd bins the interbin differences.  The
         # reference (minifft.c:276-283) scales by 2/pi, which recovers
@@ -158,6 +163,7 @@ def search_minifft_batch(windows: np.ndarray, T: float, full_N: float,
                          min_orb_p: float = MINORBP,
                          max_orb_p: Optional[float] = None,
                          numharm: int = 3, interbin: bool = False,
+                         numbetween: int = 2,
                          checkaliased: bool = True,
                          numsumpow: int = 1) -> List[RawBinCand]:
     """Search a batch of same-length power windows.
@@ -169,7 +175,8 @@ def search_minifft_batch(windows: np.ndarray, T: float, full_N: float,
     """
     B, fftlen = windows.shape
     numminifft = fftlen // 2
-    numbetween = 2
+    if numbetween not in (1, 2):
+        raise ValueError("numbetween must be 1 or 2")
     if max_orb_p is None:
         max_orb_p = T / 2.0 if not checkaliased else T / 1.2
     lobin = max(int(np.ceil(2 * numminifft * min_orb_p / T)), 1)
@@ -182,7 +189,7 @@ def search_minifft_batch(windows: np.ndarray, T: float, full_N: float,
     vals, idx = _minifft_topk(
         np.asarray(windows, np.float32), np.float32(numsumpow),
         fftlen, interbin, checkaliased, numharm, lobin, hibin,
-        MININCANDS)
+        MININCANDS, numbetween=numbetween)
     vals = np.asarray(vals)
     idx = np.asarray(idx)
     dr = 1.0 / numbetween
@@ -257,6 +264,7 @@ class PhaseModConfig:
     harmsum: int = 3
     interbin: bool = False
     noalias: bool = False
+    numbetween: int = 2     # 1: raw bins only; 2: + interpolated bins
     stack: int = 0          # >0: input is stacked power spectra
 
 
@@ -327,6 +335,7 @@ def search_phasemod(fft_or_powers: np.ndarray, N: float, dt: float,
             new = search_minifft_batch(
                 wins, T, N, lo_rs, min_orb_p, max_orb_p,
                 numharm=cfg.harmsum, interbin=cfg.interbin,
+                numbetween=cfg.numbetween,
                 checkaliased=not cfg.noalias, numsumpow=numsumpow)
             master = merge_rawbin_cands(master, new, 2 * cfg.ncand)
             fftlen >>= 1

@@ -213,6 +213,36 @@ def _threshold(dataavg, datastd, datapow, ptsperint, timesigma, freqsigma,
     return bytemask
 
 
+def rfifind_from_stats(stats: dict, dt: float, lofreq: float,
+                       chanwidth: float, timesigma: float = 10.0,
+                       freqsigma: float = 4.0,
+                       chantrigfrac: float = 0.7,
+                       inttrigfrac: float = 0.3, mjd: float = 0.0,
+                       zap_chans=(), zap_ints=()) -> RfifindResult:
+    """Re-threshold previously computed statistics (the -nocompute
+    path, rfifind.c:414-429: re-plot and remake the mask from the
+    .stats file without touching the raw data).  `stats` is the dict
+    from io.maskfile.read_statsfile."""
+    dataavg = stats["dataavg"]
+    datastd = stats["datastd"]
+    datapow = stats["datapow"]
+    ptsperint = int(stats["ptsperint"])
+    numint, numchan = dataavg.shape
+    bytemask = _threshold(dataavg, datastd, datapow, ptsperint,
+                          timesigma, freqsigma, chantrigfrac,
+                          inttrigfrac, list(zap_chans), list(zap_ints))
+    userchan = sorted({c for c in range(numchan)
+                       if (bytemask[:, c] & USERCHAN).all()})
+    userints = sorted({i for i in range(numint)
+                       if (bytemask[i] & USERINTS).all()})
+    m = fill_mask(timesigma, freqsigma, mjd, ptsperint * dt, lofreq,
+                  chanwidth, numchan, numint, ptsperint, userchan,
+                  userints, bytemask)
+    return RfifindResult(dataavg=dataavg, datastd=datastd,
+                         datapow=datapow, bytemask=bytemask, mask=m,
+                         ptsperint=ptsperint)
+
+
 def write_rfifind_products(result: RfifindResult, rootname: str,
                            lobin: int = 0, numbetween: int = 2) -> None:
     """Write rootname_rfifind.mask and rootname_rfifind.stats."""

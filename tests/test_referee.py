@@ -26,9 +26,7 @@ def _chirp_pairs(numbins, T, tones):
     t = np.arange(N) / N  # fractional obs time
     x = rng.normal(size=N)
     for (r0, z, amp) in tones:
-        phase = 2 * np.pi * (r0 * t + 0.5 * z * t * t) * 1.0
         x += amp * np.cos(2 * np.pi * (r0 * t + 0.5 * z * t * t))
-        del phase
     X = np.fft.rfft(x)[:numbins]
     return np.stack([X.real, X.imag], -1).astype(np.float32)
 

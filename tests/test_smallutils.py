@@ -112,16 +112,33 @@ def test_quicklook_finds_tone(tmp_path, capsys):
     assert abs(float(top[1]) - f0) < 0.5
 
 
-def test_dftfold_phase_and_power(tmp_path):
-    from presto_tpu.apps.dftfold import dft_at
+def test_dftfold_subvectors(tmp_path):
+    from presto_tpu.apps.dftfold import (dft_subvectors, read_dftvector,
+                                         main as dftfold_main)
+    from presto_tpu.io import datfft
+    from presto_tpu.io.infodata import InfoData, write_inf
     N, dt, f0 = 8192, 1e-3, 25.0
     t = np.arange(N) * dt
     data = np.cos(2 * np.pi * f0 * t).astype(np.float32)
-    amp, phase, norm = dft_at(data, dt, f0)
-    assert abs(amp - N / 2) < 1.0       # coherent sum
-    assert norm > 100                    # wildly significant
-    _, _, norm_off = dft_at(data, dt, f0 * 1.37)
-    assert norm_off < 5
+    T = N * dt
+    rr = f0 * T
+    vec = dft_subvectors(data, rr, 16)
+    tot = vec.sum()
+    assert abs(abs(tot) - N / 2) < 1.0          # coherent sum
+    # on frequency: all sub-vector phases aligned (the vector "walks
+    # straight"); off frequency: it curls up
+    assert np.ptp(np.unwrap(np.angle(vec))) < 0.1
+    off = dft_subvectors(data, rr * 1.37, 16).sum()
+    assert abs(off) < 0.05 * abs(tot)
+    # CLI end-to-end + .dftvec round trip
+    base = str(tmp_path / "dfttest")
+    datfft.write_dat(base + ".dat", data,
+                     InfoData(name=base, dt=dt, N=N))
+    dftfold_main(["-n", "16", "-f", str(f0), base + ".dat"])
+    d = read_dftvector("%s_%.3f.dftvec" % (base, rr))
+    assert d["numvect"] == 16 and d["n"] == N // 16
+    assert d["r"] == rr and d["dt"] == dt
+    assert np.allclose(d["vector"], vec.astype(np.complex64))
 
 
 def test_rednoise_cli(tmp_path):
@@ -172,12 +189,21 @@ def test_datutils_shift_patch_sdat_toas(tmp_path):
     back = datfft.read_dat(sdat2dat(sd))
     span = data.max() - data.min()
     assert np.abs(back - data).max() < span / 65000.0 * 2
-    # toas2dat: events land in the right bins
+    # toas2dat: events land in the right bins (t0=0 pins the grid;
+    # the default t0 is the first TOA, toas2dat.c:159-162)
     toafile = str(tmp_path / "ev.txt")
     np.savetxt(toafile, [0.0105, 0.0105, 0.5001])
-    out = toas2dat(toafile, dt=1e-3, numout=1000)
+    out = toas2dat(toafile, dt=1e-3, numout=1000, t0=0.0)
     d = datfft.read_dat(out)
     assert d[10] == 2.0 and d[500] == 1.0 and d.sum() == 3.0
+    # default t0 = first TOA
+    out = toas2dat(toafile, dt=1e-3, numout=1000)
+    d = datfft.read_dat(out)
+    assert d[0] == 2.0 and d.sum() == 3.0
+    # days units scale by 86400
+    out = toas2dat(toafile, dt=86.4, numout=1000, t0=0.0, sec=False)
+    d = datfft.read_dat(out)
+    assert d[10] == 2.0 and d[500] == 1.0
 
 
 def test_readfile_cli(tmp_path, capsys):
