@@ -17,13 +17,12 @@ import os
 import numpy as np
 import jax.numpy as jnp
 
-from presto_tpu.apps.common import (add_common_flags, open_raw,
-                                    load_timeseries, ensure_backend,
-                                    stream_blocklen)
+from presto_tpu.apps.common import (add_common_flags, add_raw_flags,
+                                    open_raw, load_timeseries,
+                                    ensure_backend, stream_blocklen)
 from presto_tpu.io.maskfile import read_mask, determine_padvals
 from presto_tpu.io.pfd import Pfd, write_pfd, write_bestprof
 from presto_tpu.ops import dedispersion as dd
-from presto_tpu.ops.clipping import clip_times, mask_block
 from presto_tpu.search.prepfold import (FoldConfig, fold_subband_series,
                                         search_fold, fold_errors)
 
@@ -37,31 +36,136 @@ def build_parser():
     p.add_argument("-f", type=float, default=0.0, help="Frequency (Hz)")
     p.add_argument("-fd", type=float, default=0.0)
     p.add_argument("-fdd", type=float, default=0.0)
-    p.add_argument("-accelcand", type=int, default=0)
-    p.add_argument("-accelfile", type=str, default=None)
-    p.add_argument("-par", "-timing", dest="parfile", type=str,
-                   default=None,
+    p.add_argument("-pfact", type=float, default=1.0,
+                   help="Factor to multiply the candidate p/p-dot by")
+    p.add_argument("-ffact", type=float, default=1.0,
+                   help="Factor to multiply the candidate f/f-dot by")
+    p.add_argument("-phs", type=float, default=0.0,
+                   help="Offset phase for the profile")
+    p.add_argument("-accelcand", "-rzwcand", dest="accelcand",
+                   type=int, default=0)
+    p.add_argument("-accelfile", "-rzwfile", dest="accelfile",
+                   type=str, default=None)
+    p.add_argument("-psr", type=str, default=None,
+                   help="Name of pulsar to fold (catalog lookup)")
+    p.add_argument("-par", dest="parfile", type=str, default=None,
                    help="Fold using an ephemeris from a .par file "
                         "(polycos generated in-framework, no TEMPO)")
+    p.add_argument("-timing", type=str, default=None,
+                   help="TOA-generation mode: par file to fold with "
+                        "(implies -nosearch, -fine, npart=60)")
     p.add_argument("-polycos", type=str, default=None,
                    help="Fold using an existing TEMPO polyco.dat")
+    p.add_argument("-absphase", action="store_true",
+                   help="Use the absolute phase of the polycos")
+    p.add_argument("-barypolycos", action="store_true",
+                   help="Force polycos for barycentered events/data")
+    p.add_argument("-topo", action="store_true",
+                   help="Fold topocentrically (no barycentering; "
+                        "this rebuild folds raw data topocentrically "
+                        "by default — flag kept for parity)")
     p.add_argument("-dm", type=float, default=0.0)
     p.add_argument("-n", dest="proflen", type=int, default=0,
                    help="Profile bins (0 = auto)")
     p.add_argument("-npart", type=int, default=64)
     p.add_argument("-nsub", type=int, default=32)
-    p.add_argument("-npfact", type=int, default=1)
-    p.add_argument("-ndmfact", type=int, default=2)
+    p.add_argument("-pstep", type=int, default=2)
+    p.add_argument("-pdstep", type=int, default=4)
+    p.add_argument("-dmstep", type=int, default=2)
+    p.add_argument("-npfact", type=int, default=2)
+    p.add_argument("-ndmfact", type=int, default=3)
+    p.add_argument("-fine", action="store_true",
+                   help="Finer p/pd gridding (well-known p, pd)")
+    p.add_argument("-coarse", action="store_true",
+                   help="Coarser p/pd gridding (unknown p, pd)")
+    p.add_argument("-slow", action="store_true",
+                   help="Useful flags for slow pulsars (implies -fine, "
+                        "proflen=100)")
+    p.add_argument("-searchpdd", action="store_true",
+                   help="Search p-dotdots as well as p and p-dots")
+    p.add_argument("-searchfdd", action="store_true",
+                   help="Search f-dotdots (implies -searchpdd)")
     p.add_argument("-noplot", "-noxwin", action="store_true",
                    help="Skip the diagnostic plot")
     p.add_argument("-nosearch", action="store_true")
     p.add_argument("-nopsearch", action="store_true")
     p.add_argument("-nopdsearch", action="store_true")
     p.add_argument("-nodmsearch", action="store_true")
+    p.add_argument("-scaleparts", action="store_true",
+                   help="Scale the part profiles independently")
+    p.add_argument("-allgrey", action="store_true",
+                   help="Greyscale images instead of color")
+    p.add_argument("-fixchi", action="store_true",
+                   help="Scale so off-pulse reduced chi2 = 1")
+    p.add_argument("-justprofs", action="store_true",
+                   help="Only output the profile portions of the plot")
+    p.add_argument("-start", dest="startT", type=float, default=0.0,
+                   help="Folding start as a fraction of the obs")
+    p.add_argument("-end", dest="endT", type=float, default=1.0,
+                   help="Folding end as a fraction of the obs")
     p.add_argument("-mask", type=str, default=None)
     p.add_argument("-clip", type=float, default=6.0)
+    p.add_argument("-zerodm", action="store_true")
+    p.add_argument("-runavg", action="store_true",
+                   help="Subtract each block's average as it is read")
+    p.add_argument("-ignorechan", type=str, default=None)
+    # binary-orbit folding (prepfold.c:878-903 orbit delays)
+    p.add_argument("-bin", dest="binary", action="store_true",
+                   help="Fold a binary pulsar (give all orbit params)")
+    p.add_argument("-pb", type=float, default=0.0,
+                   help="Orbital period (s)")
+    p.add_argument("-x", dest="asinic", type=float, default=0.0,
+                   help="Projected semi-major axis (lt-s)")
+    p.add_argument("-e", dest="ecc", type=float, default=0.0)
+    p.add_argument("-To", type=float, default=0.0,
+                   help="Time of periastron passage (MJD)")
+    p.add_argument("-w", dest="wdeg", type=float, default=0.0,
+                   help="Longitude of periastron (deg)")
+    p.add_argument("-wdot", type=float, default=0.0,
+                   help="Rate of advance of periastron (deg/yr)")
+    # event-list folding (prepfold.c:1012-1067)
+    p.add_argument("-events", action="store_true",
+                   help="Input is an event (TOA) file, not samples")
+    p.add_argument("-days", action="store_true",
+                   help="Events are days since the .inf EPOCH")
+    p.add_argument("-mjds", action="store_true",
+                   help="Events are MJDs")
+    p.add_argument("-double", dest="evdouble", action="store_true",
+                   help="Events are binary float64 (default ASCII)")
+    p.add_argument("-offset", type=float, default=0.0,
+                   help="Time offset to add to the first event")
+    add_raw_flags(p, start_flags=False)
     p.add_argument("infile")
     return p
+
+
+def apply_presets(args):
+    """The -timing/-slow/-fine/-coarse flag interactions
+    (prepfold.c:103-137)."""
+    if args.timing:
+        args.parfile = args.timing
+        args.nosearch = True
+        args.nopsearch = args.nopdsearch = args.nodmsearch = True
+        if args.npart == 64:
+            args.npart = 60
+        args.fine = True
+    if args.slow:
+        args.fine = True
+        if not args.proflen:
+            args.proflen = 100
+    if args.fine:
+        args.ndmfact = 1
+        args.dmstep = 1
+        args.npfact = 1
+        args.pstep = 1
+        args.pdstep = 2
+    elif args.coarse:
+        args.npfact = 4
+        args.pstep = 2 if args.pstep == 1 else 3
+        args.pdstep = 4 if args.pdstep == 2 else 6
+    if args.searchfdd:
+        args.searchpdd = True
+    return args
 
 
 def _fold_params(args, T: float, obs=None):
@@ -111,12 +215,32 @@ def _fold_params(args, T: float, obs=None):
         fd0 = (c.z - c.w / 2.0) / (T * T)
         f0 = (c.r - c.z / 2.0 + c.w / 12.0) / T
         return f0, fd0, fdd
+    if args.psr:
+        from presto_tpu.utils.catalog import default_catalog
+        from presto_tpu.utils.psr import p_to_f
+        pp = default_catalog().params(args.psr)
+        if pp is None:
+            raise SystemExit("prepfold: pulsar %r not in catalog"
+                             % args.psr)
+        if not args.dm:
+            args.dm = pp.dm or 0.0
+        if pp.orb is not None and not args.binary:
+            args.binary = True
+            args.pb = pp.orb.p
+            args.asinic = pp.orb.x
+            args.ecc = pp.orb.e
+            args.wdeg = pp.orb.w
+            args.To = pp.timepoch - pp.orb.t / 86400.0
+        if pp.f:
+            return pp.f, pp.fd, pp.fdd
+        return p_to_f(pp.p, pp.pd, pp.pdd or 0.0)
     if args.f > 0:
         return args.f, args.fd, args.fdd
     if args.p > 0:
         from presto_tpu.utils.psr import p_to_f
         return p_to_f(args.p, args.pd, args.pdd)
-    raise SystemExit("prepfold: give -p, -f, or -accelfile/-accelcand")
+    raise SystemExit("prepfold: give -p, -f, -psr, or "
+                     "-accelfile/-accelcand")
 
 
 def _auto_proflen(p_sec: float, dt: float) -> int:
@@ -129,23 +253,106 @@ def _auto_proflen(p_sec: float, dt: float) -> int:
     return n
 
 
+def _make_cfg(args, proflen, nsub, search_dm):
+    return FoldConfig(proflen=proflen, npart=args.npart, nsub=nsub,
+                      pstep=args.pstep, pdstep=args.pdstep,
+                      dmstep=args.dmstep,
+                      npfact=args.npfact, ndmfact=args.ndmfact,
+                      search_p=not (args.nosearch or args.nopsearch),
+                      search_pd=not (args.nosearch or args.nopdsearch),
+                      search_dm=search_dm,
+                      search_pdd=args.searchpdd)
+
+
+def _orbit_model(args, T, tepoch):
+    """(delays, delaytimes) from the -bin orbit parameters: Roemer
+    delays sampled across the fold span (the dorbint table,
+    prepfold.c:878-903), including secular periastron advance."""
+    if not args.binary:
+        return None, None
+    from presto_tpu.ops.orbit import OrbitParams, orbit_delays
+    if not (args.pb > 0 and args.asinic > 0):
+        raise SystemExit("prepfold -bin: -pb and -x are required")
+    t_since_peri = (tepoch - args.To) * 86400.0 if args.To else 0.0
+    w = args.wdeg
+    if args.wdot:
+        w = w + args.wdot * ((tepoch - args.To) / 365.25)
+    orb = OrbitParams(p=args.pb, e=args.ecc, x=args.asinic, w=w,
+                      t=t_since_peri, wd=args.wdot)
+    delaytimes = np.linspace(0.0, T, 2049)
+    delays = np.asarray(orbit_delays(delaytimes, orb), np.float64)
+    return delays, delaytimes
+
+
+def _slice_fractions(args, N):
+    lo = int(max(args.startT, 0.0) * N)
+    hi = int(min(args.endT, 1.0) * N)
+    return lo, max(hi, lo + 1)
+
+
+def fold_events_file(args, f, fd, fdd):
+    """-events mode: the infile is a TOA/event list."""
+    from presto_tpu.io.infodata import read_inf
+    from presto_tpu.search.prepfold import fold_events
+    base = os.path.splitext(args.infile)[0]
+    try:
+        info = read_inf(base)
+        mjd0 = info.mjd
+        candnm = info.object or "PSR_CAND"
+    except Exception:
+        info, mjd0, candnm = None, 0.0, "PSR_CAND"
+    if args.evdouble:
+        ev = np.fromfile(args.infile, np.float64)
+    else:
+        ev = np.loadtxt(args.infile, usecols=(0,), ndmin=1)
+    if ev.size == 0:
+        raise SystemExit("prepfold -events: no events in %s"
+                         % args.infile)
+    if args.offset:
+        ev = ev + args.offset
+    if args.mjds:
+        ev = (ev - (mjd0 or ev.min())) * 86400.0
+    elif args.days:
+        ev = ev * 86400.0
+    ev = ev - ev.min()
+    T = float(ev.max()) or 1.0
+    lo, hi = args.startT * T, args.endT * T
+    ev = ev[(ev >= lo) & (ev <= hi)] - lo
+    if ev.size == 0:
+        raise SystemExit("prepfold -events: -start/-end window "
+                         "contains no events")
+    T = float(ev.max()) or 1.0
+    proflen = args.proflen or _auto_proflen(1.0 / f, T / 1e6)
+    cfg = _make_cfg(args, proflen, 1, search_dm=False)
+    delays, delaytimes = _orbit_model(args, T, mjd0)
+    res = fold_events(ev, f, fd, fdd, cfg, fold_dm=args.dm,
+                      tepoch=mjd0, phs0=args.phs, T=T,
+                      delays=delays, delaytimes=delaytimes)
+    res.numchan = 1
+    return res, cfg, candnm
+
+
 def fold_dat(args, f, fd, fdd):
     data, info = load_timeseries(args.infile)
     dt = info.dt
+    lo, hi = _slice_fractions(args, data.size)
+    data = data[lo:hi]
+    tepoch = info.mjd + lo * dt / 86400.0
     proflen = args.proflen or _auto_proflen(1.0 / f, dt)
-    cfg = FoldConfig(proflen=proflen, npart=args.npart, nsub=1,
-                     npfact=args.npfact, ndmfact=args.ndmfact,
-                     search_p=not (args.nosearch or args.nopsearch),
-                     search_pd=not (args.nosearch or args.nopdsearch),
-                     search_dm=False)
+    cfg = _make_cfg(args, proflen, 1, search_dm=False)
+    delays, delaytimes = _orbit_model(args, data.size * dt, tepoch)
     res = fold_subband_series(data, dt, f, fd, fdd, cfg,
-                              fold_dm=info.dm, tepoch=info.mjd)
+                              fold_dm=info.dm, tepoch=tepoch,
+                              phs0=args.phs, delays=delays,
+                              delaytimes=delaytimes)
     res.numchan = 1
     return res, cfg, info.object or "PSR_CAND"
 
 
 def fold_raw(args, f, fd, fdd):
-    fb = open_raw([args.infile])
+    from presto_tpu.apps.common import BlockPrep, open_raw_args
+    from presto_tpu.utils.ranges import parse_ranges
+    fb = open_raw_args([args.infile], args)
     hdr = fb.header
     nchan, dt = hdr.nchans, hdr.tsamp
     nsub = min(args.nsub, nchan)
@@ -172,24 +379,19 @@ def fold_raw(args, f, fd, fdd):
                                                           ".stats"))
         except OSError:
             pass
+    ignore = (np.asarray(parse_ranges(args.ignorechan), np.int64)
+              if args.ignorechan else None)
+    prep = BlockPrep(nchan, dt, args, mask=mask,
+                     padvals=padvals if args.mask else None,
+                     ignore=ignore)
 
-    clip_state = None
     prev = None
     chunks = []
     chan_bins_d = jnp.asarray(chan_bins)   # upload the delays once
     nread = 0
     while nread < hdr.N + blocklen:
         if nread < hdr.N:
-            block = fb.read_spectra(nread, blocklen)
-            if mask is not None:
-                n, chans = mask.check_mask(nread * dt, blocklen * dt)
-                if n == -1:
-                    block[:] = padvals[None, :]
-                elif n > 0:
-                    block = mask_block(block, chans, padvals)
-            if args.clip > 0:
-                block, _, clip_state = clip_times(block, args.clip,
-                                                  clip_state)
+            block = prep(fb.read_spectra(nread, blocklen), nread)
         else:
             block = np.zeros((blocklen, nchan), dtype=np.float32)
         cur = jnp.asarray(np.ascontiguousarray(block.T))
@@ -202,19 +404,22 @@ def fold_raw(args, f, fd, fdd):
         nread += blocklen
     series = np.asarray(
         jnp.concatenate(chunks, axis=1)[:, :int(hdr.N) - maxd])
+    lo, hi = _slice_fractions(args, series.shape[1])
+    series = series[:, lo:hi]
+    tepoch = hdr.tstart + lo * dt / 86400.0
 
     proflen = args.proflen or _auto_proflen(1.0 / f, dt)
-    cfg = FoldConfig(proflen=proflen, npart=args.npart, nsub=nsub,
-                     npfact=args.npfact, ndmfact=args.ndmfact,
-                     search_p=not (args.nosearch or args.nopsearch),
-                     search_pd=not (args.nosearch or args.nopdsearch),
-                     search_dm=not (args.nosearch or args.nodmsearch))
+    cfg = _make_cfg(args, proflen, nsub,
+                    search_dm=not (args.nosearch or args.nodmsearch))
     chanpersub = nchan // nsub
     subfreqs = (hdr.lofreq + (np.arange(nsub) + 0.5) * chanpersub
                 * abs(hdr.foff) - 0.5 * abs(hdr.foff))
+    delays, delaytimes = _orbit_model(args, series.shape[1] * dt,
+                                      tepoch)
     res = fold_subband_series(series, dt, f, fd, fdd, cfg,
                               fold_dm=args.dm, subfreqs=subfreqs,
-                              tepoch=hdr.tstart)
+                              tepoch=tepoch, phs0=args.phs,
+                              delays=delays, delaytimes=delaytimes)
     res.lofreq = hdr.lofreq
     res.chan_wid = abs(hdr.foff)
     res.numchan = nchan
@@ -224,16 +429,22 @@ def fold_raw(args, f, fd, fdd):
 
 def run(args):
     ensure_backend()
-    is_dat = args.infile.endswith(".dat")
+    apply_presets(args)
+    is_dat = args.infile.endswith(".dat") or args.events
     # need T to turn accelcand (r, z) into (f, fd): read N*dt cheaply
     if is_dat:
         from presto_tpu.io.infodata import read_inf
-        info = read_inf(args.infile[:-4])
-        T = info.N * info.dt
-        obs = {"mjd": info.mjd, "telescope": info.telescope,
-               "bary": bool(info.bary),
-               "obsfreq": (0.0 if info.bary
-                           else info.freq + 0.5 * info.freqband)}
+        try:
+            info = read_inf(os.path.splitext(args.infile)[0])
+            T = info.N * info.dt
+            obs = {"mjd": info.mjd, "telescope": info.telescope,
+                   "bary": bool(info.bary),
+                   "obsfreq": (0.0 if info.bary
+                               else info.freq + 0.5 * info.freqband)}
+        except Exception:
+            if not args.events:
+                raise
+            T, obs = 1.0, {}
     else:
         from presto_tpu.apps.common import obs_metadata
         fb0 = open_raw([args.infile])
@@ -245,8 +456,15 @@ def run(args):
                * hdr0.nchans}
         fb0.close()
     f, fd, fdd = _fold_params(args, T, obs)
+    if args.pfact != 1.0:        # p *= pfact  =>  f /= pfact
+        f, fd = f / args.pfact, fd / args.pfact
+    if args.ffact != 1.0:
+        f, fd, fdd = (f * args.ffact, fd * args.ffact,
+                      fdd * args.ffact)
 
-    if is_dat:
+    if args.events:
+        res, cfg, candnm = fold_events_file(args, f, fd, fdd)
+    elif is_dat:
         res, cfg, candnm = fold_dat(args, f, fd, fdd)
     else:
         res, cfg, candnm = fold_raw(args, f, fd, fdd)
@@ -295,7 +513,13 @@ def run(args):
                                  res.best_dm, res.best_redchi, pfdnm))
     if not args.noplot:
         from presto_tpu.plotting import plot_pfd
-        plot_pfd(pfd, pfdnm + ".png", best_prof=res.best_prof)
+        from presto_tpu.plotting.pfdplot import PlotFlags
+        flags = PlotFlags(scaleparts=args.scaleparts,
+                          allgrey=args.allgrey,
+                          justprofs=args.justprofs,
+                          fixchi=args.fixchi)
+        plot_pfd(pfd, pfdnm + ".png", best_prof=res.best_prof,
+                 flags=flags)
         print("prepfold: diagnostic plot -> %s.png" % pfdnm)
     return res
 

@@ -209,6 +209,12 @@ def _write_subbands(args, fb, plan, subouts, dms, dt, maxd, Neff,
     subs = np.asarray(jnp.concatenate(subouts, axis=1))  # [nsub, T]
     valid = max(Neff - maxd, 0)
     subs = subs[:, :valid]
+    if plan is not None and plan.diffbins.size:
+        # same bary bin add/remove schedule as the .dat path, applied
+        # to every subband stream so the bary epoch in the sidecar
+        # matches the sample schedule
+        subs = np.stack([plan.apply(subs[s])
+                         for s in range(subs.shape[0])])
     outbase = args.outfile or "prepsubband_out"
     subdm = (args.subdm if args.subdm is not None
              else float(np.mean(dms)))

@@ -58,14 +58,118 @@ def describe(path: str, nsamp: int = 8) -> str:
     return "\n".join(out)
 
 
+# explicit raw-binary display formats (readfile_cmd.cli): flag name(s)
+# -> numpy dtype
+_RAW_FMTS = [
+    (("byte", "b"), np.uint8),
+    (("float", "f"), np.float32),
+    (("double", "d"), np.float64),
+    (("fcomplex", "fc"), np.complex64),
+    (("dcomplex", "dc"), np.complex128),
+    (("short", "s"), np.int16),
+    (("int", "i"), np.int32),
+    (("long", "l"), np.int64),
+]
+
+
+def _dump_raw(path, dtype, index, fortran, pagesize=None):
+    """Hex-free element dump of a raw binary file at an explicit dtype
+    (readfile.c's typed display modes).  -fortran strips the 4-byte
+    record-length markers Fortran unformatted I/O writes."""
+    raw = open(path, "rb").read()
+    if fortran:
+        out = bytearray()
+        i = 0
+        while i + 4 <= len(raw):
+            n = int.from_bytes(raw[i:i + 4], "little")
+            if n <= 0 or i + 8 + n > len(raw):
+                break
+            out += raw[i + 4:i + 4 + n]
+            i += 8 + n
+        raw = bytes(out)
+    d = np.frombuffer(raw, dtype=dtype)
+    lo, hi = index if index else (0, min(len(d), 100))
+    hi = min(hi, len(d))
+    lines = ["--- %s (%s, %d elements) ---"
+             % (path, np.dtype(dtype).name, len(d))]
+    for j in range(lo, hi):
+        lines.append("%8d:  %s" % (j, d[j]))
+    return "\n".join(lines)
+
+
+def _dump_cands(path, kind, index, nph):
+    from presto_tpu.apps.accelsearch import read_cand_file
+    from presto_tpu.search.phasemod import read_bincands
+    lines = ["--- %s (%s candidates) ---" % (path, kind)]
+    cands = (read_cand_file(path) if kind == "rzw"
+             else read_bincands(path))
+    lo, hi = index if index else (0, len(cands))
+    for j, c in enumerate(cands[lo:min(hi, len(cands))], start=lo):
+        lines.append("%4d:  %s" % (j + 1, c))
+    if nph:
+        lines.append("  (nph = %g)" % nph)
+    return "\n".join(lines)
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="readfile")
     p.add_argument("-n", type=int, default=8,
                    help="Samples/spectra to show")
+    p.add_argument("-page", action="store_true",
+                   help="Paginate the output (accepted; output is "
+                        "printed whole here)")
+    for names, _dt in _RAW_FMTS:
+        grp = ["-" + nm for nm in names]
+        p.add_argument(*grp, dest="fmt_" + names[0],
+                       action="store_true",
+                       help="Raw data in %s format" % names[0])
+    p.add_argument("-rzwcand", "-rzw", dest="rzwcand",
+                   action="store_true",
+                   help="File holds rzw/accel search candidates")
+    p.add_argument("-bincand", "-bin", dest="bincand",
+                   action="store_true",
+                   help="File holds bin search candidates")
+    p.add_argument("-position", "-pos", dest="position",
+                   action="store_true",
+                   help="File holds position structs (legacy; shown "
+                        "as float64 triples)")
+    p.add_argument("-filterbank", action="store_true",
+                   help="Raw data in SIGPROC filterbank format")
+    p.add_argument("-psrfits", action="store_true",
+                   help="Raw data in PSRFITS format")
+    p.add_argument("-fortran", action="store_true",
+                   help="Raw data was written by a Fortran program")
+    p.add_argument("-index", type=int, nargs=2, default=None,
+                   metavar=("LO", "HI"),
+                   help="The range of objects to display")
+    p.add_argument("-nph", type=float, default=0.0,
+                   help="0th FFT bin amplitude (for RZW data)")
     p.add_argument("files", nargs="+")
     args = p.parse_args(argv)
+    idx = tuple(args.index) if args.index else None
     for f in args.files:
-        print(describe(f, args.n))
+        fmt = next((dt for names, dt in _RAW_FMTS
+                    if getattr(args, "fmt_" + names[0])), None)
+        if args.rzwcand:
+            print(_dump_cands(f, "rzw", idx, args.nph))
+        elif args.bincand:
+            print(_dump_cands(f, "bin", idx, args.nph))
+        elif args.position:
+            print(_dump_raw(f, np.float64, idx, args.fortran))
+        elif fmt is not None:
+            print(_dump_raw(f, fmt, idx, args.fortran))
+        elif args.filterbank or args.psrfits:
+            from presto_tpu.apps.common import open_raw_args
+            fb = open_raw_args([f], args)
+            h = fb.header
+            lines = ["--- %s (forced format) ---" % f]
+            for k in ("source_name", "nchans", "nbits", "tsamp",
+                      "tstart", "N"):
+                lines.append("  %-12s = %s" % (k, getattr(h, k, "?")))
+            fb.close()
+            print("\n".join(lines))
+        else:
+            print(describe(f, args.n))
     return 0
 
 

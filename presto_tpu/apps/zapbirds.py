@@ -42,6 +42,10 @@ def build_parser():
                    help="Zap the birds in the FFT from 'zapfile'")
     p.add_argument("-zapfile", type=str, default=None,
                    help="File of freqs/widths (Hz) to zap (with -zap)")
+    p.add_argument("-defaultbirds", action="store_true",
+                   help="With -zap and no -zapfile: use the shipped "
+                        "default birdie list (power-mains harmonics, "
+                        "the lib/parkes_birds.txt analog)")
     p.add_argument("-in", dest="inzapfile", type=str, default=None,
                    help="File of freqs (Hz) and # harmonics to measure")
     p.add_argument("-out", dest="outzapfile", type=str, default=None,
@@ -163,8 +167,12 @@ def main(argv=None):
     if not args.zap and not (args.inzapfile and args.outzapfile):
         raise SystemExit("zapbirds: need -zap -zapfile F, or -in F -out G")
     if args.zap:
+        if not args.zapfile and args.defaultbirds:
+            from presto_tpu.utils.catalog import default_birds_path
+            args.zapfile = default_birds_path()
         if not args.zapfile:
-            raise SystemExit("zapbirds: -zap requires -zapfile")
+            raise SystemExit("zapbirds: -zap requires -zapfile "
+                             "(or -defaultbirds)")
         nz = zap_fft_file(args.infile, args.zapfile, args.baryv)
         print("zapbirds: zapped %d ranges in %s" % (nz, args.infile))
     else:

@@ -32,6 +32,17 @@ HARM_POW_CUTOFF = 8.0
 
 DM_RE = re.compile(r"DM(\d+\.\d{2})")
 
+
+def default_known_birds_f() -> List[Tuple[float, float]]:
+    """(freq, err) pairs from the shipped default birdie list
+    (power-mains harmonics) for default_rejection."""
+    from presto_tpu.ops.rednoise import read_birds_bary
+    from presto_tpu.utils.catalog import default_birds_path
+    path = default_birds_path()
+    if not path:
+        return []
+    return [(f, w) for (f, w, _b) in read_birds_bary(path)]
+
 HARM_RATIOS = [(3, 2), (5, 2), (2, 3), (4, 3), (5, 3),
                (3, 4), (5, 4), (2, 5), (3, 5), (4, 5)]
 
@@ -199,7 +210,11 @@ class Candlist:
                 c.note = "dominated by harmonic %d" % (maxharm + 1)
                 self._mark_bad(i, "rogueharmpow")
 
-    def default_rejection(self, known_birds_f=(), known_birds_p=()):
+    def default_rejection(self, known_birds_f=None, known_birds_p=()):
+        if known_birds_f is None:
+            # the shipped mains-harmonic birdie list (zapbirds'
+            # -defaultbirds analog for sifting); pass () to disable
+            known_birds_f = default_known_birds_f()
         self.reject_longperiod()
         self.reject_shortperiod()
         self.reject_knownbirds(known_birds_f, known_birds_p)
@@ -352,7 +367,7 @@ def candlist_from_accelfile(filename: str) -> Candlist:
 
 def read_candidates(filenames: Sequence[str],
                     prelim_reject: bool = True,
-                    known_birds_f=(), known_birds_p=()) -> Candlist:
+                    known_birds_f=None, known_birds_p=()) -> Candlist:
     """Aggregate candidates over many DM trials
     (sifting.py:1203-1230)."""
     out = Candlist()
@@ -366,7 +381,7 @@ def read_candidates(filenames: Sequence[str],
 
 def sift_candidates(filenames: Sequence[str], numdms_min: int = 2,
                     low_DM_cutoff: float = 2.0,
-                    known_birds_f=(), known_birds_p=(),
+                    known_birds_f=None, known_birds_p=(),
                     r_err: float = R_ERR) -> Candlist:
     """The ACCEL_sift.py recipe (python/ACCEL_sift.py:40-76):
     read -> reject -> dedup across DMs -> DM checks -> harmonics."""

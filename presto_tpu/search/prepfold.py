@@ -72,6 +72,9 @@ class FoldConfig:
     search_p: bool = True
     search_pd: bool = True
     search_dm: bool = True
+    search_pdd: bool = False  # add the p-dotdot axis (-searchpdd;
+                              # same trial ladder as pd,
+                              # prepfold.c:1486-1502)
 
 
 @dataclass
@@ -100,6 +103,9 @@ class FoldResult:
     best_dm: float = 0.0
     best_f: float = 0.0
     best_fd: float = 0.0
+    best_fdd: float = 0.0
+    fdds: np.ndarray = field(default_factory=lambda: np.zeros(1))
+    fdd_chi2: np.ndarray = field(default_factory=lambda: np.zeros(1))
     best_prof: Optional[np.ndarray] = None
     best_redchi: float = 0.0
 
@@ -138,18 +144,25 @@ def fold_subband_series(series: np.ndarray, dt: float, f: float,
                         cfg: Optional[FoldConfig] = None,
                         fold_dm: float = 0.0,
                         subfreqs: Optional[np.ndarray] = None,
-                        tepoch: float = 0.0) -> FoldResult:
+                        tepoch: float = 0.0, phs0: float = 0.0,
+                        delays: Optional[np.ndarray] = None,
+                        delaytimes: Optional[np.ndarray] = None
+                        ) -> FoldResult:
     """Fold [nsub, N] (or [N] -> nsub=1) subband series into the cube.
 
     The phase model is evaluated once (all subbands share it); each
     (part, sub) profile's foldstats mirror the reference's per-fold
-    bookkeeping (prepfold.c:1376-1394).
+    bookkeeping (prepfold.c:1376-1394).  phs0 offsets the profile
+    (-phs); delays/delaytimes inject extra time delays (seconds,
+    piecewise linear — the binary-orbit folding path, prepfold.c's
+    orbit delay array from dorbint, :878-903).
     """
     cfg = cfg or FoldConfig()
     arr = np.atleast_2d(np.asarray(series, dtype=np.float32))
     nsub, N = arr.shape
-    plan = fo.plan_fold(N, dt, f, fd, fdd, phs0=0.0,
-                        proflen=cfg.proflen, npart=cfg.npart)
+    plan = fo.plan_fold(N, dt, f, fd, fdd, phs0=phs0,
+                        proflen=cfg.proflen, npart=cfg.npart,
+                        delays=delays, delaytimes=delaytimes)
     cube = fo.fold_data(arr, plan)            # [npart, nsub, L]
     # occupancy correction: when the fold frequency resonates with the
     # sample grid (samples/period near an integer multiple of proflen),
@@ -176,6 +189,46 @@ def fold_subband_series(series: np.ndarray, dt: float, f: float,
                       tepoch=tepoch, subfreqs=subfreqs,
                       data_avg=float(arr.mean()),
                       data_var=float(arr.var()))
+
+
+def fold_events(events_sec: np.ndarray, f: float, fd: float = 0.0,
+                fdd: float = 0.0, cfg: Optional[FoldConfig] = None,
+                fold_dm: float = 0.0, tepoch: float = 0.0,
+                phs0: float = 0.0, T: Optional[float] = None,
+                delays: Optional[np.ndarray] = None,
+                delaytimes: Optional[np.ndarray] = None) -> FoldResult:
+    """Fold an EVENT list (photon arrival times, seconds from tepoch)
+    — the reference's -events mode (prepfold.c:1012-1067: phase per
+    event from the (f, fd, fdd) polynomial, histogrammed).
+
+    Poisson statistics: per-(part, bin) expectation is the part's event
+    rate, variance equal to the mean, so the same chi2 search applies.
+    """
+    cfg = cfg or FoldConfig()
+    ev = np.sort(np.asarray(events_sec, np.float64))
+    if T is None:
+        T = float(ev[-1]) if ev.size else 1.0
+    if delays is not None:
+        ev = ev - np.interp(ev, delaytimes, delays)
+    phases = fo.fold_phase(ev, f, fd, fdd, phs0)
+    L, npart = cfg.proflen, cfg.npart
+    bins = (np.floor(phases * L).astype(np.int64)) % L
+    parts = np.minimum((ev / (T / npart)).astype(np.int64), npart - 1)
+    cube = np.zeros((npart, 1, L))
+    np.add.at(cube, (parts, 0, bins), 1.0)
+    stats = np.zeros((npart, 1, 7))
+    part_T = T / npart
+    for p in range(npart):
+        n = float(cube[p, 0].sum())
+        # pseudo numdata: one "sample" per profile bin per part keeps
+        # part_mid_times uniform; avg=var=n/L is the Poisson rate
+        stats[p, 0] = (L, n / L, max(n / L, 1e-10), 0, 0, 0, 0)
+    res = FoldResult(cube=cube, stats=stats, fold_f=f, fold_fd=fd,
+                     fold_fdd=fdd, fold_dm=fold_dm, dt=part_T / L,
+                     T=T, tepoch=tepoch,
+                     data_avg=float(ev.size) / (npart * L),
+                     data_var=max(float(ev.size) / (npart * L), 1e-10))
+    return res
 
 
 # ----------------------------------------------------------------------
@@ -235,30 +288,45 @@ def search_fold(res: FoldResult, cfg: Optional[FoldConfig] = None
     else:
         ddprofs = res.cube[:, 0, :]
 
-    # ---- stage 2: (f, fd) --------------------------------------------
+    # ---- stage 2: (f, fd[, fdd]) -------------------------------------
     nf = 2 * L * cfg.npfact + 1 if cfg.search_p else 1
     nfd = 2 * L * cfg.npfact + 1 if cfg.search_pd else 1
+    nfdd = 2 * L * cfg.npfact + 1 if cfg.search_pdd else 1
     df = cfg.pstep / (L * res.T)
     dfd = cfg.pdstep * 2.0 / (L * res.T * res.T)
+    # pdd trials reuse the pd step ladder (phasedelay2fdotdot,
+    # prepfold.c:1486: fdotdots[ii] from the same dtmp), so one bin of
+    # end-of-obs phase delay per pdstep: dfdd = 6*dphase/T^3
+    dfdd = cfg.pdstep * 6.0 / (L * res.T ** 3)
     fs = (np.arange(nf) - nf // 2) * df            # offsets from fold_f
     fds = (np.arange(nfd) - nfd // 2) * dfd
-    # phase shift of part p for trial (df, dfd):
-    #   dphi(t_p) = df*t_p + dfd*t_p^2/2 (turns) -> bins
+    fdds = (np.arange(nfdd) - nfdd // 2) * dfdd
+    # phase shift of part p for trial (df, dfd, dfdd):
+    #   dphi(t_p) = df*t_p + dfd*t_p^2/2 + dfdd*t_p^3/6 (turns) -> bins
     # A signal offset by (df_s, dfd_s) from the fold values drifts the
     # pulse by -dphi_s(t); the ALIGNING trial is the negative of the
     # signal offset, so the reported best model is fold - trial
     # (pinned empirically in tests/test_fold.py).
-    off = (fs[:, None, None] * tmid[None, None, :]
-           + 0.5 * fds[None, :, None] * tmid[None, None, :] ** 2) * L
-    trial_shifts = off.reshape(nf * nfd, npart)
-    chi2 = np.asarray(_trial_chi2(
-        jnp.asarray(ddprofs, jnp.float32),
-        jnp.asarray(trial_shifts, jnp.float32),
-        prof_avg, prof_var)).reshape(nf, nfd)
-    bi, bj = np.unravel_index(np.argmax(chi2), chi2.shape)
+    ddprofs_dev = jnp.asarray(ddprofs, jnp.float32)
+    off2 = (fs[:, None, None] * tmid[None, None, :]
+            + 0.5 * fds[None, :, None] * tmid[None, None, :] ** 2) * L
+    # fdd axis looped on host: the full [nf, nfd, nfdd, npart] shift
+    # tensor would not fit memory at default trial counts
+    chi2_cube = np.empty((nf, nfd, nfdd), np.float64)
+    for k in range(nfdd):
+        off = off2 + (fdds[k] * tmid[None, None, :] ** 3 / 6.0) * L
+        chi2_cube[:, :, k] = np.asarray(_trial_chi2(
+            ddprofs_dev,
+            jnp.asarray(off.reshape(nf * nfd, npart), jnp.float32),
+            prof_avg, prof_var)).reshape(nf, nfd)
+    bi, bj, bk = np.unravel_index(np.argmax(chi2_cube), chi2_cube.shape)
     res.best_f = res.fold_f - float(fs[bi])
     res.best_fd = res.fold_fd - float(fds[bj])
-    res.ppd_chi2 = chi2
+    res.best_fdd = res.fold_fdd - float(fdds[bk])
+    res.fdds = res.fold_fdd - fdds
+    res.fdd_chi2 = chi2_cube[bi, bj, :]
+    res.ppd_chi2 = chi2_cube[:, :, bk]
+    off = off2 + (fdds[bk] * tmid[None, None, :] ** 3 / 6.0) * L
     # ascending AND index-matched with ppd_chi2 rows: row i's model
     # period is 1/(fold_f - fs[i])
     res.periods = 1.0 / (res.fold_f - fs) if cfg.search_p \

@@ -222,15 +222,65 @@ def parse_atnf_catalog(path: str) -> List[dict]:
     return records
 
 
+def parse_compact_catalog(path: str) -> List[dict]:
+    """Parse the shipped compact TSV catalog
+    (presto_tpu/data/pulsars.psrcat, written by tools/make_catalog.py:
+    header line naming the fields, '*' for missing)."""
+    records = []
+    fields = None
+    with open(path) as fh:
+        for line in fh:
+            if line.startswith("#"):
+                if "\t" in line:       # the field-name header
+                    fields = line[1:].split()
+                continue
+            if not line.strip() or fields is None:
+                continue
+            rec = {}
+            for k, tok in zip(fields, line.rstrip("\n").split("\t")):
+                if tok == "*" or not tok:
+                    continue
+                if k in ("bname", "jname", "raj", "decj"):
+                    rec[k] = tok
+                else:
+                    try:
+                        rec[k] = float(tok)
+                    except ValueError:
+                        pass
+            if rec.get("jname") or rec.get("bname"):
+                records.append(rec)
+    return records
+
+
+def shipped_catalog_path() -> Optional[str]:
+    """The catalog file shipped with the package (the lib/pulsars.cat
+    analog, src/database.c:676), or None if absent."""
+    p = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "data", "pulsars.psrcat")
+    return p if os.path.exists(p) else None
+
+
+def default_birds_path() -> Optional[str]:
+    """The shipped default birdie list (the lib/parkes_birds.txt
+    analog): power-mains harmonics."""
+    p = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "data", "default_birds.txt")
+    return p if os.path.exists(p) else None
+
+
 _default: Optional[Catalog] = None
 
 
 def default_catalog() -> Catalog:
-    """The built-in mini catalog, extended by $PRESTO_TPU_CATALOG
-    (path to an ATNF text export) when set."""
+    """The shipped ~1000-pulsar catalog (+ builtin mini list),
+    extended by $PRESTO_TPU_CATALOG (path to an ATNF text export)
+    when set."""
     global _default
     if _default is None:
         records = list(_BUILTIN)
+        shipped = shipped_catalog_path()
+        if shipped:
+            records = records + parse_compact_catalog(shipped)
         path = os.environ.get("PRESTO_TPU_CATALOG")
         if path and os.path.exists(path):
             records = parse_atnf_catalog(path) + records

@@ -1,0 +1,25 @@
+"""Enforce CLI flag parity against the reference clig specs.
+
+tools/flag_parity.py mechanically diffs every app's --help against its
+clig/*.cli spec; this test requires ZERO non-waived missing flags (the
+state docs/FLAG_PARITY.md documents).  Skipped when the reference tree
+is not mounted.
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.mark.slow
+def test_no_missing_flags():
+    if not os.path.isdir("/root/reference/clig"):
+        pytest.skip("reference tree not mounted")
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "flag_parity.py")],
+        capture_output=True, text=True, timeout=1200, cwd=REPO)
+    assert r.returncode == 0, r.stdout[-2000:] + r.stderr[-500:]

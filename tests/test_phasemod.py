@@ -103,3 +103,29 @@ def test_bincand_file_roundtrip(tmp_path):
     assert back[0].mini_r == pytest.approx(16.4)
     assert back[0].mini_sigma == pytest.approx(7.7)
     assert "500" in rawbin_report(back)
+
+
+def test_plotbincand_cli(tmp_path):
+    """plotbincand renders the 3-panel figure from a search_bin .cand
+    (src/plotbincand.c rebuild)."""
+    import os
+    from presto_tpu.apps.plotbincand import main as pbc_main
+    from presto_tpu.io import datfft
+    from presto_tpu.io.infodata import InfoData, write_inf
+
+    fft, N, dt = make_binary_spectrum()
+    cfg = PhaseModConfig(ncand=5, minfft=1024, maxfft=8192, harmsum=3)
+    cands = search_phasemod(fft, N, dt, cfg)
+    assert cands
+    old = os.getcwd()
+    os.chdir(tmp_path)
+    try:
+        datfft.write_fft("bt.fft", fft)
+        write_inf(InfoData(name="bt", dt=dt, N=N), "bt.inf")
+        write_bincands("bt_bin3.cand", cands)
+        assert pbc_main(["bt", "1"]) == 0
+        assert os.path.exists("bt_bin_cand_1.png")
+        assert pbc_main(["bt", "1", "-o", "z.png"]) == 0
+        assert os.path.exists("z.png")
+    finally:
+        os.chdir(old)

@@ -1,0 +1,122 @@
+"""Generate the shipped pulsar catalog + default birds list.
+
+Extracts FACTUAL astronomical data (pulsar names, positions, spin and
+orbital parameters — the public ATNF pulsar catalogue, Manchester et
+al. 2005, AJ 129, 1993) from the reference tree's vendored text export
+and writes presto_tpu/data/pulsars.psrcat in this framework's own
+compact TSV layout.  Selection: every pulsar with a measured flux
+(S400/S1400 — the ones bright enough to matter for zap lists and
+candidate identification) plus every binary, capped at ~1000 rows by
+descending 1400-MHz flux.
+
+Also writes presto_tpu/data/default_birds.txt: power-mains harmonics
+(50 Hz and 60 Hz ladders — the universal terrestrial birdies) in the
+zapbirds format.
+
+Run from the repo root when the reference tree is mounted:
+    python tools/make_catalog.py
+The generated files are committed; this tool only needs re-running to
+refresh them.
+"""
+
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+REF = "/root/reference/lib/psr_catalog.txt"
+
+FIELDS = ["bname", "jname", "raj", "decj", "p0", "p1", "f2", "pepoch",
+          "dm", "pb", "a1", "om", "ecc", "t0", "s1400"]
+
+
+def main():
+    from presto_tpu.utils.catalog import _ERR_PARAMS, _PARAMS
+
+    # reuse the ATNF parser but also capture the flux columns
+    records = []
+    with open(REF) as fh:
+        for line in fh:
+            if not line.strip() or line.startswith(("#", "-")):
+                continue
+            parts = line.split()[1:]
+            vals = {}
+            pi = 0
+            for param in _PARAMS:
+                if pi >= len(parts):
+                    break
+                tok = parts[pi]
+                if tok != "*":
+                    vals[param] = tok
+                pi += 1
+                if param in _ERR_PARAMS:
+                    pi += 1
+            rec = {}
+            name = vals.get("NAME", "")
+            if name.startswith("B"):
+                rec["bname"] = name
+            if "PSRJ" in vals:
+                rec["jname"] = vals["PSRJ"]
+            for src, dst in (("RAJ", "raj"), ("DECJ", "decj")):
+                if src in vals:
+                    rec[dst] = vals[src]
+            for src, dst in (("P0", "p0"), ("P1", "p1"), ("F2", "f2"),
+                             ("PEPOCH", "pepoch"), ("DM", "dm"),
+                             ("PB", "pb"), ("A1", "a1"), ("OM", "om"),
+                             ("ECC", "ecc"), ("T0", "t0"),
+                             ("TASC", "tasc"), ("EPS1", "eps1"),
+                             ("EPS2", "eps2"),
+                             ("S400", "s400"), ("S1400", "s1400")):
+                if src in vals:
+                    try:
+                        rec[dst] = float(vals[src])
+                    except ValueError:
+                        pass
+            if "tasc" in rec and "t0" not in rec:
+                from presto_tpu.ops.orbit import ell1_to_keplerian
+                ecc, om, t0 = ell1_to_keplerian(
+                    rec.get("eps1", 0.0), rec.get("eps2", 0.0),
+                    rec["tasc"], rec.get("pb", 0.0))
+                rec["ecc"], rec["om"] = ecc, om
+                if rec.get("pb"):
+                    rec["t0"] = t0
+            if (rec.get("jname") or rec.get("bname")) and \
+                    rec.get("p0") and rec.get("raj") and rec.get("decj"):
+                records.append(rec)
+
+    keep = [r for r in records
+            if r.get("s1400") or r.get("s400") or r.get("pb")]
+    keep.sort(key=lambda r: -(r.get("s1400") or 0.0))
+    keep = keep[:1000]
+    keep.sort(key=lambda r: r.get("jname") or r.get("bname"))
+
+    outdir = os.path.join(REPO, "presto_tpu", "data")
+    os.makedirs(outdir, exist_ok=True)
+    out = os.path.join(outdir, "pulsars.psrcat")
+    with open(out, "w") as f:
+        f.write("# presto_tpu pulsar catalog (compact TSV)\n"
+                "# Factual data from the public ATNF pulsar catalogue "
+                "(Manchester et al. 2005, AJ 129, 1993).\n"
+                "# Selection: measured flux or binary; see "
+                "tools/make_catalog.py.\n"
+                "# " + "\t".join(FIELDS) + "\n")
+        for r in keep:
+            f.write("\t".join(
+                ("%s" % r[k]) if k in r else "*"
+                for k in FIELDS) + "\n")
+    print("wrote %s (%d pulsars)" % (out, len(keep)))
+
+    birds = os.path.join(outdir, "default_birds.txt")
+    with open(birds, "w") as f:
+        f.write("# Default birdie list: power-mains harmonics (50 Hz "
+                "and 60 Hz ladders).\n"
+                "# Frequency (Hz)   Width (Hz)   [leading B = already "
+                "barycentric]\n")
+        for base in (50.0, 60.0):
+            for h in range(1, 21):
+                f.write("%14.6f   %8.4f\n" % (base * h, 0.06 * h))
+    print("wrote %s" % birds)
+
+
+if __name__ == "__main__":
+    main()
