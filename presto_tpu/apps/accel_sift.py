@@ -23,6 +23,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("-o", "--out", default="cands_sifted.txt")
     p.add_argument("--min-dm-hits", type=int, default=2)
     p.add_argument("--low-dm-cutoff", type=float, default=2.0)
+    p.add_argument("-defaultbirds", action="store_true",
+                   help="Also reject candidates at the shipped "
+                        "mains-harmonic birdie frequencies")
     p.add_argument("files", nargs="*")
     return p
 
@@ -34,7 +37,12 @@ def run(args):
     if not files:
         print("ACCEL_sift: no candidate files match")
         return None
+    birds = ()
+    if args.defaultbirds:
+        from presto_tpu.pipeline.sifting import default_known_birds_f
+        birds = default_known_birds_f()
     cl = sift_candidates(files, numdms_min=args.min_dm_hits,
+                         known_birds_f=birds,
                          low_DM_cutoff=args.low_dm_cutoff)
     cl.to_file(args.out)
     nbad = sum(len(v) for v in cl.badcands.values())

@@ -86,9 +86,15 @@ def _trial_from_args(args, base, info):
         return ppsr, OrbitParams(p=porb, x=x, e=args.e, w=args.w,
                                  t=args.t)
     if args.psr:
-        from presto_tpu.utils.catalog import default_catalog
-        pp = default_catalog().params(args.psr)
-        if pp is None or pp.orb is None:
+        from presto_tpu.utils.catalog import psrepoch
+        epoch = (info.mjd if info is not None else 51000.0)
+        try:
+            # advanced to the obs epoch: orb.p in SECONDS, orb.t in
+            # seconds since periastron — the optimizer's units
+            pp = psrepoch(args.psr, epoch)
+        except KeyError:
+            raise SystemExit("bincand: %r not in catalog" % args.psr)
+        if pp.orb is None or not pp.orb.p:
             raise SystemExit("bincand: %r not a catalog binary"
                              % args.psr)
         return (args.ppsr or pp.p), pp.orb

@@ -23,7 +23,7 @@ ZOOMFACT = 10
 ZOOMNEIGHBORS = 20
 
 
-def _minifft_norm_powers(powers: np.ndarray):
+def _minifft_norm_powers(powers: np.ndarray, numsumpow: int = 1):
     """realfft of a power series, normalized like plotbincand.c:
     norm = sqrt(n * numsumpow) / DC; returns (complex minifft, norm,
     locpow)."""
@@ -31,7 +31,7 @@ def _minifft_norm_powers(powers: np.ndarray):
     mf = np.fft.rfft(powers)[:n // 2]
     dc = mf[0].real or 1.0
     locpow = dc / n
-    norm = np.sqrt(float(n)) / dc
+    norm = np.sqrt(float(n) * numsumpow) / dc
     mf = mf * norm
     mf[0] = 1.0 + 1.0j
     return mf, norm, locpow
@@ -92,9 +92,10 @@ def main(argv=None) -> int:
     powers = (seg.real.astype(np.float64) ** 2
               + seg.imag.astype(np.float64) ** 2)
     powers = prune_powers(powers, args.numsumpow)
-    mf, norm, locpow = _minifft_norm_powers(powers)
+    mf, norm, locpow = _minifft_norm_powers(powers, args.numsumpow)
     mfpow = np.abs(mf) ** 2
-    rs, zoom = _interp_zoom(mf, c.mini_r / 2.0)
+    # c.mini_r is already in rfft-bin units of this miniFFT
+    rs, zoom = _interp_zoom(mf, c.mini_r)
 
     print("Binary candidate %d of %s:" % (args.candnum, candfile))
     print("  P_psr ~ %.9g s   P_orb ~ %.6g s   sigma = %.2f"
@@ -111,12 +112,14 @@ def main(argv=None) -> int:
     axes[0].set_xlabel("Pulsar Frequency (Hz)")
     axes[0].set_ylabel("Power / Local Power")
     axes[0].set_title("Spectrum region (outliers pruned)")
-    periods = T / np.maximum(np.arange(1, mfpow.size), 1)
+    # miniFFT bin k <-> orbital period T * k / mini_N (phasemod.py's
+    # orb_p = full_T * mini_r / mini_N), period GROWING with bin
+    periods = T * np.arange(1, mfpow.size) / float(nfft)
     axes[1].semilogx(periods, mfpow[1:], "k-", lw=0.5)
     axes[1].set_xlabel("Binary Period (s)")
     axes[1].set_ylabel("Normalized Power")
     axes[1].set_title("miniFFT")
-    axes[2].plot(T / np.maximum(rs, 1e-9), zoom, "k-")
+    axes[2].plot(T * rs / float(nfft), zoom, "k-")
     axes[2].set_xlabel("Binary Period (s)")
     axes[2].set_ylabel("Normalized Power")
     axes[2].set_title("Candidate peak (%dx interpolation)" % ZOOMFACT)

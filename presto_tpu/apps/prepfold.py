@@ -216,21 +216,32 @@ def _fold_params(args, T: float, obs=None):
         f0 = (c.r - c.z / 2.0 + c.w / 12.0) / T
         return f0, fd0, fdd
     if args.psr:
-        from presto_tpu.utils.catalog import default_catalog
+        from presto_tpu.utils.catalog import psrepoch
         from presto_tpu.utils.psr import p_to_f
-        pp = default_catalog().params(args.psr)
-        if pp is None:
+        obs = obs or {}
+        epoch = obs.get("mjd", 0.0)
+        if not epoch or epoch <= 0:      # .inf convention: -1 unknown
+            print("prepfold -psr: WARNING no valid epoch in the input "
+                  "metadata; using the catalog timepoch (orbital phase "
+                  "of binaries will be wrong)")
+            epoch = 51000.0
+        try:
+            # catalog params advanced to the obs epoch: spin by its
+            # derivatives, orb.p to SECONDS, orb.t to seconds since
+            # the last periastron (get_psr_at_epoch semantics)
+            pp = psrepoch(args.psr, epoch)
+        except (KeyError, ValueError):
             raise SystemExit("prepfold: pulsar %r not in catalog"
                              % args.psr)
         if not args.dm:
             args.dm = pp.dm or 0.0
-        if pp.orb is not None and not args.binary:
+        if pp.orb is not None and pp.orb.p and not args.binary:
             args.binary = True
-            args.pb = pp.orb.p
+            args.pb = pp.orb.p              # seconds after psrepoch
             args.asinic = pp.orb.x
             args.ecc = pp.orb.e
             args.wdeg = pp.orb.w
-            args.To = pp.timepoch - pp.orb.t / 86400.0
+            args.To = epoch - pp.orb.t / 86400.0
         if pp.f:
             return pp.f, pp.fd, pp.fdd
         return p_to_f(pp.p, pp.pd, pp.pdd or 0.0)

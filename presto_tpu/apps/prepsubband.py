@@ -215,6 +215,7 @@ def _write_subbands(args, fb, plan, subouts, dms, dt, maxd, Neff,
         # matches the sample schedule
         subs = np.stack([plan.apply(subs[s])
                          for s in range(subs.shape[0])])
+        valid = subs.shape[1]     # diffbins changed the sample count
     outbase = args.outfile or "prepsubband_out"
     subdm = (args.subdm if args.subdm is not None
              else float(np.mean(dms)))

@@ -228,8 +228,12 @@ class PsrfitsFile:
         if self._offs_sub_zero:
             return m.start_spec + row * self.nsblk
         offs_sub = float(sub.read_col("OFFS_SUB", row)[0])
-        return m.start_spec + int(round(
-            (offs_sub - (m.start_subint + 0.5) * tsub) / self.dt))
+        rel = (offs_sub - (m.start_subint + 0.5) * tsub) / self.dt
+        # snap to the row grid: the reference counts dropped blocks as
+        # round(OFFS_SUB gap / TSUBINT) (psrfits.c:741-768), so
+        # OFFS_SUB rounding drift (fractions of a row) must NOT
+        # scatter rows off the nsblk grid and leave phantom pad gaps
+        return m.start_spec + self.nsblk * int(round(rel / self.nsblk))
 
     def _row_start_spec(self, fi: int, row: int) -> int:
         if hasattr(self, "_row_specs"):
@@ -252,7 +256,10 @@ class PsrfitsFile:
                            row: int) -> Optional[np.ndarray]:
         """Fused C++ subint decode (csrc/native_io.cpp pt_decode_subint);
         None when the native library or this geometry is unsupported
-        (16/32-bit stays on the NumPy path)."""
+        (16/32-bit stays on the NumPy path).  Set `_use_native = False`
+        on the instance to force the NumPy path (parity tests)."""
+        if not getattr(self, "_use_native", True):
+            return None
         if not native.can_decode_subint(self.npol, self.nchan,
                                         self.nbits):
             return None
@@ -361,13 +368,17 @@ def write_psrfits(path: str, data: np.ndarray, dt: float,
                   weights: Optional[np.ndarray] = None,
                   zero_off: float = 0.0,
                   drop_rows: Sequence[int] = (),
+                  offs_jitter: float = 0.0,
                   src_name: str = "FAKE") -> None:
     """Write a SEARCH-mode PSRFITS file.
 
     data: [nspectra, nchan] float (will be quantized to nbits);
     freqs: [nchan] channel centers (MHz), ascending or descending;
     drop_rows: subint indices to OMIT (their OFFS_SUB gap simulates
-    dropped blocks, the psrfits.c:741-768 test case).
+    dropped blocks, the psrfits.c:741-768 test case);
+    offs_jitter: deterministic alternating OFFS_SUB error in SAMPLES
+    (real backends accumulate rounding drift; readers must snap to the
+    row grid rather than see phantom gaps).
     """
     nspec, nchan = data.shape
     nsub = (nspec + nsblk - 1) // nsblk
@@ -413,9 +424,10 @@ def write_psrfits(path: str, data: np.ndarray, dt: float,
                 samples = np.packbits(flat).tobytes()
             else:
                 raise ValueError(nbits)
+        jit = offs_jitter * dt * (1 if isub % 2 else -1)
         rows.append({
             "TSUBINT": np.float64(tsub),
-            "OFFS_SUB": np.float64((isub + 0.5) * tsub),
+            "OFFS_SUB": np.float64((isub + 0.5) * tsub + jit),
             "DAT_FREQ": np.asarray(freqs, np.float64),
             "DAT_WTS": np.asarray(weights, np.float32),
             "DAT_OFFS": np.asarray(offsets, np.float32),

@@ -66,6 +66,13 @@ def _split_n(n: int) -> tuple[int, int]:
     r = int(np.sqrt(n))
     while r > 1 and n % r:
         r -= 1
+    if r == 1 and n > (1 << 22):
+        import warnings
+        warnings.warn(
+            "out-of-core FFT of prime length %d degenerates to one "
+            "full-length in-memory FFT (~%d MB resident) — pad to a "
+            "factorable length (choose_N) to keep it streaming" %
+            (n, 16 * n >> 20), RuntimeWarning, stacklevel=3)
     return r, n // r
 
 

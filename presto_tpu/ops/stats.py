@@ -23,9 +23,14 @@ def extended_equiv_gaussian_sigma(logp):
     Parity: characteristics.c:396-415."""
     logp = np.asarray(logp, dtype=np.float64)
     t = np.sqrt(-2.0 * logp)
-    num = 2.515517 + t * (0.802853 + t * 0.010328)
-    denom = 1.0 + t * (1.432788 + t * (0.189269 + t * 0.001308))
-    return t - num / denom
+    # logp = -inf (p underflowed to 0) gives t = inf and an inf/inf
+    # division below; the sigma is then simply t (the correction term
+    # tends to a constant) — guard instead of warning
+    with np.errstate(invalid="ignore"):
+        num = 2.515517 + t * (0.802853 + t * 0.010328)
+        denom = 1.0 + t * (1.432788 + t * (0.189269 + t * 0.001308))
+        out = t - num / denom
+    return np.where(np.isinf(t), t, out)
 
 
 def log_asymtotic_incomplete_gamma(a, z):

@@ -35,7 +35,10 @@ DM_RE = re.compile(r"DM(\d+\.\d{2})")
 
 def default_known_birds_f() -> List[Tuple[float, float]]:
     """(freq, err) pairs from the shipped default birdie list
-    (power-mains harmonics) for default_rejection."""
+    (power-mains harmonics).  OPT-IN — pass the result as
+    known_birds_f (e.g. ACCEL_sift -defaultbirds); the reference's
+    ACCEL_sift recipe defaults to an empty birdie list, so the sift
+    never rejects by default."""
     from presto_tpu.ops.rednoise import read_birds_bary
     from presto_tpu.utils.catalog import default_birds_path
     path = default_birds_path()
@@ -210,11 +213,7 @@ class Candlist:
                 c.note = "dominated by harmonic %d" % (maxharm + 1)
                 self._mark_bad(i, "rogueharmpow")
 
-    def default_rejection(self, known_birds_f=None, known_birds_p=()):
-        if known_birds_f is None:
-            # the shipped mains-harmonic birdie list (zapbirds'
-            # -defaultbirds analog for sifting); pass () to disable
-            known_birds_f = default_known_birds_f()
+    def default_rejection(self, known_birds_f=(), known_birds_p=()):
         self.reject_longperiod()
         self.reject_shortperiod()
         self.reject_knownbirds(known_birds_f, known_birds_p)
@@ -367,7 +366,7 @@ def candlist_from_accelfile(filename: str) -> Candlist:
 
 def read_candidates(filenames: Sequence[str],
                     prelim_reject: bool = True,
-                    known_birds_f=None, known_birds_p=()) -> Candlist:
+                    known_birds_f=(), known_birds_p=()) -> Candlist:
     """Aggregate candidates over many DM trials
     (sifting.py:1203-1230)."""
     out = Candlist()
@@ -381,7 +380,7 @@ def read_candidates(filenames: Sequence[str],
 
 def sift_candidates(filenames: Sequence[str], numdms_min: int = 2,
                     low_DM_cutoff: float = 2.0,
-                    known_birds_f=None, known_birds_p=(),
+                    known_birds_f=(), known_birds_p=(),
                     r_err: float = R_ERR) -> Candlist:
     """The ACCEL_sift.py recipe (python/ACCEL_sift.py:40-76):
     read -> reject -> dedup across DMs -> DM checks -> harmonics."""

@@ -32,6 +32,7 @@ boundaries — see ops/fftpack note on the TPU complex-transfer limit).
 
 from __future__ import annotations
 
+import os
 from dataclasses import dataclass
 from typing import List, Optional
 
@@ -53,6 +54,13 @@ ACCEL_RDZ = 0.5
 ACCEL_CLOSEST_R = 15.0
 ACCEL_USELEN = 7470
 DBLCORRECT = 1e-14
+
+# One shared device-memory constant (the meminfo.h analog): every HBM
+# budget in this module derives from it so independent sub-budgets
+# cannot stack past the device.  Override (bytes) for parts with
+# different headroom.
+DEVICE_HBM_BYTES = int(os.environ.get("PRESTO_TPU_HBM_BYTES",
+                                      str(16 * 2 ** 30)))
 
 
 def _nearest_int(x: float) -> int:
@@ -196,7 +204,12 @@ def fft_kernel_bank_np(kern: "AccelKernels") -> np.ndarray:
     """Host-side expansion of the compact time-domain bank to the
     FFT'd [numz, fftlen, 2] bank _ffdot_blocks consumes (the numpy
     twin of _fft_kernel_bank, for driver entry points and referee
-    paths that want plain arrays)."""
+    paths that want plain arrays).
+
+    NOTE: this twin FFTs in complex128 then rounds, while the device's
+    _fft_kernel_bank FFTs in complex64 — the two banks agree only to
+    float32 rounding, not bit-for-bit (accel_ref's referee compares
+    candidate lists, where the difference is far below threshold)."""
     kc = kern.kern_pairs[..., 0] + 1j * kern.kern_pairs[..., 1]
     half = kern.kmax // 2
     placed = np.zeros((kc.shape[0], kern.fftlen), dtype=np.complex128)
@@ -687,7 +700,11 @@ class AccelSearch:
         if g is False:
             return None
         kern = self.kern
-        if (kern.numz * (g.plane_numr + g.body_numr) * 4) >= 9 * 2 ** 30:
+        # plane + stacked ys must leave room for the chunk
+        # intermediate and output staging (derived from the one shared
+        # HBM constant so budgets cannot stack past the device)
+        if (kern.numz * (g.plane_numr + g.body_numr) * 4) >= \
+                (DEVICE_HBM_BYTES * 9) // 16:
             return None
         if getattr(g, "build_body", None) is None:
             chunk_slab = self._chunk_slab_fn(g)
@@ -777,6 +794,14 @@ class AccelSearch:
         numz*slab floats per gather), each slab thresholded+top-k'd per
         stage on device with candidates collected on host — bounding
         memory for arbitrarily long spectra.
+
+        Returned candidates are PRE-COLLAPSED to at most one per ~8
+        r-bins (the segment-max reduction; lossless w.r.t. the final
+        list because remove_duplicates' ACCEL_CLOSEST_R=15-bin rule —
+        insert_new_accelcand semantics — collapses anything closer
+        anyway).  Library callers should not expect sub-segment
+        multiplicity; apply remove_duplicates/eliminate_harmonics for
+        the reference's final-list semantics.
         """
         cfg = self.cfg
         if plane is None and cfg.wmax:
@@ -847,7 +872,16 @@ class AccelSearch:
         g = self._plane_geom()
         plane_bytes = max(self.kern.numz * g.plane_numr * 4, 1) \
             if g else 1
-        max_planes = max(1, int(10 * 2 ** 30 // plane_bytes))
+        # cache budget = shared HBM constant minus the plane-build
+        # working set (carry-free builds hold plane + stacked ys +
+        # chunk intermediate concurrently — see _ys_plan), so the two
+        # budgets cannot stack past the device
+        build_ws = (self.kern.numz * g.body_numr * 4
+                    + int(os.environ.get("PRESTO_TPU_CHUNK_BUDGET",
+                                         str(2 ** 30)))) if g else 0
+        cache_budget = max(DEVICE_HBM_BYTES - build_ws - 2 * 2 ** 30,
+                           plane_bytes)
+        max_planes = max(1, int(cache_budget // plane_bytes))
 
         def plane_for(wg: float, keep: set):
             pl = plane_cache.pop(wg, None)
