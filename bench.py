@@ -96,9 +96,9 @@ def bench_accel():
     cands = s.search(pairs)          # warmup (compile or cache load)
     warm = time.time() - t0
 
-    # best of 3: the tunneled chip shows 20-30% run-to-run variance
+    # best of 5: the tunneled chip shows 20-30% run-to-run variance
     elapsed = float("inf")
-    for _ in range(3):
+    for _ in range(5):
         t0 = time.time()
         cands = s.search(pairs)
         elapsed = min(elapsed, time.time() - t0)

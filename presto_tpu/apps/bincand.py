@@ -87,7 +87,12 @@ def _trial_from_args(args, base, info):
                                  t=args.t)
     if args.psr:
         from presto_tpu.utils.catalog import psrepoch
-        epoch = (info.mjd if info is not None else 51000.0)
+        epoch = (info.mjd if info is not None else 0.0)
+        if not epoch or epoch <= 0:      # .inf convention: -1 unknown
+            print("bincand -psr: WARNING no valid epoch in the .inf; "
+                  "extrapolating catalog parameters to MJD 51000 "
+                  "(orbital phase will be wrong)")
+            epoch = 51000.0
         try:
             # advanced to the obs epoch: orb.p in SECONDS, orb.t in
             # seconds since periastron — the optimizer's units

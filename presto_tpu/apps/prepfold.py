@@ -221,9 +221,10 @@ def _fold_params(args, T: float, obs=None):
         obs = obs or {}
         epoch = obs.get("mjd", 0.0)
         if not epoch or epoch <= 0:      # .inf convention: -1 unknown
-            print("prepfold -psr: WARNING no valid epoch in the input "
-                  "metadata; using the catalog timepoch (orbital phase "
-                  "of binaries will be wrong)")
+            print("prepfold -psr: WARNING no valid epoch in the "
+                  "input metadata; extrapolating catalog parameters "
+                  "to MJD 51000 (orbital phase of binaries will be "
+                  "wrong)")
             epoch = 51000.0
         try:
             # catalog params advanced to the obs epoch: spin by its

@@ -133,7 +133,10 @@ class PsrfitsFile:
             # OFFS_SUB of row 1 overrides NSUBOFFS (psrfits.c:253-287)
             offs_sub0 = float(sub.read_col("OFFS_SUB", 0)[0])
             if offs_sub0 != 0.0:
-                numrows = int((offs_sub0 - 0.5 * tsub) / tsub + 1e-7)
+                # ROUND like the row-grid snap in _row_start_spec so
+                # negative OFFS_SUB drift on a leading dropped row
+                # cannot place the file origin one subint early
+                numrows = int(round((offs_sub0 - 0.5 * tsub) / tsub))
                 start_subint = numrows
                 self._offs_sub_zero = False
             else:

@@ -61,6 +61,11 @@ DBLCORRECT = 1e-14
 # different headroom.
 DEVICE_HBM_BYTES = int(os.environ.get("PRESTO_TPU_HBM_BYTES",
                                       str(16 * 2 ** 30)))
+# the [chunk, numz, fftlen] complex plane-build intermediate budget
+# (bigger was NOT better in clean A/Bs on v5e — HBM pressure beside
+# the plane + stacked-ys residents); single source for every consumer
+CHUNK_BUDGET_BYTES = int(os.environ.get("PRESTO_TPU_CHUNK_BUDGET",
+                                        str(2 ** 30)))
 
 
 def _nearest_int(x: float) -> int:
@@ -636,15 +641,10 @@ class AccelSearch:
         plane_numr = int(2 * int(starts[-1]) + cfg.uselen)
         plane_numr += (-plane_numr) % align
         # Chunk the block batch: the [chunk, numz, fftlen] complex
-        # intermediate is the peak working memory, so bound it (~1 GB
-        # per chunk at zmax=200) — the HBM-ladder analog of meminfo.h.
-        # Overridable (bytes) for devices with different HBM headroom;
-        # bigger was NOT better in clean A/Bs on v5e (HBM pressure
-        # beside the plane + stacked-ys residents).
-        import os
-        budget = int(os.environ.get("PRESTO_TPU_CHUNK_BUDGET",
-                                    str(2 ** 30)))
-        chunk = max(1, int(budget // (kern.numz * kern.fftlen * 8)))
+        # intermediate is the peak working memory, so bound it — the
+        # HBM-ladder analog of meminfo.h.
+        chunk = max(1, int(CHUNK_BUDGET_BYTES
+                           // (kern.numz * kern.fftlen * 8)))
         col0 = int(starts[0]) * ACCEL_RDR
         # Host uploads ONLY the raw spectrum; the per-block read
         # windows are gathered on device (the tunneled host->TPU link
@@ -877,8 +877,7 @@ class AccelSearch:
         # chunk intermediate concurrently — see _ys_plan), so the two
         # budgets cannot stack past the device
         build_ws = (self.kern.numz * g.body_numr * 4
-                    + int(os.environ.get("PRESTO_TPU_CHUNK_BUDGET",
-                                         str(2 ** 30)))) if g else 0
+                    + CHUNK_BUDGET_BYTES) if g else 0
         cache_budget = max(DEVICE_HBM_BYTES - build_ws - 2 * 2 ** 30,
                            plane_bytes)
         max_planes = max(1, int(cache_budget // plane_bytes))

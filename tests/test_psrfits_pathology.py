@@ -163,3 +163,19 @@ def test_poln_select_vs_sum(tmp_path):
     # the writer replicates the quantized data into both polns
     np.testing.assert_allclose(got_sum, 2.0 * got_one, atol=1e-4)
     np.testing.assert_allclose(got_one, data, atol=0.5)
+
+
+def test_drift_with_leading_drop(tmp_path):
+    """Negative OFFS_SUB drift combined with a dropped FIRST row: the
+    file origin must still land on the right subint (start_subint
+    rounds like the row-grid snap, not truncates)."""
+    data = make_data(1280, lo=1)
+    p = str(tmp_path / "dd.fits")
+    write_psrfits(p, data, dt=1e-3, freqs=FREQS, nsblk=256,
+                  drop_rows=[0, 1], offs_jitter=100.0)
+    with PsrfitsFile(p) as pf:
+        # rows 2..4 present: stream = 3 subints, origin at row 2
+        assert pf.nspectra == 3 * 256
+        got = pf.read_spectra(0, 3 * 256)
+    np.testing.assert_allclose(got, data[512:], atol=0.5)
+    assert not np.any(np.all(got == 0.0, axis=1))

@@ -1,0 +1,149 @@
+"""Multi-host (DCN) dryrun: 2 processes x 4 virtual CPU devices.
+
+VERDICT r1 flagged the comm backend as partial because jax.distributed
+multi-host was never exercised, even in dryrun form.  This tool runs
+the mpiprepsubband-equivalent dedispersion over a REAL multi-process
+jax.distributed cluster: two OS processes connect through the gRPC
+coordinator (the DCN transport), form one global 8-device mesh, run
+the DM-sharded dedispersion step with replicated raw input (the
+reference's MPI_Bcast pattern, mpiprepsubband.c:988-991), reduce with
+a cross-process collective, and the parent verifies the checksum
+against a single-process NumPy reference.
+
+Writes MULTIHOST_r02.json.  Run:  python tools/multihost_dryrun.py
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+NUMCHAN, NSUB, NUMDMS, NUMPTS = 64, 16, 64, 4096
+COORD = "localhost:12765"
+NPROC = 2
+
+CHILD = r"""
+import os, sys
+sys.path.insert(0, %(repo)r)
+pid = int(sys.argv[1])
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.distributed.initialize(%(coord)r, num_processes=%(nproc)d,
+                           process_id=pid)
+import numpy as np
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from presto_tpu.ops.dedispersion import (dedisp_subbands_block,
+                                         float_dedisp_many_block)
+
+assert len(jax.devices()) == 4 * %(nproc)d, len(jax.devices())
+mesh = Mesh(np.array(jax.devices()), ("dm",))
+repl = NamedSharding(mesh, P())
+dmsh = NamedSharding(mesh, P("dm"))
+
+# identical inputs on every process (the Bcast-replicated raw block)
+rng = np.random.default_rng(99)
+last = rng.normal(size=(%(numchan)d, %(numpts)d)).astype(np.float32)
+cur = rng.normal(size=(%(numchan)d, %(numpts)d)).astype(np.float32)
+chan_d = (np.arange(%(numchan)d) %% 97).astype(np.int32)
+dm_d = (np.arange(%(numdms)d)[:, None]
+        * np.linspace(0, 5, %(nsub)d)[None, :]).astype(np.int32)
+
+def mk(arr, shd):
+    return jax.make_array_from_callback(
+        arr.shape, shd, lambda idx: arr[idx])
+
+@jax.jit
+def step(last, cur, dly):
+    sub_last = dedisp_subbands_block(last, cur, chan_dev, %(nsub)d)
+    sub_cur = dedisp_subbands_block(cur, last, chan_dev, %(nsub)d)
+    out = float_dedisp_many_block(sub_last, sub_cur, dly)
+    # cross-process reduction: per-DM power then a global sum — the
+    # collective rides the gRPC/DCN transport between the 2 processes
+    return (out * out).sum(axis=1), out.sum()
+
+chan_dev = mk(chan_d, repl)
+outp, chk = jax.jit(step, in_shardings=(repl, repl, dmsh),
+                    out_shardings=(dmsh, repl))(
+    mk(last, repl), mk(cur, repl), mk(dm_d, dmsh))
+from jax.experimental import multihost_utils
+per_dm = np.asarray(multihost_utils.process_allgather(outp,
+                                                      tiled=True))
+if pid == 0:
+    print("CHK %%0.6f %%0.6f %%d" %% (float(chk), float(per_dm.sum()),
+                                      per_dm.size), flush=True)
+jax.distributed.shutdown()
+"""
+
+
+def reference():
+    import numpy as np
+    rng = np.random.default_rng(99)
+    last = rng.normal(size=(NUMCHAN, NUMPTS)).astype(np.float32)
+    cur = rng.normal(size=(NUMCHAN, NUMPTS)).astype(np.float32)
+    chan_d = (np.arange(NUMCHAN) % 97).astype(np.int64)
+    dm_d = (np.arange(NUMDMS)[:, None]
+            * np.linspace(0, 5, NSUB)[None, :]).astype(np.int64)
+    per = NUMCHAN // NSUB
+
+    def subs(a, b):
+        x2 = np.concatenate([a, b], axis=1)
+        out = np.zeros((NSUB, NUMPTS), np.float32)
+        for c in range(NUMCHAN):
+            out[c // per] += x2[c, chan_d[c]:chan_d[c] + NUMPTS]
+        return out
+
+    s1, s2 = subs(last, cur), subs(cur, last)
+    x2 = np.concatenate([s1, s2], axis=1)
+    out = np.zeros((NUMDMS, NUMPTS), np.float32)
+    for d in range(NUMDMS):
+        for s in range(NSUB):
+            out[d] += x2[s, dm_d[d, s]:dm_d[d, s] + NUMPTS]
+    return float(out.sum()), float((out.astype(np.float64) ** 2)
+                                   .sum(axis=1).sum())
+
+
+def main():
+    code = CHILD % dict(repo=REPO, coord=COORD, nproc=NPROC,
+                        numchan=NUMCHAN, nsub=NSUB, numdms=NUMDMS,
+                        numpts=NUMPTS)
+    env = dict(os.environ)
+    env.pop("PYTHONPATH", None)
+    procs = [subprocess.Popen([sys.executable, "-c", code, str(pid)],
+                              stdout=subprocess.PIPE,
+                              stderr=subprocess.PIPE, text=True,
+                              env=env, cwd=REPO)
+             for pid in range(NPROC)]
+    outs = [p.communicate(timeout=600) for p in procs]
+    rcs = [p.returncode for p in procs]
+    chk_line = next((ln for ln in outs[0][0].splitlines()
+                     if ln.startswith("CHK ")), None)
+    art = {"nproc": NPROC, "devices_per_proc": 4,
+           "coordinator": COORD, "returncodes": rcs}
+    ok = all(rc == 0 for rc in rcs) and chk_line is not None
+    if ok:
+        chk, sq, nd = chk_line.split()[1:]
+        ref_sum, ref_sq = reference()
+        art["checksum_distributed"] = float(chk)
+        art["checksum_reference"] = ref_sum
+        art["sq_distributed"] = float(sq)
+        art["sq_reference"] = ref_sq
+        art["per_dm_rows_gathered"] = int(nd)
+        ok = (abs(float(chk) - ref_sum) < 1e-3 * max(abs(ref_sum), 1)
+              and abs(float(sq) - ref_sq) < 1e-3 * max(abs(ref_sq), 1)
+              and int(nd) == NUMDMS)
+    else:
+        art["stderr_tail"] = [o[1][-1500:] for o in outs]
+    art["ok"] = bool(ok)
+    with open(os.path.join(REPO, "MULTIHOST_r02.json"), "w") as f:
+        json.dump(art, f, indent=1)
+    print(json.dumps(art, indent=1))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
