@@ -103,9 +103,19 @@ def bench_accel():
         cands = s.search(pairs)
         elapsed = min(elapsed, time.time() - t0)
 
+    # diagnostic: the 16 MB H2D spectrum upload cost through the
+    # tunneled link (negligible on PCIe) — a separate reference
+    # measurement, min-of-2 so the probe's own compile doesn't count
+    import jax.numpy as jnp
+    upload = float("inf")
+    for _ in range(2):
+        t0 = time.time()
+        float(jnp.asarray(pairs).sum())
+        upload = min(upload, time.time() - t0)
+
     numr = int(s.rhi - s.rlo) * 2
     cells = cfg.numz * numr
-    return cells / elapsed, warm, elapsed, cells, len(cands)
+    return cells / elapsed, warm, elapsed, cells, len(cands), upload
 
 
 def bench_dedisp():
@@ -155,7 +165,8 @@ def main():
     import jax
 
     cpu_cells, cpu_dmtrials, cpu_meta = load_cpu_baseline()
-    cells_per_sec, warm_a, steady_a, cells, ncands = bench_accel()
+    (cells_per_sec, warm_a, steady_a, cells, ncands,
+     upload_a) = bench_accel()
     dm_per_sec, warm_d, steady_d, nsamples = bench_dedisp()
 
     print(json.dumps({
@@ -167,12 +178,14 @@ def main():
         "dm_trials_vs_baseline": round(dm_per_sec / cpu_dmtrials, 2),
         "cpu_baseline_measured": cpu_meta is not None,
     }))
-    print("# device=%s accel: warmup=%.1fs steady=%.2fs cells=%.3g "
-          "cands=%d | dedisp: warmup=%.1fs steady=%.2fs (%d DMs x %d) "
-          "| cpu baseline: %.3g cells/s, %.1f DM-trials/s (%s)"
-          % (jax.devices()[0].platform, warm_a, steady_a, cells, ncands,
-             warm_d, steady_d, WORKLOAD["dedisp_numdms"],
-             WORKLOAD["dedisp_nsamples"], cpu_cells, cpu_dmtrials,
+    print("# device=%s accel: warmup=%.1fs steady=%.2fs (16MB H2D "
+          "ref transfer %.2fs) cells=%.3g cands=%d | dedisp: "
+          "warmup=%.1fs steady=%.2fs (%d DMs x %d) | cpu baseline: "
+          "%.3g cells/s, %.1f DM-trials/s (%s)"
+          % (jax.devices()[0].platform, warm_a, steady_a, upload_a,
+             cells, ncands, warm_d, steady_d,
+             WORKLOAD["dedisp_numdms"], WORKLOAD["dedisp_nsamples"],
+             cpu_cells, cpu_dmtrials,
              "measured" if cpu_meta else "fallback"),
           file=sys.stderr)
 

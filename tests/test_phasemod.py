@@ -129,3 +129,14 @@ def test_plotbincand_cli(tmp_path):
         assert os.path.exists("z.png")
     finally:
         os.chdir(old)
+
+
+def test_numbetween_1_raw_bins_mode():
+    """-numbetween 1 (raw bins, no interpolation) still recovers the
+    binary, at reduced precision — the reference's numbetween=1 mode."""
+    fft, N, dt = make_binary_spectrum()
+    cfg = PhaseModConfig(ncand=20, minfft=1024, maxfft=8192,
+                         harmsum=3, numbetween=1)
+    cands = search_phasemod(fft, N, dt, cfg)
+    assert cands and cands[0].mini_sigma > 5.0
+    assert any(abs(c.orb_p - 400.0) < 10.0 for c in cands)
