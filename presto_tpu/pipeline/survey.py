@@ -125,11 +125,98 @@ def _run_survey_stages(rawfiles, cfg, workdir, base, res, timer):
     res.datfiles = _stage(os.path.basename(base) + "_DM*.dat", workdir)
     print("survey: %d dedispersed time series" % len(res.datfiles))
 
-    timer.mark("realfft")
-    # ---- 4. realfft: BATCHED over the DM fan-out ----------------------
-    # per-file FFTs pay the tunnel's seconds-scale device->host latency
-    # 264 times; batching turns the stage into one upload, one batched
-    # rfft dispatch per length group, one download
+    if cfg.zaplist:
+        timer.mark("realfft")
+        _staged_fft_search_head(res, cfg)
+        fftfiles = [f[:-4] + ".fft" for f in res.datfiles]
+        timer.mark("zapbirds")
+        # ---- 5. zapbirds ---------------------------------------------
+        from presto_tpu.apps.zapbirds import main as zap_main
+        for f in fftfiles:
+            zap_main(["-zap", "-zapfile", cfg.zaplist, f])
+        timer.mark("accelsearch")
+        # ---- 6. accelsearch: BATCHED over the DM fan-out -------------
+        # all trials share length and T, so the whole survey's search
+        # runs as grouped device dispatches (search_many) instead of a
+        # per-DM dispatch storm; refinement + artifacts stay per-DM
+        _batched_accelsearch(fftfiles, cfg)
+    else:
+        # ---- 4+6 fused fast path: realfft -> accelsearch with the
+        # spectra RESIDENT on device (no zapbirds in between).  Saves
+        # a download + re-upload of every trial's spectrum — the
+        # tunneled link's slowest direction; .fft/ACCEL artifacts are
+        # still written, preserving the checkpoint contract.
+        timer.mark("realfft+accelsearch (fused)")
+        _fused_fft_search(res, cfg)
+        # resume case: trials whose .fft already existed (so the fused
+        # stage skipped regenerating them) but whose ACCEL is missing
+        _batched_accelsearch([f[:-4] + ".fft" for f in res.datfiles],
+                             cfg)
+
+    timer.mark("sift")
+    return _finish_survey_stages(rawfiles, cfg, workdir, base, res,
+                                 timer)
+
+
+def _length_groups(files, item_bytes):
+    """Group files by payload length (dict length -> file list);
+    item_bytes converts a file size to its logical length."""
+    by_len = {}
+    for f in files:
+        by_len.setdefault(item_bytes(os.path.getsize(f)), []).append(f)
+    return by_len
+
+
+def _survey_searcher(first_file, nbins, cfg):
+    """(searcher, T) for one same-length trial group."""
+    from presto_tpu.io.infodata import read_inf
+    from presto_tpu.search.accel import AccelConfig, AccelSearch
+    info = read_inf(first_file[:-4] + ".inf")
+    T = info.N * info.dt
+    acfg = AccelConfig(zmax=cfg.zmax, numharm=cfg.numharm,
+                       sigma=cfg.sigma)
+    return AccelSearch(acfg, T=T, numbins=nbins), T
+
+
+def _fused_fft_search(res, cfg) -> None:
+    """Stage 4+6 fused: batched rfft, search_many on the DEVICE
+    spectra, one download for the .fft artifacts.  Only processes
+    trials with NO .fft yet — existing spectra (an interrupted run's
+    checkpoints) are left to _batched_accelsearch so their upload
+    isn't paid twice."""
+    todo = [f for f in res.datfiles
+            if not os.path.exists(f[:-4] + ".fft")]
+    if not todo:
+        return
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from presto_tpu.io import datfft
+    from presto_tpu.ops import fftpack
+    from presto_tpu.apps.accelsearch import refine_and_write
+
+    batched = jax.jit(jax.vmap(fftpack.realfft_packed_pairs))
+    for n, files in _length_groups(
+            todo, lambda sz: (sz // 4) & ~1).items():
+        searcher, T = _survey_searcher(files[0], n // 2, cfg)
+        per = max(1, int(2 ** 30 // max(n * 4, 1)))
+        for g0 in range(0, len(files), per):
+            chunk = files[g0:g0 + per]
+            arr = np.stack([datfft.read_dat(f)[:n] for f in chunk])
+            pairs_dev = batched(jnp.asarray(arr))    # stays in HBM
+            results = searcher.search_many(pairs_dev)
+            pairs_host = np.asarray(pairs_dev)       # one download
+            for f, pr, raw in zip(chunk, pairs_host, results):
+                amps = fftpack.np_pairs_to_complex64(pr)
+                datfft.write_fft(f[:-4] + ".fft", amps)
+                refine_and_write(raw, amps, T, searcher, f[:-4],
+                                 cfg.zmax, quiet=True)
+    print("survey: fused realfft+accelsearch over %d trials "
+          "(device-resident spectra)" % len(todo))
+
+
+def _staged_fft_search_head(res, cfg):
+    """Stage 4 alone (the staged path used when zapbirds intervenes)."""
     todo = [f for f in res.datfiles
             if not os.path.exists(f[:-4] + ".fft")]
     if todo:
@@ -139,11 +226,8 @@ def _run_survey_stages(rawfiles, cfg, workdir, base, res, timer):
         from presto_tpu.io import datfft
         from presto_tpu.ops import fftpack
         batched = jax.jit(jax.vmap(fftpack.realfft_packed_pairs))
-        by_len = {}
-        for f in todo:                       # group by length via size
-            n = (os.path.getsize(f) // 4) & ~1
-            by_len.setdefault(n, []).append(f)
-        for n, files in by_len.items():
+        for n, files in _length_groups(
+                todo, lambda sz: (sz // 4) & ~1).items():
             # memory budget: read/stack/upload at most ~1 GB per group
             per = max(1, int(2 ** 30 // max(n * 4, 1)))
             for g0 in range(0, len(files), per):
@@ -156,38 +240,21 @@ def _run_survey_stages(rawfiles, cfg, workdir, base, res, timer):
                     datfft.write_fft(f[:-4] + ".fft",
                                      fftpack.np_pairs_to_complex64(pr))
         print("survey: realfft over %d series (batched)" % len(todo))
-    fftfiles = [f[:-4] + ".fft" for f in res.datfiles]
 
-    timer.mark("zapbirds")
-    # ---- 5. zapbirds --------------------------------------------------
-    if cfg.zaplist:
-        from presto_tpu.apps.zapbirds import main as zap_main
-        for f in fftfiles:
-            zap_main(["-zap", "-zapfile", cfg.zaplist, f])
 
-    timer.mark("accelsearch")
-    # ---- 6. accelsearch: BATCHED over the DM fan-out ------------------
-    # all trials share length and T, so the whole survey's search runs
-    # as grouped device dispatches (search_many) instead of a per-DM
-    # dispatch storm; refinement + artifacts stay per-DM
+def _batched_accelsearch(fftfiles, cfg):
+    """Stage 6 alone (staged path): grouped search_many over .fft
+    files already on disk."""
     todo = [f for f in fftfiles
             if not os.path.exists(f[:-4] + "_ACCEL_%d" % cfg.zmax)]
     if todo:
         import numpy as np
         from presto_tpu.io import datfft
-        from presto_tpu.io.infodata import read_inf
         from presto_tpu.ops import fftpack
-        from presto_tpu.search.accel import AccelConfig, AccelSearch
         from presto_tpu.apps.accelsearch import refine_and_write
-        by_len = {}
-        for f in todo:                       # group by length via size
-            by_len.setdefault(os.path.getsize(f) // 8, []).append(f)
-        for nbins, files in by_len.items():
-            info = read_inf(files[0][:-4] + ".inf")
-            T = info.N * info.dt
-            acfg = AccelConfig(zmax=cfg.zmax, numharm=cfg.numharm,
-                               sigma=cfg.sigma)
-            searcher = AccelSearch(acfg, T=T, numbins=nbins)
+        for nbins, files in _length_groups(
+                todo, lambda sz: sz // 8).items():
+            searcher, T = _survey_searcher(files[0], nbins, cfg)
             # memory budget ~1 GB of host spectra per batched call
             per = max(1, int(2 ** 30 // max(nbins * 8, 1)))
             for g0 in range(0, len(files), per):
@@ -202,7 +269,8 @@ def _run_survey_stages(rawfiles, cfg, workdir, base, res, timer):
         print("survey: accelsearch over %d trials (batched)"
               % len(todo))
 
-    timer.mark("sift")
+
+def _finish_survey_stages(rawfiles, cfg, workdir, base, res, timer):
     # ---- 7. sift ------------------------------------------------------
     from presto_tpu.pipeline.sifting import sift_candidates
     accfiles = _stage(os.path.basename(base)

@@ -1058,12 +1058,21 @@ class AccelSearch:
         memory-budgeted DM group instead of per-trial dispatch storms;
         the mpiprepsubband-scale path of SURVEY §2.5).
 
-        pairs_batch: [numdms, numbins, 2] float32.  Returns per-DM
-        candidate lists (same semantics as search() per spectrum).
+        pairs_batch: [numdms, numbins, 2] float32 — a NumPy array or a
+        DEVICE array (jax.Array): the survey's fused realfft->search
+        path keeps spectra resident in HBM, skipping a host download +
+        re-upload per DM trial (each direction of the tunneled link
+        costs seconds per group).  Returns per-DM candidate lists
+        (same semantics as search() per spectrum).
         """
         cfg = self.cfg
-        batch = np.ascontiguousarray(np.asarray(pairs_batch,
-                                                np.float32))
+        if isinstance(pairs_batch, jax.Array):
+            batch = pairs_batch
+            if batch.dtype != jnp.float32:    # same boundary cast the
+                batch = batch.astype(jnp.float32)   # NumPy path gets
+        else:
+            batch = np.ascontiguousarray(np.asarray(pairs_batch,
+                                                    np.float32))
         nd = batch.shape[0]
         if nd == 0:
             return []

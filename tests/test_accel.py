@@ -180,3 +180,25 @@ def test_short_spectrum_search_not_empty():
     # batched path too
     many = s.search_many(np.stack([pairs, pairs]))
     assert len(many) == 2 and many[0] and many[1]
+
+
+def test_search_many_device_array_input():
+    """search_many accepts a DEVICE array (the survey's fused
+    realfft->search path) and returns identical candidates to the
+    NumPy-input path."""
+    import jax.numpy as jnp
+    rng = np.random.default_rng(5)
+    numbins, T, nd = 1 << 14, 120.0, 3
+    batch = rng.normal(size=(nd, numbins, 2)).astype(np.float32)
+    batch[0, 3000] = (60.0, 0.0)
+    batch[2, 7777] = (55.0, 0.0)
+    from presto_tpu.search.accel import AccelConfig, AccelSearch
+    cfg = AccelConfig(zmax=8, numharm=2, sigma=3.0)
+    s1 = AccelSearch(cfg, T=T, numbins=numbins)
+    res_np = s1.search_many(batch)
+    s2 = AccelSearch(cfg, T=T, numbins=numbins)
+    res_dev = s2.search_many(jnp.asarray(batch))
+    assert len(res_np) == len(res_dev) == nd
+    for a, b in zip(res_np, res_dev):
+        assert [(c.numharm, c.r, c.z, c.power) for c in a] == \
+            [(c.numharm, c.r, c.z, c.power) for c in b]
