@@ -196,6 +196,12 @@ def _fold_params(args, T: float, obs=None):
         if rms > 0.01:
             print("prepfold: WARNING polyco->polynomial fit rms = "
                   "%.2g rotations (obs too long for one cubic?)" % rms)
+        if args.absphase:
+            # pin profile bin 0 to the ephemeris' absolute phase 0
+            # (the reference's -absphase).  The offset is resolved at
+            # the ACTUAL fold start epoch (see _apply_absphase): with
+            # -start/-end windows, tepoch moves past the file start
+            args._abs_pcs = pcs
         print("prepfold: ephemeris fold  f=%.12g Hz  fd=%.4g  fdd=%.4g"
               % (f, fd, fdd))
         return f, fd, fdd
@@ -263,6 +269,19 @@ def _auto_proflen(p_sec: float, dt: float) -> int:
     while n < raw / 2 and n < 256:
         n *= 2
     return n
+
+
+def _apply_absphase(args, tepoch: float) -> None:
+    """Fold-time half of -absphase: offset the profile by the polyco
+    rotation fraction at the fold start epoch (which -start moves past
+    the file start), pinning bin 0 to ephemeris phase 0."""
+    pcs = getattr(args, "_abs_pcs", None)
+    if pcs is None:
+        return
+    rot0 = pcs.get_rotation(int(tepoch), tepoch - int(tepoch))
+    args.phs = (args.phs + rot0) % 1.0
+    args._abs_pcs = None       # applied once
+    print("prepfold: -absphase offset = %.6f rotations" % (rot0 % 1.0))
 
 
 def _make_cfg(args, proflen, nsub, search_dm):
@@ -334,6 +353,7 @@ def fold_events_file(args, f, fd, fdd):
         raise SystemExit("prepfold -events: -start/-end window "
                          "contains no events")
     T = float(ev.max()) or 1.0
+    _apply_absphase(args, mjd0 + lo / 86400.0)
     proflen = args.proflen or _auto_proflen(1.0 / f, T / 1e6)
     cfg = _make_cfg(args, proflen, 1, search_dm=False)
     delays, delaytimes = _orbit_model(args, T, mjd0)
@@ -350,6 +370,7 @@ def fold_dat(args, f, fd, fdd):
     lo, hi = _slice_fractions(args, data.size)
     data = data[lo:hi]
     tepoch = info.mjd + lo * dt / 86400.0
+    _apply_absphase(args, tepoch)
     proflen = args.proflen or _auto_proflen(1.0 / f, dt)
     cfg = _make_cfg(args, proflen, 1, search_dm=False)
     delays, delaytimes = _orbit_model(args, data.size * dt, tepoch)
@@ -419,6 +440,7 @@ def fold_raw(args, f, fd, fdd):
     lo, hi = _slice_fractions(args, series.shape[1])
     series = series[:, lo:hi]
     tepoch = hdr.tstart + lo * dt / 86400.0
+    _apply_absphase(args, tepoch)
 
     proflen = args.proflen or _auto_proflen(1.0 / f, dt)
     cfg = _make_cfg(args, proflen, nsub,

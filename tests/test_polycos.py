@@ -210,3 +210,50 @@ class TestPrepfoldPolycos:
              "-nosearch", "-o", base + "_par", base + ".dat"]))
         assert res.best_redchi > 10.0
         assert res.fold_f == pytest.approx(f0, rel=1e-5)
+
+
+def test_absphase_offsets_profile(tmp_path):
+    """-absphase pins profile bin 0 to the polycos' absolute phase:
+    the folded profile rotates by the start-epoch rotation fraction
+    relative to a plain -polycos fold."""
+    import numpy as np
+    from presto_tpu.apps import prepfold as pf_app
+    from presto_tpu.io.datfft import write_dat
+    from presto_tpu.io.infodata import InfoData
+    from presto_tpu.models.synth import FakeSignal, fake_timeseries
+
+    f0, N, dt = 5.0, 1 << 14, 1e-3
+    mjd0 = 58000.0
+    sig = FakeSignal(f=f0, amp=5.0, shape="gauss", width=0.05)
+    data = fake_timeseries(N, dt, sig, noise_sigma=0.5, seed=3)
+    base = str(tmp_path / "ap")
+    write_dat(base + ".dat", data.astype(np.float32),
+              InfoData(name=base, telescope="GBT", dt=dt, N=N,
+                       mjd_i=int(mjd0), mjd_f=0.0))
+    # polycos with a known fractional rotation at mjd0: TMID sits
+    # 0.2 d later, and 0.2 d * 86400 s * 5 Hz is an exact integer, so
+    # frac(rotation(mjd0)) == rphase == 0.37
+    blk = Polyco(psr="J0000+0000", tmid_i=int(mjd0), tmid_f=0.2,
+                 dm=0.0, doppler=0.0, log10rms=-6.0, rphase=0.37,
+                 f0=f0, obs="1", dataspan=1440, numcoeff=3,
+                 obsfreq=1400.0, coeffs=np.zeros(3))
+    pcfile = str(tmp_path / "polyco.dat")
+    write_polycos(Polycos([blk]), pcfile)
+
+    profs = {}
+    for flags in ([], ["-absphase"]):
+        out = base + ("_abs" if flags else "_plain")
+        res = pf_app.run(pf_app.build_parser().parse_args(
+            ["-polycos", pcfile, "-npart", "8", "-n", "64",
+             "-nosearch", "-noplot", "-o", out] + flags
+            + [base + ".dat"]))
+        profs[bool(flags)] = np.asarray(res.best_prof)
+    rot0 = 0.37                  # by construction (see blk above)
+    shift_bins = rot0 * 64
+    a, b = profs[False], profs[True]
+    # circular cross-correlation peak offset == the absphase shift
+    xc = np.fft.irfft(np.fft.rfft(b) * np.conj(np.fft.rfft(a)))
+    got = float(np.argmax(xc))
+    dist = min(abs(got - shift_bins % 64),
+               64 - abs(got - shift_bins % 64))
+    assert dist <= 1.5, (got, shift_bins % 64)
