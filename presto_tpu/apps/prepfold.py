@@ -464,6 +464,9 @@ def fold_raw(args, f, fd, fdd):
 def run(args):
     ensure_backend()
     apply_presets(args)
+    if args.absphase and not (args.polycos or args.parfile):
+        raise SystemExit("prepfold: -absphase requires -polycos or "
+                         "-par/-timing (the reference errors too)")
     is_dat = args.infile.endswith(".dat") or args.events
     # need T to turn accelcand (r, z) into (f, fd): read N*dt cheaply
     if is_dat:

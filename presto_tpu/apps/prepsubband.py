@@ -11,6 +11,7 @@ are present (the mpiprepsubband analog, SURVEY.md §2.5).
 from __future__ import annotations
 
 import argparse
+import os
 
 import numpy as np
 import jax
@@ -57,6 +58,17 @@ def build_parser() -> argparse.ArgumentParser:
                    help="Decimals of DM precision in output filenames")
     p.add_argument("-ignorechan", type=str, default=None,
                    help="Channels to zero out, e.g. '0:5,34'")
+    # mpiprepsubband-equivalent launch (SURVEY s2.5): with multiple
+    # devices the DM fan-out shards over a jax mesh automatically; on
+    # a manual multi-host cluster pass the coordinator grid (the
+    # mpirun analog; mpiprepsubband.c:81-83)
+    p.add_argument("-coordinator", type=str, default=None,
+                   help="host:port of the jax.distributed coordinator "
+                        "(multi-host runs; give -nproc and -procid)")
+    p.add_argument("-nproc", type=int, default=None,
+                   help="Total process count of the multi-host run")
+    p.add_argument("-procid", type=int, default=None,
+                   help="This process's id (0-based)")
     add_raw_flags(p)
     p.add_argument("rawfiles", nargs="+")
     return p
@@ -86,6 +98,11 @@ def plan_delays(hdr, args, avgvoverc=0.0):
 
 
 def run(args):
+    if args.coordinator or args.nproc is not None:
+        from presto_tpu.parallel.mesh import init_distributed
+        nproc = init_distributed(args.coordinator, args.nproc,
+                                 args.procid)
+        print("prepsubband: joined a %d-process cluster" % nproc)
     ensure_backend()
     if args.downsamp < 1:
         raise SystemExit("prepsubband: -downsamp must be >= 1")
@@ -124,6 +141,40 @@ def run(args):
         blocklen += args.downsamp - blocklen % args.downsamp
     chan_bins_d = jnp.asarray(chan_bins)
     dm_bins_d = jnp.asarray(dm_bins)
+    # DM-sharded mesh path (the mpiprepsubband analog): used whenever
+    # more than one device is visible — a chip pod or a -coordinator
+    # cluster — and the DM count divides the device count's grid
+    ndev = len(jax.devices())
+    use_mesh = (ndev > 1 and not args.sub
+                and args.numdms % ndev == 0
+                and not os.environ.get("PRESTO_TPU_DISABLE_MESH"))
+    sh_step = None
+    if not use_mesh and jax.process_count() > 1:
+        # a cluster run MUST take the mesh path: the single-device
+        # fallback would make every process compute the full job and
+        # race on the same output files
+        raise SystemExit(
+            "prepsubband: multi-host run requires the DM-sharded path "
+            "— numdms (%d) must divide the global device count (%d), "
+            "-sub is single-host only, and PRESTO_TPU_DISABLE_MESH "
+            "must be unset" % (args.numdms, ndev))
+    if use_mesh:
+        from presto_tpu.parallel.mesh import make_mesh
+        from presto_tpu.parallel.sharded import (
+            make_sharded_dedisperse_step, shard_dm_array)
+        mesh = make_mesh()
+        sh_step = make_sharded_dedisperse_step(mesh, args.nsub,
+                                               args.downsamp)
+        dm_bins_d = shard_dm_array(dm_bins_d, mesh)
+        print("prepsubband: DM fan-out sharded over %d devices"
+              % ndev)
+    elif ndev > 1 and not args.sub:
+        why = ("PRESTO_TPU_DISABLE_MESH is set"
+               if os.environ.get("PRESTO_TPU_DISABLE_MESH")
+               else "numdms=%d is not divisible by %d"
+               % (args.numdms, ndev))
+        print("prepsubband: %d devices visible but %s — running "
+              "single-device" % (ndev, why))
     prev_raw = None
     prev_sub = None
     outs = []
@@ -147,17 +198,25 @@ def run(args):
             block = np.zeros((blocklen, nchan), dtype=np.float32)
         cur = jnp.asarray(np.ascontiguousarray(block.T))
         if prev_raw is not None:
-            sub = dd.dedisp_subbands_block(prev_raw, cur, chan_bins_d,
-                                           args.nsub)
-            if args.sub:
-                subouts.append(sub)
-            elif prev_sub is not None:
-                series = dd.float_dedisp_many_block(prev_sub, sub,
-                                                    dm_bins_d)
-                series = dd.downsample_block(series, args.downsamp)
-                # stays on device: one download at the end (the tunnel
-                # pays seconds of latency per device->host transfer)
+            if sh_step is not None and prev_sub is not None:
+                # sharded step: subbands on replicated data, the DM
+                # fan-out split over the mesh (mpiprepsubband's
+                # compute-everywhere/Bcast pattern, SURVEY s2.5)
+                sub, series = sh_step(prev_raw, cur, prev_sub,
+                                      chan_bins_d, dm_bins_d)
                 outs.append(series)
+            else:
+                sub = dd.dedisp_subbands_block(prev_raw, cur,
+                                               chan_bins_d, args.nsub)
+                if args.sub:
+                    subouts.append(sub)
+                elif prev_sub is not None:
+                    series = dd.float_dedisp_many_block(prev_sub, sub,
+                                                        dm_bins_d)
+                    series = dd.downsample_block(series, args.downsamp)
+                    # stays on device: one download at the end (the
+                    # tunnel pays seconds of latency per transfer)
+                    outs.append(series)
             prev_sub = sub
         prev_raw = cur
         nread += blocklen
@@ -167,7 +226,22 @@ def run(args):
         return _write_subbands(args, fb, plan, subouts, dms, dt,
                                int(chan_bins.max()), Neff, skip)
 
-    result = np.asarray(jnp.concatenate(outs, axis=1))  # [numdms, T]
+    cat = jnp.concatenate(outs, axis=1)                 # [numdms, T]
+    if jax.process_count() > 1:
+        # multi-host: each process materializes and writes ONLY its
+        # own DM rows — the reference's workers write their own .dat
+        # files (mpiprepsubband.c:1057-1060); nothing large crosses
+        # the DCN
+        local = {}
+        for sh in cat.addressable_shards:
+            lo = sh.index[0].start or 0
+            for k, row in enumerate(np.asarray(sh.data)):
+                local[lo + k] = row
+        local_ids = sorted(local)
+        result = np.stack([local[i] for i in local_ids])
+    else:
+        local_ids = list(range(args.numdms))
+        result = np.asarray(cat)
     valid = (Neff - maxd) // args.downsamp
     result = result[:, :valid]
     if plan is not None and plan.diffbins.size:
@@ -177,7 +251,8 @@ def run(args):
     result, valid, numout = pad_to_good_N(result, args.numout)
 
     outbase = args.outfile or "prepsubband_out"
-    for i, dmval in enumerate(dms):
+    for row, i in enumerate(local_ids):
+        dmval = dms[i]
         name = "%s_DM%.*f" % (outbase, args.dmprec, dmval)
         info = fil_to_inf(fb, name, result.shape[1], dm=float(dmval))
         if plan is not None:
@@ -188,10 +263,10 @@ def run(args):
             info.mjd_f %= 1.0
         info.dt = dt * args.downsamp
         set_onoff(info, valid, numout)
-        write_dat(name + ".dat", result[i], info)
+        write_dat(name + ".dat", result[row], info)
     fb.close()
     print("Wrote %d DMs x %d samples (lodm=%g dmstep=%g nsub=%d)"
-          % (args.numdms, result.shape[1], args.lodm, args.dmstep,
+          % (len(local_ids), result.shape[1], args.lodm, args.dmstep,
              args.nsub))
     return outbase, dms
 

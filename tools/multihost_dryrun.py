@@ -138,11 +138,99 @@ def main():
               and int(nd) == NUMDMS)
     else:
         art["stderr_tail"] = [o[1][-1500:] for o in outs]
-    art["ok"] = bool(ok)
+    art["prepsubband_cli"] = _prepsubband_cli_check()
+    art["ok"] = bool(ok and art["prepsubband_cli"].get("ok"))
     with open(os.path.join(REPO, "MULTIHOST_r02.json"), "w") as f:
         json.dump(art, f, indent=1)
     print(json.dumps(art, indent=1))
-    return 0 if ok else 1
+    return 0 if art["ok"] else 1
+
+
+PSB_CHILD = r"""
+import os, sys
+sys.path.insert(0, %(repo)r)
+pid = int(sys.argv[1])
+work = sys.argv[2]
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+import jax
+jax.config.update("jax_platforms", "cpu")
+from presto_tpu.apps import prepsubband as app
+app.run(app.build_parser().parse_args(
+    ["-coordinator", %(coord)r, "-nproc", "%(nproc)d",
+     "-procid", str(pid), "-o", os.path.join(work, "mh"),
+     "-lodm", "10", "-dmstep", "2", "-numdms", "16", "-nsub", "16",
+     "-nobary", os.path.join(work, "m.fil")]))
+"""
+
+
+def _prepsubband_cli_check():
+    """The mpiprepsubband CLI analog end-to-end: prepsubband with
+    -coordinator across 2 processes, each writing its own DM shard's
+    .dat files (mpiprepsubband.c:1057-1060), byte-identical to a
+    single-process run."""
+    import glob
+    import tempfile
+
+    out = {"ok": False}
+    work = tempfile.mkdtemp(prefix="mhpsb_")
+    env = dict(os.environ)
+    env.pop("PYTHONPATH", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    # synthesize + single-process reference (its own process so the
+    # parent never initializes jax)
+    ref_code = (
+        "import sys, os\nsys.path.insert(0, %r)\n"
+        "os.environ['XLA_FLAGS'] = "
+        "'--xla_force_host_platform_device_count=8'\n"
+        "import jax\njax.config.update('jax_platforms', 'cpu')\n"
+        "from presto_tpu.models.synth import FakeSignal, "
+        "fake_filterbank_file\n"
+        "sig = FakeSignal(f=5.0, dm=30.0, shape='gauss', width=0.1, "
+        "amp=1.0)\n"
+        "fake_filterbank_file(%r + '/m.fil', 1 << 14, 5e-4, 32, 400.0, "
+        "1.5, sig, noise_sigma=2.0, nbits=8)\n"
+        "from presto_tpu.apps import prepsubband as app\n"
+        "app.run(app.build_parser().parse_args(['-o', %r + '/ref', "
+        "'-lodm', '10', '-dmstep', '2', '-numdms', '16', '-nsub', "
+        "'16', '-nobary', %r + '/m.fil']))\n" % (REPO, work, work,
+                                                 work))
+    r = subprocess.run([sys.executable, "-c", ref_code], env=env,
+                       capture_output=True, text=True, timeout=600,
+                       cwd=REPO)
+    if r.returncode != 0:
+        out["stage"] = "reference"
+        out["stderr"] = r.stderr[-800:]
+        return out
+    coord = "localhost:12799"
+    code = PSB_CHILD % dict(repo=REPO, coord=coord, nproc=NPROC)
+    procs = [subprocess.Popen([sys.executable, "-c", code, str(pid),
+                               work],
+                              stdout=subprocess.PIPE,
+                              stderr=subprocess.PIPE, text=True,
+                              env=env, cwd=REPO)
+             for pid in range(NPROC)]
+    try:
+        outs = [p.communicate(timeout=600) for p in procs]
+    except subprocess.TimeoutExpired:
+        for p in procs:       # a hung child (dead peer, bound port):
+            p.kill()          # record the failure, don't abort main()
+        out["stage"] = "cluster-timeout"
+        return out
+    if any(p.returncode for p in procs):
+        out["stage"] = "cluster"
+        out["stderr"] = [o[1][-800:] for o in outs]
+        return out
+    refs = sorted(glob.glob(os.path.join(work, "ref_DM*.dat")))
+    mhs = sorted(glob.glob(os.path.join(work, "mh_DM*.dat")))
+    out["ref_files"] = len(refs)
+    out["mh_files"] = len(mhs)
+    same = (len(refs) == len(mhs) == 16 and all(
+        open(a, "rb").read() == open(b, "rb").read()
+        for a, b in zip(refs, mhs)))
+    out["byte_identical"] = bool(same)
+    out["ok"] = bool(same)
+    return out
 
 
 if __name__ == "__main__":
