@@ -339,21 +339,36 @@ def fold_events_file(args, f, fd, fdd):
     if ev.size == 0:
         raise SystemExit("prepfold -events: no events in %s"
                          % args.infile)
-    if args.offset:
-        ev = ev + args.offset
+    ev = np.sort(ev)
+    # read_events semantics (prepfold_utils.c:289-306): -offset is in
+    # the INPUT units (s, days, or MJDays) and defaults to -first_event
+    # for non-MJD input — so un-offset folds re-zero to the first
+    # event, while an explicit -offset keeps times tied to the .inf
+    # epoch (what -mjds/-absphase rely on).  The check is by VALUE,
+    # like the reference's: an explicit "-offset 0" also re-zeroes.
+    off = float(args.offset)
+    if off == 0.0 and not args.mjds:
+        off = -float(ev[0])
     if args.mjds:
-        ev = (ev - (mjd0 or ev.min())) * 86400.0
+        ev = ev + off
+        ev = (ev - (mjd0 or float(ev.min()))) * 86400.0
     elif args.days:
-        ev = ev * 86400.0
-    ev = ev - ev.min()
-    T = float(ev.max()) or 1.0
-    lo, hi = args.startT * T, args.endT * T
-    ev = ev[(ev >= lo) & (ev <= hi)] - lo
+        ev = (ev + off) * 86400.0
+    else:
+        ev = ev + off
+    # -start/-end are fractions of the .inf duration when known (else
+    # the event span); times stay as seconds from the epoch, T = last
+    # kept event (prepfold_utils.c:308-338, prepfold.c:407-413)
+    Ttot = (float(info.N * info.dt)
+            if info is not None and info.N and info.dt
+            else (float(ev.max()) or 1.0) + 1e-8)
+    lo, hi = args.startT * Ttot, args.endT * Ttot
+    ev = ev[(ev >= lo) & (ev < hi)]
     if ev.size == 0:
         raise SystemExit("prepfold -events: -start/-end window "
                          "contains no events")
-    T = float(ev.max()) or 1.0
-    _apply_absphase(args, mjd0 + lo / 86400.0)
+    T = (float(ev.max()) or 1.0) + 1e-8
+    _apply_absphase(args, mjd0)
     proflen = args.proflen or _auto_proflen(1.0 / f, T / 1e6)
     cfg = _make_cfg(args, proflen, 1, search_dm=False)
     delays, delaytimes = _orbit_model(args, T, mjd0)
@@ -493,11 +508,13 @@ def run(args):
                * hdr0.nchans}
         fb0.close()
     f, fd, fdd = _fold_params(args, T, obs)
-    if args.pfact != 1.0:        # p *= pfact  =>  f /= pfact
-        f, fd = f / args.pfact, fd / args.pfact
-    if args.ffact != 1.0:
-        f, fd, fdd = (f * args.ffact, fd * args.ffact,
-                      fdd * args.ffact)
+    # -pfact/-ffact are reciprocal, not independent: pfact beats ffact,
+    # and all of f/fd/fdd scale by ffact (prepfold.c:845-861)
+    if args.pfact == 0.0 or args.ffact == 0.0:
+        raise SystemExit("prepfold: -pfact/-ffact cannot be 0")
+    ffact = (1.0 / args.pfact if args.pfact != 1.0 else args.ffact)
+    if ffact != 1.0:
+        f, fd, fdd = f * ffact, fd * ffact, fdd * ffact
 
     if args.events:
         res, cfg, candnm = fold_events_file(args, f, fd, fdd)

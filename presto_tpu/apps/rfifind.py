@@ -127,7 +127,9 @@ def run(args):
         zap_chans = sorted(set(zap_chans) | set(ignore.tolist()))
     zap_ints = parse_ranges(args.zapints) if args.zapints else []
     if args.blocks > 0:
-        blk = getattr(fb, "ptsperblk", 0) or 1024    # SUBSBLOCKLEN
+        # spectra_per_subint analog: NSBLK for PSRFITS, 2400 for
+        # SIGPROC (rfifind.c:214, sigproc_fb.c:388)
+        blk = getattr(fb, "ptsperblk", 0) or 1024
         ptsperint = args.blocks * int(blk)
     else:
         ptsperint = max(1, int(args.time / hdr.tsamp + 0.5))

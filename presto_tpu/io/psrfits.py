@@ -211,6 +211,11 @@ class PsrfitsFile:
     def nspectra(self) -> int:
         return int(self.N)
 
+    @property
+    def ptsperblk(self) -> int:
+        """Spectra per block = spectra per subint (rfifind.c:214)."""
+        return int(self.nsblk)
+
     def close(self):
         for f in self.files:
             f.close()

@@ -236,6 +236,16 @@ class FilterbankFile:
     def nspectra(self) -> int:
         return self.header.N
 
+    @property
+    def ptsperblk(self) -> int:
+        """Spectra per "block" for interval sizing (rfifind -blocks).
+
+        SIGPROC filterbanks are flat streams with no native block
+        structure; the reference adopts 2400 spectra as the blocksize
+        (sigproc_fb.c:388).
+        """
+        return 2400
+
     def read_spectra(self, start: int, count: int) -> np.ndarray:
         """Read `count` spectra starting at `start`; zero-pad past EOF."""
         hdr = self.header
@@ -353,6 +363,10 @@ class FilterbankSet:
     @property
     def nspectra(self) -> int:
         return self.header.N
+
+    @property
+    def ptsperblk(self) -> int:
+        return 2400              # see FilterbankFile.ptsperblk
 
     def read_spectra(self, start: int, count: int) -> np.ndarray:
         out = np.zeros((count, self.header.nchans), dtype=np.float32)

@@ -177,6 +177,11 @@ def search_minifft_batch(windows: np.ndarray, T: float, full_N: float,
     numminifft = fftlen // 2
     if numbetween not in (1, 2):
         raise ValueError("numbetween must be 1 or 2")
+    if interbin:
+        # interbinning implies 2 points/bin; the reference overrides
+        # numbetween rather than honoring -numbetween 1
+        # (minifft.c:67-70)
+        numbetween = 2
     if max_orb_p is None:
         max_orb_p = T / 2.0 if not checkaliased else T / 1.2
     lobin = max(int(np.ceil(2 * numminifft * min_orb_p / T)), 1)

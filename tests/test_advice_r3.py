@@ -1,0 +1,129 @@
+"""Regression tests for the round-2 advisor findings: reader
+ptsperblk for rfifind -blocks, prepfold -pfact/-ffact reciprocity,
+prepfold -events offset/epoch handling, and interbin forcing
+numbetween=2."""
+
+import os
+
+import numpy as np
+import pytest
+
+from presto_tpu.models.synth import FakeSignal, fake_filterbank_file
+
+
+@pytest.fixture(scope="module")
+def datfile(tmp_path_factory):
+    d = tmp_path_factory.mktemp("advice")
+    path = str(d / "fake.fil")
+    sig = FakeSignal(f=7.8125, dm=0.0, shape="gauss", width=0.06,
+                     amp=1.5)
+    fake_filterbank_file(path, N=1 << 14, dt=5e-4, nchan=8,
+                         lofreq=1350.0, chanwidth=3.0, signal=sig,
+                         noise_sigma=2.0, nbits=8)
+    from presto_tpu.apps import prepdata
+    base = str(d / "psr")
+    prepdata.run(prepdata.build_parser().parse_args(
+        ["-dm", "0.0", "-o", base, path]))
+    return base, sig, d
+
+
+def test_ptsperblk_sigproc(tmp_path):
+    """rfifind -blocks sizes an interval in reader blocks: 2400
+    spectra for SIGPROC (sigproc_fb.c:388)."""
+    from presto_tpu.io.sigproc import FilterbankFile
+    path = str(tmp_path / "t.fil")
+    fake_filterbank_file(path, N=4096, dt=1e-3, nchan=4,
+                         lofreq=1350.0, chanwidth=3.0,
+                         signal=FakeSignal(f=1.0, dm=0.0),
+                         noise_sigma=1.0, nbits=8)
+    with FilterbankFile(path) as fb:
+        assert fb.ptsperblk == 2400
+
+
+def test_ptsperblk_psrfits(tmp_path):
+    """PSRFITS blocks are subints: ptsperblk == NSBLK
+    (rfifind.c:214)."""
+    from presto_tpu.io.psrfits import PsrfitsFile, write_psrfits
+    path = str(tmp_path / "t.fits")
+    nchan, nsblk = 4, 64
+    data = np.random.default_rng(0).normal(
+        100, 5, (nsblk * 4, nchan)).astype(np.float32)
+    freqs = 1350.0 + 3.0 * np.arange(nchan)
+    write_psrfits(path, data, 1e-3, freqs, nsblk=nsblk)
+    with PsrfitsFile([path]) as pf:
+        assert pf.ptsperblk == nsblk
+
+
+def test_pfact_matches_reciprocal_ffact(datfile):
+    """-pfact P folds at f/P, fd/P, fdd/P — identical to -ffact 1/P —
+    and beats a simultaneously given -ffact (prepfold.c:845-861)."""
+    from presto_tpu.apps import prepfold as prepfold_app
+    base, sig, d = datfile
+    runs = {}
+    for tag, extra in [("pfact", ["-pfact", "2.0"]),
+                       ("ffact", ["-ffact", "0.5"]),
+                       ("both", ["-pfact", "2.0", "-ffact", "3.0"])]:
+        res = prepfold_app.run(prepfold_app.build_parser().parse_args(
+            ["-f", "%.6f" % sig.f, "-fd", "1e-7", "-fdd", "1e-12",
+             "-nosearch", "-npart", "4", "-n", "16",
+             "-o", str(d / ("pf_" + tag))] + extra + [base + ".dat"]))
+        runs[tag] = res
+    for tag in ("pfact", "ffact", "both"):
+        assert runs[tag].best_f == pytest.approx(sig.f / 2.0, rel=1e-9)
+        assert runs[tag].best_fd == pytest.approx(5e-8, rel=1e-6)
+        np.testing.assert_allclose(runs[tag].cube, runs["pfact"].cube)
+
+
+def test_events_offset_not_noop(tmp_path):
+    """An explicit -offset keeps event times tied to the epoch instead
+    of being cancelled by re-zeroing (prepfold_utils.c:289-306): a
+    fold of events [t0, t0+span] with -offset -t0 must equal the fold
+    of [0, span] with no offset."""
+    from presto_tpu.apps import prepfold as prepfold_app
+    rng = np.random.default_rng(1)
+    f0 = 3.0
+    # events drawn with phase structure so profiles are nontrivial
+    base_t = np.sort(rng.uniform(0, 50.0, 4000))
+    keep = rng.uniform(size=base_t.size) < \
+        0.5 + 0.4 * np.cos(2 * np.pi * f0 * base_t)
+    ev0 = base_t[keep]
+    ev0 -= ev0[0]                # anchor first event at exactly 0
+    t0 = 1000.0
+
+    def fold(tag, events, extra):
+        p = str(tmp_path / ("ev_%s.txt" % tag))
+        np.savetxt(p, events)
+        return prepfold_app.run(prepfold_app.build_parser().parse_args(
+            ["-events", "-f", "%.6f" % f0, "-nosearch",
+             "-npart", "4", "-n", "16",
+             "-o", p + "_fold", p] + extra))
+
+    r_plain = fold("plain", ev0, [])
+    r_off = fold("off", ev0 + t0, ["-offset", "%.1f" % (-t0)])
+    np.testing.assert_allclose(r_off.cube, r_plain.cube)
+    # un-offset non-MJD events re-zero to the first event, so a
+    # constant shift with no -offset changes nothing either
+    r_shift = fold("shift", ev0 + t0, [])
+    np.testing.assert_allclose(r_shift.cube, r_plain.cube)
+
+
+def test_interbin_forces_numbetween_2():
+    """search_bin -numbetween 1 -interbin must still interbin: the
+    reference forces numbetween=2 with interbinning (minifft.c:67-70).
+    The candidate r grid must land on half-bins, impossible at
+    numbetween=1."""
+    from presto_tpu.search.phasemod import search_minifft_batch
+    fftlen = 1024
+    n = np.arange(fftlen)
+    # power series whose miniFFT has a tone at half-integer bin 100.5
+    win = (10.0 + 5.0 * np.cos(2 * np.pi * 100.5 * n / fftlen)
+           + np.random.default_rng(2).normal(0, 0.1, fftlen)
+           ).astype(np.float32)
+    cands = search_minifft_batch(
+        win[None], 1e6, 1e7,
+        np.array([0.0]), numharm=1, interbin=True, numbetween=1,
+        checkaliased=False)
+    assert cands, "no candidates returned"
+    rs = np.array([c.mini_r for c in cands])
+    assert np.any(np.abs(rs * 2 - np.round(rs * 2)) < 1e-9) and \
+        np.any(np.abs(rs - np.round(rs)) > 0.25), rs
