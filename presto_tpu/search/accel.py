@@ -39,7 +39,7 @@ from typing import List, Optional
 import numpy as np
 import jax
 import jax.numpy as jnp
-from functools import partial
+from functools import lru_cache, partial
 
 from presto_tpu.ops import responses as resp
 from presto_tpu.ops import stats as st
@@ -152,7 +152,7 @@ class AccelKernels:
 
     Kernels are stored TIME-DOMAIN, centered in a common kmax-tap
     window (kmax = 2*NUMBETWEEN*halfwidth of the widest kernel); the
-    host uploads this compact bank and _fft_kernel_bank expands it to
+    host uploads this compact bank and _fft_kernel_bank_c expands it to
     the FFT'd fftlen bank on device (a ~20x upload saving through the
     tunneled link; one bank per w plane in the jerk search).
     """
@@ -208,11 +208,11 @@ class AccelKernels:
 def fft_kernel_bank_np(kern: "AccelKernels") -> np.ndarray:
     """Host-side expansion of the compact time-domain bank to the
     FFT'd [numz, fftlen, 2] bank _ffdot_blocks consumes (the numpy
-    twin of _fft_kernel_bank, for driver entry points and referee
+    twin of _fft_kernel_bank_c, for driver entry points and referee
     paths that want plain arrays).
 
     NOTE: this twin FFTs in complex128 then rounds, while the device's
-    _fft_kernel_bank FFTs in complex64 — the two banks agree only to
+    _fft_kernel_bank_c FFTs in complex64 — the two banks agree only to
     float32 rounding, not bit-for-bit (accel_ref's referee compares
     candidate lists, where the difference is far below threshold)."""
     kc = kern.kern_pairs[..., 0] + 1j * kern.kern_pairs[..., 1]
@@ -225,22 +225,19 @@ def fft_kernel_bank_np(kern: "AccelKernels") -> np.ndarray:
 
 
 @partial(jax.jit, static_argnames=("fftlen",))
-def _fft_kernel_bank(kern_tpairs, fftlen):
-    """Device prep of the FFT'd kernel bank from the compact centered
-    time-domain bank: NR wrap placement (place_complex_kernel,
-    corr_prep.c:58-80) + forward FFT.  Runs once per bank — the host
-    uploads only numz*kmax*8 bytes instead of numz*fftlen*8 (a ~20x
-    saving through the tunneled host->TPU link; the jerk search
-    uploads one bank per w plane)."""
-    kc = kern_tpairs[..., 0] + 1j * kern_tpairs[..., 1]  # [numz, kmax]
+def _fft_kernel_bank_c(kern_tpairs, fftlen):
+    """FFT'd complex64 device bank from the compact time-domain bank
+    (NR wrap placement, corr_prep.c:58-80 + forward FFT) — the form
+    the build hot path consumes (see the dtype note on _kern_bank_z;
+    the compact time-domain bank still uploads as pairs)."""
+    kc = kern_tpairs[..., 0] + 1j * kern_tpairs[..., 1]
     kmax = kc.shape[-1]
     half = kmax // 2
     numz = kc.shape[0]
     placed = jnp.zeros((numz, fftlen), dtype=jnp.complex64)
     placed = placed.at[:, :half].set(kc[:, half:])
     placed = placed.at[:, fftlen - half:].set(kc[:, :half])
-    kern = jnp.fft.fft(placed, axis=-1)
-    return jnp.stack([kern.real, kern.imag], axis=-1).astype(jnp.float32)
+    return jnp.fft.fft(placed, axis=-1)
 
 
 @partial(jax.jit, static_argnames=("uselen", "fftlen", "halfwidth"))
@@ -251,7 +248,7 @@ def _ffdot_blocks(seg_pairs, kern_pairs, uselen, fftlen, halfwidth):
         amplitudes for each block's read window (lobin = block_rlo -
         halfwidth, fftlen//2 whole bins).
     kern_pairs: [numz, fftlen, 2] float32 — FFT'd kernel bank (device,
-        from _fft_kernel_bank).
+        from _fft_kernel_bank_c).
     Returns [nblocks, numz, uselen] float32 powers.
 
     Parity with the per-row loop of accel_utils.c:1002-1051: spread ×2,
@@ -278,17 +275,147 @@ def _ffdot_blocks(seg_pairs, kern_pairs, uselen, fftlen, halfwidth):
     return (good.real ** 2 + good.imag ** 2).astype(jnp.float32)
 
 
-@jax.jit
-def _block_median_norms(seg_pairs):
+# ----------------------------------------------------------------------
+# Factored MXU-DFT correlation engine
+# ----------------------------------------------------------------------
+#
+# XLA's TPU FFT is a multi-pass HBM-bound loop, and the correlation
+# pipeline around it (spread scatter, kernel cmul, inverse FFT,
+# |.|^2, then a plane-sized [B, numz, .] -> [numz, B*.] relayout)
+# costs several full traversals of multi-GB complex intermediates.
+# The factored engine computes the same correlation as two small DFT
+# matmul stages (fftlen = n1 * 128) on the MXU, with the inverse
+# written directly in z-major order ('zxic' einsum output) so the
+# slab lands in plane layout with NO post-hoc transpose.  Validated
+# at HIGHEST precision to the same float32 error vs a float64 FFT as
+# the jnp.fft path (3.2e-7 vs 3.6e-7 max rel on the bench workload).
+
+_DFT_N2 = 128                    # lane-width radix of stage 2
+
+ACCEL_ENGINE = os.environ.get("PRESTO_TPU_ACCEL_ENGINE", "auto")
+
+
+def _use_mxu_engine(fftlen: int) -> bool:
+    """auto: factored engine on TPU (pocketfft-backed XLA FFT wins on
+    CPU), when fftlen factors as n1*128 with even n1 (the spread trick
+    needs n2/2 integral)."""
+    ok = fftlen % (2 * _DFT_N2) == 0
+    if ACCEL_ENGINE == "mxu":
+        return ok
+    if ACCEL_ENGINE == "fft":
+        return False
+    try:
+        return ok and jax.devices()[0].platform == "tpu"
+    except Exception:
+        return False
+
+
+@lru_cache(maxsize=8)
+def _dft_consts_np(fftlen: int):
+    """Pair-format (f32 [..., 2]) DFT stage constants — complex arrays
+    cannot cross the host->device boundary on the tunneled TPU, so
+    they upload as pairs and recombine under jit.
+
+    Factorization (time i = i1*n2 + i2, freq k = k1 + n1*k2):
+      fwd   Y[k1, j] = sum_i1 D1[k1, i1] x[i1*(n2/2) + j]   (spread
+            data: only even i2 = 2j are nonzero, halving stage 2)
+            S[k1, k2] = (Y * T2) @ D2m, tiled 2x along k2
+      inv   q = P @ C2;  corr[i1, i2] = iD1 @ (q * Tb)
+    """
+    n2 = _DFT_N2
+    n1 = fftlen // n2
+    m = n2 // 2
+
+    def pairs(c):
+        return np.stack([c.real, c.imag], -1).astype(np.float32)
+
+    k1 = np.arange(n1)
+    i1 = np.arange(n1)
+    j = np.arange(m)
+    k2 = np.arange(n2)
+    i2 = np.arange(n2)
+    D1 = np.exp(-2j * np.pi * np.outer(k1, i1) / n1)
+    T2 = np.exp(-2j * np.pi * np.outer(k1, 2 * j) / fftlen)
+    D2m = np.exp(-2j * np.pi * np.outer(j, np.arange(m)) / m)
+    C2 = np.exp(+2j * np.pi * np.outer(k2, i2) / n2)
+    Tb = np.exp(+2j * np.pi * np.outer(k1, i2) / fftlen) / fftlen
+    iD1 = np.exp(+2j * np.pi * np.outer(i1, k1) / n1)
+    return tuple(pairs(c) for c in (D1, T2, D2m, C2, Tb, iD1))
+
+
+@partial(jax.jit, static_argnames=("fftlen",))
+def _kern_bank_z(kern_c, fftlen):
+    """FFT'd complex bank [numz, fftlen] -> conjugated stage-layout
+    bank [numz, n1, n2] (Z[k1, k2] = Kfft[k1 + n1*k2]).
+
+    NOTE on dtypes in this module's device path: everything internal
+    is complex64, NOT float32 [..., 2] pairs — a trailing dim of 2
+    lands on the TPU lane axis and is padded 2 -> 128, a 64x tax on
+    every byte moved (measured: the 561 window slices alone cost
+    121 ms in pair layout).  Pairs appear only at host<->device
+    boundaries (the axon link cannot transfer complex)."""
+    n1 = fftlen // _DFT_N2
+    return jnp.conj(kern_c).reshape(
+        kern_c.shape[0], _DFT_N2, n1).transpose(0, 2, 1)
+
+
+def _ffdot_slab_mxu(data, kz, consts, uselen, fftlen, halfwidth):
+    """Factored-DFT twin of _ffdot_blocks, returning the slab in plane
+    layout [numz, B*uselen] (z-major, blocks concatenated along
+    columns) — same math, same normalization, no output transpose.
+
+    data: [B, fftlen//2] complex64 block windows; kz: _kern_bank_z
+    bank; consts: _dft_consts pair arrays."""
+    n2 = _DFT_N2
+    n1 = fftlen // n2
+    m = n2 // 2
+    B = data.shape[0]
+    cx = lambda p: p[..., 0] + 1j * p[..., 1]
+    D1, T2, D2m, C2, Tb, iD1 = (cx(c) for c in consts)
+    numz = kz.shape[0]
+    prec = jax.lax.Precision.HIGHEST
+    x2 = data.reshape(B, n1, m)
+    Y = jnp.einsum("ab,xbj->xaj", D1, x2, precision=prec)
+    Sm = jnp.einsum("xaj,jk->xak", Y * T2[None], D2m, precision=prec)
+    S = jnp.concatenate([Sm, Sm], axis=-1)               # [B, n1, n2]
+    Pm = S[:, None] * kz[None]                           # [B,numz,n1,n2]
+    q = jnp.einsum("xzab,bc->xzac", Pm, C2, precision=prec)
+    corr = jnp.einsum("ia,xzac->zxic", iD1, q * Tb[None, None],
+                      precision=prec)                    # [numz,B,n1,n2]
+    pw = (corr.real ** 2 + corr.imag ** 2).astype(jnp.float32)
+    pw = pw.reshape(numz, B, fftlen)
+    off = halfwidth * ACCEL_NUMBETWEEN
+    pw = jax.lax.slice(pw, (0, 0, off), (numz, B, off + uselen))
+    return pw.reshape(numz, B * uselen)
+
+
+def _ffdot_slab_fft(data, kern_c, uselen, fftlen, halfwidth):
+    """jnp.fft twin of _ffdot_slab_mxu (complex in, z-major slab out)
+    — the engine used where the factored transform doesn't apply
+    (CPU, or fftlen not a multiple of 256)."""
+    B = data.shape[0]
+    numz = kern_c.shape[0]
+    spread = jnp.zeros((B, fftlen), dtype=jnp.complex64)
+    spread = spread.at[:, ::ACCEL_NUMBETWEEN].set(data)
+    fdata = jnp.fft.fft(spread, axis=-1)
+    prod = fdata[:, None, :] * jnp.conj(kern_c)[None]
+    corr = jnp.fft.ifft(prod, axis=-1)
+    offset = halfwidth * ACCEL_NUMBETWEEN
+    good = jax.lax.dynamic_slice_in_dim(corr, offset, uselen, axis=2)
+    pw = (good.real ** 2 + good.imag ** 2).astype(jnp.float32)
+    return jnp.moveaxis(pw, 0, 1).reshape(numz, B * uselen)
+
+
+def _block_median_norms_c(data):
     """Old-style per-block median power normalization factors.
 
     norm = 1/sqrt(median(|amps|^2)/ln2) (accel_utils.c:952-967).
-    seg_pairs: [nblocks, numdata, 2] -> [nblocks, 1, 1] scale to apply
-    to amplitudes (the reference scales data before correlating).
-    """
-    pows = seg_pairs[..., 0] ** 2 + seg_pairs[..., 1] ** 2
-    med = jnp.maximum(jnp.median(pows, axis=-1), 1e-30)  # all-zero guard
-    return (1.0 / jnp.sqrt(med / jnp.log(2.0)))[:, None, None]
+    data: [B, numdata] complex windows -> [B, 1] float32 scale (the
+    reference scales data before correlating)."""
+    pows = data.real ** 2 + data.imag ** 2
+    med = jnp.maximum(jnp.median(pows, axis=-1), 1e-30)
+    return (1.0 / jnp.sqrt(med / jnp.log(2.0))).astype(jnp.float32)[
+        :, None]
 
 
 # ----------------------------------------------------------------------
@@ -557,11 +684,19 @@ class AccelSearch:
     # -- plane ---------------------------------------------------------
 
     def _plan_blocks(self):
-        """r-block starts (whole bins) covering [8, rhi] like the
+        """r-block starts (whole bins) covering [0, rhi] — the
         reference's inmem pre-population + search loops
-        (accelsearch.c:143-160)."""
+        (accelsearch.c:143-160) start at r=8; this grid starts at r=0
+        so plane columns stay tile-aligned (col0=16 puts every concat
+        joint of the plane assembly at a misaligned lane offset, a
+        measured ~2x write-cost tax on v5e).  Deviation: the first
+        block's median-normalization window covers [0, uselen/2)
+        instead of [8, 8+uselen/2) — 8 bins of content out of 4096,
+        immaterial to the robust median — and columns below rlo are
+        computed but filtered at collect time (_collect_slab r0min),
+        exactly like any other below-rlo column of an aligned slab."""
         blocks = []
-        startr = 8.0
+        startr = 0.0
         step = self.cfg.uselen * ACCEL_DR
         # Only full, in-spectrum blocks are built/searched — same bound
         # as the reference loop (accelsearch.c:167): a partial block at
@@ -578,34 +713,31 @@ class AccelSearch:
         through the host<->TPU link would dominate the search time).
 
         plane column c = absolute half-bin (r = c * ACCEL_DR), starting
-        at column 0 == r 0 (columns below 16 are zero: the search and
-        pre-population start at r=8 as in accelsearch.c:144).  Block j
-        occupies the contiguous columns [16 + j*uselen, 16 + (j+1)*
-        uselen): starts are 8 + j*uselen*DR, all integral, so each
-        device chunk writes one contiguous slab via dynamic_update_slice.
+        at column 0 == r 0.  Block j occupies the contiguous columns
+        [j*uselen, (j+1)*uselen): starts are j*uselen*DR (the r=0
+        block-grid origin of _plan_blocks; columns below rlo are
+        filtered at collect time), so the per-chunk slabs concatenate
+        directly into the plane.
         fft_pairs: [numbins, 2] float32 (the packed .fft as pairs).
         """
-        cfg, kern = self.cfg, self.kern
+        kern = self.kern
         starts = self._plan_blocks()
         if not starts:
             # spectrum too short for one full block: empty plane
             return jnp.zeros((kern.numz, 0), dtype=jnp.float32)
         if kern_pairs_dev is None:
             kern_pairs_dev = self._kern_bank_dev()
-        yp = self._ys_plan()
-        if yp is not None:
-            key = ("build_ys",) + yp.key
-            self._build_plan = (key, yp.lobin_chunks)
-            if key not in self._fn_cache:
-                self._fn_cache[key] = jax.jit(yp.build_body)
-            return self._fn_cache[key](self._to_dev(fft_pairs),
-                                       jnp.asarray(yp.lobin_chunks),
-                                       kern_pairs_dev)
-        return self._build_carry(fft_pairs, kern_pairs_dev)
+        yp = self._build_plan_ns()
+        key = ("build",) + yp.key
+        self._build_plan = key
+        if key not in self._fn_cache:
+            self._fn_cache[key] = jax.jit(yp.build_body)
+        return self._fn_cache[key](self._to_dev(fft_pairs),
+                                   kern_pairs_dev)
 
     def _kern_bank_dev(self):
         if self._kern_dev is None:   # one small upload, reused
-            self._kern_dev = _fft_kernel_bank(
+            self._kern_dev = _fft_kernel_bank_c(
                 jnp.asarray(self.kern.kern_pairs), self.kern.fftlen)
         return self._kern_dev
 
@@ -642,9 +774,15 @@ class AccelSearch:
         plane_numr += (-plane_numr) % align
         # Chunk the block batch: the [chunk, numz, fftlen] complex
         # intermediate is the peak working memory, so bound it — the
-        # HBM-ladder analog of meminfo.h.
+        # HBM-ladder analog of meminfo.h.  Round down to the smallest
+        # chunk keeping chunk*uselen a lane-tile multiple (aligned
+        # concat joints / DUS offsets).
         chunk = max(1, int(CHUNK_BUDGET_BYTES
                            // (kern.numz * kern.fftlen * 8)))
+        import math as _math
+        almul = 128 // _math.gcd(cfg.uselen, 128)
+        if chunk >= almul:
+            chunk -= chunk % almul
         col0 = int(starts[0]) * ACCEL_RDR
         # Host uploads ONLY the raw spectrum; the per-block read
         # windows are gathered on device (the tunneled host->TPU link
@@ -658,8 +796,15 @@ class AccelSearch:
         npad_blocks = nsteps * chunk - nblocks
         lobin0 = int(starts[0]) - kern.halfwidth
         pad_lo = max(0, -lobin0)
+        # cover the last real window AND the frame builder's (F+P)*hop
+        # base region (padded frames read zeros there)
+        hop = int(cfg.uselen * ACCEL_DR)
+        F = nsteps * chunk
+        P = -(-numdata // hop)
         pad_hi = numdata + max(
             0, int(starts[-1]) - kern.halfwidth + numdata - self.numbins)
+        pad_hi = max(pad_hi,
+                     lobin0 + pad_lo + (F + P) * hop - self.numbins)
         lobins = np.asarray(
             [int(s0) - kern.halfwidth for s0 in starts]
             + [self.numbins] * npad_blocks, np.int32) + pad_lo
@@ -667,110 +812,173 @@ class AccelSearch:
         self._geom = SimpleNamespace(
             starts=starts, numdata=numdata, plane_numr=plane_numr,
             chunk=chunk, nsteps=nsteps, col0=col0, nblocks=nblocks,
-            lobins=lobins, lobin_chunks=lobins.reshape(nsteps, chunk),
+            lobins=lobins,
             pads=((pad_lo, pad_hi), (0, 0)),
             body_numr=nsteps * chunk * cfg.uselen)
         return self._geom
 
     def _chunk_slab_fn(self, g):
-        """Per-chunk slab computation.  kern_dev is an ARGUMENT (not a
-        closure) so the jerk search's per-w kernel banks share one
-        compiled function."""
+        """Per-chunk slab computation: [chunk, numdata] complex block
+        windows -> [numz, chunk*uselen] slab in plane (z-major)
+        layout.  kern_use is an ARGUMENT (not a closure) so the jerk
+        search's per-w kernel banks share one compiled function; it is
+        the complex FFT'd bank for the fft engine and the stage-layout
+        conj bank (_kern_bank_z) for the mxu engine."""
         cfg, kern = self.cfg, self.kern
+        use_mxu = _use_mxu_engine(kern.fftlen)
+        consts = _dft_consts_np(kern.fftlen) if use_mxu else None
 
-        def chunk_slab(fft_pad, lobin_chunk, kern_dev):
-            idx = lobin_chunk[:, None] + jnp.arange(g.numdata)
-            batch = fft_pad[idx]            # [chunk, numdata, 2]
+        def chunk_slab(data, kern_use):
             if cfg.norm == "median":
-                batch = batch * _block_median_norms(batch)
-            powers = _ffdot_blocks(batch, kern_dev, cfg.uselen,
+                data = data * _block_median_norms_c(data)
+            if use_mxu:
+                return _ffdot_slab_mxu(
+                    data, kern_use, tuple(map(jnp.asarray, consts)),
+                    cfg.uselen, kern.fftlen, kern.halfwidth)
+            return _ffdot_slab_fft(data, kern_use, cfg.uselen,
                                    kern.fftlen, kern.halfwidth)
-            # [chunk, numz, uselen] -> [numz, chunk*uselen] slab
-            return jnp.moveaxis(powers, 0, 1).reshape(kern.numz, -1)
+
+        chunk_slab.use_mxu = use_mxu
         return chunk_slab
 
-    def _ys_plan(self):
-        """Carry-free plane-build plan: a scan stacking per-chunk slabs
-        (ys), placed into the plane with ONE transpose-pad copy — a
-        carried-plane dynamic_update_slice costs a large fraction of a
-        plane traversal per scan step.  The stacked ys is a second
-        plane-sized buffer, so returns None (-> carry variant) when 2x
-        plane would crowd HBM (~16 GB on v5e)."""
+    def _frames_fn(self, g):
+        """All block read windows at once, from the uniform block grid
+        (hop = uselen*ACCEL_DR bins): two reshapes + one concat
+        instead of per-block slices (561 dynamic_slice ops measured
+        ~100 ms on v5e; this is one pass over ~18 MB).  Returns
+        f(fft_raw_pairs) -> [nframes, numdata] complex64, where frames
+        past the real blocks read the zero padding (the padded-block
+        contract of _plane_geom)."""
+        kern = self.kern
+        hop = int(self.cfg.uselen * ACCEL_DR)
+        L = g.numdata
+        F = g.nsteps * g.chunk
+        lob0 = int(g.lobins[0])
+        pad_lo, pad_hi = g.pads[0]
+        P = -(-L // hop)              # rows each frame spans
+
+        def frames(fft_raw):
+            c = jnp.pad(fft_raw[:, 0] + 1j * fft_raw[:, 1],
+                        (pad_lo, pad_hi))
+            base = jax.lax.slice(c, (lob0,), (lob0 + (F + P) * hop,))
+            A = base.reshape(F + P, hop)
+            parts = [jax.lax.slice(A, (p, 0),
+                                   (p + F, min(hop, L - p * hop)))
+                     for p in range(P)]
+            return jnp.concatenate(parts, axis=1) if P > 1 else parts[0]
+        return frames
+
+    # how many chunk bodies are unrolled for the concat assembly before
+    # falling back to a scanned DUS carry (HLO size bound; planes that
+    # big exceed single-chip HBM anyway and stream through oocfft)
+    _UNROLL_CHUNKS = 48
+
+    def _build_plan_ns(self):
+        """Plane-build plan: unrolled per-chunk z-major slabs joined by
+        ONE concatenate (the plane is written exactly once — both the
+        stacked-ys moveaxis assembly (~350 ms) and a scanned
+        dynamic_update_slice carry (~185 ms: XLA copies the carried
+        plane each step) measured as the dominant cost of the round-2
+        build on v5e).  Falls back to the DUS-carry scan when nsteps
+        is too large to unroll."""
         g = self._plane_geom()
         if g is False:
             return None
         kern = self.kern
-        # plane + stacked ys must leave room for the chunk
-        # intermediate and output staging (derived from the one shared
-        # HBM constant so budgets cannot stack past the device)
-        if (kern.numz * (g.plane_numr + g.body_numr) * 4) >= \
-                (DEVICE_HBM_BYTES * 9) // 16:
-            return None
         if getattr(g, "build_body", None) is None:
             chunk_slab = self._chunk_slab_fn(g)
             plane_numr, col0, pads = g.plane_numr, g.col0, g.pads
             numz = kern.numz
+            cw = g.chunk * self.cfg.uselen
+            use_mxu = chunk_slab.use_mxu
+            fftlen = kern.fftlen
 
-            body_w = min(g.body_numr, plane_numr - col0)
+            def prep_bank(kern_c):
+                return _kern_bank_z(kern_c, fftlen) if use_mxu \
+                    else kern_c
 
-            def build_body(fft_raw, lobin_chunks, kern_dev):
-                fft_pad = jnp.pad(fft_raw, pads)
-                def body(_, lc):
-                    return None, chunk_slab(fft_pad, lc, kern_dev)
-                _, ys = jax.lax.scan(body, None, lobin_chunks)
-                body_arr = jnp.moveaxis(ys, 0, 1).reshape(
-                    numz, -1)[:, :body_w]
-                return jnp.pad(
-                    body_arr,
-                    ((0, 0), (col0, plane_numr - col0 - body_w)))
+            frames_fn = self._frames_fn(g)
+            chunk = g.chunk
+
+            # the unrolled concat holds all slabs (~1x plane) PLUS the
+            # concat output plane; when 2x plane + the chunk
+            # intermediate would crowd HBM, stream through the 1x-plane
+            # DUS carry instead (slower, but it fits)
+            fits = (numz * (plane_numr + g.nsteps * cw) * 4
+                    + CHUNK_BUDGET_BYTES) < (DEVICE_HBM_BYTES * 9) // 16
+
+            if g.nsteps <= self._UNROLL_CHUNKS and fits:
+                def build_body(fft_raw, kern_dev):
+                    fr = frames_fn(fft_raw)
+                    kern_use = prep_bank(kern_dev)
+                    # optimization_barrier chain: unrolled chunks have
+                    # no data deps between them, and XLA's scheduler
+                    # will happily keep every chunk's multi-GB complex
+                    # intermediates alive at once (OOM on v5e); the
+                    # chain forces chunk i+1 to start after slab i
+                    slabs = []
+                    for i in range(g.nsteps):
+                        data = jax.lax.slice(
+                            fr, (i * chunk, 0),
+                            ((i + 1) * chunk, fr.shape[1]))
+                        slab = chunk_slab(data, kern_use)
+                        if i + 1 < g.nsteps:
+                            fr, slab = jax.lax.optimization_barrier(
+                                (fr, slab))
+                        slabs.append(slab)
+                    # keep only REAL blocks' columns (a padded frame
+                    # reads the spectrum tail + zero padding, so its
+                    # ~zero median blows the normalization up — its
+                    # output must never reach the plane), zero-fill
+                    # the alignment padding, and write everything with
+                    # one concatenate
+                    keep = min(plane_numr - col0,
+                               g.nblocks * self.cfg.uselen)
+                    over = g.nsteps * cw - keep
+                    if over > 0:
+                        slabs[-1] = jax.lax.slice(
+                            slabs[-1], (0, 0), (numz, cw - over))
+                    parts = [jnp.zeros((numz, col0), jnp.float32)] \
+                        if col0 else []
+                    parts += slabs
+                    right = plane_numr - col0 - sum(
+                        s.shape[1] for s in slabs)
+                    if right > 0:
+                        parts.append(jnp.zeros((numz, right),
+                                               jnp.float32))
+                    return jnp.concatenate(parts, axis=1)
+            else:
+                # DUS-carry fallback: chunks of REAL blocks only, the
+                # final chunk overlapping backwards (rewrites the same
+                # values) so padded-frame output never lands in the
+                # plane and every dispatch shares one shape
+                bstarts = [min(i * chunk, g.nblocks - chunk)
+                           for i in range(g.nsteps)]
+                start_cols = np.asarray(
+                    [col0 + b * self.cfg.uselen for b in bstarts],
+                    np.int32)
+                bstarts = np.asarray(bstarts, np.int32)
+
+                def build_body(fft_raw, kern_dev):
+                    fr = frames_fn(fft_raw)
+                    kern_use = prep_bank(kern_dev)
+                    pl = jnp.zeros((numz, plane_numr), jnp.float32)
+
+                    def body(pl, xs):
+                        b0, start_col = xs
+                        data = jax.lax.dynamic_slice(
+                            fr, (b0, 0), (chunk, fr.shape[1]))
+                        slabv = chunk_slab(data, kern_use)
+                        return jax.lax.dynamic_update_slice(
+                            pl, slabv, (0, start_col)), None
+                    pl, _ = jax.lax.scan(
+                        body, pl, (jnp.asarray(bstarts),
+                                   jnp.asarray(start_cols)))
+                    return pl
+
             g.build_body = build_body
-            g.key = (g.chunk, g.nsteps, g.plane_numr)
+            g.key = (g.chunk, g.nsteps, g.plane_numr, use_mxu)
         return g
-
-    def _build_carry(self, fft_pairs, kern_pairs_dev):
-        # carry fallback: per-step in-place slab writes over REAL
-        # blocks only (the final chunk overlaps backwards so no padded
-        # zero-windows ever overwrite computed columns)
-        g = self._plane_geom()
-        cfg, kern = self.cfg, self.kern
-        chunk, nblocks = g.chunk, g.nblocks
-        chunk_slab = self._chunk_slab_fn(g)
-        pads, plane_numr = g.pads, g.plane_numr
-        chunk_ids = []
-        c0 = 0
-        while c0 < nblocks:
-            if c0 + chunk > nblocks:
-                c0 = nblocks - chunk   # overlap: rewrites same values
-            chunk_ids.append(c0)
-            c0 += chunk
-        nsteps = len(chunk_ids)
-        lobin_chunks = np.stack([g.lobins[i:i + chunk]
-                                 for i in chunk_ids])
-        start_cols = np.asarray(
-            [g.col0 + i * cfg.uselen for i in chunk_ids], dtype=np.int32)
-        plane = jnp.zeros((kern.numz, plane_numr), dtype=jnp.float32)
-
-        self._build_plan = None     # carry fallback: no batched build
-        key = ("build", chunk, nsteps, plane_numr)
-        if key not in self._fn_cache:
-            @partial(jax.jit, donate_argnums=(0,))
-            def build_all(pl, fft_raw, lobin_chunks, start_cols,
-                          kern_dev):
-                fft_pad = jnp.pad(fft_raw, pads)
-                def body(pl, xs):
-                    lc, start_col = xs
-                    slabv = chunk_slab(fft_pad, lc, kern_dev)
-                    return jax.lax.dynamic_update_slice(
-                        pl, slabv, (0, start_col)), None
-                pl, _ = jax.lax.scan(body, pl,
-                                     (lobin_chunks, start_cols))
-                return pl
-            self._fn_cache[key] = build_all
-
-        return self._fn_cache[key](plane, self._to_dev(fft_pairs),
-                                   jnp.asarray(lobin_chunks),
-                                   jnp.asarray(start_cols),
-                                   kern_pairs_dev)
 
     # -- search --------------------------------------------------------
 
@@ -852,7 +1060,7 @@ class AccelSearch:
             # numharm == 1: no subharmonic reads — take the fused
             # build+search dispatch per w (no resident plane at all)
             for w in (float(x) for x in cfg.ws):
-                kern_dev = _fft_kernel_bank(
+                kern_dev = _fft_kernel_bank_c(
                     jnp.asarray(bank_for(w).kern_pairs),
                     self.kern.fftlen)
                 cs = self._search_fused(fft_pairs, slab, kern_dev)
@@ -873,9 +1081,10 @@ class AccelSearch:
         plane_bytes = max(self.kern.numz * g.plane_numr * 4, 1) \
             if g else 1
         # cache budget = shared HBM constant minus the plane-build
-        # working set (carry-free builds hold plane + stacked ys +
-        # chunk intermediate concurrently — see _ys_plan), so the two
-        # budgets cannot stack past the device
+        # working set (the concat build holds plane + the per-chunk
+        # slabs + chunk intermediate concurrently — see
+        # _build_plan_ns), so the two budgets cannot stack past the
+        # device
         build_ws = (self.kern.numz * g.body_numr * 4
                     + CHUNK_BUDGET_BYTES) if g else 0
         cache_budget = max(DEVICE_HBM_BYTES - build_ws - 2 * 2 ** 30,
@@ -895,7 +1104,7 @@ class AccelSearch:
                     else:
                         break
                 bank = bank_for(wg)
-                pl = self.build_plane(fft_pairs, _fft_kernel_bank(
+                pl = self.build_plane(fft_pairs, _fft_kernel_bank_c(
                     jnp.asarray(bank.kern_pairs), bank.fftlen))
             plane_cache[wg] = pl      # (re)insert most-recent
             return pl
@@ -936,9 +1145,9 @@ class AccelSearch:
         """Plane build + staged search in ONE device dispatch (the
         plane never surfaces; saves a host<->device round trip, which
         costs ~0.2-0.4 s through the tunneled TPU link).  Returns None
-        when the carry-free build plan doesn't apply (huge planes or
-        too-short spectra) — callers then take the two-dispatch path."""
-        yp = self._ys_plan()
+        when there is no build plan (too-short spectra) — callers then
+        take the two-dispatch path."""
+        yp = self._build_plan_ns()
         if yp is None:
             return None
         splan = self._slab_plan(yp.plane_numr, slab)
@@ -950,13 +1159,12 @@ class AccelSearch:
             build_body, scan_body = yp.build_body, scanner.body
 
             @jax.jit
-            def fused(fft_raw, lobin_chunks, kern_dev, scols):
-                return scan_body(
-                    build_body(fft_raw, lobin_chunks, kern_dev), scols)
+            def fused(fft_raw, kern_dev, scols):
+                return scan_body(build_body(fft_raw, kern_dev), scols)
             self._fn_cache[key] = fused
         packed = self._fn_cache[key](
-            self._to_dev(fft_pairs), jnp.asarray(yp.lobin_chunks),
-            kern_dev, jnp.asarray(start_cols, dtype=jnp.int32))
+            self._to_dev(fft_pairs), kern_dev,
+            jnp.asarray(start_cols, dtype=jnp.int32))
         return self._collect_packed(packed, start_cols)
 
     def _slab_plan(self, plane_numr: int, slab: int):
@@ -1084,19 +1292,14 @@ class AccelSearch:
         # first spectrum primes the caches and fixes the geometry
         p0 = self.build_plane(batch[0])
         numz, plane_numr = p0.shape
-        plan = getattr(self, "_build_plan", None)
         if plane_numr == 0:
             return [[] for _ in range(nd)]
-        if plan is None:
-            # carry-fallback geometry (huge planes): per-DM loop
-            return [self.search(batch[i], slab=slab)
-                    for i in range(nd)]
-        key, lobin_chunks = plan
+        key = self._build_plan
         build_one = self._fn_cache[key]
         mkey = ("build_many",) + key[1:]
         if mkey not in self._fn_cache:
             self._fn_cache[mkey] = jax.jit(
-                jax.vmap(build_one, in_axes=(0, None, None)))
+                jax.vmap(build_one, in_axes=(0, None)))
         build_many = self._fn_cache[mkey]
 
         splan = self._slab_plan(plane_numr, slab)
@@ -1104,7 +1307,6 @@ class AccelSearch:
             return [[] for _ in range(nd)]
         slab, k, scanner, start_cols = splan
         scols = jnp.asarray(start_cols, dtype=jnp.int32)
-        lob = jnp.asarray(lobin_chunks)
         self._kern_bank_dev()         # ensure the FFT'd device bank
 
         def collect_dm(vals, cidx, zrow):
@@ -1138,7 +1340,7 @@ class AccelSearch:
         done = 1
         for g0 in starts:
             sub = jnp.asarray(batch[g0:g0 + group])
-            planes = build_many(sub, lob, self._kern_dev)
+            planes = build_many(sub, self._kern_dev)
             vals, cidx, zrow = _unpack_scan(scanner.many(planes, scols))
             for d in range(vals.shape[0]):
                 if g0 + d < done:

@@ -5,13 +5,24 @@ the harmonic-sum gather") is HBM-bandwidth-bound in the XLA
 formulation: every subharmonic add materializes plane-sized
 intermediates (z-permuted copy, phase-stacked copy, accumulator
 update).  This kernel keeps one column tile of the accumulator in
-VMEM, DMAs exactly the source windows each harmonic needs from the
-HBM-resident plane, applies the z-row mapping AND the fractional-
-stride column mapping as one-hot MXU matmuls (exact selections;
-Mosaic cannot lower the interleave reshape the XLA phase trick
-uses), and reduces each stage to per-column (max over z, argmax) on
-the spot — the only HBM writes are the [stages, slab] reduction
-outputs, ~1000x smaller than the XLA path's intermediates.
+VMEM, DMAs exactly the source window each harmonic needs from the
+HBM-resident plane (only the z rows the term's zinds map can touch —
+~frac*numz of them), applies the fractional-stride column mapping as
+single-vreg lane gathers (tpu.dynamic_gather, decomposed over 128-lane
+source/output chunks; the dynamic DMA-alignment residual folds into
+the gather indices, so no vector rolls at all), applies the z-row
+mapping as ONE exact bf16x3 one-hot matmul (hi/mid/lo split of the
+f32 values stacked along the contraction — each output element is a
+single selected bf16 triplet, reconstructing the float32 bit-for-bit
+at full-bf16 MXU rate instead of a 6-pass HIGHEST f32 matmul), and
+reduces each stage to per-column (max over z, argmax) on the spot —
+the only HBM writes are the [stages, slab] reduction outputs.
+
+v1 of this kernel (one fixed-size window per term + pltpu.roll + two
+HIGHEST-precision one-hot matmuls) measured 336 ms on the bench
+workload; the selection matmuls were ~200 ms of it and the
+DMA+collect floor 135 ms.  v2 cuts both: ~45% less DMA (row-shrunk
+windows), no rolls, and ~3x cheaper exact selection.
 
 Thresholding / segment-max / top-k stay in XLA outside the kernel
 (they operate on the reduced [stages, slab] arrays, which are cheap).
@@ -19,21 +30,20 @@ Thresholding / segment-max / top-k stay in XLA outside the kernel
 Alignment contract (enforced by the caller): slab starts and the slab
 length are multiples of TILE, so every tile start j0 is divisible by
 every htot <= 16; DMA starts are floored to 128-lane multiples with
-the residual rolled away in VMEM.  The plane must be padded to
-ceil(numz/8)*8 rows and carry >= PLANE_PAD columns of zero padding at
-the right edge so subharmonic window DMAs never run off the array
+the residual added to the gather indices.  The plane must be padded
+to ceil(numz/8)*8 rows and carry >= PLANE_PAD columns of zero padding
+at the right edge so subharmonic window DMAs never run off the array
 (search/accel.py's _scan_pallas_py applies both pads).
 
-Hardware notes discovered building this: grid-pipelined manual DMAs
-into one scratch get reordered across grid steps (hence the per-term
-x2-parity window banks), and pltpu.roll with a dynamic NEGATIVE
-shift is miscompiled by this Mosaic version (hence the positive-
-equivalent WIN - off shifts).
+Hardware notes (discovered building v1/v2): grid-pipelined manual
+DMAs into one scratch get reordered across grid steps (hence the
+per-term x2-parity window banks); tpu.dynamic_gather handles ONE
+source vreg along the gathered dim, so lane gathers decompose into
+128-lane chunks combined with predicated selects.
 """
 
 from __future__ import annotations
 
-from functools import partial
 from typing import List, Tuple
 
 import numpy as np
@@ -41,9 +51,8 @@ import jax
 import jax.numpy as jnp
 
 TILE = 256                   # columns per grid tile (lanes)
-WIN = TILE + 128             # DMA window (lane-aligned): covers the
-                             # harmonic-term span for all harm < htot <= 16
-PLANE_PAD = WIN              # right-edge zero padding the plane needs
+PLANE_PAD = 384              # right-edge zero padding the plane needs
+                             # (largest per-term DMA window)
 
 
 def _stage_terms(fracs_zinds):
@@ -56,6 +65,21 @@ def _stage_terms(fracs_zinds):
         for harm, htot, zinds in stage:
             terms.append((harm, htot, np.asarray(zinds)))
     return terms, counts
+
+
+def _term_geom(harm: int, htot: int, zinds: np.ndarray):
+    """Static per-term window geometry: rows the zinds map can touch
+    (8-padded) and the 128-multiple DMA window width covering the
+    column map's span from any 128-aligned floor.  The residual
+    off = ((j0//htot)*harm) % 128 with j0 a multiple of TILE=256 is a
+    multiple of 256*harm/htot mod 128, i.e. of 16 for htot=16 — so
+    off can reach 112 (NOT 96: a 96-based window undersized the
+    harm=1/htot=16 term by one lane chunk, zeroing 8 of every 2048
+    columns of its stage-5 sums)."""
+    rows = -(-(int(zinds.max()) + 1) // 8) * 8
+    cspan = ((TILE - 1) * harm + (htot >> 1)) // htot + 2
+    win = -(-(112 + cspan) // 128) * 128
+    return rows, win
 
 
 def make_stage_reducer(numharmstages, fracs_zinds, slab: int,
@@ -78,69 +102,59 @@ def make_stage_reducer(numharmstages, fracs_zinds, slab: int,
     nterms = len(terms)
     ntiles = slab // TILE
     nstages = numharmstages
-    # sublane tiling: the kernel works on a plane padded to 8-row
-    # multiples (zero rows; they never win the argmax since powers
-    # are >= 0 and ties resolve to the lowest row index)
     numz_pad = -(-numz // 8) * 8
+    geom = [_term_geom(h, t, zi) for (h, t, zi) in terms]
 
-    # one-hot z-permutation matrices: perm[t] @ src == src[zinds_t]
-    onehots = np.zeros((max(nterms, 1), numz_pad, numz_pad),
-                       np.float32)
+    # bf16x3 stacked one-hot z-permutation: oh3[t] is [numz_pad,
+    # 3*rows] with the same one-hot block repeated for the hi/mid/lo
+    # value planes — (oh3 @ [hi;mid;lo]) selects and reconstructs each
+    # float32 exactly in ONE bf16 matmul (see module docstring)
+    onehots = []
     for i, (_h, _t, zinds) in enumerate(terms):
-        onehots[i, np.arange(numz), zinds] = 1.0
+        rows = geom[i][0]
+        oh = np.zeros((numz_pad, rows), np.float32)
+        oh[np.arange(numz), zinds] = 1.0
+        onehots.append(jnp.asarray(
+            np.concatenate([oh, oh, oh], axis=1).astype(jnp.bfloat16)))
 
-    # one-hot column-selection matrices: (src @ colsel[t])[z, j] ==
-    # src[z, (j*harm + htot//2) // htot] of the ROLLED window (max
-    # needed row < TILE for every harm < htot) — Mosaic cannot lower
-    # the phase-interleave reshape the XLA path uses, so the
-    # fractional-stride column map runs on the MXU too (exact:
-    # selectors are 0/1, so the decomposed-f32 passes recover each
-    # power bit-for-bit)
-    colsels = np.zeros((max(nterms, 1), TILE, TILE), np.float32)
-    j = np.arange(TILE)
-    for i, (harm, htot, _z) in enumerate(terms):
-        colsels[i, (j * harm + (htot >> 1)) // htot, j] = 1.0
+    def kernel(start_cols_ref, P_ref, *refs):
+        oh_refs = refs[:nterms]
+        colmax_ref, colz_ref = refs[nterms], refs[nterms + 1]
+        acc_ref = refs[nterms + 2]
+        win_refs = refs[nterms + 3:nterms + 3 + (1 + nterms)]
+        sems = refs[-1]
 
-    def kernel(start_cols_ref, P_ref, onehot_ref, colsel_ref,
-               colmax_ref, colz_ref, acc_ref, src_ref, sems):
         s = pl.program_id(0)
         t = pl.program_id(1)
         j0 = start_cols_ref[s] + t * TILE
 
-        # One DMA buffer + semaphore PER window (fundamental + each
-        # harmonic term) x2 grid-step parity banks: Mosaic pipelines
-        # grid iterations, so the next step's DMAs race this step's
-        # reads unless they land in the other bank; the fan-out also
-        # overlaps all fetches with compute.
-        bank = ((s * ntiles + t) % 2) * (1 + nterms)
+        # x2 grid-step parity banks: Mosaic pipelines grid iterations,
+        # so the next step's DMAs race this step's reads unless they
+        # land in the other bank; the fan-out also overlaps fetches
+        # with compute.
+        bank = (s * ntiles + t) % 2
 
-        def start_dma(slot, cstart):
-            slot = slot + bank
-            pltpu.make_async_copy(
-                P_ref.at[:, pl.ds(cstart, WIN)],
-                src_ref.at[slot], sems.at[slot]).start()
+        def fund_dma():
+            return pltpu.make_async_copy(
+                P_ref.at[:, pl.ds(pl.multiple_of(j0, 128), TILE)],
+                win_refs[0].at[bank], sems.at[0, bank])
 
-        def wait_dma(slot, cstart):
-            slot = slot + bank
-            pltpu.make_async_copy(
-                P_ref.at[:, pl.ds(cstart, WIN)],
-                src_ref.at[slot], sems.at[slot]).wait()
-
-        def term_start(fi):
+        def term_dma(fi):
             harm, htot, _z = terms[fi]
+            rows, win = geom[fi]
             cs = (j0 // htot) * harm
-            # DMA starts must be 128-lane-aligned: fetch from the
-            # floor; the residual (0/32/64/96) is rolled away at use
             off = cs % 128
-            return pl.multiple_of(cs - off, 128), off
+            return pltpu.make_async_copy(
+                P_ref.at[pl.ds(0, rows),
+                         pl.ds(pl.multiple_of(cs - off, 128), win)],
+                win_refs[1 + fi].at[bank], sems.at[1 + fi, bank]), off
 
-        fund_start = pl.multiple_of(j0, 128)
-        start_dma(0, fund_start)
+        fund_dma().start()
         for fi in range(nterms):
-            start_dma(1 + fi, term_start(fi)[0])
+            term_dma(fi)[0].start()
 
-        wait_dma(0, fund_start)
-        acc_ref[:, :] = src_ref[bank, :, :TILE]
+        fund_dma().wait()
+        acc_ref[:, :] = win_refs[0][bank]
 
         def collect(stage):
             a = acc_ref[:, :]
@@ -155,31 +169,41 @@ def make_stage_reducer(numharmstages, fracs_zinds, slab: int,
         fi = 0
         for stage in range(1, nstages):
             for _ in range(counts[stage - 1]):
-                cstart, off = term_start(fi)
-                wait_dma(1 + fi, cstart)
-                # positive-equivalent shift: dynamic NEGATIVE rolls
-                # are miscompiled by this Mosaic version (off by a
-                # lane tile); WIN - off rolls the residual away
-                src = pltpu.roll(src_ref[bank + 1 + fi],
-                                 shift=WIN - off, axis=1)[:, :TILE]
-                # column map then z-row map, both as one-hot MXU
-                # matmuls (exact selections, see colsels note)
-                cols = jax.lax.dot_general(
-                    src, colsel_ref[fi],
-                    (((1,), (0,)), ((), ())),
-                    preferred_element_type=jnp.float32,
-                    precision=jax.lax.Precision.HIGHEST)
+                harm, htot, _z = terms[fi]
+                rows, win = geom[fi]
+                dma, off = term_dma(fi)
+                dma.wait()
+                src = win_refs[1 + fi][bank]      # [rows, win]
+                # fractional-stride column map as chunked lane
+                # gathers; the DMA-floor residual `off` rides in the
+                # indices (no roll)
+                sel_cols = []
+                nchunks = win // 128
+                for c2 in range(TILE // 128):
+                    jj = jax.lax.broadcasted_iota(
+                        jnp.int32, (rows, 128), 1) + c2 * 128
+                    idx = off + (jj * harm + (htot >> 1)) // htot
+                    out = jnp.zeros((rows, 128), jnp.float32)
+                    for c in range(nchunks):
+                        g = jnp.take_along_axis(
+                            src[:, c * 128:(c + 1) * 128],
+                            jnp.clip(idx - c * 128, 0, 127), axis=1)
+                        out = jnp.where(idx // 128 == c, g, out)
+                    sel_cols.append(out)
+                sel = jnp.concatenate(sel_cols, axis=1)  # [rows, TILE]
+                # exact bf16x3 split: hi+mid+lo == x bit-for-bit
+                hi = sel.astype(jnp.bfloat16)
+                r1 = sel - hi.astype(jnp.float32)
+                mid = r1.astype(jnp.bfloat16)
+                lo = (r1 - mid.astype(jnp.float32)).astype(jnp.bfloat16)
+                stacked = jnp.concatenate([hi, mid, lo], axis=0)
                 add = jax.lax.dot_general(
-                    onehot_ref[fi], cols,
+                    oh_refs[fi][...], stacked,
                     (((1,), (0,)), ((), ())),
-                    preferred_element_type=jnp.float32,
-                    precision=jax.lax.Precision.HIGHEST)
+                    preferred_element_type=jnp.float32)
                 acc_ref[:, :] = acc_ref[:, :] + add
                 fi += 1
             collect(stage)
-
-    onehots_j = jnp.asarray(onehots)
-    colsels_j = jnp.asarray(colsels)
 
     @jax.jit
     def reduce_stages(P, start_cols):
@@ -187,11 +211,8 @@ def make_stage_reducer(numharmstages, fracs_zinds, slab: int,
         gs = pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=1,
             grid=(nslabs, ntiles),
-            in_specs=[
-                pl.BlockSpec(memory_space=pl.ANY),   # P (HBM)
-                pl.BlockSpec(memory_space=pltpu.VMEM),  # onehots
-                pl.BlockSpec(memory_space=pltpu.VMEM),  # colsels
-            ],
+            in_specs=[pl.BlockSpec(memory_space=pl.ANY)] +   # P (HBM)
+                     [pl.BlockSpec(memory_space=pltpu.VMEM)] * nterms,
             out_specs=[
                 pl.BlockSpec((1, nstages, TILE),
                              lambda s, t, *_: (s, 0, t)),
@@ -199,10 +220,13 @@ def make_stage_reducer(numharmstages, fracs_zinds, slab: int,
                              lambda s, t, *_: (s, 0, t)),
             ],
             scratch_shapes=[
-                pltpu.VMEM((numz_pad, TILE), jnp.float32),   # acc
-                pltpu.VMEM((2 * (1 + nterms), numz_pad, WIN),
-                           jnp.float32),                     # windows
-                pltpu.SemaphoreType.DMA((2 * (1 + nterms),)),
+                pltpu.VMEM((numz_pad, TILE), jnp.float32),       # acc
+                pltpu.VMEM((2, numz_pad, TILE), jnp.float32),    # fund
+            ] + [
+                pltpu.VMEM((2, geom[i][0], geom[i][1]), jnp.float32)
+                for i in range(nterms)
+            ] + [
+                pltpu.SemaphoreType.DMA((1 + nterms, 2)),
             ],
         )
         return pl.pallas_call(
@@ -215,7 +239,7 @@ def make_stage_reducer(numharmstages, fracs_zinds, slab: int,
                                      jnp.int32),
             ],
             interpret=interpret,
-        )(start_cols, P, onehots_j, colsels_j)
+        )(start_cols, P, *onehots)
 
     return reduce_stages
 

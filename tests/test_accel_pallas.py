@@ -36,7 +36,7 @@ def _numpy_stage_reduce(P, start_cols, slab, fracs_zinds, nstages):
     return colmax, colz
 
 
-@pytest.mark.parametrize("numharm", [4, 8])
+@pytest.mark.parametrize("numharm", [4, 8, 16])
 def test_pallas_reducer_matches_numpy(numharm):
     import jax.numpy as jnp
     rng = np.random.default_rng(0)
@@ -44,10 +44,13 @@ def test_pallas_reducer_matches_numpy(numharm):
     numz = cfg.numz                      # 21
     nstages = cfg.numharmstages
     slab = 2 * TILE
-    R = 4 * TILE + PLANE_PAD
+    # wide enough to place a slab at j0=1792: the htot=16 terms hit
+    # the maximal DMA-floor residual off=112 there (regression for the
+    # undersized-window bug that zeroed their last 8 columns)
+    R = 10 * TILE + PLANE_PAD
     P = rng.random((numz, R)).astype(np.float32)
     P[:, -PLANE_PAD:] = 0.0              # the padding contract
-    start_cols = np.asarray([0, TILE, 2 * TILE], np.int32)
+    start_cols = np.asarray([0, TILE, 2 * TILE, 7 * TILE], np.int32)
 
     fz = _harm_fracs_and_zinds(cfg, numz)
     reducer = make_stage_reducer(nstages, fz, slab, numz, R,

@@ -1,0 +1,130 @@
+"""Per-stage device timing for the accelsearch bench workload.
+
+Splits the headline search into build / scan / collect and reports
+device-only times (spectrum pre-uploaded, scalar-sync timed), plus the
+derived roofline numbers for BASELINE.md's per-stage table.
+
+Usage: python tools/profile_accel.py [--reps 5]
+"""
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def sync(x):
+    """Force execution; fetch one scalar (block_until_ready is
+    unreliable through the tunneled link)."""
+    import jax.numpy as jnp
+    return float(jnp.ravel(x)[0] if hasattr(x, "ravel")
+                 else jnp.asarray(x).ravel()[0])
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--reps", type=int, default=5)
+    ap.add_argument("--numbins", type=int, default=1 << 21)
+    ap.add_argument("--zmax", type=int, default=200)
+    ap.add_argument("--numharm", type=int, default=8)
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+    from bench import make_accel_input, ACCEL_T, WORKLOAD
+    from presto_tpu.search.accel import AccelConfig, AccelSearch
+
+    WORKLOAD["accel_numbins"] = args.numbins
+    pairs = make_accel_input()
+    cfg = AccelConfig(zmax=args.zmax, numharm=args.numharm, sigma=6.0)
+    s = AccelSearch(cfg, T=ACCEL_T, numbins=args.numbins)
+
+    dev_pairs = jnp.asarray(pairs)
+    sync(dev_pairs.sum())
+
+    kern_dev = s._kern_bank_dev()
+    sync(jnp.abs(kern_dev))          # complex can't cross the link
+
+    def best(fn, reps=args.reps):
+        fn()                      # warmup/compile
+        el = float("inf")
+        for _ in range(reps):
+            t0 = time.time()
+            fn()
+            el = min(el, time.time() - t0)
+        return el
+
+    # 1. plane build only
+    t_build = best(lambda: sync(s.build_plane(dev_pairs)))
+    plane = s.build_plane(dev_pairs)
+    numz, plane_numr = plane.shape
+
+    # 2. scan only (plane resident)
+    splan = s._slab_plan(plane_numr, 1 << 20)
+    slab, k, scanner, start_cols = splan
+    scols = jnp.asarray(start_cols, dtype=np.int32)
+    t_scan = best(lambda: sync(scanner(plane, scols)))
+
+    # 3. fused dispatch (what search() runs), device-only
+    yp = s._build_plan_ns()
+    t_fused = None
+    if yp is not None:
+        cs = s._search_fused(dev_pairs, 1 << 20, kern_dev)
+        fkey = [k for k in s._fn_cache if k and k[0] == "fused"]
+        if fkey:
+            fused = s._fn_cache[fkey[0]]
+            t_fused = best(lambda: sync(fused(dev_pairs, kern_dev,
+                                              scols)))
+
+    # 4. host collect cost (on the last packed result)
+    packed = scanner(plane, scols)
+    sync(packed)
+    t0 = time.time()
+    packed_np = np.asarray(packed)
+    t_d2h = time.time() - t0
+    t0 = time.time()
+    s._collect_packed(packed_np, start_cols)
+    t_collect = time.time() - t0
+
+    # 5. end-to-end search() with device-resident input
+    t_e2e = best(lambda: s.search(dev_pairs))
+
+    numr = int(s.rhi - s.rlo) * 2
+    cells = cfg.numz * numr
+    plane_gb = numz * plane_numr * 4 / 1e9
+    hbm_bw = 819e9
+    fftlen, hw = s.kern.fftlen, s.kern.halfwidth
+    nblocks = len(s._plan_blocks())
+    # build FLOPs: per block 1 fwd + numz inv c2c FFTs of fftlen
+    fft_flops = nblocks * (1 + numz) * 5 * fftlen * np.log2(fftlen)
+    cmul_flops = nblocks * numz * fftlen * 6
+    print("workload: numbins=2^%d zmax=%d numharm=%d  plane %dx%d "
+          "(%.2f GB)  fftlen=%d halfwidth=%d blocks=%d"
+          % (np.log2(args.numbins), args.zmax, args.numharm, numz,
+             plane_numr, plane_gb, fftlen, hw, nblocks))
+    print("build : %7.1f ms  (roofline: write plane %.1f ms; "
+          "%.1f GFLOP fft + %.1f GFLOP cmul)"
+          % (t_build * 1e3, plane_gb * 1e9 / hbm_bw * 1e3,
+             fft_flops / 1e9, cmul_flops / 1e9))
+    print("scan  : %7.1f ms  (roofline: read plane ~%.1f ms x ~%d "
+          "windows)"
+          % (t_scan * 1e3, plane_gb * 1e9 / hbm_bw * 1e3,
+             1 + len(s._harm_fracs())))
+    if t_fused is not None:
+        print("fused : %7.1f ms  (build+scan one dispatch)"
+              % (t_fused * 1e3,))
+    print("d2h   : %7.1f ms   collect(host): %.1f ms"
+          % (t_d2h * 1e3, t_collect * 1e3))
+    print("e2e   : %7.1f ms  -> %.3g cells/s device-resident"
+          % (t_e2e * 1e3, cells / t_e2e))
+    if t_fused:
+        print("fused-only cells/s: %.3g" % (cells / t_fused,))
+
+
+if __name__ == "__main__":
+    main()
