@@ -140,7 +140,9 @@ def run(args):
     if blocklen % args.downsamp:
         blocklen += args.downsamp - blocklen % args.downsamp
     chan_bins_d = jnp.asarray(chan_bins)
-    dm_bins_d = jnp.asarray(dm_bins)
+    # host np for the unsharded loop: float_dedisp_many_block's
+    # static-slice fast path dispatches on the host array
+    dm_bins_d = np.asarray(dm_bins)
     # DM-sharded mesh path (the mpiprepsubband analog): used whenever
     # more than one device is visible — a chip pod or a -coordinator
     # cluster — and the DM count divides the device count's grid

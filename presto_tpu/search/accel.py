@@ -242,13 +242,16 @@ def _fft_kernel_bank_c(kern_tpairs, fftlen):
 
 @partial(jax.jit, static_argnames=("uselen", "fftlen", "halfwidth"))
 def _ffdot_blocks(seg_pairs, kern_pairs, uselen, fftlen, halfwidth):
-    """Batched f-fdot power plane for many r-blocks at once.
+    """Batched f-fdot power plane for many r-blocks at once —
+    the PAIRS-boundary form kept for __graft_entry__ and external
+    float32-only consumers (the build hot path uses the complex
+    slab engines _ffdot_slab_mxu/_ffdot_slab_fft instead).
 
     seg_pairs: [nblocks, fftlen//2, 2] float32 — normalized Fourier
         amplitudes for each block's read window (lobin = block_rlo -
         halfwidth, fftlen//2 whole bins).
-    kern_pairs: [numz, fftlen, 2] float32 — FFT'd kernel bank (device,
-        from _fft_kernel_bank_c).
+    kern_pairs: [numz, fftlen, 2] float32 — FFT'd kernel bank as
+        pairs (fft_kernel_bank_np's output).
     Returns [nblocks, numz, uselen] float32 powers.
 
     Parity with the per-row loop of accel_utils.c:1002-1051: spread ×2,
@@ -652,9 +655,12 @@ class AccelSearch:
         # empty search (the reference's block loop, accelsearch.c:167,
         # simply assumes survey-length FFTs): shrink the block to fit
         max_uselen = max(64, 2 * (numbins - 16))
-        if cfg.uselen > max_uselen:
+        if cfg.uselen > max_uselen or cfg.uselen % 2:
             from dataclasses import replace
-            cfg = replace(cfg, uselen=max_uselen)
+            # even uselen keeps the block grid on whole bins — the
+            # uniform-hop frame builder (_frames_fn) requires an
+            # integer hop = uselen/2
+            cfg = replace(cfg, uselen=min(cfg.uselen & ~1, max_uselen))
         self.cfg = cfg
         self.T = T
         self.numbins = numbins

@@ -202,3 +202,13 @@ def test_search_many_device_array_input():
     for a, b in zip(res_np, res_dev):
         assert [(c.numharm, c.r, c.z, c.power) for c in a] == \
             [(c.numharm, c.r, c.z, c.power) for c in b]
+
+
+def test_odd_uselen_normalized_even():
+    """The uniform-hop frame builder needs an integer bin hop
+    (uselen/2): odd uselen is rounded down at plan time instead of
+    silently shifting every block window."""
+    from presto_tpu.search.accel import AccelConfig, AccelSearch
+    s = AccelSearch(AccelConfig(zmax=20, numharm=2, uselen=7471),
+                    T=100.0, numbins=1 << 17)
+    assert s.cfg.uselen == 7470
