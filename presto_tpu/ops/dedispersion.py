@@ -206,14 +206,34 @@ def float_dedisp_many_block(lastdata, data, delays_dm, approx_mean=0.0):
     """
     if isinstance(delays_dm, np.ndarray) and \
             delays_dm.size <= _STATIC_SLICE_LIMIT:
-        dkey = tuple(map(tuple, delays_dm.astype(np.int64).tolist()))
-        return _float_dedisp_static(lastdata, data, dkey,
-                                    float(approx_mean))
+        return _static_fn_for(delays_dm)(lastdata, data,
+                                         float(approx_mean))
     return _float_dedisp_vmap(lastdata, data, jnp.asarray(delays_dm),
                               approx_mean)
 
 
 _STATIC_SLICE_LIMIT = 16384   # numdms*nsub unroll bound
+_static_fns: dict = {}        # delay-plan bytes -> compiled closure
+
+
+def _static_fn_for(delays_dm: np.ndarray):
+    """Compiled static-slice closure for one delay plan, memoized on
+    the plan's bytes — prepsubband calls this once per streamed block
+    with the same plan, and rebuilding + jit-cache-hashing a
+    numdms*nsub static tuple every call is measurable host overhead."""
+    key = (delays_dm.shape, delays_dm.dtype.str, delays_dm.tobytes())
+    fn = _static_fns.get(key)
+    if fn is None:
+        if len(_static_fns) > 8:      # bound retained programs
+            _static_fns.clear()
+        dkey = tuple(map(tuple, delays_dm.astype(np.int64).tolist()))
+
+        @jax.jit
+        def fn(lastdata, data, approx_mean):
+            return _float_dedisp_static_body(lastdata, data, dkey,
+                                             approx_mean)
+        _static_fns[key] = fn
+    return fn
 
 
 @jax.jit
@@ -227,8 +247,7 @@ def _float_dedisp_vmap(lastdata, data, delays_dm, approx_mean=0.0):
     return jax.vmap(per_dm)(delays_dm) - approx_mean
 
 
-@partial(jax.jit, static_argnames=("dkey", "approx_mean"))
-def _float_dedisp_static(lastdata, data, dkey, approx_mean):
+def _float_dedisp_static_body(lastdata, data, dkey, approx_mean):
     """Static-delay float_dedisp: per-DM sums of statically-sliced
     subband windows (see float_dedisp_many_block).  Slices are 1-D
     views of the flattened subband buffer — [1, T] 2-D rows leave 7 of

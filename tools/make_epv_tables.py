@@ -1,0 +1,88 @@
+"""Extract the EPV ephemeris coefficient tables to presto_tpu/data/.
+
+The built-in km-grade ephemeris (astro/ephem.py EpvEphemeris) is the
+simplified VSOP2000 Earth solution of X. Moisson & P. Bretagnon
+(2001, Celest. Mech. Dyn. Astron. 80, 205): ~2000 published
+(amplitude, phase, frequency) Poisson-series coefficients.  The
+reference vendors an adaptation of Bretagnon's tables in
+src/slalib/epv.f; this tool parses those DATA statements AS DATA
+(numeric tables of published scientific coefficients — no code is
+executed or translated) and writes them to a compact .npz the package
+ships.  Provenance and the evaluation model are documented in
+astro/ephem.py.
+
+Usage: python tools/make_epv_tables.py [path-to-epv.f] [out.npz]
+"""
+
+import os
+import re
+import sys
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DEFAULT_SRC = "/root/reference/src/slalib/epv.f"
+DEFAULT_OUT = os.path.join(REPO, "presto_tpu", "data", "epv.npz")
+
+# series lengths, from the table dimensioning (epv.f PARAMETER block)
+COUNTS = {
+    ("E", 0): (501, 501, 137), ("E", 1): (79, 80, 12),
+    ("E", 2): (5, 5, 3),
+    ("S", 0): (212, 213, 69), ("S", 1): (50, 50, 14),
+    ("S", 2): (9, 9, 2),
+}
+
+_HDR = re.compile(
+    r"DATA\s*\(\(([ES])(\d)\(I,J,(\d)\),I=1,3\),J=\s*\d+,\s*\w+\)")
+_NUM = re.compile(r"[-+]?\d*\.?\d+D[-+]\d+|\b0D0\b")
+
+
+def parse(path):
+    """-> dict[(body, power, comp)] = [n, 3] float64 (amp, phase, freq)."""
+    blocks = {}
+    cur = None
+    nums = []
+    for raw in open(path):
+        line = raw.rstrip("\n")
+        m = _HDR.search(line)
+        if m:
+            if cur is not None:
+                blocks.setdefault(cur[0], []).extend(nums)
+            body, power, comp = m.group(1), int(m.group(2)), int(m.group(3))
+            cur = ((body, power, comp - 1),)
+            nums = []
+            line = line[m.end():]
+        if cur is not None and (line.lstrip().startswith(":")
+                                or _HDR.search(raw) or "/" in line):
+            for tok in _NUM.findall(line):
+                nums.append(float(tok.replace("D", "e")))
+    if cur is not None:
+        blocks.setdefault(cur[0], []).extend(nums)
+
+    out = {}
+    for (body, power, comp), vals in blocks.items():
+        arr = np.asarray(vals, np.float64).reshape(-1, 3)
+        want = COUNTS[(body, power)][comp]
+        if arr.shape[0] != want:
+            raise SystemExit(
+                "epv parse: %s%d comp %d has %d terms, expected %d"
+                % (body, power, comp, arr.shape[0], want))
+        out[(body, power, comp)] = arr
+    if len(out) != 18:
+        raise SystemExit("epv parse: %d blocks, expected 18" % len(out))
+    return out
+
+
+def main():
+    src = sys.argv[1] if len(sys.argv) > 1 else DEFAULT_SRC
+    dst = sys.argv[2] if len(sys.argv) > 2 else DEFAULT_OUT
+    blocks = parse(src)
+    arrays = {"%s%d%s" % (b, p, "xyz"[c]): v
+              for (b, p, c), v in blocks.items()}
+    np.savez_compressed(dst, **arrays)
+    tot = sum(v.shape[0] for v in blocks.values())
+    print("wrote %s: 18 blocks, %d coefficient triplets" % (dst, tot))
+
+
+if __name__ == "__main__":
+    main()
