@@ -1,0 +1,60 @@
+"""downsample_filterbank: time-average a SIGPROC .fil by a factor.
+
+Twin of bin/downsample_filterbank.py: streams the filterbank in
+blocks, averages every DS_fact consecutive spectra per channel, and
+writes <base>_DS<f>.fil with tsamp scaled accordingly (header
+otherwise preserved; output sample depth matches the input's 8/32
+bits, with 8-bit data rounded like the reference's byte output).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+from dataclasses import replace
+
+import numpy as np
+
+from presto_tpu.io.sigproc import (FilterbankFile, write_filterbank)
+
+
+def build_parser():
+    p = argparse.ArgumentParser(
+        prog="downsample_filterbank",
+        description="time-downsample a .fil by an integer factor")
+    p.add_argument("dsfact", type=int)
+    p.add_argument("infile")
+    p.add_argument("-o", "--output", default="")
+    return p
+
+
+def main(argv=None):
+    args = build_parser().parse_args(argv)
+    if args.dsfact < 1:
+        raise SystemExit("DS_fact must be >= 1")
+    with FilterbankFile(args.infile) as fb:
+        hdr = fb.header
+        nout = hdr.N // args.dsfact
+        data = np.empty((nout, hdr.nchans), np.float32)
+        blk = max(args.dsfact, (1 << 20) // max(hdr.nchans, 1)
+                  // args.dsfact * args.dsfact)
+        done = 0
+        while done < nout:
+            n = min(blk // args.dsfact, nout - done)
+            raw = fb.read_spectra(done * args.dsfact, n * args.dsfact)
+            data[done:done + n] = raw.reshape(
+                n, args.dsfact, hdr.nchans).mean(axis=1)
+            done += n
+    new_hdr = replace(hdr, tsamp=hdr.tsamp * args.dsfact, N=nout)
+    base = os.path.splitext(args.infile)[0]
+    out = args.output or "%s_DS%d.fil" % (base, args.dsfact)
+    if hdr.nbits == 8:
+        data = np.clip(np.round(data), 0, 255)
+    write_filterbank(out, new_hdr, data.astype(np.float32))
+    print("downsample_filterbank: %d -> %d spectra (x%d) -> %s"
+          % (hdr.N, nout, args.dsfact, out))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

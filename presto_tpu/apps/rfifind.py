@@ -19,7 +19,7 @@ import numpy as np
 
 from presto_tpu.apps.common import (add_common_flags, add_raw_flags,
                                     open_raw_args, BlockPrep,
-                                    fil_to_inf, ensure_backend)
+                                    fil_to_inf, ensure_backend, obs_metadata)
 from presto_tpu.io.infodata import write_inf, read_inf
 from presto_tpu.io.maskfile import (read_mask, read_statsfile,
                                     determine_padvals)
@@ -103,6 +103,10 @@ def _run_nocompute(args):
         chantrigfrac=args.chanfrac, inttrigfrac=args.intfrac,
         mjd=info.mjd_i + info.mjd_f, zap_chans=zap_chans,
         zap_ints=zap_ints)
+    res.info = {"filenm": getattr(info, "name", "") or "-",
+                "telescope": info.telescope, "ra": info.ra_str,
+                "dec": info.dec_str, "chanfrac": args.chanfrac,
+                "intfrac": args.intfrac}
     write_rfifind_products(res, outbase)
     print("rfifind -nocompute: re-thresholded %d ints x %d chans, "
           "%.1f%% masked -> %s_rfifind.mask"
@@ -165,6 +169,10 @@ def run(args):
     write_rfifind_products(res, outbase)
     info = fil_to_inf(fb, outbase + "_rfifind", hdr.N)
     write_inf(info, outbase + "_rfifind.inf")
+    tel, ra, dec = obs_metadata(fb)
+    res.info = {"filenm": args.rawfiles[0], "telescope": tel,
+                "ra": ra, "dec": dec, "chanfrac": args.chanfrac,
+                "intfrac": args.intfrac}    # plot info block
     fb.close()
     print("rfifind: %d ints x %d chans, %.1f%% masked -> %s_rfifind.mask"
           % (res.mask.numint, res.mask.numchan,

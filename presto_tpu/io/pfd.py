@@ -203,3 +203,30 @@ def write_bestprof(path: str, p: Pfd, best_prof: np.ndarray,
         w("######################################################\n")
         for i, v in enumerate(best_prof):
             w("%4d  %.7g\n" % (i, v))
+
+
+def use_for_timing(p: Pfd) -> bool:
+    """True when the fold can produce valid TOAs: the best (searched)
+    solution must agree with the FOLD solution to within a 0.1-bin
+    rotation over the observation, else prepfold's search moved the
+    profile and TOAs from it are bogus (prepfold.py:325-346).
+    """
+    from presto_tpu.utils.psr import p_to_f
+    T = p.dt * float(p.stats[:, 0, 0].sum())
+    # best-solution choice mirrors freq_offsets (prepfold.py:250-266):
+    # barycentric fold (fold_pow == 1) compares against the bary
+    # values; an un-searched topocentric fold (topo_p1 == 0) has zero
+    # offsets by construction
+    if p.fold_pow == 1.0:
+        best = (p.bary_p1, p.bary_p2, p.bary_p3)
+    elif p.topo_p1 == 0.0:
+        return True
+    else:
+        best = (p.topo_p1, p.topo_p2, p.topo_p3)
+    if not best[0]:
+        return False
+    f3 = p_to_f(*best)
+    offs = np.abs(np.asarray(f3) -
+                  np.asarray([p.fold_p1, p.fold_p2, p.fold_p3]))
+    dphi = offs * np.asarray([T, T ** 2 / 2.0, T ** 3 / 6.0])
+    return bool(dphi.max() <= 0.1 / p.proflen)

@@ -3,7 +3,7 @@
 BASELINE.json config 5 (the mpiprepsubband-equivalent) at REAL shapes,
 executed on the virtual 8-device CPU mesh
 (xla_force_host_platform_device_count=8), producing
-TARGETSCALE_r02.json with:
+TARGETSCALE_r0N.json with:
 
   * the HBM-fit plan for a real v5e-8 (per-device residency arithmetic
     — the meminfo.h analog at target scale);
@@ -238,7 +238,8 @@ def main():
 
     art["total_sec"] = round(time.time() - t_all, 1)
     out = os.path.join(os.path.dirname(os.path.dirname(
-        os.path.abspath(__file__))), "TARGETSCALE_r02.json")
+        os.path.abspath(__file__))),
+        sys.argv[1] if len(sys.argv) > 1 else "TARGETSCALE_r03.json")
     with open(out, "w") as f:
         json.dump(art, f, indent=1)
     print(json.dumps(art, indent=1))
