@@ -15,7 +15,8 @@ from dataclasses import replace
 
 import numpy as np
 
-from presto_tpu.io.sigproc import (FilterbankFile, write_filterbank)
+from presto_tpu.io.sigproc import (FilterbankFile, pack_bits,
+                                   write_filterbank_header)
 
 
 def build_parser():
@@ -32,25 +33,35 @@ def main(argv=None):
     args = build_parser().parse_args(argv)
     if args.dsfact < 1:
         raise SystemExit("DS_fact must be >= 1")
+    base = os.path.splitext(args.infile)[0]
+    out = args.output or "%s_DS%d.fil" % (base, args.dsfact)
     with FilterbankFile(args.infile) as fb:
         hdr = fb.header
         nout = hdr.N // args.dsfact
-        data = np.empty((nout, hdr.nchans), np.float32)
-        blk = max(args.dsfact, (1 << 20) // max(hdr.nchans, 1)
-                  // args.dsfact * args.dsfact)
-        done = 0
-        while done < nout:
-            n = min(blk // args.dsfact, nout - done)
-            raw = fb.read_spectra(done * args.dsfact, n * args.dsfact)
-            data[done:done + n] = raw.reshape(
-                n, args.dsfact, hdr.nchans).mean(axis=1)
-            done += n
-    new_hdr = replace(hdr, tsamp=hdr.tsamp * args.dsfact, N=nout)
-    base = os.path.splitext(args.infile)[0]
-    out = args.output or "%s_DS%d.fil" % (base, args.dsfact)
-    if hdr.nbits == 8:
-        data = np.clip(np.round(data), 0, 255)
-    write_filterbank(out, new_hdr, data.astype(np.float32))
+        new_hdr = replace(hdr, tsamp=hdr.tsamp * args.dsfact, N=nout)
+        # stream input AND output block-by-block: survey-scale .fil
+        # files do not fit in RAM
+        nblk = max(1, (1 << 22) // max(hdr.nchans * args.dsfact, 1))
+        with open(out, "wb") as f:
+            write_filterbank_header(new_hdr, f)
+            done = 0
+            while done < nout:
+                n = min(nblk, nout - done)
+                raw = fb.read_spectra(done * args.dsfact,
+                                      n * args.dsfact)
+                d = raw.reshape(n, args.dsfact,
+                                hdr.nchans).mean(axis=1)
+                if hdr.foff < 0:     # disk order is descending freq
+                    d = d[:, ::-1]
+                d = np.ascontiguousarray(d)
+                if hdr.nbits == 8:
+                    d = np.clip(np.round(d), 0, 255)
+                if hdr.nbits in (1, 2, 4, 8):
+                    pack_bits(d.ravel().astype(np.uint8),
+                              hdr.nbits).tofile(f)
+                else:
+                    d.ravel().astype(np.float32).tofile(f)
+                done += n
     print("downsample_filterbank: %d -> %d spectra (x%d) -> %s"
           % (hdr.N, nout, args.dsfact, out))
     return 0

@@ -29,18 +29,43 @@ def build_parser():
     p.add_argument("-norfi", action="store_true",
                    help="Skip rfifind masking")
     p.add_argument("-workdir", type=str, default=".")
+    p.add_argument("--recipe", type=str, default=None,
+                   help="named survey policy (palfa, gbncc): sets the "
+                        "accel passes, sift thresholds, fold "
+                        "selection, SP settings and zaplist; -lodm/"
+                        "-hidm/-nsub/-zaplist still apply")
     p.add_argument("rawfiles", nargs="+")
     return p
 
 
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
-    cfg = SurveyConfig(
-        lodm=args.lodm, hidm=args.hidm, nsub=args.nsub,
-        zmax=args.zmax, numharm=args.numharm, sigma=args.sigma,
-        rfi_time=args.rfitime, zaplist=args.zaplist,
-        fold_top=args.foldtop, singlepulse=not args.nosp,
-        skip_rfifind=args.norfi)
+    if args.recipe:
+        # the recipe OWNS these policies — explicitly-passed values
+        # would be silently ignored, so make the conflict loud
+        for flag, val, dflt in (("-zmax", args.zmax, 0),
+                                ("-numharm", args.numharm, 8),
+                                ("-sigma", args.sigma, 4.0),
+                                ("-rfitime", args.rfitime, 2.0),
+                                ("-foldtop", args.foldtop, 3)):
+            if val != dflt:
+                raise SystemExit(
+                    "pipeline: %s conflicts with --recipe %s (the "
+                    "recipe sets that policy); drop the flag or the "
+                    "recipe" % (flag, args.recipe))
+        from presto_tpu.pipeline.recipes import get_recipe
+        cfg = get_recipe(args.recipe).to_config(
+            args.lodm, args.hidm, nsub=args.nsub,
+            zaplist=args.zaplist)
+        cfg.singlepulse = not args.nosp
+        cfg.skip_rfifind = args.norfi
+    else:
+        cfg = SurveyConfig(
+            lodm=args.lodm, hidm=args.hidm, nsub=args.nsub,
+            zmax=args.zmax, numharm=args.numharm, sigma=args.sigma,
+            rfi_time=args.rfitime, zaplist=args.zaplist,
+            fold_top=args.foldtop, singlepulse=not args.nosp,
+            skip_rfifind=args.norfi)
     res = run_survey(args.rawfiles, cfg, workdir=args.workdir)
     print("pipeline: done — %d DMs, %d sifted cands, %d folds, "
           "%d SP events" % (len(res.datfiles),

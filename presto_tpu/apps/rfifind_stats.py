@@ -60,7 +60,7 @@ def channel_zaps(stats, mask, power=200.0, edges=0.01, asigma=2.0,
     zap |= pw > power
     zap |= np.abs(_robust_sigmas(av)) > asigma
     zap |= np.abs(_robust_sigmas(sd)) > ssigma
-    zap[list(getattr(mask, "mask_zap_chans", []) or [])] = True
+    zap[np.asarray(mask.zap_chans, int)] = True
     return zap
 
 

@@ -30,6 +30,19 @@ SIGMA_THRESHOLD = 6.0
 C_POW_THRESHOLD = 100.0
 HARM_POW_CUTOFF = 8.0
 
+@dataclass
+class SiftPolicy:
+    """One survey's sifting thresholds — the knobs the reference's
+    survey drivers set as sifting-module globals
+    (PALFA_presto_search.py:47-52)."""
+    sigma_threshold: float = SIGMA_THRESHOLD
+    c_pow_threshold: float = C_POW_THRESHOLD
+    short_period: float = SHORT_PERIOD
+    long_period: float = LONG_PERIOD
+    harm_pow_cutoff: float = HARM_POW_CUTOFF
+    r_err: float = R_ERR
+
+
 DM_RE = re.compile(r"DM(\d+\.\d{2})")
 
 
@@ -213,12 +226,14 @@ class Candlist:
                 c.note = "dominated by harmonic %d" % (maxharm + 1)
                 self._mark_bad(i, "rogueharmpow")
 
-    def default_rejection(self, known_birds_f=(), known_birds_p=()):
-        self.reject_longperiod()
-        self.reject_shortperiod()
+    def default_rejection(self, known_birds_f=(), known_birds_p=(),
+                          policy: "SiftPolicy" = None):
+        pol = policy or SiftPolicy()
+        self.reject_longperiod(pol.long_period)
+        self.reject_shortperiod(pol.short_period)
         self.reject_knownbirds(known_birds_f, known_birds_p)
-        self.reject_threshold()
-        self.reject_harmpowcutoff()
+        self.reject_threshold(pol.sigma_threshold, pol.c_pow_threshold)
+        self.reject_harmpowcutoff(pol.harm_pow_cutoff)
         self.reject_rogueharmpow()
 
     # -- dedup / harmonic / DM sifts ----------------------------------
@@ -366,14 +381,15 @@ def candlist_from_accelfile(filename: str) -> Candlist:
 
 def read_candidates(filenames: Sequence[str],
                     prelim_reject: bool = True,
-                    known_birds_f=(), known_birds_p=()) -> Candlist:
+                    known_birds_f=(), known_birds_p=(),
+                    policy: "SiftPolicy" = None) -> Candlist:
     """Aggregate candidates over many DM trials
     (sifting.py:1203-1230)."""
     out = Candlist()
     for fn in filenames:
         cl = candlist_from_accelfile(fn)
         if prelim_reject:
-            cl.default_rejection(known_birds_f, known_birds_p)
+            cl.default_rejection(known_birds_f, known_birds_p, policy)
         out.extend(cl)
     return out
 
@@ -381,10 +397,15 @@ def read_candidates(filenames: Sequence[str],
 def sift_candidates(filenames: Sequence[str], numdms_min: int = 2,
                     low_DM_cutoff: float = 2.0,
                     known_birds_f=(), known_birds_p=(),
-                    r_err: float = R_ERR) -> Candlist:
+                    r_err: float = None,
+                    policy: "SiftPolicy" = None) -> Candlist:
     """The ACCEL_sift.py recipe (python/ACCEL_sift.py:40-76):
-    read -> reject -> dedup across DMs -> DM checks -> harmonics."""
-    cl = read_candidates(filenames, True, known_birds_f, known_birds_p)
+    read -> reject -> dedup across DMs -> DM checks -> harmonics.
+    An explicit r_err beats the policy's; default R_ERR otherwise."""
+    if r_err is None:
+        r_err = policy.r_err if policy is not None else R_ERR
+    cl = read_candidates(filenames, True, known_birds_f, known_birds_p,
+                         policy)
     dmlist = sorted({c.DMstr for c in cl})
     cl.remove_duplicate_candidates(r_err)
     if len(dmlist) > 1:

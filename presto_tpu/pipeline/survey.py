@@ -37,14 +37,26 @@ class SurveyConfig:
     numharm: int = 8
     sigma: float = 4.0
     zaplist: Optional[str] = None
+    # extra accelsearch passes beyond (zmax, numharm, sigma), e.g.
+    # the PALFA lo/hi pair — each entry is (zmax, numharm, sigma)
+    accel_passes: Optional[tuple] = None
     # sifting / folding
     min_dm_hits: int = 2
     low_dm_cutoff: float = 2.0
     fold_top: int = 3
+    sift_policy: Optional[object] = None   # sifting.SiftPolicy
+    fold_sigma: Optional[float] = None     # fold all cands above this
+    max_folds: int = 150                   # ... capped here
     # single pulse
     sp_threshold: float = 5.0
+    sp_maxwidth: float = 0.0
     singlepulse: bool = True
     skip_rfifind: bool = False
+
+    @property
+    def all_passes(self):
+        return ((self.zmax, self.numharm, self.sigma),) + \
+            tuple(self.accel_passes or ())
 
 
 @dataclass
@@ -125,6 +137,8 @@ def _run_survey_stages(rawfiles, cfg, workdir, base, res, timer):
     res.datfiles = _stage(os.path.basename(base) + "_DM*.dat", workdir)
     print("survey: %d dedispersed time series" % len(res.datfiles))
 
+    from dataclasses import replace as _replace
+    passes = cfg.all_passes
     if cfg.zaplist:
         timer.mark("realfft")
         _staged_fft_search_head(res, cfg)
@@ -135,11 +149,12 @@ def _run_survey_stages(rawfiles, cfg, workdir, base, res, timer):
         for f in fftfiles:
             zap_main(["-zap", "-zapfile", cfg.zaplist, f])
         timer.mark("accelsearch")
-        # ---- 6. accelsearch: BATCHED over the DM fan-out -------------
-        # all trials share length and T, so the whole survey's search
-        # runs as grouped device dispatches (search_many) instead of a
-        # per-DM dispatch storm; refinement + artifacts stay per-DM
-        _batched_accelsearch(fftfiles, cfg)
+        # ---- 6. accelsearch: BATCHED over the DM fan-out, once per
+        # recipe pass (e.g. PALFA's zmax=0/nh=16 + zmax=50/nh=8) -----
+        for (zmax, nh, sg) in passes:
+            _batched_accelsearch(
+                fftfiles, _replace(cfg, zmax=zmax, numharm=nh,
+                                   sigma=sg))
     else:
         # ---- 4+6 fused fast path: realfft -> accelsearch with the
         # spectra RESIDENT on device (no zapbirds in between).  Saves
@@ -148,10 +163,12 @@ def _run_survey_stages(rawfiles, cfg, workdir, base, res, timer):
         # still written, preserving the checkpoint contract.
         timer.mark("realfft+accelsearch (fused)")
         _fused_fft_search(res, cfg)
-        # resume case: trials whose .fft already existed (so the fused
-        # stage skipped regenerating them) but whose ACCEL is missing
-        _batched_accelsearch([f[:-4] + ".fft" for f in res.datfiles],
-                             cfg)
+        for (zmax, nh, sg) in passes:
+            # resume case for the first pass; full searches for the
+            # recipe's additional passes
+            _batched_accelsearch(
+                [f[:-4] + ".fft" for f in res.datfiles],
+                _replace(cfg, zmax=zmax, numharm=nh, sigma=sg))
 
     timer.mark("sift")
     return _finish_survey_stages(rawfiles, cfg, workdir, base, res,
@@ -273,11 +290,15 @@ def _batched_accelsearch(fftfiles, cfg):
 def _finish_survey_stages(rawfiles, cfg, workdir, base, res, timer):
     # ---- 7. sift ------------------------------------------------------
     from presto_tpu.pipeline.sifting import sift_candidates
-    accfiles = _stage(os.path.basename(base)
-                      + "_DM*_ACCEL_%d" % cfg.zmax, workdir)
+    accfiles = []
+    for (zmax, _nh, _sg) in cfg.all_passes:
+        accfiles += _stage(os.path.basename(base)
+                           + "_DM*_ACCEL_%d" % zmax, workdir)
+    accfiles = sorted(set(accfiles))
     res.candfile = os.path.join(workdir, "cands_sifted.txt")
     cl = sift_candidates(accfiles, numdms_min=cfg.min_dm_hits,
-                         low_DM_cutoff=cfg.low_dm_cutoff)
+                         low_DM_cutoff=cfg.low_dm_cutoff,
+                         policy=cfg.sift_policy)
     cl.to_file(res.candfile)
     res.sifted = cl
     print("survey: %d sifted candidates -> %s"
@@ -286,7 +307,14 @@ def _finish_survey_stages(rawfiles, cfg, workdir, base, res, timer):
     timer.mark("prepfold")
     # ---- 8. fold the top candidates -----------------------------------
     from presto_tpu.apps.prepfold import main as prepfold_main
-    top = sorted(cl.cands, key=lambda c: -c.sigma)[:cfg.fold_top]
+    ranked = sorted(cl.cands, key=lambda c: -c.sigma)
+    if cfg.fold_sigma is not None:
+        # recipe policy: fold everything above to_prepfold_sigma,
+        # never more than max_folds (PALFA_presto_search.py:32-33)
+        top = [c for c in ranked
+               if c.sigma >= cfg.fold_sigma][:cfg.max_folds]
+    else:
+        top = ranked[:cfg.fold_top]
     for i, c in enumerate(top):
         accpath = os.path.join(workdir, c.filename) \
             if not os.path.dirname(c.filename) else c.filename
@@ -315,7 +343,10 @@ def _finish_survey_stages(rawfiles, cfg, workdir, base, res, timer):
         sp_todo = [f for f in res.datfiles
                    if not os.path.exists(f[:-4] + ".singlepulse")]
         if sp_todo:
-            sp_main(["-t", str(cfg.sp_threshold)] + sp_todo)
+            argv = ["-t", str(cfg.sp_threshold)]
+            if cfg.sp_maxwidth:
+                argv += ["-m", str(cfg.sp_maxwidth)]
+            sp_main(argv + sp_todo)
         from presto_tpu.search.singlepulse import read_singlepulse
         for f in res.datfiles:
             spf = f[:-4] + ".singlepulse"

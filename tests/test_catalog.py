@@ -227,3 +227,17 @@ def test_shipped_catalog_loaded():
     birds = read_birds_bary(default_birds_path())
     assert len(birds) == 40
     assert birds[0][0] == 50.0 and birds[20][0] == 60.0
+
+
+def test_full_depth_faint_solitary_lookup():
+    """The shipped catalog is FULL-depth (no flux/binary cut): faint
+    solitary pulsars — the ones that show up as new-search false
+    positives — must resolve (VERDICT r2 item 8)."""
+    from presto_tpu.utils.catalog import default_catalog
+    cat = default_catalog()
+    assert len(cat) > 2000, len(cat)
+    # catalogued pulsars with no measured flux and no binary params
+    for name in ("J0645+80", "J0024-7204Z"):
+        rec = cat.lookup(name)
+        assert rec is not None, name
+        assert rec.get("p0"), name

@@ -4,10 +4,10 @@ Extracts FACTUAL astronomical data (pulsar names, positions, spin and
 orbital parameters — the public ATNF pulsar catalogue, Manchester et
 al. 2005, AJ 129, 1993) from the reference tree's vendored text export
 and writes presto_tpu/data/pulsars.psrcat in this framework's own
-compact TSV layout.  Selection: every pulsar with a measured flux
-(S400/S1400 — the ones bright enough to matter for zap lists and
-candidate identification) plus every binary, capped at ~1000 rows by
-descending 1400-MHz flux.
+compact TSV layout.  Selection: EVERY catalogued pulsar with a period and position
+(full depth, like the reference's lib/pulsars.cat) — faint solitary
+pulsars show up in new-search false positives, so known-source
+identification needs all of them.
 
 Also writes presto_tpu/data/default_birds.txt: power-mains harmonics
 (50 Hz and 60 Hz ladders — the universal terrestrial birdies) in the
@@ -84,10 +84,11 @@ def main():
                     rec.get("p0") and rec.get("raj") and rec.get("decj"):
                 records.append(rec)
 
-    keep = [r for r in records
-            if r.get("s1400") or r.get("s400") or r.get("pb")]
-    keep.sort(key=lambda r: -(r.get("s1400") or 0.0))
-    keep = keep[:1000]
+    # FULL depth (VERDICT r2 item 8): every catalogued pulsar with a
+    # period and position — faint solitary pulsars are exactly what
+    # turns up in new-search false positives, so the old
+    # flux-or-binary cut hurt known-source identification
+    keep = records
     keep.sort(key=lambda r: r.get("jname") or r.get("bname"))
 
     outdir = os.path.join(REPO, "presto_tpu", "data")
@@ -97,8 +98,8 @@ def main():
         f.write("# presto_tpu pulsar catalog (compact TSV)\n"
                 "# Factual data from the public ATNF pulsar catalogue "
                 "(Manchester et al. 2005, AJ 129, 1993).\n"
-                "# Selection: measured flux or binary; see "
-                "tools/make_catalog.py.\n"
+                "# Selection: ALL catalogued pulsars with period+position "
+                "(full depth); see tools/make_catalog.py.\n"
                 "# " + "\t".join(FIELDS) + "\n")
         for r in keep:
             f.write("\t".join(

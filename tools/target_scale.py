@@ -33,17 +33,24 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-os.environ["JAX_PLATFORMS"] = "cpu"
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8").strip()
+
+def _force_virtual_mesh():
+    """Pin the 8-device virtual CPU mesh.  Called from main() ONLY —
+    importing this module for its config/constants (target_scale_chip
+    does) must NOT hijack the caller's platform: an earlier version
+    set these at import time and silently turned the real-chip run
+    into a CPU run."""
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
 
 import numpy as np
 import jax
-
-jax.config.update("jax_platforms", "cpu")
-
 import jax.numpy as jnp
 
 from presto_tpu.ops.dedispersion import (dedisp_subbands_block,
@@ -133,6 +140,7 @@ def make_block(i, rng_key):
 
 
 def main():
+    _force_virtual_mesh()
     t_all = time.time()
     art = {"config": {"numdms": NUMDMS, "nsamp": NSAMP,
                       "numchan": NUMCHAN, "nsub": NSUB,
@@ -240,6 +248,10 @@ def main():
     out = os.path.join(os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))),
         sys.argv[1] if len(sys.argv) > 1 else "TARGETSCALE_r03.json")
+    if os.path.exists(out):        # merge, never clobber other runs'
+        merged = json.load(open(out))   # sections (e.g. real_chip_*)
+        merged.update(art)
+        art = merged
     with open(out, "w") as f:
         json.dump(art, f, indent=1)
     print(json.dumps(art, indent=1))

@@ -37,6 +37,10 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+if jax.devices()[0].platform != "tpu":
+    raise SystemExit("target_scale_chip: needs the real TPU "
+                     "(platform is %s)" % jax.devices()[0].platform)
+
 from tools.target_scale import (NUMCHAN, NSUB, NUMPTS, NSAMP, NBLOCKS,
                                 DT, PSR_F0, PSR_DM, delays, make_block)
 from presto_tpu.ops.dedispersion import (dedisp_subbands_block,
@@ -52,7 +56,6 @@ def sync(x):
 
 def main():
     art_path = os.path.join(REPO, "TARGETSCALE_r03.json")
-    art = json.load(open(art_path)) if os.path.exists(art_path) else {}
     chip = {"device": str(jax.devices()[0]),
             "dms_per_device": DMS_PER_DEV}
 
@@ -235,6 +238,9 @@ def main():
     except Exception:
         pass
 
+    # load at WRITE time (the virtual-mesh run may have finished
+    # meanwhile) and merge — never clobber its sections
+    art = json.load(open(art_path)) if os.path.exists(art_path) else {}
     art["real_chip_r03"] = chip
     with open(art_path, "w") as f:
         json.dump(art, f, indent=1)
