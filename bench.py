@@ -198,6 +198,7 @@ def main():
         "inclusive_vs_baseline": round(incl_cells_per_sec / cpu_cells,
                                        2),
         "upload_s": round(upload_a, 2),
+        "warmup_s": round(warm_a, 1),
         "dm_trials_per_sec": round(dm_per_sec, 1),
         "dm_trials_vs_baseline": round(dm_per_sec / cpu_dmtrials, 2),
         "cpu_baseline_measured": cpu_meta is not None,

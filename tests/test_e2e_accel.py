@@ -157,3 +157,38 @@ def test_jerk_variant_e2e(tmp_path):
             (res.best_fdd, fdd)
     finally:
         os.chdir(old)
+
+
+def test_jerk_recovery_fast():
+    """Scaled-down jerk recovery for the FAST suite (VERDICT r2 weak
+    item 6: the flagship w-recovery living only behind the slow mark
+    let regressions surface late).  Smaller N/wmax, library-level
+    search (no CLI artifacts), same physics."""
+    from presto_tpu.models.synth import FakeSignal, fake_timeseries
+    from presto_tpu.ops import fftpack
+    from presto_tpu.search.accel import AccelConfig, AccelSearch
+    import jax.numpy as jnp
+    n = 1 << 15
+    dt = 1e-3
+    t_obs = n * dt
+    z0, w_true = 10.0, 60.0               # z_mid = 40 < zmax=60
+    f0 = 7.37
+    fd = z0 / (t_obs * t_obs)
+    fdd = w_true / (t_obs ** 3)
+    sig = FakeSignal(f=f0, fdot=fd, fdotdot=fdd, amp=0.6,
+                     shape="gauss", width=0.1)
+    data = fake_timeseries(n, dt, sig, noise_sigma=1.0, seed=17)
+    data = data - data.mean()
+    pairs = np.asarray(fftpack.realfft_packed_pairs(
+        jnp.asarray(data.astype(np.float32))))
+    cfg = AccelConfig(zmax=60, wmax=80, numharm=2, sigma=5.0)
+    cands = AccelSearch(cfg, T=t_obs, numbins=pairs.shape[0]) \
+        .search(pairs)
+    assert cands
+    best = max(cands, key=lambda c: c.sigma)
+    assert best.sigma > 6.0, (best.sigma,)
+    h = max(round((best.r / t_obs)
+                  / (f0 + 0.5 * fd * t_obs + fdd * t_obs ** 2 / 12)),
+            1)
+    assert best.w / h == pytest.approx(w_true, abs=30.0), \
+        (best.w, h, best.sigma)
