@@ -200,14 +200,24 @@ def float_dedisp_many_block(lastdata, data, delays_dm, approx_mean=0.0):
     NOT jitted itself: the dispatch must see the host array.  Callers
     may close over it inside their own jit — with np delays the
     static path's constants embed in the enclosing trace.  Plans past
-    _STATIC_SLICE_LIMIT total slices fall back to the vmap path (the
-    unrolled HLO would otherwise grow with numdms*nsub — a 4096-DM
-    survey fan-out is ~131k ops).
+    _STATIC_SLICE_LIMIT total slices run the SAME static path in DM
+    batches (one compiled program per batch, outputs concatenated) so
+    the unrolled HLO stays bounded while throughput keeps the fused
+    full-width passes; only traced (device-array) delays use the vmap
+    path.
     """
-    if isinstance(delays_dm, np.ndarray) and \
-            delays_dm.size <= _STATIC_SLICE_LIMIT:
-        return _static_fn_for(delays_dm)(lastdata, data,
-                                         float(approx_mean))
+    if isinstance(delays_dm, np.ndarray):
+        if delays_dm.size <= _STATIC_SLICE_LIMIT:
+            return _static_fn_for(delays_dm)(lastdata, data,
+                                             float(approx_mean))
+        # bigger plans (the 512-DM x 64-sub per-device target-scale
+        # share) stay on the fast path in DM batches: each batch is
+        # its own compiled program, outputs concatenate
+        per = max(1, _STATIC_SLICE_LIMIT // delays_dm.shape[1])
+        outs = [_static_fn_for(delays_dm[i:i + per])(
+                    lastdata, data, float(approx_mean))
+                for i in range(0, delays_dm.shape[0], per)]
+        return jnp.concatenate(outs, axis=0)
     return _float_dedisp_vmap(lastdata, data, jnp.asarray(delays_dm),
                               approx_mean)
 
@@ -224,8 +234,11 @@ def _static_fn_for(delays_dm: np.ndarray):
     key = (delays_dm.shape, delays_dm.dtype.str, delays_dm.tobytes())
     fn = _static_fns.get(key)
     if fn is None:
-        if len(_static_fns) > 8:      # bound retained programs
-            _static_fns.clear()
+        while len(_static_fns) > 32:   # bound retained programs:
+            # evict the OLDEST only — clearing everything would make
+            # plans whose batch count exceeds the bound re-jit every
+            # streamed block (dict preserves insertion order)
+            _static_fns.pop(next(iter(_static_fns)))
         dkey = tuple(map(tuple, delays_dm.astype(np.int64).tolist()))
 
         @jax.jit

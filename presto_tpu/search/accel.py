@@ -371,16 +371,12 @@ def _ffdot_slab_mxu(data, kz, consts, uselen, fftlen, halfwidth):
     bank; consts: _dft_consts pair arrays."""
     n2 = _DFT_N2
     n1 = fftlen // n2
-    m = n2 // 2
     B = data.shape[0]
     cx = lambda p: p[..., 0] + 1j * p[..., 1]
-    D1, T2, D2m, C2, Tb, iD1 = (cx(c) for c in consts)
+    C2, Tb, iD1 = (cx(c) for c in consts[3:])
     numz = kz.shape[0]
     prec = jax.lax.Precision.HIGHEST
-    x2 = data.reshape(B, n1, m)
-    Y = jnp.einsum("ab,xbj->xaj", D1, x2, precision=prec)
-    Sm = jnp.einsum("xaj,jk->xak", Y * T2[None], D2m, precision=prec)
-    S = jnp.concatenate([Sm, Sm], axis=-1)               # [B, n1, n2]
+    S = _fwd_stage_c(data, consts, fftlen)               # [B, n1, n2]
     Pm = S[:, None] * kz[None]                           # [B,numz,n1,n2]
     q = jnp.einsum("xzab,bc->xzac", Pm, C2, precision=prec)
     corr = jnp.einsum("ia,xzac->zxic", iD1, q * Tb[None, None],
@@ -390,6 +386,31 @@ def _ffdot_slab_mxu(data, kz, consts, uselen, fftlen, halfwidth):
     off = halfwidth * ACCEL_NUMBETWEEN
     pw = jax.lax.slice(pw, (0, 0, off), (numz, B, off + uselen))
     return pw.reshape(numz, B * uselen)
+
+
+def _fwd_stage_c(data, consts, fftlen):
+    """Forward half of the factored transform: block windows ->
+    stage-layout spectra S [B, n1, n2] complex — ONE implementation
+    shared by the XLA slab engine and the pallas builder, so the two
+    engines cannot drift."""
+    n2 = _DFT_N2
+    n1 = fftlen // n2
+    m = n2 // 2
+    B = data.shape[0]
+    cx = lambda p: p[..., 0] + 1j * p[..., 1]
+    D1, T2, D2m = (cx(c) for c in consts[:3])
+    prec = jax.lax.Precision.HIGHEST
+    x2 = data.reshape(B, n1, m)
+    Y = jnp.einsum("ab,xbj->xaj", D1, x2, precision=prec)
+    Sm = jnp.einsum("xaj,jk->xak", Y * T2[None], D2m, precision=prec)
+    return jnp.concatenate([Sm, Sm], axis=-1)
+
+
+def _fwd_stage_mxu(data, consts, fftlen):
+    """_fwd_stage_c as (re, im) float32 pairs (the pallas builder's
+    input form)."""
+    S = _fwd_stage_c(data, consts, fftlen)
+    return (S.real.astype(jnp.float32), S.imag.astype(jnp.float32))
 
 
 def _ffdot_slab_fft(data, kern_c, uselen, fftlen, halfwidth):
@@ -874,6 +895,61 @@ class AccelSearch:
             return jnp.concatenate(parts, axis=1) if P > 1 else parts[0]
         return frames
 
+    def _pallas_build_body(self, g, frames_fn):
+        """EXPERIMENTAL plane-build body (PRESTO_TPU_ACCEL_ENGINE=plb):
+        forward spectra in XLA, correlation + |.|^2 in a VMEM pallas
+        kernel (search/build_pallas.py).  Measured on v5e at the bench
+        workload: kernel alone ~130 ms but the XLA wrapping (fwd
+        stage, bank prep, the uselen slice pass, dispatch) brings the
+        whole build to ~385 ms vs the default XLA mxu engine's
+        ~305 ms — so it stays opt-in until the wrapper passes are
+        fused away.  Checksum-identical to the mxu engine."""
+        try:
+            from presto_tpu.search import accel_pallas as ap
+            if not ap.pallas_available():
+                print("accel: PRESTO_TPU_ACCEL_ENGINE=plb requested "
+                      "but no TPU backend — using the default engine")
+                return None
+            from presto_tpu.search import build_pallas as bp
+        except Exception as e:
+            print("accel: PRESTO_TPU_ACCEL_ENGINE=plb unavailable "
+                  "(%s) — using the default engine" % (e,))
+            return None
+        cfg, kern = self.cfg, self.kern
+        fftlen, numz = kern.fftlen, kern.numz
+        nblocks, plane_numr = g.nblocks, g.plane_numr
+        uselen = cfg.uselen
+        numz_pad = -(-numz // bp.ZT) * bp.ZT
+        nb_pad = -(-nblocks // bp.BB) * bp.BB
+        builder = bp.make_plane_builder(numz, nblocks, fftlen, uselen,
+                                        kern.halfwidth)
+        consts = _dft_consts_np(fftlen)
+
+        def build_body(fft_raw, kern_dev):
+            fr = jax.lax.slice(frames_fn(fft_raw), (0, 0),
+                               (nblocks, fftlen // 2))
+            if cfg.norm == "median":
+                fr = fr * _block_median_norms_c(fr)
+            Sr, Si = _fwd_stage_mxu(
+                fr, tuple(map(jnp.asarray, consts)), fftlen)
+            bpad = ((0, nb_pad - nblocks), (0, 0), (0, 0))
+            Sr, Si = jnp.pad(Sr, bpad), jnp.pad(Si, bpad)
+            kz = _kern_bank_z(kern_dev, fftlen)
+            Kr = jnp.pad(kz.real.astype(jnp.float32),
+                         ((0, numz_pad - numz), (0, 0), (0, 0)))
+            Ki = jnp.pad(kz.imag.astype(jnp.float32),
+                         ((0, numz_pad - numz), (0, 0), (0, 0)))
+            pw = builder(Sr, Si, Kr, Ki)   # [numz_pad, nb_pad, n1, n2]
+            off = kern.halfwidth * ACCEL_NUMBETWEEN
+            frames3 = pw.reshape(numz_pad, nb_pad, fftlen)
+            body = jax.lax.slice(
+                frames3, (0, 0, off),
+                (numz, nblocks, off + uselen)).reshape(
+                    numz, nblocks * uselen)
+            return jnp.pad(
+                body, ((0, 0), (0, plane_numr - nblocks * uselen)))
+        return build_body
+
     # how many chunk bodies are unrolled for the concat assembly before
     # falling back to a scanned DUS carry (HLO size bound; planes that
     # big exceed single-chip HBM anyway and stream through oocfft)
@@ -905,6 +981,13 @@ class AccelSearch:
 
             frames_fn = self._frames_fn(g)
             chunk = g.chunk
+
+            plb = self._pallas_build_body(g, frames_fn) \
+                if (use_mxu and ACCEL_ENGINE == "plb") else None
+            if plb is not None:
+                g.build_body = plb
+                g.key = (g.chunk, g.nsteps, g.plane_numr, "plb")
+                return g
 
             # the unrolled concat holds all slabs (~1x plane) PLUS the
             # concat output plane; when 2x plane + the chunk

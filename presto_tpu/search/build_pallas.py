@@ -1,0 +1,135 @@
+"""Pallas TPU kernel for the F-Fdot plane build (correlation stage).
+
+The XLA build (accel.py `_ffdot_slab_mxu`) materializes multi-GB
+complex intermediates between its einsum stages — at the ~200 GB/s
+this chip streams, those passes dominate the build.  This kernel
+keeps everything in VMEM: for one (z-tile, block) grid cell it loads
+the block's forward spectrum S (tiny, stage-layout [n1, n2]) and the
+z-tile's kernel bank slice, computes
+
+    Pm   = S * conj(K_z)              (VPU, complex as re/im pairs)
+    q    = Pm @ C2                    (MXU, inverse stage A over k2)
+    r    = q * Tbar                   (VPU twiddle, 1/fftlen folded in)
+    corr = iD1 @ r_z  per z           (MXU, inverse stage B over k1)
+    out  = |corr|^2                   (VPU)
+
+and writes the [zt, block, n1, n2] power frames (full fftlen width;
+the caller's fused XLA pass slices the uselen window into the plane —
+an in-kernel [n1,n2]->[1,fftlen] flatten is a Mosaic relayout that
+measured slower than the extra pass).  The factored-DFT math is
+identical to
+_ffdot_slab_mxu (same constants, from _dft_consts_np), so the two
+engines agree to float32 rounding of the dot order.
+
+Grid: (z_tiles, nblocks) with block minor, so pallas's BlockSpec
+pipelining re-fetches the kernel-bank tile only when the z-tile
+changes and streams S per block.  Output is [numz_pad, nblocks,
+uselen] (full lane-dim blocks — a 2-D [.., uselen]-wide block would
+put every store at an unaligned lane offset); the caller reshapes to
+the plane and pads, both free or cheap.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+ZT = 8                       # z rows per grid cell (sublane tile)
+BB = 8                       # blocks per grid cell (the output block's
+                             # second-minor dim must be a multiple of 8)
+
+
+def make_plane_builder(numz: int, nblocks: int, fftlen: int,
+                       uselen: int, halfwidth: int,
+                       interpret: bool = False):
+    """Returns f(S_re, S_im [nb_pad, n1, n2], K_re, K_im
+    [numz_pad, n1, n2]) -> powers [numz_pad, nb_pad, n1, n2],
+    nb_pad = ceil(nblocks/BB)*BB (callers zero-pad S, then slice the
+    [off : off+uselen] window of the flattened last two dims).
+
+    K is the stage-layout CONJUGATED bank (accel._kern_bank_z, split
+    to pairs); numz_pad = ceil(numz/8)*8 with zero rows below."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+    from presto_tpu.search.accel import (_dft_consts_np,
+                                         ACCEL_NUMBETWEEN)
+
+    n2 = 128
+    n1 = fftlen // n2
+    numz_pad = -(-numz // ZT) * ZT
+    nzt = numz_pad // ZT
+    nb_pad = -(-nblocks // BB) * BB
+    off = halfwidth * ACCEL_NUMBETWEEN
+    # inverse-stage constants (host f64 -> f32 pairs)
+    _D1, _T2, _D2m, C2, Tb, iD1 = _dft_consts_np(fftlen)
+    C2r, C2i = (jnp.asarray(C2[..., i]) for i in (0, 1))
+    Tbr, Tbi = (jnp.asarray(Tb[..., i]) for i in (0, 1))
+    iD1r, iD1i = (jnp.asarray(iD1[..., i]) for i in (0, 1))
+
+    prec = jax.lax.Precision.HIGHEST
+
+    def dot(a, b):
+        return jax.lax.dot_general(a, b, (((1,), (0,)), ((), ())),
+                                   preferred_element_type=jnp.float32,
+                                   precision=prec)
+
+    def kernel(Sr_ref, Si_ref, Kr_ref, Ki_ref,
+               C2r_ref, C2i_ref, Tbr_ref, Tbi_ref, iD1r_ref, iD1i_ref,
+               out_ref):
+        kr = Kr_ref[...].reshape(ZT * n1, n2)
+        ki = Ki_ref[...].reshape(ZT * n1, n2)
+        c2r, c2i = C2r_ref[...], C2i_ref[...]
+        tbr = jnp.tile(Tbr_ref[...], (ZT, 1))
+        tbi = jnp.tile(Tbi_ref[...], (ZT, 1))
+        d1r, d1i = iD1r_ref[...], iD1i_ref[...]
+        for bb in range(BB):
+            Sr = jnp.tile(Sr_ref[bb], (ZT, 1))       # [ZT*n1, n2]
+            Si = jnp.tile(Si_ref[bb], (ZT, 1))
+            # stage A (all ZT z rows in one [ZT*n1, n2] MXU batch)
+            pr = Sr * kr - Si * ki                   # Pm = S * Kconj
+            pi = Sr * ki + Si * kr                   # (K pre-conj'd)
+            qr = dot(pr, c2r) - dot(pi, c2i)         # q = Pm @ C2
+            qi = dot(pr, c2i) + dot(pi, c2r)
+            rr = qr * tbr - qi * tbi                 # r = q * Tbar
+            ri = qr * tbi + qi * tbr
+            # stage B: move z from sublane blocks to LANE blocks so
+            # all ZT rows share one [n1, ZT*n2] dot (256 tiny per-z
+            # dots per cell measured SLOWER than the XLA engine)
+            rl_r = jnp.concatenate(
+                [rr[z * n1:(z + 1) * n1] for z in range(ZT)], axis=1)
+            rl_i = jnp.concatenate(
+                [ri[z * n1:(z + 1) * n1] for z in range(ZT)], axis=1)
+            cr = dot(d1r, rl_r) - dot(d1i, rl_i)     # [n1, ZT*n2]
+            ci = dot(d1r, rl_i) + dot(d1i, rl_r)
+            pw = cr * cr + ci * ci
+            for z in range(ZT):
+                out_ref[z, bb] = pw[:, z * n2:(z + 1) * n2]
+        return
+
+    @jax.jit
+    def build(Sr, Si, Kr, Ki):
+        grid = (nzt, nb_pad // BB)
+        return pl.pallas_call(
+            kernel,
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec((BB, n1, n2), lambda zt, b: (b, 0, 0)),
+                pl.BlockSpec((BB, n1, n2), lambda zt, b: (b, 0, 0)),
+                pl.BlockSpec((ZT, n1, n2), lambda zt, b: (zt, 0, 0)),
+                pl.BlockSpec((ZT, n1, n2), lambda zt, b: (zt, 0, 0)),
+                pl.BlockSpec((n2, n2), lambda zt, b: (0, 0)),
+                pl.BlockSpec((n2, n2), lambda zt, b: (0, 0)),
+                pl.BlockSpec((n1, n2), lambda zt, b: (0, 0)),
+                pl.BlockSpec((n1, n2), lambda zt, b: (0, 0)),
+                pl.BlockSpec((n1, n1), lambda zt, b: (0, 0)),
+                pl.BlockSpec((n1, n1), lambda zt, b: (0, 0)),
+            ],
+            out_specs=pl.BlockSpec((ZT, BB, n1, n2),
+                                   lambda zt, b: (zt, b, 0, 0)),
+            out_shape=jax.ShapeDtypeStruct(
+                (numz_pad, nb_pad, n1, n2), jnp.float32),
+            interpret=interpret,
+        )(Sr, Si, Kr, Ki, C2r, C2i, Tbr, Tbi, iD1r, iD1i)
+
+    return build

@@ -63,3 +63,41 @@ def test_pallas_reducer_matches_numpy(numharm):
                                            nstages)
     np.testing.assert_allclose(got_max, want_max, rtol=1e-6)
     np.testing.assert_array_equal(got_z, want_z)
+
+
+def test_plane_builder_matches_mxu_engine():
+    """search/build_pallas.py (experimental plb engine) must agree
+    with the XLA factored-DFT engine it mirrors (interpret mode)."""
+    import jax.numpy as jnp
+    from presto_tpu.search.accel import (
+        AccelConfig, AccelKernels, _dft_consts_np, _ffdot_slab_mxu,
+        _kern_bank_z, _fft_kernel_bank_c, _fwd_stage_mxu)
+    from presto_tpu.search import build_pallas as bp
+    cfg = AccelConfig(zmax=20, numharm=2, uselen=1024)
+    kern = AccelKernels.build(cfg)
+    fftlen, hw, numz = kern.fftlen, kern.halfwidth, cfg.numz
+    rng = np.random.default_rng(3)
+    B = 9                                 # exercises block padding
+    data = (rng.normal(size=(B, fftlen // 2))
+            + 1j * rng.normal(size=(B, fftlen // 2))
+            ).astype(np.complex64)
+    kc = _fft_kernel_bank_c(jnp.asarray(kern.kern_pairs), fftlen)
+    kz = _kern_bank_z(kc, fftlen)
+    consts = tuple(map(jnp.asarray, _dft_consts_np(fftlen)))
+    want = np.asarray(_ffdot_slab_mxu(jnp.asarray(data), kz, consts,
+                                      cfg.uselen, fftlen, hw))
+    Sr, Si = _fwd_stage_mxu(jnp.asarray(data), consts, fftlen)
+    nb_pad = -(-B // bp.BB) * bp.BB
+    numz_pad = -(-numz // bp.ZT) * bp.ZT
+    bpad = ((0, nb_pad - B), (0, 0), (0, 0))
+    zpad = ((0, numz_pad - numz), (0, 0), (0, 0))
+    build = bp.make_plane_builder(numz, B, fftlen, cfg.uselen, hw,
+                                  interpret=True)
+    pw = np.asarray(build(
+        jnp.pad(Sr, bpad), jnp.pad(Si, bpad),
+        jnp.pad(kz.real.astype(jnp.float32), zpad),
+        jnp.pad(kz.imag.astype(jnp.float32), zpad)))
+    off = 2 * hw
+    got = pw.reshape(numz_pad, nb_pad, fftlen)[
+        :numz, :B, off:off + cfg.uselen].reshape(numz, -1)
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
