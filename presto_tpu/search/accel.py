@@ -899,11 +899,14 @@ class AccelSearch:
         """EXPERIMENTAL plane-build body (PRESTO_TPU_ACCEL_ENGINE=plb):
         forward spectra in XLA, correlation + |.|^2 in a VMEM pallas
         kernel (search/build_pallas.py).  Measured on v5e at the bench
-        workload: kernel alone ~130 ms but the XLA wrapping (fwd
-        stage, bank prep, the uselen slice pass, dispatch) brings the
-        whole build to ~385 ms vs the default XLA mxu engine's
-        ~305 ms — so it stays opt-in until the wrapper passes are
-        fused away.  Checksum-identical to the mxu engine."""
+        workload: kernel alone ~74 ms (after real-stacking each
+        complex matmul into ONE MXU dot — per-dot issue latency, not
+        FLOPs, dominated), but the XLA wrapping (fwd stage, bank
+        prep, and above all the [.., n1, n2] -> flat-time slice pass,
+        a physical relayout TPU tiling cannot view for free) brings
+        the whole build to ~365 ms vs the default engine's ~305 ms —
+        opt-in until that relayout is eliminated.  Checksum-identical
+        to the mxu engine."""
         try:
             from presto_tpu.search import accel_pallas as ap
             if not ap.pallas_available():

@@ -61,11 +61,21 @@ def make_plane_builder(numz: int, nblocks: int, fftlen: int,
     nzt = numz_pad // ZT
     nb_pad = -(-nblocks // BB) * BB
     off = halfwidth * ACCEL_NUMBETWEEN
-    # inverse-stage constants (host f64 -> f32 pairs)
+    # inverse-stage constants (host f64 -> f32 pairs).  Complex
+    # matmuls are ONE real MXU dot each via the real-stacking
+    # identity  [Ar|Ai] @ [[Br, Bi], [-Bi, Br]] = [Cr|Ci]  — per-dot
+    # ISSUE LATENCY, not FLOP throughput, dominated the 64-small-dot
+    # version of this kernel.
     _D1, _T2, _D2m, C2, Tb, iD1 = _dft_consts_np(fftlen)
-    C2r, C2i = (jnp.asarray(C2[..., i]) for i in (0, 1))
+
+    def two(c):
+        r, i = c[..., 0], c[..., 1]
+        return jnp.asarray(np.block([[r, i], [-i, r]]))
+
+    C2two = two(C2)                       # [2*n2, 2*n2]
     Tbr, Tbi = (jnp.asarray(Tb[..., i]) for i in (0, 1))
-    iD1r, iD1i = (jnp.asarray(iD1[..., i]) for i in (0, 1))
+    iD1two = jnp.asarray(
+        np.concatenate([iD1[..., 0], iD1[..., 1]], axis=1))  # [n1,2n1]
 
     prec = jax.lax.Precision.HIGHEST
 
@@ -75,33 +85,34 @@ def make_plane_builder(numz: int, nblocks: int, fftlen: int,
                                    precision=prec)
 
     def kernel(Sr_ref, Si_ref, Kr_ref, Ki_ref,
-               C2r_ref, C2i_ref, Tbr_ref, Tbi_ref, iD1r_ref, iD1i_ref,
-               out_ref):
+               C2two_ref, Tbr_ref, Tbi_ref, iD1two_ref, out_ref):
         kr = Kr_ref[...].reshape(ZT * n1, n2)
         ki = Ki_ref[...].reshape(ZT * n1, n2)
-        c2r, c2i = C2r_ref[...], C2i_ref[...]
+        c2two = C2two_ref[...]
         tbr = jnp.tile(Tbr_ref[...], (ZT, 1))
         tbi = jnp.tile(Tbi_ref[...], (ZT, 1))
-        d1r, d1i = iD1r_ref[...], iD1i_ref[...]
+        d1two = iD1two_ref[...]
         for bb in range(BB):
             Sr = jnp.tile(Sr_ref[bb], (ZT, 1))       # [ZT*n1, n2]
             Si = jnp.tile(Si_ref[bb], (ZT, 1))
-            # stage A (all ZT z rows in one [ZT*n1, n2] MXU batch)
+            # stage A (all ZT z rows, ONE [ZT*n1, 2n2]@[2n2, 2n2] dot)
             pr = Sr * kr - Si * ki                   # Pm = S * Kconj
             pi = Sr * ki + Si * kr                   # (K pre-conj'd)
-            qr = dot(pr, c2r) - dot(pi, c2i)         # q = Pm @ C2
-            qi = dot(pr, c2i) + dot(pi, c2r)
+            q2 = dot(jnp.concatenate([pr, pi], axis=1), c2two)
+            qr, qi = q2[:, :n2], q2[:, n2:]
             rr = qr * tbr - qi * tbi                 # r = q * Tbar
             ri = qr * tbi + qi * tbr
-            # stage B: move z from sublane blocks to LANE blocks so
-            # all ZT rows share one [n1, ZT*n2] dot (256 tiny per-z
-            # dots per cell measured SLOWER than the XLA engine)
+            # stage B: z moved from sublane blocks to LANE blocks and
+            # the complex product real-stacked on the CONTRACTION:
+            # ONE [n1, 2n1]@[2n1, ZT*n2] dot for all ZT rows
             rl_r = jnp.concatenate(
                 [rr[z * n1:(z + 1) * n1] for z in range(ZT)], axis=1)
             rl_i = jnp.concatenate(
                 [ri[z * n1:(z + 1) * n1] for z in range(ZT)], axis=1)
-            cr = dot(d1r, rl_r) - dot(d1i, rl_i)     # [n1, ZT*n2]
-            ci = dot(d1r, rl_i) + dot(d1i, rl_r)
+            cr = dot(d1two,
+                     jnp.concatenate([rl_r, -rl_i], axis=0))
+            ci = dot(d1two,
+                     jnp.concatenate([rl_i, rl_r], axis=0))
             pw = cr * cr + ci * ci
             for z in range(ZT):
                 out_ref[z, bb] = pw[:, z * n2:(z + 1) * n2]
@@ -118,18 +129,16 @@ def make_plane_builder(numz: int, nblocks: int, fftlen: int,
                 pl.BlockSpec((BB, n1, n2), lambda zt, b: (b, 0, 0)),
                 pl.BlockSpec((ZT, n1, n2), lambda zt, b: (zt, 0, 0)),
                 pl.BlockSpec((ZT, n1, n2), lambda zt, b: (zt, 0, 0)),
-                pl.BlockSpec((n2, n2), lambda zt, b: (0, 0)),
-                pl.BlockSpec((n2, n2), lambda zt, b: (0, 0)),
+                pl.BlockSpec((2 * n2, 2 * n2), lambda zt, b: (0, 0)),
                 pl.BlockSpec((n1, n2), lambda zt, b: (0, 0)),
                 pl.BlockSpec((n1, n2), lambda zt, b: (0, 0)),
-                pl.BlockSpec((n1, n1), lambda zt, b: (0, 0)),
-                pl.BlockSpec((n1, n1), lambda zt, b: (0, 0)),
+                pl.BlockSpec((n1, 2 * n1), lambda zt, b: (0, 0)),
             ],
             out_specs=pl.BlockSpec((ZT, BB, n1, n2),
                                    lambda zt, b: (zt, b, 0, 0)),
             out_shape=jax.ShapeDtypeStruct(
                 (numz_pad, nb_pad, n1, n2), jnp.float32),
             interpret=interpret,
-        )(Sr, Si, Kr, Ki, C2r, C2i, Tbr, Tbi, iD1r, iD1i)
+        )(Sr, Si, Kr, Ki, C2two, Tbr, Tbi, iD1two)
 
     return build
