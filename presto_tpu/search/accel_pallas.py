@@ -50,11 +50,12 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-TILE = 512                   # columns per grid tile (lanes):
-                             # 512 measured ~15% faster than 256
-                             # on the bench workload (fewer per-
-                             # tile DMAs/collects)
-PLANE_PAD = 640              # right-edge zero padding the plane needs
+TILE = 1024                  # columns per grid tile (lanes): fewer
+                             # per-tile DMAs/collects win — 256/512/
+                             # 1024 measured 194/164/151 ms on the
+                             # bench workload (VMEM bounds going
+                             # further)
+PLANE_PAD = 1152             # right-edge zero padding the plane needs
                              # (largest per-term DMA window)
 
 
