@@ -1284,12 +1284,17 @@ class AccelSearch:
         # and a TILE-multiple slab; fall back to the XLA scanner when
         # the geometry is too small to align
         use_pallas = False
+        ptile = None
         try:
             from presto_tpu.search import accel_pallas as ap
-            if (ap.pallas_available() and cfg.numharm <= 16
-                    and plane_numr % ap.TILE == 0
-                    and slab >= 4 * ap.TILE):
-                align = max(align, ap.TILE)
+            fz_probe = _harm_fracs_and_zinds(cfg, self.cfg.numz)
+            # plane is aligned to the MAX tile, so any smaller
+            # power-of-two tile the VMEM budget picks also divides it
+            ptile = ap.pick_tile(fz_probe, self.cfg.numz, slab) \
+                if (ap.pallas_available() and cfg.numharm <= 16
+                    and plane_numr % ap.TILE == 0) else None
+            if ptile:
+                align = max(align, ptile)
                 use_pallas = True
         except Exception:
             pass
@@ -1309,7 +1314,7 @@ class AccelSearch:
             if use_pallas:
                 reducer = ap.make_stage_reducer(
                     cfg.numharmstages, fz, slab, self.cfg.numz,
-                    plane_numr)
+                    plane_numr, tile=ptile)
             self._fn_cache[skey] = _make_search_scanner(
                 cfg.numharmstages, fz, self.powcut, slab, k,
                 plane_numr, aligned=aligned,

@@ -71,24 +71,26 @@ def _stage_terms(fracs_zinds):
     return terms, counts
 
 
-def _term_geom(harm: int, htot: int, zinds: np.ndarray):
+def _term_geom(harm: int, htot: int, zinds: np.ndarray,
+               tile: int = None):
     """Static per-term window geometry: rows the zinds map can touch
     (8-padded) and the 128-multiple DMA window width covering the
     column map's span from any 128-aligned floor.  The residual
-    off = ((j0//htot)*harm) % 128 with j0 a multiple of TILE is a
-    multiple of TILE*harm/htot mod 128, i.e. of 16 for htot=16 — so
-    off can reach 112 (NOT 96: a 96-based window undersized the
-    harm=1/htot=16 term by one lane chunk, zeroing 8 of every 2048
-    columns of its stage-5 sums)."""
+    off = ((j0//htot)*harm) % 128 is a multiple of (TILE*harm/htot)
+    mod 128 — at TILE=1024 only {0, 64}, but the sizing keeps the
+    worst case over ANY TILE >= 128 (112, reached at TILE=256 for
+    htot=16; an earlier 96-based window undersized that term by one
+    lane chunk and silently zeroed 8 of every 2048 columns)."""
+    tile = tile or TILE
     rows = -(-(int(zinds.max()) + 1) // 8) * 8
-    cspan = ((TILE - 1) * harm + (htot >> 1)) // htot + 2
+    cspan = ((tile - 1) * harm + (htot >> 1)) // htot + 2
     win = -(-(112 + cspan) // 128) * 128
     return rows, win
 
 
 def make_stage_reducer(numharmstages, fracs_zinds, slab: int,
                        numz: int, plane_numr: int,
-                       interpret: bool = False):
+                       interpret: bool = False, tile: int = None):
     """Build the pallas stage reducer.
 
     Returns f(P, start_cols) -> (colmax f32, colz i32), each
@@ -102,12 +104,13 @@ def make_stage_reducer(numharmstages, fracs_zinds, slab: int,
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
+    TILE = tile or globals()["TILE"]
     terms, counts = _stage_terms(fracs_zinds)
     nterms = len(terms)
     ntiles = slab // TILE
     nstages = numharmstages
     numz_pad = -(-numz // 8) * 8
-    geom = [_term_geom(h, t, zi) for (h, t, zi) in terms]
+    geom = [_term_geom(h, t, zi, TILE) for (h, t, zi) in terms]
 
     # bf16x3 stacked one-hot z-permutation: oh3[t] is [numz_pad,
     # 3*rows] with the same one-hot block repeated for the hi/mid/lo
@@ -251,6 +254,38 @@ def make_stage_reducer(numharmstages, fracs_zinds, slab: int,
 def pad_rows(numz: int) -> int:
     """Rows the kernel-ready plane must have (8-sublane tiling)."""
     return -(-numz // 8) * 8
+
+
+def scratch_bytes(fracs_zinds, numz: int, tile: int = None) -> int:
+    """Static VMEM scratch estimate for make_stage_reducer (acc + the
+    x2-parity window banks + the bf16 one-hot inputs) — callers gate
+    on this instead of discovering a Mosaic scratch-allocation error
+    at dispatch time (scratch scales with TILE and numz)."""
+    tile = tile or TILE
+    terms, _ = _stage_terms(fracs_zinds)
+    numz_pad = pad_rows(numz)
+    total = numz_pad * tile * 4                 # acc
+    total += 2 * numz_pad * tile * 4            # fundamental banks
+    for (h, t, zi) in terms:
+        rows, win = _term_geom(h, t, zi, tile)
+        total += 2 * rows * win * 4             # term window banks
+        total += numz_pad * 3 * rows * 2        # oh3 (bf16, VMEM in)
+    return total
+
+
+# the TPU's scoped-vmem stack limit is 16 MB (measured: a 19.6 MB
+# scratch set fails kernel compile); leave spill headroom
+VMEM_BUDGET = 14 * 2 ** 20
+
+
+def pick_tile(fracs_zinds, numz: int, slab: int):
+    """Largest tile whose scratch fits the scoped-vmem budget (None
+    when even the smallest doesn't — caller falls back to XLA)."""
+    for t in (TILE, 512, 256):
+        if t <= slab and slab % t == 0 and \
+                scratch_bytes(fracs_zinds, numz, t) <= VMEM_BUDGET:
+            return t
+    return None
 
 
 def pallas_available() -> bool:

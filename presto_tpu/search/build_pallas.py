@@ -74,8 +74,11 @@ def make_plane_builder(numz: int, nblocks: int, fftlen: int,
 
     C2two = two(C2)                       # [2*n2, 2*n2]
     Tbr, Tbi = (jnp.asarray(Tb[..., i]) for i in (0, 1))
-    iD1two = jnp.asarray(
-        np.concatenate([iD1[..., 0], iD1[..., 1]], axis=1))  # [n1,2n1]
+    # LEFT-side stacking needs the transpose-shaped block matrix:
+    # [[Dr, -Di], [Di, Dr]] @ [Rr; Ri] = [Dr Rr - Di Ri ; Di Rr + Dr Ri]
+    iD1two = jnp.asarray(np.block(
+        [[iD1[..., 0], -iD1[..., 1]],
+         [iD1[..., 1], iD1[..., 0]]]))    # [2*n1, 2*n1]
 
     prec = jax.lax.Precision.HIGHEST
 
@@ -103,16 +106,15 @@ def make_plane_builder(numz: int, nblocks: int, fftlen: int,
             rr = qr * tbr - qi * tbi                 # r = q * Tbar
             ri = qr * tbi + qi * tbr
             # stage B: z moved from sublane blocks to LANE blocks and
-            # the complex product real-stacked on the CONTRACTION:
-            # ONE [n1, 2n1]@[2n1, ZT*n2] dot for all ZT rows
+            # the complex product real-stacked on BOTH sides: ONE
+            # [2n1, 2n1]@[2n1, ZT*n2] dot yields [cr; ci] for all ZT
             rl_r = jnp.concatenate(
                 [rr[z * n1:(z + 1) * n1] for z in range(ZT)], axis=1)
             rl_i = jnp.concatenate(
                 [ri[z * n1:(z + 1) * n1] for z in range(ZT)], axis=1)
-            cr = dot(d1two,
-                     jnp.concatenate([rl_r, -rl_i], axis=0))
-            ci = dot(d1two,
-                     jnp.concatenate([rl_i, rl_r], axis=0))
+            c2 = dot(d1two,
+                     jnp.concatenate([rl_r, rl_i], axis=0))
+            cr, ci = c2[:n1], c2[n1:]
             pw = cr * cr + ci * ci
             for z in range(ZT):
                 out_ref[z, bb] = pw[:, z * n2:(z + 1) * n2]
@@ -132,7 +134,7 @@ def make_plane_builder(numz: int, nblocks: int, fftlen: int,
                 pl.BlockSpec((2 * n2, 2 * n2), lambda zt, b: (0, 0)),
                 pl.BlockSpec((n1, n2), lambda zt, b: (0, 0)),
                 pl.BlockSpec((n1, n2), lambda zt, b: (0, 0)),
-                pl.BlockSpec((n1, 2 * n1), lambda zt, b: (0, 0)),
+                pl.BlockSpec((2 * n1, 2 * n1), lambda zt, b: (0, 0)),
             ],
             out_specs=pl.BlockSpec((ZT, BB, n1, n2),
                                    lambda zt, b: (zt, b, 0, 0)),

@@ -44,9 +44,10 @@ def test_pallas_reducer_matches_numpy(numharm):
     numz = cfg.numz                      # 21
     nstages = cfg.numharmstages
     slab = 2 * TILE
-    # wide enough to place a slab at j0=1792: the htot=16 terms hit
-    # the maximal DMA-floor residual off=112 there (regression for the
-    # undersized-window bug that zeroed their last 8 columns)
+    # slabs at several TILE-aligned starts: at TILE=1024 the htot=16
+    # DMA-floor residual takes its full reachable set {0, 64} (the
+    # historical off=112 undersize case is unreachable at this TILE;
+    # _term_geom sizes for the worst case over any TILE >= 128)
     R = 10 * TILE + PLANE_PAD
     P = rng.random((numz, R)).astype(np.float32)
     P[:, -PLANE_PAD:] = 0.0              # the padding contract
