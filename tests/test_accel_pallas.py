@@ -102,3 +102,30 @@ def test_plane_builder_matches_mxu_engine():
     got = pw.reshape(numz_pad, nb_pad, fftlen)[
         :numz, :B, off:off + cfg.uselen].reshape(numz, -1)
     np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
+
+
+def test_pick_tile_vmem_gate():
+    """Tile selection honors the measured 16 MB scoped-vmem stack:
+    big-numz searches step down tiles and eventually decline the
+    kernel instead of failing at dispatch."""
+    from presto_tpu.search.accel import (AccelConfig,
+                                         _harm_fracs_and_zinds)
+    from presto_tpu.search.accel_pallas import (pick_tile,
+                                                scratch_bytes,
+                                                VMEM_BUDGET, TILE)
+    slab = 1 << 20
+    picks = {}
+    for zmax in (200, 400, 800):
+        cfg = AccelConfig(zmax=zmax, numharm=8)
+        fz = _harm_fracs_and_zinds(cfg, cfg.numz)
+        t = pick_tile(fz, cfg.numz, slab)
+        picks[zmax] = t
+        if t is not None:
+            assert scratch_bytes(fz, cfg.numz, t) <= VMEM_BUDGET
+            assert slab % t == 0
+    assert picks[200] == TILE          # bench config keeps the max
+    assert picks[400] is not None and picks[400] < TILE
+    assert picks[800] is None          # graceful XLA fallback
+    # tiny slabs never get a tile bigger than themselves
+    assert pick_tile(_harm_fracs_and_zinds(
+        AccelConfig(zmax=20, numharm=2), 21), 21, 128) is None
