@@ -146,16 +146,15 @@ def test_static_path_batches_large_plans(monkeypatch):
     """Host delay plans past the unroll bound run the SAME static
     path in DM batches, bit-identical to the vmap path (the 512-DM
     target-scale share; a monolithic unroll OOMs at compile)."""
-    import presto_tpu.ops.dedispersion as ddm
-    monkeypatch.setattr(ddm, "_STATIC_SLICE_LIMIT", 128)
+    monkeypatch.setattr(dd, "_STATIC_SLICE_LIMIT", 128)
     rng = np.random.default_rng(7)
     nsub, T, nd = 8, 256, 70          # 560 slices > patched limit
     last = rng.normal(size=(nsub, T)).astype(np.float32)
     data = rng.normal(size=(nsub, T)).astype(np.float32)
     dl = rng.integers(0, T, (nd, nsub)).astype(np.int32)
-    a = np.asarray(ddm.float_dedisp_many_block(
+    a = np.asarray(dd.float_dedisp_many_block(
         jnp.asarray(last), jnp.asarray(data), dl))
-    b = np.asarray(ddm._float_dedisp_vmap(
+    b = np.asarray(dd._float_dedisp_vmap(
         jnp.asarray(last), jnp.asarray(data), jnp.asarray(dl)))
     assert a.shape == (nd, T)
     np.testing.assert_array_equal(a, b)
