@@ -29,11 +29,13 @@ def build_parser():
     p.add_argument("-norfi", action="store_true",
                    help="Skip rfifind masking")
     p.add_argument("-workdir", type=str, default=".")
+    from presto_tpu.pipeline.recipes import RECIPES
     p.add_argument("--recipe", type=str, default=None,
-                   help="named survey policy (palfa, gbncc): sets the "
-                        "accel passes, sift thresholds, fold "
-                        "selection, SP settings and zaplist; -lodm/"
-                        "-hidm/-nsub/-zaplist still apply")
+                   help="named survey policy (%s): sets the accel "
+                        "passes, sift thresholds, fold selection, SP "
+                        "settings and zaplist; -lodm/-hidm/-nsub/"
+                        "-zaplist still apply"
+                        % ", ".join(sorted(RECIPES)))
     p.add_argument("rawfiles", nargs="+")
     return p
 

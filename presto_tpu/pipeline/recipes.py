@@ -13,12 +13,13 @@ reproduces the PALFA flow end to end (and the policies are testable
 on synthetic data, tests/test_survey_recipe.py).
 
 Recipe values are taken from the reference drivers:
-PALFA_presto_search.py:28-52, GBNCC_search.py:16-35.
+PALFA_presto_search.py:28-52, GBNCC_search.py:16-35,
+GBT350_drift_search.py:16-35.
 """
 
 from __future__ import annotations
 
-from dataclasses import dataclass, field
+from dataclasses import dataclass, field, replace
 from typing import Optional, Tuple
 
 from presto_tpu.pipeline.sifting import SiftPolicy
@@ -89,7 +90,16 @@ GBNCC = SurveyRecipe(
     sp_threshold=5.0, sp_maxwidth=0.1,
     nsub=32)
 
-RECIPES = {r.name: r for r in (PALFA, GBNCC)}
+# GBT350 drift survey (GBT350_drift_search.py:16-35): GBNCC's policy
+# at the 350 MHz drift scan, except a much tighter fold budget — the
+# driver caps 20 lo-accel + 10 hi-accel folds per pointing
+# (GBT350_drift_search.py:21-22; SurveyRecipe has one combined cap,
+# so 30 approximates the split).  The reference driver also splits
+# the drifting observation into pointings upstream of this
+# per-pointing flow (run the recipe per pointing file).
+GBT350_DRIFT = replace(GBNCC, name="gbt350drift", max_folds=30)
+
+RECIPES = {r.name: r for r in (PALFA, GBNCC, GBT350_DRIFT)}
 
 
 def get_recipe(name: str) -> SurveyRecipe:

@@ -44,7 +44,10 @@ def test_palfa_recipe_one_command(tmp_path):
 def test_recipe_expansion():
     """Recipe -> SurveyConfig policy mapping (fast check)."""
     from presto_tpu.pipeline.recipes import get_recipe, RECIPES
-    assert set(RECIPES) == {"palfa", "gbncc"}
+    assert set(RECIPES) == {"palfa", "gbncc", "gbt350drift"}
+    drift = get_recipe("gbt350drift").to_config(0.0, 90.0)
+    assert drift.all_passes == ((0, 16, 2.0), (50, 8, 3.0))
+    assert drift.rfi_time == pytest.approx(25600 * 0.00008192)
     cfg = get_recipe("palfa").to_config(10.0, 50.0)
     assert (cfg.zmax, cfg.numharm, cfg.sigma) == (0, 16, 2.0)
     assert cfg.accel_passes == ((50, 8, 3.0),)
