@@ -36,25 +36,30 @@ def build_parser():
                         "settings and zaplist; -lodm/-hidm/-nsub/"
                         "-zaplist still apply"
                         % ", ".join(sorted(RECIPES)))
+    p.add_argument("--driftprep", action="store_true",
+                   help="treat the input as a raw drift scan: split "
+                        "it into overlapping pointings first (apps/"
+                        "drift_prep) and run the survey per pointing "
+                        "(the GBT350_drift_search.py flow)")
+    p.add_argument("-orign", type=int, default=None,
+                   help="with --driftprep: samples per pointing")
     p.add_argument("rawfiles", nargs="+")
     return p
 
 
 def main(argv=None) -> int:
-    args = build_parser().parse_args(argv)
+    parser = build_parser()
+    args = parser.parse_args(argv)
     if args.recipe:
         # the recipe OWNS these policies — explicitly-passed values
         # would be silently ignored, so make the conflict loud
-        for flag, val, dflt in (("-zmax", args.zmax, 0),
-                                ("-numharm", args.numharm, 8),
-                                ("-sigma", args.sigma, 4.0),
-                                ("-rfitime", args.rfitime, 2.0),
-                                ("-foldtop", args.foldtop, 3)):
-            if val != dflt:
+        for name in ("zmax", "numharm", "sigma", "rfitime",
+                     "foldtop"):
+            if getattr(args, name) != parser.get_default(name):
                 raise SystemExit(
-                    "pipeline: %s conflicts with --recipe %s (the "
+                    "pipeline: -%s conflicts with --recipe %s (the "
                     "recipe sets that policy); drop the flag or the "
-                    "recipe" % (flag, args.recipe))
+                    "recipe" % (name, args.recipe))
         from presto_tpu.pipeline.recipes import get_recipe
         cfg = get_recipe(args.recipe).to_config(
             args.lodm, args.hidm, nsub=args.nsub,
@@ -68,6 +73,32 @@ def main(argv=None) -> int:
             rfi_time=args.rfitime, zaplist=args.zaplist,
             fold_top=args.foldtop, singlepulse=not args.nosp,
             skip_rfifind=args.norfi)
+    if args.driftprep:
+        # drift-scan mode: prep the pointings, then one survey per
+        # pointing in its own subdirectory (each pointing is an
+        # independent sky position; GBT350_drift_search.py runs this
+        # flow once per prepped file)
+        import os
+        from presto_tpu.pipeline.driftprep import (ORIG_N,
+                                                   split_drift_scan)
+        pointings = split_drift_scan(
+            args.rawfiles, outdir=args.workdir,
+            orig_N=args.orign or ORIG_N)
+        print("pipeline: drift scan -> %d pointings" % len(pointings))
+        results = []
+        for pf in pointings:
+            sub = os.path.join(
+                args.workdir,
+                os.path.splitext(os.path.basename(pf))[0])
+            results.append(run_survey([pf], cfg, workdir=sub))
+        print("pipeline: done — %d pointings, %d sifted cands, "
+              "%d folds, %d SP events"
+              % (len(results),
+                 sum(len(r.sifted) if r.sifted else 0
+                     for r in results),
+                 sum(len(r.folded) for r in results),
+                 sum(r.sp_events for r in results)))
+        return 0
     res = run_survey(args.rawfiles, cfg, workdir=args.workdir)
     print("pipeline: done — %d DMs, %d sifted cands, %d folds, "
           "%d SP events" % (len(res.datfiles),

@@ -200,11 +200,22 @@ class PsrfitsFile:
         # read_spectra always presents ascending frequency, so the
         # header describes the band with fch1 = lowest center, foff > 0
         # (same convention FilterbankFile ends up with post-flip).
+        def _colons_to_sigproc(s: str) -> float:
+            # "hh:mm:ss.s" -> hhmmss.s (SIGPROC packed coordinate)
+            try:
+                parts = [p for p in s.split(":") if p != ""]
+                sign = -1.0 if parts and parts[0].startswith("-") else 1.0
+                vals = [abs(float(p)) for p in parts] + [0.0, 0.0]
+                return sign * (vals[0] * 10000 + vals[1] * 100 + vals[2])
+            except (ValueError, IndexError):
+                return 0.0
         return FilterbankHeader(
             source_name=self.source or "Unknown",
             nchans=self.nchan, nbits=self.nbits,
             fch1=float(self.freqs.min()), foff=abs(self.df),
             tsamp=self.dt, tstart=float(self.start_mjd),
+            src_raj=_colons_to_sigproc(getattr(self, "ra_str", "")),
+            src_dej=_colons_to_sigproc(getattr(self, "dec_str", "")),
             nifs=1, N=int(self.N))
 
     @property

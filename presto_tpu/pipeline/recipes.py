@@ -30,15 +30,21 @@ from presto_tpu.pipeline.survey import SurveyConfig
 class SurveyRecipe:
     name: str
     rfi_time: float                       # rfifind interval (s)
-    # ((zmax, numharm, sigma), ...): first is the primary pass
-    accel_passes: Tuple[Tuple[int, int, float], ...]
+    # ((zmax, numharm, sigma, flo), ...): first is the primary pass;
+    # flo is the per-pass low-frequency search limit in Hz
+    # (lo_accel_flo=2.0 / hi_accel_flo=1.0, PALFA_presto_search.py:39-43)
+    accel_passes: Tuple[Tuple[int, int, float, float], ...]
     sift: SiftPolicy
     fold_sigma: float                     # to_prepfold_sigma
-    max_folds: int                        # max_cands_to_fold
+    max_folds: int                        # max_cands_to_fold (combined)
     sp_threshold: float
     sp_maxwidth: float
     use_default_zaplist: bool = True
     nsub: int = 32
+    # per-pass fold caps aligned with accel_passes, e.g. GBNCC's
+    # 20-lo + 10-hi split (GBNCC_search.py:21-22); None -> one
+    # combined max_folds cap (PALFA_presto_search.py:33)
+    fold_caps_per_pass: Optional[Tuple[int, ...]] = None
 
     def to_config(self, lodm: float, hidm: float,
                   nsub: Optional[int] = None,
@@ -47,15 +53,16 @@ class SurveyRecipe:
         if zaplist is None and self.use_default_zaplist:
             from presto_tpu.utils.catalog import default_birds_path
             zaplist = default_birds_path()
-        (zmax0, nh0, sg0), *rest = self.accel_passes
+        (zmax0, nh0, sg0, flo0), *rest = self.accel_passes
         return SurveyConfig(
             lodm=lodm, hidm=hidm, nsub=nsub or self.nsub,
             rfi_time=self.rfi_time,
-            zmax=zmax0, numharm=nh0, sigma=sg0,
+            zmax=zmax0, numharm=nh0, sigma=sg0, flo=flo0,
             accel_passes=tuple(rest) or None,
             zaplist=zaplist,
             sift_policy=self.sift,
             fold_sigma=self.fold_sigma, max_folds=self.max_folds,
+            max_folds_per_pass=self.fold_caps_per_pass,
             sp_threshold=self.sp_threshold,
             sp_maxwidth=self.sp_maxwidth)
 
@@ -69,7 +76,7 @@ class SurveyRecipe:
 PALFA = SurveyRecipe(
     name="palfa",
     rfi_time=2 ** 15 * 0.000064,          # 2.097 s
-    accel_passes=((0, 16, 2.0), (50, 8, 3.0)),
+    accel_passes=((0, 16, 2.0, 2.0), (50, 8, 3.0, 1.0)),
     sift=SiftPolicy(sigma_threshold=5.0, c_pow_threshold=100.0,
                     short_period=0.0005, long_period=15.0,
                     harm_pow_cutoff=8.0, r_err=1.1),
@@ -78,26 +85,27 @@ PALFA = SurveyRecipe(
     nsub=32)
 
 # GBNCC (GBT 350 MHz Northern Celestial Cap; GBNCC_search.py:16-35):
-# same lo/hi accel pair and thresholds at GBT 350 MHz sampling.
+# same lo/hi accel pair and thresholds at GBT 350 MHz sampling, with
+# the per-pass fold budget (20 lo-accel + 10 hi-accel,
+# GBNCC_search.py:21-22,479-486).
 GBNCC = SurveyRecipe(
     name="gbncc",
     rfi_time=25600 * 0.00008192,          # 2.097 s
-    accel_passes=((0, 16, 2.0), (50, 8, 3.0)),
+    accel_passes=((0, 16, 2.0, 2.0), (50, 8, 3.0, 1.0)),
     sift=SiftPolicy(sigma_threshold=5.0, c_pow_threshold=100.0,
                     short_period=0.0005, long_period=15.0,
                     harm_pow_cutoff=8.0, r_err=1.1),
-    fold_sigma=6.0, max_folds=150,
+    fold_sigma=6.0, max_folds=30, fold_caps_per_pass=(20, 10),
     sp_threshold=5.0, sp_maxwidth=0.1,
     nsub=32)
 
 # GBT350 drift survey (GBT350_drift_search.py:16-35): GBNCC's policy
-# at the 350 MHz drift scan, except a much tighter fold budget — the
-# driver caps 20 lo-accel + 10 hi-accel folds per pointing
-# (GBT350_drift_search.py:21-22; SurveyRecipe has one combined cap,
-# so 30 approximates the split).  The reference driver also splits
-# the drifting observation into pointings upstream of this
-# per-pointing flow (run the recipe per pointing file).
-GBT350_DRIFT = replace(GBNCC, name="gbt350drift", max_folds=30)
+# (same lo/hi passes, same 20+10 per-pass fold caps,
+# GBT350_drift_search.py:21-22) applied per drift-scan pointing.
+# Split a raw drift scan into overlapping pointings first with
+# `python -m presto_tpu.apps.drift_prep` (the GBT350_drift_prep.py
+# analog) or pass --driftprep to the pipeline app.
+GBT350_DRIFT = replace(GBNCC, name="gbt350drift")
 
 RECIPES = {r.name: r for r in (PALFA, GBNCC, GBT350_DRIFT)}
 

@@ -36,9 +36,11 @@ class SurveyConfig:
     zmax: int = 0
     numharm: int = 8
     sigma: float = 4.0
+    flo: float = 1.0                       # min freq searched (Hz)
     zaplist: Optional[str] = None
-    # extra accelsearch passes beyond (zmax, numharm, sigma), e.g.
-    # the PALFA lo/hi pair — each entry is (zmax, numharm, sigma)
+    # extra accelsearch passes beyond (zmax, numharm, sigma[, flo]),
+    # e.g. the PALFA lo/hi pair — each entry is (zmax, numharm,
+    # sigma) or (zmax, numharm, sigma, flo); a 3-tuple inherits flo
     accel_passes: Optional[tuple] = None
     # sifting / folding
     min_dm_hits: int = 2
@@ -47,6 +49,10 @@ class SurveyConfig:
     sift_policy: Optional[object] = None   # sifting.SiftPolicy
     fold_sigma: Optional[float] = None     # fold all cands above this
     max_folds: int = 150                   # ... capped here
+    # per-pass fold caps aligned with all_passes, e.g. the GBNCC/
+    # GBT350 20-lo + 10-hi split (GBNCC_search.py:21-22,
+    # GBT350_drift_search.py:21-22); None -> one combined max_folds
+    max_folds_per_pass: Optional[tuple] = None
     # single pulse
     sp_threshold: float = 5.0
     sp_maxwidth: float = 0.0
@@ -55,8 +61,11 @@ class SurveyConfig:
 
     @property
     def all_passes(self):
-        return ((self.zmax, self.numharm, self.sigma),) + \
+        """Normalized 4-tuples (zmax, numharm, sigma, flo)."""
+        raw = ((self.zmax, self.numharm, self.sigma, self.flo),) + \
             tuple(self.accel_passes or ())
+        return tuple(p if len(p) == 4 else tuple(p) + (self.flo,)
+                     for p in raw)
 
 
 @dataclass
@@ -151,10 +160,10 @@ def _run_survey_stages(rawfiles, cfg, workdir, base, res, timer):
         timer.mark("accelsearch")
         # ---- 6. accelsearch: BATCHED over the DM fan-out, once per
         # recipe pass (e.g. PALFA's zmax=0/nh=16 + zmax=50/nh=8) -----
-        for (zmax, nh, sg) in passes:
+        for (zmax, nh, sg, flo) in passes:
             _batched_accelsearch(
                 fftfiles, _replace(cfg, zmax=zmax, numharm=nh,
-                                   sigma=sg))
+                                   sigma=sg, flo=flo))
     else:
         # ---- 4+6 fused fast path: realfft -> accelsearch with the
         # spectra RESIDENT on device (no zapbirds in between).  Saves
@@ -163,12 +172,13 @@ def _run_survey_stages(rawfiles, cfg, workdir, base, res, timer):
         # still written, preserving the checkpoint contract.
         timer.mark("realfft+accelsearch (fused)")
         _fused_fft_search(res, cfg)
-        for (zmax, nh, sg) in passes:
+        for (zmax, nh, sg, flo) in passes:
             # resume case for the first pass; full searches for the
             # recipe's additional passes
             _batched_accelsearch(
                 [f[:-4] + ".fft" for f in res.datfiles],
-                _replace(cfg, zmax=zmax, numharm=nh, sigma=sg))
+                _replace(cfg, zmax=zmax, numharm=nh, sigma=sg,
+                         flo=flo))
 
     timer.mark("sift")
     return _finish_survey_stages(rawfiles, cfg, workdir, base, res,
@@ -191,7 +201,7 @@ def _survey_searcher(first_file, nbins, cfg):
     info = read_inf(first_file[:-4] + ".inf")
     T = info.N * info.dt
     acfg = AccelConfig(zmax=cfg.zmax, numharm=cfg.numharm,
-                       sigma=cfg.sigma)
+                       sigma=cfg.sigma, flo=cfg.flo)
     return AccelSearch(acfg, T=T, numbins=nbins), T
 
 
@@ -291,7 +301,7 @@ def _finish_survey_stages(rawfiles, cfg, workdir, base, res, timer):
     # ---- 7. sift ------------------------------------------------------
     from presto_tpu.pipeline.sifting import sift_candidates
     accfiles = []
-    for (zmax, _nh, _sg) in cfg.all_passes:
+    for (zmax, _nh, _sg, _flo) in cfg.all_passes:
         accfiles += _stage(os.path.basename(base)
                            + "_DM*_ACCEL_%d" % zmax, workdir)
     accfiles = sorted(set(accfiles))
@@ -310,9 +320,24 @@ def _finish_survey_stages(rawfiles, cfg, workdir, base, res, timer):
     ranked = sorted(cl.cands, key=lambda c: -c.sigma)
     if cfg.fold_sigma is not None:
         # recipe policy: fold everything above to_prepfold_sigma,
-        # never more than max_folds (PALFA_presto_search.py:32-33)
-        top = [c for c in ranked
-               if c.sigma >= cfg.fold_sigma][:cfg.max_folds]
+        # never more than max_folds (PALFA_presto_search.py:32-33);
+        # per-pass caps split the budget by search pass, e.g. 20
+        # lo-accel + 10 hi-accel (GBNCC_search.py:479-486)
+        above = [c for c in ranked if c.sigma >= cfg.fold_sigma]
+        if cfg.max_folds_per_pass:
+            if len(cfg.max_folds_per_pass) != len(cfg.all_passes):
+                raise ValueError(
+                    "max_folds_per_pass has %d caps for %d accel "
+                    "passes" % (len(cfg.max_folds_per_pass),
+                                len(cfg.all_passes)))
+            top = []
+            for (zmax, _nh, _sg, _flo), cap in zip(
+                    cfg.all_passes, cfg.max_folds_per_pass):
+                tag = "_ACCEL_%d" % zmax
+                top += [c for c in above
+                        if c.filename.endswith(tag)][:cap]
+        else:
+            top = above[:cfg.max_folds]
     else:
         top = ranked[:cfg.fold_top]
     for i, c in enumerate(top):

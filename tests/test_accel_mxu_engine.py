@@ -1,0 +1,66 @@
+"""Force the MXU-DFT correlation engine on CPU (VERDICT r3 weak item
+6): the engine that actually runs on TPU hardware
+(search/accel.py _ffdot_slab_mxu, selected by _use_mxu_engine only on
+TPU in auto mode) must be covered by the fast suite, not only by
+device artifacts.  PRESTO_TPU_ACCEL_ENGINE=mxu forces it on any
+backend (accel.py:306), so this runs the same search twice — factored
+MXU-DFT engine vs the jnp.fft engine — at the bench fftlen (8192, the
+zmax=200 plan) and asserts the candidate lists agree."""
+
+import numpy as np
+import pytest
+
+from presto_tpu.search import accel
+from presto_tpu.search.accel import (AccelConfig, AccelSearch,
+                                     remove_duplicates)
+
+
+def _tone_pairs(numbins, T, tones, seed=7):
+    N = 2 * numbins
+    rng = np.random.default_rng(seed)
+    t = np.arange(N) / N
+    x = rng.normal(size=N)
+    for (r0, z, amp) in tones:
+        x += amp * np.cos(2 * np.pi * (r0 * t + 0.5 * z * t * t))
+    X = np.fft.rfft(x)[:numbins]
+    return np.stack([X.real, X.imag], -1).astype(np.float32)
+
+
+def _key(c):
+    return (c.numharm, round(2 * c.r), round(2 * c.z))
+
+
+def test_mxu_engine_matches_fft_engine_fftlen8192(monkeypatch):
+    numbins = 1 << 16
+    T = 300.0
+    # isolated tones, far apart (> dedup radius), so the two engines'
+    # float32 rounding cannot flip cluster representatives
+    tones = [(5000.25, 0.0, 0.08), (17000.5, 30.0, 0.10),
+             (40000.0, -60.0, 0.12)]
+    pairs = _tone_pairs(numbins, T, tones)
+    cfg = AccelConfig(zmax=200, numharm=4, sigma=5.0)
+
+    monkeypatch.setattr(accel, "ACCEL_ENGINE", "mxu")
+    s = AccelSearch(cfg, T=T, numbins=numbins)
+    assert accel._use_mxu_engine(s.kern.fftlen), \
+        "mxu engine not engaged (fftlen=%d)" % s.kern.fftlen
+    assert s.kern.fftlen >= 8192
+    mxu = remove_duplicates(s.search(pairs))
+
+    monkeypatch.setattr(accel, "ACCEL_ENGINE", "fft")
+    fft = remove_duplicates(
+        AccelSearch(cfg, T=T, numbins=numbins).search(pairs))
+
+    assert mxu and fft
+    mk, fk = {_key(c): c for c in mxu}, {_key(c): c for c in fft}
+    assert set(mk) == set(fk), \
+        "engine candidate lists differ: mxu-only=%s fft-only=%s" % (
+            sorted(set(mk) - set(fk)), sorted(set(fk) - set(mk)))
+    for k, mc in mk.items():
+        fc = fk[k]
+        assert mc.sigma == pytest.approx(fc.sigma, abs=0.05), k
+        assert mc.power == pytest.approx(fc.power, rel=1e-3), k
+    # the injected tones were all recovered: a chirp r0*t + z*t^2/2
+    # is detected at its mid-observation frequency r0 + z/2
+    for (r0, z, _a) in tones:
+        assert any(abs(c.r - (r0 + z / 2)) <= 1.0 for c in mxu), r0

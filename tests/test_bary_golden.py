@@ -103,6 +103,32 @@ def test_earth_ssb_velocity_absolute():
         assert err_km_s < VEL_BOUND_KM_S, (mjd, err_km_s)
 
 
+def test_epv_vs_independent_keplerian_oracle():
+    """Cross-check vs an INDEPENDENT model (ADVICE r3 item 2): the
+    golden vectors above are themselves the EPV series, so a
+    systematic epv.npz regeneration error (wrong units, swapped axes,
+    truncated tables) could pass the self-consistency bounds.  The
+    data-free Keplerian model (ephem='KEPLER') shares nothing with
+    the tables; its absolute error is ~16,000 km position / ~1 m/s
+    velocity (measured), so the default must agree with it to
+    ~25,000 km / 5 m/s — while a scale/axis/units error in a
+    regenerated epv.npz would miss by a large fraction of an AU
+    (or by km/s in velocity)."""
+    for mjd, _pb, _vb in GOLDEN_EPV:
+        jd = mjd + 2400000.5
+        pos_e, vel_e = earth_posvel_ssb(jd)
+        pos_k, vel_k = earth_posvel_ssb(jd, ephem="KEPLER")
+        dpos_km = np.linalg.norm(
+            np.asarray(pos_e) - np.asarray(pos_k)) * AU_KM
+        dvel_mm_s = np.linalg.norm(
+            np.asarray(vel_e) - np.asarray(vel_k)) \
+            * AU_KM / 86400.0 * 1e6
+        assert dpos_km < 25000.0, (mjd, dpos_km)
+        assert dvel_mm_s < 5000.0, (mjd, dvel_mm_s)
+        # and the two models really are distinct implementations
+        assert dpos_km > 1.0, "KEPLER appears to alias the default"
+
+
 def test_roemer_delay_absolute_and_differential():
     """Roemer delay p.n/c: absolute error < 0.4 ms (the km-grade
     default), differential drift over an 8 h observation < 1 us."""

@@ -1,0 +1,47 @@
+"""Claim/artifact equality (VERDICT r3 item 7): BASELINE.md's
+BENCH_TABLE and WARMUP blocks must equal what tools/update_baseline.py
+regenerates from the NEWEST driver-captured BENCH_r*.json — committing
+a stale BASELINE.md fails the suite (the 10.8 s-vs-17.1 s class of
+drift from rounds 1-3, permanently dead)."""
+
+import json
+import os
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+import update_baseline as ub  # noqa: E402
+
+
+def _have_artifacts():
+    path, bench = ub.newest_bench_artifact()
+    return bench is not None and os.path.exists(
+        os.path.join(REPO, "cpu_baseline.json"))
+
+
+@pytest.mark.skipif(not _have_artifacts(),
+                    reason="no BENCH_r*.json artifact yet")
+def test_baseline_md_matches_newest_bench_artifact():
+    path, bench = ub.newest_bench_artifact()
+    with open(os.path.join(REPO, "cpu_baseline.json")) as f:
+        cpu = json.load(f)
+    src = open(os.path.join(REPO, "BASELINE.md")).read()
+    regenerated = ub.apply_blocks(src, ub.render_table(bench, cpu),
+                                  ub.render_warmup(bench))
+    # the last-update date may differ; everything else may not
+    assert ub.strip_date(regenerated) == ub.strip_date(src), (
+        "BASELINE.md BENCH_TABLE/WARMUP blocks are stale vs %s — "
+        "run: python tools/update_baseline.py --from-artifact"
+        % os.path.basename(path))
+
+
+def test_update_baseline_refuses_regime_less_json():
+    with pytest.raises(ValueError):
+        ub.render_table({"value": 1.0, "dm_trials_per_sec": 1.0,
+                         "vs_baseline": 1.0,
+                         "dm_trials_vs_baseline": 1.0},
+                        {"accel_cells_per_sec": 1.0,
+                         "dedisp_dm_trials_per_sec": 1.0})

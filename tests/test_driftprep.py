@@ -1,0 +1,141 @@
+"""Drift-scan preparation (VERDICT r3 item 8): carve a drifting
+observation into overlapping per-pointing files the way the reference
+prep scripts do (bin/GBT350_drift_prep.py:25-33), then run the
+gbt350drift recipe from a raw scan end to end."""
+
+import glob
+import os
+
+import numpy as np
+import pytest
+
+from presto_tpu.models.synth import FakeSignal, fake_filterbank_file
+from presto_tpu.pipeline.driftprep import (_coord_tag,
+                                           _deg_ra_to_sigproc,
+                                           _sigproc_to_deg_ra,
+                                           plan_pointings,
+                                           split_drift_scan)
+
+
+def test_pointing_plan_overlap():
+    """NMAX = total/overlap_samples - 1, starts step by half a
+    pointing at overlap 0.5 (GBT350_drift_prep.py:27,44-46)."""
+    plan = plan_pointings(total_samples=10000, tsamp=1e-3,
+                          tstart=55000.0, src_raj=120000.0,
+                          src_dej=-300000.0, orig_N=4000,
+                          overlap_factor=0.5)
+    assert len(plan) == 10000 // 2000 - 1          # 4 pointings
+    assert [p.start_sample for p in plan] == [0, 2000, 4000, 6000]
+    assert all(p.nsamp == 4000 for p in plan)
+    # successive pointings share half their samples
+    assert plan[1].start_sample == plan[0].start_sample + 2000
+    # dec fixed, tstart advances by the hop
+    assert all(p.src_dej == -300000.0 for p in plan)
+    assert plan[1].tstart == pytest.approx(
+        55000.0 + 2000 * 1e-3 / 86400.0)
+
+
+def test_pointing_ra_advances_sidereal():
+    """RA advances at the sidereal rate between pointing midpoints."""
+    tsamp = 81.92e-6
+    plan = plan_pointings(total_samples=1728000 * 2, tsamp=tsamp,
+                          tstart=55000.0, src_raj=0.0, src_dej=0.0,
+                          orig_N=1728000, overlap_factor=0.5)
+    hop_s = 864000 * tsamp                      # ~70.8 s
+    d_ra = (_sigproc_to_deg_ra(plan[1].src_raj)
+            - _sigproc_to_deg_ra(plan[0].src_raj))
+    assert d_ra == pytest.approx(360.0 * hop_s / 86164.0905, rel=1e-6)
+
+
+def test_ra_roundtrip_and_tag():
+    for deg in (0.0, 123.456, 359.9, 15.0):
+        back = _sigproc_to_deg_ra(_deg_ra_to_sigproc(deg))
+        assert back == pytest.approx(deg % 360.0, abs=1e-6)
+    assert _coord_tag(123456.7, -54321.0) == "1234-0543"
+    assert _coord_tag(1230.0, 54321.0) == "0012+0543"
+
+
+def test_split_drift_scan_roundtrip(tmp_path):
+    """Cut pointings carry exactly the right samples (8-bit lossless)
+    and honor the overlap; re-running reuses existing outputs."""
+    d = str(tmp_path)
+    scan = os.path.join(d, "scan.fil")
+    N, nchan = 6000, 16
+    fake_filterbank_file(scan, N=N, dt=1e-3, nchan=nchan,
+                         lofreq=350.0, chanwidth=1.0,
+                         signal=FakeSignal(f=5.0, dm=10.0, amp=0.5),
+                         noise_sigma=5.0, nbits=8, seed=7)
+    from presto_tpu.io.sigproc import FilterbankFile
+    with FilterbankFile(scan) as fb:
+        full = fb.read_spectra(0, N)
+    out = split_drift_scan([scan], outdir=d, orig_N=2000,
+                           overlap_factor=0.5, prefix="tdrift")
+    assert len(out) == 6000 // 1000 - 1
+    mtimes = [os.path.getmtime(f) for f in out]
+    for i, f in enumerate(out):
+        with FilterbankFile(f) as fb:
+            got = fb.read_spectra(0, fb.nspectra)
+            assert fb.nspectra == 2000
+        np.testing.assert_array_equal(
+            got, full[i * 1000:i * 1000 + 2000])
+    # checkpoint contract: second run rewrites nothing
+    out2 = split_drift_scan([scan], outdir=d, orig_N=2000,
+                            overlap_factor=0.5, prefix="tdrift")
+    assert out2 == out
+    assert [os.path.getmtime(f) for f in out2] == mtimes
+
+
+def test_drift_prep_app_nmax_and_single(tmp_path):
+    d = str(tmp_path)
+    scan = os.path.join(d, "scan.fil")
+    fake_filterbank_file(scan, N=5000, dt=1e-3, nchan=8,
+                         lofreq=350.0, chanwidth=1.0,
+                         signal=FakeSignal(f=5.0, dm=10.0, amp=0.5),
+                         noise_sigma=4.0, nbits=8, seed=3)
+    from presto_tpu.apps.drift_prep import main as prep_main
+    import io
+    from contextlib import redirect_stdout
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        prep_main(["-nmax", "-orign", "2000", scan])
+    # 4 pointings -> NMAX = 3
+    assert int(buf.getvalue().strip()) == 3
+    # one selected pointing only (the cluster fan-out mode)
+    prep_main(["-num", "1", "-orign", "2000", "-outdir", d,
+               "-prefix", "one", scan])
+    made = glob.glob(os.path.join(d, "one_*_p0001.fil"))
+    assert len(made) == 1
+    with pytest.raises(ValueError):
+        from presto_tpu.pipeline.driftprep import split_drift_scan \
+            as sds
+        sds([scan], outdir=d, orig_N=2000, pointing=99)
+
+
+@pytest.mark.slow
+def test_gbt350drift_recipe_from_raw_scan(tmp_path):
+    """--recipe gbt350drift --driftprep: raw drift scan in, per-
+    pointing survey directories out (the GBT350_drift_search.py flow,
+    VERDICT r3 missing item 2)."""
+    d = str(tmp_path)
+    scan = os.path.join(d, "scan.fil")
+    sig = FakeSignal(f=11.1, dm=40.0, shape="gauss", width=0.06,
+                     amp=1.5)
+    fake_filterbank_file(scan, N=1 << 15, dt=5e-4, nchan=32,
+                         lofreq=350.0, chanwidth=1.0, signal=sig,
+                         noise_sigma=3.0, nbits=8)
+    from presto_tpu.apps.pipeline import main as pipeline_main
+    rc = pipeline_main(["--recipe", "gbt350drift", "--driftprep",
+                        "-orign", str(1 << 14), "-lodm", "30",
+                        "-hidm", "55", "-nsub", "16",
+                        "-workdir", d, scan])
+    assert rc == 0
+    # 2^15 samples at orig_N=2^14, overlap 0.5 -> NMAX+1 = 3 pointings
+    pfiles = sorted(glob.glob(os.path.join(d, "drift_*_p????.fil")))
+    assert len(pfiles) == 3
+    # every pointing got its own survey directory with sifted cands
+    for pf in pfiles:
+        sub = os.path.splitext(pf)[0]
+        assert os.path.exists(os.path.join(sub, "cands_sifted.txt"))
+    # the injected pulsar is recovered in at least one pointing
+    folded = glob.glob(os.path.join(d, "*", "fold_cand*.pfd"))
+    assert folded, "no pointing folded any candidate"

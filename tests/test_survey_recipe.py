@@ -46,14 +46,25 @@ def test_recipe_expansion():
     from presto_tpu.pipeline.recipes import get_recipe, RECIPES
     assert set(RECIPES) == {"palfa", "gbncc", "gbt350drift"}
     drift = get_recipe("gbt350drift").to_config(0.0, 90.0)
-    assert drift.all_passes == ((0, 16, 2.0), (50, 8, 3.0))
+    # per-pass flo: lo_accel_flo=2.0 / hi_accel_flo=1.0
+    # (GBT350_drift_search.py:30-33)
+    assert drift.all_passes == ((0, 16, 2.0, 2.0), (50, 8, 3.0, 1.0))
     assert drift.rfi_time == pytest.approx(25600 * 0.00008192)
+    # per-pass fold budget: 20 lo + 10 hi (GBT350_drift_search.py:21-22,
+    # GBNCC_search.py:21-22)
+    assert drift.max_folds_per_pass == (20, 10)
+    assert drift.max_folds == 30
+    gbncc = get_recipe("gbncc").to_config(0.0, 90.0)
+    assert gbncc.max_folds_per_pass == (20, 10)
     cfg = get_recipe("palfa").to_config(10.0, 50.0)
-    assert (cfg.zmax, cfg.numharm, cfg.sigma) == (0, 16, 2.0)
-    assert cfg.accel_passes == ((50, 8, 3.0),)
-    assert cfg.all_passes == ((0, 16, 2.0), (50, 8, 3.0))
+    assert (cfg.zmax, cfg.numharm, cfg.sigma, cfg.flo) == \
+        (0, 16, 2.0, 2.0)
+    assert cfg.accel_passes == ((50, 8, 3.0, 1.0),)
+    assert cfg.all_passes == ((0, 16, 2.0, 2.0), (50, 8, 3.0, 1.0))
     assert cfg.sift_policy.sigma_threshold == 5.0
+    # PALFA keeps the single combined cap (PALFA_presto_search.py:33)
     assert cfg.fold_sigma == 6.0 and cfg.max_folds == 150
+    assert cfg.max_folds_per_pass is None
     assert cfg.sp_maxwidth == 0.1
     assert cfg.zaplist and os.path.exists(cfg.zaplist)
     with pytest.raises(ValueError):

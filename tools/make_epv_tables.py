@@ -11,6 +11,19 @@ executed or translated) and writes them to a compact .npz the package
 ships.  Provenance and the evaluation model are documented in
 astro/ephem.py.
 
+Licensing basis (ADVICE r3): the coefficients are the published
+scientific result of Moisson & Bretagnon (2001) — measured facts of
+the solar system's dynamics, distributed by IMCCE as data tables and
+reprinted across ephemeris implementations.  Facts and discoveries
+are not copyrightable subject matter (only their expression is); the
+GPL on SLALIB covers epv.f's *code*, none of which is used — the
+Fortran is treated purely as a container for the published numeric
+tables, equivalent to retyping them from the paper's electronic
+supplement.  Anyone re-deriving epv.npz without the reference tree
+can regenerate the identical numbers from the IMCCE VSOP2000
+distribution (ftp://ftp.imcce.fr/pub/ephem/planets/vsop2000), which
+is the canonical upstream source.
+
 Usage: python tools/make_epv_tables.py [path-to-epv.f] [out.npz]
 """
 

@@ -3,13 +3,18 @@
 Usage:
     python bench.py | python tools/update_baseline.py
 or  python tools/update_baseline.py '<bench json line>'
+or  python tools/update_baseline.py --from-artifact   # newest BENCH_r*.json
 
 Reads cpu_baseline.json for the CPU side and replaces the block
 between BENCH_TABLE_START/END markers, so the committed claims are
-always regenerated from measurements (VERDICT r1 item 10).
+always regenerated from measurements (VERDICT r1 item 10).  The fast
+suite regenerates the same blocks from the newest driver-captured
+BENCH_r*.json and fails on any drift (tests/test_claim_drift.py,
+VERDICT r3 item 7) — a stale BASELINE.md cannot be committed.
 """
 
 import datetime
+import glob
 import json
 import os
 import re
@@ -17,72 +22,148 @@ import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
+DATE_TOKEN = "(last update %s;"
 
-def main():
-    if len(sys.argv) > 1:
-        text = sys.argv[1]
-    else:
-        text = sys.stdin.read()
-    line = next(ln for ln in text.splitlines()
-                if ln.strip().startswith("{"))
-    bench = json.loads(line)
-    with open(os.path.join(REPO, "cpu_baseline.json")) as f:
-        cpu = json.load(f)
 
+def newest_bench_artifact(repo=REPO):
+    """(path, parsed-bench-dict) of the highest-round BENCH_r*.json.
+    Driver artifacts wrap the bench line as {"n": N, "parsed": {...}};
+    accept both that and a bare bench dict."""
+    def round_no(p):
+        m = re.search(r"_r(\d+)\.json$", p)
+        return int(m.group(1)) if m else -1
+    arts = sorted(glob.glob(os.path.join(repo, "BENCH_r*.json")),
+                  key=round_no)
+    if not arts:
+        return None, None
+    path = arts[-1]
+    with open(path) as f:
+        doc = json.load(f)
+    return path, doc.get("parsed", doc)
+
+
+def render_table(bench, cpu, date=None):
+    """The BENCH_TABLE block body for a bench JSON + cpu baseline.
+    Raises ValueError when the bench line lacks the device-resident
+    regime marker (measurement-boundary mixing guard)."""
+    if bench.get("regime") != "device-resident":
+        raise ValueError(
+            "bench JSON lacks the device-resident regime marker — "
+            "refusing to mix measurement boundaries in one table")
     cells = bench["value"]
     dm = bench["dm_trials_per_sec"]
-    if bench.get("regime") != "device-resident":
-        print("update_baseline: bench JSON lacks the device-resident "
-              "regime marker — refusing to mix measurement "
-              "boundaries in one table", file=sys.stderr)
-        return 1
     incl = bench.get("inclusive_cells_per_sec", float("nan"))
     incl_r = bench.get("inclusive_vs_baseline", float("nan"))
-    table = (
+    rows = [
         "| Metric | CPU (cpu_baseline.json) | TPU v5e chip (steady) "
-        "| ratio |\n|---|---|---|---|\n"
+        "| ratio |",
+        "|---|---|---|---|",
         "| accelsearch zmax=200 nh=8, 2²¹ bins (config 4), "
-        "device-resident | %.3g cells/s | %.3g cells/s | **%.1f×** "
-        "|\n"
+        "device-resident | %.3g cells/s | %.3g cells/s | **%.1f×** |"
+        % (cpu["accel_cells_per_sec"], cells, bench["vs_baseline"]),
         "| — same, inclusive of a fresh 16 MB spectrum upload "
         "(tunnel-bound HERE, ~µs on PCIe; rounds 1-2 reported THIS "
         "regime as the headline) | %.3g cells/s | %.3g cells/s "
-        "| %.1f× |\n"
-        "| dedispersion 128 chan→32 sub→128 DM × "
-        "2²⁰ (config 2, compute) | %.1f DM-trials/s "
-        "| %.0f DM-trials/s | **%.1f×** |\n\n"
-        "(last update %s; TPU numbers vary ±20-30%% run-to-run "
+        "| %.1f× |"
+        % (cpu["accel_cells_per_sec"], incl, incl_r),
+        "| dedispersion 128 chan→32 sub→128 DM × 2²⁰ (config 2, "
+        "compute) | %.1f DM-trials/s | %.0f DM-trials/s | **%.1f×** |"
+        % (cpu["dedisp_dm_trials_per_sec"], dm,
+           bench["dm_trials_vs_baseline"]),
+    ]
+    # optional rows appear when bench.py emitted the extended metrics
+    for key, label in EXTRA_ROWS:
+        if key in bench:
+            r = bench[key]
+            rows.append("| %s | %s | %s | %s |" % (
+                label,
+                ("%.3g %s" % (r["cpu"], r.get("unit", ""))
+                 if r.get("cpu") else "—"),
+                "%.3g %s" % (r["value"], r.get("unit", "")),
+                ("**%.1f×**" % r["vs_baseline"]
+                 if r.get("vs_baseline") else "—")))
+    tail = (
+        "\n(last update %s; TPU numbers vary ±20-30%% run-to-run "
         "through\nthe tunneled link — bench.py reports best-of-5; "
         "the CPU baseline's\ndata is in RAM, so device-resident is "
         "the like-for-like row)"
-        % (cpu["accel_cells_per_sec"], cells, bench["vs_baseline"],
-           cpu["accel_cells_per_sec"], incl, incl_r,
-           cpu["dedisp_dm_trials_per_sec"], dm,
-           bench["dm_trials_vs_baseline"],
-           datetime.date.today().isoformat()))
+        % (date or datetime.date.today().isoformat()))
+    return "\n".join(rows) + "\n" + tail
 
-    path = os.path.join(REPO, "BASELINE.md")
-    src = open(path).read()
+
+# extended bench rows (VERDICT r3 item 4): bench.py emits these as
+# nested dicts {"value":, "unit":, "cpu":, "vs_baseline":} when run
+# with PRESTO_TPU_BENCH_EXTENDED=1
+EXTRA_ROWS = (
+    ("config3", "realfft + accelsearch zmax=0 nh=16 2²¹ bins "
+                "(config 3, survey workhorse), device-resident"),
+    ("singlepulse", "single-pulse search 128 DM × 2²⁰ (config 5 SP "
+                    "stage), device-resident"),
+    ("jerk", "jerk search zmax=100 wmax=300 2²⁰ bins (diagnostic), "
+             "device-resident"),
+)
+
+
+def render_warmup(bench):
+    warm = bench.get("warmup_s")
+    if warm is None:
+        return None
+    return ("Cold-start: with the XLA persistent compilation "
+            "cache\n(`presto_tpu/__init__.py`, the FFTW-wisdom "
+            "analog) the accelsearch\nwarmup (compile or cache "
+            "load, cache-load varies with the tunneled\nlink) "
+            "last measured **%.1f s**; steady-state timings "
+            "exclude it." % warm)
+
+
+def apply_blocks(src, table, wtext):
+    """Replace the marker blocks in BASELINE.md text; raises on
+    missing markers."""
     pat = r"(BENCH_TABLE_START.*?-->\n).*?(\n<!-- BENCH_TABLE_END)"
     if not re.search(pat, src, flags=re.S):
-        print("update_baseline: BENCH_TABLE markers not found",
-              file=sys.stderr)
-        return 1
+        raise ValueError("BENCH_TABLE markers not found")
     new = re.sub(pat, lambda m: m.group(1) + table + m.group(2), src,
                  flags=re.S)
-    warm = bench.get("warmup_s")
-    if warm is not None:
-        # the warmup claim regenerates from the same driver-captured
-        # JSON as the table (round-1/2 both drifted here)
-        wtext = ("Cold-start: with the XLA persistent compilation "
-                 "cache\n(`presto_tpu/__init__.py`, the FFTW-wisdom "
-                 "analog) the accelsearch\nwarmup (compile or cache "
-                 "load, cache-load varies with the tunneled\nlink) "
-                 "last measured **%.1f s**; steady-state timings "
-                 "exclude it." % warm)
+    if wtext is not None:
         wpat = r"(WARMUP_START[^\n]*-->\n).*?(\n<!-- WARMUP_END)"
         new = re.sub(wpat, lambda m: m.group(1) + wtext + m.group(2),
                      new, flags=re.S)
+    return new
+
+
+def strip_date(text):
+    """Normalize the last-update date so equality checks ignore it."""
+    return re.sub(r"\(last update \d{4}-\d{2}-\d{2};",
+                  DATE_TOKEN % "X", text)
+
+
+def main():
+    if len(sys.argv) > 1 and sys.argv[1] == "--from-artifact":
+        path, bench = newest_bench_artifact()
+        if bench is None:
+            print("update_baseline: no BENCH_r*.json found",
+                  file=sys.stderr)
+            return 1
+        print("update_baseline: using %s" % os.path.basename(path))
+    else:
+        text = sys.argv[1] if len(sys.argv) > 1 else sys.stdin.read()
+        line = next(ln for ln in text.splitlines()
+                    if ln.strip().startswith("{"))
+        bench = json.loads(line)
+    with open(os.path.join(REPO, "cpu_baseline.json")) as f:
+        cpu = json.load(f)
+    try:
+        table = render_table(bench, cpu)
+    except ValueError as e:
+        print("update_baseline: %s" % e, file=sys.stderr)
+        return 1
+    path = os.path.join(REPO, "BASELINE.md")
+    src = open(path).read()
+    try:
+        new = apply_blocks(src, table, render_warmup(bench))
+    except ValueError as e:
+        print("update_baseline: %s" % e, file=sys.stderr)
+        return 1
     if new == src:
         print("update_baseline: table already up to date")
         return 0
