@@ -161,11 +161,28 @@ def refine_and_write(raw_cands, amps, T, searcher, base, zmax,
     if harmremove:
         raw_cands = eliminate_harmonics(raw_cands)
     cands = remove_duplicates(raw_cands)
-    refined = []
-    for c in cands:
+    # batched polish (search/polish.py) for the whole list in a few
+    # device dispatches; per-candidate scipy only as exception/jerk
+    # fallback (PRESTO_TPU_POLISH=scipy forces the reference loop)
+    ocs = [None] * len(cands)
+    if os.environ.get("PRESTO_TPU_POLISH", "batch") != "scipy" \
+            and cands:
         try:
-            oc = optimize_accelcand(amps, c, T, searcher.numindep,
-                                    harmpolish=harmpolish)
+            from presto_tpu.search.polish import optimize_accelcands
+            ocs = optimize_accelcands(amps, cands, T,
+                                      searcher.numindep,
+                                      harmpolish=harmpolish,
+                                      with_props=False)
+        except Exception as e:
+            print("accelsearch: batched polish failed (%s); "
+                  "using the per-candidate path" % (e,))
+            ocs = [None] * len(cands)
+    refined = []
+    for c, oc in zip(cands, ocs):
+        try:
+            if oc is None:
+                oc = optimize_accelcand(amps, c, T, searcher.numindep,
+                                        harmpolish=harmpolish)
             c.r, c.z = oc.r, oc.z
             c.power, c.sigma = oc.power, oc.sigma
             if wmax:

@@ -1,0 +1,386 @@
+"""Batched Fourier-domain candidate refinement (the device polish).
+
+The reference refines accelsearch candidates ONE AT A TIME on the host
+(optimize_accelcand accel_utils.c:465-525 -> amoeba simplex
+maximize_rz.c:22-140), every power evaluation building a fresh Fresnel
+z-response kernel (rzinterp.c:144).  At survey sigma cutoffs that
+serial loop dominates the whole low-zmax pass (the production
+workhorse config): thousands of candidates x ~150 simplex evaluations
+x a kernel build each.
+
+TPU-first redesign — no Fresnel integrals, no per-candidate loop:
+
+The z-response kernel is exactly the continuous matched filter
+
+    R(d; z) = integral_0^1 exp(2 pi i (-d u + z (u^2 - u)/2)) du
+
+(validated against ops/responses.gen_z_response to quadrature
+accuracy; the (u^2-u)/2 form is the mid-observation-centered chirp of
+responses.c:257's startr = roffset - z/2).  Therefore the interpolated
+amplitude a candidate polish maximizes,
+
+    A(r, z) = sum_m X[m] conj(R(m - r; z)),
+
+is identically the time-domain dot product
+
+    A(r, z) = integral_0^1 w(u) exp(-2 pi i (fr u + z (u^2-u)/2)) du,
+    w(u)    = sum_|d|<W/2 X[rint + d] e^{2 pi i d u},   fr = r - rint.
+
+w(u) — the band-limited chunk of the original time series carrying
+the candidate — is computed ONCE per (candidate, harmonic) pair for
+the whole batch (one complex matmul, MXU), after which every
+refinement evaluation is an elementwise chirp multiply + mean over
+npts quadrature points: fully batched over candidates, harmonics, and
+trial (r, z) grids.
+
+The optimizer itself is a fixed-shape coarse-to-fine grid descent
+(jit-friendly: no data-dependent control flow): a (2G+1)^2 grid of
+(r, z) steps scaled 1/numharm per candidate, re-centered on the joint
+harmonic-sum argmax and shrunk 3x per stage.  Candidates whose coarse
+stage pins to the grid boundary even after the re-center walk are
+flagged; with PRESTO_TPU_POLISH_FALLBACK=1 (and a host complex
+spectrum) they are re-polished one by one with the scipy simplex.
+The fallback is OFF by default: boundary-pinned seeds are nearly
+always noise candidates whose wander the reference's simplex shares,
+and at survey scale the per-candidate referee costs more than the
+whole batched polish.
+
+Numerical note: A evaluated this way uses ALL W window taps for every
+z, where the reference truncates the kernel at 2*hw(z) taps.  On a
+candidate peak the difference is far inside the Fourier error bars
+(tests pin |dr| <~ 0.01 bins vs the scipy path); it is a deliberate
+accuracy upgrade, not drift.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import List, Optional, Sequence
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from presto_tpu.ops import responses as resp
+from presto_tpu.ops import stats as st
+from presto_tpu.search.optimize import (FourierProps, OptimizedCand,
+                                        RDerivs, calc_props,
+                                        optimize_accelcand)
+
+GRID_G = 3              # grid half-extent: (2G+1)^2 = 49 points/stage
+N_STAGES = 5            # stage s step = step0 / 3^s
+SHRINK = 3.0
+# stage-0 steps in FUNDAMENTAL bins (scaled 1/numharm per candidate):
+# the search grid quantizes r to 0.5/nh and z to 2/nh, so the true
+# peak lies within (0.25, 1.0)/nh of the seed; G*step0 must cover it
+STEP0_R = 0.12
+STEP0_Z = 0.5
+PAIR_CHUNK = 512        # pairs per lax.map slice of the grid evals
+
+
+def _round_up(n: int, m: int) -> int:
+    return -(-n // m) * m
+
+
+# ----------------------------------------------------------------------
+# Device kernels
+# ----------------------------------------------------------------------
+
+
+@partial(jax.jit, static_argnames=("W", "npts"))
+def _windows_to_wmat(amp_pairs, rints, W, npts):
+    """Gather each pair's W-tap spectral window and inverse-transform
+    it to w(u) on the npts-point midpoint grid: ONE complex matmul
+    for the whole batch.  Out-of-spectrum taps read zero (the same
+    zero-fill as optimize.rz_interp's seg)."""
+    n = amp_pairs.shape[0]
+    dl = jnp.arange(W, dtype=jnp.int32) - W // 2
+    idx = rints[:, None] + dl[None]
+    ok = (idx >= 0) & (idx < n)
+    seg = amp_pairs[jnp.clip(idx, 0, n - 1)]        # [P, W, 2]
+    segc = jnp.where(ok, seg[..., 0] + 1j * seg[..., 1], 0.0)
+    u = (jnp.arange(npts, dtype=jnp.float32) + 0.5) / npts
+    F = jnp.exp(2j * jnp.pi * jnp.outer(dl.astype(jnp.float32), u))
+    return jnp.matmul(segc, F,
+                      precision=jax.lax.Precision.HIGHEST)  # [P, npts]
+
+
+def _eval_A(wmat, fr, zh):
+    """A at (fr, z) per pair and grid point: wmat [P, npts] complex,
+    fr/zh [P, G] -> [P, G] complex64 (chirp multiply + mean)."""
+    npts = wmat.shape[-1]
+    u = (jnp.arange(npts, dtype=jnp.float32) + 0.5) / npts
+    cu = 0.5 * (u * u - u)
+    ph = jnp.exp(-2j * jnp.pi * (fr[..., None] * u
+                                 + zh[..., None] * cu))
+    return jnp.mean(wmat[:, None, :] * ph, axis=-1)
+
+
+def _eval_A_chunked(wmat, fr, zh):
+    """_eval_A with the pair axis chunked through lax.map (bounds the
+    [P, G, npts] phase intermediate)."""
+    P = wmat.shape[0]
+    if P <= PAIR_CHUNK:
+        return _eval_A(wmat, fr, zh)
+    pad = _round_up(P, PAIR_CHUNK) - P
+    wm = jnp.pad(wmat, ((0, pad), (0, 0)))
+    frp = jnp.pad(fr, ((0, pad), (0, 0)))
+    zhp = jnp.pad(zh, ((0, pad), (0, 0)))
+    nch = (P + pad) // PAIR_CHUNK
+    out = jax.lax.map(
+        lambda args: _eval_A(*args),
+        (wm.reshape(nch, PAIR_CHUNK, -1),
+         frp.reshape(nch, PAIR_CHUNK, -1),
+         zhp.reshape(nch, PAIR_CHUNK, -1)))
+    return out.reshape(nch * PAIR_CHUNK, -1)[:P]
+
+
+@partial(jax.jit, static_argnames=("ncand",))
+def _refine_stages(wmat, cand_of, hh, frac0, zseed, inv_lp,
+                   obj_w, step0_r, step0_z, ncand):
+    """The coarse-to-fine joint-harmonic grid descent, entirely in
+    OFFSET space: the device never sees an absolute r (float32 spacing
+    at survey-scale r*h ~ 1e8 is several BINS — all absolute
+    reconstruction happens on host in float64).
+
+    wmat [P, npts]; cand_of [P] pair->candidate; hh [P] harmonic
+    number; frac0 [P] = seed_r*h - rint (float64 residual, cast f32);
+    zseed [ncand]; inv_lp [P] 1/locpow objective weights; obj_w [P]
+    0/1 mask (harmpolish=False keeps only the fundamental in the
+    objective); step0_* [ncand].
+
+    Returns (dr, dz) [ncand] fundamental offsets from the seed and a
+    boundary flag [ncand] (stage-0 argmax pinned to the grid edge
+    after the re-center walk).
+    """
+    G = GRID_G
+    g1 = jnp.arange(-G, G + 1, dtype=jnp.float32)
+    gi = jnp.repeat(g1, 2 * G + 1)        # r offsets
+    gj = jnp.tile(g1, 2 * G + 1)          # z offsets
+
+    def stage_argmax(dr, dz, sr, sz):
+        # trial offset grids per candidate -> per pair fr/z
+        rs = dr[:, None] + sr[:, None] * gi[None]   # [ncand, ngrid2]
+        zs = dz[:, None] + sz[:, None] * gj[None]
+        frp = frac0[:, None] + rs[cand_of] * hh[:, None]
+        zhp = (zseed[cand_of][:, None] + zs[cand_of]) * hh[:, None]
+        A = _eval_A_chunked(wmat, frp, zhp)
+        P2 = (A.real ** 2 + A.imag ** 2) * (inv_lp * obj_w)[:, None]
+        obj = jax.ops.segment_sum(P2, cand_of, num_segments=ncand)
+        best = jnp.argmax(obj, axis=-1)
+        return (rs[jnp.arange(ncand), best],
+                zs[jnp.arange(ncand), best], best)
+
+    dr = jnp.zeros(ncand, jnp.float32)
+    dz = jnp.zeros(ncand, jnp.float32)
+    # stage-0 walk: re-center twice at the coarse step so a seed near
+    # the cell edge still captures its peak
+    edge = jnp.zeros(ncand, dtype=bool)
+    for _ in range(2):
+        dr, dz, best = stage_argmax(dr, dz, step0_r, step0_z)
+        bi, bj = best // (2 * G + 1), best % (2 * G + 1)
+        edge = (bi == 0) | (bi == 2 * G) | (bj == 0) | (bj == 2 * G)
+    for s in range(1, N_STAGES):
+        sr = step0_r / (SHRINK ** s)
+        sz = step0_z / (SHRINK ** s)
+        dr, dz, _ = stage_argmax(dr, dz, sr, sz)
+    return dr, dz, edge
+
+
+@jax.jit
+def _final_measures(wmat, fr, zh):
+    """Per-pair measurements at the refined peak, one dispatch:
+    columns = [raw amp, d/dr stencil lo/hi, locpow offsets].
+    Returns (A [P, 3] complex for (mid, lo, hi), locpow [P])."""
+    H = resp.NUMLOCPOWAVG // 2
+    offs = np.concatenate([[0.0, -0.05, 0.05],
+                           -(resp.DELTAAVGBINS + np.arange(H)),
+                           (resp.DELTAAVGBINS + np.arange(H))]
+                          ).astype(np.float32)
+    frg = fr[:, None] + jnp.asarray(offs)[None]
+    zhg = jnp.broadcast_to(zh[:, None], frg.shape)
+    A = _eval_A_chunked(wmat, frg, zhg)
+    pows = A.real ** 2 + A.imag ** 2
+    locpow = jnp.maximum(jnp.mean(pows[:, 3:], axis=-1), 1e-30)
+    # pairs at the boundary: complex cannot cross host<->device here
+    return jnp.stack([A[:, :3].real, A[:, :3].imag], -1), locpow
+
+
+# ----------------------------------------------------------------------
+# Host driver
+# ----------------------------------------------------------------------
+
+
+def _geometry(zmax_pairs: float):
+    """(W, npts) for a batch whose largest per-harmonic |z| (including
+    grid drift) is zmax_pairs: the window spans the widest kernel plus
+    the locpow offsets, quadrature resolves W/2 + z/2 + 1 cycles."""
+    hw = resp.z_resp_halfwidth(float(zmax_pairs), resp.HIGHACC)
+    W = _round_up(2 * hw + 2 * (resp.DELTAAVGBINS
+                                + resp.NUMLOCPOWAVG // 2) + 16, 128)
+    need = W // 2 + zmax_pairs / 2 + 2
+    npts = 128
+    while npts < 2 * need:
+        npts *= 2
+    return W, int(npts)
+
+
+def optimize_accelcands(amps: np.ndarray, cands, T: float,
+                        numindep: Sequence[float],
+                        harmpolish: bool = True,
+                        with_props: bool = True
+                        ) -> List[OptimizedCand]:
+    """Batched twin of optimize_accelcand over a candidate list.
+
+    amps: complex spectrum (numpy, any float/complex dtype) or a
+    device [n, 2] float32 pairs array (the survey's resident spectra).
+    Returns OptimizedCand per input candidate, in input order; scipy
+    fallback per candidate where the grid descent flags a boundary.
+    """
+    if not cands:
+        return []
+    amps_host = None        # complex host spectrum (scipy fallback)
+    if isinstance(amps, jax.Array):
+        amp_pairs = amps
+    else:
+        amps = np.asarray(amps)
+        if amps.dtype.kind == "c":
+            amp_pairs = np.stack([amps.real, amps.imag],
+                                 -1).astype(np.float32)
+            amps_host = amps
+        else:
+            amp_pairs = np.asarray(amps, np.float32)
+        amp_pairs = jnp.asarray(amp_pairs)
+
+    nc = len(cands)
+    nh = np.asarray([c.numharm for c in cands], np.int32)
+    seed_r = np.asarray([c.r for c in cands], np.float64)
+    seed_z = np.asarray([c.z for c in cands], np.float64)
+
+    # pair expansion (candidate, harmonic)
+    cand_of = np.repeat(np.arange(nc, dtype=np.int32), nh)
+    hh = np.concatenate([np.arange(1, n + 1) for n in nh]
+                        ).astype(np.float32)
+    rint = np.floor(seed_r[cand_of] * hh).astype(np.int32)
+    P = cand_of.shape[0]
+
+    step0_r = (STEP0_R / nh).astype(np.float32)
+    step0_z = (STEP0_Z / nh).astype(np.float32)
+    zmax_b = float(np.abs(seed_z[cand_of] * hh).max()
+                   + STEP0_Z * GRID_G + 1.0)
+    W, npts = _geometry(zmax_b)
+
+    # pad pairs/cands to bucket shapes (bounded recompile count)
+    Pp = max(64, 1 << int(np.ceil(np.log2(P))))
+    ncp = max(32, 1 << int(np.ceil(np.log2(nc))))
+    pad_p, pad_c = Pp - P, ncp - nc
+
+    def padp(a, fill=0):
+        return np.concatenate([a, np.full((pad_p,) + a.shape[1:], fill,
+                                          a.dtype)]) if pad_p else a
+
+    def padc(a, fill=0):
+        return np.concatenate([a, np.full((pad_c,) + a.shape[1:], fill,
+                                          a.dtype)]) if pad_c else a
+
+    cand_ofp = padp(cand_of, nc)          # dummy pairs -> pad segment
+    cand_ofp = np.where(cand_ofp >= ncp, ncp - 1, cand_ofp)
+    hhp, rintp = padp(hh, 1.0), padp(rint, 0)
+    # float64 residual of the absolute frequency: everything the
+    # device sees is seed-relative (float32 cannot hold survey-scale
+    # absolute r*h to bin precision)
+    frac0 = (seed_r[cand_of] * hh.astype(np.float64)
+             - rint).astype(np.float32)
+    frac0p = padp(frac0, 0.5)
+    seed_zp = padc(seed_z.astype(np.float32), 0.0)
+    s0rp, s0zp = padc(step0_r, STEP0_R), padc(step0_z, STEP0_Z)
+
+    wmat = _windows_to_wmat(amp_pairs, jnp.asarray(rintp), W, npts)
+
+    # seed local powers -> objective weights (fixed during descent,
+    # like the scipy path's pre-refinement locpows)
+    fr0 = jnp.asarray(frac0p)
+    zh0 = jnp.asarray(seed_zp[cand_ofp] * hhp)
+    _, lp0 = _final_measures(wmat, fr0, zh0)
+    obj_w = padp(np.ones(P, np.float32)) if harmpolish else \
+        padp((hh == 1.0).astype(np.float32))
+
+    drc, dzc, edge = _refine_stages(
+        wmat, jnp.asarray(cand_ofp), jnp.asarray(hhp),
+        jnp.asarray(frac0p), jnp.asarray(seed_zp),
+        1.0 / lp0, jnp.asarray(obj_w), jnp.asarray(s0rp),
+        jnp.asarray(s0zp), ncp)
+
+    drp = np.asarray(drc, np.float64)
+    dzp = np.asarray(dzc, np.float64)
+    rr = seed_r + drp[:nc]                # float64 reconstruction
+    zz = seed_z + dzp[:nc]
+    edge = np.asarray(edge)[:nc]
+
+    # final measurements at the refined peak (padded shapes; the
+    # fractional part is computed in float64 then cast)
+    rrp = np.concatenate([rr, np.full(pad_c, 8.0)]) if pad_c else rr
+    zzp = np.concatenate([zz, np.zeros(pad_c)]) if pad_c else zz
+    frf = jnp.asarray((rrp[cand_ofp] * hhp.astype(np.float64)
+                       - rintp).astype(np.float32))
+    zhf = jnp.asarray((zzp[cand_ofp] * hhp).astype(np.float32))
+    A3p, lpf = _final_measures(wmat, frf, zhf)
+    A3p = np.asarray(A3p)[:P]
+    A3 = A3p[..., 0].astype(np.complex128) + 1j * A3p[..., 1]
+    lpf = np.asarray(lpf, np.float64)[:P]
+    rawp = (A3[:, 0].real ** 2 + A3[:, 0].imag ** 2).astype(np.float64)
+    hpow = rawp / lpf
+
+    out: List[Optional[OptimizedCand]] = [None] * nc
+    tot = np.zeros(nc)
+    np.add.at(tot, cand_of, hpow)
+    stages = np.log2(nh).astype(int)
+    sig = np.empty(nc, np.float64)
+    for s_ in np.unique(stages):      # one vectorized call per stage
+        m = stages == s_
+        sig[m] = np.atleast_1d(st.candidate_sigma(
+            tot[m], 1 << int(s_), numindep[int(s_)]))
+
+    # Edge-pinned candidates (stage-0 argmax on the grid boundary even
+    # after the re-center walk) are almost always NOISE seeds whose
+    # local max sits outside the quantization error bounds — the
+    # reference's simplex wanders on those too, and they die in
+    # sifting.  The scipy referee per edge candidate is therefore
+    # opt-in (PRESTO_TPU_POLISH_FALLBACK=1): at survey scale it costs
+    # ~70 ms x thousands of noise candidates for no list change.
+    import os as _os
+    use_fb = (_os.environ.get("PRESTO_TPU_POLISH_FALLBACK", "0") == "1"
+              and amps_host is not None)
+
+    pair_lo = np.concatenate([[0], np.cumsum(nh)])
+    for i in range(nc):
+        if use_fb and edge[i]:
+            out[i] = optimize_accelcand(amps_host, cands[i], T,
+                                        numindep,
+                                        harmpolish=harmpolish)
+            continue
+        sl = slice(pair_lo[i], pair_lo[i + 1])
+        props: List[FourierProps] = []
+        if with_props:
+            for j in range(pair_lo[i], pair_lo[i + 1]):
+                h = hh[j]
+                pw = lambda a: (a.real ** 2 + a.imag ** 2) / lpf[j]
+                amid, alo, ahi = A3[j]
+                pm, pl, ph_ = pw(amid), pw(alo), pw(ahi)
+                phm = float(np.angle(amid))
+                phl = phm + float(np.angle(alo * np.conj(amid)))
+                phh = phm + float(np.angle(ahi * np.conj(amid)))
+                hstep = 0.05
+                d = RDerivs(
+                    pow=pm, phs=phm,
+                    dpow=(ph_ - pl) / (2 * hstep),
+                    dphs=(phh - phl) / (2 * hstep),
+                    d2pow=(ph_ - 2 * pm + pl) / hstep ** 2,
+                    d2phs=(phh - 2 * phm + phl) / hstep ** 2,
+                    locpow=lpf[j])
+                props.append(calc_props(d, rr[i] * h, zz[i] * h))
+        out[i] = OptimizedCand(
+            r=float(rr[i]), z=float(zz[i]), power=float(tot[i]),
+            sigma=float(sig[i]), numharm=int(nh[i]),
+            hpows=list(hpow[sl]), props=props)
+    return out
