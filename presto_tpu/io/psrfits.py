@@ -34,6 +34,41 @@ from presto_tpu.io.sigproc import FilterbankHeader
 SECPERDAY = 86400.0
 
 
+def _ra_str_to_sigproc(s) -> float:
+    """RA string ('hh:mm:ss.s', 'hh mm ss.s', or numeric hours) ->
+    SIGPROC packed hhmmss.s — via the shared coordinate parser
+    (astro/bary.parse_ra) instead of a third hand-rolled split."""
+    from presto_tpu.astro.bary import parse_ra
+    from presto_tpu.utils.psr import rad_to_hms
+    try:
+        if isinstance(s, str) and ":" not in s and " " not in s.strip():
+            # bare number in a string: hours
+            rad = float(s) * np.pi / 12.0
+        else:
+            rad = parse_ra(s)
+    except (ValueError, IndexError, TypeError):
+        return 0.0
+    h, m, sec = rad_to_hms(rad)
+    return h * 10000.0 + m * 100.0 + sec
+
+
+def _dec_str_to_sigproc(s) -> float:
+    """DEC string ('[+-]dd:mm:ss.s', spaces, or numeric degrees) ->
+    SIGPROC packed [+-]ddmmss.s."""
+    from presto_tpu.astro.bary import parse_dec
+    from presto_tpu.utils.psr import rad_to_dms
+    try:
+        if isinstance(s, str) and ":" not in s and " " not in s.strip():
+            rad = float(s) * np.pi / 180.0
+        else:
+            rad = parse_dec(s)
+    except (ValueError, IndexError, TypeError):
+        return 0.0
+    d, m, sec = rad_to_dms(rad)
+    sign = -1.0 if d < 0 or (d == 0 and rad < 0) else 1.0
+    return sign * (abs(d) * 10000.0 + m * 100.0 + sec)
+
+
 def unpack_samples(raw: np.ndarray, nbits: int) -> np.ndarray:
     """Packed big-endian-bit samples -> uint8/uint16/etc array.
     Vectorized analog of the unpack loops (psrfits.c:828-866)."""
@@ -200,22 +235,13 @@ class PsrfitsFile:
         # read_spectra always presents ascending frequency, so the
         # header describes the band with fch1 = lowest center, foff > 0
         # (same convention FilterbankFile ends up with post-flip).
-        def _colons_to_sigproc(s: str) -> float:
-            # "hh:mm:ss.s" -> hhmmss.s (SIGPROC packed coordinate)
-            try:
-                parts = [p for p in s.split(":") if p != ""]
-                sign = -1.0 if parts and parts[0].startswith("-") else 1.0
-                vals = [abs(float(p)) for p in parts] + [0.0, 0.0]
-                return sign * (vals[0] * 10000 + vals[1] * 100 + vals[2])
-            except (ValueError, IndexError):
-                return 0.0
         return FilterbankHeader(
             source_name=self.source or "Unknown",
             nchans=self.nchan, nbits=self.nbits,
             fch1=float(self.freqs.min()), foff=abs(self.df),
             tsamp=self.dt, tstart=float(self.start_mjd),
-            src_raj=_colons_to_sigproc(getattr(self, "ra_str", "")),
-            src_dej=_colons_to_sigproc(getattr(self, "dec_str", "")),
+            src_raj=_ra_str_to_sigproc(getattr(self, "ra_str", "")),
+            src_dej=_dec_str_to_sigproc(getattr(self, "dec_str", "")),
             nifs=1, N=int(self.N))
 
     @property

@@ -157,7 +157,18 @@ def split_drift_scan(rawfiles: Sequence[str], outdir: str = ".",
             p.path = path
             written.append(path)
             if os.path.exists(path):
-                continue
+                # reuse only when the existing cut matches THIS plan's
+                # geometry (a rerun with different orig_N/overlap
+                # collides on the name but must not keep stale cuts)
+                from presto_tpu.io.sigproc import FilterbankFile
+                try:
+                    with FilterbankFile(path) as old:
+                        reuse = int(old.nspectra) == p.nsamp
+                except Exception:
+                    reuse = False     # unreadable: rewrite it
+                if reuse:
+                    continue
+                os.remove(path)
             out_hdr = FilterbankHeader(
                 source_name="%s_%s" % (prefix, tag),
                 machine_id=getattr(hdr, "machine_id", 10),
@@ -179,8 +190,14 @@ def split_drift_scan(rawfiles: Sequence[str], outdir: str = ".",
                     block = fb.read_spectra(s0, cnt)
                     if out_hdr.foff < 0:
                         block = block[:, ::-1]
-                    arr = np.clip(np.rint(block), 0,
-                                  (1 << out_hdr.nbits) - 1)
+                    if out_hdr.nbits == 32:
+                        # 32-bit SIGPROC is float32: write samples
+                        # verbatim (rounding/clipping would zero every
+                        # negative sample of bandpass-subtracted data)
+                        arr = block
+                    else:
+                        arr = np.clip(np.rint(block), 0,
+                                      (1 << out_hdr.nbits) - 1)
                     f.write(pack_bits(
                         np.ascontiguousarray(arr).ravel(),
                         out_hdr.nbits).tobytes())

@@ -139,3 +139,53 @@ def test_gbt350drift_recipe_from_raw_scan(tmp_path):
     # the injected pulsar is recovered in at least one pointing
     folded = glob.glob(os.path.join(d, "*", "fold_cand*.pfd"))
     assert folded, "no pointing folded any candidate"
+
+
+def test_split_preserves_float32_data(tmp_path):
+    """32-bit SIGPROC is float32: zero-mean (negative) samples must
+    round-trip VERBATIM, not be rounded/clipped at zero."""
+    d = str(tmp_path)
+    scan = os.path.join(d, "scan32.fil")
+    N, nchan = 4000, 8
+    # write signed float32 data directly (bandpass-subtracted style)
+    from presto_tpu.io.sigproc import (FilterbankFile,
+                                       FilterbankHeader,
+                                       write_filterbank_header)
+    rng = np.random.default_rng(3)
+    full = rng.normal(size=(N, nchan)).astype(np.float32)
+    hdr = FilterbankHeader(source_name="t32", nchans=nchan, nbits=32,
+                           fch1=357.0, foff=-1.0, tsamp=1e-3,
+                           tstart=55000.0, nifs=1, N=N)
+    with open(scan, "wb") as f:
+        write_filterbank_header(hdr, f)
+        f.write(full[:, ::-1].tobytes())   # descending band on disk
+    with FilterbankFile(scan) as fb:
+        full = fb.read_spectra(0, N)
+    assert (full < 0).any()          # the test premise: signed floats
+    out = split_drift_scan([scan], outdir=d, orig_N=2000,
+                           overlap_factor=0.5, prefix="t32")
+    for i, f in enumerate(out):
+        with FilterbankFile(f) as fb:
+            got = fb.read_spectra(0, fb.nspectra)
+        np.testing.assert_array_equal(
+            got, full[i * 1000:i * 1000 + 2000])
+
+
+def test_split_rerun_with_new_geometry_rewrites(tmp_path):
+    """A rerun with a different orig_N must NOT reuse stale same-name
+    cuts from the old geometry."""
+    d = str(tmp_path)
+    scan = os.path.join(d, "scan.fil")
+    fake_filterbank_file(scan, N=6000, dt=1e-3, nchan=8,
+                         lofreq=350.0, chanwidth=1.0,
+                         signal=FakeSignal(f=5.0, dm=10.0, amp=0.5),
+                         noise_sigma=5.0, nbits=8, seed=5)
+    out1 = split_drift_scan([scan], outdir=d, orig_N=2000,
+                            overlap_factor=0.5, prefix="tg")
+    out2 = split_drift_scan([scan], outdir=d, orig_N=1000,
+                            overlap_factor=0.5, prefix="tg")
+    from presto_tpu.io.sigproc import FilterbankFile
+    for f in out2:
+        with FilterbankFile(f) as fb:
+            assert fb.nspectra == 1000
+    assert set(out1) & set(out2)     # the collision the fix guards

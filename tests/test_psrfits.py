@@ -166,3 +166,19 @@ def test_psrfits_through_prepdata_pipeline(tmp_path, monkeypatch):
     ts = np.fromfile("out.dat", np.float32)
     peak = int(np.argmax(ts))
     assert abs(peak - int(t0 / dt)) <= 2
+
+
+def test_header_coordinate_forms():
+    """RA/DEC strings in colon, space-separated, and numeric forms all
+    parse to SIGPROC packed coordinates (via the shared astro/bary
+    parser — no silent 0.0 for space-separated headers)."""
+    from presto_tpu.io.psrfits import (_ra_str_to_sigproc,
+                                       _dec_str_to_sigproc)
+    assert abs(_ra_str_to_sigproc("05:34:21.0") - 53421.0) < 1e-6
+    assert abs(_ra_str_to_sigproc("05 34 21.0") - 53421.0) < 1e-6
+    assert abs(_ra_str_to_sigproc("5.5725") - 53421.0) < 0.1
+    assert abs(_dec_str_to_sigproc("+22:00:52.2") - 220052.2) < 1e-6
+    assert abs(_dec_str_to_sigproc("-05 21 10") - -52110.0) < 1e-6
+    assert abs(_dec_str_to_sigproc("-0:30:00") - -3000.0) < 1e-6
+    assert _ra_str_to_sigproc("") == 0.0
+    assert _dec_str_to_sigproc(None) == 0.0
