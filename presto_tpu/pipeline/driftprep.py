@@ -163,12 +163,19 @@ def split_drift_scan(rawfiles: Sequence[str], outdir: str = ".",
                 from presto_tpu.io.sigproc import FilterbankFile
                 try:
                     with FilterbankFile(path) as old:
-                        reuse = int(old.nspectra) == p.nsamp
+                        # same sample count AND same start time: a
+                        # rerun with a different overlap_factor keeps
+                        # nsamp but shifts start_sample — names can
+                        # still collide at tag resolution
+                        reuse = (int(old.nspectra) == p.nsamp
+                                 and abs(old.header.tstart - p.tstart)
+                                 < 0.5 * hdr.tsamp / 86400.0)
                 except Exception:
                     reuse = False     # unreadable: rewrite it
                 if reuse:
                     continue
-                os.remove(path)
+                # no unlink: .part + os.replace overwrites atomically,
+                # so a crash mid-rewrite leaves the old artifact
             out_hdr = FilterbankHeader(
                 source_name="%s_%s" % (prefix, tag),
                 machine_id=getattr(hdr, "machine_id", 10),

@@ -33,7 +33,7 @@ boundaries — see ops/fftpack note on the TPU complex-transfer limit).
 from __future__ import annotations
 
 import os
-from dataclasses import dataclass
+from dataclasses import dataclass, replace
 from typing import List, Optional
 
 import numpy as np
@@ -477,7 +477,8 @@ SEARCH_SEG = 16     # columns per segment-max before top-k: 16 columns
 
 def _make_search_scanner(numharmstages, fracs_zinds, powcuts, slab, k,
                          plane_numr, aligned=False,
-                         pallas_reducer=None, numz=None):
+                         pallas_reducer=None, numz=None,
+                         plane_padded=False):
     """One jit'd function running the whole staged search as a lax.scan
     over slab start columns (a single device dispatch — the tunneled
     TPU pays ~0.1-0.4 s latency per call, so per-slab calls dominate
@@ -504,6 +505,16 @@ def _make_search_scanner(numharmstages, fracs_zinds, powcuts, slab, k,
     nseg = -(-slab // SEARCH_SEG)
     segpad = nseg * SEARCH_SEG - slab
     kk = min(k, nseg)
+
+    def _zi_for(zinds, nrows):
+        """zinds extended to a pad_rows plane (the direct-plane pallas
+        builder hands the scanner ceil(numz/8)*8 rows; pad rows are
+        zero-kernel rows, mapped to themselves so they stay zero in
+        every harmonic accumulator and can never beat powcut)."""
+        if nrows == zinds.shape[0]:
+            return zinds
+        return jnp.concatenate([
+            zinds, jnp.arange(zinds.shape[0], nrows, dtype=jnp.int32)])
 
     def slab_body(planes, start_col):
         """planes: [1 + n_harm_terms] source planes — planes[0] is the
@@ -547,7 +558,8 @@ def _make_search_scanner(numharmstages, fracs_zinds, powcuts, slab, k,
                     cstart = (start_col // htot) * harm
                     src = jax.lax.dynamic_slice(
                         planes[fi], (0, cstart), (P.shape[0], slab))
-                    sub = jnp.take(src, zinds, axis=0)
+                    sub = jnp.take(src, _zi_for(zinds, P.shape[0]),
+                                   axis=0)
                     src3 = sub[:, :(nq + 1) * harm].reshape(
                         -1, nq + 1, harm)
                     pieces = []
@@ -574,7 +586,8 @@ def _make_search_scanner(numharmstages, fracs_zinds, powcuts, slab, k,
                         plane_numr - slab)
                     src = jax.lax.dynamic_slice(planes[fi], (0, cstart),
                                                 (P.shape[0], slab))
-                    sub = jnp.take(src, zinds, axis=0)
+                    sub = jnp.take(src, _zi_for(zinds, P.shape[0]),
+                                   axis=0)
                     acc = acc + jnp.take(sub, rind - cstart, axis=1)
             outs.append(collect(acc, stage))
         vals = jnp.stack([o[0] for o in outs])      # [stages, k]
@@ -610,10 +623,15 @@ def _make_search_scanner(numharmstages, fracs_zinds, powcuts, slab, k,
 
     def _scan_pallas_py(P, start_cols):
         """Pallas stage-reduction path: pad the plane to the kernel's
-        tiling contract, reduce on-kernel, finish in XLA."""
+        tiling contract, reduce on-kernel, finish in XLA.  A plane
+        from the direct-plane builder (plane_padded) already has
+        pad_rows rows and >= PLANE_PAD trailing zero columns — no
+        multi-GB pad pass."""
         from presto_tpu.search import accel_pallas as ap
-        Ppad = jnp.pad(P, ((0, ap.pad_rows(numz) - numz),
-                           (0, ap.PLANE_PAD)))
+        rowpad = max(0, ap.pad_rows(numz) - P.shape[0])
+        colpad = 0 if plane_padded else ap.PLANE_PAD
+        Ppad = jnp.pad(P, ((0, rowpad), (0, colpad))) \
+            if (rowpad or colpad) else P
         colmax, colz = pallas_reducer(Ppad, start_cols)
         return _collect_from_reduced(colmax, colz)
 
@@ -677,15 +695,49 @@ class AccelSearch:
         # simply assumes survey-length FFTs): shrink the block to fit
         max_uselen = max(64, 2 * (numbins - 16))
         if cfg.uselen > max_uselen or cfg.uselen % 2:
-            from dataclasses import replace
             # even uselen keeps the block grid on whole bins — the
             # uniform-hop frame builder (_frames_fn) requires an
             # integer hop = uselen/2
             cfg = replace(cfg, uselen=min(cfg.uselen & ~1, max_uselen))
+        # Direct-plane pallas builder (TPU): pick an ALIGNED geometry —
+        # uselen a multiple of 128 columns filling the fftlen minus a
+        # 128-aligned output offset — so the build kernel stores the
+        # plane layout directly (build_pallas.py docstring).  Only the
+        # DEFAULT uselen is retuned; an explicit cfg.uselen is the
+        # caller's choice (the reference's own ACCEL_USELEN is a CPU
+        # FFT tuning knob, accel.h:10-16).
+        try:
+            from presto_tpu.search import accel_pallas as _ap
+            _plb_ok = (_ap.pallas_available()
+                       and ACCEL_ENGINE in ("auto", "plb"))
+        except Exception:
+            _plb_ok = False
+        if _plb_ok and cfg.uselen == ACCEL_USELEN:
+            fft0 = calc_fftlen(1, 1, cfg.zmax, cfg.uselen, cfg.wmax)
+            hw0 = (resp.w_resp_halfwidth(float(cfg.zmax),
+                                         float(cfg.wmax), resp.LOWACC)
+                   if cfg.wmax else
+                   resp.z_resp_halfwidth(float(cfg.zmax), resp.LOWACC))
+            hw_eff0 = -(-hw0 // 64) * 64
+            u_al = (fft0 - 4 * hw_eff0) & ~127
+            if (1024 <= u_al <= max_uselen
+                    and calc_fftlen(1, 1, cfg.zmax, u_al,
+                                    cfg.wmax) == fft0):
+                cfg = replace(cfg, uselen=u_al)
         self.cfg = cfg
         self.T = T
         self.numbins = numbins
         self.kern = AccelKernels.build(cfg)
+        # plb engages when the ACTUAL kernel geometry satisfies the
+        # alignment contract (kern built above)
+        self._plb_hw_eff = None
+        if _plb_ok:
+            hw_eff = -(-self.kern.halfwidth // 64) * 64
+            if (self.kern.fftlen % (2 * _DFT_N2) == 0
+                    and cfg.uselen % _DFT_N2 == 0
+                    and cfg.uselen + 4 * hw_eff <= self.kern.fftlen
+                    and _use_mxu_engine(self.kern.fftlen)):
+                self._plb_hw_eff = hw_eff
         self._fn_cache = {}   # compiled build/scan fns (avoid re-jit)
         self._kern_dev = None  # device copy of the kernel bank (lazy)
         self._w_banks = {0.0: self.kern}   # jerk-search kernel banks
@@ -797,8 +849,21 @@ class AccelSearch:
                 align = max(align, ap.TILE)
         except Exception:
             pass
-        plane_numr = int(2 * int(starts[-1]) + cfg.uselen)
-        plane_numr += (-plane_numr) % align
+        # direct-plane builder geometry: the plane IS the kernel
+        # output, [numz_pad, nb_pad*uselen] with >= 1 zero-padded
+        # block on the right (covers the scan's PLANE_PAD contract);
+        # the effective halfwidth rounds the window offset to a
+        # 128-column boundary so the good region is whole n1-rows
+        hw_eff = self._plb_hw_eff
+        hw_use = hw_eff if hw_eff else kern.halfwidth
+        nb_pad = None
+        if hw_eff:
+            from presto_tpu.search import build_pallas as bp
+            nb_pad = -(-(len(starts) + 1) // bp.BB) * bp.BB
+            plane_numr = nb_pad * cfg.uselen
+        else:
+            plane_numr = int(2 * int(starts[-1]) + cfg.uselen)
+            plane_numr += (-plane_numr) % align
         # Chunk the block batch: the [chunk, numz, fftlen] complex
         # intermediate is the peak working memory, so bound it — the
         # HBM-ladder analog of meminfo.h.  Round down to the smallest
@@ -821,7 +886,7 @@ class AccelSearch:
         chunk = min(chunk, nblocks)
         nsteps = (nblocks + chunk - 1) // chunk
         npad_blocks = nsteps * chunk - nblocks
-        lobin0 = int(starts[0]) - kern.halfwidth
+        lobin0 = int(starts[0]) - hw_use
         pad_lo = max(0, -lobin0)
         # cover the last real window AND the frame builder's (F+P)*hop
         # base region (padded frames read zeros there)
@@ -829,17 +894,18 @@ class AccelSearch:
         F = nsteps * chunk
         P = -(-numdata // hop)
         pad_hi = numdata + max(
-            0, int(starts[-1]) - kern.halfwidth + numdata - self.numbins)
+            0, int(starts[-1]) - hw_use + numdata - self.numbins)
         pad_hi = max(pad_hi,
                      lobin0 + pad_lo + (F + P) * hop - self.numbins)
         lobins = np.asarray(
-            [int(s0) - kern.halfwidth for s0 in starts]
+            [int(s0) - hw_use for s0 in starts]
             + [self.numbins] * npad_blocks, np.int32) + pad_lo
         from types import SimpleNamespace
         self._geom = SimpleNamespace(
             starts=starts, numdata=numdata, plane_numr=plane_numr,
             chunk=chunk, nsteps=nsteps, col0=col0, nblocks=nblocks,
-            lobins=lobins,
+            lobins=lobins, hw_use=hw_use, hw_eff=hw_eff,
+            nb_pad=nb_pad,
             pads=((pad_lo, pad_hi), (0, 0)),
             body_numr=nsteps * chunk * cfg.uselen)
         return self._geom
@@ -854,6 +920,10 @@ class AccelSearch:
         cfg, kern = self.cfg, self.kern
         use_mxu = _use_mxu_engine(kern.fftlen)
         consts = _dft_consts_np(kern.fftlen) if use_mxu else None
+        hw_use = g.hw_use     # effective halfwidth: plb geometry pads
+                              # the output offset, and the window
+                              # lobins shift with it — every engine
+                              # must slice at the same offset
 
         def chunk_slab(data, kern_use):
             if cfg.norm == "median":
@@ -861,9 +931,9 @@ class AccelSearch:
             if use_mxu:
                 return _ffdot_slab_mxu(
                     data, kern_use, tuple(map(jnp.asarray, consts)),
-                    cfg.uselen, kern.fftlen, kern.halfwidth)
+                    cfg.uselen, kern.fftlen, hw_use)
             return _ffdot_slab_fft(data, kern_use, cfg.uselen,
-                                   kern.fftlen, kern.halfwidth)
+                                   kern.fftlen, hw_use)
 
         chunk_slab.use_mxu = use_mxu
         return chunk_slab
@@ -896,36 +966,39 @@ class AccelSearch:
         return frames
 
     def _pallas_build_body(self, g, frames_fn):
-        """EXPERIMENTAL plane-build body (PRESTO_TPU_ACCEL_ENGINE=plb):
-        forward spectra in XLA, correlation + |.|^2 in a VMEM pallas
-        kernel (search/build_pallas.py).  Measured on v5e at the bench
-        workload: kernel alone ~74 ms (after real-stacking each
-        complex matmul into ONE MXU dot — per-dot issue latency, not
-        FLOPs, dominated), but the XLA wrapping (fwd stage, bank
-        prep, and above all the [.., n1, n2] -> flat-time slice pass,
-        a physical relayout TPU tiling cannot view for free) brings
-        the whole build to ~365 ms vs the default engine's ~305 ms —
-        opt-in until that relayout is eliminated.  Checksum-identical
-        to the mxu engine."""
+        """Direct-plane pallas build body (the default TPU engine when
+        the aligned geometry holds — see __init__): forward spectra in
+        XLA, correlation + |.|^2 in a VMEM pallas kernel
+        (search/build_pallas.py) that writes the plane layout
+        directly.  The output is [numz_pad, nb_pad*uselen]: pad z
+        rows are zero (zero kernels) and padded blocks write zero
+        columns, both handled by the scanner; the only post-op is a
+        free reshape.  (The previous full-frame version lost ~290 ms
+        to an XLA [off:off+uselen] relayout pass; kernel alone
+        measured ~74 ms on the bench workload.)"""
         try:
             from presto_tpu.search import accel_pallas as ap
             if not ap.pallas_available():
-                print("accel: PRESTO_TPU_ACCEL_ENGINE=plb requested "
-                      "but no TPU backend — using the default engine")
+                if ACCEL_ENGINE == "plb":
+                    print("accel: PRESTO_TPU_ACCEL_ENGINE=plb "
+                          "requested but no TPU backend — using the "
+                          "default engine")
                 return None
             from presto_tpu.search import build_pallas as bp
         except Exception as e:
-            print("accel: PRESTO_TPU_ACCEL_ENGINE=plb unavailable "
-                  "(%s) — using the default engine" % (e,))
+            print("accel: pallas build unavailable (%s) — using the "
+                  "default engine" % (e,))
             return None
         cfg, kern = self.cfg, self.kern
         fftlen, numz = kern.fftlen, kern.numz
-        nblocks, plane_numr = g.nblocks, g.plane_numr
+        nblocks = g.nblocks
         uselen = cfg.uselen
+        off_eff = g.hw_eff * ACCEL_NUMBETWEEN
         numz_pad = -(-numz // bp.ZT) * bp.ZT
-        nb_pad = -(-nblocks // bp.BB) * bp.BB
-        builder = bp.make_plane_builder(numz, nblocks, fftlen, uselen,
-                                        kern.halfwidth)
+        nb_pad = g.nb_pad
+        assert nb_pad * uselen == g.plane_numr
+        builder = bp.make_plane_builder(numz, nb_pad, fftlen, uselen,
+                                        off_eff)
         consts = _dft_consts_np(fftlen)
 
         def build_body(fft_raw, kern_dev):
@@ -942,15 +1015,9 @@ class AccelSearch:
                          ((0, numz_pad - numz), (0, 0), (0, 0)))
             Ki = jnp.pad(kz.imag.astype(jnp.float32),
                          ((0, numz_pad - numz), (0, 0), (0, 0)))
-            pw = builder(Sr, Si, Kr, Ki)   # [numz_pad, nb_pad, n1, n2]
-            off = kern.halfwidth * ACCEL_NUMBETWEEN
-            frames3 = pw.reshape(numz_pad, nb_pad, fftlen)
-            body = jax.lax.slice(
-                frames3, (0, 0, off),
-                (numz, nblocks, off + uselen)).reshape(
-                    numz, nblocks * uselen)
-            return jnp.pad(
-                body, ((0, 0), (0, plane_numr - nblocks * uselen)))
+            pw = builder(Sr, Si, Kr, Ki)
+            # [numz_pad, nb_pad, uselen//128, 128] -> the plane, free
+            return pw.reshape(numz_pad, nb_pad * uselen)
         return build_body
 
     # how many chunk bodies are unrolled for the concat assembly before
@@ -985,8 +1052,13 @@ class AccelSearch:
             frames_fn = self._frames_fn(g)
             chunk = g.chunk
 
+            if ACCEL_ENGINE == "plb" and not g.hw_eff:
+                print("accel: PRESTO_TPU_ACCEL_ENGINE=plb requested "
+                      "but the aligned geometry does not hold "
+                      "(explicit uselen or halfwidth too wide) — "
+                      "using the default engine")
             plb = self._pallas_build_body(g, frames_fn) \
-                if (use_mxu and ACCEL_ENGINE == "plb") else None
+                if (use_mxu and g.hw_eff) else None
             if plb is not None:
                 g.build_body = plb
                 g.key = (g.chunk, g.nsteps, g.plane_numr, "plb")
@@ -1307,7 +1379,13 @@ class AccelSearch:
         top_a = min(top + ((-top) % align), plane_numr) if aligned \
             else top
         k = min(cfg.max_cands_per_stage, slab)
-        skey = ("scan", slab, k, plane_numr, aligned, use_pallas)
+        # a direct-plane build already carries the reducer's row pad
+        # and >= PLANE_PAD trailing zero columns: skip the 3.4 GB pad
+        plane_padded = bool(
+            use_pallas and self._plb_hw_eff
+            and plane_numr >= top_a + ap.PLANE_PAD)
+        skey = ("scan", slab, k, plane_numr, aligned, use_pallas,
+                plane_padded)
         if skey not in self._fn_cache:
             fz = _harm_fracs_and_zinds(cfg, self.cfg.numz)
             reducer = None
@@ -1318,7 +1396,8 @@ class AccelSearch:
             self._fn_cache[skey] = _make_search_scanner(
                 cfg.numharmstages, fz, self.powcut, slab, k,
                 plane_numr, aligned=aligned,
-                pallas_reducer=reducer, numz=self.cfg.numz)
+                pallas_reducer=reducer, numz=self.cfg.numz,
+                plane_padded=plane_padded)
         start_cols = []
         off = r0a
         while True:
@@ -1395,8 +1474,15 @@ class AccelSearch:
         build_one = self._fn_cache[key]
         mkey = ("build_many",) + key[1:]
         if mkey not in self._fn_cache:
-            self._fn_cache[mkey] = jax.jit(
-                jax.vmap(build_one, in_axes=(0, None)))
+            if "plb" in key:
+                # pallas_call + vmap is unsupported; sequential map is
+                # fine (each build saturates the chip on its own)
+                self._fn_cache[mkey] = jax.jit(
+                    lambda batch, kd: jax.lax.map(
+                        lambda b: build_one(b, kd), batch))
+            else:
+                self._fn_cache[mkey] = jax.jit(
+                    jax.vmap(build_one, in_axes=(0, None)))
         build_many = self._fn_cache[mkey]
 
         splan = self._slab_plan(plane_numr, slab)
@@ -1460,6 +1546,7 @@ class AccelSearch:
             numharm = 1 << stage
             v = vals[stage]
             good = v > 0.0
+            good &= zrow[stage] < cfg.numz   # plane pad rows (zeros)
             if start_col < r0min:     # alignment searched below rlo:
                 good &= (start_col + cidx[stage]) >= r0min
             if rtop is not None:      # ... or a few columns past rhi

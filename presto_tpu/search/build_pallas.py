@@ -13,20 +13,21 @@ z-tile's kernel bank slice, computes
     corr = iD1 @ r_z  per z           (MXU, inverse stage B over k1)
     out  = |corr|^2                   (VPU)
 
-and writes the [zt, block, n1, n2] power frames (full fftlen width;
-the caller's fused XLA pass slices the uselen window into the plane —
-an in-kernel [n1,n2]->[1,fftlen] flatten is a Mosaic relayout that
-measured slower than the extra pass).  The factored-DFT math is
-identical to
+and writes THE PLANE DIRECTLY: with the aligned geometry (uselen and
+the output offset both multiples of n2=128, chosen by AccelSearch
+when this builder engages) each block's good region is whole n1-rows
+of its [n1, n2] frame, so the kernel stores [rows_good, n2] slices
+whose row-major layout IS the plane's [numz_pad, nb_pad*uselen]
+body — the caller's only post-op is a free reshape.  (The previous
+version wrote full frames and sliced the misaligned [off:off+uselen]
+window in XLA: a physical relayout pass that cost more than the
+kernel itself.)  The factored-DFT math is identical to
 _ffdot_slab_mxu (same constants, from _dft_consts_np), so the two
 engines agree to float32 rounding of the dot order.
 
 Grid: (z_tiles, nblocks) with block minor, so pallas's BlockSpec
 pipelining re-fetches the kernel-bank tile only when the z-tile
-changes and streams S per block.  Output is [numz_pad, nblocks,
-uselen] (full lane-dim blocks — a 2-D [.., uselen]-wide block would
-put every store at an unaligned lane offset); the caller reshapes to
-the plane and pads, both free or cheap.
+changes and streams S per block.
 """
 
 from __future__ import annotations
@@ -41,26 +42,34 @@ BB = 8                       # blocks per grid cell (the output block's
 
 
 def make_plane_builder(numz: int, nblocks: int, fftlen: int,
-                       uselen: int, halfwidth: int,
+                       uselen: int, off: int,
                        interpret: bool = False):
     """Returns f(S_re, S_im [nb_pad, n1, n2], K_re, K_im
-    [numz_pad, n1, n2]) -> powers [numz_pad, nb_pad, n1, n2],
-    nb_pad = ceil(nblocks/BB)*BB (callers zero-pad S, then slice the
-    [off : off+uselen] window of the flattened last two dims).
+    [numz_pad, n1, n2]) -> powers [numz_pad, nb_pad, uselen//n2, n2]
+    — block bb's [off : off+uselen] good window, so a reshape to
+    [numz_pad, nb_pad*uselen] is the finished plane body.
 
+    Alignment contract: uselen % n2 == 0 and off % n2 == 0 (off is
+    the 128-aligned round-up of halfwidth*NUMBETWEEN half-bins; the
+    caller's window lobins use off//NUMBETWEEN as the effective
+    halfwidth), off + uselen <= fftlen.
+    nb_pad = ceil(nblocks/BB)*BB (callers zero-pad S; zero S ->
+    zero powers, so padded blocks write zero plane columns).
     K is the stage-layout CONJUGATED bank (accel._kern_bank_z, split
     to pairs); numz_pad = ceil(numz/8)*8 with zero rows below."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
-    from presto_tpu.search.accel import (_dft_consts_np,
-                                         ACCEL_NUMBETWEEN)
+    from presto_tpu.search.accel import _dft_consts_np
 
     n2 = 128
     n1 = fftlen // n2
+    assert uselen % n2 == 0 and off % n2 == 0, (uselen, off)
+    assert off + uselen <= fftlen, (off, uselen, fftlen)
+    rows_lo = off // n2
+    rows_good = uselen // n2
     numz_pad = -(-numz // ZT) * ZT
     nzt = numz_pad // ZT
     nb_pad = -(-nblocks // BB) * BB
-    off = halfwidth * ACCEL_NUMBETWEEN
     # inverse-stage constants (host f64 -> f32 pairs).  Complex
     # matmuls are ONE real MXU dot each via the real-stacking
     # identity  [Ar|Ai] @ [[Br, Bi], [-Bi, Br]] = [Cr|Ci]  — per-dot
@@ -117,7 +126,8 @@ def make_plane_builder(numz: int, nblocks: int, fftlen: int,
             cr, ci = c2[:n1], c2[n1:]
             pw = cr * cr + ci * ci
             for z in range(ZT):
-                out_ref[z, bb] = pw[:, z * n2:(z + 1) * n2]
+                out_ref[z, bb] = pw[rows_lo:rows_lo + rows_good,
+                                    z * n2:(z + 1) * n2]
         return
 
     @jax.jit
@@ -136,10 +146,10 @@ def make_plane_builder(numz: int, nblocks: int, fftlen: int,
                 pl.BlockSpec((n1, n2), lambda zt, b: (0, 0)),
                 pl.BlockSpec((2 * n1, 2 * n1), lambda zt, b: (0, 0)),
             ],
-            out_specs=pl.BlockSpec((ZT, BB, n1, n2),
+            out_specs=pl.BlockSpec((ZT, BB, rows_good, n2),
                                    lambda zt, b: (zt, b, 0, 0)),
             out_shape=jax.ShapeDtypeStruct(
-                (numz_pad, nb_pad, n1, n2), jnp.float32),
+                (numz_pad, nb_pad, rows_good, n2), jnp.float32),
             interpret=interpret,
         )(Sr, Si, Kr, Ki, C2two, Tbr, Tbi, iD1two)
 

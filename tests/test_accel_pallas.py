@@ -67,8 +67,10 @@ def test_pallas_reducer_matches_numpy(numharm):
 
 
 def test_plane_builder_matches_mxu_engine():
-    """search/build_pallas.py (experimental plb engine) must agree
-    with the XLA factored-DFT engine it mirrors (interpret mode)."""
+    """search/build_pallas.py (the direct-plane build kernel) must
+    agree with the XLA factored-DFT engine it mirrors (interpret
+    mode), writing the aligned [off_eff : off_eff+uselen] window of
+    each block straight into plane layout."""
     import jax.numpy as jnp
     from presto_tpu.search.accel import (
         AccelConfig, AccelKernels, _dft_consts_np, _ffdot_slab_mxu,
@@ -76,7 +78,10 @@ def test_plane_builder_matches_mxu_engine():
     from presto_tpu.search import build_pallas as bp
     cfg = AccelConfig(zmax=20, numharm=2, uselen=1024)
     kern = AccelKernels.build(cfg)
-    fftlen, hw, numz = kern.fftlen, kern.halfwidth, cfg.numz
+    fftlen, numz = kern.fftlen, cfg.numz
+    hw_eff = -(-kern.halfwidth // 64) * 64
+    off_eff = 2 * hw_eff
+    assert cfg.uselen + 2 * off_eff <= fftlen
     rng = np.random.default_rng(3)
     B = 9                                 # exercises block padding
     data = (rng.normal(size=(B, fftlen // 2))
@@ -85,23 +90,26 @@ def test_plane_builder_matches_mxu_engine():
     kc = _fft_kernel_bank_c(jnp.asarray(kern.kern_pairs), fftlen)
     kz = _kern_bank_z(kc, fftlen)
     consts = tuple(map(jnp.asarray, _dft_consts_np(fftlen)))
+    # the XLA engine slicing at the SAME aligned offset is the oracle
     want = np.asarray(_ffdot_slab_mxu(jnp.asarray(data), kz, consts,
-                                      cfg.uselen, fftlen, hw))
+                                      cfg.uselen, fftlen, hw_eff))
     Sr, Si = _fwd_stage_mxu(jnp.asarray(data), consts, fftlen)
     nb_pad = -(-B // bp.BB) * bp.BB
     numz_pad = -(-numz // bp.ZT) * bp.ZT
     bpad = ((0, nb_pad - B), (0, 0), (0, 0))
     zpad = ((0, numz_pad - numz), (0, 0), (0, 0))
-    build = bp.make_plane_builder(numz, B, fftlen, cfg.uselen, hw,
-                                  interpret=True)
+    build = bp.make_plane_builder(numz, B, fftlen, cfg.uselen,
+                                  off_eff, interpret=True)
     pw = np.asarray(build(
         jnp.pad(Sr, bpad), jnp.pad(Si, bpad),
         jnp.pad(kz.real.astype(jnp.float32), zpad),
         jnp.pad(kz.imag.astype(jnp.float32), zpad)))
-    off = 2 * hw
-    got = pw.reshape(numz_pad, nb_pad, fftlen)[
-        :numz, :B, off:off + cfg.uselen].reshape(numz, -1)
+    plane = pw.reshape(numz_pad, nb_pad * cfg.uselen)
+    got = plane[:numz, :B * cfg.uselen]
     np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
+    # padded blocks and pad z rows write zeros
+    assert not plane[:, B * cfg.uselen:].any()
+    assert not plane[numz:].any()
 
 
 def test_pick_tile_vmem_gate():

@@ -189,3 +189,27 @@ def test_split_rerun_with_new_geometry_rewrites(tmp_path):
         with FilterbankFile(f) as fb:
             assert fb.nspectra == 1000
     assert set(out1) & set(out2)     # the collision the fix guards
+
+
+def test_split_rerun_with_new_overlap_rewrites(tmp_path):
+    """overlap_factor changes shift start samples but keep nsamp —
+    colliding names must still be rewritten (reuse checks tstart)."""
+    d = str(tmp_path)
+    scan = os.path.join(d, "scan.fil")
+    N = 6000
+    fake_filterbank_file(scan, N=N, dt=1e-3, nchan=8,
+                         lofreq=350.0, chanwidth=1.0,
+                         signal=FakeSignal(f=5.0, dm=10.0, amp=0.5),
+                         noise_sigma=5.0, nbits=8, seed=5)
+    from presto_tpu.io.sigproc import FilterbankFile
+    with FilterbankFile(scan) as fb:
+        full = fb.read_spectra(0, N)
+    split_drift_scan([scan], outdir=d, orig_N=2000,
+                     overlap_factor=0.5, prefix="to")
+    out = split_drift_scan([scan], outdir=d, orig_N=2000,
+                           overlap_factor=0.25, prefix="to")
+    for i, f in enumerate(out):
+        with FilterbankFile(f) as fb:
+            got = fb.read_spectra(0, fb.nspectra)
+        start = i * 500               # 2000 * 0.25 spacing
+        np.testing.assert_array_equal(got, full[start:start + 2000])
