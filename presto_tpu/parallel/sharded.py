@@ -126,3 +126,81 @@ def make_sharded_sixstep_fft(mesh: Mesh, rows: int):
         return jnp.stack([X.real, X.imag], axis=-1).astype(jnp.float32)
 
     return fft_pairs
+
+
+# ----------------------------------------------------------------------
+# DM-batch-sharded accelsearch (the search-stage mpiprepsubband analog)
+# ----------------------------------------------------------------------
+
+
+def sharded_accel_search_many(searcher, pairs_batch, mesh: Mesh,
+                              slab: int = 1 << 20):
+    """Accelsearch over a DM fan-out with the trial axis sharded over
+    `mesh` — the search-stage application of the mpiprepsubband
+    invariant (SURVEY §4.8; mpiprepsubband.c:288-297's DM partition):
+    each device owns numdms/n trials and runs the IDENTICAL fused
+    build+scan program on its shard sequentially (one plane resident
+    per device at a time), with no cross-device communication at all.
+    The packed per-stage top-k tensors gather to the host, where
+    candidate collection is byte-identical to the single-device path —
+    tests pin sharded lists == single-device lists.
+
+    searcher: an AccelSearch whose geometry matches pairs_batch's
+    numbins.  pairs_batch: [numdms, numbins, 2] float32 (host).
+    Returns per-DM candidate lists (search_many semantics).
+    """
+    cfg = searcher.cfg
+    if cfg.wmax:
+        # jerk searches keep the per-w plane-cache loop (no sharded
+        # variant yet) — same results, device-serial
+        return searcher.search_many(pairs_batch, slab=slab)
+    batch = np.ascontiguousarray(np.asarray(pairs_batch, np.float32))
+    nd = batch.shape[0]
+    if nd == 0:
+        return []
+    n = int(np.prod([mesh.shape[a] for a in mesh.axis_names]))
+    axis = mesh.axis_names[0]
+    g = searcher._build_plan_ns()
+    if g is None:
+        return [[] for _ in range(nd)]
+    splan = searcher._slab_plan(g.plane_numr, slab)
+    if splan is None:
+        return [[] for _ in range(nd)]
+    slab_, k, scanner, start_cols = splan
+    kern_dev = searcher._kern_bank_dev()
+    build_body, scan_body = g.build_body, scanner.body
+    # pad the DM axis to a mesh multiple (padded trials re-search the
+    # last spectrum; their results are dropped)
+    pad = (-nd) % n
+    if pad:
+        batch = np.concatenate([batch] + [batch[-1:]] * pad)
+    scols = jnp.asarray(np.asarray(start_cols, np.int32))
+
+    # cache the compiled program on the searcher (jax.jit caches on
+    # function identity; a fresh closure per call would re-trace the
+    # fused build+scan every survey group)
+    fkey = ("sharded_search", mesh, g.key, slab_, k, batch.shape)
+    fn = searcher._fn_cache.get(fkey)
+    if fn is None:
+        def per_shard(local, kern, sc):
+            def per_dm(_, x):
+                return None, scan_body(build_body(x, kern), sc)
+            _, packed = jax.lax.scan(per_dm, None, local)
+            return jnp.moveaxis(packed, 1, 0)  # [3, nd_loc, nsl, s, k]
+
+        fn = jax.jit(jax.shard_map(
+            per_shard, mesh=mesh,
+            in_specs=(P(axis), P(), P()),
+            out_specs=P(None, axis)))
+        searcher._fn_cache[fkey] = fn
+    packed = np.asarray(fn(jnp.asarray(batch), kern_dev, scols))
+    from presto_tpu.search.accel import _unpack_scan
+    vals, cidx, zrow = _unpack_scan(packed)
+    out = []
+    for d in range(nd):
+        cands = []
+        for si, start in enumerate(start_cols):
+            searcher._collect_slab(vals[d][si], cidx[d][si],
+                                   zrow[d][si], start, cands)
+        out.append(searcher._dedup_sort(cands))
+    return out

@@ -53,3 +53,47 @@ def test_sharded_sixstep_fft(mesh):
     got = got_pairs[..., 0] + 1j * got_pairs[..., 1]
     want = np.fft.fft(x)
     np.testing.assert_allclose(got, want, rtol=1e-3, atol=3e-2)
+
+
+def test_sharded_accel_search_matches_single(mesh):
+    """Search-stage mpiprepsubband invariant (VERDICT r3 item 5):
+    DM-batch-sharded accelsearch candidate lists must equal the
+    single-device lists — exactly for the mesh-size-1 twin (same
+    program, sharding cannot change floats), and as (numharm, r, z)
+    sets vs the production search_many path."""
+    from presto_tpu.search.accel import AccelConfig, AccelSearch
+
+    rng = np.random.default_rng(2)
+    nbins = 1 << 14
+    nd = 12                      # not a mesh multiple: exercises pad
+    t = np.arange(1 << 15) / (1 << 15)
+    batch = []
+    for d in range(nd):
+        x = rng.normal(size=1 << 15)
+        r0 = 2000.5 + 70.0 * d
+        x += 0.12 * np.cos(2 * np.pi * (r0 * t + 4.0 * t * t))
+        X = np.fft.rfft(x)[:nbins]
+        batch.append(np.stack([X.real, X.imag], -1).astype(np.float32))
+    batch = np.stack(batch)
+
+    cfg = AccelConfig(zmax=20, numharm=4, sigma=3.0)
+    s = AccelSearch(cfg, T=800.0, numbins=nbins)
+    got = sharded.sharded_accel_search_many(s, batch, mesh)
+    mesh1 = make_mesh(1, ("dm",))
+    want = sharded.sharded_accel_search_many(s, batch, mesh1)
+    assert len(got) == len(want) == nd
+    for a, b in zip(got, want):
+        assert [(c.numharm, c.r, c.z, c.power) for c in a] == \
+               [(c.numharm, c.r, c.z, c.power) for c in b]
+    # consistency with the production batched path (identical search
+    # program modulo vmap-vs-scan scheduling): same candidate sets
+    many = s.search_many(batch)
+    for a, b in zip(got, many):
+        assert {(c.numharm, round(c.r, 3), round(c.z, 2))
+                for c in a} == \
+               {(c.numharm, round(c.r, 3), round(c.z, 2))
+                for c in b}
+    # every injected chirp recovered in its trial (mid-observation
+    # frequency r0 + z/2, z = 2*4.0 = 8)
+    for d, cl in enumerate(got):
+        assert cl and abs(cl[0].r - (2004.5 + 70.0 * d)) < 1.0
