@@ -56,6 +56,10 @@ def build_parser():
                         "(implies -nosearch, -fine, npart=60)")
     p.add_argument("-polycos", type=str, default=None,
                    help="Fold using an existing TEMPO polyco.dat")
+    p.add_argument("-ephem", type=str, default="DE405",
+                   help="Ephemeris for -par/-timing polycos: a DE name"
+                        " (built-in analytic), a .npz table, or a JPL"
+                        " .bsp SPK kernel (the sub-us timing path)")
     p.add_argument("-absphase", action="store_true",
                    help="Use the absolute phase of the polycos")
     p.add_argument("-barypolycos", action="store_true",
@@ -189,6 +193,7 @@ def _fold_params(args, T: float, obs=None):
             pcs = make_polycos(par, mjd0 - 1.0 / 1440.0, dur_min,
                                telescope=obs.get("telescope", "GBT"),
                                obsfreq=obs.get("obsfreq", 0.0),
+                               ephem=getattr(args, "ephem", "DE405"),
                                barytime=obs.get("bary", False))
             if not args.dm:
                 args.dm = getattr(par, "DM", 0.0)

@@ -9,76 +9,19 @@ the analytic ephemeris.  The reader must reproduce the fitted
 polynomials to float64 round-off and chain SSB->EMB->Earth correctly.
 """
 
-import struct
+import os
+import sys
 
 import numpy as np
 import pytest
 
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
 from presto_tpu.astro.spk import (AU_KM, DAY_S, EARTH, EMB, J2000_JD,
                                   SPK, SSB, SUN, SPKEphemeris)
 from presto_tpu.astro.ephem import get_ephemeris
-
-NCOEF = 12
-
-
-def _cheby_fit(fn, t0, t1, ncoef):
-    """Chebyshev coefficients of fn over [t0, t1] (3 components)."""
-    k = np.arange(ncoef)
-    x = np.cos(np.pi * (k + 0.5) / ncoef)          # Chebyshev nodes
-    t = 0.5 * (t0 + t1) + 0.5 * (t1 - t0) * x
-    y = fn(t)                                      # [ncoef, 3]
-    T = np.cos(np.outer(np.arccos(x), k))          # [ncoef, ncoef]
-    c = 2.0 / ncoef * T.T @ y                      # [ncoef, 3]
-    c[0] *= 0.5
-    return c.T                                     # [3, ncoef]
-
-
-def _write_spk(path, segments):
-    """Minimal single-summary-record DAF/SPK writer.
-
-    segments: list of (target, center, data_type, init, intlen,
-    records[n, rsize]) — enough structure to exercise the reader's
-    address arithmetic, summary walk, and both Chebyshev data types.
-    """
-    nd, ni = 2, 6
-    # element data begins at record 4 (1:file, 2:summary, 3:names)
-    arrays = []
-    addr = (4 - 1) * 128 + 1                       # 1-indexed doubles
-    summaries = []
-    for (tgt, ctr, dtype, init, intlen, recs) in segments:
-        n, rsize = recs.shape
-        flat = np.concatenate([recs.ravel(),
-                               [init, intlen, float(rsize), float(n)]])
-        a0, a1 = addr, addr + len(flat) - 1
-        et0 = init
-        et1 = init + intlen * n
-        summaries.append((et0, et1, tgt, ctr, 1, dtype, a0, a1))
-        arrays.append(flat)
-        addr = a1 + 1
-
-    file_rec = bytearray(1024)
-    file_rec[0:8] = b"DAF/SPK "
-    file_rec[8:16] = struct.pack("<ii", nd, ni)
-    file_rec[16:76] = b"synthetic kernel".ljust(60)
-    file_rec[76:88] = struct.pack("<iii", 2, 2, addr)  # FWARD BWARD FREE
-    file_rec[88:96] = b"LTL-IEEE"
-
-    sum_rec = bytearray(1024)
-    sum_rec[0:24] = struct.pack("<ddd", 0.0, 0.0, float(len(summaries)))
-    for i, (et0, et1, tgt, ctr, frame, dtype, a0, a1) in \
-            enumerate(summaries):
-        off = 24 + i * 40
-        sum_rec[off:off + 40] = struct.pack("<dd6i", et0, et1, tgt, ctr,
-                                            frame, dtype, a0, a1)
-    name_rec = b" " * 1024
-
-    data = np.concatenate(arrays)
-    with open(path, "wb") as f:
-        f.write(bytes(file_rec))
-        f.write(bytes(sum_rec))
-        f.write(name_rec)
-        f.write(data.astype("<f8").tobytes())
-        f.write(b"\0" * ((-f.tell()) % 1024))
+from spk_synth import NCOEF, cheby_fit as _cheby_fit, \
+    write_spk as _write_spk
 
 
 @pytest.fixture(scope="module")
