@@ -48,7 +48,13 @@ FALLBACK_CPU_DM_TRIALS_PER_SEC = 41.2
 WORKLOAD = {"accel_numbins": 1 << 21, "accel_zmax": 200,
             "accel_numharm": 8, "dedisp_numchan": 128,
             "dedisp_nsub": 32, "dedisp_numdms": 128,
-            "dedisp_nsamples": 1 << 20}
+            "dedisp_nsamples": 1 << 20,
+            # extended rows (VERDICT r3 item 4)
+            "accel3_numharm": 16, "accel3_sigma": 2.0,
+            "sp_nseries": 128, "sp_nsamples": 1 << 20,
+            "sp_threshold": 5.0,
+            "jerk_numbins": 1 << 20, "jerk_zmax": 100,
+            "jerk_wmax": 300, "jerk_numharm": 4}
 
 
 def load_cpu_baseline():
@@ -177,13 +183,147 @@ def bench_dedisp():
     return numdms / elapsed, warm, elapsed, nsamples
 
 
+def search_and_polish(s, pairs_or_dev, T):
+    """Config-3 workload body shared with bench_cpu.py's CPU twin:
+    search -> harmonic elimination -> dedup -> batched polish (the
+    full per-trial candidate flow of the survey's workhorse pass).
+    The AccelSearch is built ONCE by the caller: compiled programs
+    cache per instance, so steady-state timing must reuse it."""
+    from presto_tpu.search.accel import (eliminate_harmonics,
+                                         remove_duplicates)
+    from presto_tpu.search.polish import optimize_accelcands
+    raw = s.search(pairs_or_dev)
+    cands = remove_duplicates(eliminate_harmonics(raw))
+    ocs = optimize_accelcands(pairs_or_dev, cands, T, s.numindep,
+                              with_props=False)
+    return cands, ocs
+
+
+def bench_accel3():
+    """Config 3 (survey workhorse): zmax=0 numharm=16 sigma=2 over the
+    same 2^21-bin spectrum, INCLUDING candidate refinement — the r2-r3
+    bottleneck (serial scipy polish) now runs as the batched device
+    polish, so the steady wall time is device-dominated."""
+    import jax.numpy as jnp
+    from presto_tpu.search.accel import AccelConfig
+
+    numbins = WORKLOAD["accel_numbins"]
+    pairs = make_accel_input()
+    cfg = AccelConfig(zmax=0, numharm=WORKLOAD["accel3_numharm"],
+                      sigma=WORKLOAD["accel3_sigma"])
+    from presto_tpu.search.accel import AccelSearch
+    s = AccelSearch(cfg, T=ACCEL_T, numbins=numbins)
+    dev_pairs = jnp.asarray(pairs)
+    float(dev_pairs.sum())
+    t0 = time.time()
+    cands, _ = search_and_polish(s, dev_pairs, ACCEL_T)
+    warm = time.time() - t0
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.time()
+        cands, ocs = search_and_polish(s, dev_pairs, ACCEL_T)
+        best = min(best, time.time() - t0)
+    return best, warm, len(cands)
+
+
+def bench_singlepulse():
+    """Config 5's SP stage: the device-resident batched matched
+    filter over a 128-trial x 2^20-sample DM fan-out
+    (search_many_resident — the survey's fused regime: the
+    dedispersed series are already in HBM; only stds/scales and the
+    compacted hits cross the boundary).  The CPU twin runs the full
+    host search_many on the same data."""
+    import jax.numpy as jnp
+    from presto_tpu.search.singlepulse import SinglePulseSearch
+
+    nf, n = WORKLOAD["sp_nseries"], WORKLOAD["sp_nsamples"]
+    rng = np.random.default_rng(7)
+    series = [rng.normal(size=n).astype(np.float32) for _ in range(nf)]
+    for s in series[::8]:           # sprinkle single pulses
+        for pos in (12345, 500000):
+            s[pos:pos + 30] += 4.0
+    batch = jnp.asarray(np.stack(series))     # resident (one upload)
+    float(batch.sum())
+    sp = SinglePulseSearch(threshold=WORKLOAD["sp_threshold"])
+    dms = list(np.arange(nf, dtype=float))
+    t0 = time.time()
+    res = sp.search_many_resident(batch, dt=8.192e-5, dms=dms)
+    warm = time.time() - t0
+    best = float("inf")
+    for _ in range(2):
+        t0 = time.time()
+        res = sp.search_many_resident(batch, dt=8.192e-5, dms=dms)
+        best = min(best, time.time() - t0)
+    nev = sum(len(c) for (c, _st, _b) in res)
+    return best, warm, nev
+
+
+def bench_jerk():
+    """Jerk-search diagnostic: zmax=100 wmax=300 numharm=4 over a
+    2^20-bin spectrum, device-resident — (r, z, w) volume cells/s
+    (kernel banks host-built once and cached; the reference also
+    excludes its 'Generating correlation kernels' setup from the
+    search loop, accelsearch.c:134-160)."""
+    import jax.numpy as jnp
+    from presto_tpu.search.accel import AccelConfig, AccelSearch
+
+    numbins = WORKLOAD["jerk_numbins"]
+    rng = np.random.default_rng(11)
+    pairs = np.stack([rng.normal(size=numbins), rng.normal(
+        size=numbins)], -1).astype(np.float32)
+    pairs[123456] = (200.0, 0.0)
+    cfg = AccelConfig(zmax=WORKLOAD["jerk_zmax"],
+                      wmax=WORKLOAD["jerk_wmax"],
+                      numharm=WORKLOAD["jerk_numharm"], sigma=6.0)
+    s = AccelSearch(cfg, T=ACCEL_T, numbins=numbins)
+    dev_pairs = jnp.asarray(pairs)
+    float(dev_pairs.sum())
+    t0 = time.time()
+    cands = s.search(dev_pairs)
+    warm = time.time() - t0
+    best = float("inf")
+    for _ in range(2):
+        t0 = time.time()
+        cands = s.search(dev_pairs)
+        best = min(best, time.time() - t0)
+    numr = int(s.rhi - s.rlo) * 2
+    cells = cfg.numz * numr * len(cfg.ws)
+    return cells / best, warm, best, cells, len(cands)
+
+
 def main():
     import jax
 
+    extended = os.environ.get("PRESTO_TPU_BENCH_EXTENDED", "1") != "0"
     cpu_cells, cpu_dmtrials, cpu_meta = load_cpu_baseline()
     (cells_per_sec, warm_a, steady_a, cells, ncands, upload_a,
      incl_cells_per_sec, incl_a) = bench_accel()
     dm_per_sec, warm_d, steady_d, nsamples = bench_dedisp()
+
+    extra = {}
+    if extended:
+        cpu = cpu_meta or {}
+        c3_s, c3_warm, c3_n = bench_accel3()
+        c3_cpu = cpu.get("config3_seconds")
+        extra["config3"] = {
+            "value": round(c3_s, 2), "unit": "s",
+            "cpu": round(c3_cpu, 1) if c3_cpu else None,
+            "vs_baseline": round(c3_cpu / c3_s, 2) if c3_cpu else None,
+            "ncands": c3_n, "warmup_s": round(c3_warm, 1)}
+        sp_s, sp_warm, sp_n = bench_singlepulse()
+        sp_cpu = cpu.get("sp_seconds")
+        extra["singlepulse"] = {
+            "value": round(sp_s, 2), "unit": "s",
+            "cpu": round(sp_cpu, 1) if sp_cpu else None,
+            "vs_baseline": round(sp_cpu / sp_s, 2) if sp_cpu else None,
+            "nevents": sp_n, "warmup_s": round(sp_warm, 1)}
+        (jk_cells, jk_warm, jk_s, jk_tot,
+         jk_n) = bench_jerk()
+        extra["jerk"] = {
+            "value": round(jk_cells, 1), "unit": "cells/s",
+            "cpu": None, "vs_baseline": None,
+            "seconds": round(jk_s, 2), "cells": jk_tot,
+            "ncands": jk_n, "warmup_s": round(jk_warm, 1)}
 
     print(json.dumps({
         "metric": "ffdot_cells_per_sec_zmax200_nh8",
@@ -202,6 +342,7 @@ def main():
         "dm_trials_per_sec": round(dm_per_sec, 1),
         "dm_trials_vs_baseline": round(dm_per_sec / cpu_dmtrials, 2),
         "cpu_baseline_measured": cpu_meta is not None,
+        **extra,
     }))
     print("# device=%s accel: warmup=%.1fs steady=%.2fs "
           "inclusive=%.2fs (16MB H2D ref transfer %.2fs) cells=%.3g "

@@ -20,7 +20,14 @@ import sys
 import time
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
-os.environ.setdefault("JAX_PLATFORMS", "cpu")   # no TPU needed here
+# Force the CPU backend authoritatively: the ambient environment pins
+# JAX_PLATFORMS=axon and its sitecustomize re-asserts it, so setdefault
+# is not enough — the config update below is (same trick as tests/
+# conftest.py).  The config-3/SP twins run jax-backed code and MUST
+# measure the host, not the chip.
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax  # noqa: E402
+jax.config.update("jax_platforms", "cpu")
 
 import numpy as np
 
@@ -89,12 +96,63 @@ def bench_dedisp_cpu(repeats=3):
             "numdms": numdms, "nsamples": N, "checksum": checksum}
 
 
+def bench_accel3_cpu():
+    """Config-3 CPU twin: search_ref (zmax=0 nh=16 sigma=2) + the
+    SAME batched polish algorithm on the CPU backend — conservative
+    for the ratio: the reference's actual per-candidate simplex loop
+    (optimize_accelcand, ~70 ms/candidate measured on this host)
+    would be ~10-20x slower than this on survey candidate counts."""
+    from presto_tpu.search.accel import AccelConfig
+    from presto_tpu.search.accel_ref import timed_search_ref
+    from presto_tpu.search.accel import (AccelSearch,
+                                         eliminate_harmonics,
+                                         remove_duplicates)
+    from presto_tpu.search.polish import optimize_accelcands
+
+    pairs = make_accel_input()
+    numbins = WORKLOAD["accel_numbins"]
+    cfg = AccelConfig(zmax=0, numharm=WORKLOAD["accel3_numharm"],
+                      sigma=WORKLOAD["accel3_sigma"])
+    s = AccelSearch(cfg, T=ACCEL_T, numbins=numbins)
+    amps = pairs[..., 0].astype(np.complex64) + 1j * pairs[..., 1]
+    t0 = time.perf_counter()
+    cands, t_plane, t_search, cells = timed_search_ref(
+        pairs, cfg, ACCEL_T, dtype=np.float32)
+    kept = remove_duplicates(eliminate_harmonics(cands))
+    ocs = optimize_accelcands(amps, kept, ACCEL_T, s.numindep,
+                              with_props=False)
+    el = time.perf_counter() - t0
+    return {"config3_seconds": el, "config3_ncands": len(kept)}
+
+
+def bench_sp_cpu():
+    """Config-5 SP-stage CPU twin: the identical batched matched
+    filter (search_many) on the CPU backend, all cores."""
+    from presto_tpu.search.singlepulse import SinglePulseSearch
+    nf, n = WORKLOAD["sp_nseries"], WORKLOAD["sp_nsamples"]
+    rng = np.random.default_rng(7)
+    series = [rng.normal(size=n).astype(np.float32)
+              for _ in range(nf)]
+    for s in series[::8]:
+        for pos in (12345, 500000):
+            s[pos:pos + 30] += 4.0
+    sp = SinglePulseSearch(threshold=WORKLOAD["sp_threshold"])
+    t0 = time.perf_counter()
+    res = sp.search_many(series, dt=8.192e-5,
+                         dms=list(np.arange(nf, dtype=float)))
+    el = time.perf_counter() - t0
+    return {"sp_seconds": el,
+            "sp_nevents": sum(len(c) for (c, _s, _b) in res)}
+
+
 def main():
     import scipy
 
     t0 = time.time()
     accel = bench_accel_cpu()
     dedisp = bench_dedisp_cpu()
+    accel3 = bench_accel3_cpu()
+    spb = bench_sp_cpu()
     out = {
         # workload fingerprint: bench.py validates this against its
         # own config so the TPU/CPU ratio can never silently compare
@@ -105,6 +163,10 @@ def main():
         "accel_ncands": accel["ncands"],
         "dedisp_dm_trials_per_sec": round(dedisp["dm_trials_per_sec"], 2),
         "dedisp_seconds": round(dedisp["seconds"], 3),
+        "config3_seconds": round(accel3["config3_seconds"], 2),
+        "config3_ncands": accel3["config3_ncands"],
+        "sp_seconds": round(spb["sp_seconds"], 2),
+        "sp_nevents": spb["sp_nevents"],
         "nproc": os.cpu_count(),
         "numpy": np.__version__,
         "scipy": scipy.__version__,
@@ -114,10 +176,44 @@ def main():
             "and the device path) at float32 via scipy.fft pocketfft with "
             "workers=all cores; dedisp = vectorized NumPy shift-and-sum "
             "(dispersion.c:165-229 semantics), 128 chan -> 32 subbands -> "
-            "128 DMs x 2^20 samples; best-of-N wall time on this host"),
+            "128 DMs x 2^20 samples; best-of-N wall time on this host. "
+            "NOTE: this shared host shows up to ~2.7x CPU run-to-run "
+            "variance; the file keeps the fastest (strongest) CPU "
+            "observed per metric — conservative for every TPU ratio"),
     }
-    with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                           "cpu_baseline.json"), "w") as f:
+    # Keep the FASTEST CPU ever observed per metric: this shared host
+    # shows up to ~2.7x run-to-run CPU variance (noisy neighbors), and
+    # the strongest CPU baseline is the conservative one for every
+    # claimed TPU ratio.  Merged only when the relevant workload keys
+    # match (new keys may extend the fingerprint).
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "cpu_baseline.json")
+    # metric GROUPS merge atomically (seconds decide; derived rates
+    # and counts ride along so the file never mixes runs into a
+    # self-inconsistent pair)
+    GROUPS = (
+        ("accel_seconds", ("accel_cells_per_sec", "accel_ncands")),
+        ("dedisp_seconds", ("dedisp_dm_trials_per_sec",)),
+        ("config3_seconds", ("config3_ncands",)),
+        ("sp_seconds", ("sp_nevents",)),
+    )
+    try:
+        with open(path) as f:
+            old = json.load(f)
+        ow = old.get("workload") or {}
+        shared = [k2 for k2 in WORKLOAD if k2 in ow]
+        if shared and all(ow[k2] == WORKLOAD[k2] for k2 in shared):
+            for secs_key, riders in GROUPS:
+                if old.get(secs_key, float("inf")) < out[secs_key]:
+                    out[secs_key] = old[secs_key]
+                    for rk in riders:
+                        if rk in old:
+                            out[rk] = old[rk]
+            print("# merged with previous baseline (per-group best; "
+                  "host CPU varies run-to-run)", file=sys.stderr)
+    except Exception:
+        pass
+    with open(path, "w") as f:
         json.dump(out, f, indent=1)
     print(json.dumps(out))
     print("# total bench_cpu time %.1fs" % (time.time() - t0),

@@ -170,18 +170,57 @@ def gen_w_response(roffset: float, numbetween: int, z: float, w: float,
     assert numkern >= numbetween and numkern % (2 * numbetween) == 0
     if abs(w) < 1e-4:
         return gen_z_response(roffset, numbetween, z, numkern)
-    maxfreq = (numkern / (2.0 * numbetween) + abs(z) + abs(w) / 2.0
+    return gen_w_response_bank(roffset, numbetween,
+                               np.asarray([z]), w, numkern)[0]
+
+
+_WBANK_EXPMAT: dict = {}         # (numkern, numbetween, roffset,
+                                 # npts) -> cached Fourier matrix
+_WBANK_BUDGET = 2 * 2 ** 30      # bytes of cached matrices (a wmax=
+                                 # 300 bank's matrix is ~0.5-1 GB)
+
+
+def gen_w_response_bank(roffset: float, numbetween: int,
+                        zs: np.ndarray, w: float,
+                        numkern: int) -> np.ndarray:
+    """gen_w_response for a whole z bank at once -> [len(zs), numkern].
+
+    The expensive part of the quadrature is the [numkern, npts]
+    Fourier matrix exp(-2 pi i nu u) — it depends only on the kernel
+    GRID, not on (z, w), so one matrix (cached across banks: a jerk
+    search builds ~2*wmax/ACCEL_DW fundamental banks plus subharmonic
+    banks, all on the same grid) serves every z of every w plane and
+    the per-z work collapses to one [nz, npts] chirp table and a BLAS
+    matmul.  The serial per-(z, w) version cost ~1-2 s each — an hour
+    of host time for a wmax=300 kernel-bank build."""
+    zs = np.asarray(zs, np.float64)
+    absz = float(np.abs(zs).max()) if zs.size else 0.0
+    maxfreq = (numkern / (2.0 * numbetween) + absz + abs(w) / 2.0
                + abs(roffset) + 2.0)
     npts = int(max(1 << 14, next_pow2(int(32 * maxfreq))))
     u = (np.arange(npts, dtype=np.float64) + 0.5) / npts
-    phi = ((-0.5 * z + w / 12.0) * u + (0.5 * z - 0.25 * w) * u * u
-           + (w / 6.0) * u ** 3)
-    i = np.arange(numkern, dtype=np.float64)
-    nu = i / numbetween - numkern / (2.0 * numbetween) - roffset
-    # resp = mean_u exp(2πi(φ(u) - ν u)); evaluate as matmul in chunks
-    sig = np.exp(2j * np.pi * phi)
-    expmat = np.exp(-2j * np.pi * np.outer(nu, u))
-    return (expmat @ sig) / npts
+    ckey = (numkern, numbetween, round(roffset, 12), npts)
+    expmat = _WBANK_EXPMAT.get(ckey)
+    if expmat is None:
+        i = np.arange(numkern, dtype=np.float64)
+        nu = i / numbetween - numkern / (2.0 * numbetween) - roffset
+        expmat = np.exp(-2j * np.pi * np.outer(u, nu))  # [npts, kern]
+        # cache only bank-amortizable keys (roffset=0: the kernel-bank
+        # builds; per-candidate refinement's arbitrary fracs would
+        # fill the cache with single-use matrices) under a byte
+        # budget, evicting oldest-inserted first
+        if roffset == 0.0 and zs.size > 1:
+            _WBANK_EXPMAT[ckey] = expmat
+            used = sum(m.nbytes for m in _WBANK_EXPMAT.values())
+            while used > _WBANK_BUDGET and len(_WBANK_EXPMAT) > 1:
+                k0 = next(iter(_WBANK_EXPMAT))
+                used -= _WBANK_EXPMAT.pop(k0).nbytes
+    z_ = zs[:, None]
+    phi = ((-0.5 * z_ + w / 12.0) * u[None]
+           + (0.5 * z_ - 0.25 * w) * u[None] ** 2
+           + (w / 6.0) * u[None] ** 3)
+    sig = np.exp(2j * np.pi * phi)                      # [nz, npts]
+    return (sig @ expmat) / npts
 
 
 def next_pow2(n: int) -> int:

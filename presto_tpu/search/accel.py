@@ -181,21 +181,31 @@ class AccelKernels:
         numz = cfg.numz
         kmax = 2 * ACCEL_NUMBETWEEN * halfwidth
         kerns = np.zeros((numz, kmax), dtype=np.complex128)
+        zs = -cfg.zmax + np.arange(numz, dtype=np.float64) * ACCEL_DZ
+        if abs(w) >= 1e-7:
+            # whole-bank quadrature at full kmax taps (the centered
+            # numkern sub-grids of the kmax grid coincide exactly, so
+            # masking reproduces the per-z-truncated kernels); the
+            # serial per-z path cost ~1-2 s/kernel — an hour per
+            # wmax=300 bank set
+            full = resp.gen_w_response_bank(0.0, ACCEL_NUMBETWEEN,
+                                            zs, float(w), kmax)
         for i in range(numz):
-            z = -cfg.zmax + i * ACCEL_DZ
+            z = zs[i]
             if abs(w) < 1e-7:
                 hw = resp.z_resp_halfwidth(float(z), resp.LOWACC)
                 numkern = min(2 * ACCEL_NUMBETWEEN * hw, kmax)
                 k = resp.gen_z_response(0.0, ACCEL_NUMBETWEEN, float(z),
                                         numkern)
+                start = kmax // 2 - numkern // 2
+                kerns[i, start:start + numkern] = k[:numkern]
             else:
                 hw = resp.w_resp_halfwidth(float(z), float(w),
                                            resp.LOWACC)
                 numkern = min(2 * ACCEL_NUMBETWEEN * hw, kmax)
-                k = resp.gen_w_response(0.0, ACCEL_NUMBETWEEN, float(z),
-                                        float(w), numkern)
-            start = kmax // 2 - numkern // 2
-            kerns[i, start:start + numkern] = k[:numkern]
+                start = kmax // 2 - numkern // 2
+                kerns[i, start:start + numkern] = \
+                    full[i, start:start + numkern]
         pairs = np.stack([kerns.real, kerns.imag], axis=-1).astype(np.float32)
         return cls(fftlen=fftlen, halfwidth=halfwidth, numz=numz,
                    zlo=-cfg.zmax, kmax=kmax, kern_pairs=pairs)
@@ -1210,11 +1220,21 @@ class AccelSearch:
         fft_pairs = self._to_dev(fft_pairs)
         fracs = self._harm_fracs()
 
+        # host-RAM budget for cached w kernel banks (a bank is
+        # numz*kmax*2 float32 ~ a few MB; a wmax=300 search uses 31
+        # fundamental banks plus subharmonic-w banks, and rebuilding
+        # one costs seconds of host quadrature — cache by bytes, not
+        # the old count-of-8 which thrashed past wmax=140)
+        bank_budget = int(os.environ.get(
+            "PRESTO_TPU_WBANK_BUDGET", str(512 * 2 ** 20)))
+
         def bank_for(wg: float) -> AccelKernels:
             bank = self._w_banks.get(wg)
             if bank is None:
                 bank = AccelKernels.build(cfg, wg)
-                if len(self._w_banks) < 8:      # bound host RAM
+                used = sum(b.kern_pairs.nbytes
+                           for b in self._w_banks.values())
+                if used + bank.kern_pairs.nbytes <= bank_budget:
                     self._w_banks[wg] = bank
             return bank
 

@@ -411,6 +411,108 @@ class SinglePulseSearch:
             out.append((cands, stds, bad))
         return out
 
+    def search_many_resident(self, series, dt: float,
+                             dms: Sequence[float],
+                             offregions_list=None, G: int = 2048):
+        """search_many with the series DEVICE-RESIDENT end to end —
+        the survey's fused regime (dedispersed series stay in HBM;
+        feeding them back through the host link costs more than the
+        whole search on slow links).  Only small arrays cross the
+        boundary: per-block stds down, normalization scales up, and
+        the compacted top-G above-threshold hits down.
+
+        series: [nf, N] float32 (jax array, or numpy uploaded once).
+        Results match search_many exactly (same chunking, pruning,
+        bad-block cuts) unless a file has more than G above-threshold
+        top-k samples (heavy RFI) — those fall back to the host path.
+        """
+        import jax as _jax
+        nf = int(series.shape[0])
+        N = int(series.shape[1])
+        if offregions_list is None:
+            offregions_list = [()] * nf
+        dev = series if isinstance(series, _jax.Array) \
+            else jnp.asarray(np.asarray(series, np.float32))
+        dlen = self.detrendlen
+        nblk = N // dlen
+        widths, chunklen, fftlen, overlap, kern_pairs = \
+            self._chunk_geometry(widths=[1] + list(self.downfacts_for(dt)))
+        # pass 1: stds only (device detrend, tiny D2H)
+        roundN = nblk * dlen
+        _, stds_all = _detrend_blocks(
+            dev[:, :roundN].reshape(nf * nblk, dlen), dlen,
+            self.fast_detrend)
+        stds_all = np.asarray(stds_all).reshape(nf, nblk)
+        scales = np.empty((nf, nblk), np.float32)
+        masks = np.ones((nf, nblk), np.float32)
+        bads = []
+        for fi in range(nf):
+            stds = stds_all[fi]
+            medstd = float(np.median(stds)) if nblk else 0.0
+            zerostd = np.flatnonzero(stds <= 1e-4 * medstd)
+            if self.badblocks:
+                bad, med, _ = flag_bad_blocks(stds)
+                bad = np.union1d(bad, zerostd)
+                stds = stds.copy()
+                stds[bad] = med if med > 0.0 else 1.0
+            else:
+                bad = zerostd
+                stds = np.where(stds <= 0.0, 1.0, stds)
+            scales[fi] = 1.0 / stds
+            masks[fi, bad] = 0.0
+            bads.append(bad)
+        # pass 2: normalize + frames + convolve + compact, on device
+        tv, ti, tb, counts = _resident_pipeline(
+            dev, jnp.asarray(scales), jnp.asarray(masks), kern_pairs,
+            np.float32(self.threshold), dlen, self.fast_detrend,
+            nblk, chunklen, fftlen, overlap,
+            min(self.topk, chunklen), G)
+        tv = np.asarray(tv)
+        ti = np.asarray(ti)
+        tb = np.asarray(tb)
+        counts = np.asarray(counts)      # [nf, F, W]
+        k = min(self.topk, chunklen)
+        W = len(widths)
+        out = []
+        for fi in range(nf):
+            capped = np.minimum(counts[fi], k).sum()
+            if capped > G:
+                # compaction overflow (pathological RFI): host path
+                row = np.asarray(dev[fi])
+                res = self.search_many([row], dt, [dms[fi]],
+                                       [offregions_list[fi]])[0]
+                out.append(res)
+                continue
+            good = tv[fi] > self.threshold
+            chunk = ti[fi][good] // (W * k)
+            wi = (ti[fi][good] // k) % W
+            vals = tv[fi][good]
+            bins = tb[fi][good] + chunk * chunklen
+            cands: List[SPCandidate] = []
+            for c, w in set(zip(chunk.tolist(), wi.tolist())):
+                sel = (chunk == c) & (wi == w)
+                df = widths[w]
+                b = bins[sel]
+                v = vals[sel]
+                order = np.argsort(b)
+                bl, vl = prune_related1([int(x) for x in b[order]],
+                                        [float(x) for x in v[order]],
+                                        df)
+                for bb, vv in zip(bl, vl):
+                    # host path bounds bins by the detrend-truncated
+                    # normed length, not the raw N
+                    if bb < nblk * dlen:
+                        cands.append(SPCandidate(
+                            bin=bb, sigma=vv, time=bb * dt,
+                            downfact=df, dm=dms[fi]))
+            cands.sort()
+            cands = prune_related2(cands, widths)
+            cands = self._post_filter(cands, bads[fi],
+                                      offregions_list[fi])
+            # adjusted stds, matching _finish_normalize's return
+            out.append((cands, 1.0 / scales[fi], bads[fi]))
+        return out
+
     def _post_filter(self, cands, bad, offregions):
         """Bad-block cut + off-region border pruning (shared by the
         single and batched search paths)."""
@@ -430,6 +532,64 @@ class SinglePulseSearch:
         normed, stds, bad = self.normalize(ts)
         cands = self.search_normalized(normed, dt, dm=dm)
         return self._post_filter(cands, bad, offregions), stds, bad
+
+
+@partial(jax.jit, static_argnames=("detrendlen", "fast", "nblk",
+                                   "chunklen", "fftlen", "overlap",
+                                   "k", "G"))
+def _resident_pipeline(series, scales, badmask, kern_pairs, threshold,
+                       detrendlen, fast, nblk, chunklen, fftlen,
+                       overlap, k, G):
+    """Device half of search_many_resident for ONE file batch:
+    series [nf, N] -> per-file compacted hits.
+
+    scales [nf, nblk] (1/std per detrend block, host-computed from the
+    stds pass), badmask [nf, nblk] (0 for bad blocks).  Returns
+    (tv [nf, G], ti [nf, G], tb [nf, G], counts [nf, F, W]):
+    the global top-G above-threshold smoothed samples per file with
+    their flat (chunk, width) encoding and matched-filter bin, plus
+    exact per-(chunk, width) hit counts (capacity/overflow checks).
+    """
+    nf, N = series.shape
+    roundN = nblk * detrendlen
+    blocks = series[:, :roundN].reshape(nf * nblk, detrendlen)
+    resid, _stds = _detrend_blocks(blocks, detrendlen, fast)
+    normed = (resid.reshape(nf, nblk, detrendlen)
+              * (scales * badmask)[:, :, None]).reshape(nf, roundN)
+    F = max(roundN // chunklen, 1)
+    # the host path copies only F*chunklen samples into its padded
+    # buffer (zeros beyond) — zero the tail so the last chunk's right
+    # overlap matches exactly (no-op when one chunk spans everything)
+    keep = min(F * chunklen, roundN)
+    if keep < roundN:
+        normed = jnp.concatenate(
+            [normed[:, :keep],
+             jnp.zeros((nf, roundN - keep), jnp.float32)], axis=1)
+    # overlap-padded frames via two reshapes (no per-chunk slices)
+    P = -(-fftlen // chunklen)
+    pad_hi = (F + P) * chunklen - roundN
+    padded = jnp.pad(normed, ((0, 0), (overlap, overlap + pad_hi)))
+    A = padded[:, :(F + P) * chunklen].reshape(nf, F + P, chunklen)
+    parts = [jax.lax.slice(A, (0, p, 0),
+                           (nf, p + F, min(chunklen, fftlen - p *
+                                           chunklen)))
+             for p in range(P)]
+    frames = jnp.concatenate(parts, axis=2)      # [nf, F, fftlen]
+
+    def per_file(fr):
+        vals, idx, counts = _convolve_topk(fr, kern_pairs, threshold,
+                                           fftlen, overlap, k)
+        flatv = jnp.where(vals > threshold, vals, -1.0).reshape(-1)
+        g = min(G, flatv.shape[0])
+        tv, ti = jax.lax.top_k(flatv, g)
+        tb = jnp.take(idx.reshape(-1), ti)
+        if g < G:
+            tv = jnp.pad(tv, (0, G - g), constant_values=-1.0)
+            ti = jnp.pad(ti, (0, G - g))
+            tb = jnp.pad(tb, (0, G - g))
+        return tv, ti, tb, counts
+
+    return jax.lax.map(per_file, frames)
 
 
 def _collect_chunk_hits(vals_c, idx_c, counts_c, chunknum, widths,

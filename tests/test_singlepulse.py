@@ -170,3 +170,54 @@ def test_search_many_matches_search():
             assert a.bin == b.bin and a.downfact == b.downfact
             assert abs(a.sigma - b.sigma) < 1e-4
         assert any(abs(c.bin - (2000 + 500 * i)) < 10 for c in mcands)
+
+
+def test_search_many_resident_matches_host_path():
+    """The device-resident SP pipeline (series stay in HBM, only
+    stds/scales/compacted hits cross the boundary) must reproduce
+    search_many exactly."""
+    from presto_tpu.search.singlepulse import SinglePulseSearch
+    rng = np.random.default_rng(5)
+    nf, n, dt = 6, 1 << 16, 1e-3
+    series = []
+    for fi in range(nf):
+        x = rng.normal(size=n).astype(np.float32)
+        x[2000 + 137 * fi: 2030 + 137 * fi] += 3.0     # broad pulse
+        x[40000] += 8.0                                # sharp pulse
+        if fi == 2:
+            x[10000:11000] = 50.0                      # bad block
+        series.append(x)
+    sp = SinglePulseSearch(threshold=5.0)
+    dms = list(np.arange(nf, dtype=float))
+    want = sp.search_many(series, dt, dms)
+    got = sp.search_many_resident(np.stack(series), dt, dms)
+    assert len(got) == len(want) == nf
+    for (gc, gs, gb), (wc, ws, wb) in zip(got, want):
+        assert [(c.bin, c.downfact, round(c.sigma, 4)) for c in gc] \
+            == [(c.bin, c.downfact, round(c.sigma, 4)) for c in wc]
+        np.testing.assert_allclose(gs, ws, rtol=1e-5)
+        np.testing.assert_array_equal(gb, wb)
+    assert any(len(c) > 0 for (c, _s, _b) in got)
+
+
+def test_resident_matches_host_at_truncation_edges():
+    """Review repros: (a) the last chunk's right overlap must read
+    ZEROS beyond F*chunklen (host _padded_chunks semantics), (b) bins
+    are bounded by the detrend-truncated length roundN, not raw N."""
+    from presto_tpu.search.singlepulse import SinglePulseSearch
+    sp = SinglePulseSearch(threshold=5.0)
+    rng = np.random.default_rng(9)
+    # (a) pulse straddling the F*chunklen boundary (N=65536 -> F=8)
+    x = rng.normal(size=1 << 16).astype(np.float32)
+    x[63990:64020] += 3.0
+    want = sp.search_many([x], 1e-3, [0.0])[0]
+    got = sp.search_many_resident(x[None], 1e-3, [0.0])[0]
+    assert [(c.bin, c.downfact, round(c.sigma, 4)) for c in got[0]] \
+        == [(c.bin, c.downfact, round(c.sigma, 4)) for c in want[0]]
+    # (b) pulse bleeding past roundN (N=5500 -> roundN=5000)
+    y = rng.normal(size=5500).astype(np.float32)
+    y[4985:5000] += 6.0
+    want = sp.search_many([y], 1e-3, [0.0])[0]
+    got = sp.search_many_resident(y[None], 1e-3, [0.0])[0]
+    assert [(c.bin, c.downfact, round(c.sigma, 4)) for c in got[0]] \
+        == [(c.bin, c.downfact, round(c.sigma, 4)) for c in want[0]]

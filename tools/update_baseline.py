@@ -92,15 +92,17 @@ def render_table(bench, cpu, date=None):
 
 
 # extended bench rows (VERDICT r3 item 4): bench.py emits these as
-# nested dicts {"value":, "unit":, "cpu":, "vs_baseline":} when run
-# with PRESTO_TPU_BENCH_EXTENDED=1
+# nested dicts {"value":, "unit":, "cpu":, "vs_baseline":} by default
+# (PRESTO_TPU_BENCH_EXTENDED=0 skips them).  config3/singlepulse are
+# wall SECONDS (lower is better; ratio = cpu/dev), jerk is cells/s.
 EXTRA_ROWS = (
-    ("config3", "realfft + accelsearch zmax=0 nh=16 2²¹ bins "
-                "(config 3, survey workhorse), device-resident"),
+    ("config3", "accelsearch zmax=0 nh=16 2²¹ bins + batched polish "
+                "(config 3, survey workhorse; seconds, incl. "
+                "refinement), device-resident"),
     ("singlepulse", "single-pulse search 128 DM × 2²⁰ (config 5 SP "
-                    "stage), device-resident"),
-    ("jerk", "jerk search zmax=100 wmax=300 2²⁰ bins (diagnostic), "
-             "device-resident"),
+                    "stage; seconds), device-resident series"),
+    ("jerk", "jerk search zmax=100 wmax=300 nh=4 2²⁰ bins "
+             "(diagnostic), device-resident"),
 )
 
 
