@@ -200,9 +200,23 @@ def main():
     try:
         with open(path) as f:
             old = json.load(f)
+    except FileNotFoundError:
+        old = None
+    except json.JSONDecodeError as e:
+        print("# previous cpu_baseline.json unreadable (%s) — NOT "
+              "merging; the conservative-best policy restarts from "
+              "this run" % e, file=sys.stderr)
+        old = None
+    if old is not None:
         ow = old.get("workload") or {}
         shared = [k2 for k2 in WORKLOAD if k2 in ow]
-        if shared and all(ow[k2] == WORKLOAD[k2] for k2 in shared):
+        same_env = all(old.get(k2) == out[k2]
+                       for k2 in ("nproc", "numpy", "scipy"))
+        if not same_env:
+            print("# environment changed vs previous baseline — NOT "
+                  "merging (provenance would misattribute old "
+                  "timings)", file=sys.stderr)
+        elif shared and all(ow[k2] == WORKLOAD[k2] for k2 in shared):
             for secs_key, riders in GROUPS:
                 if old.get(secs_key, float("inf")) < out[secs_key]:
                     out[secs_key] = old[secs_key]
@@ -211,8 +225,6 @@ def main():
                             out[rk] = old[rk]
             print("# merged with previous baseline (per-group best; "
                   "host CPU varies run-to-run)", file=sys.stderr)
-    except Exception:
-        pass
     with open(path, "w") as f:
         json.dump(out, f, indent=1)
     print(json.dumps(out))

@@ -98,14 +98,21 @@ def build_plane_ref(search: AccelSearch, spectrum: np.ndarray,
     if not starts:
         return np.zeros((kern.numz, 0), dtype=dtype), 0
     numdata = kern.fftlen // 2
-    offset = kern.halfwidth * ACCEL_NUMBETWEEN
+    # the search's EFFECTIVE halfwidth: the direct-plane TPU builder
+    # pads the window offset to a 128-column boundary, shifting every
+    # block's read window and normalization window with it — the
+    # referee must use the same geometry to produce the same list
+    # (on CPU hw_use == kern.halfwidth and nothing changes)
+    g = search._plane_geom()
+    hw_use = g.hw_use if g else kern.halfwidth
+    offset = hw_use * ACCEL_NUMBETWEEN
     col0 = int(starts[0]) * ACCEL_RDR
     plane_cols = col0 + len(starts) * cfg.uselen
     plane = np.zeros((kern.numz, plane_cols), dtype=dtype)
     spec = np.asarray(spectrum, dtype=cdtype)
     nbins = spec.shape[0]
     for j, s0 in enumerate(starts):
-        lobin = int(s0) - kern.halfwidth
+        lobin = int(s0) - hw_use
         win = np.zeros(numdata, dtype=cdtype)
         lo, hi = max(lobin, 0), min(lobin + numdata, nbins)
         win[lo - lobin:hi - lobin] = spec[lo:hi]
