@@ -226,6 +226,18 @@ def bench_accel3():
     return best, warm, len(cands)
 
 
+def make_sp_series():
+    """The SP-bench series BOTH bench scripts must search (shared so
+    the CPU/TPU twins cannot drift; part of the workload contract)."""
+    nf, n = WORKLOAD["sp_nseries"], WORKLOAD["sp_nsamples"]
+    rng = np.random.default_rng(7)
+    series = [rng.normal(size=n).astype(np.float32) for _ in range(nf)]
+    for s in series[::8]:           # sprinkle single pulses
+        for pos in (12345, 500000):
+            s[pos:pos + 30] += 4.0
+    return series
+
+
 def bench_singlepulse():
     """Config 5's SP stage: the device-resident batched matched
     filter over a 128-trial x 2^20-sample DM fan-out
@@ -236,12 +248,8 @@ def bench_singlepulse():
     import jax.numpy as jnp
     from presto_tpu.search.singlepulse import SinglePulseSearch
 
-    nf, n = WORKLOAD["sp_nseries"], WORKLOAD["sp_nsamples"]
-    rng = np.random.default_rng(7)
-    series = [rng.normal(size=n).astype(np.float32) for _ in range(nf)]
-    for s in series[::8]:           # sprinkle single pulses
-        for pos in (12345, 500000):
-            s[pos:pos + 30] += 4.0
+    nf = WORKLOAD["sp_nseries"]
+    series = make_sp_series()
     batch = jnp.asarray(np.stack(series))     # resident (one upload)
     float(batch.sum())
     sp = SinglePulseSearch(threshold=WORKLOAD["sp_threshold"])

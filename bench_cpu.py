@@ -127,15 +127,12 @@ def bench_accel3_cpu():
 
 def bench_sp_cpu():
     """Config-5 SP-stage CPU twin: the identical batched matched
-    filter (search_many) on the CPU backend, all cores."""
+    filter (search_many) on the CPU backend, all cores, over the
+    SHARED series (bench.make_sp_series — twins cannot drift)."""
+    from bench import make_sp_series
     from presto_tpu.search.singlepulse import SinglePulseSearch
-    nf, n = WORKLOAD["sp_nseries"], WORKLOAD["sp_nsamples"]
-    rng = np.random.default_rng(7)
-    series = [rng.normal(size=n).astype(np.float32)
-              for _ in range(nf)]
-    for s in series[::8]:
-        for pos in (12345, 500000):
-            s[pos:pos + 30] += 4.0
+    nf = WORKLOAD["sp_nseries"]
+    series = make_sp_series()
     sp = SinglePulseSearch(threshold=WORKLOAD["sp_threshold"])
     t0 = time.perf_counter()
     res = sp.search_many(series, dt=8.192e-5,

@@ -201,6 +201,10 @@ def gen_w_response_bank(roffset: float, numbetween: int,
     u = (np.arange(npts, dtype=np.float64) + 0.5) / npts
     ckey = (numkern, numbetween, round(roffset, 12), npts)
     expmat = _WBANK_EXPMAT.get(ckey)
+    if expmat is not None:
+        # LRU refresh (plain-FIFO eviction would drop the hottest
+        # grid first when two grids alternate under budget pressure)
+        _WBANK_EXPMAT[ckey] = _WBANK_EXPMAT.pop(ckey)
     if expmat is None:
         i = np.arange(numkern, dtype=np.float64)
         nu = i / numbetween - numkern / (2.0 * numbetween) - roffset

@@ -154,8 +154,14 @@ def sharded_accel_search_many(searcher, pairs_batch, mesh: Mesh,
         # jerk searches keep the per-w plane-cache loop (no sharded
         # variant yet) — same results, device-serial
         return searcher.search_many(pairs_batch, slab=slab)
-    batch = np.ascontiguousarray(np.asarray(pairs_batch, np.float32))
-    nd = batch.shape[0]
+    if isinstance(pairs_batch, jax.Array):
+        batch = pairs_batch          # device-resident: never round-
+        if batch.dtype != jnp.float32:    # trip through the host
+            batch = batch.astype(jnp.float32)
+    else:
+        batch = np.ascontiguousarray(np.asarray(pairs_batch,
+                                                np.float32))
+    nd = int(batch.shape[0])
     if nd == 0:
         return []
     n = int(np.prod([mesh.shape[a] for a in mesh.axis_names]))
@@ -173,7 +179,8 @@ def sharded_accel_search_many(searcher, pairs_batch, mesh: Mesh,
     # last spectrum; their results are dropped)
     pad = (-nd) % n
     if pad:
-        batch = np.concatenate([batch] + [batch[-1:]] * pad)
+        xp = jnp if isinstance(batch, jax.Array) else np
+        batch = xp.concatenate([batch] + [batch[-1:]] * pad)
     scols = jnp.asarray(np.asarray(start_cols, np.int32))
 
     # cache the compiled program on the searcher (jax.jit caches on

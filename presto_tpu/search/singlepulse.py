@@ -437,12 +437,13 @@ class SinglePulseSearch:
         nblk = N // dlen
         widths, chunklen, fftlen, overlap, kern_pairs = \
             self._chunk_geometry(widths=[1] + list(self.downfacts_for(dt)))
-        # pass 1: stds only (device detrend, tiny D2H)
+        # pass 1: detrend once; residuals stay RESIDENT for pass 2,
+        # only the tiny stds cross to the host
         roundN = nblk * dlen
-        _, stds_all = _detrend_blocks(
+        resid, stds_dev = _detrend_blocks(
             dev[:, :roundN].reshape(nf * nblk, dlen), dlen,
             self.fast_detrend)
-        stds_all = np.asarray(stds_all).reshape(nf, nblk)
+        stds_all = np.asarray(stds_dev).reshape(nf, nblk)
         scales = np.empty((nf, nblk), np.float32)
         masks = np.ones((nf, nblk), np.float32)
         bads = []
@@ -463,8 +464,8 @@ class SinglePulseSearch:
             bads.append(bad)
         # pass 2: normalize + frames + convolve + compact, on device
         tv, ti, tb, counts = _resident_pipeline(
-            dev, jnp.asarray(scales), jnp.asarray(masks), kern_pairs,
-            np.float32(self.threshold), dlen, self.fast_detrend,
+            resid, jnp.asarray(scales), jnp.asarray(masks), kern_pairs,
+            np.float32(self.threshold), dlen,
             nblk, chunklen, fftlen, overlap,
             min(self.topk, chunklen), G)
         tv = np.asarray(tv)
@@ -534,14 +535,15 @@ class SinglePulseSearch:
         return self._post_filter(cands, bad, offregions), stds, bad
 
 
-@partial(jax.jit, static_argnames=("detrendlen", "fast", "nblk",
-                                   "chunklen", "fftlen", "overlap",
-                                   "k", "G"))
-def _resident_pipeline(series, scales, badmask, kern_pairs, threshold,
-                       detrendlen, fast, nblk, chunklen, fftlen,
+@partial(jax.jit, static_argnames=("detrendlen", "nblk", "chunklen",
+                                   "fftlen", "overlap", "k", "G"))
+def _resident_pipeline(resid, scales, badmask, kern_pairs, threshold,
+                       detrendlen, nblk, chunklen, fftlen,
                        overlap, k, G):
     """Device half of search_many_resident for ONE file batch:
-    series [nf, N] -> per-file compacted hits.
+    detrend RESIDUALS [nf*nblk, detrendlen] (kept resident from the
+    stds pass — re-detrending would double the sort-heavy device
+    work) -> per-file compacted hits.
 
     scales [nf, nblk] (1/std per detrend block, host-computed from the
     stds pass), badmask [nf, nblk] (0 for bad blocks).  Returns
@@ -550,10 +552,8 @@ def _resident_pipeline(series, scales, badmask, kern_pairs, threshold,
     their flat (chunk, width) encoding and matched-filter bin, plus
     exact per-(chunk, width) hit counts (capacity/overflow checks).
     """
-    nf, N = series.shape
+    nf = scales.shape[0]
     roundN = nblk * detrendlen
-    blocks = series[:, :roundN].reshape(nf * nblk, detrendlen)
-    resid, _stds = _detrend_blocks(blocks, detrendlen, fast)
     normed = (resid.reshape(nf, nblk, detrendlen)
               * (scales * badmask)[:, :, None]).reshape(nf, roundN)
     F = max(roundN // chunklen, 1)

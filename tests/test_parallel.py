@@ -81,6 +81,11 @@ def test_sharded_accel_search_matches_single(mesh):
     got = sharded.sharded_accel_search_many(s, batch, mesh)
     mesh1 = make_mesh(1, ("dm",))
     want = sharded.sharded_accel_search_many(s, batch, mesh1)
+    # device-resident input path (no host round-trip) matches too
+    got_dev = sharded.sharded_accel_search_many(
+        s, jnp.asarray(batch), mesh)
+    assert [(c.numharm, c.r, c.z) for cl in got_dev for c in cl] == \
+           [(c.numharm, c.r, c.z) for cl in got for c in cl]
     assert len(got) == len(want) == nd
     for a, b in zip(got, want):
         assert [(c.numharm, c.r, c.z, c.power) for c in a] == \
