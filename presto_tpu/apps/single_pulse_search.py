@@ -92,15 +92,20 @@ def run(args) -> list:
         per = max(1, int(2 ** 30 // max(n * 4, 1)))
         for g0 in range(0, len(items), per):
             chunk = items[g0:g0 + per]
-            series = []
-            for _, base, nuse, _, _ in chunk:
+            # same-length group: load straight into one [nf, n] array
+            # (no list-of-rows copy) for the device-resident pipeline
+            # — one upload per group; only stds/scales/compacted hits
+            # cross the link (exact parity with search_many is
+            # test-pinned)
+            batch = np.empty((len(chunk), n), np.float32)
+            for ri, (_, base, nuse, _, _) in enumerate(chunk):
                 ts, _ = load_timeseries(base + ".dat")
-                series.append(np.asarray(ts[:nuse], np.float32))
-            results = sp.search_many(
-                series, dt,
+                batch[ri] = np.asarray(ts[:nuse], np.float32)
+            results = sp.search_many_resident(
+                batch, dt,
                 dms=[it[3].dm for it in chunk],
                 offregions_list=[it[4] for it in chunk])
-            del series
+            del batch
             for (fn, base, _, info, _), (cands, stds, bad) in \
                     zip(chunk, results):
                 cands = [c for c in cands
