@@ -165,8 +165,10 @@ def refine_and_write(raw_cands, amps, T, searcher, base, zmax,
     # device dispatches; per-candidate scipy only as exception/jerk
     # fallback (PRESTO_TPU_POLISH=scipy forces the reference loop)
     ocs = [None] * len(cands)
-    if os.environ.get("PRESTO_TPU_POLISH", "batch") != "scipy" \
-            and cands:
+    jocs = [None] * len(cands)
+    use_batch = (os.environ.get("PRESTO_TPU_POLISH", "batch")
+                 != "scipy")
+    if use_batch and cands:
         try:
             from presto_tpu.search.polish import optimize_accelcands
             ocs = optimize_accelcands(amps, cands, T,
@@ -177,8 +179,27 @@ def refine_and_write(raw_cands, amps, T, searcher, base, zmax,
             print("accelsearch: batched polish failed (%s); "
                   "using the per-candidate path" % (e,))
             ocs = [None] * len(cands)
+    if use_batch and cands and wmax and all(o is not None
+                                            for o in ocs):
+        # batched (r, z, w) jerk polish seeded from the z-polish (the
+        # per-candidate max_rzw_arr path rebuilds a w-response
+        # quadrature per power evaluation: minutes per candidate)
+        try:
+            from presto_tpu.search.accel import AccelCand
+            from presto_tpu.search.polish import optimize_jerk_cands
+            seeds = [AccelCand(power=o.power, sigma=o.sigma,
+                               numharm=o.numharm, r=o.r, z=o.z,
+                               w=c.w)
+                     for c, o in zip(cands, ocs)]
+            jocs = optimize_jerk_cands(amps, seeds, T,
+                                       searcher.numindep,
+                                       harmpolish=harmpolish)
+        except Exception as e:
+            print("accelsearch: batched jerk polish failed (%s); "
+                  "using the per-candidate path" % (e,))
+            jocs = [None] * len(cands)
     refined = []
-    for c, oc in zip(cands, ocs):
+    for c, oc, joc in zip(cands, ocs, jocs):
         try:
             if oc is None:
                 oc = optimize_accelcand(amps, c, T, searcher.numindep,
@@ -186,24 +207,27 @@ def refine_and_write(raw_cands, amps, T, searcher, base, zmax,
             c.r, c.z = oc.r, oc.z
             c.power, c.sigma = oc.power, oc.sigma
             if wmax:
-                from presto_tpu.search.optimize import (
-                    get_localpower, max_rzw_arr, power_at_rzw)
-                r, z, w, _ = max_rzw_arr(amps, c.r, c.z, c.w)
-                accepted = False
-                if abs(w) <= wmax:
+                if joc is not None:
+                    r, z, w, tot = joc.r, joc.z, joc.w, joc.power
+                    sig = joc.sigma
+                else:
+                    from presto_tpu.search.optimize import (
+                        get_localpower, max_rzw_arr, power_at_rzw)
+                    r, z, w, _ = max_rzw_arr(amps, c.r, c.z, c.w)
                     nh = c.numharm
                     tot = sum(
                         power_at_rzw(amps, r * h, z * h, w * h)
                         / get_localpower(amps, r * h, z * h)
-                        for h in range(1, nh + 1))
-                    if tot > c.power:
-                        stage = int(np.log2(nh))
-                        c.r, c.z, c.w = r, z, float(w)
-                        c.power = float(tot)
-                        c.sigma = float(st.candidate_sigma(
-                            tot, nh, searcher.numindep[stage]))
-                        accepted = True
-                if not accepted:
+                        for h in range(1, nh + 1)) \
+                        if abs(w) <= wmax else 0.0
+                    sig = float(st.candidate_sigma(
+                        tot, nh, searcher.numindep[
+                            int(np.log2(nh))])) if tot else 0.0
+                if abs(w) <= wmax and tot > c.power:
+                    c.r, c.z, c.w = float(r), float(z), float(w)
+                    c.power = float(tot)
+                    c.sigma = float(sig)
+                else:
                     c.w = 0.0
         except Exception as e:
             print("accelsearch: refinement failed for r=%.1f (%s); "

@@ -368,6 +368,7 @@ class OptimizedCand:
     numharm: int
     hpows: List[float] = field(default_factory=list)
     props: List[FourierProps] = field(default_factory=list)
+    w: float = 0.0          # jerk refinement result (0 = no w search)
 
     def freq(self, T: float) -> float:
         return self.r / T

@@ -68,8 +68,10 @@ from presto_tpu.search.optimize import (FourierProps, OptimizedCand,
                                         optimize_accelcand)
 
 GRID_G = 3              # grid half-extent: (2G+1)^2 = 49 points/stage
+GRID_GW = 2             # jerk descent: (2G+1)^2*(2GW+1) = 245/stage
 N_STAGES = 5            # stage s step = step0 / 3^s
 SHRINK = 3.0
+STEP0_W = 5.0           # w step (fund bins; seed error <= ACCEL_DW/2)
 # stage-0 steps in FUNDAMENTAL bins (scaled 1/numharm per candidate):
 # the search grid quantizes r to 0.5/nh and z to 2/nh, so the true
 # peak lies within (0.25, 1.0)/nh of the seed; G*step0 must cover it
@@ -105,33 +107,45 @@ def _windows_to_wmat(amp_pairs, rints, W, npts):
                       precision=jax.lax.Precision.HIGHEST)  # [P, npts]
 
 
-def _eval_A(wmat, fr, zh):
-    """A at (fr, z) per pair and grid point: wmat [P, npts] complex,
-    fr/zh [P, G] -> [P, G] complex64 (chirp multiply + mean)."""
+def _eval_A(wmat, fr, zh, wh=None):
+    """A at (fr, z[, w]) per pair and grid point: wmat [P, npts]
+    complex, fr/zh[/wh] [P, G] -> [P, G] complex64 (chirp multiply +
+    mean).  The w term is the jerk phase w*(u^3/6 - u^2/4 + u/12) —
+    the time-domain twin of gen_w_response's cubic phase model
+    (validated against ops/responses to the same window-truncation
+    tolerance as the z term)."""
     npts = wmat.shape[-1]
     u = (jnp.arange(npts, dtype=jnp.float32) + 0.5) / npts
     cu = 0.5 * (u * u - u)
-    ph = jnp.exp(-2j * jnp.pi * (fr[..., None] * u
-                                 + zh[..., None] * cu))
+    phase = fr[..., None] * u + zh[..., None] * cu
+    if wh is not None:
+        p3 = u * u * u / 6.0 - u * u / 4.0 + u / 12.0
+        phase = phase + wh[..., None] * p3
+    ph = jnp.exp(-2j * jnp.pi * phase)
     return jnp.mean(wmat[:, None, :] * ph, axis=-1)
 
 
-def _eval_A_chunked(wmat, fr, zh):
+def _eval_A_chunked(wmat, fr, zh, wh=None):
     """_eval_A with the pair axis chunked through lax.map (bounds the
     [P, G, npts] phase intermediate)."""
     P = wmat.shape[0]
     if P <= PAIR_CHUNK:
-        return _eval_A(wmat, fr, zh)
+        return _eval_A(wmat, fr, zh, wh)
     pad = _round_up(P, PAIR_CHUNK) - P
-    wm = jnp.pad(wmat, ((0, pad), (0, 0)))
-    frp = jnp.pad(fr, ((0, pad), (0, 0)))
-    zhp = jnp.pad(zh, ((0, pad), (0, 0)))
     nch = (P + pad) // PAIR_CHUNK
-    out = jax.lax.map(
-        lambda args: _eval_A(*args),
-        (wm.reshape(nch, PAIR_CHUNK, -1),
-         frp.reshape(nch, PAIR_CHUNK, -1),
-         zhp.reshape(nch, PAIR_CHUNK, -1)))
+
+    def prep(a):
+        return jnp.pad(a, ((0, pad), (0, 0))).reshape(
+            nch, PAIR_CHUNK, -1)
+
+    if wh is None:
+        out = jax.lax.map(
+            lambda args: _eval_A(*args),
+            (prep(wmat), prep(fr), prep(zh)))
+    else:
+        out = jax.lax.map(
+            lambda args: _eval_A(*args),
+            (prep(wmat), prep(fr), prep(zh), prep(wh)))
     return out.reshape(nch * PAIR_CHUNK, -1)[:P]
 
 
@@ -236,6 +250,8 @@ def optimize_accelcands(amps: np.ndarray, cands, T: float,
     device [n, 2] float32 pairs array (the survey's resident spectra).
     Returns OptimizedCand per input candidate, in input order; scipy
     fallback per candidate where the grid descent flags a boundary.
+    (optimize_jerk_cands mirrors this driver with a w dimension —
+    keep shared-logic fixes in sync.)
     """
     if not cands:
         return []
@@ -384,3 +400,195 @@ def optimize_accelcands(amps: np.ndarray, cands, T: float,
             sigma=float(sig[i]), numharm=int(nh[i]),
             hpows=list(hpow[sl]), props=props)
     return out
+
+
+# ----------------------------------------------------------------------
+# Jerk (r, z, w) polish
+# ----------------------------------------------------------------------
+
+
+@jax.jit
+def _eval_A_rzw_pairs(wmat, fr, zh, wh):
+    """Jitted (re, im)-pair boundary around _eval_A_chunked for the
+    eager final-measure call: standalone eager complex ops fail to
+    compile on the axon backend (complex must stay INSIDE jit)."""
+    A = _eval_A_chunked(wmat, fr, zh, wh)
+    return jnp.stack([A.real, A.imag], -1)
+
+
+@partial(jax.jit, static_argnames=("ncand",))
+def _refine_stages_rzw(wmat, cand_of, hh, frac0, zseed, wseed, inv_lp,
+                       obj_w, step0_r, step0_z, step0_w, ncand):
+    """3-D twin of _refine_stages: coarse-to-fine (r, z, w) grid
+    descent in offset space.  The w seed is the jerk plane of origin
+    (ACCEL_DW grid), so the stage-0 w radius only needs to cover half
+    a plane step."""
+    G, GW = GRID_G, GRID_GW
+    g1 = jnp.arange(-G, G + 1, dtype=jnp.float32)
+    gw = jnp.arange(-GW, GW + 1, dtype=jnp.float32)
+    n2d = (2 * G + 1) ** 2
+    gi = jnp.tile(jnp.repeat(g1, 2 * G + 1), 2 * GW + 1)
+    gj = jnp.tile(jnp.tile(g1, 2 * G + 1), 2 * GW + 1)
+    gk = jnp.repeat(gw, n2d)
+
+    def stage_argmax(dr, dz, dw, sr, sz, sw):
+        rs = dr[:, None] + sr[:, None] * gi[None]
+        zs = dz[:, None] + sz[:, None] * gj[None]
+        ws = dw[:, None] + sw[:, None] * gk[None]
+        frp = frac0[:, None] + rs[cand_of] * hh[:, None]
+        zhp = (zseed[cand_of][:, None] + zs[cand_of]) * hh[:, None]
+        whp = (wseed[cand_of][:, None] + ws[cand_of]) * hh[:, None]
+        A = _eval_A_chunked(wmat, frp, zhp, whp)
+        P2 = (A.real ** 2 + A.imag ** 2) * (inv_lp * obj_w)[:, None]
+        obj = jax.ops.segment_sum(P2, cand_of, num_segments=ncand)
+        best = jnp.argmax(obj, axis=-1)
+        ar = jnp.arange(ncand)
+        return rs[ar, best], zs[ar, best], ws[ar, best]
+
+    dr = jnp.zeros(ncand, jnp.float32)
+    dz = jnp.zeros(ncand, jnp.float32)
+    dw = jnp.zeros(ncand, jnp.float32)
+    for _ in range(2):                       # stage-0 re-center walk
+        dr, dz, dw = stage_argmax(dr, dz, dw, step0_r, step0_z,
+                                  step0_w)
+    for s in range(1, N_STAGES):
+        dr, dz, dw = stage_argmax(
+            dr, dz, dw, step0_r / (SHRINK ** s),
+            step0_z / (SHRINK ** s), step0_w / (SHRINK ** s))
+    return dr, dz, dw
+
+
+def optimize_jerk_cands(amps, cands, T: float,
+                        numindep: Sequence[float],
+                        harmpolish: bool = True
+                        ) -> List[OptimizedCand]:
+    """Batched (r, z, w) refinement for jerk-search candidates — the
+    device twin of the max_rzw_arr per-candidate simplex, whose every
+    power evaluation rebuilds a w-response quadrature (~0.2-0.5 s per
+    EVALUATION on host: minutes per candidate).  Seeds come from the
+    search (w = the jerk plane of origin, fundamental-scaled);
+    per-harmonic local powers follow the scipy acceptance convention
+    (measured at w=0, refine_and_write's jerk branch).  Returns
+    OptimizedCand per input, in order, with .w set.
+
+    MAINTENANCE NOTE: the host driver below (pairs conversion, pair
+    expansion, bucket padding, sigma loop) intentionally mirrors
+    optimize_accelcands' — a fix to the shared logic there (padding
+    collisions, locpow convention, float64 offset bookkeeping) must
+    be applied HERE too."""
+    if not cands:
+        return []
+    if isinstance(amps, jax.Array):
+        amp_pairs = amps
+    else:
+        amps = np.asarray(amps)
+        if amps.dtype.kind == "c":
+            amp_pairs = np.stack([amps.real, amps.imag],
+                                 -1).astype(np.float32)
+        else:
+            amp_pairs = np.asarray(amps, np.float32)
+        amp_pairs = jnp.asarray(amp_pairs)
+
+    nc = len(cands)
+    nh = np.asarray([c.numharm for c in cands], np.int32)
+    seed_r = np.asarray([c.r for c in cands], np.float64)
+    seed_z = np.asarray([c.z for c in cands], np.float64)
+    seed_w = np.asarray([getattr(c, "w", 0.0) for c in cands],
+                        np.float64)
+    cand_of = np.repeat(np.arange(nc, dtype=np.int32), nh)
+    hh = np.concatenate([np.arange(1, n + 1) for n in nh]
+                        ).astype(np.float32)
+    rint = np.floor(seed_r[cand_of] * hh).astype(np.int32)
+    P = cand_of.shape[0]
+    step0_r = (STEP0_R / nh).astype(np.float32)
+    step0_z = (STEP0_Z / nh).astype(np.float32)
+    step0_w = (STEP0_W / nh).astype(np.float32)
+
+    # window geometry must cover the widest (z, w) kernel in the batch
+    zmax_b = float(np.abs(seed_z[cand_of] * hh).max()
+                   + STEP0_Z * GRID_G + 1.0)
+    wmax_b = float(np.abs(seed_w[cand_of] * hh).max()
+                   + STEP0_W * GRID_GW + 1.0)
+    hw = resp.w_resp_halfwidth(zmax_b, wmax_b, resp.HIGHACC)
+    W = _round_up(2 * hw + 2 * (resp.DELTAAVGBINS
+                                + resp.NUMLOCPOWAVG // 2) + 16, 128)
+    need = W // 2 + zmax_b / 2 + wmax_b / 12.0 + 2
+    npts = 128
+    while npts < 2 * need:
+        npts *= 2
+
+    Pp = max(64, 1 << int(np.ceil(np.log2(P))))
+    ncp = max(32, 1 << int(np.ceil(np.log2(nc))))
+    pad_p, pad_c = Pp - P, ncp - nc
+
+    def padp(a, fill=0):
+        return np.concatenate([a, np.full((pad_p,) + a.shape[1:],
+                                          fill, a.dtype)]) \
+            if pad_p else a
+
+    def padc(a, fill=0):
+        return np.concatenate([a, np.full((pad_c,) + a.shape[1:],
+                                          fill, a.dtype)]) \
+            if pad_c else a
+
+    cand_ofp = padp(cand_of, nc)
+    cand_ofp = np.where(cand_ofp >= ncp, ncp - 1, cand_ofp)
+    hhp, rintp = padp(hh, 1.0), padp(rint, 0)
+    frac0 = (seed_r[cand_of] * hh.astype(np.float64)
+             - rint).astype(np.float32)
+    frac0p = padp(frac0, 0.5)
+    seed_zp = padc(seed_z.astype(np.float32), 0.0)
+    seed_wp = padc(seed_w.astype(np.float32), 0.0)
+    s0rp = padc(step0_r, STEP0_R)
+    s0zp = padc(step0_z, STEP0_Z)
+    s0wp = padc(step0_w, STEP0_W)
+
+    wmat = _windows_to_wmat(amp_pairs, jnp.asarray(rintp), W, npts)
+    # locpow at the seed, w=0 (the jerk acceptance convention)
+    _, lp0 = _final_measures(
+        wmat, jnp.asarray(frac0p),
+        jnp.asarray(seed_zp[cand_ofp] * hhp))
+    obj_w = padp(np.ones(P, np.float32)) if harmpolish else \
+        padp((hh == 1.0).astype(np.float32))
+
+    drc, dzc, dwc = _refine_stages_rzw(
+        wmat, jnp.asarray(cand_ofp), jnp.asarray(hhp),
+        jnp.asarray(frac0p), jnp.asarray(seed_zp),
+        jnp.asarray(seed_wp), 1.0 / lp0, jnp.asarray(obj_w),
+        jnp.asarray(s0rp), jnp.asarray(s0zp), jnp.asarray(s0wp), ncp)
+
+    rr = seed_r + np.asarray(drc, np.float64)[:nc]
+    zz = seed_z + np.asarray(dzc, np.float64)[:nc]
+    ww = seed_w + np.asarray(dwc, np.float64)[:nc]
+
+    # raw powers at the refined (r, z, w); locpow at (r, z), w=0
+    rrp = np.concatenate([rr, np.full(pad_c, 8.0)]) if pad_c else rr
+    zzp = np.concatenate([zz, np.zeros(pad_c)]) if pad_c else zz
+    wwp = np.concatenate([ww, np.zeros(pad_c)]) if pad_c else ww
+    frf = jnp.asarray((rrp[cand_ofp] * hhp.astype(np.float64)
+                       - rintp).astype(np.float32))
+    zhf = jnp.asarray((zzp[cand_ofp] * hhp).astype(np.float32))
+    whf = jnp.asarray((wwp[cand_ofp] * hhp).astype(np.float32))
+    Afp = np.asarray(_eval_A_rzw_pairs(
+        wmat, frf[:, None], zhf[:, None], whf[:, None]))
+    rawp = (Afp[..., 0] ** 2 + Afp[..., 1] ** 2)[:P, 0].astype(
+        np.float64)
+    _, lpf = _final_measures(wmat, frf, zhf)
+    lpf = np.asarray(lpf, np.float64)[:P]
+    hpow = rawp / lpf
+
+    tot = np.zeros(nc)
+    np.add.at(tot, cand_of, hpow)
+    stages = np.log2(nh).astype(int)
+    sig = np.empty(nc, np.float64)
+    for s_ in np.unique(stages):
+        m = stages == s_
+        sig[m] = np.atleast_1d(st.candidate_sigma(
+            tot[m], 1 << int(s_), numindep[int(s_)]))
+
+    pair_lo = np.concatenate([[0], np.cumsum(nh)])
+    return [OptimizedCand(
+        r=float(rr[i]), z=float(zz[i]), power=float(tot[i]),
+        sigma=float(sig[i]), numharm=int(nh[i]),
+        hpows=list(hpow[pair_lo[i]:pair_lo[i + 1]]), w=float(ww[i]))
+        for i in range(nc)]

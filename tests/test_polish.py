@@ -157,3 +157,54 @@ def test_large_r_precision():
     out2 = optimize_accelcands(big, [cand2], T_OBS, [n])
     assert abs(out2[0].r - r0) < 0.01
     assert abs(out2[0].z - z0) < 0.2
+
+
+def test_jerk_polish_recovers_rzw():
+    """optimize_jerk_cands refines (r, z, w) to the injected values —
+    the batched twin of max_rzw_arr (whose every power evaluation
+    rebuilds a w-response quadrature)."""
+    from presto_tpu.search.polish import optimize_jerk_cands
+    from presto_tpu.search.accel import AccelCand
+    from presto_tpu.search.optimize import max_rzw_arr
+    rng = np.random.default_rng(4)
+    n = 1 << 15
+    u = (np.arange(1 << 16) + 0.5) / (1 << 16)
+    X = (rng.normal(size=n) + 1j * rng.normal(size=n)) * 0.5
+    cands = []
+    # nh=1 seeds off by ~DW/2 fund bins; the nh=2 case pins the
+    # candidate-frame w quantization (plane w / numharm: seeds err
+    # <= DW/(2 nh)) against the descent's 1/nh step scaling
+    truths = [(4000.3, 30.0, 120.0, 1, 8.0),
+              (9000.7, -20.0, -160.0, 1, -8.0),
+              (14000.4, 10.0, 60.0, 2, 4.0)]
+    for (r0, z0, w0, nh_c, werr) in truths:
+        # inject the cubic-phase response around its bin
+        d = np.arange(-200, 200)
+        rint = int(np.floor(r0))
+        ph = np.exp(2j * np.pi * (
+            -(d[:, None] + rint - r0) * u
+            + 0.5 * z0 * (u * u - u)
+            + w0 * (u ** 3 / 6 - u ** 2 / 4 + u / 12)))
+        X[d + rint] += 40 * ph.mean(axis=1)
+        if nh_c == 2:   # second harmonic at (2r, 2z, 2w)
+            rint2 = int(np.floor(2 * r0))
+            ph2 = np.exp(2j * np.pi * (
+                -(d[:, None] + rint2 - 2 * r0) * u
+                + 0.5 * 2 * z0 * (u * u - u)
+                + 2 * w0 * (u ** 3 / 6 - u ** 2 / 4 + u / 12)))
+            X[d + rint2] += 25 * ph2.mean(axis=1)
+        # seed at the search grid's quantization error
+        cands.append(AccelCand(
+            power=900.0, sigma=20.0, numharm=nh_c,
+            r=r0 + 0.2 / nh_c, z=z0 + 0.9 / nh_c, w=w0 + werr))
+    out = optimize_jerk_cands(X.astype(np.complex64), cands, 500.0,
+                              [n, n / 2, n / 4])
+    for (r0, z0, w0, nh_c, werr), oc in zip(truths, out):
+        assert abs(oc.r - r0) < 0.05, (oc.r, r0)
+        assert abs(oc.z - z0) < 0.5, (oc.z, z0)
+        assert abs(oc.w - w0) < 4.0, (oc.w, w0)
+    # agrees with the scipy simplex on the first candidate
+    r_s, z_s, w_s, p_s = max_rzw_arr(X, cands[0].r, cands[0].z,
+                                     cands[0].w)
+    assert abs(out[0].r - r_s) < 0.05
+    assert abs(out[0].w - w_s) < 5.0
