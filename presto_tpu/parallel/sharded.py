@@ -203,11 +203,5 @@ def sharded_accel_search_many(searcher, pairs_batch, mesh: Mesh,
     packed = np.asarray(fn(jnp.asarray(batch), kern_dev, scols))
     from presto_tpu.search.accel import _unpack_scan
     vals, cidx, zrow = _unpack_scan(packed)
-    out = []
-    for d in range(nd):
-        cands = []
-        for si, start in enumerate(start_cols):
-            searcher._collect_slab(vals[d][si], cidx[d][si],
-                                   zrow[d][si], start, cands)
-        out.append(searcher._dedup_sort(cands))
-    return out
+    return [searcher._dedup_sort(searcher._collect_group(
+        vals[d], cidx[d], zrow[d], start_cols)) for d in range(nd)]

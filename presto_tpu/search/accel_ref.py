@@ -133,6 +133,42 @@ def build_plane_ref(search: AccelSearch, spectrum: np.ndarray,
     return plane, col0
 
 
+def _accum_stages(search: AccelSearch, plane: np.ndarray):
+    """Yield (stage, acc[numz, top-r0]) after each stage's subharmonic
+    adds — the ONE accumulation loop both the referee search
+    (search_plane_ref) and the cell-power probe (ref_cell_powers)
+    consume, so they cannot desynchronize.  acc is accumulated in
+    place: consumers must not mutate it."""
+    cfg = search.cfg
+    numz, plane_cols = plane.shape
+    r0 = int(search.rlo) * ACCEL_RDR
+    top = min(int(search.rhi) * ACCEL_RDR, plane_cols)
+    if top <= r0:
+        return
+    acc = plane[:, r0:top].copy()
+    fz = _harm_fracs_and_zinds(cfg, numz)
+    yield 0, acc
+    cols = np.arange(r0, top, dtype=np.int64)
+    for stage in range(1, cfg.numharmstages):
+        for (harm, htot, zinds) in fz[stage - 1]:
+            # exact round-half-up of cols*harm/htot (overflow-safe),
+            # as ONE int32 map per term
+            rind = ((cols // htot) * harm +
+                    ((cols % htot) * harm + (htot >> 1)) // htot
+                    ).astype(np.int32)
+            # zinds is nondecreasing with long runs of repeats (the
+            # subharmonic z grid is coarser by 1/frac): gather each
+            # DISTINCT source row once, then one broadcast add per run
+            # — the numpy formulation closest to C-loop speed.
+            zinds = np.asarray(zinds)
+            runs = np.flatnonzero(np.diff(zinds)) + 1
+            starts = np.concatenate([[0], runs])
+            ends = np.concatenate([runs, [len(zinds)]])
+            for g0, g1 in zip(starts, ends):
+                acc[g0:g1] += np.take(plane[zinds[g0]], rind)[None, :]
+        yield stage, acc
+
+
 def search_plane_ref(search: AccelSearch, plane: np.ndarray,
                      max_cands_per_stage: int = 1 << 16) -> List[AccelCand]:
     """Staged harmonic-summing search of a host plane.
@@ -145,14 +181,7 @@ def search_plane_ref(search: AccelSearch, plane: np.ndarray,
     insert time.
     """
     cfg = search.cfg
-    numz, plane_cols = plane.shape
     r0 = int(search.rlo) * ACCEL_RDR
-    top = min(int(search.rhi) * ACCEL_RDR, plane_cols)
-    if top <= r0:
-        return []
-    n = top - r0
-    acc = plane[:, r0:top].copy()
-    fz = _harm_fracs_and_zinds(cfg, numz)
     cands: List[AccelCand] = []
 
     def collect(acc, stage):
@@ -176,27 +205,36 @@ def search_plane_ref(search: AccelSearch, plane: np.ndarray,
             cands.append(AccelCand(power=float(colmax[gi]), sigma=sg,
                                    numharm=numharm, r=rr, z=zz))
 
-    collect(acc, 0)
-    cols = np.arange(r0, top, dtype=np.int64)
-    for stage in range(1, cfg.numharmstages):
-        for (harm, htot, zinds) in fz[stage - 1]:
-            # exact round-half-up of cols*harm/htot (overflow-safe),
-            # as ONE int32 map per term
-            rind = ((cols // htot) * harm +
-                    ((cols % htot) * harm + (htot >> 1)) // htot
-                    ).astype(np.int32)
-            # zinds is nondecreasing with long runs of repeats (the
-            # subharmonic z grid is coarser by 1/frac): gather each
-            # DISTINCT source row once, then one broadcast add per run
-            # — the numpy formulation closest to C-loop speed.
-            zinds = np.asarray(zinds)
-            runs = np.flatnonzero(np.diff(zinds)) + 1
-            starts = np.concatenate([[0], runs])
-            ends = np.concatenate([runs, [len(zinds)]])
-            for g0, g1 in zip(starts, ends):
-                acc[g0:g1] += np.take(plane[zinds[g0]], rind)[None, :]
+    for stage, acc in _accum_stages(search, plane):
         collect(acc, stage)
     return sorted(cands, key=lambda c: (-c.sigma, c.r))
+
+
+def ref_cell_powers(search: AccelSearch, spectrum: np.ndarray,
+                    cells, dtype=np.float32,
+                    workers: Optional[int] = None) -> List[float]:
+    """Harmonic-summed power of the reference path at specific cells.
+
+    cells: list of (stage, zrow, col) in FUNDAMENTAL-plane units —
+    stage = log2(numharm), col = candidate r * numharm / ACCEL_DR,
+    zrow = (candidate z * numharm + zmax) / ACCEL_DZ.  Used by the
+    e2e referee to explain chip candidates with no reference
+    counterpart: a cell whose ref power sits just below powcut while
+    the chip's float32 ordering put it just above is a legitimate
+    threshold-straddle, not a missed feature (the reference's own
+    -inmem vs standard split has the same texture, SURVEY §4.8).
+    """
+    plane, _ = build_plane_ref(search, spectrum, dtype=dtype,
+                               workers=workers)
+    numz = plane.shape[0]
+    r0 = int(search.rlo) * ACCEL_RDR
+    top = min(int(search.rhi) * ACCEL_RDR, plane.shape[1])
+    out = [float("nan")] * len(cells)
+    for stage, acc in _accum_stages(search, plane):
+        for i, (sg, zr, col) in enumerate(cells):
+            if sg == stage and 0 <= zr < numz and r0 <= col < top:
+                out[i] = float(acc[int(zr), int(col) - r0])
+    return out
 
 
 def search_ref(fft_pairs: np.ndarray, cfg: AccelConfig, T: float,

@@ -98,3 +98,27 @@ def test_float32_device_matches_float64_referee():
         rmid = r0 + 0.5 * z
         assert any(abs(c.r - rmid) < 7.5 for c in ref), r0
         assert any(abs(c.r - rmid) < 7.5 for c in dev), r0
+
+
+def test_feature_containment_above_sigma_floor():
+    """The e2e referee invariant (tools/target_scale_e2e.py, VERDICT
+    r4 weak #2), pinned fast: above a stated sigma floor, every chip
+    candidate has a referee feature counterpart within +-8 bins and
+    vice versa (containment 1.0 both directions) — float32-ordering
+    divergence is confined to the near-threshold tail."""
+    numbins, T, floor = 1 << 16, 300.0, 30.0
+    tones = [(5000.5, 0.0, 0.30), (20000.25, 10.0, 0.35),
+             (43210.0, -15.0, 0.40)]
+    pairs = _chirp_pairs(numbins, T, tones)
+    cfg = AccelConfig(zmax=30, numharm=4, sigma=3.0)
+    dev = remove_duplicates(
+        AccelSearch(cfg, T=T, numbins=numbins).search(pairs))
+    ref = remove_duplicates(search_ref(pairs, cfg, T, dtype=np.float64))
+
+    def contained(a, b):
+        rb = np.asarray([c.r for c in b])
+        strong = [c for c in a if c.sigma >= floor]
+        assert strong, "no candidates above the floor; vacuous"
+        return all(np.abs(rb - c.r).min() <= 8.0 for c in strong)
+
+    assert contained(dev, ref) and contained(ref, dev)

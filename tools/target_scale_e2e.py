@@ -1,16 +1,26 @@
-"""END-TO-END per-chip target-scale run (VERDICT r3 item 1).
+"""END-TO-END per-chip target-scale run (VERDICT r3 item 1, r4 items
+1/2/7).
 
 One v5e device's share of the 4096-DM x 2^23 plan — 512 DM trials —
 through the FULL search pipeline as one pipelined program:
 
     dedisp (subband pass once, then per-group DM fan-out from the
     HBM-resident subband stream) -> rfft -> zmax=200 numharm=8 fused
-    accelsearch -> per-trial ACCEL artifacts -> cross-DM sifting,
+    accelsearch -> COMPACTED candidate D2H -> per-trial ACCEL
+    artifacts -> cross-DM sifting -> device-resident single-pulse
+    search over the same 512 series (BASELINE.json config 5 in full).
 
-with device dispatches of group g+1 issued before group g's host
-collection (host sift overlaps device search).  This replaces the
-stage-wise r03 numbers with the product number: per-chip END-TO-END
-seconds for a device's whole share.
+Round-5 structure (VERDICT r4 weak #1): every group's scanner output
+is compacted ON DEVICE (compact_scan_packed: top-m slots of the dense
+[3, nslabs, stages, k] tensor) so the per-group D2H drops from ~12.6
+MB to ~0.4 MB through the ~5-35 MB/s tunneled link, and host
+collection is the vectorized collect_compacted pass.  The r4 run was
+host-collection-bound (153.8 of 154.0 s); this run records the
+device-only floor for the same share (all groups dispatched, one
+final sync, no collection) alongside the overlapped e2e wall, and
+MEASURES the 8-share host-concurrency assumption behind the v5e-8
+projection by replaying the recorded compacted outputs through 8
+concurrent collect+write+sift workers (--replay-worker mode).
 
 Policy notes (documented, not hidden):
   * trials are noise streams synthesized ON DEVICE (the real pipeline
@@ -19,15 +29,20 @@ Policy notes (documented, not hidden):
     candidate counts (and thus host sift cost) are the noise-trial
     counts plus the probe trial below.
   * candidate refinement follows the survey fold policy: the sifted
-    top candidates are polished (batched, device) at the end — the
-    reference's drivers likewise fold/inspect only sifted survivors
-    (PALFA_presto_search.py:32-33).
+    candidates AT THE PROBE DM are polished (batched, device) at the
+    end against the probe spectrum — the reference's drivers likewise
+    fold/inspect only sifted survivors (PALFA_presto_search.py:32-33).
+    Only probe-DM candidates are polished: non-probe trials' spectra
+    are not retained, so polishing their candidates against the probe
+    spectrum would be physically meaningless (ADVICE r4).
   * correctness artifacts: the pulsar-DM probe series (host-built
     with the dispersed pulsar, as r03) is searched on-chip inside the
     same pipeline; sigma recovery is asserted and its candidate list
-    is compared to the NumPy float64-path referee (accel_ref).
+    is compared to the NumPy float64-path referee (accel_ref), with
+    every feature-level mismatch explained to a cell-power root cause
+    and the containment invariant asserted above SIGMA_FLOOR.
 
-Writes TARGETSCALE_r04.json.  Run: python tools/target_scale_e2e.py
+Writes TARGETSCALE_r05.json.  Run: python tools/target_scale_e2e.py
 """
 
 import json
@@ -38,23 +53,85 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 
-import numpy as np
-import jax
-import jax.numpy as jnp
-
-if jax.devices()[0].platform != "tpu":
-    raise SystemExit("target_scale_e2e: needs the real TPU "
-                     "(platform is %s)" % jax.devices()[0].platform)
-
-from tools.target_scale import (NUMCHAN, NSUB, NUMPTS, NSAMP, NBLOCKS,
-                                DT, PSR_F0, PSR_DM, delays, make_block)
-from presto_tpu.ops.dedispersion import dedisp_subbands_block
-
 DMS_PER_DEV = 512
 GROUP = 16                      # DM trials per fused search dispatch
 SIGMA = 6.0
 ZMAX, NUMHARM = 200, 8
+COMPACT_M = 2048                # top-m candidate slots per trial D2H
+SIGMA_FLOOR = 30.0              # referee containment invariant floor
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_WORKER = len(sys.argv) >= 3 and sys.argv[1] == "--replay-worker"
+if _WORKER:                     # host-side replay: CPU, no TPU claim
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+if not _WORKER and jax.devices()[0].platform != "tpu":
+    raise SystemExit("target_scale_e2e: needs the real TPU "
+                     "(platform is %s)" % jax.devices()[0].platform)
+
+
+def main_worker(workdir: str) -> None:
+    """--replay-worker <dir>: one simulated chip-share of host-side
+    candidate collection — decode the recorded compacted outputs,
+    write per-trial ACCEL/.inf artifacts, run the cross-DM sift.
+    Runs on CPU (no TPU contention: the real host work is pure
+    numpy/scipy).  Prints one JSON line {t0, t1, ncands, nsifted};
+    a file barrier (`ready`/`go`) excludes setup from the timed span
+    so N concurrent workers measure pure collect throughput.
+
+    The decode geometry (start_cols, r0min/rtop bounds) comes from
+    meta.json verbatim: the parent's TPU slab plan is pallas-aligned
+    and a CPU re-plan would legitimately differ."""
+    from presto_tpu.search.accel import AccelConfig, AccelSearch
+    from presto_tpu.pipeline.sifting import sift_candidates
+
+    meta = json.load(open(os.path.join(workdir, "meta.json")))
+    comp = np.load(os.path.join(workdir, "comp.npz"))
+    groups = [comp["g%d" % gi] for gi in range(meta["ngroups"])]
+    cfg = AccelConfig(zmax=meta["zmax"], numharm=meta["numharm"],
+                      sigma=meta["sigma"],
+                      max_cands_per_stage=meta["max_cands_per_stage"])
+    srch = AccelSearch(cfg, T=meta["T"], numbins=meta["numbins"])
+    srch._r0min = meta["r0min"]
+    srch._rtop = meta["rtop"]
+    start_cols = meta["start_cols"]
+    dms = meta["dms"]
+    outdir = os.path.join(workdir, "out_%d" % os.getpid())
+    os.makedirs(outdir, exist_ok=True)
+
+    # barrier: setup done; wait for the parent's go (bounded: don't
+    # orphan-spin forever if the parent died before releasing it)
+    open(os.path.join(workdir, "ready_%d" % os.getpid()), "w").close()
+    go = os.path.join(workdir, "go")
+    deadline = time.time() + 600
+    while not os.path.exists(go):
+        if time.time() > deadline:
+            raise SystemExit("replay worker: no 'go' within 600 s "
+                             "(parent gone?)")
+        time.sleep(0.01)
+
+    t0 = time.time()
+    ncands = 0
+    accel_files = []
+    for gi, g in enumerate(groups):
+        for ti in range(g.shape[0]):
+            cands = srch.collect_compacted(
+                g[ti], start_cols, requested_m=meta["compact_m"])
+            ncands += len(cands)
+            accel_files.append(_write_accel(
+                outdir, dms[gi * g.shape[0] + ti], cands, meta["T"]))
+    cl = sift_candidates(accel_files, numdms_min=2)
+    t1 = time.time()
+    print(json.dumps({"t0": t0, "t1": t1, "ncands": ncands,
+                      "nsifted": len(cl)}))
+
+from tools.target_scale import (NUMCHAN, NSUB, NUMPTS, NSAMP, NBLOCKS,
+                                DT, PSR_F0, PSR_DM, delays, make_block)
+from presto_tpu.ops.dedispersion import dedisp_subbands_block
 
 
 def sync(x):
@@ -63,11 +140,12 @@ def sync(x):
 
 def main():
     t_wall = time.time()
-    art_path = os.path.join(REPO, "TARGETSCALE_r04.json")
+    art_path = os.path.join(REPO, "TARGETSCALE_r05.json")
     out = {"device": str(jax.devices()[0]),
            "dms_per_device": DMS_PER_DEV, "group": GROUP,
            "nsamp": NSAMP, "numchan": NUMCHAN, "nsub": NSUB,
-           "zmax": ZMAX, "numharm": NUMHARM, "sigma": SIGMA}
+           "zmax": ZMAX, "numharm": NUMHARM, "sigma": SIGMA,
+           "compact_m": COMPACT_M}
 
     chan_d, dm_d_full, dms = delays()
     psr_dm_idx = int(np.argmin(np.abs(dms - PSR_DM)))
@@ -137,9 +215,10 @@ def main():
     out["subband_pass_sec"] = round(t_sub, 2)
     out["subband_warmup_sec"] = round(t_sub_warm, 1)
 
-    # ---- per-group fused dedisp -> rfft -> search ------------------
+    # ---- per-group fused dedisp -> rfft -> search -> compact -------
     from presto_tpu.ops import fftpack
-    from presto_tpu.search.accel import AccelConfig, AccelSearch
+    from presto_tpu.search.accel import (AccelConfig, AccelSearch,
+                                         compact_scan_packed)
     numbins = NSAMP // 2
     T_obs = NSAMP * DT
     cfg = AccelConfig(zmax=ZMAX, numharm=NUMHARM, sigma=SIGMA,
@@ -157,18 +236,20 @@ def main():
                                      # program needs the headroom for
                                      # its 7 GB plane
 
-    # ONE program, fully per-trial: dedisp -> rfft -> fused search
-    # inside a single lax.scan step, so the live set is the 2.2 GB
-    # stream + ONE 6.5 GB plane + small transients (a group-wide
-    # spectra buffer or a vmapped FFT tips the 15 GiB arena over via
-    # allocation fragmentation around the plane).  The stream and the
-    # complex kernel bank are ARGUMENTS — closing over device arrays
-    # captures them as lowering constants (host fetch of complex:
-    # unsupported; 2 GB copies).  Traced (not baked-in) delays keep
-    # ONE compiled program for all 32 groups; the fused-static dedisp
-    # formulation (BASELINE.md) is ~3x faster per slice but would
-    # re-specialize the whole program per group.  The probe trial's
-    # host-prepared spectrum rides in via a per-trial select.
+    # ONE program, fully per-trial: dedisp -> rfft -> fused search ->
+    # top-m compaction inside a single lax.scan step, so the live set
+    # is the 2.2 GB stream + ONE 6.5 GB plane + small transients (a
+    # group-wide spectra buffer or a vmapped FFT tips the 15 GiB arena
+    # over via allocation fragmentation around the plane).  The stream
+    # and the complex kernel bank are ARGUMENTS — closing over device
+    # arrays captures them as lowering constants (host fetch of
+    # complex: unsupported; 2 GB copies).  Traced (not baked-in)
+    # delays keep ONE compiled program for all 32 groups; the
+    # fused-static dedisp formulation (BASELINE.md) is ~3x faster per
+    # slice but would re-specialize the whole program per group.  The
+    # probe trial's host-prepared spectrum rides in via a per-trial
+    # select.  Output: [GROUP, 3, COMPACT_M] compacted candidates —
+    # the D2H shrink that moved the e2e wall off the host (r4 weak 1).
     @jax.jit
     def group_pipeline(fl, kern, sc, delr, inject, probe_p):
         def per_trial(_, inp):
@@ -180,9 +261,10 @@ def main():
             acc = acc - jnp.mean(acc)
             p = fftpack.realfft_packed_pairs(acc)
             p = jnp.where(inj, probe_p, p)
-            return None, scan_body(build_body(p, kern), sc)
-        _, packed = jax.lax.scan(per_trial, None, (delr, inject))
-        return jnp.moveaxis(packed, 1, 0)
+            packed = scan_body(build_body(p, kern), sc)
+            return None, compact_scan_packed(packed, COMPACT_M)
+        _, comp = jax.lax.scan(per_trial, None, (delr, inject))
+        return comp                       # [GROUP, 3, COMPACT_M]
 
     probe_pairs = jnp.asarray(probe)
     sync(jnp.abs(probe_pairs).sum())
@@ -208,43 +290,47 @@ def main():
                  probe_pairs).ravel()[0].astype(jnp.float32))
     out["search_warmup_sec"] = round(time.time() - t0, 1)
 
+    # ---- device-only floor: all groups, one final sync, no D2H -----
+    # (the number a PCIe-attached host would approach; r4 asserted
+    # ~110-130 s without measuring it — this measures it)
+    t0 = time.time()
+    floor_outs = [(probe_fn if gi == probe_group else base_fn)(
+        delr_dev[gi], probe_pairs) for gi in range(ngroups)]
+    sync(floor_outs[-1][0, 0, :1].astype(jnp.float32))
+    out["device_floor_sec"] = round(time.time() - t0, 2)
+    del floor_outs
+
     # ---- the timed end-to-end share --------------------------------
     workdir = os.path.join(REPO, "_target_e2e")
     os.makedirs(workdir, exist_ok=True)
     for f in os.listdir(workdir):
-        os.remove(os.path.join(workdir, f))
+        p = os.path.join(workdir, f)
+        if os.path.isfile(p):
+            os.remove(p)
 
     t_e2e0 = time.time()
-    host_sift_s = 0.0
-    pending = None                   # (group_idx, device packed)
+    host_collect_s = 0.0
     ncands_total = 0
     accel_files = []
+    comp_groups = []
 
-    def collect(group_idx, packed_dev):
-        nonlocal ncands_total, host_sift_s
+    # dispatch EVERY group up front (async): the device queue runs
+    # back-to-back while the host decodes each group's compacted
+    # output as it lands — collection fully overlaps device search
+    comp_devs = [(probe_fn if gi == probe_group else base_fn)(
+        delr_dev[gi], probe_pairs) for gi in range(ngroups)]
+    for gi, cd_dev in enumerate(comp_devs):
+        comp = np.asarray(cd_dev)             # D2H (~0.4 MB compacted)
         t0 = time.time()
-        packed = np.asarray(packed_dev)      # D2H
-        from presto_tpu.search.accel import _unpack_scan
-        vals, cidx, zrow = _unpack_scan(packed)
+        comp_groups.append(comp)
         for ti in range(GROUP):
-            dm_idx = group_idx * GROUP + ti
-            cands = []
-            for si, start in enumerate(start_cols):
-                srch._collect_slab(vals[ti][si], cidx[ti][si],
-                                   zrow[ti][si], start, cands)
-            cands = srch._dedup_sort(cands)
+            cands = srch.collect_compacted(comp[ti], start_cols,
+                                           requested_m=COMPACT_M)
             ncands_total += len(cands)
             accel_files.append(_write_accel(
-                workdir, dms[lo + dm_idx], cands, T_obs))
-        host_sift_s += time.time() - t0
-
-    for gi in range(ngroups):
-        fn = probe_fn if gi == probe_group else base_fn
-        packed_dev = fn(delr_dev[gi], probe_pairs)  # async dispatch
-        if pending is not None:
-            collect(*pending)                # host work overlaps
-        pending = (gi, packed_dev)
-    collect(*pending)
+                workdir, dms[lo + gi * GROUP + ti], cands, T_obs))
+        host_collect_s += time.time() - t0
+    del comp_devs
 
     # cross-DM sifting over the standard artifacts
     t0 = time.time()
@@ -254,34 +340,75 @@ def main():
     t_e2e = time.time() - t_e2e0
 
     out["e2e_share_sec"] = round(t_e2e, 2)
-    out["host_collect_sec_inside"] = round(host_sift_s, 2)
+    out["host_collect_sec_inside"] = round(host_collect_s, 2)
     out["final_sift_sec"] = round(sift_s, 2)
     out["ncands_raw"] = ncands_total
     out["ncands_sifted"] = len(cl)
-    total = t_sub + t_e2e
+
+    # ---- single-pulse stage over the SAME 512 series (config 5) ----
+    out["singlepulse"] = _sp_share(flat, delr_dev, dms, lo, sublen)
+    sp_share = out["singlepulse"]["sp_share_sec"]
+
+    total = t_sub + t_e2e + sp_share
     out["per_chip_pipeline_sec"] = round(total, 2)
+
+    # ---- 8-share host-concurrency artifact (v5e-8 projection) ------
+    np.savez(os.path.join(workdir, "comp.npz"),
+             **{"g%d" % gi: g for gi, g in enumerate(comp_groups)})
+    json.dump({"ngroups": ngroups, "zmax": ZMAX, "numharm": NUMHARM,
+               "sigma": SIGMA, "max_cands_per_stage": 512,
+               "T": T_obs, "numbins": numbins, "slab": 1 << 20,
+               "start_cols": [int(s) for s in start_cols],
+               "r0min": int(srch._r0min), "rtop": int(srch._rtop),
+               "compact_m": COMPACT_M,
+               "dms": [float(dms[lo + i])
+                       for i in range(DMS_PER_DEV)]},
+              open(os.path.join(workdir, "meta.json"), "w"))
+    conc1 = _run_replay_workers(workdir, 1)
+    conc8 = _run_replay_workers(workdir, 8)
+    out["host_concurrency"] = {
+        "workers_1": conc1, "workers_8": conc8,
+        "note": "N concurrent processes each replaying ONE chip-share "
+                "of collect+ACCEL-write+sift from the recorded "
+                "compacted outputs — the measured host-side cost of 8 "
+                "chips sharing this host"}
+    host_ok = conc8["wall_sec"] <= max(out["device_floor_sec"],
+                                       1.0)
     out["v5e8_projection"] = {
         "dms": 4096, "wall_sec_est": round(total, 2),
+        "host_concurrency_measured": True,
+        "host_8share_wall_sec": conc8["wall_sec"],
+        "host_overlaps_device": bool(host_ok),
         "note": "DM-sharded: each of 8 chips runs this share "
-                "concurrently; no cross-device traffic (mpiprepsubband"
-                " partition, SURVEY 2.5)"}
+                "concurrently (mpiprepsubband partition, SURVEY 2.5); "
+                "8 concurrent host collect shares measured at %.1f s "
+                "%s the %.1f s device floor, so host work stays "
+                "overlapped" % (
+                    conc8["wall_sec"],
+                    "<=" if host_ok else ">",
+                    out["device_floor_sec"])}
 
     # ---- correctness: probe recovery + referee equality ------------
     top = _probe_top(cl, dms[psr_dm_idx])
     out["pulsar_recovered"] = top
-    assert top and top["sigma"] > 50, top
 
     t0 = time.time()
     out["referee"] = _referee_check(probe, srch, cfg, T_obs, workdir,
                                     dms[psr_dm_idx])
     out["referee_sec_cpu"] = round(time.time() - t0, 1)
 
-    # ---- survey fold policy: polish sifted top candidates ----------
+    # ---- survey fold policy: polish sifted probe-DM candidates -----
+    # (only the probe trial's spectrum survives on device, so only its
+    # candidates are physically polishable — ADVICE r4; the timing is
+    # representative per-trial polish cost either way)
     t0 = time.time()
     from presto_tpu.search.polish import optimize_accelcands
     from presto_tpu.search.accel import AccelCand
-    ranked = sorted(cl.cands, key=lambda c: -c.sigma)[:64]
-    seeds = [AccelCand(power=c.power if hasattr(c, "power") else 0.0,
+    probe_dm = dms[psr_dm_idx]
+    ranked = sorted((c for c in cl.cands
+                     if abs(c.DM - probe_dm) < 1e-6),
+                    key=lambda c: -c.sigma)[:64]
+    seeds = [AccelCand(power=getattr(c, "power", 0.0),
                        sigma=c.sigma, numharm=c.numharm,
                        r=c.r, z=c.z) for c in ranked]
     if seeds:
@@ -289,15 +416,111 @@ def main():
                                   srch.numindep, with_props=False)
         out["polish_top_sec"] = round(time.time() - t0, 2)
         out["polish_top_n"] = len(ocs)
+        out["polish_note"] = ("probe-DM sifted candidates only; "
+                              "per-trial polish cost is DM-agnostic")
 
     out["wall_total_sec"] = round(time.time() - t_wall, 1)
     art = {}
     if os.path.exists(art_path):
         art = json.load(open(art_path))
-    art["e2e_r04"] = out
+    art["e2e_r05"] = out
     with open(art_path, "w") as f:
         json.dump(art, f, indent=1)
     print(json.dumps(out, indent=1))
+
+    # enforced invariants — checked AFTER the artifact is on disk so
+    # a failing run still records its evidence for diagnosis
+    assert top and top["sigma"] > 50, top
+    viol = out["referee"].get("violations", [])
+    assert not viol, viol
+
+
+def _sp_share(flat, delr_dev, dms, lo, sublen):
+    """Device-resident single-pulse search over the same 512
+    dedispersed series (BASELINE.json config 5 pairs the accel share
+    WITH single_pulse_search; r4's share omitted it — VERDICT #7).
+    Per group: re-dedisperse [GROUP, NSAMP] from the resident subband
+    stream in one jit (the accel path consumed its series inside the
+    fused program), then search_many_resident — only stds/scales and
+    compacted hits cross the link."""
+    from presto_tpu.search.singlepulse import SinglePulseSearch
+    sp = SinglePulseSearch(threshold=5.0)
+
+    @jax.jit
+    def group_series(fl, delr):
+        def per_trial(_, dl):
+            acc = jax.lax.dynamic_slice(fl, (dl[0],), (NSAMP,))
+            for s in range(1, NSUB):
+                acc = acc + jax.lax.dynamic_slice(
+                    fl, (s * sublen + dl[s],), (NSAMP,))
+            return None, acc
+        _, series = jax.lax.scan(per_trial, None, delr)
+        return series                    # [GROUP, NSAMP]
+
+    # warmup (compile both the series program and SP's own programs)
+    t0 = time.time()
+    ser = group_series(flat, delr_dev[0])
+    res = sp.search_many_resident(
+        ser, dt=DT, dms=[float(dms[lo + i]) for i in range(GROUP)])
+    warm = time.time() - t0
+
+    t0 = time.time()
+    nev = 0
+    for gi, delr in enumerate(delr_dev):
+        ser = group_series(flat, delr)
+        res = sp.search_many_resident(
+            ser, dt=DT,
+            dms=[float(dms[lo + gi * GROUP + i]) for i in range(GROUP)])
+        nev += sum(len(c) for (c, _st, _b) in res)
+    elapsed = time.time() - t0
+    return {"sp_share_sec": round(elapsed, 2),
+            "sp_warmup_sec": round(warm, 1),
+            "sp_nevents": int(nev), "threshold": 5.0}
+
+
+def _run_replay_workers(workdir: str, n: int) -> dict:
+    """Launch n --replay-worker processes (each = one chip-share of
+    host collection), barrier-synchronize their timed spans, return
+    {wall_sec, per_worker_sec, n}."""
+    import subprocess
+    import glob
+    for f in glob.glob(os.path.join(workdir, "ready_*")) + \
+            [os.path.join(workdir, "go")]:
+        if os.path.exists(f):
+            os.remove(f)
+    procs = [subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__),
+         "--replay-worker", workdir],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+        for _ in range(n)]
+    deadline = time.time() + 600
+    while len(glob.glob(os.path.join(workdir, "ready_*"))) < n:
+        if time.time() > deadline:
+            for p in procs:
+                p.kill()
+            raise RuntimeError("replay workers never became ready")
+        time.sleep(0.05)
+    open(os.path.join(workdir, "go"), "w").close()
+    results = []
+    for p in procs:
+        outb, errb = p.communicate(timeout=600)
+        lines = outb.decode().strip().splitlines()
+        if p.returncode != 0 or not lines:
+            raise RuntimeError(
+                "replay worker failed (rc=%s):\n%s"
+                % (p.returncode, errb.decode()[-2000:]))
+        results.append(json.loads(lines[-1]))
+    wall = max(r["t1"] for r in results) - min(r["t0"]
+                                               for r in results)
+    import shutil
+    for d in glob.glob(os.path.join(workdir, "out_*")):
+        shutil.rmtree(d, ignore_errors=True)
+    return {"n": n, "wall_sec": round(wall, 2),
+            "per_worker_sec": [round(r["t1"] - r["t0"], 2)
+                               for r in results],
+            "ncands": results[0]["ncands"],
+            "nsifted": results[0]["nsifted"]}
 
 
 def _host_probe_series(chan_d, dly):
@@ -367,10 +590,18 @@ def _referee_check(probe_pairs, srch, cfg, T_obs, workdir, psr_dm):
     equality vs the on-chip search of the SAME spectrum.  Uses
     srch.cfg (the ALIGNED uselen geometry the chip actually ran) —
     the raw cfg's default uselen gives different normalization
-    windows and a legitimately different borderline set."""
+    windows and a legitimately different borderline set.
+
+    Round-5 hardening (VERDICT r4 weak #2): every feature-level
+    mismatch in EITHER direction is chased to a cell-power root cause
+    (ref_cell_powers at the exact (stage, zrow, col) cell), and the
+    equality texture is an asserted invariant: feature containment
+    must be 1.0 BOTH directions above SIGMA_FLOOR, and the eliminated
+    top lists identical to depth >= 5."""
     from presto_tpu.search.accel import (remove_duplicates,
-                                         eliminate_harmonics)
-    from presto_tpu.search.accel_ref import search_ref
+                                         eliminate_harmonics,
+                                         ACCEL_DR, ACCEL_DZ)
+    from presto_tpu.search.accel_ref import search_ref, ref_cell_powers
     chip = remove_duplicates(srch.search(jnp.asarray(probe_pairs)))
     ref = remove_duplicates(search_ref(probe_pairs, srch.cfg, T_obs,
                                        dtype=np.float32))
@@ -386,8 +617,9 @@ def _referee_check(probe_pairs, srch, cfg, T_obs, workdir, psr_dm):
     # reference's own -inmem vs standard paths are likewise distinct
     # float orderings, SURVEY §4.8).  So we report: how deep the
     # eliminated lists agree exactly, the sigma at first divergence,
-    # and FEATURE-level containment (every candidate has a
-    # counterpart at the same fundamental frequency +-8 bins).
+    # FEATURE-level containment (every candidate has a counterpart at
+    # the same fundamental frequency +-8 bins), and a root-cause
+    # classification of every feature mismatch.
     ec = [(c.numharm, c.r, c.z, round(c.sigma, 2))
           for c in eliminate_harmonics(chip)]
     er = [(c.numharm, c.r, c.z, round(c.sigma, 2))
@@ -397,20 +629,106 @@ def _referee_check(probe_pairs, srch, cfg, T_obs, workdir, psr_dm):
         n_id += 1
     div_sigma = ec[n_id][3] if n_id < len(ec) else None
 
-    def feat_frac(a, b):
+    def unmatched(a, b):
+        rb = np.asarray([c.r for c in b])
+        return [c for c in a if np.abs(rb - c.r).min() > 8.0]
+
+    un_chip = unmatched(chip, ref)        # chip cands missing in ref
+    un_ref = unmatched(ref, chip)         # ref cands missing in chip
+
+    def cells_of(cl):
+        return [(int(np.log2(c.numharm)),
+                 int(round((c.z * c.numharm + cfg.zmax) / ACCEL_DZ)),
+                 int(round(c.r * c.numharm / ACCEL_DR)))
+                for c in cl]
+
+    expl = []
+    if un_chip:
+        # ref harmonic-summed power at the EXACT chip cells: the ref
+        # path keeps every above-powcut column, so a chip candidate
+        # absent from ref means ref's power there was <= powcut —
+        # quantify how close (threshold straddle) it was
+        rp = ref_cell_powers(srch, probe_pairs, cells_of(un_chip),
+                             dtype=np.float32)
+        for c, p_ref in zip(un_chip, rp):
+            stage = int(np.log2(c.numharm))
+            cut = srch.powcut[stage]
+            expl.append({
+                "side": "chip_only", "sigma": round(c.sigma, 2),
+                "numharm": c.numharm, "r": c.r, "z": c.z,
+                "chip_power": round(c.power, 3),
+                "ref_power_at_cell": round(p_ref, 3),
+                "powcut": round(cut, 3),
+                "kind": ("threshold_straddle"
+                         if (np.isfinite(p_ref) and p_ref <= cut
+                             and c.power > cut
+                             and abs(p_ref - c.power)
+                             / max(c.power, 1e-9) < 1e-2)
+                         else "unexplained")})
+    for c in un_ref:
+        # reverse direction: ref candidate the chip never reported —
+        # the chip's segment-max + per-slab top-k keeps every
+        # above-powcut SEGMENT representative, so a missing feature
+        # means the chip's float32 power at that cell fell <= powcut:
+        # a straddle when the ref power itself hugs the cut
+        stage = int(np.log2(c.numharm))
+        cut = srch.powcut[stage]
+        margin = (c.power - cut) / max(cut, 1e-9)
+        expl.append({
+            "side": "ref_only", "sigma": round(c.sigma, 2),
+            "numharm": c.numharm, "r": c.r, "z": c.z,
+            "ref_power": round(c.power, 3),
+            "powcut": round(cut, 3),
+            "rel_margin_above_cut": round(float(margin), 6),
+            "kind": ("threshold_straddle" if margin < 1e-2
+                     else "unexplained")})
+
+    def feat_frac(a, b, floor=None):
+        if floor is not None:
+            a = [c for c in a if c.sigma >= floor]
+        if not a:
+            return 1.0
+        if not b:
+            return 0.0
         rb = np.asarray([c.r for c in b])
         return float(np.mean([np.abs(rb - c.r).min() <= 8.0
-                              for c in a])) if a else 1.0
+                              for c in a]))
 
-    return {"chip_n": len(chip), "ref_n": len(ref),
-            "raw_cell_jaccard": round(
-                len(inter) / max(len(key(chip) | key(ref)), 1), 4),
-            "top_identical_n": n_id,
-            "first_divergence_sigma": div_sigma,
-            "feature_match_chip_in_ref": round(feat_frac(chip, ref), 3),
-            "feature_match_ref_in_chip": round(feat_frac(ref, chip), 3),
-            "top_eliminated": ec[:5]}
+    res = {"chip_n": len(chip), "ref_n": len(ref),
+           "raw_cell_jaccard": round(
+               len(inter) / max(len(key(chip) | key(ref)), 1), 4),
+           "top_identical_n": n_id,
+           "first_divergence_sigma": div_sigma,
+           "feature_match_chip_in_ref": round(feat_frac(chip, ref), 3),
+           "feature_match_ref_in_chip": round(feat_frac(ref, chip), 3),
+           "mismatch_explanations": expl,
+           "sigma_floor": SIGMA_FLOOR,
+           "feature_match_above_floor": [
+               feat_frac(chip, ref, SIGMA_FLOOR),
+               feat_frac(ref, chip, SIGMA_FLOOR)],
+           "top_eliminated": ec[:5]}
+    # the pinned invariant (also enforced by tests/test_referee.py on
+    # a fast synthetic search): full feature containment above the
+    # stated sigma floor, both directions, top-list identity depth,
+    # and a threshold-straddle root cause for every feature mismatch.
+    # Violations are recorded (and raised by main AFTER the artifact
+    # lands on disk).
+    viol = []
+    if res["feature_match_above_floor"] != [1.0, 1.0]:
+        viol.append("feature containment above sigma %.0f != 1/1: %r"
+                    % (SIGMA_FLOOR, res["feature_match_above_floor"]))
+    if n_id < min(5, len(ec), len(er)):
+        viol.append("top eliminated lists identical only to depth %d"
+                    % n_id)
+    for e in expl:
+        if e["kind"] != "threshold_straddle":
+            viol.append("unexplained feature mismatch: %r" % (e,))
+    res["violations"] = viol
+    return res
 
 
 if __name__ == "__main__":
-    main()
+    if _WORKER:
+        main_worker(sys.argv[2])
+    else:
+        main()
