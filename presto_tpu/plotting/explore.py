@@ -80,7 +80,8 @@ class SpectrumView(_WindowedView):
     Mirrors explorefft's display model: median-normalized powers
     (local LOCALCHUNK medians, like the reference's chunked polynomial
     fit), chunk-max display reduction, power-of-two zoom, harmonic
-    markers.
+    markers, switchable normalization (explorefft.c:912-958) and a
+    birdie zaplist sink (explorefft.c:810-885).
     """
     powers: np.ndarray            # raw |X|^2, k = 0..n/2-1
     T: float                      # observation length (s)
@@ -88,6 +89,10 @@ class SpectrumView(_WindowedView):
     numbins: int = 0              # 0 -> initial window (2^17 like ref)
     harmonics: int = 0            # draw markers at k*f0 for cursor f0
     cursor_r: float = 0.0
+    norm_mode: str = "median"     # 'median' | 'raw' ('N' key cycle)
+    yscale: float = 0.0           # manual y ceiling; 0 = auto ('S')
+    zapfile: str = "explore.zap"  # 'Z' appends birdies here
+    zapped: List[Tuple[float, float]] = field(default_factory=list)
 
     def _array(self) -> np.ndarray:
         return self.powers
@@ -103,13 +108,36 @@ class SpectrumView(_WindowedView):
     def normalized(self) -> np.ndarray:
         """Median-normalized powers of the current window (the
         reference's chunked local normalization, explorefft.c's
-        LOGLOCALCHUNK medians; powers/median * ln2 so chi^2 mean=1)."""
+        LOGLOCALCHUNK medians; powers/median * ln2 so chi^2 mean=1).
+        norm_mode='raw' shows unnormalized powers
+        (explorefft.c:944-951's 'r' submode)."""
         w = self.powers[self.lobin:self.lobin + self.numbins]
+        if self.norm_mode == "raw":
+            return np.asarray(w, dtype=np.float64)
         nc = max(1, len(w) // LOCALCHUNK)
         chunks, csize = _chunks_of(w, nc)
         med = np.median(chunks, axis=1)
         med = np.maximum(np.repeat(med, csize)[:len(w)], 1e-30)
         return (w / med) * np.log(2.0)
+
+    def peak(self) -> Tuple[float, float]:
+        """(r, normalized power) of the strongest displayed point."""
+        f, p = self.display()
+        i = int(np.argmax(p))
+        return f[i] * self.T, float(p[i])
+
+    def add_birdie(self) -> Tuple[float, float]:
+        """Append the strongest displayed peak to the zaplist as
+        (freq_hz, width_hz) — explorefft's 'Z' birdie capture with
+        the interactive cursor span replaced by a LOCALCHUNK-bin
+        width around the peak.  Returns the (freq, width) written."""
+        r, _p = self.peak()
+        f0 = r / self.T
+        width = LOCALCHUNK / self.T
+        with open(self.zapfile, "a") as fh:
+            fh.write("%17.14g %17.14g\n" % (f0, width))
+        self.zapped.append((f0, width))
+        return f0, width
 
     def display(self) -> Tuple[np.ndarray, np.ndarray]:
         """(freqs_hz, display_powers) with <= DISPLAYNUM chunk-max
@@ -130,11 +158,15 @@ class SpectrumView(_WindowedView):
 @dataclass
 class TimeseriesView(_WindowedView):
     """Windowed view of a .dat time series (exploredat.c model):
-    chunked min/avg/max envelopes."""
+    chunked min/avg/max envelopes, median/average center toggle
+    (exploredat.c:482-489) and envelope on/off (exploredat.c:475-481's
+    space toggle)."""
     data: np.ndarray
     dt: float
     lobin: int = 0
     numbins: int = 0
+    center: str = "avg"           # 'avg' | 'median' ('M' key toggle)
+    show_envelope: bool = True    # ' ' toggles min/max band
 
     def _array(self) -> np.ndarray:
         return self.data
@@ -143,15 +175,23 @@ class TimeseriesView(_WindowedView):
         self._clamp(1 << 16)
 
     def display(self):
-        """(times_s, avg, mn, mx) chunk envelopes, <= DISPLAYNUM."""
+        """(times_s, center, mn, mx) chunk envelopes, <= DISPLAYNUM."""
         w = self.data[self.lobin:self.lobin + self.numbins]
         nout = min(DISPLAYNUM, len(w))
-        avg = _chunk_reduce(w, nout, "avg")
+        if self.center == "median" and len(w) > nout:
+            c, _ = _chunks_of(w, nout)
+            avg = np.median(c, axis=1)
+        else:
+            avg = _chunk_reduce(w, nout, "avg")
         mn = _chunk_reduce(w, nout, "min")
         mx = _chunk_reduce(w, nout, "max")
         ts = (self.lobin + np.arange(len(avg)) *
               (len(w) / len(avg))) * self.dt
         return ts, avg, mn, mx
+
+    def goto_time(self, t_sec: float) -> None:
+        self.lobin = int(max(0, min(t_sec / self.dt - self.numbins // 2,
+                                    len(self.data) - self.numbins)))
 
     def stats(self) -> Tuple[float, float, float, float]:
         w = self.data[self.lobin:self.lobin + self.numbins]
@@ -159,14 +199,136 @@ class TimeseriesView(_WindowedView):
                 float(w.min()), float(w.max()))
 
 
-HELP = """explore keys:
-  z / Z    zoom in / out (x2)
-  < / >    pan left / right (also arrow keys)
-  h        toggle x16 harmonic markers at the strongest shown peak
-  g        (spectrum) center on strongest displayed peak
-  s        print window stats to stdout
-  q        quit
+HELP = """explore keys (explorefft.c / exploredat.c interaction model):
+  a / i      zoom in (x2)
+  x / o      zoom out (x2)
+  < / left   shift left one full screen      , shift left 1/8 screen
+  > / right  shift right one full screen     . shift right 1/8 screen
+  + / -      raise / lower the y ceiling (spectrum)
+  s          auto-scale y
+  g          center on the strongest displayed peak
+  G          go to a typed frequency (Hz) / time (s)
+  d          print details of the strongest displayed point
+  h          toggle x16 harmonic markers at the strongest shown peak
+  n          cycle normalization: local-median <-> raw   (spectrum)
+  z          append strongest peak to the zaplist birdie file (spectrum)
+  m          toggle chunk center median <-> average   (time series)
+  space      toggle the min/max envelope band         (time series)
+  v          print window statistics
+  p          save the current plot to a PNG
+  ?          print this help
+  q          quit
 """
+
+
+def dispatch_key(view, key, arg: Optional[float] = None):
+    """Headless keystroke dispatch: mutate `view` per the reference's
+    interaction model (explorefft.c:637-1007, exploredat.c:460-730)
+    and return the ACTION for the caller to perform:
+
+      ("redraw", None)  view changed, re-render
+      ("quit", None)    close
+      ("print", text)   write text to the terminal
+      ("save", None)    save the current figure (caller names it)
+      ("prompt", what)  ask the user for a number, then call again
+                        with arg=<value> and the same key
+      None              key not bound
+
+    `arg` carries the answer to a ("prompt", ...) round trip ('G').
+    Pure logic + zapfile append — no matplotlib: tests drive it
+    headless, the apps wire it to key_press_event."""
+    spec = isinstance(view, SpectrumView)
+    if key == "q":
+        return ("quit", None)
+    if key == "?":
+        return ("print", HELP)
+    if key in ("a", "i"):
+        view.zoom(0.5)
+        return ("redraw", None)
+    if key in ("x", "o"):
+        view.zoom(2.0)
+        return ("redraw", None)
+    if key in ("<", "left"):
+        view.pan(-1.0)
+        return ("redraw", None)
+    if key == ",":
+        view.pan(-0.125)
+        return ("redraw", None)
+    if key in (">", "right"):
+        view.pan(1.0)
+        return ("redraw", None)
+    if key == ".":
+        view.pan(0.125)
+        return ("redraw", None)
+    if key in ("+", "=") and spec:
+        _, p = view.display()
+        cur = view.yscale or float(np.max(p))
+        view.yscale = cur / 1.25
+        return ("redraw", None)
+    if key in ("-", "_") and spec:
+        _, p = view.display()
+        cur = view.yscale or float(np.max(p))
+        view.yscale = cur * 1.25
+        return ("redraw", None)
+    if key == "s":
+        if spec:
+            view.yscale = 0.0
+        return ("redraw", None)
+    if key == "g":
+        if spec:
+            r, _p = view.peak()
+            view.goto_freq(r / view.T)
+        else:
+            ts, avg, _mn, mx = view.display()
+            view.goto_time(float(ts[int(np.argmax(mx))]))
+        return ("redraw", None)
+    if key == "G":
+        if arg is None:
+            return ("prompt", "frequency (Hz)" if spec else "time (s)")
+        if spec:
+            view.goto_freq(float(arg))
+        else:
+            view.goto_time(float(arg))
+        return ("redraw", None)
+    if key == "d":
+        if spec:
+            r, p = view.peak()
+            return ("print",
+                    "peak: r=%.1f  f=%.9g Hz  p=%.6g Hz  norm power "
+                    "%.3f" % (r, r / view.T, view.T / r, p))
+        mean, std, lo, hi = view.stats()
+        return ("print", "window mean %.6g  std %.6g  min %.6g  "
+                "max %.6g" % (mean, std, lo, hi))
+    if key == "h" and spec:
+        if view.harmonics:
+            view.harmonics = 0
+        else:
+            view.cursor_r, _ = view.peak()
+            view.harmonics = 16
+        return ("redraw", None)
+    if key == "n" and spec:
+        view.norm_mode = "raw" if view.norm_mode == "median" \
+            else "median"
+        return ("redraw", None)
+    if key == "z" and spec:
+        f0, width = view.add_birdie()
+        return ("print", "added birdie %.9g Hz (width %.3g Hz) -> %s"
+                % (f0, width, view.zapfile))
+    if key == "m" and not spec:
+        view.center = "median" if view.center == "avg" else "avg"
+        return ("redraw", None)
+    if key == " " and not spec:
+        view.show_envelope = not view.show_envelope
+        return ("redraw", None)
+    if key == "v":
+        if spec:
+            f, p = view.display()
+            return ("print", "window %.6f-%.6f Hz, max norm power "
+                    "%.2f" % (f[0], f[-1], float(p.max())))
+        return ("print", "mean/std/min/max: %r" % (view.stats(),))
+    if key == "p":
+        return ("save", None)
+    return None
 
 
 def render_spectrum(view: SpectrumView, ax) -> None:
@@ -177,20 +339,23 @@ def render_spectrum(view: SpectrumView, ax) -> None:
         if f[0] <= hf <= f[-1]:
             ax.axvline(hf, color="#c04040", lw=0.7, alpha=0.6)
     ax.set_xlabel("Frequency (Hz)")
-    ax.set_ylabel("Normalized power")
+    ax.set_ylabel("Normalized power" if view.norm_mode == "median"
+                  else "Raw power")
     ax.set_title("bins %d - %d of %d  (max-of-chunk display)"
                  % (view.lobin, view.lobin + view.numbins,
                     len(view.powers)))
     ax.set_xlim(f[0], f[-1])
+    if view.yscale:
+        ax.set_ylim(0.0, view.yscale)
 
 
 def render_timeseries(view: TimeseriesView, ax) -> None:
     ts, avg, mn, mx = view.display()
     ax.clear()
-    if view.numbins > len(avg):          # envelope display
+    if view.show_envelope and view.numbins > len(avg):
         ax.fill_between(ts, mn, mx, color="#a0c0e0", alpha=0.7,
                         label="min/max")
-    ax.plot(ts, avg, lw=0.6, color="#2060a0", label="avg")
+    ax.plot(ts, avg, lw=0.6, color="#2060a0", label=view.center)
     mean, std, lo, hi = view.stats()
     ax.set_xlabel("Time (s)")
     ax.set_ylabel("Amplitude")
@@ -218,39 +383,34 @@ def run_explorer(view, render, out_png: Optional[str] = None) -> str:
         return path
 
     print(HELP)
+    nsaved = [0]
+
+    def perform(action):
+        if action is None:
+            return
+        verb, payload = action
+        if verb == "quit":
+            plt.close(fig)
+        elif verb == "print":
+            print(payload)
+        elif verb == "save":
+            path = "explore_%02d.png" % nsaved[0]
+            nsaved[0] += 1
+            fig.savefig(path, dpi=110)
+            print("saved", path)
+        elif verb == "prompt":
+            try:
+                val = float(input("%s> " % payload))
+            except (ValueError, EOFError):
+                return
+            perform(dispatch_key(view, "G", arg=val))
+            return
+        if verb in ("redraw",):
+            render(view, ax)
+            fig.canvas.draw_idle()
 
     def on_key(event):
-        k = event.key
-        if k == "q":
-            plt.close(fig)
-            return
-        if k == "z":
-            view.zoom(0.5)
-        elif k == "Z":
-            view.zoom(2.0)
-        elif k in ("<", "left"):
-            view.pan(-0.4)
-        elif k in (">", "right"):
-            view.pan(0.4)
-        elif k == "h" and isinstance(view, SpectrumView):
-            if view.harmonics:
-                view.harmonics = 0
-            else:
-                f, p = view.display()
-                view.cursor_r = f[int(np.argmax(p))] * view.T
-                view.harmonics = 16
-        elif k == "g" and isinstance(view, SpectrumView):
-            f, p = view.display()
-            view.goto_freq(f[int(np.argmax(p))])
-        elif k == "s":
-            if isinstance(view, SpectrumView):
-                f, p = view.display()
-                print("window %.6f-%.6f Hz, max norm power %.2f"
-                      % (f[0], f[-1], float(p.max())))
-            else:
-                print("mean/std/min/max:", view.stats())
-        render(view, ax)
-        fig.canvas.draw_idle()
+        perform(dispatch_key(view, event.key))
 
     fig.canvas.mpl_connect("key_press_event", on_key)
     plt.show()

@@ -94,3 +94,99 @@ def test_monte_campaign_regimes(tmp_path):
     out = str(tmp_path / "monte.json")
     save_json(res, out)
     assert json.load(open(out))["results"]
+
+
+def test_dispatch_key_spectrum(tmp_path):
+    """The headless keystroke dispatch implements the explorefft.c
+    interaction model: zoom/pan/goto/harmonics/normalization/birdie
+    capture return the right actions and mutate the view."""
+    from presto_tpu.plotting.explore import dispatch_key
+    rng = np.random.default_rng(2)
+    powers = rng.exponential(size=1 << 15)
+    powers[9000] = 800.0
+    v = SpectrumView(powers=powers, T=200.0,
+                     zapfile=str(tmp_path / "birds.zap"))
+    n0 = v.numbins
+    assert dispatch_key(v, "a") == ("redraw", None)   # zoom in
+    assert v.numbins == n0 // 2
+    assert dispatch_key(v, "x") == ("redraw", None)   # zoom out
+    assert v.numbins == n0
+    dispatch_key(v, "a")                              # pan needs room:
+    v.lobin = 0                                       # window < array
+    dispatch_key(v, ">")                              # full screen
+    assert v.lobin == v.numbins
+    dispatch_key(v, "<")
+    assert v.lobin == 0
+    dispatch_key(v, ".")                              # right 1/8
+    assert v.lobin == v.numbins // 8
+    dispatch_key(v, ",")                              # left 1/8
+    assert v.lobin == 0
+    v.lobin = 0
+    dispatch_key(v, "x")                              # restore
+    # goto strongest peak then harmonics toggle
+    dispatch_key(v, "g")
+    f, p = v.display()
+    assert f[0] <= 45.0 <= f[-1]
+    dispatch_key(v, "h")
+    assert v.harmonics == 16 and v.cursor_r > 0
+    dispatch_key(v, "h")
+    assert v.harmonics == 0
+    # normalization cycle
+    assert v.norm_mode == "median"
+    dispatch_key(v, "n")
+    assert v.norm_mode == "raw"
+    assert v.display()[1].max() > 500.0               # raw power
+    dispatch_key(v, "n")
+    # typed goto is a prompt round trip
+    verb, what = dispatch_key(v, "G")
+    assert verb == "prompt" and "frequency" in what
+    assert dispatch_key(v, "G", arg=10.0) == ("redraw", None)
+    f, _ = v.display()
+    assert f[0] <= 10.0 <= f[-1]
+    # y scaling
+    dispatch_key(v, "+")
+    y1 = v.yscale
+    assert y1 > 0
+    dispatch_key(v, "-")
+    assert v.yscale > y1
+    dispatch_key(v, "s")
+    assert v.yscale == 0.0
+    # birdie capture appends to the zapfile
+    dispatch_key(v, "g")
+    verb, msg = dispatch_key(v, "z")
+    assert verb == "print" and "birdie" in msg
+    f0, width = v.zapped[0]
+    assert abs(f0 - 45.0) < 1.0
+    line = open(v.zapfile).read().split()
+    assert abs(float(line[0]) - f0) < 1e-9
+    # details / stats / save / help / quit verbs
+    assert dispatch_key(v, "d")[0] == "print"
+    assert dispatch_key(v, "v")[0] == "print"
+    assert dispatch_key(v, "p") == ("save", None)
+    assert dispatch_key(v, "?")[0] == "print"
+    assert dispatch_key(v, "q") == ("quit", None)
+    assert dispatch_key(v, "F1") is None
+
+
+def test_dispatch_key_timeseries():
+    from presto_tpu.plotting.explore import dispatch_key
+    rng = np.random.default_rng(3)
+    data = rng.normal(size=1 << 14).astype(np.float32)
+    data[9000:9004] += 30.0
+    v = TimeseriesView(data=data, dt=1e-3)
+    assert dispatch_key(v, "m") == ("redraw", None)
+    assert v.center == "median"
+    assert dispatch_key(v, " ") == ("redraw", None)
+    assert v.show_envelope is False
+    # goto strongest displayed max
+    dispatch_key(v, "a")
+    dispatch_key(v, "g")
+    ts, avg, mn, mx = v.display()
+    assert ts[0] <= 9.0 <= ts[-1] + 1.0
+    verb, what = dispatch_key(v, "G")
+    assert verb == "prompt" and "time" in what
+    dispatch_key(v, "G", arg=2.0)
+    ts, *_ = v.display()
+    assert ts[0] <= 2.0
+    assert dispatch_key(v, "v")[0] == "print"
+    assert dispatch_key(v, "d")[0] == "print"
