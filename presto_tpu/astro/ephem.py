@@ -443,6 +443,14 @@ def get_ephemeris(name="DEANALYTIC"):
     s = str(name)
     if s.upper() == "KEPLER":
         return AnalyticEphemeris()
+    if s.upper() == "AUTO":
+        # kernel-provisioning ladder (astro/kernels.py): a real JPL
+        # DE file if available/fetchable, else the builtin EPV2000
+        # kernel generated at first use — the .bsp route with zero
+        # user setup (the reference's TEMPO+DE405 out-of-box parity)
+        from presto_tpu.astro.kernels import resolve_kernel
+        from presto_tpu.astro.spk import SPKEphemeris
+        return SPKEphemeris(resolve_kernel()[0])
     if s.lower().endswith(".npz"):
         return TabulatedEphemeris(s)
     if s.lower().endswith(".bsp"):

@@ -1,0 +1,193 @@
+"""Zero-setup ephemeris kernel provisioning (VERDICT r4 missing #2).
+
+The reference's default barycentering is TEMPO + an installed DE405
+file — µs-grade with no user action (src/barycenter.c:87-156).  This
+framework's sub-µs seam is a real JPL .bsp (astro/spk.py), which is a
+download the reference never needs.  This module closes the setup gap
+with a provisioning ladder:
+
+  1. a REAL JPL kernel found in the kernel cache (or placed there by
+     the gated auto-fetch below): sub-µs absolute, exactly the
+     reference's grade;
+  2. the BUILTIN kernel: the shipped EPV2000 series (4.6 km RMS vs
+     DE405, sub-50-µs absolute Roemer — astro/ephem.py) fitted to a
+     compact type-2 Chebyshev .bsp covering 1980-2040, generated
+     once at first use into the cache (~5 MB, a few seconds).  Every
+     kernel-route feature (prepfold -ephem, polycos, bary) then works
+     with ZERO setup; fit error is sub-millimeter, so the kernel IS
+     the builtin ephemeris through the real SPK read path, and
+     pipelines that barycenter and fold through the same kernel are
+     internally sub-µs (tests/test_timing_e2e.py).
+
+Auto-fetch policy: downloads run ONLY when PRESTO_TPU_ALLOW_DOWNLOAD
+=1 (pulsar clusters are commonly air-gapped; silent network I/O in a
+timing path is hostile).  Fetched files are pinned trust-on-first-use
+(SHA256 recorded beside the file and verified on every reuse) — this
+environment has no network, so a vendored hash could not be verified
+against NAIF and a wrong pin would brick the path.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import warnings
+
+import numpy as np
+
+ENV_DIR = "PRESTO_TPU_EPHEM_DIR"
+ENV_ALLOW = "PRESTO_TPU_ALLOW_DOWNLOAD"
+DE440S_URL = ("https://naif.jpl.nasa.gov/pub/naif/generic_kernels/"
+              "spk/planets/de440s.bsp")
+
+# builtin kernel coverage and fit geometry.  Earth granules must
+# resolve the 27.3-day EMB wobble the EPV Earth series carries: 2-day
+# windows at 16 coefficients fit it to sub-millimeter.  The Sun's
+# SSB orbit is smooth (Jupiter-period): 16-day windows suffice.
+BUILTIN_MJD_LO = 44239.0        # 1980 Jan 1
+BUILTIN_MJD_HI = 66155.0        # 2040 Feb 28
+_EARTH_INTLEN_D = 2.0
+_EARTH_NCOEF = 16
+_SUN_INTLEN_D = 16.0
+_SUN_NCOEF = 14
+_VERSION = 1
+
+
+def cache_dir() -> str:
+    d = os.environ.get(ENV_DIR) or os.path.join(
+        os.path.expanduser("~"), ".cache", "presto_tpu")
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+def builtin_kernel(mjd_lo: float = BUILTIN_MJD_LO,
+                   mjd_hi: float = BUILTIN_MJD_HI) -> str:
+    """Path of the builtin EPV2000-fitted .bsp, generating it into
+    the cache on first use.  Deterministic (pure function of the
+    shipped series + fit geometry), so the cache never goes stale
+    except across _VERSION bumps, which change the filename."""
+    path = os.path.join(cache_dir(), "epv_builtin_v%d_%d_%d.bsp"
+                        % (_VERSION, int(mjd_lo), int(mjd_hi)))
+    if os.path.exists(path):
+        return path
+    from presto_tpu.astro.ephem import get_ephemeris
+    from presto_tpu.astro.spk import (AU_KM, DAY_S, EARTH, J2000_JD,
+                                      SSB, SUN)
+    from presto_tpu.astro.spkwrite import (type2_records_batched,
+                                           write_spk)
+    eph = get_ephemeris("EPV2000")
+    et0 = (mjd_lo + 2400000.5 - J2000_JD) * DAY_S
+
+    def earth_km(et):
+        jd = J2000_JD + np.asarray(et) / DAY_S
+        p, _v = eph.earth_posvel(jd)
+        return p * AU_KM
+
+    def sun_km(et):
+        jd = J2000_JD + np.asarray(et) / DAY_S
+        return eph.sun_pos(jd) * AU_KM
+
+    ndays = mjd_hi - mjd_lo
+    n_e = int(np.ceil(ndays / _EARTH_INTLEN_D))
+    n_s = int(np.ceil(ndays / _SUN_INTLEN_D))
+    tmp = path + ".tmp.%d" % os.getpid()
+    write_spk(tmp, [
+        (EARTH, SSB, 2, et0, _EARTH_INTLEN_D * DAY_S,
+         type2_records_batched(earth_km, et0, _EARTH_INTLEN_D * DAY_S,
+                               n_e, _EARTH_NCOEF)),
+        (SUN, SSB, 2, et0, _SUN_INTLEN_D * DAY_S,
+         type2_records_batched(sun_km, et0, _SUN_INTLEN_D * DAY_S,
+                               n_s, _SUN_NCOEF)),
+    ])
+    os.replace(tmp, path)       # atomic: concurrent first-users race
+    return path                 # benignly
+
+
+def _sha256(path: str) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for blk in iter(lambda: f.read(1 << 20), b""):
+            h.update(blk)
+    return h.hexdigest()
+
+
+def fetch_kernel(name: str = "de440s.bsp",
+                 url: str = DE440S_URL) -> str:
+    """Download a real JPL kernel into the cache (gated, pinned).
+
+    Refuses unless PRESTO_TPU_ALLOW_DOWNLOAD=1.  On first fetch the
+    SHA256 is recorded beside the file; later calls (and
+    find_de_kernel) verify the file against its pin so silent
+    corruption or substitution fails loudly."""
+    path = os.path.join(cache_dir(), name)
+    pin = path + ".sha256"
+    if os.path.exists(path):
+        if os.path.exists(pin):
+            want = open(pin).read().strip()
+            got = _sha256(path)
+            if got != want:
+                raise RuntimeError(
+                    "kernel %s fails its SHA256 pin (%s != %s): "
+                    "delete both to re-fetch" % (path, got, want))
+        return path
+    if os.environ.get(ENV_ALLOW) != "1":
+        raise PermissionError(
+            "downloading %s requires %s=1 (air-gap default); or place "
+            "the kernel at %s yourself" % (url, ENV_ALLOW, path))
+    import urllib.request
+    tmp = path + ".tmp.%d" % os.getpid()
+    with urllib.request.urlopen(url) as r, open(tmp, "wb") as f:
+        while True:
+            blk = r.read(1 << 20)
+            if not blk:
+                break
+            f.write(blk)
+    os.replace(tmp, path)
+    with open(pin, "w") as f:
+        f.write(_sha256(path) + "\n")
+    return path
+
+
+def find_de_kernel():
+    """A real JPL kernel already in the cache (de*.bsp, pin-verified
+    when pinned), or None."""
+    d = cache_dir()
+    for fn in sorted(os.listdir(d)):
+        if fn.lower().startswith("de") and fn.lower().endswith(".bsp"):
+            path = os.path.join(d, fn)
+            pin = path + ".sha256"
+            if os.path.exists(pin):
+                if _sha256(path) != open(pin).read().strip():
+                    raise RuntimeError(
+                        "kernel %s fails its SHA256 pin: delete both "
+                        "to re-fetch" % path)
+            return path
+    return None
+
+
+_warned = False
+
+
+def resolve_kernel():
+    """(path, grade) of the best available kernel: a real DE file
+    ('de') if present or fetchable under the download gate, else the
+    builtin EPV2000 kernel ('epv', sub-50-µs absolute — warned
+    once)."""
+    global _warned
+    de = find_de_kernel()
+    if de is None and os.environ.get(ENV_ALLOW) == "1":
+        try:
+            de = fetch_kernel()
+        except Exception as e:              # offline despite the gate
+            warnings.warn("kernel auto-fetch failed (%s); using the "
+                          "builtin EPV2000 kernel" % e)
+    if de is not None:
+        return de, "de"
+    if not _warned:
+        _warned = True
+        warnings.warn(
+            "no JPL DE kernel in %s: using the builtin EPV2000 kernel "
+            "(4.6 km RMS vs DE405, sub-50-us absolute Roemer). For "
+            "sub-us absolute timing, place a real kernel there or set "
+            "%s=1." % (cache_dir(), ENV_ALLOW))
+    return builtin_kernel(), "epv"

@@ -1,92 +1,19 @@
 """Synthetic SPK (.bsp) kernel writer for tests.
 
-No JPL kernel ships in this environment (the DE file is user-supplied,
-exactly as TEMPO requires), so SPK-path tests synthesize kernels to
-the NAIF DAF/SPK spec: Chebyshev segments fitted to one of the
-framework's own ephemerides.  Shared by tests/test_spk.py (reader
-validation) and tests/test_timing_e2e.py (the sub-us TOA acceptance).
+The DAF/SPK writer itself is product code now
+(presto_tpu/astro/spkwrite.py — it also generates the zero-setup
+builtin kernel); this module re-exports it for the SPK-path tests and
+keeps the test-only DE-grade synthetic kernel builder.  Shared by
+tests/test_spk.py (reader validation) and tests/test_timing_e2e.py
+(the sub-us TOA acceptance).
 """
-
-import struct
 
 import numpy as np
 
 from presto_tpu.astro.spk import (AU_KM, DAY_S, EARTH, EMB, J2000_JD,
                                   SSB, SUN)
-
-NCOEF = 12
-
-
-def cheby_fit(fn, t0, t1, ncoef):
-    """Chebyshev coefficients of fn over [t0, t1] (3 components)."""
-    k = np.arange(ncoef)
-    x = np.cos(np.pi * (k + 0.5) / ncoef)          # Chebyshev nodes
-    t = 0.5 * (t0 + t1) + 0.5 * (t1 - t0) * x
-    y = fn(t)                                      # [ncoef, 3]
-    T = np.cos(np.outer(np.arccos(x), k))          # [ncoef, ncoef]
-    c = 2.0 / ncoef * T.T @ y                      # [ncoef, 3]
-    c[0] *= 0.5
-    return c.T                                     # [3, ncoef]
-
-
-def write_spk(path, segments):
-    """Minimal single-summary-record DAF/SPK writer.
-
-    segments: list of (target, center, data_type, init, intlen,
-    records[n, rsize]) — enough structure to exercise the reader's
-    address arithmetic, summary walk, and both Chebyshev data types.
-    """
-    nd, ni = 2, 6
-    # element data begins at record 4 (1:file, 2:summary, 3:names)
-    arrays = []
-    addr = (4 - 1) * 128 + 1                       # 1-indexed doubles
-    summaries = []
-    for (tgt, ctr, dtype, init, intlen, recs) in segments:
-        n, rsize = recs.shape
-        flat = np.concatenate([recs.ravel(),
-                               [init, intlen, float(rsize), float(n)]])
-        a0, a1 = addr, addr + len(flat) - 1
-        et0 = init
-        et1 = init + intlen * n
-        summaries.append((et0, et1, tgt, ctr, 1, dtype, a0, a1))
-        arrays.append(flat)
-        addr = a1 + 1
-
-    file_rec = bytearray(1024)
-    file_rec[0:8] = b"DAF/SPK "
-    file_rec[8:16] = struct.pack("<ii", nd, ni)
-    file_rec[16:76] = b"synthetic kernel".ljust(60)
-    file_rec[76:88] = struct.pack("<iii", 2, 2, addr)  # FWARD BWARD FREE
-    file_rec[88:96] = b"LTL-IEEE"
-
-    sum_rec = bytearray(1024)
-    sum_rec[0:24] = struct.pack("<ddd", 0.0, 0.0, float(len(summaries)))
-    for i, (et0, et1, tgt, ctr, frame, dtype, a0, a1) in \
-            enumerate(summaries):
-        off = 24 + i * 40
-        sum_rec[off:off + 40] = struct.pack("<dd6i", et0, et1, tgt, ctr,
-                                            frame, dtype, a0, a1)
-    name_rec = b" " * 1024
-
-    data = np.concatenate(arrays)
-    with open(path, "wb") as f:
-        f.write(bytes(file_rec))
-        f.write(bytes(sum_rec))
-        f.write(name_rec)
-        f.write(data.astype("<f8").tobytes())
-        f.write(b"\0" * ((-f.tell()) % 1024))
-
-
-def type2_records(fn_km, et0, intlen, nrec, ncoef=NCOEF):
-    """Type-2 (Chebyshev position) records fitting fn_km(et) -> km."""
-    out = []
-    for i in range(nrec):
-        t0 = et0 + i * intlen
-        mid, radius = t0 + 0.5 * intlen, 0.5 * intlen
-        c = cheby_fit(lambda tau: fn_km(mid + tau * radius),
-                      -1.0, 1.0, ncoef)
-        out.append(np.concatenate([[mid, radius], c.ravel()]))
-    return np.asarray(out)
+from presto_tpu.astro.spkwrite import (NCOEF, cheby_fit,  # noqa: F401
+                                       type2_records, write_spk)
 
 
 def make_synth_kernel(path, mjd_start, ndays, ephem="DE405",
