@@ -42,8 +42,14 @@ def _ra_str_to_sigproc(s) -> float:
     from presto_tpu.utils.psr import rad_to_hms
     try:
         if isinstance(s, str) and ":" not in s and " " not in s.strip():
-            # bare number in a string: hours
-            rad = float(s) * np.pi / 12.0
+            # Bare number in a string: hours by convention — but some
+            # PSRFITS writers store decimal DEGREES here.  Values
+            # >= 24 cannot be hours: treat as degrees (ADVICE r4);
+            # the ambiguous 0-24 range stays hours (documented
+            # convention), values in it are wrong by 15x only for
+            # degree-writing sources within 24 deg of RA 0.
+            v = float(s)
+            rad = v * np.pi / (12.0 if abs(v) < 24.0 else 180.0)
         else:
             rad = parse_ra(s)
     except (ValueError, IndexError, TypeError):

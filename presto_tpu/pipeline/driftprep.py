@@ -166,10 +166,22 @@ def split_drift_scan(rawfiles: Sequence[str], outdir: str = ".",
                         # same sample count AND same start time: a
                         # rerun with a different overlap_factor keeps
                         # nsamp but shifts start_sample — names can
-                        # still collide at tag resolution
+                        # still collide at tag resolution.  Band
+                        # geometry and sample format must also match:
+                        # a rerun against a different input file (or
+                        # requantization) keeps nsamp/tstart but must
+                        # not keep the stale cut (ADVICE r4).
+                        oh = old.header
                         reuse = (int(old.nspectra) == p.nsamp
-                                 and abs(old.header.tstart - p.tstart)
-                                 < 0.5 * hdr.tsamp / 86400.0)
+                                 and abs(oh.tstart - p.tstart)
+                                 < 0.5 * hdr.tsamp / 86400.0
+                                 and oh.nchans == hdr.nchans
+                                 and oh.nbits == (
+                                     8 if getattr(hdr, "nbits", 8)
+                                     not in (8, 16, 32) else hdr.nbits)
+                                 and abs(oh.fch1 - hdr.fch1) < 1e-9
+                                 and abs(oh.foff - hdr.foff) < 1e-12
+                                 and abs(oh.tsamp - hdr.tsamp) < 1e-12)
                 except Exception:
                     reuse = False     # unreadable: rewrite it
                 if reuse:

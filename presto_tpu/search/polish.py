@@ -365,8 +365,19 @@ def optimize_accelcands(amps: np.ndarray, cands, T: float,
     # opt-in (PRESTO_TPU_POLISH_FALLBACK=1): at survey scale it costs
     # ~70 ms x thousands of noise candidates for no list change.
     import os as _os
-    use_fb = (_os.environ.get("PRESTO_TPU_POLISH_FALLBACK", "0") == "1"
-              and amps_host is not None)
+    fb_requested = _os.environ.get("PRESTO_TPU_POLISH_FALLBACK",
+                                   "0") == "1"
+    use_fb = fb_requested and amps_host is not None
+    if fb_requested and amps_host is None and np.any(edge):
+        # the requested scipy referee NEEDS the host spectrum: with a
+        # device-resident pairs array it cannot run — say so rather
+        # than silently skipping the opt-in (ADVICE r4)
+        import warnings
+        warnings.warn(
+            "PRESTO_TPU_POLISH_FALLBACK=1 but the spectrum is device-"
+            "resident (no host amps): %d edge-pinned candidate(s) "
+            "keep their batched-grid values; pass a NumPy spectrum "
+            "to enable the scipy referee" % int(np.sum(edge)))
 
     pair_lo = np.concatenate([[0], np.cumsum(nh)])
     for i in range(nc):
