@@ -54,7 +54,11 @@ WORKLOAD = {"accel_numbins": 1 << 21, "accel_zmax": 200,
             "sp_nseries": 128, "sp_nsamples": 1 << 20,
             "sp_threshold": 5.0,
             "jerk_numbins": 1 << 20, "jerk_zmax": 100,
-            "jerk_wmax": 300, "jerk_numharm": 4}
+            "jerk_wmax": 300, "jerk_numharm": 4,
+            # r5 rows: config-3 amortized over a DM fan-out, config-1
+            # prepdata single-DM dedispersion (VERDICT r4 weak #3/#4)
+            "accel3_numdms": 16,
+            "prep_numchan": 128, "prep_nsamples": 1 << 22}
 
 
 def load_cpu_baseline():
@@ -226,6 +230,112 @@ def bench_accel3():
     return best, warm, len(cands)
 
 
+def make_accel3_batch():
+    """The config-3 DM fan-out batch (shared workload contract):
+    trial 0 is the exact single-trial config-3 spectrum, the rest are
+    fresh noise with the same tone set shifted per trial (same
+    candidate-count scale per trial, so per-trial cost is
+    comparable)."""
+    numbins, nd = WORKLOAD["accel_numbins"], WORKLOAD["accel3_numdms"]
+    batch = np.empty((nd, numbins, 2), np.float32)
+    batch[0] = make_accel_input()
+    rng = np.random.default_rng(2025)
+    for d in range(1, nd):
+        re = rng.normal(size=numbins).astype(np.float32)
+        im = rng.normal(size=numbins).astype(np.float32)
+        batch[d] = np.stack([re, im], -1)
+        for r0 in (12345, 123456, 765432):
+            batch[d, r0 + 17 * d] = (300.0, 0.0)
+    return batch
+
+
+def bench_accel3_amortized():
+    """Config 3 the way the survey RUNS it (VERDICT r4 weak #3): one
+    search_many over a 16-trial DM fan-out (spectra device-resident,
+    batched plane builds + batched scans), then per-trial candidate
+    flow (eliminate/dedup + batched polish against that trial's
+    spectrum).  Reported as per-trial seconds; the CPU baseline is
+    the measured single-trial config-3 twin, which has no batching to
+    amortize (the reference's accelsearch is likewise invoked once
+    per .dat)."""
+    import jax.numpy as jnp
+    from presto_tpu.search.accel import (AccelConfig, AccelSearch,
+                                         eliminate_harmonics,
+                                         remove_duplicates)
+    from presto_tpu.search.polish import optimize_accelcands
+
+    nd = WORKLOAD["accel3_numdms"]
+    batch = jnp.asarray(make_accel3_batch())
+    float(batch.sum())                  # settle the upload
+    cfg = AccelConfig(zmax=0, numharm=WORKLOAD["accel3_numharm"],
+                      sigma=WORKLOAD["accel3_sigma"])
+    s = AccelSearch(cfg, T=ACCEL_T, numbins=batch.shape[1])
+
+    def run():
+        res = s.search_many(batch)
+        ntot = 0
+        for d, raw in enumerate(res):
+            kept = remove_duplicates(eliminate_harmonics(raw))
+            ocs = optimize_accelcands(batch[d], kept, ACCEL_T,
+                                      s.numindep, with_props=False)
+            ntot += len(ocs)
+        return ntot
+
+    t0 = time.time()
+    n = run()                           # warmup/compile
+    warm = time.time() - t0
+    best = float("inf")
+    for _ in range(2):
+        t0 = time.time()
+        n = run()
+        best = min(best, time.time() - t0)
+    return best / nd, warm, n, nd
+
+
+def bench_prepdata():
+    """Config 1 (prepdata): single-DM dedispersion of a 128-chan
+    stream to one time series, compute-only and device-resident
+    (the real prepdata is reader-I/O-bound; the compute rate is what
+    the backend contributes — BASELINE.md documents the transfer
+    story for this link separately)."""
+    import jax
+    import jax.numpy as jnp
+    from presto_tpu.ops.dedispersion import dedisperse_series
+
+    numchan, N = WORKLOAD["prep_numchan"], WORKLOAD["prep_nsamples"]
+    bins = make_prep_delays()
+    blocks = jax.jit(
+        lambda key: jax.random.normal(key, (numchan, N),
+                                      dtype=jnp.float32)
+    )(jax.random.PRNGKey(5))
+    blocks.block_until_ready()
+
+    @jax.jit
+    def run(x):
+        out = dedisperse_series(x, jnp.asarray(bins))
+        return out[::4096].sum()
+
+    t0 = time.time()
+    float(run(blocks))
+    warm = time.time() - t0
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.time()
+        float(run(blocks))
+        best = min(best, time.time() - t0)
+    return N / best, warm, best
+
+
+def make_prep_delays():
+    """Config-1 delay ladder (shared workload contract): the
+    quadratic nu^-2 shape of a real DM at survey magnitudes."""
+    numchan = WORKLOAD["prep_numchan"]
+    c = np.arange(numchan, dtype=np.float64)
+    return (4000.0 * ((numchan / (numchan + c)) ** 2
+                      - (numchan / (2 * numchan)) ** 2)
+            ).astype(np.int32).clip(min=0)
+
+
 def make_sp_series():
     """The SP-bench series BOTH bench scripts must search (shared so
     the CPU/TPU twins cannot drift; part of the workload contract)."""
@@ -318,6 +428,15 @@ def main():
             "cpu": round(c3_cpu, 1) if c3_cpu else None,
             "vs_baseline": round(c3_cpu / c3_s, 2) if c3_cpu else None,
             "ncands": c3_n, "warmup_s": round(c3_warm, 1)}
+        (c3a_s, c3a_warm, c3a_n,
+         c3a_nd) = bench_accel3_amortized()
+        extra["config3_amortized"] = {
+            "value": round(c3a_s, 3), "unit": "s/trial",
+            "numdms": c3a_nd,
+            "cpu": round(c3_cpu, 1) if c3_cpu else None,
+            "vs_baseline": round(c3_cpu / c3a_s, 1) if c3_cpu
+            else None,
+            "ncands": c3a_n, "warmup_s": round(c3a_warm, 1)}
         sp_s, sp_warm, sp_n = bench_singlepulse()
         sp_cpu = cpu.get("sp_seconds")
         extra["singlepulse"] = {
@@ -327,11 +446,26 @@ def main():
             "nevents": sp_n, "warmup_s": round(sp_warm, 1)}
         (jk_cells, jk_warm, jk_s, jk_tot,
          jk_n) = bench_jerk()
+        jk_cpu = cpu.get("jerk_seconds")
         extra["jerk"] = {
             "value": round(jk_cells, 1), "unit": "cells/s",
-            "cpu": None, "vs_baseline": None,
+            "cpu": round(jk_cpu, 1) if jk_cpu else None,
+            "vs_baseline": round(jk_cpu / jk_s, 2) if jk_cpu
+            else None,
+            "cpu_note": ("cpu twin sums subharmonics from the "
+                         "same-w plane (conservative lower-bound "
+                         "ratio; accel_ref.timed_jerk_ref)"
+                         if jk_cpu else None),
             "seconds": round(jk_s, 2), "cells": jk_tot,
             "ncands": jk_n, "warmup_s": round(jk_warm, 1)}
+        pp_rate, pp_warm, pp_s = bench_prepdata()
+        pp_cpu = cpu.get("prep_seconds")
+        extra["config1_prepdata"] = {
+            "value": round(pp_rate, 1), "unit": "samples/s",
+            "cpu": round(pp_cpu, 3) if pp_cpu else None,
+            "vs_baseline": round(pp_cpu / pp_s, 2) if pp_cpu
+            else None,
+            "seconds": round(pp_s, 4), "warmup_s": round(pp_warm, 1)}
 
     print(json.dumps({
         "metric": "ffdot_cells_per_sec_zmax200_nh8",

@@ -142,6 +142,52 @@ def bench_sp_cpu():
             "sp_nevents": sum(len(c) for (c, _s, _b) in res)}
 
 
+def bench_jerk_cpu():
+    """Jerk-search CPU twin (VERDICT r4 weak #4): per-w plane builds
+    + staged search via accel_ref.timed_jerk_ref — CONSERVATIVE (its
+    docstring: subharmonic sums read the same-w plane, so the true
+    reference would be slower and every device ratio derived from
+    this number is a lower bound).  Kernel banks are untimed on both
+    sides."""
+    from presto_tpu.search.accel import AccelConfig
+    from presto_tpu.search.accel_ref import timed_jerk_ref
+
+    numbins = WORKLOAD["jerk_numbins"]
+    rng = np.random.default_rng(11)
+    pairs = np.stack([rng.normal(size=numbins), rng.normal(
+        size=numbins)], -1).astype(np.float32)
+    pairs[123456] = (200.0, 0.0)
+    cfg = AccelConfig(zmax=WORKLOAD["jerk_zmax"],
+                      wmax=WORKLOAD["jerk_wmax"],
+                      numharm=WORKLOAD["jerk_numharm"], sigma=6.0)
+    n, el, cells = timed_jerk_ref(pairs, cfg, ACCEL_T,
+                                  dtype=np.float32)
+    return {"jerk_seconds": el, "jerk_cells": cells,
+            "jerk_ncands": n}
+
+
+def bench_prepdata_cpu(repeats=3):
+    """Config-1 twin: single-DM shift-and-sum of 128 chans to one
+    series (prepdata's compute core, dispersion.c:125-161 semantics),
+    vectorized slice adds — memory-bandwidth-bound like the C loop."""
+    from bench import make_prep_delays
+    numchan, N = WORKLOAD["prep_numchan"], WORKLOAD["prep_nsamples"]
+    rng = np.random.default_rng(5)
+    raw = rng.normal(size=(numchan, N)).astype(np.float32)
+    bins = np.asarray(make_prep_delays(), np.int64)
+    out_len = N - int(bins.max())
+    best = float("inf")
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        out = np.zeros(out_len, np.float32)
+        for c in range(numchan):
+            out += raw[c, bins[c]:bins[c] + out_len]
+        checksum = float(out[::4096].sum())
+        best = min(best, time.perf_counter() - t0)
+    return {"prep_seconds": best, "prep_samples_per_sec": N / best,
+            "prep_checksum": checksum}
+
+
 def main():
     import scipy
 
@@ -150,6 +196,8 @@ def main():
     dedisp = bench_dedisp_cpu()
     accel3 = bench_accel3_cpu()
     spb = bench_sp_cpu()
+    jerk = bench_jerk_cpu()
+    prep = bench_prepdata_cpu()
     out = {
         # workload fingerprint: bench.py validates this against its
         # own config so the TPU/CPU ratio can never silently compare
@@ -164,6 +212,11 @@ def main():
         "config3_ncands": accel3["config3_ncands"],
         "sp_seconds": round(spb["sp_seconds"], 2),
         "sp_nevents": spb["sp_nevents"],
+        "jerk_seconds": round(jerk["jerk_seconds"], 2),
+        "jerk_cells": jerk["jerk_cells"],
+        "jerk_ncands": jerk["jerk_ncands"],
+        "prep_seconds": round(prep["prep_seconds"], 4),
+        "prep_samples_per_sec": round(prep["prep_samples_per_sec"], 1),
         "nproc": os.cpu_count(),
         "numpy": np.__version__,
         "scipy": scipy.__version__,
@@ -193,6 +246,8 @@ def main():
         ("dedisp_seconds", ("dedisp_dm_trials_per_sec",)),
         ("config3_seconds", ("config3_ncands",)),
         ("sp_seconds", ("sp_nevents",)),
+        ("jerk_seconds", ("jerk_cells", "jerk_ncands")),
+        ("prep_seconds", ("prep_samples_per_sec",)),
     )
     try:
         with open(path) as f:
