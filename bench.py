@@ -57,7 +57,7 @@ WORKLOAD = {"accel_numbins": 1 << 21, "accel_zmax": 200,
             "jerk_wmax": 300, "jerk_numharm": 4,
             # r5 rows: config-3 amortized over a DM fan-out, config-1
             # prepdata single-DM dedispersion (VERDICT r4 weak #3/#4)
-            "accel3_numdms": 16,
+            "accel3_numdms": 64,
             "prep_numchan": 128, "prep_nsamples": 1 << 22}
 
 
@@ -251,7 +251,8 @@ def make_accel3_batch():
 
 def bench_accel3_amortized():
     """Config 3 the way the survey RUNS it (VERDICT r4 weak #3): one
-    search_many over a 16-trial DM fan-out (spectra device-resident,
+    search_many over a WORKLOAD["accel3_numdms"]-trial DM fan-out
+    (spectra device-resident,
     batched plane builds + batched scans), then per-trial candidate
     flow (eliminate/dedup + batched polish against that trial's
     spectrum).  Reported as per-trial seconds; the CPU baseline is
@@ -262,7 +263,7 @@ def bench_accel3_amortized():
     from presto_tpu.search.accel import (AccelConfig, AccelSearch,
                                          eliminate_harmonics,
                                          remove_duplicates)
-    from presto_tpu.search.polish import optimize_accelcands
+    from presto_tpu.search.polish import optimize_accelcands_batched
 
     nd = WORKLOAD["accel3_numdms"]
     batch = jnp.asarray(make_accel3_batch())
@@ -273,13 +274,14 @@ def bench_accel3_amortized():
 
     def run():
         res = s.search_many(batch)
-        ntot = 0
-        for d, raw in enumerate(res):
-            kept = remove_duplicates(eliminate_harmonics(raw))
-            ocs = optimize_accelcands(batch[d], kept, ACCEL_T,
-                                      s.numindep, with_props=False)
-            ntot += len(ocs)
-        return ntot
+        kept = [remove_duplicates(eliminate_harmonics(raw))
+                for raw in res]
+        # cross-trial batched polish: every trial's candidates
+        # against its own spectrum in ONE device pipeline (per-trial
+        # calls each pay the link's ~120 ms dispatch floor)
+        ocs = optimize_accelcands_batched(batch, kept, ACCEL_T,
+                                          s.numindep)
+        return sum(len(o) for o in ocs)
 
     t0 = time.time()
     n = run()                           # warmup/compile
@@ -312,7 +314,11 @@ def bench_prepdata():
 
     @jax.jit
     def run(x):
-        out = dedisperse_series(x, jnp.asarray(bins))
+        # bins stay a NumPy array so dedisperse_series computes its
+        # int(max) trim statically (a device array would force a
+        # host sync at trace time); the slices themselves use the
+        # same dynamic_slice path either way
+        out = dedisperse_series(x, bins)
         return out[::4096].sum()
 
     t0 = time.time()

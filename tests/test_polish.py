@@ -208,3 +208,33 @@ def test_jerk_polish_recovers_rzw():
                                      cands[0].w)
     assert abs(out[0].r - r_s) < 0.05
     assert abs(out[0].w - w_s) < 5.0
+
+
+def test_batched_multitrial_polish_matches_per_trial():
+    """optimize_accelcands_batched (cross-trial, one device pipeline)
+    returns the same refined values as per-trial optimize_accelcands
+    calls — the survey's amortized-polish contract."""
+    import jax.numpy as jnp
+    from presto_tpu.search.accel import AccelConfig, AccelSearch
+    from presto_tpu.search.polish import (optimize_accelcands,
+                                          optimize_accelcands_batched)
+    rng = np.random.default_rng(17)
+    numbins, T, ns = 1 << 14, 150.0, 3
+    batch = rng.normal(size=(ns, numbins, 2)).astype(np.float32)
+    for d in range(ns):
+        batch[d, 2500 + 401 * d] = (70.0, 0.0)
+        batch[d, 9000 + 100 * d] = (55.0, 0.0)
+    cfg = AccelConfig(zmax=8, numharm=2, sigma=3.0)
+    s = AccelSearch(cfg, T=T, numbins=numbins)
+    lists = s.search_many(batch)
+    assert all(lists), "every trial must yield candidates"
+    dev = jnp.asarray(batch)
+    per = [optimize_accelcands(dev[d], lists[d], T, s.numindep,
+                               with_props=False) for d in range(ns)]
+    bat = optimize_accelcands_batched(dev, lists, T, s.numindep)
+    assert [len(x) for x in bat] == [len(x) for x in per]
+    for a, b in zip(per, bat):
+        for oa, ob in zip(a, b):
+            assert oa.r == pytest.approx(ob.r, abs=1e-9)
+            assert oa.z == pytest.approx(ob.z, abs=1e-9)
+            assert oa.sigma == pytest.approx(ob.sigma, abs=1e-9)

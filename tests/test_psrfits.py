@@ -182,3 +182,18 @@ def test_header_coordinate_forms():
     assert abs(_dec_str_to_sigproc("-0:30:00") - -3000.0) < 1e-6
     assert _ra_str_to_sigproc("") == 0.0
     assert _dec_str_to_sigproc(None) == 0.0
+
+
+def test_bare_numeric_ra_degrees_plausibility():
+    """Bare numeric RA strings >= 24 cannot be hours: they are decimal
+    degrees from degree-writing PSRFITS sources and must not be
+    mis-packed by 15x (ADVICE r4).  The 0-24 range stays hours (the
+    documented convention)."""
+    from presto_tpu.io.psrfits import _ra_str_to_sigproc
+    # 83.633 deg == 5h34m31.92s
+    packed = _ra_str_to_sigproc("83.633")
+    assert abs(packed - 53431.92) < 0.05
+    # small values remain hours
+    assert abs(_ra_str_to_sigproc("5.5755") - 53431.8) < 0.2
+    # and the hh:mm:ss form is untouched
+    assert abs(_ra_str_to_sigproc("05:34:21") - 53421.0) < 1e-6

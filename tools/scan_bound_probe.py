@@ -1,0 +1,124 @@
+"""Staged-scan dispatch decomposition (VERDICT r4 weak #8).
+
+The r4 table recorded the aligned-geometry staged scan at 205 ms
+single-dispatch (r3 unaligned: 165 ms) while the amortized scan sat
+at ~98 ms, at its ~100-120 ms bound.  This probe separates the three
+contributions on the real chip so BASELINE.md can state what the
+single-dispatch number is made of:
+
+  * dispatch+sync floor: a trivial jit round trip through the
+    tunneled link (the irreducible per-dispatch cost OF THIS LINK);
+  * scan amortized: N in-jit scans per dispatch (the PCIe-host
+    number);
+  * scan single-dispatch: one scan per dispatch, best-of-N;
+
+for BOTH the aligned/direct-plane geometry (default engine) and the
+unaligned default-uselen geometry (PRESTO_TPU_ACCEL_ENGINE=fft), via
+a subprocess per engine (the engine knob is read at import).
+
+Run: python tools/scan_bound_probe.py            (~3 min)
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+CHILD = r"""
+import json, os, sys, time
+sys.path.insert(0, %(repo)r)
+import numpy as np
+import jax
+import jax.numpy as jnp
+from bench import WORKLOAD, ACCEL_T, make_accel_input
+from presto_tpu.search.accel import AccelConfig, AccelSearch
+
+assert jax.devices()[0].platform == "tpu"
+
+def sync(x):
+    return float(jnp.ravel(x)[0].astype(jnp.float32))
+
+out = {"engine_env": os.environ.get("PRESTO_TPU_ACCEL_ENGINE",
+                                    "auto")}
+cfg = AccelConfig(zmax=WORKLOAD["accel_zmax"],
+                  numharm=WORKLOAD["accel_numharm"], sigma=6.0)
+s = AccelSearch(cfg, T=ACCEL_T, numbins=WORKLOAD["accel_numbins"])
+out["uselen"] = s.cfg.uselen
+out["plb"] = s._plb_hw_eff is not None
+pairs = jnp.asarray(make_accel_input())
+plane = s.build_plane(pairs)
+out["plane_shape"] = list(plane.shape)
+splan = s._slab_plan(plane.shape[1], 1 << 20)
+slab, k, scanner, start_cols = splan
+scols = jnp.asarray(start_cols, dtype=jnp.int32)
+out["nslabs"] = len(start_cols)
+
+# dispatch+sync floor through the tunnel
+tiny = jax.jit(lambda x: x + 1.0)
+sync(tiny(jnp.zeros(8)))
+floor = min((lambda t0: (sync(tiny(jnp.zeros(8))),
+                         time.time() - t0)[1])(time.time())
+            for _ in range(7))
+out["dispatch_floor_ms"] = round(floor * 1e3, 1)
+
+# single-dispatch scan
+packed = scanner(plane, scols)
+sync(packed)                                 # compile + settle
+best = float("inf")
+for _ in range(5):
+    t0 = time.time()
+    sync(scanner(plane, scols))
+    best = min(best, time.time() - t0)
+out["scan_single_ms"] = round(best * 1e3, 1)
+
+# amortized: N scans inside ONE dispatch
+NREP = 8
+@jax.jit
+def many(P, sc):
+    def body(c, i):
+        # per-iteration input variation (start columns shifted by
+        # i mod 2) + full-output consumption: otherwise XLA hoists the
+        # loop-invariant scan out (LICM) or dead-code-eliminates
+        # unconsumed stages, and the "amortized" number is fiction
+        p = scanner.body(P, sc + (i %% 2))
+        return c + p.sum(), None
+    c, _ = jax.lax.scan(body, jnp.int32(0),
+                        jnp.arange(NREP, dtype=jnp.int32))
+    return c
+sync(many(plane, scols))
+best = float("inf")
+for _ in range(3):
+    t0 = time.time()
+    sync(many(plane, scols))
+    best = min(best, time.time() - t0)
+out["scan_amortized_ms"] = round(best * 1e3 / NREP, 1)
+
+print("PROBE " + json.dumps(out))
+"""
+
+
+def run_one(engine):
+    env = dict(os.environ)     # keep PYTHONPATH: the axon TPU plugin
+    if engine:                 # registers through sitecustomize
+        env["PRESTO_TPU_ACCEL_ENGINE"] = engine
+    r = subprocess.run([sys.executable, "-c",
+                        CHILD % dict(repo=REPO)],
+                       env=env, capture_output=True, text=True,
+                       timeout=900, cwd=REPO)
+    if r.returncode != 0:
+        raise RuntimeError(r.stderr[-2000:])
+    line = next(ln for ln in r.stdout.splitlines()
+                if ln.startswith("PROBE "))
+    return json.loads(line[6:])
+
+
+def main():
+    res = {"aligned_default": run_one(None),
+           "unaligned_fft": run_one("fft")}
+    print(json.dumps(res, indent=1))
+
+
+if __name__ == "__main__":
+    main()

@@ -134,6 +134,22 @@ from tools.target_scale import (NUMCHAN, NSUB, NUMPTS, NSAMP, NBLOCKS,
 from presto_tpu.ops.dedispersion import dedisp_subbands_block
 
 
+
+def _probe_cache_path():
+    """Deterministic cache path of the host-built probe spectrum —
+    the ONE fingerprint both the full pipeline and --referee-only
+    share (the key must cover EVERY generation parameter, so edits to
+    the synthetic workload invalidate the cached probe)."""
+    import hashlib
+    from tools import target_scale as ts
+    chan_d, dm_d_full, dms = delays()
+    psr_dm_idx = int(np.argmin(np.abs(dms - PSR_DM)))
+    fp = hashlib.sha1(repr((ts.SEED, PSR_F0, PSR_DM, ts.PSR_AMP,
+                            NUMCHAN, NSUB, NUMPTS, NSAMP, DT,
+                            psr_dm_idx)).encode()).hexdigest()[:12]
+    return "/tmp/presto_tpu_e2e_probe_%s.npy" % fp
+
+
 def sync(x):
     return float(jnp.ravel(x)[0])
 
@@ -161,14 +177,7 @@ def main():
     # searched inside the pipeline as trial `psr_local` of its group)
     psr_local = psr_dm_idx - lo
     t0 = time.time()
-    # cache key covers EVERY generation parameter, so edits to the
-    # synthetic workload invalidate the cached probe
-    import hashlib
-    from tools import target_scale as ts
-    fp = hashlib.sha1(repr((ts.SEED, PSR_F0, PSR_DM, ts.PSR_AMP,
-                            NUMCHAN, NSUB, NUMPTS, NSAMP, DT,
-                            psr_dm_idx)).encode()).hexdigest()[:12]
-    cache = "/tmp/presto_tpu_e2e_probe_%s.npy" % fp
+    cache = _probe_cache_path()
     if os.path.exists(cache):
         probe = np.load(cache)
         out["probe_prep_host_sec"] = 0.0    # cached (deterministic)
@@ -645,10 +654,12 @@ def _referee_check(probe_pairs, srch, cfg, T_obs, workdir, psr_dm):
     # remove_duplicates collapses everything within ACCEL_CLOSEST_R
     # = 15 bins to a cluster peak, so two float32-legitimate orderings
     # of the same sidelobe forest elect representatives up to one
-    # collapse radius apart on each side — the SAME cluster radius
-    # tests/test_referee.py pins (measured r05: reps 12-14.5 bins
-    # apart with IDENTICAL cell powers both sides).
-    CLUSTER_R = 31.0
+    # collapse radius apart on each side (+1 bin of rounding slack) —
+    # the SAME cluster radius tests/test_referee.py pins (measured
+    # r05: reps 12-14.5 bins apart with IDENTICAL cell powers both
+    # sides).
+    from presto_tpu.search.accel import ACCEL_CLOSEST_R
+    CLUSTER_R = 2.0 * ACCEL_CLOSEST_R + 1.0
 
     def nearest_r(c, other):
         ro = np.asarray([o.r for o in other])
@@ -776,15 +787,10 @@ def main_referee_only():
     spectrum is cached deterministically) and patch it into the
     existing TARGETSCALE_r05.json — iterating on the equality
     invariant must not cost a 20-minute pipeline re-run."""
-    import hashlib
-    from tools import target_scale as ts
     from presto_tpu.search.accel import AccelConfig, AccelSearch
     chan_d, dm_d_full, dms = delays()
     psr_dm_idx = int(np.argmin(np.abs(dms - PSR_DM)))
-    fp = hashlib.sha1(repr((ts.SEED, PSR_F0, PSR_DM, ts.PSR_AMP,
-                            NUMCHAN, NSUB, NUMPTS, NSAMP, DT,
-                            psr_dm_idx)).encode()).hexdigest()[:12]
-    cache = "/tmp/presto_tpu_e2e_probe_%s.npy" % fp
+    cache = _probe_cache_path()
     if not os.path.exists(cache):
         raise SystemExit("no cached probe (%s): run the full tool "
                          "first" % cache)
