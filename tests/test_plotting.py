@@ -49,6 +49,19 @@ def test_show_pfd_cli(tmp_path):
     _png_ok(str(tmp_path / "c.png"))
 
 
+def test_pfd2png_cli(tmp_path):
+    """bin/pfd2png parity: .pfd files in, PNGs out (the reference's
+    pstoimg wrapper replaced by direct matplotlib rendering)."""
+    from presto_tpu.io.pfd import write_pfd
+    from presto_tpu.apps.pfd2png import main
+    paths = [str(tmp_path / name) for name in ("a.pfd", "b.pfd")]
+    for p in paths:
+        write_pfd(p, _fake_pfd())
+    assert main(paths) == 0
+    for p in paths:
+        _png_ok(p[:-4] + ".png")
+
+
 def test_plot_rfifind(tmp_path):
     from presto_tpu.plotting import plot_rfifind
     from presto_tpu.search.rfifind import rfifind
