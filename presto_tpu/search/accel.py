@@ -665,7 +665,22 @@ def _make_search_scanner(numharmstages, fracs_zinds, powcuts, slab, k,
         _, outs = jax.lax.scan(per_dm, None, Ps)
         return jnp.moveaxis(outs, 1, 0)   # [3, numdms, nslabs, stages, k]
 
+    from functools import partial
+
+    @partial(jax.jit, static_argnums=2)
+    def scan_many_compact(Ps, start_cols, m):
+        """scan_many + per-trial top-m candidate compaction in the
+        SAME dispatch: the dense [3, nd, nslabs, stages, k] tensor
+        never crosses to the host (compact_scan_packed — the D2H
+        shrink that made the e2e share device-bound, applied to the
+        library's batched path)."""
+        packed = scan_many(Ps, start_cols)
+        per_dm = jnp.moveaxis(packed, 1, 0)  # [nd, 3, nsl, st, k]
+        return jax.vmap(
+            lambda p: compact_scan_packed(p, m))(per_dm)
+
     scan_all.many = scan_many
+    scan_all.many_compact = scan_many_compact
     return scan_all
 
 
@@ -1494,7 +1509,9 @@ class AccelSearch:
         return sorted(uniq, key=lambda c: (-c.sigma, c.r))
 
     def search_many(self, pairs_batch: np.ndarray,
-                    slab: int = 1 << 20) -> List[List[AccelCand]]:
+                    slab: int = 1 << 20,
+                    compact_m: int = COMPACT_CANDS
+                    ) -> List[List[AccelCand]]:
         """Batched search over many same-length spectra — the survey's
         DM fan-out (one plane build + one scanned search dispatch per
         memory-budgeted DM group instead of per-trial dispatch storms;
@@ -1579,11 +1596,28 @@ class AccelSearch:
         for g0 in starts:
             sub = jnp.asarray(batch[g0:g0 + group])
             planes = build_many(sub, self._kern_dev)
-            vals, cidx, zrow = _unpack_scan(scanner.many(planes, scols))
-            for d in range(vals.shape[0]):
+            # per-trial top-m compaction rides the scan dispatch: the
+            # dense top-k tensor stays on device (compact_m slots per
+            # trial cross instead — the D2H that dominated slow-link
+            # surveys).  A trial overflowing the budget (pathological
+            # RFI forest) falls back to the lossless dense fetch for
+            # its group.
+            comp = np.asarray(scanner.many_compact(planes, scols,
+                                                   compact_m))
+            dense = None
+            for d in range(comp.shape[0]):
                 if g0 + d < done:
                     continue               # overlap: already collected
-                out.append(collect_dm(vals[d], cidx[d], zrow[d]))
+                try:
+                    cands = self.collect_compacted(
+                        comp[d], start_cols, requested_m=compact_m)
+                except ValueError:
+                    if dense is None:
+                        dense = _unpack_scan(
+                            scanner.many(planes, scols))
+                    vals, cidx, zrow = dense
+                    cands = collect_dm(vals[d], cidx[d], zrow[d])
+                out.append(cands)
                 done = g0 + d + 1
         return out
 

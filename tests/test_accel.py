@@ -245,3 +245,25 @@ def test_compact_collect_matches_dense():
     tiny = jax.jit(A.compact_scan_packed, static_argnums=1)(packed, 2)
     with pytest.raises(ValueError):
         s.collect_compacted(tiny, start_cols)
+
+
+def test_search_many_compact_overflow_falls_back_dense():
+    """search_many's compacted D2H path: a compact_m too small for a
+    trial's positives must fall back to the lossless dense fetch, and
+    the results must equal the default (ample-budget) path exactly."""
+    rng = np.random.default_rng(9)
+    numbins, T, nd = 1 << 14, 120.0, 3
+    batch = rng.normal(size=(nd, numbins, 2)).astype(np.float32)
+    batch[0, 3000] = (60.0, 0.0)
+    batch[1, 5000] = (50.0, 0.0)
+    batch[2, 7777] = (55.0, 0.0)
+    cfg = AccelConfig(zmax=8, numharm=2, sigma=2.0)  # low cut: many
+    s1 = AccelSearch(cfg, T=T, numbins=numbins)      # positives
+    res_default = s1.search_many(batch)
+    s2 = AccelSearch(cfg, T=T, numbins=numbins)
+    res_tiny = s2.search_many(batch, compact_m=2)    # forces fallback
+    key = lambda cl: [(c.numharm, c.r, c.z, c.power, c.sigma)
+                      for c in cl]
+    assert [key(a) for a in res_default] == [key(b) for b in res_tiny]
+    assert sum(len(a) for a in res_default) > 3 * 2  # budget really
+    # overflowed (more candidates than the tiny budget could carry)
