@@ -1308,6 +1308,20 @@ class AccelSearch:
                     c.w = w
                     all_cands.append(c)
             return self._merge_w_cands(all_cands)
+        return self._search_jerk_planes(fft_pairs, slab, fracs,
+                                        bank_for, all_cands)
+
+    def _search_jerk_planes(self, fft_pairs, slab, fracs, bank_for,
+                            all_cands):
+        """The numharm>1 jerk path: per-subharmonic-w source planes
+        over an HBM-budgeted LRU, with ALL w scans dispatched before
+        any host collection — jax dispatches are async, so the host
+        sync (the per-w np.asarray of round 4) was paying the
+        tunneled link's ~120 ms dispatch+sync floor once per w plane;
+        queueing every scan first and collecting afterwards pays it
+        once for the whole ws ladder (same float program, identical
+        candidates)."""
+        cfg = self.cfg
 
         # Per-subharmonic-w source planes over an HBM-budgeted LRU.
         # Planes in `keep` are the current scan's working set and are
@@ -1353,12 +1367,22 @@ class AccelSearch:
             return []
         slab_, k, scanner, start_cols = splan
         scols = jnp.asarray(start_cols, dtype=jnp.int32)
+        # phase 1: QUEUE every w's builds + scan (async dispatches;
+        # the device executes them back-to-back).  Pending packed
+        # outputs are ~100 KB each; planes stay governed by the LRU
+        # budget (queued executions keep their input buffers alive
+        # regardless of host-side eviction).
+        pend = []
         for w in sorted((float(x) for x in cfg.ws), key=abs):
             wsubs = [calc_required_w(f, w) for f in fracs]
             keep = set(wsubs) | {w}
             pl = plane_for(w, keep)
             subs = [plane_for(wg, keep) for wg in wsubs]
-            packed = scanner.planes(tuple([pl] + subs), scols)
+            pend.append((w, scanner.planes(tuple([pl] + subs),
+                                           scols)))
+        # phase 2: collect — the first fetch waits on the queue, the
+        # rest overlap device execution of later w planes
+        for w, packed in pend:
             for c in self._collect_packed(packed, start_cols):
                 # the plane cell is the numharm-th harmonic: its
                 # (r, z, w) all scale down to the fundamental
