@@ -117,3 +117,20 @@ def test_plot_ffdot(tmp_path):
     plot_ffdot(powers, np.arange(100, 300), np.linspace(-20, 20, 21),
                out, cands=[C()], title="t")
     _png_ok(out)
+
+
+def test_a2x_cli(tmp_path):
+    """bin/a2x parity: ASCII reports render to printable pages (PDF
+    multi-page + PNG first-page), the vendored PostScript
+    pretty-printer replaced by native matplotlib rendering."""
+    from presto_tpu.apps.a2x import main
+    txt = tmp_path / "report.txt"
+    txt.write_text("\n".join("line %03d of the report" % i
+                             for i in range(150)))
+    assert main([str(txt)]) == 0
+    pdf = tmp_path / "report.pdf"
+    assert pdf.exists() and pdf.read_bytes()[:5] == b"%PDF-"
+    out = tmp_path / "p.png"
+    assert main([str(txt), "-o", str(out), "-landscape",
+                 "-columns", "2"]) == 0
+    _png_ok(str(out))
