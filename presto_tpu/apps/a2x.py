@@ -22,7 +22,8 @@ def build_parser():
     p.add_argument("textfiles", nargs="+")
     p.add_argument("-o", default=None,
                    help="Output file for a SINGLE input (default "
-                        "<input>.pdf; .png also supported)")
+                        "<input>.pdf; a .png output renders the "
+                        "FIRST page only)")
     p.add_argument("-landscape", action="store_true")
     p.add_argument("-columns", type=int, default=1, choices=(1, 2))
     p.add_argument("-lines", type=int, default=66,
@@ -73,7 +74,11 @@ def render_text(path: str, out: str, landscape: bool = False,
             else:
                 fig.savefig(out, dpi=150)
                 plt.close(fig)
-                break                    # raster sink: first page
+                if len(pages) > 1:     # raster sink holds ONE page
+                    print("a2x: %s holds page 1 of %d — use a .pdf "
+                          "output for the full document"
+                          % (out, len(pages)))
+                break
             plt.close(fig)
     finally:
         if sink is not None:

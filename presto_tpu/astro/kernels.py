@@ -60,12 +60,20 @@ def cache_dir() -> str:
     return d
 
 
-def builtin_kernel(mjd_lo: float = BUILTIN_MJD_LO,
-                   mjd_hi: float = BUILTIN_MJD_HI) -> str:
+def builtin_kernel(mjd_lo: float = None,
+                   mjd_hi: float = None) -> str:
     """Path of the builtin EPV2000-fitted .bsp, generating it into
     the cache on first use.  Deterministic (pure function of the
     shipped series + fit geometry), so the cache never goes stale
-    except across _VERSION bumps, which change the filename."""
+    except across _VERSION bumps, which change the filename.
+
+    The default range reads BUILTIN_MJD_LO/HI at CALL time (def-time
+    defaults would freeze them, making the range un-narrowable for
+    resolve_kernel callers and un-patchable in tests)."""
+    if mjd_lo is None:
+        mjd_lo = BUILTIN_MJD_LO
+    if mjd_hi is None:
+        mjd_hi = BUILTIN_MJD_HI
     path = os.path.join(cache_dir(), "epv_builtin_v%d_%d_%d.bsp"
                         % (_VERSION, int(mjd_lo), int(mjd_hi)))
     if os.path.exists(path):

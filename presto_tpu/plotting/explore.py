@@ -204,7 +204,8 @@ HELP = """explore keys (explorefft.c / exploredat.c interaction model):
   x / o      zoom out (x2)
   < / left   shift left one full screen      , shift left 1/8 screen
   > / right  shift right one full screen     . shift right 1/8 screen
-  + / -      raise / lower the y ceiling (spectrum)
+  + / -      taller / shorter powers, i.e. lower / raise the y
+             ceiling (spectrum; explorefft.c's 'Increase height')
   s          auto-scale y
   g          center on the strongest displayed peak
   G          go to a typed frequency (Hz) / time (s)
@@ -293,9 +294,10 @@ def dispatch_key(view, key, arg: Optional[float] = None):
     if key == "d":
         if spec:
             r, p = view.peak()
+            period = "P=%.6g s" % (view.T / r) if r > 0 else "P=inf"
             return ("print",
-                    "peak: r=%.1f  f=%.9g Hz  p=%.6g Hz  norm power "
-                    "%.3f" % (r, r / view.T, view.T / r, p))
+                    "peak: r=%.1f  f=%.9g Hz  %s  norm power "
+                    "%.3f" % (r, r / view.T, period, p))
         mean, std, lo, hi = view.stats()
         return ("print", "window mean %.6g  std %.6g  min %.6g  "
                 "max %.6g" % (mean, std, lo, hi))

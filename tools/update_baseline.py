@@ -151,9 +151,14 @@ def cited_artifact(baseline_text):
 
 
 def strip_date(text):
-    """Normalize the last-update date so equality checks ignore it."""
-    return re.sub(r"\(last update \d{4}-\d{2}-\d{2};",
-                  DATE_TOKEN % "X", text)
+    """Normalize the last-update date so equality checks ignore it.
+    Matches both the historical '(last update YYYY-MM-DD;' tail and
+    the source-stamped '(from X; last update YYYY-MM-DD;' form —
+    anchoring on '(' alone would stop matching the stamped tail and
+    turn the claim gate into a timestamp comparator that goes red at
+    the next midnight."""
+    return re.sub(r"last update \d{4}-\d{2}-\d{2};",
+                  "last update X;", text)
 
 
 def main():
