@@ -1297,9 +1297,7 @@ class AccelSearch:
             # numharm == 1: no subharmonic reads — take the fused
             # build+search dispatch per w (no resident plane at all)
             for w in (float(x) for x in cfg.ws):
-                kern_dev = _fft_kernel_bank_c(
-                    jnp.asarray(bank_for(w).kern_pairs),
-                    self.kern.fftlen)
+                kern_dev = self._w_bank_dev(w, bank_for)
                 cs = self._search_fused(fft_pairs, slab, kern_dev)
                 if cs is None:
                     cs = self._search_plane(
@@ -1310,6 +1308,35 @@ class AccelSearch:
             return self._merge_w_cands(all_cands)
         return self._search_jerk_planes(fft_pairs, slab, fracs,
                                         bank_for, all_cands)
+
+    def _w_bank_dev(self, wg: float, bank_for):
+        """Device FFT'd kernel bank for the w-plane grid value wg,
+        LRU-cached ACROSS search() calls (HBM-byte-budgeted,
+        PRESTO_TPU_WBANK_DEV_BUDGET, default 512 MB).  A steady-state
+        jerk survey re-searches many spectra with one config; without
+        this cache every search re-uploads ~1-3 MB per w bank through
+        the host link and re-FFTs it — measurable against the ~200 ms
+        per-w device work."""
+        cache = getattr(self, "_w_banks_dev_cache", None)
+        if cache is None:
+            cache = self._w_banks_dev_cache = {}
+        ent = cache.pop(wg, None)
+        if ent is None:
+            bank = bank_for(wg)
+            ent = _fft_kernel_bank_c(jnp.asarray(bank.kern_pairs),
+                                     bank.fftlen)
+            budget = int(os.environ.get(
+                "PRESTO_TPU_WBANK_DEV_BUDGET", str(512 * 2 ** 20)))
+            nbytes = int(np.prod(ent.shape)) * ent.dtype.itemsize
+            used = sum(int(np.prod(b.shape)) * b.dtype.itemsize
+                       for b in cache.values())
+            while cache and used + nbytes > budget:   # LRU: dicts
+                old = next(iter(cache))               # keep insert
+                used -= int(np.prod(cache[old].shape)) \
+                    * cache[old].dtype.itemsize       # order
+                del cache[old]
+        cache[wg] = ent               # (re)insert most-recent
+        return ent
 
     def _search_jerk_planes(self, fft_pairs, slab, fracs, bank_for,
                             all_cands):
@@ -1354,9 +1381,8 @@ class AccelSearch:
                             break
                     else:
                         break
-                bank = bank_for(wg)
-                pl = self.build_plane(fft_pairs, _fft_kernel_bank_c(
-                    jnp.asarray(bank.kern_pairs), bank.fftlen))
+                pl = self.build_plane(fft_pairs,
+                                      self._w_bank_dev(wg, bank_for))
             plane_cache[wg] = pl      # (re)insert most-recent
             return pl
 

