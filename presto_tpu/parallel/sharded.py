@@ -134,21 +134,29 @@ def make_sharded_sixstep_fft(mesh: Mesh, rows: int):
 
 
 def sharded_accel_search_many(searcher, pairs_batch, mesh: Mesh,
-                              slab: int = 1 << 20):
+                              slab: int = 1 << 20,
+                              compact_m: int = None):
     """Accelsearch over a DM fan-out with the trial axis sharded over
     `mesh` — the search-stage application of the mpiprepsubband
     invariant (SURVEY §4.8; mpiprepsubband.c:288-297's DM partition):
     each device owns numdms/n trials and runs the IDENTICAL fused
     build+scan program on its shard sequentially (one plane resident
     per device at a time), with no cross-device communication at all.
-    The packed per-stage top-k tensors gather to the host, where
-    candidate collection is byte-identical to the single-device path —
-    tests pin sharded lists == single-device lists.
+    Each trial's candidates COMPACT on-shard before the gather
+    (accel.compact_scan_packed: the dense per-stage top-k tensors are
+    the dominant cross-device traffic of a sharded survey — ~100 MB
+    per 512 trials over ICI/DCN vs ~12 MB compacted); host collection
+    decodes to lists byte-identical to the single-device path — tests
+    pin sharded lists == single-device lists — with a lossless dense
+    re-gather fallback for trials that overflow the budget.
 
     searcher: an AccelSearch whose geometry matches pairs_batch's
     numbins.  pairs_batch: [numdms, numbins, 2] float32 (host).
     Returns per-DM candidate lists (search_many semantics).
     """
+    from presto_tpu.search.accel import COMPACT_CANDS
+    if compact_m is None:
+        compact_m = COMPACT_CANDS
     cfg = searcher.cfg
     if cfg.wmax:
         # jerk searches keep the per-w plane-cache loop (no sharded
@@ -183,25 +191,57 @@ def sharded_accel_search_many(searcher, pairs_batch, mesh: Mesh,
         batch = xp.concatenate([batch] + [batch[-1:]] * pad)
     scols = jnp.asarray(np.asarray(start_cols, np.int32))
 
-    # cache the compiled program on the searcher (jax.jit caches on
+    # cache the compiled programs on the searcher (jax.jit caches on
     # function identity; a fresh closure per call would re-trace the
     # fused build+scan every survey group)
-    fkey = ("sharded_search", mesh, g.key, slab_, k, batch.shape)
+    from presto_tpu.search.accel import compact_scan_packed
+
+    fkey = ("sharded_search_c", mesh, g.key, slab_, k, batch.shape,
+            compact_m)
     fn = searcher._fn_cache.get(fkey)
     if fn is None:
         def per_shard(local, kern, sc):
             def per_dm(_, x):
-                return None, scan_body(build_body(x, kern), sc)
-            _, packed = jax.lax.scan(per_dm, None, local)
-            return jnp.moveaxis(packed, 1, 0)  # [3, nd_loc, nsl, s, k]
+                packed = scan_body(build_body(x, kern), sc)
+                return None, compact_scan_packed(packed, compact_m)
+            _, comp = jax.lax.scan(per_dm, None, local)
+            return comp                      # [nd_loc, 3, m]
 
         fn = jax.jit(jax.shard_map(
             per_shard, mesh=mesh,
             in_specs=(P(axis), P(), P()),
-            out_specs=P(None, axis)))
+            out_specs=P(axis)))
         searcher._fn_cache[fkey] = fn
-    packed = np.asarray(fn(jnp.asarray(batch), kern_dev, scols))
-    from presto_tpu.search.accel import _unpack_scan
-    vals, cidx, zrow = _unpack_scan(packed)
-    return [searcher._dedup_sort(searcher._collect_group(
-        vals[d], cidx[d], zrow[d], start_cols)) for d in range(nd)]
+    comp = np.asarray(fn(jnp.asarray(batch), kern_dev, scols))
+    dense = None
+    out = []
+    for d in range(nd):
+        try:
+            out.append(searcher.collect_compacted(
+                comp[d], start_cols, requested_m=compact_m))
+        except ValueError:
+            # budget overflow (pathological trial): lossless dense
+            # re-gather, compiled only when needed
+            if dense is None:
+                dkey = ("sharded_search", mesh, g.key, slab_, k,
+                        batch.shape)
+                dfn = searcher._fn_cache.get(dkey)
+                if dfn is None:
+                    def per_shard_dense(local, kern, sc):
+                        def per_dm(_, x):
+                            return None, scan_body(
+                                build_body(x, kern), sc)
+                        _, packed = jax.lax.scan(per_dm, None, local)
+                        return jnp.moveaxis(packed, 1, 0)
+                    dfn = jax.jit(jax.shard_map(
+                        per_shard_dense, mesh=mesh,
+                        in_specs=(P(axis), P(), P()),
+                        out_specs=P(None, axis)))
+                    searcher._fn_cache[dkey] = dfn
+                from presto_tpu.search.accel import _unpack_scan
+                dense = _unpack_scan(np.asarray(
+                    dfn(jnp.asarray(batch), kern_dev, scols)))
+            vals, cidx, zrow = dense
+            out.append(searcher._dedup_sort(searcher._collect_group(
+                vals[d], cidx[d], zrow[d], start_cols)))
+    return out

@@ -102,3 +102,25 @@ def test_sharded_accel_search_matches_single(mesh):
     # frequency r0 + z/2, z = 2*4.0 = 8)
     for d, cl in enumerate(got):
         assert cl and abs(cl[0].r - (2004.5 + 70.0 * d)) < 1.0
+
+
+def test_sharded_search_compact_overflow_falls_back_dense(mesh):
+    """The sharded search's on-shard compaction must fall back to the
+    lossless dense gather when a trial overflows a tiny budget, with
+    lists equal to the default path exactly."""
+    import numpy as np
+    from presto_tpu.parallel.sharded import sharded_accel_search_many
+    from presto_tpu.search.accel import AccelConfig, AccelSearch
+    rng = np.random.default_rng(21)
+    numbins, T, nd = 1 << 13, 90.0, 8
+    batch = rng.normal(size=(nd, numbins, 2)).astype(np.float32)
+    for d in range(nd):
+        batch[d, 2000 + 300 * d] = (50.0, 0.0)
+    cfg = AccelConfig(zmax=4, numharm=2, sigma=2.0)
+    s1 = AccelSearch(cfg, T=T, numbins=numbins)
+    ref = sharded_accel_search_many(s1, batch, mesh)
+    s2 = AccelSearch(cfg, T=T, numbins=numbins)
+    tiny = sharded_accel_search_many(s2, batch, mesh, compact_m=2)
+    key = lambda cl: [(c.numharm, c.r, c.z, c.power) for c in cl]
+    assert [key(a) for a in ref] == [key(b) for b in tiny]
+    assert sum(len(a) for a in ref) > nd * 2
