@@ -33,6 +33,15 @@ def build_parser():
     p.add_argument("-profile", type=str, default=None,
                    help="Text file, one profile value per line")
     p.add_argument("-phase", type=float, default=0.0)
+    # scattering tail (bin/injectpsr.py's scattering model)
+    p.add_argument("-tau", type=float, default=0.0,
+                   help="Scattering timescale, s, at -taufreq "
+                        "(0 = no scattering)")
+    p.add_argument("-taufreq", type=float, default=0.0,
+                   help="Reference freq for -tau, MHz (default: the "
+                        "highest channel)")
+    p.add_argument("-tauidx", type=float, default=-4.0,
+                   help="Scattering spectral index: tau ~ nu^idx")
     # circular-orbit injection (bin/injectpsr.py's orbit options)
     p.add_argument("-porb", type=float, default=0.0,
                    help="Orbital period, s (0 = isolated)")
@@ -59,7 +68,9 @@ def main(argv=None) -> int:
                             t=-args.torb)
     params = InjectParams(f=f, fdot=args.fdot, phase0=args.phase,
                           dm=args.dm, shape="gauss", width=args.width,
-                          profile=profile, orbit=orbit)
+                          profile=profile, orbit=orbit, tau=args.tau,
+                          tau_ref_mhz=args.taufreq,
+                          tau_index=args.tauidx)
     if args.amp is not None:
         params.amp = args.amp
     elif args.snr is not None:
@@ -70,9 +81,10 @@ def main(argv=None) -> int:
     else:
         raise SystemExit("one of -amp / -snr is required")
     inject_into_filterbank(args.infile, args.o, params)
-    print("injectpsr: %s + (f=%.6g Hz, DM=%.2f, amp=%.4g%s) -> %s"
+    print("injectpsr: %s + (f=%.6g Hz, DM=%.2f, amp=%.4g%s%s) -> %s"
           % (args.infile, f, args.dm, params.amp,
-             ", orbit" if orbit else "", args.o))
+             ", orbit" if orbit else "",
+             ", tau=%.3gs" % args.tau if args.tau else "", args.o))
     return 0
 
 

@@ -1,0 +1,84 @@
+"""presto-serve: the always-on, continuously-batching search service.
+
+Runs the L8 serving layer (presto_tpu.serve) as a long-lived HTTP
+process: submit search jobs (observation + SurveyConfig spec), poll
+status/results, scrape /metrics.  One resident process amortizes XLA
+compilation across every job it serves — the plan cache plus the
+process-lifetime jit caches replace the per-run compile cost of the
+batch driver.
+
+  presto-serve -port 8787 -workdir /scratch/serve
+  curl -XPOST :8787/submit -d '{"rawfiles": ["beam.fil"],
+                                "config": {"lodm": 0, "hidm": 100}}'
+
+See docs/SERVING.md for protocol, metrics schema, and tuning knobs.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+
+from presto_tpu.apps.common import ensure_backend
+
+
+def build_parser():
+    p = argparse.ArgumentParser(prog="presto-serve")
+    p.add_argument("-host", type=str, default="127.0.0.1")
+    p.add_argument("-port", type=int, default=8787)
+    p.add_argument("-workdir", type=str, default="serve_work",
+                   help="Root directory; each job runs in "
+                        "<workdir>/<job_id>")
+    p.add_argument("-depth", type=int, default=64,
+                   help="Queue depth bound (backpressure above this)")
+    p.add_argument("-maxbatch", type=int, default=8,
+                   help="Max same-bucket jobs coalesced per batch")
+    p.add_argument("-timeout", type=float, default=0.0,
+                   help="Per-job wall-clock budget in seconds "
+                        "(0 = unlimited)")
+    p.add_argument("-retries", type=int, default=2,
+                   help="Retries per job after the first attempt")
+    p.add_argument("-backoff", type=float, default=2.0,
+                   help="Retry backoff base in seconds (doubles per "
+                        "attempt)")
+    p.add_argument("-plans", type=int, default=32,
+                   help="Compiled-plan cache capacity (LRU)")
+    p.add_argument("-events", type=str, default=None,
+                   help="Append structured JSON events to this file")
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    ensure_backend()
+    from presto_tpu.serve.scheduler import SchedulerConfig
+    from presto_tpu.serve.server import SearchService, start_http
+    scfg = SchedulerConfig(
+        max_batch=args.maxbatch,
+        job_timeout_s=args.timeout or None,
+        max_retries=args.retries,
+        backoff_base_s=args.backoff)
+    service = SearchService(args.workdir, queue_depth=args.depth,
+                            plan_capacity=args.plans,
+                            scheduler_cfg=scfg,
+                            events_path=args.events)
+    service.start()
+    httpd = start_http(service, args.host, args.port)
+    host, port = httpd.server_address[:2]
+    print("presto-serve: listening on http://%s:%d "
+          "(POST /submit, GET /jobs/<id>, /healthz, /metrics)"
+          % (host, port))
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        print("presto-serve: shutting down")
+    finally:
+        httpd.shutdown()
+        service.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

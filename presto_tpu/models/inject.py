@@ -4,9 +4,11 @@ SURVEY.md §5.3).
 
 Adds a parameterized pulsar signal on top of REAL (or synthetic) data:
 per-channel cold-plasma delays, intra-channel DM smearing (the profile
-convolved with the channel's smearing boxcar), optional binary-orbit
-phase modulation (ops/orbit.orbit_delays), and either a fixed amplitude
-or a target folded S/N.
+convolved with the channel's smearing boxcar), an optional exponential
+scattering tail (tau scaled per channel as tau ~ nu^-4, the injectpsr
+scattering model), optional binary-orbit phase modulation
+(ops/orbit.orbit_delays), and either a fixed amplitude or a target
+folded S/N.
 """
 
 from __future__ import annotations
@@ -34,6 +36,13 @@ class InjectParams:
     width: float = 0.05            # FWHM in rotations (gauss)
     profile: Optional[np.ndarray] = field(default=None)  # custom, any len
     orbit: Optional[OrbitParams] = None
+    # interstellar scattering: one-sided exponential tail of timescale
+    # tau (seconds) at tau_ref_mhz (0 -> the highest channel), scaled
+    # per channel as tau * (nu/nu_ref)**tau_index (thin-screen
+    # Kolmogorov-ish default -4, bin/injectpsr.py's model)
+    tau: float = 0.0
+    tau_ref_mhz: float = 0.0
+    tau_index: float = -4.0
 
 
 def _base_profile(params: InjectParams) -> np.ndarray:
@@ -50,10 +59,24 @@ def _base_profile(params: InjectParams) -> np.ndarray:
     return pulse_shape(ph + 0.5, params.shape, params.width)
 
 
+def scattering_taus(params: InjectParams,
+                    freqs: np.ndarray) -> np.ndarray:
+    """Per-channel scattering timescales (seconds): tau at the
+    reference frequency scaled by (nu/nu_ref)**tau_index."""
+    freqs = np.asarray(freqs, float)
+    if params.tau <= 0.0:
+        return np.zeros(len(freqs))
+    nu_ref = params.tau_ref_mhz or float(freqs.max())
+    return params.tau * (np.maximum(freqs, 1e-3)
+                         / nu_ref) ** params.tau_index
+
+
 def _smeared_profiles(params: InjectParams, freqs: np.ndarray,
                       chanwidth: float, dt: float) -> np.ndarray:
     """[nchan, _NFINE] profiles convolved with each channel's DM
-    smearing boxcar + the sampling boxcar (injectpsr.py applies both)."""
+    smearing boxcar + the sampling boxcar (injectpsr.py applies both)
+    and, when params.tau > 0, the channel's one-sided exponential
+    scattering tail."""
     base = _base_profile(params)
     F = np.fft.rfft(base)
     k = np.arange(F.size)
@@ -62,12 +85,23 @@ def _smeared_profiles(params: InjectParams, freqs: np.ndarray,
     hi = freqs + 0.5 * chanwidth
     smear_sec = np.abs(delay_from_dm(params.dm, np.maximum(lo, 1e-3))
                        - delay_from_dm(params.dm, hi))
+    taus = scattering_taus(params, freqs)
     out = np.empty((len(freqs), _NFINE))
     for c, sm in enumerate(smear_sec):
         width = np.hypot(sm, dt) * params.f     # rotations
         width = min(max(width, 0.0), 1.0)
         # boxcar of `width` rotations in the Fourier domain: sinc
-        resp = np.sinc(k * width)
+        resp = np.sinc(k * width).astype(complex)
+        if taus[c] > 0.0:
+            # unit-area one-sided exponential exp(-t/tau)/tau has
+            # harmonic response 1/(1 + 2*pi*i*k*tau_rot); periodic
+            # wrap-around comes free in the harmonic domain.  Flux is
+            # conserved (k=0 untouched) so the peak DROPS as the tail
+            # grows — the physical behavior, and why a target-S/N
+            # injection should set amp via amp_for_snr on the
+            # unscattered profile then expect the scattered S/N loss.
+            tau_rot = taus[c] * params.f        # rotations
+            resp = resp / (1.0 + 2j * np.pi * k * tau_rot)
         out[c] = np.fft.irfft(F * resp, _NFINE)
     return out
 

@@ -81,3 +81,15 @@ def dm_sharding(mesh: Mesh, ndim: int = 2, dm_axis: int = 0):
 
 def replicated(mesh: Mesh):
     return NamedSharding(mesh, P())
+
+
+def batch_sharding(mesh: Mesh, ndim: int = 2, batch_axis: int = 0):
+    """NamedSharding for a stacked micro-batch (serve layer): the
+    leading batch axis — coalesced same-bucket jobs, or a job's DM
+    fan-out — spreads across the mesh's first axis ('dm' on the
+    standard search mesh); remaining dims replicated.  The serving
+    analog of dm_sharding: batch placement rides the same axis the
+    DM trials do, so a batched device call spans every chip."""
+    spec = [None] * ndim
+    spec[batch_axis] = mesh.axis_names[0]
+    return NamedSharding(mesh, P(*spec))

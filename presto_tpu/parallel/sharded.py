@@ -26,6 +26,14 @@ from presto_tpu.ops.dedispersion import (dedisp_subbands_block,
                                          downsample_block)
 from presto_tpu.parallel.mesh import dm_sharding, replicated
 
+# jax.shard_map moved in/out of the top-level namespace across jax
+# releases (top-level in >=0.5/0.7, jax.experimental.shard_map before);
+# resolve once so the sharded paths run on whichever is installed.
+try:
+    _shard_map = jax.shard_map            # newer jax
+except AttributeError:                     # 0.4.x
+    from jax.experimental.shard_map import shard_map as _shard_map
+
 
 def shard_dm_array(arr, mesh: Mesh):
     """Place [numdms, ...] array with the DM axis across mesh 'dm'."""
@@ -207,7 +215,7 @@ def sharded_accel_search_many(searcher, pairs_batch, mesh: Mesh,
             _, comp = jax.lax.scan(per_dm, None, local)
             return comp                      # [nd_loc, 3, m]
 
-        fn = jax.jit(jax.shard_map(
+        fn = jax.jit(_shard_map(
             per_shard, mesh=mesh,
             in_specs=(P(axis), P(), P()),
             out_specs=P(axis)))
@@ -233,7 +241,7 @@ def sharded_accel_search_many(searcher, pairs_batch, mesh: Mesh,
                                 build_body(x, kern), sc)
                         _, packed = jax.lax.scan(per_dm, None, local)
                         return jnp.moveaxis(packed, 1, 0)
-                    dfn = jax.jit(jax.shard_map(
+                    dfn = jax.jit(_shard_map(
                         per_shard_dense, mesh=mesh,
                         in_specs=(P(axis), P(), P()),
                         out_specs=P(None, axis)))

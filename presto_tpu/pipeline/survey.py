@@ -58,6 +58,11 @@ class SurveyConfig:
     sp_maxwidth: float = 0.0
     singlepulse: bool = True
     skip_rfifind: bool = False
+    # serving hook: an object with .searcher(acfg, T, numbins) (serve/
+    # plancache.SearcherProvider).  None -> build searchers inline, the
+    # batch-driver behavior.  A resident service shares one provider
+    # across jobs so same-shaped trial groups reuse compiled plans.
+    plan_provider: Optional[object] = None
 
     @property
     def all_passes(self):
@@ -84,14 +89,15 @@ def _stage(done_glob: str, workdir: str) -> List[str]:
 
 
 def run_survey(rawfiles: Sequence[str], cfg: SurveyConfig,
-               workdir: str = ".") -> SurveyResult:
+               workdir: str = ".", timer=None) -> SurveyResult:
     os.makedirs(workdir, exist_ok=True)
     rawfiles = [os.path.abspath(f) for f in rawfiles]
     base = os.path.join(
         workdir, os.path.splitext(os.path.basename(rawfiles[0]))[0])
     res = SurveyResult(workdir=workdir)
-    from presto_tpu.utils.timing import StageTimer
-    timer = StageTimer()
+    if timer is None:
+        from presto_tpu.utils.timing import StageTimer
+        timer = StageTimer()
     try:
         return _run_survey_stages(rawfiles, cfg, workdir, base, res,
                                   timer)
@@ -202,6 +208,8 @@ def _survey_searcher(first_file, nbins, cfg):
     T = info.N * info.dt
     acfg = AccelConfig(zmax=cfg.zmax, numharm=cfg.numharm,
                        sigma=cfg.sigma, flo=cfg.flo)
+    if cfg.plan_provider is not None:
+        return cfg.plan_provider.searcher(acfg, T, nbins), T
     return AccelSearch(acfg, T=T, numbins=nbins), T
 
 

@@ -1,0 +1,38 @@
+"""presto_tpu.serve — always-on, continuously-batching search service.
+
+The batch driver (`pipeline/survey.py`) is artifact-per-stage and
+process-per-run: every invocation pays XLA compilation for each
+distinct trial shape it meets.  This package is the L8 serving layer
+above it — the shape modern inference servers use — so a long-lived
+process amortizes compilation across requests and keeps the device
+mesh saturated:
+
+  queue.py      bounded priority job queue with backpressure
+  plancache.py  compiled-plan cache (pad-to-bucket shape quantization)
+  scheduler.py  continuous micro-batching loop: same-bucket coalescing,
+                per-job timeout, bounded retry with exponential
+                backoff, graceful degradation to single-job execution
+  server.py     SearchService + threaded HTTP front end
+                (/submit /jobs/<id> /healthz /metrics /events)
+  events.py     structured JSON event log for tracing
+
+See docs/SERVING.md for the wire protocol, metrics schema, and
+tuning knobs.
+"""
+
+from presto_tpu.serve.events import EventLog
+from presto_tpu.serve.queue import (Job, JobQueue, QueueClosed,
+                                    QueueFull, JobStatus)
+from presto_tpu.serve.plancache import (PlanCache, PlanKey,
+                                        SearcherProvider, bucket_key,
+                                        quantize_nsamp)
+from presto_tpu.serve.scheduler import (JobTimeout, Scheduler,
+                                        SchedulerConfig)
+from presto_tpu.serve.server import SearchService, start_http
+
+__all__ = [
+    "EventLog", "Job", "JobQueue", "JobStatus", "JobTimeout",
+    "PlanCache", "PlanKey", "QueueClosed", "QueueFull", "Scheduler",
+    "SchedulerConfig", "SearchService", "SearcherProvider",
+    "bucket_key", "quantize_nsamp", "start_http",
+]

@@ -1,0 +1,56 @@
+"""Structured JSON event log for the serving layer.
+
+Every lifecycle transition a job makes (enqueue / schedule / compile /
+execute / retry / degrade / complete / fail / timeout) emits one JSON
+object, so a trace of the service is greppable the way the batch
+driver's artifacts are replayable.  Events go to an in-memory ring
+(the /events endpoint) and optionally to an append-only JSON-lines
+file — one parseable line per event, never partial writes.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from collections import Counter, deque
+from typing import Dict, List, Optional
+
+
+class EventLog:
+    """Thread-safe event sink: bounded ring + optional file."""
+
+    def __init__(self, path: Optional[str] = None, keep: int = 4096):
+        self._lock = threading.Lock()
+        self._ring: deque = deque(maxlen=keep)
+        self._counts: Counter = Counter()
+        self._seq = 0
+        self._path = path
+        self._fh = open(path, "a") if path else None
+
+    def emit(self, kind: str, **fields) -> dict:
+        """Record one event; returns the event dict (seq/ts stamped)."""
+        with self._lock:
+            self._seq += 1
+            ev = {"seq": self._seq, "ts": time.time(), "kind": kind}
+            ev.update(fields)
+            self._ring.append(ev)
+            self._counts[kind] += 1
+            if self._fh is not None:
+                self._fh.write(json.dumps(ev, sort_keys=True) + "\n")
+                self._fh.flush()
+        return ev
+
+    def tail(self, n: int = 100) -> List[dict]:
+        with self._lock:
+            return list(self._ring)[-n:]
+
+    def counts(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self._counts)
+
+    def close(self) -> None:
+        with self._lock:
+            if self._fh is not None:
+                self._fh.close()
+                self._fh = None

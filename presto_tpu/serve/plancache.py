@@ -1,0 +1,201 @@
+"""Compiled-plan cache keyed on trial geometry (serve layer).
+
+XLA compiles one executable per program shape; in the batch driver a
+new process pays that cost for every run.  A resident service only
+pays it once per *bucket*: plans are keyed on
+(nchan, nsamp, dtype, DM-block shape, zmax, numharm) with the sample
+count quantized pad-to-bucket (next power of two), so beams whose raw
+lengths differ by a few percent land in the same bucket and reuse the
+same jitted dedispersion/accelsearch executables — the plan-cache
+shape modern inference servers use for sequence lengths.
+
+Two cooperating layers:
+
+  * `bucket_key(rawfile, cfg)` — the *scheduling* key: what the
+    micro-batching loop coalesces on (same bucket -> same batch).
+  * `PlanCache` + `SearcherProvider` — the *execution* cache: the
+    survey's searcher construction (`_survey_searcher`) routes through
+    `SurveyConfig.plan_provider`, so same-shaped trial groups across
+    jobs share one AccelSearch instance (one kernel bank + one jit
+    cache) instead of recompiling per job.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import OrderedDict
+from dataclasses import dataclass
+from typing import Any, Callable, Optional, Tuple
+
+
+@dataclass(frozen=True)
+class PlanKey:
+    """Hashable plan identity.  `kind` separates plan families
+    ("job" scheduling buckets vs "accel" searcher plans); `extra`
+    carries family-specific fields (e.g. sigma/flo/T for accel)."""
+    kind: str
+    nchan: int
+    nsamp: int
+    dtype: str
+    dm_block: Tuple
+    zmax: int
+    numharm: int
+    extra: Tuple = ()
+
+
+def quantize_nsamp(n: int) -> int:
+    """Pad-to-bucket sample-count quantization: next power of two.
+
+    Coarse on purpose — the goal is few buckets and many hits, not a
+    tight fit; the survey's own choose_N padding happens downstream of
+    this at the actual trial length."""
+    from presto_tpu.utils.psr import next2_to_n
+    return int(next2_to_n(max(int(n), 1)))
+
+
+def dm_block_shape(cfg) -> Tuple:
+    """The DM fan-out geometry of a SurveyConfig, as a hashable
+    shape: (lodm, hidm, nsub) fully determine the DDplan methods for
+    a given observation."""
+    return (round(float(cfg.lodm), 3), round(float(cfg.hidm), 3),
+            int(cfg.nsub))
+
+
+def bucket_key(rawfiles, cfg) -> PlanKey:
+    """Scheduling bucket for a job: observation geometry (from the raw
+    header) + search geometry (from the config).  Jobs with equal
+    buckets produce identically-shaped device programs, so the
+    scheduler may coalesce them."""
+    from presto_tpu.apps.common import open_raw
+    paths = [rawfiles] if isinstance(rawfiles, str) else list(rawfiles)
+    fb = open_raw(paths)
+    hdr = fb.header
+    nchan, nsamp, nbits = int(hdr.nchans), int(hdr.N), int(hdr.nbits)
+    fb.close()
+    return PlanKey(kind="job", nchan=nchan,
+                   nsamp=quantize_nsamp(nsamp),
+                   dtype="uint%d" % nbits if nbits < 32 else "float32",
+                   dm_block=dm_block_shape(cfg),
+                   zmax=int(cfg.zmax), numharm=int(cfg.numharm))
+
+
+@dataclass
+class CompiledPlan:
+    """A cached executable bundle + bookkeeping."""
+    key: PlanKey
+    obj: Any
+    build_seconds: float
+    built_at: float
+    uses: int = 0
+
+    def place(self, batch, mesh=None):
+        """Mesh-aware placement of a stacked same-bucket batch: shard
+        the leading (job/trial) axis across the mesh so one batched
+        device call spans the chips (no-op passthrough without a
+        mesh)."""
+        if mesh is None:
+            return batch
+        import jax
+        import jax.numpy as jnp
+        from presto_tpu.parallel.mesh import batch_sharding
+        arr = jnp.asarray(batch)
+        return jax.device_put(
+            arr, batch_sharding(mesh, ndim=arr.ndim))
+
+
+class PlanCache:
+    """Thread-safe LRU cache of compiled plans with hit/miss/eviction
+    accounting (the /metrics `plans` block)."""
+
+    def __init__(self, capacity: int = 32, events=None):
+        if capacity < 1:
+            raise ValueError("capacity must be >= 1")
+        self.capacity = capacity
+        self._events = events
+        self._lock = threading.Lock()
+        self._plans: "OrderedDict[PlanKey, CompiledPlan]" = \
+            OrderedDict()
+        self._hits = 0
+        self._misses = 0
+        self._evictions = 0
+        self._compile_s = 0.0
+
+    def get(self, key: PlanKey, builder: Callable[[], Any]) -> Any:
+        """Return the cached plan for `key`, building (and counting a
+        compile) on first use.  The builder runs outside the lock so a
+        long XLA compile never blocks cache hits on other keys; two
+        racing builders for one key keep the first-inserted plan."""
+        with self._lock:
+            plan = self._plans.get(key)
+            if plan is not None:
+                self._plans.move_to_end(key)
+                self._hits += 1
+                plan.uses += 1
+                return plan.obj
+            self._misses += 1
+        t0 = time.time()
+        obj = builder()
+        dt = time.time() - t0
+        with self._lock:
+            existing = self._plans.get(key)
+            if existing is not None:        # lost the build race
+                existing.uses += 1
+                return existing.obj
+            self._compile_s += dt
+            self._plans[key] = CompiledPlan(
+                key=key, obj=obj, build_seconds=dt, built_at=t0,
+                uses=1)
+            self._plans.move_to_end(key)
+            while len(self._plans) > self.capacity:
+                old_key, _ = self._plans.popitem(last=False)
+                self._evictions += 1
+                if self._events is not None:
+                    self._events.emit("evict", plan=repr(old_key))
+        if self._events is not None:
+            self._events.emit("compile", plan=repr(key), seconds=dt)
+        return obj
+
+    def contains(self, key: PlanKey) -> bool:
+        with self._lock:
+            return key in self._plans
+
+    def stats(self) -> dict:
+        with self._lock:
+            total = self._hits + self._misses
+            return {
+                "size": len(self._plans),
+                "capacity": self.capacity,
+                "hits": self._hits,
+                "misses": self._misses,
+                "evictions": self._evictions,
+                "compile_s": round(self._compile_s, 3),
+                "hit_rate": (self._hits / total) if total else 0.0,
+            }
+
+
+class SearcherProvider:
+    """The `SurveyConfig.plan_provider` adapter: routes the survey's
+    per-trial-group searcher construction through a PlanCache, so a
+    resident service compiles each accel-plan geometry once."""
+
+    def __init__(self, cache: PlanCache, mesh=None):
+        self.cache = cache
+        self.mesh = mesh
+
+    def searcher(self, acfg, T: float, numbins: int):
+        """Cached AccelSearch for (acfg, T, numbins).  T enters the
+        key (it scales the z grid and candidate frequencies), so only
+        genuinely identical trial geometries share a plan — required
+        for byte-equality with the batch driver."""
+        key = PlanKey(kind="accel", nchan=0, nsamp=int(numbins),
+                      dtype="float32", dm_block=(),
+                      zmax=int(acfg.zmax), numharm=int(acfg.numharm),
+                      extra=(float(acfg.sigma), float(acfg.flo),
+                             round(float(T), 9)))
+
+        def _build():
+            from presto_tpu.search.accel import AccelSearch
+            return AccelSearch(acfg, T=T, numbins=numbins)
+
+        return self.cache.get(key, _build)

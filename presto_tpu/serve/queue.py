@@ -1,0 +1,177 @@
+"""Bounded priority job queue with backpressure (serve layer).
+
+A job is one observation + one SurveyConfig-like spec.  The queue is
+a heap ordered by (priority, arrival); depth is bounded so a burst of
+submissions turns into explicit backpressure (QueueFull / HTTP 429)
+instead of unbounded memory growth — the admission-control half of
+continuous batching.  `pop_batch` is the other half: it hands the
+scheduler the head job plus every queued job sharing its plan bucket,
+so same-shaped beams ride one compiled executable.
+"""
+
+from __future__ import annotations
+
+import heapq
+import itertools
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, List, Optional, Tuple
+
+
+class QueueFull(RuntimeError):
+    """Submission rejected: the queue is at its bounded depth."""
+
+
+class QueueClosed(RuntimeError):
+    """The queue has been closed; no further pops/submissions."""
+
+
+class JobStatus:
+    """Job lifecycle states (plain strings; JSON-friendly)."""
+    QUEUED = "queued"
+    SCHEDULED = "scheduled"
+    RUNNING = "running"
+    RETRY_WAIT = "retry-wait"
+    DONE = "done"
+    FAILED = "failed"
+    TIMEOUT = "timeout"
+
+    TERMINAL = (DONE, FAILED, TIMEOUT)
+
+
+@dataclass
+class Job:
+    """One search request: observation path(s) + survey spec."""
+    job_id: str
+    rawfiles: List[str]
+    cfg: Any                       # pipeline.survey.SurveyConfig
+    workdir: str
+    priority: int = 10             # lower sorts first
+    bucket: Any = None             # plancache.bucket_key() result
+    spec: dict = field(default_factory=dict)   # raw submitted spec
+    status: str = JobStatus.QUEUED
+    attempts: int = 0
+    error: str = ""
+    submitted: float = 0.0
+    started: float = 0.0
+    finished: float = 0.0
+    result: Optional[dict] = None
+
+    def view(self) -> dict:
+        """JSON-safe status snapshot (the /jobs/<id> payload)."""
+        return {
+            "job_id": self.job_id,
+            "status": self.status,
+            "priority": self.priority,
+            "bucket": repr(self.bucket),
+            "attempts": self.attempts,
+            "error": self.error,
+            "submitted": self.submitted,
+            "started": self.started,
+            "finished": self.finished,
+            "workdir": self.workdir,
+        }
+
+
+class JobQueue:
+    """Thread-safe bounded priority queue with bucket coalescing."""
+
+    def __init__(self, maxdepth: int = 64):
+        if maxdepth < 1:
+            raise ValueError("maxdepth must be >= 1")
+        self.maxdepth = maxdepth
+        self._heap: List[Tuple[int, int, Job]] = []
+        self._count = itertools.count()
+        self._lock = threading.Lock()
+        self._not_empty = threading.Condition(self._lock)
+        self._not_full = threading.Condition(self._lock)
+        self._closed = False
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._heap)
+
+    depth = __len__
+
+    def submit(self, job: Job, block: bool = False,
+               timeout: Optional[float] = None) -> None:
+        """Enqueue `job`.  Non-blocking by default: raises QueueFull at
+        the depth bound (the server maps this to HTTP 429).  With
+        block=True, waits up to `timeout` seconds for a slot."""
+        deadline = None if timeout is None else time.time() + timeout
+        with self._lock:
+            while True:
+                if self._closed:
+                    raise QueueClosed("queue is closed")
+                if len(self._heap) < self.maxdepth:
+                    break
+                if not block:
+                    raise QueueFull(
+                        "queue depth %d reached" % self.maxdepth)
+                remaining = (None if deadline is None
+                             else deadline - time.time())
+                if remaining is not None and remaining <= 0:
+                    raise QueueFull(
+                        "queue depth %d reached (timed out after "
+                        "%.3gs)" % (self.maxdepth, timeout))
+                self._not_full.wait(remaining)
+            job.status = JobStatus.QUEUED
+            if not job.submitted:
+                job.submitted = time.time()
+            heapq.heappush(self._heap,
+                           (job.priority, next(self._count), job))
+            self._not_empty.notify()
+
+    def requeue(self, job: Job) -> None:
+        """Re-admit a retrying job.  Retries bypass the depth bound —
+        the job already held a slot when first admitted; bouncing it
+        now would turn a transient failure into a drop."""
+        with self._lock:
+            if self._closed:
+                raise QueueClosed("queue is closed")
+            job.status = JobStatus.QUEUED
+            heapq.heappush(self._heap,
+                           (job.priority, next(self._count), job))
+            self._not_empty.notify()
+
+    def pop_batch(self, max_batch: int = 8,
+                  timeout: Optional[float] = None) -> List[Job]:
+        """Pop the head job plus up to max_batch-1 queued jobs sharing
+        its bucket (arrival order preserved within the batch).  Jobs in
+        other buckets keep their heap positions.  Returns [] on
+        timeout, raises QueueClosed once closed and drained."""
+        with self._lock:
+            if not self._heap:
+                if self._closed:
+                    raise QueueClosed("queue is closed")
+                self._not_empty.wait(timeout)
+            if not self._heap:
+                if self._closed:
+                    raise QueueClosed("queue is closed")
+                return []
+            _, _, head = heapq.heappop(self._heap)
+            batch = [head]
+            if max_batch > 1:
+                keep, take = [], []
+                for entry in sorted(self._heap):
+                    if (len(batch) + len(take) < max_batch
+                            and entry[2].bucket == head.bucket):
+                        take.append(entry)
+                    else:
+                        keep.append(entry)
+                batch += [e[2] for e in take]
+                self._heap = keep
+                heapq.heapify(self._heap)
+            for j in batch:
+                j.status = JobStatus.SCHEDULED
+            self._not_full.notify(len(batch))
+            return batch
+
+    def close(self) -> None:
+        """Close the queue: submitters fail fast, poppers drain then
+        get QueueClosed."""
+        with self._lock:
+            self._closed = True
+            self._not_empty.notify_all()
+            self._not_full.notify_all()

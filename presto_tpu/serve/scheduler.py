@@ -1,0 +1,300 @@
+"""Continuous micro-batching scheduler (serve layer).
+
+One daemon thread runs the serving loop:
+
+  drain due retries -> pop a same-bucket batch -> execute
+
+Execution semantics:
+
+  * batch path — when a cross-job batch executor is configured it gets
+    the whole batch (one stacked device call); any batch-level failure
+    *degrades gracefully* to the single-job path instead of failing
+    the batch's jobs wholesale.
+  * single-job path — each job runs under a per-job wall-clock
+    timeout; failures retry with exponential backoff up to
+    max_retries, then surface as a failed/timeout job status.  A job
+    failing never stops the loop.
+
+Even without a cross-job executor the coalesced batch is what
+amortizes compilation: every job in it shares the same plan bucket,
+so the first job builds the executables and the rest ride the plan
+cache (and XLA's process-lifetime jit cache) warm.
+"""
+
+from __future__ import annotations
+
+import heapq
+import itertools
+import threading
+import time
+import traceback
+from concurrent.futures import ThreadPoolExecutor
+from concurrent.futures import TimeoutError as FutureTimeout
+from dataclasses import dataclass
+from typing import Callable, List, Optional
+
+from presto_tpu.serve.queue import (Job, JobQueue, JobStatus,
+                                    QueueClosed)
+
+
+class JobTimeout(RuntimeError):
+    """A job exceeded its per-job wall-clock budget."""
+
+
+@dataclass
+class SchedulerConfig:
+    max_batch: int = 8             # coalescing bound per iteration
+    job_timeout_s: Optional[float] = None
+    max_retries: int = 2           # retries after the first attempt
+    backoff_base_s: float = 0.5    # delay = base * 2**(attempt-1)
+    backoff_max_s: float = 30.0
+    poll_s: float = 0.25           # loop tick while idle
+    # Test seam (the injectpsr of the serving layer): called as
+    # fault_injector(job, attempt) right before execution; anything it
+    # raises is handled exactly like a stage failure.
+    fault_injector: Optional[Callable] = None
+
+
+class Scheduler:
+    """Owns the serving loop thread; executes jobs via `executor`
+    (callable(job) -> result dict) with optional cross-job
+    `batch_executor` (callable(jobs) -> list of result dicts)."""
+
+    def __init__(self, queue: JobQueue, executor: Callable,
+                 cfg: Optional[SchedulerConfig] = None, events=None,
+                 latency=None, batch_executor: Optional[Callable] = None):
+        self.queue = queue
+        self.executor = executor
+        self.batch_executor = batch_executor
+        self.cfg = cfg or SchedulerConfig()
+        self.events = events
+        self.latency = latency
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        self._retry_heap: list = []
+        self._retry_seq = itertools.count()
+        self._retry_lock = threading.Lock()
+        self._pool: Optional[ThreadPoolExecutor] = None
+        self._stats_lock = threading.Lock()
+        self._done = 0
+        self._failed = 0
+        self._retried = 0
+        self._batches = 0
+        self._batched_jobs = 0
+        self._degrades = 0
+
+    # ---- lifecycle ----------------------------------------------------
+
+    @property
+    def alive(self) -> bool:
+        return self._thread is not None and self._thread.is_alive()
+
+    def start(self) -> "Scheduler":
+        if self.alive:
+            return self
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._loop, name="presto-serve-scheduler",
+            daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self, timeout: float = 10.0) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout)
+        if self._pool is not None:
+            self._pool.shutdown(wait=False)
+            self._pool = None
+
+    def drain(self, timeout: float = 60.0, poll: float = 0.05) -> bool:
+        """Wait until the queue and retry shelf are empty (for tests /
+        shutdown).  Returns False on timeout."""
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            with self._retry_lock:
+                pending_retries = len(self._retry_heap)
+            if (len(self.queue) == 0 and pending_retries == 0
+                    and not self._busy):
+                return True
+            time.sleep(poll)
+        return False
+
+    # ---- the loop -----------------------------------------------------
+
+    _busy = False
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            self._admit_due_retries()
+            try:
+                batch = self.queue.pop_batch(self.cfg.max_batch,
+                                             timeout=self.cfg.poll_s)
+            except QueueClosed:
+                break
+            if not batch:
+                continue
+            self._busy = True
+            try:
+                self._run_batch(batch)
+            except Exception:
+                # belt-and-braces: _run_batch handles per-job errors;
+                # anything escaping is a scheduler bug, but it must
+                # not kill the always-on loop.
+                if self.events is not None:
+                    self.events.emit(
+                        "scheduler-error",
+                        error=traceback.format_exc(limit=5))
+            finally:
+                self._busy = False
+
+    def _admit_due_retries(self) -> None:
+        now = time.time()
+        due: List[Job] = []
+        with self._retry_lock:
+            while self._retry_heap and self._retry_heap[0][0] <= now:
+                _, _, job = heapq.heappop(self._retry_heap)
+                due.append(job)
+        for job in due:
+            try:
+                self.queue.requeue(job)
+            except QueueClosed:
+                job.status = JobStatus.FAILED
+                job.error = "queue closed during retry wait"
+                job.finished = time.time()
+
+    # ---- batch execution ----------------------------------------------
+
+    def _run_batch(self, batch: List[Job]) -> None:
+        with self._stats_lock:
+            self._batches += 1
+            self._batched_jobs += len(batch)
+        if self.events is not None:
+            self.events.emit("schedule", jobs=[j.job_id for j in batch],
+                             occupancy=len(batch),
+                             bucket=repr(batch[0].bucket))
+        if self.batch_executor is not None and len(batch) > 1:
+            try:
+                results = self._with_timeout(
+                    lambda: self.batch_executor(batch))
+                for job, result in zip(batch, results):
+                    self._finish_ok(job, result)
+                return
+            except Exception as e:
+                # graceful degradation: the batch path failing means
+                # each job gets an individual shot (and its own
+                # retry/backoff budget), not a collective failure.
+                with self._stats_lock:
+                    self._degrades += 1
+                if self.events is not None:
+                    self.events.emit(
+                        "degrade", jobs=[j.job_id for j in batch],
+                        error="%s: %s" % (type(e).__name__, e))
+        for job in batch:
+            self._run_single(job)
+
+    def _run_single(self, job: Job) -> None:
+        job.attempts += 1
+        job.status = JobStatus.RUNNING
+        if not job.started:
+            job.started = time.time()
+        if self.events is not None:
+            self.events.emit("execute", job=job.job_id,
+                             attempt=job.attempts)
+        t0 = time.time()
+        try:
+            if self.cfg.fault_injector is not None:
+                self.cfg.fault_injector(job, job.attempts)
+            result = self._with_timeout(lambda: self.executor(job))
+        except Exception as e:
+            self._handle_failure(job, e)
+            return
+        if self.latency is not None:
+            self.latency.record("job_exec", time.time() - t0)
+        self._finish_ok(job, result)
+
+    def _finish_ok(self, job: Job, result: Optional[dict]) -> None:
+        job.result = result
+        job.status = JobStatus.DONE
+        job.error = ""
+        job.finished = time.time()
+        with self._stats_lock:
+            self._done += 1
+        if self.latency is not None and job.submitted:
+            self.latency.record("job_total",
+                                job.finished - job.submitted)
+        if self.events is not None:
+            self.events.emit("complete", job=job.job_id,
+                             attempts=job.attempts,
+                             seconds=round(job.finished
+                                           - job.submitted, 3))
+
+    def _handle_failure(self, job: Job, exc: Exception) -> None:
+        timed_out = isinstance(exc, JobTimeout)
+        job.error = "%s: %s" % (type(exc).__name__, exc)
+        if job.attempts <= self.cfg.max_retries:
+            delay = min(
+                self.cfg.backoff_base_s * 2.0 ** (job.attempts - 1),
+                self.cfg.backoff_max_s)
+            job.status = JobStatus.RETRY_WAIT
+            with self._stats_lock:
+                self._retried += 1
+            with self._retry_lock:
+                heapq.heappush(
+                    self._retry_heap,
+                    (time.time() + delay, next(self._retry_seq), job))
+            if self.events is not None:
+                self.events.emit("retry", job=job.job_id,
+                                 attempt=job.attempts,
+                                 delay_s=round(delay, 4),
+                                 error=job.error)
+            return
+        job.status = (JobStatus.TIMEOUT if timed_out
+                      else JobStatus.FAILED)
+        job.finished = time.time()
+        with self._stats_lock:
+            self._failed += 1
+        if self.events is not None:
+            self.events.emit("fail", job=job.job_id,
+                             attempts=job.attempts, error=job.error,
+                             timeout=timed_out)
+
+    # ---- timeout plumbing ---------------------------------------------
+
+    def _with_timeout(self, fn: Callable):
+        """Run fn() under the per-job wall-clock budget.  On timeout
+        the worker thread is abandoned (Python offers no safe
+        preemption) and a fresh worker serves subsequent jobs — the
+        stuck thread ends with its work discarded."""
+        if not self.cfg.job_timeout_s:
+            return fn()
+        if self._pool is None:
+            self._pool = ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix="presto-serve-job")
+        fut = self._pool.submit(fn)
+        try:
+            return fut.result(timeout=self.cfg.job_timeout_s)
+        except FutureTimeout:
+            stuck = self._pool
+            self._pool = None          # zombie pool: never reused
+            stuck.shutdown(wait=False)
+            raise JobTimeout("exceeded %.3gs job budget"
+                             % self.cfg.job_timeout_s) from None
+
+    # ---- metrics ------------------------------------------------------
+
+    def stats(self) -> dict:
+        with self._stats_lock:
+            with self._retry_lock:
+                waiting = len(self._retry_heap)
+            return {
+                "alive": self.alive,
+                "jobs_done": self._done,
+                "jobs_failed": self._failed,
+                "retries": self._retried,
+                "retry_waiting": waiting,
+                "batches": self._batches,
+                "degrades": self._degrades,
+                "batch_occupancy": (self._batched_jobs / self._batches
+                                    if self._batches else 0.0),
+            }

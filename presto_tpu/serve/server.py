@@ -1,0 +1,323 @@
+"""SearchService + threaded HTTP front end (serve layer).
+
+`SearchService` is the composition root: it owns the bounded queue,
+the plan cache, the event log, the latency accounting, and the
+micro-batching scheduler, and executes each job as one restartable
+`pipeline.survey.run_survey` in the job's own workdir — so every
+serving result is byte-identical to what the batch driver would have
+written, and a crashed service resumes from the artifacts.
+
+The wire protocol is plain HTTP + JSON over stdlib `http.server`
+(ThreadingHTTPServer; one thread per connection, the scheduler thread
+does the device work):
+
+  POST /submit            {"rawfiles": [...], "config": {...},
+                           "priority": int}      -> 202 {job_id, ...}
+                          429 when the queue applies backpressure
+  GET  /jobs/<id>         job status snapshot
+  GET  /jobs/<id>/result  terminal result payload (409 until terminal)
+  GET  /healthz           liveness: queue + scheduler state
+  GET  /metrics           queue/scheduler/plan-cache/latency snapshot
+  GET  /events?n=100      tail of the structured event log
+
+See docs/SERVING.md for the full schema.
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import os
+import threading
+import time
+from dataclasses import fields as dataclass_fields
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, Optional
+from urllib.parse import urlparse, parse_qs
+
+from presto_tpu.serve.events import EventLog
+from presto_tpu.serve.plancache import (PlanCache, SearcherProvider,
+                                        bucket_key)
+from presto_tpu.serve.queue import (Job, JobQueue, JobStatus,
+                                    QueueClosed, QueueFull)
+from presto_tpu.serve.scheduler import Scheduler, SchedulerConfig
+from presto_tpu.utils.timing import LatencyStats, StageTimer
+
+
+class BadRequest(ValueError):
+    """Malformed submission (HTTP 400)."""
+
+
+def _allowed_config_fields():
+    """SurveyConfig fields settable over the wire: everything except
+    object-valued hooks (plan_provider/sift_policy are in-process
+    only)."""
+    from presto_tpu.pipeline.survey import SurveyConfig
+    blocked = {"plan_provider", "sift_policy"}
+    return {f.name for f in dataclass_fields(SurveyConfig)
+            if f.name not in blocked}
+
+
+class SearchService:
+    """The always-on search service (in-process API; server-agnostic).
+    """
+
+    def __init__(self, workroot: str, queue_depth: int = 64,
+                 plan_capacity: int = 32,
+                 scheduler_cfg: Optional[SchedulerConfig] = None,
+                 events_path: Optional[str] = None, mesh=None):
+        os.makedirs(workroot, exist_ok=True)
+        self.workroot = os.path.abspath(workroot)
+        self.events = EventLog(path=events_path)
+        self.latency = LatencyStats()
+        self.queue = JobQueue(maxdepth=queue_depth)
+        self.plans = PlanCache(capacity=plan_capacity,
+                               events=self.events)
+        self.provider = SearcherProvider(self.plans, mesh=mesh)
+        self.scheduler = Scheduler(self.queue, self._execute_job,
+                                   cfg=scheduler_cfg,
+                                   events=self.events,
+                                   latency=self.latency)
+        self._jobs: Dict[str, Job] = {}
+        self._jobs_lock = threading.Lock()
+        self._ids = itertools.count(1)
+        self._t0 = time.time()
+
+    # ---- lifecycle ----------------------------------------------------
+
+    def start(self) -> "SearchService":
+        self.scheduler.start()
+        return self
+
+    def stop(self) -> None:
+        self.queue.close()
+        self.scheduler.stop()
+        self.events.close()
+
+    # ---- job admission ------------------------------------------------
+
+    def submit(self, spec: dict) -> dict:
+        """Admit one search job.  spec:
+
+          rawfiles  [str, ...]  (required; must exist)
+          config    {SurveyConfig field: value}   (optional)
+          priority  int (optional; lower runs first)
+          job_id    str (optional; must be unique)
+
+        Raises BadRequest on malformed specs, QueueFull under
+        backpressure.  Returns the job's status view."""
+        from presto_tpu.pipeline.survey import SurveyConfig
+        if not isinstance(spec, dict):
+            raise BadRequest("spec must be a JSON object")
+        rawfiles = spec.get("rawfiles")
+        if not rawfiles or not isinstance(rawfiles, (list, tuple)):
+            raise BadRequest("spec.rawfiles must be a non-empty list")
+        rawfiles = [os.path.abspath(str(f)) for f in rawfiles]
+        missing = [f for f in rawfiles if not os.path.exists(f)]
+        if missing:
+            raise BadRequest("rawfiles not found: %s" % missing)
+        cfg_dict = spec.get("config") or {}
+        allowed = _allowed_config_fields()
+        unknown = set(cfg_dict) - allowed
+        if unknown:
+            raise BadRequest("unknown config fields: %s"
+                             % sorted(unknown))
+        cfg = SurveyConfig(**cfg_dict)
+        cfg.plan_provider = self.provider
+        job_id = str(spec.get("job_id") or "job-%06d" % next(self._ids))
+        with self._jobs_lock:
+            if job_id in self._jobs:
+                raise BadRequest("duplicate job_id %r" % job_id)
+        try:
+            bucket = bucket_key(rawfiles, cfg)
+        except Exception as e:
+            raise BadRequest("unreadable observation header: %s" % e)
+        job = Job(job_id=job_id, rawfiles=rawfiles, cfg=cfg,
+                  workdir=os.path.join(self.workroot, job_id),
+                  priority=int(spec.get("priority", 10)),
+                  bucket=bucket, spec=dict(spec))
+        self.queue.submit(job)          # may raise QueueFull
+        with self._jobs_lock:
+            self._jobs[job_id] = job
+        self.events.emit("enqueue", job=job_id,
+                         bucket=repr(bucket), priority=job.priority,
+                         depth=len(self.queue))
+        return job.view()
+
+    # ---- job execution (scheduler thread) -----------------------------
+
+    def _execute_job(self, job: Job) -> dict:
+        """Run one job as a restartable survey in its own workdir,
+        feeding the shared per-stage latency percentiles."""
+        from presto_tpu.pipeline.survey import run_survey
+        timer = StageTimer(stats=self.latency)
+        res = run_survey(job.rawfiles, job.cfg, workdir=job.workdir,
+                         timer=timer)
+        return {
+            "workdir": res.workdir,
+            "candfile": res.candfile,
+            "n_datfiles": len(res.datfiles),
+            "n_cands": (len(res.sifted) if res.sifted is not None
+                        else 0),
+            "folded": list(res.folded),
+            "sp_events": res.sp_events,
+            "stage_seconds": {k: round(v, 4)
+                              for k, v in timer.stages.items()},
+        }
+
+    # ---- introspection ------------------------------------------------
+
+    def get_job(self, job_id: str) -> Optional[Job]:
+        with self._jobs_lock:
+            return self._jobs.get(job_id)
+
+    def status(self, job_id: str) -> Optional[dict]:
+        job = self.get_job(job_id)
+        return None if job is None else job.view()
+
+    def result(self, job_id: str) -> Optional[dict]:
+        job = self.get_job(job_id)
+        if job is None:
+            return None
+        view = job.view()
+        view["result"] = job.result
+        return view
+
+    def wait(self, job_ids, timeout: float = 300.0,
+             poll: float = 0.05) -> bool:
+        """Block until every listed job is terminal (True) or the
+        timeout lapses (False).  In-process convenience for tests and
+        the load generator."""
+        if isinstance(job_ids, str):
+            job_ids = [job_ids]
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            jobs = [self.get_job(j) for j in job_ids]
+            if all(j is not None and j.status in JobStatus.TERMINAL
+                   for j in jobs):
+                return True
+            time.sleep(poll)
+        return False
+
+    def healthz(self) -> dict:
+        return {
+            "ok": bool(self.scheduler.alive),
+            "uptime_s": round(time.time() - self._t0, 3),
+            "queue_depth": len(self.queue),
+            "scheduler_alive": self.scheduler.alive,
+        }
+
+    def metrics(self) -> dict:
+        with self._jobs_lock:
+            by_status: Dict[str, int] = {}
+            for job in self._jobs.values():
+                by_status[job.status] = by_status.get(job.status, 0) + 1
+        return {
+            "uptime_s": round(time.time() - self._t0, 3),
+            "queue": {"depth": len(self.queue),
+                      "capacity": self.queue.maxdepth},
+            "jobs": by_status,
+            "scheduler": self.scheduler.stats(),
+            "plans": self.plans.stats(),
+            "latency": self.latency.snapshot(),
+            "events": self.events.counts(),
+        }
+
+
+# ----------------------------------------------------------------------
+# HTTP front end
+# ----------------------------------------------------------------------
+
+class _Handler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+
+    @property
+    def service(self) -> SearchService:
+        return self.server.service        # type: ignore[attr-defined]
+
+    def log_message(self, fmt, *args):    # route access logs to events
+        self.service.events.emit("http", line=fmt % args)
+
+    def _json(self, code: int, payload: dict) -> None:
+        body = json.dumps(payload, sort_keys=True).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self) -> None:
+        url = urlparse(self.path)
+        parts = [p for p in url.path.split("/") if p]
+        try:
+            if url.path == "/healthz":
+                h = self.service.healthz()
+                self._json(200 if h["ok"] else 503, h)
+            elif url.path == "/metrics":
+                self._json(200, self.service.metrics())
+            elif url.path == "/events":
+                n = int(parse_qs(url.query).get("n", ["100"])[0])
+                self._json(200,
+                           {"events": self.service.events.tail(n)})
+            elif len(parts) == 2 and parts[0] == "jobs":
+                view = self.service.status(parts[1])
+                if view is None:
+                    self._json(404, {"error": "no such job"})
+                else:
+                    self._json(200, view)
+            elif (len(parts) == 3 and parts[0] == "jobs"
+                  and parts[2] == "result"):
+                view = self.service.result(parts[1])
+                if view is None:
+                    self._json(404, {"error": "no such job"})
+                elif view["status"] not in JobStatus.TERMINAL:
+                    self._json(409, {"error": "job not finished",
+                                     "status": view["status"]})
+                else:
+                    self._json(200, view)
+            else:
+                self._json(404, {"error": "unknown endpoint"})
+        except Exception as e:
+            self._json(500, {"error": "%s: %s" % (type(e).__name__,
+                                                  e)})
+
+    def do_POST(self) -> None:
+        if urlparse(self.path).path != "/submit":
+            self._json(404, {"error": "unknown endpoint"})
+            return
+        try:
+            length = int(self.headers.get("Content-Length", "0"))
+            spec = json.loads(self.rfile.read(length) or b"{}")
+            self._json(202, self.service.submit(spec))
+        except BadRequest as e:
+            self._json(400, {"error": str(e)})
+        except QueueFull as e:
+            self._json(429, {"error": str(e)})
+        except QueueClosed as e:
+            self._json(503, {"error": str(e)})
+        except json.JSONDecodeError as e:
+            self._json(400, {"error": "bad JSON: %s" % e})
+        except Exception as e:
+            self._json(500, {"error": "%s: %s" % (type(e).__name__,
+                                                  e)})
+
+
+class ServeHTTPServer(ThreadingHTTPServer):
+    daemon_threads = True
+    allow_reuse_address = True
+
+    def __init__(self, addr, service: SearchService):
+        super().__init__(addr, _Handler)
+        self.service = service
+
+
+def start_http(service: SearchService, host: str = "127.0.0.1",
+               port: int = 0) -> ServeHTTPServer:
+    """Bind + serve in a daemon thread; returns the server (its
+    .server_address carries the bound port — port=0 picks a free one,
+    the test/loadgen pattern)."""
+    httpd = ServeHTTPServer((host, port), service)
+    t = threading.Thread(target=httpd.serve_forever,
+                         name="presto-serve-http", daemon=True)
+    t.start()
+    return httpd

@@ -13,7 +13,9 @@ from __future__ import annotations
 
 import os
 import sys
+import threading
 import time
+from collections import deque
 from contextlib import contextmanager
 from typing import Dict, Optional
 
@@ -32,14 +34,84 @@ def print_percent_complete(current: int, total: int,
     return pct
 
 
+class LatencyStats:
+    """Per-name latency samples with percentile accounting — the
+    serving layer's /metrics backbone.  Each name keeps a bounded
+    window of recent samples (deque; old samples age out) plus
+    lifetime count/total, and reports p50/p90/p99 over the window.
+    Thread-safe: the service records from scheduler and HTTP threads.
+    """
+
+    def __init__(self, window: int = 2048):
+        self._lock = threading.Lock()
+        self._window = window
+        self._samples: Dict[str, deque] = {}
+        self._count: Dict[str, int] = {}
+        self._total: Dict[str, float] = {}
+
+    def record(self, name: str, seconds: float) -> None:
+        with self._lock:
+            if name not in self._samples:
+                self._samples[name] = deque(maxlen=self._window)
+                self._count[name] = 0
+                self._total[name] = 0.0
+            self._samples[name].append(float(seconds))
+            self._count[name] += 1
+            self._total[name] += float(seconds)
+
+    def percentiles(self, name: str,
+                    qs=(50, 90, 99)) -> Dict[str, float]:
+        """Nearest-rank percentiles over the sample window."""
+        with self._lock:
+            xs = sorted(self._samples.get(name, ()))
+        if not xs:
+            return {"p%d" % q: 0.0 for q in qs}
+        n = len(xs)
+        return {"p%d" % q: xs[min(n - 1, max(0, (n * q + 99) // 100 - 1))]
+                for q in qs}
+
+    def snapshot(self) -> Dict[str, dict]:
+        """{name: {count, mean_s, p50_s, p90_s, p99_s, max_s}} for
+        every recorded stage (the /metrics `latency` block)."""
+        with self._lock:
+            names = list(self._samples)
+        out = {}
+        for name in names:
+            with self._lock:
+                xs = list(self._samples[name])
+                count = self._count[name]
+                total = self._total[name]
+            if not xs:
+                continue
+            pcts = self.percentiles(name)
+            out[name] = {
+                "count": count,
+                "mean_s": round(total / count, 6),
+                "p50_s": round(pcts["p50"], 6),
+                "p90_s": round(pcts["p90"], 6),
+                "p99_s": round(pcts["p99"], 6),
+                "max_s": round(max(xs), 6),
+            }
+        return out
+
+
 class StageTimer:
     """Accumulates named per-stage wall times; prints a summary table.
-    The pipeline-driver analog of the reference's per-tool timing."""
+    The pipeline-driver analog of the reference's per-tool timing.
+    With `stats` (a LatencyStats), every closed stage also records a
+    latency sample, so a resident service accumulates per-stage
+    percentiles across jobs."""
 
-    def __init__(self):
+    def __init__(self, stats: Optional[LatencyStats] = None):
         self.stages: Dict[str, float] = {}
         self._t0 = time.time()
         self._cur: Optional[tuple] = None
+        self._stats = stats
+
+    def _close(self, name: str, dt: float) -> None:
+        self.stages[name] = self.stages.get(name, 0.0) + dt
+        if self._stats is not None:
+            self._stats.record(name, dt)
 
     def mark(self, name: Optional[str]) -> None:
         """Sequential accounting: close the current stage (if any) and
@@ -48,7 +120,7 @@ class StageTimer:
         now = time.time()
         if self._cur is not None:
             cname, t0 = self._cur
-            self.stages[cname] = self.stages.get(cname, 0.0) + now - t0
+            self._close(cname, now - t0)
         self._cur = (name, now) if name else None
 
     @contextmanager
@@ -57,8 +129,7 @@ class StageTimer:
         try:
             yield
         finally:
-            self.stages[name] = self.stages.get(name, 0.0) + \
-                (time.time() - t0)
+            self._close(name, time.time() - t0)
 
     def report(self, file=None) -> str:
         total = time.time() - self._t0

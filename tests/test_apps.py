@@ -203,3 +203,78 @@ def test_prepsubband_mesh_equals_single(tmp_path, monkeypatch):
         assert len(files) == 16
         outs[mode] = [open(f, "rb").read() for f in files]
     assert all(a == b for a, b in zip(outs["mesh"], outs["single"]))
+
+
+def test_bary_cli_matches_library_and_roundtrips(tmp_path, capsys):
+    """apps/bary: stdin/file TOA topo->bary converter (src/bary.c
+    analog) agrees with astro.bary.barycenter and -inv inverts it."""
+    from presto_tpu.apps import bary as bary_app
+    from presto_tpu.astro.bary import barycenter
+    mjds = [58000.5, 58001.25]
+    toas = tmp_path / "toas.txt"
+    toas.write_text("# topocentric TOAs\n58000.5\n58001.25  # two\n")
+    ra, dec = "05:34:31.97", "+22:00:52.1"
+    assert bary_app.main(["-ra", ra, "-dec", dec, "-obs", "GB",
+                          "-voverc", str(toas)]) == 0
+    lines = capsys.readouterr().out.strip().splitlines()
+    assert len(lines) == 2
+    ref_b, ref_v = barycenter(np.array(mjds), ra, dec, obs="GB")
+    for line, b, v in zip(lines, ref_b, ref_v):
+        got_b, got_v = (float(x) for x in line.split())
+        assert got_b == pytest.approx(b, abs=1e-12)
+        assert got_v == pytest.approx(v, rel=1e-9)
+    # inverse: feed the barycentric times back with -inv
+    btoas = tmp_path / "btoas.txt"
+    btoas.write_text("".join("%.12f\n" % b for b in ref_b))
+    assert bary_app.main(["-inv", "-ra", ra, "-dec", dec, "-obs",
+                          "GB", str(btoas)]) == 0
+    out = capsys.readouterr().out.strip().splitlines()
+    for line, t in zip(out, mjds):
+        # sub-microsecond roundtrip (1e-11 day ~ 0.9 us)
+        assert float(line) == pytest.approx(t, abs=1e-11)
+
+
+def test_bary_cli_empty_input(tmp_path, capsys):
+    from presto_tpu.apps import bary as bary_app
+    empty = tmp_path / "none.txt"
+    empty.write_text("# nothing\n")
+    assert bary_app.main([str(empty)]) == 1
+
+
+def test_makeinf_cli_writes_readable_inf(tmp_path):
+    """apps/makeinf: flag-driven .inf creation roundtrips through the
+    byte-compatible reader (src/makeinf.c analog)."""
+    from presto_tpu.apps import makeinf as makeinf_app
+    base = str(tmp_path / "made")
+    assert makeinf_app.main(
+        ["-o", base, "-N", "1048576", "-dt", "6.4e-5",
+         "-telescope", "GBT", "-object", "J0737-3039A",
+         "-ra", "07:37:51.2480", "-dec", "-30:39:40.7000",
+         "-mjd", "58000.5", "-dm", "48.92", "-freq", "1400.0",
+         "-freqband", "400.0", "-numchan", "1024",
+         "-chanwid", "0.390625"]) == 0
+    info = read_inf(base)
+    assert info.telescope == "GBT"
+    assert info.object == "J0737-3039A"
+    assert info.N == 1048576 and info.dt == 6.4e-5
+    assert info.mjd_i == 58000 and info.mjd_f == pytest.approx(0.5)
+    assert info.dm == 48.92 and info.num_chan == 1024
+    assert info.dec_str.startswith("-30")
+
+
+def test_makeinf_cli_interactive(tmp_path):
+    """-i prompts for every field; answers override, Enter keeps the
+    flag-provided default (reference makeinf questionnaire)."""
+    import io
+    from presto_tpu.apps import makeinf as makeinf_app
+    base = str(tmp_path / "quiz")
+    answers = io.StringIO("Parkes\n" + "\n" * 17)
+    assert makeinf_app.main(
+        ["-i", "-o", base, "-N", "4096", "-dt", "0.001",
+         "-freq", "1400.0", "-numchan", "64", "-chanwid", "0.5",
+         "-freqband", "32.0", "-mjd", "55000.0"],
+        stdin=answers) == 0
+    info = read_inf(base)
+    assert info.telescope == "Parkes"     # answered
+    assert info.N == 4096                 # kept default
+    assert info.num_chan == 64

@@ -4,6 +4,7 @@ uses injectpsr.py for exactly this kind of fault injection, SURVEY §5.3).
 
 import numpy as np
 import jax.numpy as jnp
+import pytest
 
 from presto_tpu.io.sigproc import (FilterbankFile, FilterbankHeader,
                                    write_filterbank)
@@ -136,3 +137,74 @@ def test_injectpsr_cli_roundtrip(tmp_path):
     s = s[:hdr.N - int(np.asarray(bins).max())]
     snr, _ = _fold_snr(s, hdr.tsamp, 4.0)
     assert snr > 8
+
+
+def test_scattering_tail_asymmetry_and_flux():
+    """tau > 0 adds a one-sided exponential tail: flux conserved,
+    peak lowered, centroid delayed by ~tau, mass after the peak."""
+    from presto_tpu.models.inject import _smeared_profiles, _NFINE
+    freqs = np.array([1400.0])
+    clean = InjectParams(f=2.0, dm=0.0, width=0.04)
+    tau_s = 0.05                           # 0.1 rotations at f=2
+    scat = InjectParams(f=2.0, dm=0.0, width=0.04, tau=tau_s)
+    p0 = _smeared_profiles(clean, freqs, 1.0, 1e-4)[0]
+    p1 = _smeared_profiles(scat, freqs, 1.0, 1e-4)[0]
+    # flux (profile mean) conserved to numerical precision
+    assert p1.sum() == pytest.approx(p0.sum(), rel=1e-6)
+    # peak drops, tail rises
+    assert p1.max() < 0.8 * p0.max()
+    # centroid delay ~ tau (in rotations), computed on the circle
+    ph = np.arange(_NFINE) / _NFINE
+    ang0 = np.angle(np.sum(p0 * np.exp(2j * np.pi * ph)))
+    ang1 = np.angle(np.sum(p1 * np.exp(2j * np.pi * ph)))
+    delay_rot = (ang1 - ang0) / (2 * np.pi) % 1.0
+    assert delay_rot == pytest.approx(tau_s * 2.0, rel=0.15)
+    # asymmetry: more mass in the 0.25 turn after the peak than before
+    peak = int(np.argmax(p1))
+    idx = (np.arange(_NFINE) + peak) % _NFINE
+    after = p1[idx[1:_NFINE // 4]].sum()
+    before = p1[idx[-_NFINE // 4 + 1:]].sum()
+    assert after > 1.5 * before
+
+
+def test_scattering_scales_as_nu_minus_4():
+    """The per-channel tail follows tau ~ nu^-4 referenced to the top
+    of the band (injectpsr's thin-screen scaling)."""
+    from presto_tpu.models.inject import scattering_taus
+    freqs = np.array([700.0, 1400.0])
+    params = InjectParams(f=1.0, tau=0.01)        # ref = 1400 (top)
+    taus = scattering_taus(params, freqs)
+    assert taus[1] == pytest.approx(0.01)
+    assert taus[0] == pytest.approx(0.01 * 16.0)  # (700/1400)^-4
+    # explicit reference frequency + index override
+    params = InjectParams(f=1.0, tau=0.01, tau_ref_mhz=700.0,
+                          tau_index=-4.4)
+    taus = scattering_taus(params, freqs)
+    assert taus[0] == pytest.approx(0.01)
+    assert taus[1] == pytest.approx(0.01 * 2.0 ** -4.4)
+
+
+def test_scattering_tau_zero_is_identity():
+    from presto_tpu.models.inject import _smeared_profiles
+    freqs = np.array([400.0, 410.0])
+    a = InjectParams(f=3.0, dm=20.0, width=0.05)
+    b = InjectParams(f=3.0, dm=20.0, width=0.05, tau=0.0)
+    np.testing.assert_allclose(
+        _smeared_profiles(a, freqs, 1.0, 1e-3),
+        _smeared_profiles(b, freqs, 1.0, 1e-3))
+
+
+def test_inject_scattered_pulsar_end_to_end():
+    """Scattered injection through the public API: the folded profile
+    of the low channel has a longer tail than the high channel's."""
+    nchan, N, dt = 2, 1 << 14, 1e-3
+    freqs = np.array([400.0, 800.0])
+    params = InjectParams(f=2.0, dm=0.0, amp=5.0, width=0.03,
+                          tau=0.02, tau_ref_mhz=800.0)
+    out = inject_pulsar(np.zeros((N, nchan), np.float32), dt, freqs,
+                        params)
+    prof_lo = np.asarray(simplefold(out[:, 0], dt, 2.0, proflen=256))
+    prof_hi = np.asarray(simplefold(out[:, 1], dt, 2.0, proflen=256))
+    # tau(400) = 16 * tau(800): the low channel is far more smeared
+    assert prof_lo.max() < 0.55 * prof_hi.max()
+    assert prof_lo.sum() == pytest.approx(prof_hi.sum(), rel=0.05)

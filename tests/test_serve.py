@@ -1,0 +1,367 @@
+"""Unit tests for the serving layer (presto_tpu.serve): plan-cache
+keying/eviction, queue backpressure + bucket coalescing, scheduler
+retry/backoff/timeout/degradation, event log, latency percentiles,
+and mesh batch placement."""
+
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from presto_tpu.serve.events import EventLog
+from presto_tpu.serve.plancache import (PlanCache, PlanKey,
+                                        bucket_key, dm_block_shape,
+                                        quantize_nsamp)
+from presto_tpu.serve.queue import (Job, JobQueue, JobStatus,
+                                    QueueClosed, QueueFull)
+from presto_tpu.serve.scheduler import (JobTimeout, Scheduler,
+                                        SchedulerConfig)
+from presto_tpu.utils.timing import LatencyStats, StageTimer
+
+
+def _job(i, bucket="b", priority=10):
+    return Job(job_id="j%d" % i, rawfiles=[], cfg=None,
+               workdir="/tmp/j%d" % i, priority=priority,
+               bucket=bucket)
+
+
+# ----------------------------------------------------------------------
+# plan cache
+# ----------------------------------------------------------------------
+
+def test_plancache_compiles_once_per_key():
+    cache = PlanCache(capacity=8)
+    builds = []
+    key = PlanKey("accel", 0, 4096, "float32", (), 0, 8)
+    for _ in range(5):
+        obj = cache.get(key, lambda: builds.append(1) or object())
+    assert len(builds) == 1
+    st = cache.stats()
+    assert st["misses"] == 1 and st["hits"] == 4
+    assert st["hit_rate"] == pytest.approx(0.8)
+    assert obj is cache.get(key, lambda: pytest.fail("rebuilt"))
+
+
+def test_plancache_lru_eviction():
+    cache = PlanCache(capacity=2)
+    keys = [PlanKey("k", 0, n, "f32", (), 0, 1) for n in (1, 2, 3)]
+    cache.get(keys[0], object)
+    cache.get(keys[1], object)
+    cache.get(keys[0], object)          # touch 0: 1 becomes LRU
+    cache.get(keys[2], object)          # evicts 1
+    assert cache.contains(keys[0]) and cache.contains(keys[2])
+    assert not cache.contains(keys[1])
+    st = cache.stats()
+    assert st["evictions"] == 1 and st["size"] == 2
+
+
+def test_quantize_nsamp_buckets_similar_lengths():
+    # pad-to-bucket: lengths within the same power-of-two bucket share
+    # a plan key; the bucket is never smaller than the data
+    assert quantize_nsamp(100000) == quantize_nsamp(120000) == 131072
+    assert quantize_nsamp(131072) == 131072
+    assert quantize_nsamp(131073) == 262144
+    assert quantize_nsamp(1) == 1
+
+
+def test_bucket_key_from_real_header(tmp_path):
+    from presto_tpu.models.synth import FakeSignal, fake_filterbank_file
+    from presto_tpu.pipeline.survey import SurveyConfig
+    path = str(tmp_path / "b.fil")
+    sig = FakeSignal(f=10.0, dm=30.0, amp=0.0)
+    fake_filterbank_file(path, 5000, 1e-3, 8, 400.0, 1.0, sig,
+                         noise_sigma=1.0, nbits=8)
+    cfg = SurveyConfig(lodm=10.0, hidm=20.0, nsub=8, zmax=0,
+                       numharm=4)
+    key = bucket_key([path], cfg)
+    assert key.nchan == 8 and key.nsamp == 8192
+    assert key.dm_block == dm_block_shape(cfg)
+    assert key.zmax == 0 and key.numharm == 4
+    # same geometry, different file -> same bucket
+    path2 = str(tmp_path / "c.fil")
+    fake_filterbank_file(path2, 5000, 1e-3, 8, 400.0, 1.0, sig,
+                         noise_sigma=1.0, nbits=8, seed=7)
+    assert bucket_key([path2], cfg) == key
+    # different search geometry -> different bucket
+    assert bucket_key([path], SurveyConfig(lodm=10.0, hidm=20.0,
+                                           nsub=8, zmax=50,
+                                           numharm=4)) != key
+
+
+# ----------------------------------------------------------------------
+# queue
+# ----------------------------------------------------------------------
+
+def test_queue_backpressure():
+    q = JobQueue(maxdepth=2)
+    q.submit(_job(1))
+    q.submit(_job(2))
+    with pytest.raises(QueueFull):
+        q.submit(_job(3))
+    with pytest.raises(QueueFull):
+        q.submit(_job(3), block=True, timeout=0.05)
+    # popping frees a slot for a blocked submitter
+    t = threading.Thread(target=q.submit, args=(_job(3),),
+                         kwargs={"block": True, "timeout": 5.0})
+    t.start()
+    q.pop_batch(max_batch=1, timeout=1.0)
+    t.join(timeout=5.0)
+    assert not t.is_alive() and len(q) == 2
+
+
+def test_queue_priority_and_coalescing():
+    q = JobQueue(maxdepth=16)
+    q.submit(_job(1, bucket="A", priority=10))
+    q.submit(_job(2, bucket="B", priority=10))
+    q.submit(_job(3, bucket="A", priority=10))
+    q.submit(_job(4, bucket="C", priority=1))    # highest priority
+    batch = q.pop_batch(max_batch=8, timeout=0.1)
+    assert [j.job_id for j in batch] == ["j4"]   # nothing shares C
+    batch = q.pop_batch(max_batch=8, timeout=0.1)
+    assert [j.job_id for j in batch] == ["j1", "j3"]  # A coalesced
+    assert all(j.status == JobStatus.SCHEDULED for j in batch)
+    batch = q.pop_batch(max_batch=8, timeout=0.1)
+    assert [j.job_id for j in batch] == ["j2"]
+    assert len(q) == 0
+
+
+def test_queue_coalescing_respects_max_batch():
+    q = JobQueue(maxdepth=16)
+    for i in range(5):
+        q.submit(_job(i, bucket="X"))
+    batch = q.pop_batch(max_batch=3, timeout=0.1)
+    assert len(batch) == 3
+    assert len(q) == 2
+
+
+def test_queue_close():
+    q = JobQueue(maxdepth=4)
+    q.submit(_job(1))
+    q.close()
+    with pytest.raises(QueueClosed):
+        q.submit(_job(2))
+    assert [j.job_id for j in q.pop_batch(timeout=0.1)] == ["j1"]
+    with pytest.raises(QueueClosed):
+        q.pop_batch(timeout=0.1)
+
+
+# ----------------------------------------------------------------------
+# scheduler
+# ----------------------------------------------------------------------
+
+def _run_scheduler(executor, jobs, cfg=None, batch_executor=None,
+                   timeout=20.0):
+    q = JobQueue(maxdepth=32)
+    events = EventLog()
+    cfg = cfg or SchedulerConfig(max_batch=8, poll_s=0.01,
+                                 backoff_base_s=0.02,
+                                 backoff_max_s=0.2)
+    sched = Scheduler(q, executor, cfg=cfg, events=events,
+                      batch_executor=batch_executor)
+    for j in jobs:
+        q.submit(j)
+    sched.start()
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if all(j.status in JobStatus.TERMINAL for j in jobs):
+            break
+        time.sleep(0.01)
+    return sched, events, q
+
+
+def test_scheduler_retry_with_exponential_backoff():
+    calls = []
+
+    def flaky(job):
+        calls.append(time.time())
+        if len(calls) < 3:
+            raise RuntimeError("transient stage failure")
+        return {"ok": True}
+
+    job = _job(1)
+    cfg = SchedulerConfig(max_batch=1, poll_s=0.005, max_retries=3,
+                          backoff_base_s=0.08, backoff_max_s=2.0)
+    sched, events, _ = _run_scheduler(flaky, [job], cfg=cfg)
+    try:
+        assert job.status == JobStatus.DONE
+        assert job.attempts == 3
+        assert job.result == {"ok": True}
+        retries = [e for e in events.tail(100) if e["kind"] == "retry"]
+        assert [e["delay_s"] for e in retries] == [0.08, 0.16]
+        # observed inter-attempt gaps actually grew (backoff happened)
+        gap1, gap2 = calls[1] - calls[0], calls[2] - calls[1]
+        assert gap1 >= 0.07 and gap2 >= 0.14
+    finally:
+        sched.stop()
+
+
+def test_scheduler_exhausted_retries_fail_without_killing_loop():
+    def always_fails(job):
+        raise ValueError("poison beam")
+
+    bad, good = _job(1), _job(2)
+    calls = {"good": 0}
+
+    def executor(job):
+        if job.job_id == bad.job_id:
+            return always_fails(job)
+        calls["good"] += 1
+        return {}
+
+    cfg = SchedulerConfig(max_batch=1, poll_s=0.005, max_retries=1,
+                          backoff_base_s=0.01)
+    sched, events, q = _run_scheduler(executor, [bad], cfg=cfg)
+    try:
+        assert bad.status == JobStatus.FAILED
+        assert "poison beam" in bad.error
+        # the loop survived: a subsequent good job completes
+        q.submit(good)
+        deadline = time.time() + 10
+        while good.status != JobStatus.DONE and time.time() < deadline:
+            time.sleep(0.01)
+        assert good.status == JobStatus.DONE
+        assert sched.alive
+        assert sched.stats()["jobs_failed"] == 1
+    finally:
+        sched.stop()
+
+
+def test_scheduler_per_job_timeout():
+    def sleepy(job):
+        time.sleep(1.0)
+        return {}
+
+    job = _job(1)
+    cfg = SchedulerConfig(max_batch=1, poll_s=0.005, max_retries=0,
+                          job_timeout_s=0.1)
+    sched, events, _ = _run_scheduler(sleepy, [job], cfg=cfg)
+    try:
+        assert job.status == JobStatus.TIMEOUT
+        assert "job budget" in job.error
+        fails = [e for e in events.tail(50) if e["kind"] == "fail"]
+        assert fails and fails[0]["timeout"] is True
+    finally:
+        sched.stop()
+
+
+def test_scheduler_fault_injector_seam():
+    """The injected-stage-failure seam: the injector's exception is
+    handled exactly like an executor failure (retried, then fails)."""
+    job = _job(1)
+    boom = {"n": 0}
+
+    def injector(j, attempt):
+        boom["n"] += 1
+        raise RuntimeError("injected stage failure")
+
+    cfg = SchedulerConfig(max_batch=1, poll_s=0.005, max_retries=2,
+                          backoff_base_s=0.01, fault_injector=injector)
+    sched, events, _ = _run_scheduler(
+        lambda j: {"ok": True}, [job], cfg=cfg)
+    try:
+        assert job.status == JobStatus.FAILED
+        assert boom["n"] == 3                   # 1 try + 2 retries
+        kinds = [e["kind"] for e in events.tail(100)]
+        assert kinds.count("retry") == 2
+    finally:
+        sched.stop()
+
+
+def test_scheduler_batch_failure_degrades_to_single_jobs():
+    jobs = [_job(i, bucket="same") for i in range(3)]
+    singles = []
+
+    def batch_exec(batch):
+        raise RuntimeError("stacked batch OOM")
+
+    def single_exec(job):
+        singles.append(job.job_id)
+        return {"single": True}
+
+    sched, events, _ = _run_scheduler(single_exec, jobs,
+                                      batch_executor=batch_exec)
+    try:
+        assert all(j.status == JobStatus.DONE for j in jobs)
+        assert sorted(singles) == ["j0", "j1", "j2"]
+        kinds = [e["kind"] for e in events.tail(100)]
+        assert "degrade" in kinds
+        st = sched.stats()
+        assert st["degrades"] == 1
+        assert st["batch_occupancy"] == pytest.approx(3.0)
+    finally:
+        sched.stop()
+
+
+# ----------------------------------------------------------------------
+# events / latency / placement
+# ----------------------------------------------------------------------
+
+def test_event_log_ring_counts_and_file(tmp_path):
+    path = str(tmp_path / "events.jsonl")
+    log = EventLog(path=path, keep=4)
+    for i in range(6):
+        log.emit("tick", i=i)
+    log.emit("tock")
+    assert log.counts() == {"tick": 6, "tock": 1}
+    tail = log.tail(10)
+    assert len(tail) == 4                      # ring bound
+    assert tail[-1]["kind"] == "tock"
+    assert tail[-1]["seq"] == 7
+    log.close()
+    lines = [json.loads(ln) for ln in open(path)]
+    assert len(lines) == 7                     # file keeps everything
+    assert lines[0]["i"] == 0
+
+
+def test_latency_stats_percentiles():
+    stats = LatencyStats()
+    for ms in range(1, 101):                   # 1..100 ms
+        stats.record("stage", ms / 1000.0)
+    pcts = stats.percentiles("stage")
+    assert pcts["p50"] == pytest.approx(0.050)
+    assert pcts["p99"] == pytest.approx(0.099)
+    snap = stats.snapshot()["stage"]
+    assert snap["count"] == 100
+    assert snap["max_s"] == pytest.approx(0.100)
+    assert snap["mean_s"] == pytest.approx(0.0505, rel=1e-3)
+
+
+def test_stage_timer_feeds_latency_stats():
+    stats = LatencyStats()
+    timer = StageTimer(stats=stats)
+    with timer.stage("fft"):
+        time.sleep(0.01)
+    timer.mark("sift")
+    time.sleep(0.01)
+    timer.mark(None)
+    snap = stats.snapshot()
+    assert snap["fft"]["count"] == 1 and snap["sift"]["count"] == 1
+    assert snap["fft"]["p50_s"] >= 0.009
+
+
+def test_batch_sharding_places_batch_across_mesh():
+    import jax
+    from presto_tpu.parallel.mesh import make_mesh, batch_sharding
+    mesh = make_mesh()                          # 8 virtual CPU devices
+    n = len(jax.devices())
+    x = np.arange(n * 16, dtype=np.float32).reshape(n, 16)
+    sharding = batch_sharding(mesh, ndim=2)
+    y = jax.device_put(x, sharding)
+    assert len(y.sharding.device_set) == n
+    np.testing.assert_array_equal(np.asarray(y), x)
+
+
+def test_compiled_plan_place_with_mesh():
+    import jax
+    from presto_tpu.parallel.mesh import make_mesh
+    from presto_tpu.serve.plancache import CompiledPlan, PlanKey
+    mesh = make_mesh()
+    plan = CompiledPlan(key=PlanKey("k", 0, 8, "f32", (), 0, 1),
+                        obj=None, build_seconds=0.0, built_at=0.0)
+    n = len(jax.devices())
+    x = np.ones((n, 4), np.float32)
+    placed = plan.place(x, mesh=mesh)
+    assert len(placed.sharding.device_set) == n
+    assert plan.place(x, mesh=None) is x        # passthrough

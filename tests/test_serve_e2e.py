@@ -1,0 +1,227 @@
+"""End-to-end serving tests (ISSUE 1 acceptance): two same-bucket
+jobs submitted to the service must compile exactly one accel plan
+(cache stats), produce candidate files byte-equal to the batch
+driver's, survive an injected stage failure (retry with backoff, then
+a failed-job status, scheduler loop alive), and speak the HTTP
+protocol.  A slow-marked smoke test drives tools/serve_loadgen.py
+in-process."""
+
+import json
+import os
+import shutil
+import time
+import urllib.request
+
+import pytest
+
+from presto_tpu.models.synth import FakeSignal, fake_filterbank_file
+
+# Small but detectable beam geometry (cf. test_survey_pipeline's
+# known-good config, shrunk for the serving loop's multi-run test).
+N, NCHAN, DT = 1 << 14, 16, 5e-4
+F0, DM = 23.0, 55.0
+CFG = {"lodm": 45.0, "hidm": 65.0, "nsub": 16, "zmax": 0,
+       "numharm": 4, "sigma": 4.0, "fold_top": 0,
+       "singlepulse": False, "skip_rfifind": True}
+
+
+def _make_beam(path, seed=42):
+    sig = FakeSignal(f=F0, dm=DM, shape="gauss", width=0.08, amp=0.8)
+    fake_filterbank_file(path, N, DT, NCHAN, 400.0, 1.0, sig,
+                         noise_sigma=2.0, nbits=8, seed=seed)
+    return path
+
+
+def _survey_cfg(**extra):
+    from presto_tpu.pipeline.survey import SurveyConfig
+    d = dict(CFG)
+    d.update(extra)
+    return SurveyConfig(**d)
+
+
+@pytest.fixture(scope="module")
+def beam_and_batch(tmp_path_factory):
+    """One synthetic beam + the batch driver's run over it (the
+    byte-equality referee)."""
+    root = tmp_path_factory.mktemp("serve_e2e")
+    beam = _make_beam(str(root / "beam.fil"))
+    batchdir = str(root / "batch")
+    from presto_tpu.pipeline.survey import run_survey
+    res = run_survey([beam], _survey_cfg(), workdir=batchdir)
+    assert res.sifted is not None and len(res.sifted) >= 1
+    return beam, res.candfile, str(root)
+
+
+@pytest.fixture(scope="module")
+def serve_run(beam_and_batch):
+    """Submit two same-bucket jobs + one fault-injected job through a
+    live service (HTTP included), then a post-fault job proving the
+    loop survived."""
+    beam, batch_candfile, root = beam_and_batch
+    from presto_tpu.serve.scheduler import SchedulerConfig
+    from presto_tpu.serve.server import SearchService, start_http
+
+    faulted = set()
+    fault_attempts = []
+
+    def injector(job, attempt):
+        if job.job_id in faulted:
+            fault_attempts.append((attempt, time.time()))
+            raise RuntimeError("injected stage failure")
+
+    scfg = SchedulerConfig(max_batch=8, poll_s=0.02, max_retries=2,
+                           backoff_base_s=0.05, backoff_max_s=1.0,
+                           fault_injector=injector)
+    service = SearchService(os.path.join(root, "serve"),
+                            scheduler_cfg=scfg)
+    httpd = start_http(service)
+    host, port = httpd.server_address[:2]
+    url = "http://%s:%d" % (host, port)
+
+    spec = {"rawfiles": [beam], "config": CFG}
+    # submit BEFORE starting the scheduler so the two same-bucket jobs
+    # are provably coalesced into one micro-batch
+    j1 = service.submit(dict(spec))["job_id"]
+    j2 = service.submit(dict(spec))["job_id"]
+    j3 = service.submit(dict(spec))["job_id"]
+    faulted.add(j3)
+    service.start()
+    assert service.wait([j1, j2, j3], timeout=600.0)
+    # the loop must still be serving: a post-fault job completes
+    j4 = service.submit(dict(spec))["job_id"]
+    assert service.wait([j4], timeout=600.0)
+    yield dict(service=service, url=url, jobs=(j1, j2, j3, j4),
+               batch_candfile=batch_candfile,
+               fault_attempts=fault_attempts)
+    httpd.shutdown()
+    service.stop()
+
+
+def test_same_bucket_jobs_compile_one_plan(serve_run):
+    """The acceptance centerpiece: every job shares ONE accel-plan
+    compile (all searches ride the cached executable)."""
+    service = serve_run["service"]
+    st = service.plans.stats()
+    assert st["misses"] == 1, st
+    assert st["hits"] >= 2, st
+    assert st["hit_rate"] > 0.5
+
+
+def test_serve_results_byte_equal_to_batch_driver(serve_run):
+    service = serve_run["service"]
+    ref = open(serve_run["batch_candfile"], "rb").read()
+    assert len(ref) > 0
+    for jid in serve_run["jobs"][:2]:
+        job = service.get_job(jid)
+        assert job.status == "done", job.error
+        got = open(job.result["candfile"], "rb").read()
+        assert got == ref, "serve cands differ from batch driver"
+        assert job.result["n_cands"] >= 1
+
+
+def test_jobs_were_coalesced_into_one_batch(serve_run):
+    service = serve_run["service"]
+    scheds = [e for e in service.events.tail(1000)
+              if e["kind"] == "schedule"]
+    first = scheds[0]
+    # j1..j3 share a bucket and were queued before the loop started:
+    # one micro-batch carries all three
+    assert first["occupancy"] == 3
+    assert service.scheduler.stats()["batch_occupancy"] >= 1.5
+
+
+def test_injected_failure_retried_with_backoff_then_failed(serve_run):
+    service = serve_run["service"]
+    j3 = serve_run["jobs"][2]
+    job = service.get_job(j3)
+    assert job.status == "failed"
+    assert "injected stage failure" in job.error
+    assert job.attempts == 3                    # 1 try + 2 retries
+    retries = [e for e in service.events.tail(1000)
+               if e["kind"] == "retry" and e["job"] == j3]
+    assert [e["delay_s"] for e in retries] == [0.05, 0.1]
+    # attempts really were spaced by growing delays
+    ts = [t for _, t in serve_run["fault_attempts"]]
+    assert ts[1] - ts[0] >= 0.04
+    assert ts[2] - ts[1] >= 0.08
+    assert service.scheduler.alive
+
+
+def test_scheduler_survived_and_served_after_fault(serve_run):
+    service = serve_run["service"]
+    j4 = serve_run["jobs"][3]
+    assert service.get_job(j4).status == "done"
+    # j4 arrived after the plan was cached: zero extra compiles
+    assert service.plans.stats()["misses"] == 1
+
+
+def _get(url):
+    with urllib.request.urlopen(url, timeout=10) as r:
+        return r.status, json.loads(r.read())
+
+
+def test_http_protocol_endpoints(serve_run):
+    url = serve_run["url"]
+    code, h = _get(url + "/healthz")
+    assert code == 200 and h["ok"] is True
+    code, m = _get(url + "/metrics")
+    assert code == 200
+    assert m["plans"]["misses"] == 1
+    assert m["jobs"]["done"] == 3 and m["jobs"]["failed"] == 1
+    assert m["scheduler"]["jobs_done"] == 3
+    # per-stage latency percentiles flow from the survey's StageTimer
+    assert "sift" in m["latency"]
+    assert m["latency"]["job_total"]["count"] == 3
+    for jid in serve_run["jobs"][:1]:
+        code, view = _get(url + "/jobs/%s" % jid)
+        assert code == 200 and view["status"] == "done"
+        code, res = _get(url + "/jobs/%s/result" % jid)
+        assert code == 200 and res["result"]["n_cands"] >= 1
+    code, ev = _get(url + "/events?n=5")
+    assert code == 200 and len(ev["events"]) == 5
+
+
+def test_http_submit_validation(serve_run):
+    url = serve_run["url"]
+    req = urllib.request.Request(
+        url + "/submit",
+        data=json.dumps({"rawfiles": ["/no/such/beam.fil"]}).encode(),
+        headers={"Content-Type": "application/json"})
+    try:
+        urllib.request.urlopen(req, timeout=10)
+        assert False, "expected HTTP 400"
+    except urllib.error.HTTPError as e:
+        assert e.code == 400
+    try:
+        _get(url + "/jobs/nonexistent")
+        assert False, "expected HTTP 404"
+    except urllib.error.HTTPError as e:
+        assert e.code == 404
+
+
+@pytest.mark.slow
+def test_serve_loadgen_smoke(tmp_path):
+    """tools/serve_loadgen.py against an in-process service: all beams
+    complete, throughput and percentiles are reported."""
+    import sys
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__),
+                                    os.pardir, "tools"))
+    import serve_loadgen
+    from presto_tpu.serve.server import SearchService, start_http
+    beams = serve_loadgen.make_beams(str(tmp_path), 3, nsamp=N,
+                                     nchan=NCHAN)
+    service = SearchService(str(tmp_path / "serve")).start()
+    httpd = start_http(service)
+    host, port = httpd.server_address[:2]
+    try:
+        report = serve_loadgen.run_loadgen(
+            "http://%s:%d" % (host, port), beams, rate=2.0,
+            config=CFG, timeout=600.0)
+    finally:
+        httpd.shutdown()
+        service.stop()
+    assert report["done"] == 3
+    assert report["failed"] == 0 and report["unfinished"] == 0
+    assert report["throughput_jobs_per_s"] > 0
+    assert report["p99_s"] >= report["p50_s"] > 0
+    assert report["plan_hit_rate"] > 0

@@ -175,9 +175,11 @@ def per_shard(local, kern, sc):
     return jnp.moveaxis(packed, 1, 0)     # [3, nd_loc, nsl, st, k]
 
 
-fn = jax.jit(jax.shard_map(per_shard, mesh=mesh,
-                           in_specs=(P("dm"), P(), P()),
-                           out_specs=P(None, "dm")))
+from presto_tpu.parallel.sharded import _shard_map
+
+fn = jax.jit(_shard_map(per_shard, mesh=mesh,
+                        in_specs=(P("dm"), P(), P()),
+                        out_specs=P(None, "dm")))
 dmsh = NamedSharding(mesh, P("dm"))
 gbatch = jax.make_array_from_callback(
     batch.shape, dmsh, lambda idx: batch[idx])

@@ -1,0 +1,151 @@
+"""serve_loadgen: replay synthetic beams against a presto-serve
+instance and report throughput + latency percentiles from /metrics.
+
+Generates N same-shaped synthetic beams (so they coalesce into one
+plan bucket), submits them at a fixed rate over the HTTP protocol,
+polls until every job is terminal, then prints a JSON report:
+submitted/done/failed counts, wall time, jobs/s, and the service's
+own job_total p50/p99 from /metrics.
+
+  # against a running server
+  python tools/serve_loadgen.py -url http://127.0.0.1:8787 -beams 8
+
+  # self-contained: spin up an in-process service first
+  python tools/serve_loadgen.py -selfhost -beams 4 -rate 2
+
+Also importable (`run_loadgen`) — the `-m slow` serve smoke test
+drives it in-process.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+import urllib.request
+
+
+def _http_json(url: str, payload=None) -> dict:
+    data = (json.dumps(payload).encode() if payload is not None
+            else None)
+    req = urllib.request.Request(
+        url, data=data,
+        headers={"Content-Type": "application/json"} if data else {})
+    with urllib.request.urlopen(req, timeout=30) as resp:
+        return json.loads(resp.read())
+
+
+def make_beams(outdir: str, n: int, nsamp: int = 1 << 14,
+               nchan: int = 16, dt: float = 5e-4, f0: float = 23.0,
+               dm: float = 55.0):
+    """n same-shaped synthetic beams (identical geometry -> one plan
+    bucket), each with its own noise realization."""
+    from presto_tpu.models.synth import FakeSignal, fake_filterbank_file
+    paths = []
+    for i in range(n):
+        path = os.path.join(outdir, "beam%03d" % i, "beam.fil")
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        sig = FakeSignal(f=f0, dm=dm, shape="gauss", width=0.08,
+                         amp=0.8)
+        fake_filterbank_file(path, nsamp, dt, nchan, 400.0, 1.0, sig,
+                             noise_sigma=2.0, nbits=8, seed=100 + i)
+        paths.append(path)
+    return paths
+
+
+def run_loadgen(url: str, beams, rate: float = 2.0,
+                config: dict = None, timeout: float = 600.0) -> dict:
+    """Submit `beams` (paths) at `rate` jobs/s; block until terminal;
+    return the report dict."""
+    config = config or {"lodm": 45.0, "hidm": 65.0, "nsub": 16,
+                        "zmax": 0, "numharm": 4, "fold_top": 0,
+                        "singlepulse": False, "skip_rfifind": True}
+    t0 = time.time()
+    job_ids = []
+    for i, beam in enumerate(beams):
+        target = t0 + i / max(rate, 1e-6)
+        if target > time.time():
+            time.sleep(target - time.time())
+        view = _http_json(url + "/submit",
+                          {"rawfiles": [beam], "config": config})
+        job_ids.append(view["job_id"])
+    deadline = time.time() + timeout
+    done = {}
+    while time.time() < deadline and len(done) < len(job_ids):
+        for jid in job_ids:
+            if jid in done:
+                continue
+            view = _http_json(url + "/jobs/" + jid)
+            if view["status"] in ("done", "failed", "timeout"):
+                done[jid] = view["status"]
+        time.sleep(0.25)
+    wall = time.time() - t0
+    metrics = _http_json(url + "/metrics")
+    lat = metrics.get("latency", {}).get("job_total", {})
+    n_done = sum(1 for s in done.values() if s == "done")
+    return {
+        "submitted": len(job_ids),
+        "done": n_done,
+        "failed": len(done) - n_done,
+        "unfinished": len(job_ids) - len(done),
+        "wall_s": round(wall, 3),
+        "throughput_jobs_per_s": round(n_done / wall, 4) if wall else 0,
+        "p50_s": lat.get("p50_s", 0.0),
+        "p99_s": lat.get("p99_s", 0.0),
+        "batch_occupancy": metrics["scheduler"]["batch_occupancy"],
+        "plan_hit_rate": metrics["plans"]["hit_rate"],
+    }
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="serve_loadgen")
+    p.add_argument("-url", type=str, default=None,
+                   help="Base URL of a running presto-serve")
+    p.add_argument("-selfhost", action="store_true",
+                   help="Spin up an in-process service instead")
+    p.add_argument("-beams", type=int, default=4)
+    p.add_argument("-rate", type=float, default=2.0,
+                   help="Submission rate, jobs/s")
+    p.add_argument("-nsamp", type=int, default=1 << 14)
+    p.add_argument("-nchan", type=int, default=16)
+    p.add_argument("-workdir", type=str, default=None,
+                   help="Scratch root (default: a temp dir)")
+    p.add_argument("-timeout", type=float, default=600.0)
+    args = p.parse_args(argv)
+    if not args.url and not args.selfhost:
+        p.error("need -url or -selfhost")
+
+    sys.path.insert(0, os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    workdir = args.workdir or tempfile.mkdtemp(prefix="loadgen_")
+    beams = make_beams(workdir, args.beams, nsamp=args.nsamp,
+                       nchan=args.nchan)
+
+    service = httpd = None
+    url = args.url
+    if args.selfhost:
+        from presto_tpu.apps.common import ensure_backend
+        ensure_backend()
+        from presto_tpu.serve.server import SearchService, start_http
+        service = SearchService(os.path.join(workdir, "serve")).start()
+        httpd = start_http(service)
+        host, port = httpd.server_address[:2]
+        url = "http://%s:%d" % (host, port)
+    try:
+        report = run_loadgen(url, beams, rate=args.rate,
+                             timeout=args.timeout)
+    finally:
+        if httpd is not None:
+            httpd.shutdown()
+        if service is not None:
+            service.stop()
+    print(json.dumps(report, indent=2, sort_keys=True))
+    return 0 if report["failed"] == 0 and report["unfinished"] == 0 \
+        else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
