@@ -70,8 +70,9 @@ def build_parser():
 
 def write_cand_file(path: str, cands) -> None:
     """Binary .cand dump: one record per candidate of
-    (power f4, sigma f4, numharm i4, r f8, z f8, w f8)."""
-    with open(path, "wb") as f:
+    (power f4, sigma f4, numharm i4, r f8, z f8, w f8); atomic."""
+    from presto_tpu.io.atomic import atomic_open
+    with atomic_open(path, "wb") as f:
         for c in cands:
             f.write(struct.pack("<ffiddd", c.power, c.sigma, c.numharm,
                                 c.r, c.z, c.w))
@@ -124,8 +125,10 @@ def write_accel_file(path: str, cands, T: float,
                      with_w: bool = False) -> None:
     """Text table with the reference's column structure
     (output_fundamentals, accel_utils.c:565-718); jerk runs append an
-    FFT 'w' column."""
-    with open(path, "w") as f:
+    FFT 'w' column.  Atomic on disk: a killed search never leaves a
+    half-written ACCEL table for a resume to trust."""
+    from presto_tpu.io.atomic import atomic_open
+    with atomic_open(path, "w") as f:
         f.write("             Summed  Coherent  Num        Period      "
                 "    Frequency         FFT 'r'        Freq Deriv      "
                 "FFT 'z'      Accel    "

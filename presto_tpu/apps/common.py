@@ -343,13 +343,24 @@ def fil_to_inf(fb: FilterbankFile, outbase: str, N: int,
         num_chan=hdr.nchans, chan_wid=abs(hdr.foff),
         analyzer="presto_tpu")
 
-def stream_blocklen(nchan: int, maxd: int) -> int:
+def stream_blocklen(nchan: int, maxd: int,
+                    nspec: Optional[int] = None) -> int:
     """Streaming block length for the two-block dedispersion window.
 
     Big blocks amortize the per-dispatch tunnel latency (~0.1-0.4 s),
     but the [nchan, 2*blocklen] float32 device window must stay within
     a ~256 MB budget for high-channel-count data; and the window must
-    exceed the max dedispersion delay."""
+    exceed the max dedispersion delay.
+
+    When the observation length `nspec` is known, the block is clamped
+    to it: read_spectra zero-pads past EOF, and a block that is mostly
+    synthetic zeros poisons the clipper's running statistics — the
+    real samples read as the "outliers" and get zapped (observed as
+    all-zero .dat output for observations much shorter than the
+    default block)."""
     budget = (1 << 25) // max(nchan, 1)
     base = max(1 << 12, min(1 << 17, budget))
-    return max(base, 1 << (maxd + 1).bit_length())
+    blocklen = max(base, 1 << (maxd + 1).bit_length())
+    if nspec is not None and 0 < nspec < blocklen:
+        blocklen = max(int(nspec), 1 << (maxd + 1).bit_length())
+    return blocklen

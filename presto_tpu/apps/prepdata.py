@@ -96,7 +96,7 @@ def run(args) -> str:
                      padvals=padvals if args.mask else None,
                      ignore=ignore)
 
-    blocklen = stream_blocklen(nchan, maxd)
+    blocklen = stream_blocklen(nchan, maxd, nspec=int(hdr.N) - skip)
     out = []
     bins_d = jnp.asarray(bins)
     prev = jnp.zeros((nchan, blocklen), dtype=jnp.float32)

@@ -422,7 +422,7 @@ def fold_raw(args, f, fd, fdd):
                                 abs(hdr.foff))
     chan_bins = dd.delays_to_bins(chan_del - chan_del.min(), dt)
     maxd = int(chan_bins.max())
-    blocklen = stream_blocklen(nchan, maxd)
+    blocklen = stream_blocklen(nchan, maxd, nspec=int(hdr.N))
 
     mask = read_mask(args.mask) if args.mask else None
     padvals = np.zeros(nchan, dtype=np.float32)

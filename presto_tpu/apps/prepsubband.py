@@ -134,7 +134,8 @@ def run(args):
                      ignore=ignore)
 
     blocklen = stream_blocklen(nchan, max(int(chan_bins.max()),
-                                          int(dm_bins.max())))
+                                          int(dm_bins.max())),
+                               nspec=Neff)
     # the per-block downsampler reshapes [.., blocklen/downsamp,
     # downsamp]: round blocklen up to a multiple of the factor
     if blocklen % args.downsamp:

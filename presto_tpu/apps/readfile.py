@@ -147,30 +147,42 @@ def main(argv=None) -> int:
     p.add_argument("files", nargs="+")
     args = p.parse_args(argv)
     idx = tuple(args.index) if args.index else None
+    from presto_tpu.io.errors import PrestoIOError
+    rc = 0
     for f in args.files:
         fmt = next((dt for names, dt in _RAW_FMTS
                     if getattr(args, "fmt_" + names[0])), None)
-        if args.rzwcand:
-            print(_dump_cands(f, "rzw", idx, args.nph))
-        elif args.bincand:
-            print(_dump_cands(f, "bin", idx, args.nph))
-        elif args.position:
-            print(_dump_raw(f, np.float64, idx, args.fortran))
-        elif fmt is not None:
-            print(_dump_raw(f, fmt, idx, args.fortran))
-        elif args.filterbank or args.psrfits:
-            from presto_tpu.apps.common import open_raw_args
-            fb = open_raw_args([f], args)
-            h = fb.header
-            lines = ["--- %s (forced format) ---" % f]
-            for k in ("source_name", "nchans", "nbits", "tsamp",
-                      "tstart", "N"):
-                lines.append("  %-12s = %s" % (k, getattr(h, k, "?")))
-            fb.close()
-            print("\n".join(lines))
-        else:
-            print(describe(f, args.n))
-    return 0
+        try:
+            if args.rzwcand:
+                print(_dump_cands(f, "rzw", idx, args.nph))
+            elif args.bincand:
+                print(_dump_cands(f, "bin", idx, args.nph))
+            elif args.position:
+                print(_dump_raw(f, np.float64, idx, args.fortran))
+            elif fmt is not None:
+                print(_dump_raw(f, fmt, idx, args.fortran))
+            elif args.filterbank or args.psrfits:
+                from presto_tpu.apps.common import open_raw_args
+                fb = open_raw_args([f], args)
+                h = fb.header
+                lines = ["--- %s (forced format) ---" % f]
+                for k in ("source_name", "nchans", "nbits", "tsamp",
+                          "tstart", "N"):
+                    lines.append("  %-12s = %s"
+                                 % (k, getattr(h, k, "?")))
+                fb.close()
+                print("\n".join(lines))
+            else:
+                print(describe(f, args.n))
+        except PrestoIOError as e:
+            # truncated/corrupt input: one-line typed diagnosis and a
+            # nonzero exit, never a struct.error traceback
+            print("readfile: %s" % e, file=sys.stderr)
+            rc = 1
+        except (ValueError, EOFError, OSError) as e:
+            print("readfile: %s: %s" % (f, e), file=sys.stderr)
+            rc = 1
+    return rc
 
 
 if __name__ == "__main__":

@@ -166,6 +166,21 @@ def run(args):
                          mjd=hdr.tstart, zap_chans=zap_chans,
                          zap_ints=zap_ints)
     outbase = args.outfile or "rfifind_out"
+    # ingest quarantine -> mask integration: stretches the reader
+    # quarantined while streaming (NaN/Inf scrubs, zero-fill runs,
+    # short reads, dropped PSRFITS rows) become zapped intervals
+    # exactly like statistical RFI, and the DataQualityReport itself
+    # is written as a durable artifact next to the mask.
+    quality = getattr(fb, "quality", None)
+    if quality is not None:
+        extra = quality.zap_intervals(ptsperint, res.mask.numint)
+        if extra:
+            res.mask.zap_ints = np.asarray(
+                sorted(set(res.mask.zap_ints.tolist()) | set(extra)),
+                np.int32)
+        if not quality.clean:
+            print("rfifind: %s" % quality.summary())
+        quality.write(outbase + "_rfifind_quality.json")
     write_rfifind_products(res, outbase)
     info = fil_to_inf(fb, outbase + "_rfifind", hdr.N)
     write_inf(info, outbase + "_rfifind.inf")

@@ -1,0 +1,10 @@
+"""io layer: raw-data readers, artifact writers, fault-tolerance
+primitives.
+
+Robustness conventions (docs/ROBUSTNESS.md): artifact writers are
+atomic (io/atomic.py), readers quarantine recoverable damage into a
+DataQualityReport (io/quality.py) and raise the typed PrestoIOError
+(io/errors.py) for genuinely unrecoverable corruption.
+"""
+
+from presto_tpu.io.errors import PrestoIOError  # noqa: F401

@@ -22,6 +22,8 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
 
 import numpy as np
 
+from presto_tpu.io.errors import PrestoIOError
+
 BLOCK = 2880
 CARD = 80
 
@@ -95,7 +97,7 @@ class Header:
         self.cards[key] = value
 
 
-def _read_header(buf, offset: int) -> Tuple[Header, int]:
+def _read_header(buf, offset: int, path: str = "") -> Tuple[Header, int]:
     """Parse header cards from `offset`; returns (header, data_offset)."""
     hdr = Header()
     pos = offset
@@ -103,7 +105,10 @@ def _read_header(buf, offset: int) -> Tuple[Header, int]:
     while not done:
         block = buf[pos:pos + BLOCK]
         if len(block) < BLOCK:
-            raise ValueError("truncated FITS header")
+            raise PrestoIOError("truncated FITS header", path=path,
+                                offset=pos, expected_bytes=BLOCK,
+                                actual_bytes=len(block),
+                                kind="truncated-header")
         for i in range(0, BLOCK, CARD):
             card = block[i:i + CARD].decode("ascii", "replace")
             key = card[:8].strip()
@@ -154,12 +159,25 @@ class BinTableHDU:
     naxis1: int        # row record bytes
     naxis2: int        # rows
     _buf: Any = None
+    path: str = ""
 
     def colindex(self, name: str) -> Column:
         for c in self.columns:
             if c.name == name:
                 return c
         raise KeyError(name)
+
+    def _check(self, start: int, nbytes: int, name: str) -> None:
+        """Bounds-check a column read against the actual file size —
+        a table whose NAXIS2 promises more rows than the file holds
+        (truncated download, killed writer) must fail with a typed
+        error, not a numpy buffer exception."""
+        avail = len(self._buf) - start
+        if start < 0 or avail < nbytes:
+            raise PrestoIOError(
+                "truncated FITS table data (column %s)" % name,
+                path=self.path, offset=start, expected_bytes=nbytes,
+                actual_bytes=max(0, avail), kind="truncated-data")
 
     def read_col(self, name: str, row: int,
                  count: Optional[int] = None) -> np.ndarray:
@@ -168,10 +186,12 @@ class BinTableHDU:
         start = self.data_offset + row * self.naxis1 + c.offset
         if c.code == "X":
             nbytes = (c.repeat + 7) // 8
+            self._check(start, nbytes, name)
             raw = np.frombuffer(self._buf, np.uint8, nbytes, start)
             return raw
         n = count if count is not None else c.repeat
         elem = _TFORM_DTYPES[c.code][1]
+        self._check(start, n * elem, name)
         raw = np.frombuffer(self._buf, c.dtype, n, start)
         if c.code == "A":
             return raw
@@ -181,10 +201,12 @@ class BinTableHDU:
         """The undecoded bytes of column `name` for one row."""
         c = self.colindex(name)
         start = self.data_offset + row * self.naxis1 + c.offset
+        self._check(start, c.nbytes, name)
         return np.frombuffer(self._buf, np.uint8, c.nbytes, start)
 
 
-def _parse_bintable(hdr: Header, data_offset: int, buf) -> BinTableHDU:
+def _parse_bintable(hdr: Header, data_offset: int, buf,
+                    path: str = "") -> BinTableHDU:
     tfields = int(hdr["TFIELDS"])
     cols = []
     off = 0
@@ -208,9 +230,13 @@ def _parse_bintable(hdr: Header, data_offset: int, buf) -> BinTableHDU:
                            unit=str(hdr.get("TUNIT%d" % i, "")).strip()))
         off += nbytes
     naxis1 = int(hdr["NAXIS1"])
-    assert off <= naxis1, "columns overflow NAXIS1"
+    if off > naxis1:
+        raise PrestoIOError("FITS columns overflow NAXIS1 (%d > %d)"
+                            % (off, naxis1), path=path,
+                            kind="bad-header")
     return BinTableHDU(header=hdr, columns=cols, data_offset=data_offset,
-                       naxis1=naxis1, naxis2=int(hdr["NAXIS2"]), _buf=buf)
+                       naxis1=naxis1, naxis2=int(hdr["NAXIS2"]),
+                       _buf=buf, path=path)
 
 
 class FitsFile:
@@ -220,29 +246,40 @@ class FitsFile:
         self.path = path
         self._f = open(path, "rb")
         try:
-            self._mm = mmap.mmap(self._f.fileno(), 0,
-                                 access=mmap.ACCESS_READ)
-        except (ValueError, OSError):
-            self._mm = self._f.read()
-        self.primary, pos = _read_header(self._mm, 0)
-        if self.primary.get("NAXIS", 0) not in (0, None):
-            # skip primary data if any
-            nax = int(self.primary["NAXIS"])
-            if nax > 0:
-                n = abs(int(self.primary["BITPIX"])) // 8
-                for a in range(1, nax + 1):
-                    n *= int(self.primary["NAXIS%d" % a])
-                pos += (n + BLOCK - 1) // BLOCK * BLOCK
-        self.hdus: List[BinTableHDU] = []
-        size = len(self._mm)
-        while pos < size:
-            hdr, doff = _read_header(self._mm, pos)
-            if str(hdr.get("XTENSION", "")).strip() != "BINTABLE":
-                raise ValueError("only BINTABLE extensions supported")
-            hdu = _parse_bintable(hdr, doff, self._mm)
-            self.hdus.append(hdu)
-            nbytes = hdu.naxis1 * hdu.naxis2
-            pos = doff + (nbytes + BLOCK - 1) // BLOCK * BLOCK
+            try:
+                self._mm = mmap.mmap(self._f.fileno(), 0,
+                                     access=mmap.ACCESS_READ)
+            except (ValueError, OSError):
+                self._mm = self._f.read()
+            self.primary, pos = _read_header(self._mm, 0, path)
+            if self.primary.get("NAXIS", 0) not in (0, None):
+                # skip primary data if any
+                nax = int(self.primary["NAXIS"])
+                if nax > 0:
+                    n = abs(int(self.primary["BITPIX"])) // 8
+                    for a in range(1, nax + 1):
+                        n *= int(self.primary["NAXIS%d" % a])
+                    pos += (n + BLOCK - 1) // BLOCK * BLOCK
+            self.hdus: List[BinTableHDU] = []
+            size = len(self._mm)
+            while pos < size:
+                hdr, doff = _read_header(self._mm, pos, path)
+                if str(hdr.get("XTENSION", "")).strip() != "BINTABLE":
+                    raise ValueError(
+                        "only BINTABLE extensions supported")
+                hdu = _parse_bintable(hdr, doff, self._mm, path)
+                self.hdus.append(hdu)
+                nbytes = hdu.naxis1 * hdu.naxis2
+                pos = doff + (nbytes + BLOCK - 1) // BLOCK * BLOCK
+        except KeyError as e:
+            # a required card (TFIELDS/NAXIS1/...) vanished: typed
+            # corruption error, not a KeyError escape
+            self.close()
+            raise PrestoIOError("missing FITS card %s" % e, path=path,
+                                kind="bad-header") from None
+        except BaseException:
+            self.close()
+            raise
 
     def hdu(self, extname: str) -> BinTableHDU:
         for h in self.hdus:
@@ -348,5 +385,5 @@ def write_fits(path: str, primary_cards: Sequence[Tuple],
             data += rec
         out += _pad_block(bytes(data), fill=b"\0")
 
-    with open(path, "wb") as f:
-        f.write(bytes(out))
+    from presto_tpu.io.atomic import atomic_write_bytes
+    atomic_write_bytes(path, bytes(out))

@@ -114,8 +114,8 @@ def write_inf(info: InfoData, filename: str | None = None) -> str:
                               "{:.12g}".format(info.chan_wid)))
     lines.append(_fmt("Data analyzed by", info.analyzer))
     lines.append(" Any additional notes:\n    {}\n\n".format(info.notes))
-    with open(path, "w") as f:
-        f.write("".join(lines))
+    from presto_tpu.io.atomic import atomic_write_text
+    atomic_write_text(path, "".join(lines))
     return path
 
 

@@ -91,8 +91,9 @@ def fill_mask(timesigma, freqsigma, mjd, dtint, lofreq, dfreq,
 
 
 def write_mask(path: str, m: Mask) -> None:
-    """Binary parity: write_mask (mask.c:233-265)."""
-    with open(path, "wb") as f:
+    """Binary parity: write_mask (mask.c:233-265); atomic on disk."""
+    from presto_tpu.io.atomic import atomic_open
+    with atomic_open(path, "wb") as f:
         f.write(struct.pack("<6d", m.timesigma, m.freqsigma, m.mjd,
                             m.dtint, m.lofreq, m.dfreq))
         f.write(struct.pack("<3i", m.numchan, m.numint, m.ptsperint))
@@ -111,15 +112,20 @@ def write_mask(path: str, m: Mask) -> None:
 
 
 def read_mask(path: str) -> Mask:
-    """Binary parity: read_mask (mask.c:103-148)."""
+    """Binary parity: read_mask (mask.c:103-148).  Truncated masks
+    raise a typed PrestoIOError, not a bare struct.error."""
+    from presto_tpu.io.errors import read_exact
     with open(path, "rb") as f:
         ts, fs, mjd, dtint, lofreq, dfreq = struct.unpack(
-            "<6d", f.read(48))
-        numchan, numint, ptsperint = struct.unpack("<3i", f.read(12))
-        nzc, = struct.unpack("<i", f.read(4))
+            "<6d", read_exact(f, 48, path, "mask header"))
+        numchan, numint, ptsperint = struct.unpack(
+            "<3i", read_exact(f, 12, path, "mask header"))
+        nzc, = struct.unpack("<i", read_exact(f, 4, path,
+                                              "mask header"))
         zap_chans = np.fromfile(f, "<i4", nzc) if nzc else \
             np.array([], np.int32)
-        nzi, = struct.unpack("<i", f.read(4))
+        nzi, = struct.unpack("<i", read_exact(f, 4, path,
+                                              "mask zap data"))
         zap_ints = np.fromfile(f, "<i4", nzi) if nzi else \
             np.array([], np.int32)
         counts = np.fromfile(f, "<i4", numint)
@@ -140,9 +146,10 @@ def read_mask(path: str) -> Mask:
 def write_statsfile(path: str, datapow, dataavg, datastd, ptsperint,
                     lobin=0, numbetween=2) -> None:
     """Binary parity: write_statsfile (rfifind.c:600-617).
-    datapow/avg/std: [numint, numchan] float32."""
+    datapow/avg/std: [numint, numchan] float32; atomic on disk."""
+    from presto_tpu.io.atomic import atomic_open
     numint, numchan = datapow.shape
-    with open(path, "wb") as f:
+    with atomic_open(path, "wb") as f:
         f.write(struct.pack("<5i", numchan, numint, ptsperint, lobin,
                             numbetween))
         np.asarray(datapow, "<f4").tofile(f)

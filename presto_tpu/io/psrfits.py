@@ -28,7 +28,10 @@ from typing import List, Optional, Sequence, Tuple
 import numpy as np
 
 from presto_tpu.io import native
+from presto_tpu.io.errors import PrestoIOError
 from presto_tpu.io.fitsio import FitsFile, write_fits
+from presto_tpu.io.quality import (DataQualityReport, record_zero_runs,
+                                   scrub_nonfinite)
 from presto_tpu.io.sigproc import FilterbankHeader
 
 SECPERDAY = 86400.0
@@ -116,16 +119,27 @@ class PsrfitsFile:
     def __init__(self, paths, apply_weight: Optional[bool] = None,
                  apply_scale: Optional[bool] = None,
                  apply_offset: Optional[bool] = None,
-                 use_poln: int = 0):
+                 use_poln: int = 0, quarantine: bool = True):
         if isinstance(paths, str):
             paths = [paths]
         self.paths = list(paths)
         self.files: List[FitsFile] = []
         self.meta: List[PsrfitsMeta] = []
         self.use_poln = use_poln
-        self._open_all()
+        self.quarantine = quarantine
+        try:
+            self._open_all()
+        except (KeyError, TypeError) as e:
+            # a missing HDU/column (SUBINT, TBIN, DATA...) or a card
+            # whose value rotted to the wrong type is file corruption,
+            # not a dict bug: surface it typed
+            self.close()
+            raise PrestoIOError(
+                "missing/corrupt PSRFITS structure: %s" % e,
+                path=self.paths[0], kind="bad-header") from None
         self._auto_scaling(apply_weight, apply_scale, apply_offset)
         self._cache_row = (None, None)
+        self._init_quality()
 
     # -- setup --------------------------------------------------------
     def _open_all(self):
@@ -148,6 +162,14 @@ class PsrfitsFile:
                 self.poln_order = str(h.get("POL_TYPE", "AA+BB")).strip()
                 self.nsblk = int(h["NSBLK"])
                 self.nbits = int(h.get("NBITS", 8))
+                if (self.nchan <= 0 or self.nsblk <= 0
+                        or self.dt <= 0.0
+                        or self.nbits not in (1, 2, 4, 8, 16, 32)):
+                    raise PrestoIOError(
+                        "invalid SUBINT geometry (NCHAN=%d NSBLK=%d "
+                        "TBIN=%g NBITS=%d)" % (self.nchan, self.nsblk,
+                                               self.dt, self.nbits),
+                        path=path, kind="bad-header")
                 self.zero_offset = abs(float(h.get("ZERO_OFF", 0.0) or 0.0))
                 self.chan_dm = float(pri.get("CHAN_DM", 0.0) or 0.0)
                 self.source = str(pri.get("SRC_NAME", "")).strip()
@@ -208,6 +230,22 @@ class PsrfitsFile:
         last = self.meta[-1]
         self.N = last.start_spec + self._last_spec_of(len(self.meta) - 1)
         self.padvals = np.zeros(self.nchan, np.float32)
+
+    def _init_quality(self) -> None:
+        """Build the quarantine ledger; pad gaps the row geometry
+        already implies (dropped subints, inter-file holes) are
+        recorded up front so the report is complete even before any
+        data is read."""
+        self.quality = DataQualityReport(path=self.paths[0],
+                                         nspectra=int(self.N),
+                                         nchan=self.nchan)
+        covered = sorted((int(s), int(s) + self.nsblk)
+                         for specs in self._row_specs for s in specs)
+        pos = 0
+        for lo, hi in covered:
+            if lo > pos:
+                self.quality.add(pos, lo, "dropped-rows")
+            pos = max(pos, hi)
 
     def _last_spec_of(self, fi: int) -> int:
         """Spectrum index just past file fi's last row (rel. to file
@@ -334,6 +372,7 @@ class PsrfitsFile:
         raw = sub.read_col_raw_bytes("DATA", row)
         fast = self._decode_row_native(sub, raw, row)
         if fast is not None:
+            fast = self._scrub_row(fast, fi, row)
             self._cache_row = ((fi, row), fast)
             return fast
         samples = unpack_samples(raw, self.nbits)
@@ -374,8 +413,22 @@ class PsrfitsFile:
         if self.df < 0:
             data = data[:, ::-1]      # present ascending
         out = np.ascontiguousarray(data, dtype=np.float32)
+        out = self._scrub_row(out, fi, row)
         self._cache_row = ((fi, row), out)
         return out
+
+    def _scrub_row(self, data: np.ndarray, fi: int,
+                   row: int) -> np.ndarray:
+        """Ingest quarantine on one decoded subint: NaN/Inf samples
+        (32-bit data, or poisoned DAT_SCL/DAT_OFFS/DAT_WTS) scrub to
+        0 and long zero-fill runs are recorded — both become mask
+        entries downstream instead of exceptions or silent garbage."""
+        if not self.quarantine:
+            return data
+        start = self._row_start_spec(fi, row)
+        data = scrub_nonfinite(data, start, self.quality)
+        record_zero_runs(data, start, self.quality)
+        return data
 
     def read_spectra(self, start: int, count: int) -> np.ndarray:
         """[count, nchan] float32, ascending frequency; gaps (dropped

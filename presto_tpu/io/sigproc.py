@@ -21,6 +21,9 @@ from typing import BinaryIO, Iterator, Optional
 import numpy as np
 
 from presto_tpu.io import native
+from presto_tpu.io.errors import PrestoIOError, read_exact
+from presto_tpu.io.quality import (DataQualityReport, record_zero_runs,
+                                   scrub_nonfinite)
 
 _TELESCOPES = {0: "Fake", 1: "Arecibo", 2: "Ooty", 3: "Nancay", 4: "Parkes",
                5: "Jodrell", 6: "GBT", 7: "GMRT", 8: "Effelsberg"}
@@ -49,11 +52,12 @@ def _send_double(f: BinaryIO, name: str, val: float) -> None:
     f.write(struct.pack("<d", float(val)))
 
 
-def _get_string(f: BinaryIO) -> str:
-    nbytes = struct.unpack("<i", f.read(4))[0]
+def _get_string(f: BinaryIO, path: str = "") -> str:
+    nbytes = struct.unpack(
+        "<i", read_exact(f, 4, path, "SIGPROC header"))[0]
     if not 0 < nbytes < 200:
         raise ValueError("bad SIGPROC header string length %d" % nbytes)
-    return f.read(nbytes).decode()
+    return read_exact(f, nbytes, path, "SIGPROC header").decode()
 
 
 @dataclass
@@ -122,31 +126,45 @@ def write_filterbank_header(hdr: FilterbankHeader, f: BinaryIO) -> None:
     _send_string(f, "HEADER_END")
 
 
-def read_filterbank_header(f: BinaryIO) -> FilterbankHeader:
-    """Parity: read_filterbank_header (sigproc_fb.c:229-336)."""
+def read_filterbank_header(f: BinaryIO,
+                           path: str = "") -> FilterbankHeader:
+    """Parity: read_filterbank_header (sigproc_fb.c:229-336).
+
+    Truncated headers raise a typed PrestoIOError (file, offset,
+    expected/actual bytes) instead of a bare struct.error escape.
+    """
     hdr = FilterbankHeader()
-    first = _get_string(f)
+    first = _get_string(f, path)
     if first != "HEADER_START":
         raise ValueError("not a SIGPROC filterbank file")
     while True:
-        key = _get_string(f)
+        key = _get_string(f, path)
         if key == "HEADER_END":
             break
         if key in _INT_KEYS:
-            val = struct.unpack("<i", f.read(4))[0]
+            val = struct.unpack(
+                "<i", read_exact(f, 4, path, "SIGPROC header"))[0]
             if key == "nsamples":
                 continue
             if hasattr(hdr, key):
                 setattr(hdr, key, val)
         elif key in _DBL_KEYS:
-            val = struct.unpack("<d", f.read(8))[0]
+            val = struct.unpack(
+                "<d", read_exact(f, 8, path, "SIGPROC header"))[0]
             if hasattr(hdr, key):
                 setattr(hdr, key, val)
         elif key in _STR_KEYS:
-            setattr(hdr, key, _get_string(f))
+            setattr(hdr, key, _get_string(f, path))
         else:
             raise ValueError("unknown SIGPROC header key: %r" % key)
     hdr.headerlen = f.tell()
+    if hdr.nchans <= 0 or hdr.nifs <= 0 or hdr.nbits <= 0:
+        # corrupt header values would divide by zero below / poison
+        # every downstream geometry computation
+        raise PrestoIOError(
+            "invalid SIGPROC geometry (nchans=%d nifs=%d nbits=%d)"
+            % (hdr.nchans, hdr.nifs, hdr.nbits), path=path,
+            kind="bad-header")
     pos = f.tell()
     f.seek(0, os.SEEK_END)
     filelen = f.tell()
@@ -213,15 +231,23 @@ class FilterbankFile:
     its readers (get_filterbank_rawblock, sigproc_fb.c:419-).
     """
 
-    def __init__(self, path: str):
+    def __init__(self, path: str, quarantine: bool = True):
         self.path = path
         self.f = open(path, "rb")
         try:
-            self.header = read_filterbank_header(self.f)
+            self.header = read_filterbank_header(self.f, path)
+        except PrestoIOError:
+            # already typed (truncated header): keep file/offset info
+            self.f.close()
+            raise
         except (ValueError, struct.error) as e:
             self.f.close()
             raise ValueError("%s is not a SIGPROC filterbank file (%s)"
                              % (path, e)) from None
+        self.quarantine = quarantine
+        self.quality = DataQualityReport(path=path,
+                                         nspectra=self.header.N,
+                                         nchan=self.header.nchans)
 
     def close(self):
         self.f.close()
@@ -247,17 +273,41 @@ class FilterbankFile:
         return 2400
 
     def read_spectra(self, start: int, count: int) -> np.ndarray:
-        """Read `count` spectra starting at `start`; zero-pad past EOF."""
+        """Read `count` spectra starting at `start`; zero-pad past EOF.
+
+        Short reads (the file shrank after open — a writer died or the
+        volume went away) are quarantined: the missing tail is recorded
+        in self.quality and zero-filled rather than crashing in the
+        decoder's reshape.
+        """
         hdr = self.header
         bps = hdr.bytes_per_spectrum
         self.f.seek(hdr.headerlen + start * bps)
         navail = max(0, min(count, hdr.N - start))
         raw = np.frombuffer(self.f.read(navail * bps), dtype=np.uint8)
-        arr = self._decode_raw(raw, navail)
-        if navail < count:
-            pad = np.zeros((count - navail, hdr.nchans), dtype=np.float32)
+        got = len(raw) // bps
+        if got < navail:
+            raw = raw[:got * bps]
+            self.quality.add(start + got, start + navail, "short-read")
+        arr = self._decode_raw(raw, got)
+        arr = self._scrub(arr, start, got)
+        if got < count:
+            pad = np.zeros((count - got, hdr.nchans), dtype=np.float32)
             arr = np.concatenate([arr, pad], axis=0)
         return np.ascontiguousarray(arr)
+
+    def _scrub(self, arr: np.ndarray, start: int,
+               nspec: int) -> np.ndarray:
+        """Ingest quarantine on a decoded block: NaN/Inf samples are
+        scrubbed to 0 (only 32-bit data can hold them) and long
+        zero-fill runs recorded; both land in self.quality for the
+        mask integration downstream."""
+        if not self.quarantine or nspec == 0:
+            return arr
+        if self.header.nbits == 32:
+            arr = scrub_nonfinite(arr, start, self.quality)
+        record_zero_runs(arr[:nspec], start, self.quality)
+        return arr
 
     def _decode_raw(self, raw: np.ndarray, nspec: int) -> np.ndarray:
         """Packed bytes -> [nspec, nchans] float32 ascending (the ONE
@@ -306,6 +356,7 @@ class FilterbankFile:
                 if nspec <= 0:
                     break
                 arr = self._decode_raw(raw[:nspec * bps], nspec)
+                arr = self._scrub(arr, start + delivered, nspec)
                 if nspec < block_size:
                     arr = np.concatenate(
                         [arr, np.zeros((block_size - nspec,
@@ -349,6 +400,20 @@ class FilterbankSet:
         # absolute starting spectrum of each file within the set
         self._starts = np.cumsum(
             [0] + [fb.header.N for fb in self.files[:-1]])
+
+    @property
+    def quality(self) -> DataQualityReport:
+        """Merged member-file quarantine ledgers, shifted to the
+        stitched observation's spectrum indices."""
+        out = DataQualityReport(path=self.path,
+                                nspectra=int(self.header.N),
+                                nchan=self.header.nchans)
+        for fb, start in zip(self.files, self._starts):
+            out.scrubbed_samples += fb.quality.scrubbed_samples
+            for iv in fb.quality.intervals:
+                out.add(iv.start + int(start), iv.stop + int(start),
+                        iv.reason)
+        return out
 
     def close(self):
         for fb in self.files:
@@ -400,10 +465,11 @@ def write_filterbank(path: str, hdr: FilterbankHeader,
     If hdr.foff < 0 the channel axis is flipped to descending order on
     disk, matching standard SIGPROC convention.
     """
+    from presto_tpu.io.atomic import atomic_open
     arr = data
     if hdr.foff < 0:
         arr = arr[:, ::-1]
-    with open(path, "wb") as f:
+    with atomic_open(path, "wb") as f:
         write_filterbank_header(hdr, f)
         packed = pack_bits(np.ascontiguousarray(arr).ravel(), hdr.nbits)
         f.write(packed.tobytes())

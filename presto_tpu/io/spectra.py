@@ -116,6 +116,18 @@ class Spectra:
                       maskval: float = 0.0) -> None:
         self.data[list(channums), :] = maskval
 
+    def scrub(self, padval: float = 0.0) -> int:
+        """Ingest quarantine for in-memory spectra: replace NaN/Inf
+        samples (corrupt blocks that slipped past the readers, or
+        downstream math on masked data) with `padval` in place.
+        Returns the number of samples scrubbed so callers can log or
+        add the count to a DataQualityReport."""
+        bad = ~np.isfinite(self.data)
+        nbad = int(bad.sum())
+        if nbad:
+            self.data[bad] = padval
+        return nbad
+
     def mean_spectrum(self) -> np.ndarray:
         return self.data.mean(axis=1)
 

@@ -335,7 +335,8 @@ class Candlist:
         return lines
 
     def to_file(self, path: str):
-        with open(path, "w") as f:
+        from presto_tpu.io.atomic import atomic_open
+        with atomic_open(path, "w") as f:
             f.write("\n".join(self.summary_lines()) + "\n")
             for c in self.cands:
                 for dm, snr, sig in sorted(c.hits):

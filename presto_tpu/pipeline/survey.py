@@ -12,8 +12,18 @@ that all run the same canonical flow — the tutorial command history
 This module is that flow as one restartable driver.  Every stage
 writes the standard durable artifacts (.mask/.dat/.inf/.fft/
 ACCEL_*/cands_sifted.txt/.pfd/.singlepulse), and a stage is skipped
-when its outputs already exist (the artifact-per-stage contract IS the
-checkpoint system, SURVEY §5.4).
+when its outputs are VERIFIED complete (the artifact-per-stage
+contract IS the checkpoint system, SURVEY §5.4) — verified, not
+merely present: every artifact is written atomically (io/atomic.py)
+and journaled with size + CRC-32 in the workdir's manifest.json
+(pipeline/manifest.py), so a resume after a kill redoes any stage
+whose outputs are missing, truncated, checksum-stale, or were never
+journaled, instead of silently trusting whatever bytes survived.
+
+Chaos hooks: SurveyConfig.fault_injector (testing/chaos.py
+FaultInjector) is called at every stage and chunk boundary; the chaos
+test matrix kills the survey at each point and asserts a resumed run
+produces byte-identical final artifacts.
 """
 
 from __future__ import annotations
@@ -63,6 +73,12 @@ class SurveyConfig:
     # batch-driver behavior.  A resident service shares one provider
     # across jobs so same-shaped trial groups reuse compiled plans.
     plan_provider: Optional[object] = None
+    # fault-tolerance hooks: fault_injector is an object with
+    # .point(name) (testing/chaos.FaultInjector) called at stage/chunk
+    # boundaries; verify_resume=False reverts to the legacy trust-
+    # existence checkpoint contract (no manifest journal).
+    fault_injector: Optional[object] = None
+    verify_resume: bool = True
 
     @property
     def all_passes(self):
@@ -82,10 +98,42 @@ class SurveyResult:
     folded: List[str] = field(default_factory=list)
     sp_events: int = 0
     sifted: Optional[object] = None      # sifting.Candlist
+    quality: Optional[object] = None     # io/quality.DataQualityReport
 
 
 def _stage(done_glob: str, workdir: str) -> List[str]:
     return sorted(glob.glob(os.path.join(workdir, done_glob)))
+
+
+def _chaos(cfg: SurveyConfig, point: str) -> None:
+    """Fire the configured fault injector at a named kill point."""
+    fi = getattr(cfg, "fault_injector", None)
+    if fi is not None:
+        fi.point(point)
+
+
+def _valid(manifest, path: str) -> bool:
+    """Is this artifact trustworthy for resume?  With a manifest:
+    exists AND matches its journaled size+checksum.  Without
+    (verify_resume=False): the legacy existence check."""
+    if manifest is None:
+        return os.path.exists(path)
+    return manifest.valid(path)
+
+
+def _record(manifest, paths, stage: str) -> None:
+    if manifest is not None:
+        manifest.record_many([p for p in paths if os.path.exists(p)],
+                             stage)
+
+
+def _drop_stale(manifest, paths) -> List[str]:
+    """Delete + forget artifacts that fail verification; returns the
+    surviving (valid) subset."""
+    if manifest is None:
+        return [p for p in paths if os.path.exists(p)]
+    stale = set(manifest.invalidate_stale(paths))
+    return [p for p in paths if p not in stale]
 
 
 def run_survey(rawfiles: Sequence[str], cfg: SurveyConfig,
@@ -95,28 +143,53 @@ def run_survey(rawfiles: Sequence[str], cfg: SurveyConfig,
     base = os.path.join(
         workdir, os.path.splitext(os.path.basename(rawfiles[0]))[0])
     res = SurveyResult(workdir=workdir)
+    # crash-safe resume setup: sweep a killed run's in-flight temp
+    # files, then load the artifact journal this run will verify
+    # against and append to
+    from presto_tpu.io.atomic import cleanup_stale_tmp
+    cleanup_stale_tmp(workdir)
+    manifest = None
+    if cfg.verify_resume:
+        from presto_tpu.pipeline.manifest import SurveyManifest
+        manifest = SurveyManifest.load(workdir)
     if timer is None:
         from presto_tpu.utils.timing import StageTimer
         timer = StageTimer()
     try:
         return _run_survey_stages(rawfiles, cfg, workdir, base, res,
-                                  timer)
+                                  timer, manifest)
     finally:
         timer.mark(None)
         timer.report()
 
 
-def _run_survey_stages(rawfiles, cfg, workdir, base, res, timer):
+def _run_survey_stages(rawfiles, cfg, workdir, base, res, timer,
+                       manifest=None):
 
     timer.mark("rfifind")
+    _chaos(cfg, "pre-rfifind")
     # ---- 1. rfifind ---------------------------------------------------
     mask = base + "_rfifind.mask"
     if not cfg.skip_rfifind:
-        if not os.path.exists(mask):
+        if not _valid(manifest, mask):
+            _drop_stale(manifest,
+                        glob.glob(base + "_rfifind.*")
+                        + [base + "_rfifind_quality.json"])
             from presto_tpu.apps.rfifind import main as rfifind_main
             rfifind_main(["-time", str(cfg.rfi_time), "-o", base]
                          + rawfiles)
+            _record(manifest,
+                    glob.glob(base + "_rfifind.*")
+                    + [base + "_rfifind_quality.json"], "rfifind")
         res.maskfile = mask
+        qpath = base + "_rfifind_quality.json"
+        if os.path.exists(qpath):
+            from presto_tpu.io.quality import DataQualityReport
+            try:
+                res.quality = DataQualityReport.read(qpath)
+            except (OSError, ValueError):
+                pass
+    _chaos(cfg, "post-rfifind")
 
     timer.mark("ddplan")
     # ---- 2. DDplan ----------------------------------------------------
@@ -134,10 +207,16 @@ def _run_survey_stages(rawfiles, cfg, workdir, base, res, timer):
           % (len(plan.methods), plan.total_numdms))
 
     timer.mark("prepsubband")
+    _chaos(cfg, "pre-prepsubband")
     # ---- 3. prepsubband per method ------------------------------------
     from presto_tpu.apps.prepsubband import main as prepsubband_main
+    dat_glob = os.path.basename(base) + "_DM*.dat"
+    # verify survivors of a previous run ONCE, before the loop — this
+    # run's own per-method outputs are journaled as each method lands,
+    # so they must not be re-judged (and deleted) mid-flight
+    _drop_stale(manifest, _stage(dat_glob, workdir))
     for m in plan.methods:
-        have = _stage(os.path.basename(base) + "_DM*.dat", workdir)
+        have = _stage(dat_glob, workdir)
         missing = [dm for dm in m.dms
                    if not any("_DM%.2f.dat" % dm in f for f in have)]
         if not missing:
@@ -149,27 +228,40 @@ def _run_survey_stages(rawfiles, cfg, workdir, base, res, timer):
         if res.maskfile and os.path.exists(res.maskfile):
             argv += ["-mask", res.maskfile]
         prepsubband_main(argv + rawfiles)
-    res.datfiles = _stage(os.path.basename(base) + "_DM*.dat", workdir)
+        done = _stage(dat_glob, workdir)
+        _record(manifest, done + [f[:-4] + ".inf" for f in done],
+                "prepsubband")
+        _chaos(cfg, "prepsubband-method")
+    res.datfiles = _stage(dat_glob, workdir)
     print("survey: %d dedispersed time series" % len(res.datfiles))
+    _chaos(cfg, "post-prepsubband")
 
     from dataclasses import replace as _replace
     passes = cfg.all_passes
     if cfg.zaplist:
         timer.mark("realfft")
-        _staged_fft_search_head(res, cfg)
+        _staged_fft_search_head(res, cfg, manifest)
         fftfiles = [f[:-4] + ".fft" for f in res.datfiles]
         timer.mark("zapbirds")
         # ---- 5. zapbirds ---------------------------------------------
+        # zapping mutates the .fft in place and is NOT idempotent, so
+        # the journal's stage tag is the checkpoint: a spectrum whose
+        # entry already says "zapbirds" (and still verifies) is done.
         from presto_tpu.apps.zapbirds import main as zap_main
         for f in fftfiles:
+            if (manifest is not None and manifest.valid(f)
+                    and manifest.stage_of(f) == "zapbirds"):
+                continue
             zap_main(["-zap", "-zapfile", cfg.zaplist, f])
+            _record(manifest, [f], "zapbirds")
+            _chaos(cfg, "zapbirds-file")
         timer.mark("accelsearch")
         # ---- 6. accelsearch: BATCHED over the DM fan-out, once per
         # recipe pass (e.g. PALFA's zmax=0/nh=16 + zmax=50/nh=8) -----
         for (zmax, nh, sg, flo) in passes:
             _batched_accelsearch(
                 fftfiles, _replace(cfg, zmax=zmax, numharm=nh,
-                                   sigma=sg, flo=flo))
+                                   sigma=sg, flo=flo), manifest)
     else:
         # ---- 4+6 fused fast path: realfft -> accelsearch with the
         # spectra RESIDENT on device (no zapbirds in between).  Saves
@@ -177,18 +269,19 @@ def _run_survey_stages(rawfiles, cfg, workdir, base, res, timer):
         # tunneled link's slowest direction; .fft/ACCEL artifacts are
         # still written, preserving the checkpoint contract.
         timer.mark("realfft+accelsearch (fused)")
-        _fused_fft_search(res, cfg)
+        _fused_fft_search(res, cfg, manifest)
         for (zmax, nh, sg, flo) in passes:
             # resume case for the first pass; full searches for the
             # recipe's additional passes
             _batched_accelsearch(
                 [f[:-4] + ".fft" for f in res.datfiles],
                 _replace(cfg, zmax=zmax, numharm=nh, sigma=sg,
-                         flo=flo))
+                         flo=flo), manifest)
 
     timer.mark("sift")
+    _chaos(cfg, "pre-sift")
     return _finish_survey_stages(rawfiles, cfg, workdir, base, res,
-                                 timer)
+                                 timer, manifest)
 
 
 def _length_groups(files, item_bytes):
@@ -213,14 +306,15 @@ def _survey_searcher(first_file, nbins, cfg):
     return AccelSearch(acfg, T=T, numbins=nbins), T
 
 
-def _fused_fft_search(res, cfg) -> None:
+def _fused_fft_search(res, cfg, manifest=None) -> None:
     """Stage 4+6 fused: batched rfft, search_many on the DEVICE
     spectra, one download for the .fft artifacts.  Only processes
-    trials with NO .fft yet — existing spectra (an interrupted run's
-    checkpoints) are left to _batched_accelsearch so their upload
-    isn't paid twice."""
+    trials with NO verified .fft yet — existing valid spectra (an
+    interrupted run's checkpoints) are left to _batched_accelsearch so
+    their upload isn't paid twice."""
+    _drop_stale(manifest, [f[:-4] + ".fft" for f in res.datfiles])
     todo = [f for f in res.datfiles
-            if not os.path.exists(f[:-4] + ".fft")]
+            if not _valid(manifest, f[:-4] + ".fft")]
     if not todo:
         return
     import jax
@@ -241,19 +335,29 @@ def _fused_fft_search(res, cfg) -> None:
             pairs_dev = batched(jnp.asarray(arr))    # stays in HBM
             results = searcher.search_many(pairs_dev)
             pairs_host = np.asarray(pairs_dev)       # one download
+            arts = []
             for f, pr, raw in zip(chunk, pairs_host, results):
                 amps = fftpack.np_pairs_to_complex64(pr)
                 datfft.write_fft(f[:-4] + ".fft", amps)
                 refine_and_write(raw, amps, T, searcher, f[:-4],
                                  cfg.zmax, quiet=True)
+                acc = f[:-4] + "_ACCEL_%d" % cfg.zmax
+                arts += [f[:-4] + ".fft", acc, acc + ".cand"]
+            _record(manifest, arts, "fft+accel")
+            _chaos(cfg, "fused-chunk")
     print("survey: fused realfft+accelsearch over %d trials "
           "(device-resident spectra)" % len(todo))
 
 
-def _staged_fft_search_head(res, cfg):
-    """Stage 4 alone (the staged path used when zapbirds intervenes)."""
+def _staged_fft_search_head(res, cfg, manifest=None):
+    """Stage 4 alone (the staged path used when zapbirds intervenes).
+
+    Resume caveat: an .fft the journal marks "zapbirds" is a ZAPPED
+    spectrum — still valid, must not be regenerated (that would undo
+    the zap and desync the stage tag)."""
+    _drop_stale(manifest, [f[:-4] + ".fft" for f in res.datfiles])
     todo = [f for f in res.datfiles
-            if not os.path.exists(f[:-4] + ".fft")]
+            if not _valid(manifest, f[:-4] + ".fft")]
     if todo:
         import jax
         import jax.numpy as jnp
@@ -274,14 +378,22 @@ def _staged_fft_search_head(res, cfg):
                 for f, pr in zip(chunk, pairs):
                     datfft.write_fft(f[:-4] + ".fft",
                                      fftpack.np_pairs_to_complex64(pr))
+                _record(manifest, [f[:-4] + ".fft" for f in chunk],
+                        "realfft")
+                _chaos(cfg, "fft-chunk")
         print("survey: realfft over %d series (batched)" % len(todo))
 
 
-def _batched_accelsearch(fftfiles, cfg):
+def _batched_accelsearch(fftfiles, cfg, manifest=None):
     """Stage 6 alone (staged path): grouped search_many over .fft
     files already on disk."""
-    todo = [f for f in fftfiles
-            if not os.path.exists(f[:-4] + "_ACCEL_%d" % cfg.zmax)]
+    accs = [f[:-4] + "_ACCEL_%d" % cfg.zmax for f in fftfiles]
+    # the ACCEL table and its binary .cand companion are one logical
+    # artifact: either going stale redoes both
+    _drop_stale(manifest, accs + [a + ".cand" for a in accs])
+    todo = [f for f, a in zip(fftfiles, accs)
+            if not (_valid(manifest, a)
+                    and _valid(manifest, a + ".cand"))]
     if todo:
         import numpy as np
         from presto_tpu.io import datfft
@@ -298,14 +410,20 @@ def _batched_accelsearch(fftfiles, cfg):
                 batch = np.stack([fftpack.np_complex64_to_pairs(a)
                                   for a in amps_list])
                 results = searcher.search_many(batch)
+                arts = []
                 for f, amps, raw in zip(chunk, amps_list, results):
                     refine_and_write(raw, amps, T, searcher, f[:-4],
                                      cfg.zmax, quiet=True)
+                    acc = f[:-4] + "_ACCEL_%d" % cfg.zmax
+                    arts += [acc, acc + ".cand"]
+                _record(manifest, arts, "accel")
+                _chaos(cfg, "accel-chunk")
         print("survey: accelsearch over %d trials (batched)"
               % len(todo))
 
 
-def _finish_survey_stages(rawfiles, cfg, workdir, base, res, timer):
+def _finish_survey_stages(rawfiles, cfg, workdir, base, res, timer,
+                          manifest=None):
     # ---- 7. sift ------------------------------------------------------
     from presto_tpu.pipeline.sifting import sift_candidates
     accfiles = []
@@ -318,9 +436,11 @@ def _finish_survey_stages(rawfiles, cfg, workdir, base, res, timer):
                          low_DM_cutoff=cfg.low_dm_cutoff,
                          policy=cfg.sift_policy)
     cl.to_file(res.candfile)
+    _record(manifest, [res.candfile], "sift")
     res.sifted = cl
     print("survey: %d sifted candidates -> %s"
           % (len(cl), res.candfile))
+    _chaos(cfg, "post-sift")
 
     timer.mark("prepfold")
     # ---- 8. fold the top candidates -----------------------------------
@@ -356,7 +476,7 @@ def _finish_survey_stages(rawfiles, cfg, workdir, base, res, timer):
         candfile = accpath + ".cand"
         datfile = accpath.split("_ACCEL_")[0] + ".dat"
         outbase = os.path.join(workdir, "fold_cand%d" % (i + 1))
-        if os.path.exists(outbase + ".pfd"):
+        if _valid(manifest, outbase + ".pfd"):
             res.folded.append(outbase + ".pfd")
             continue
         try:
@@ -365,26 +485,35 @@ def _finish_survey_stages(rawfiles, cfg, workdir, base, res, timer):
                            "-dm", "%.2f" % c.DM, "-nosearch",
                            "-o", outbase, datfile])
             res.folded.append(outbase + ".pfd")
+            _record(manifest, [outbase + ".pfd"], "prepfold")
         except SystemExit as e:
             print("survey: fold of cand %d failed: %s" % (i + 1, e))
+        _chaos(cfg, "fold-cand")
     print("survey: folded %d candidates" % len(res.folded))
 
     timer.mark("single_pulse")
+    _chaos(cfg, "pre-singlepulse")
     # ---- 9. single-pulse search --------------------------------------
     if cfg.singlepulse and res.datfiles:
         from presto_tpu.apps.single_pulse_search import main as sp_main
+        _drop_stale(manifest,
+                    [f[:-4] + ".singlepulse" for f in res.datfiles])
         sp_todo = [f for f in res.datfiles
-                   if not os.path.exists(f[:-4] + ".singlepulse")]
+                   if not _valid(manifest, f[:-4] + ".singlepulse")]
         if sp_todo:
             argv = ["-t", str(cfg.sp_threshold)]
             if cfg.sp_maxwidth:
                 argv += ["-m", str(cfg.sp_maxwidth)]
             sp_main(argv + sp_todo)
+            _record(manifest,
+                    [f[:-4] + ".singlepulse" for f in sp_todo],
+                    "singlepulse")
         from presto_tpu.search.singlepulse import read_singlepulse
         for f in res.datfiles:
             spf = f[:-4] + ".singlepulse"
             if os.path.exists(spf):
                 res.sp_events += len(read_singlepulse(spf))
         print("survey: %d single-pulse events" % res.sp_events)
+    _chaos(cfg, "post-survey")
 
     return res

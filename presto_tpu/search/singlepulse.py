@@ -618,8 +618,10 @@ def _collect_chunk_hits(vals_c, idx_c, counts_c, chunknum, widths,
 
 
 def write_singlepulse(path: str, cands: Sequence[SPCandidate]) -> None:
-    """Write the .singlepulse ASCII artifact (reference column format)."""
-    with open(path, "w") as f:
+    """Write the .singlepulse ASCII artifact (reference column format,
+    atomic on disk)."""
+    from presto_tpu.io.atomic import atomic_open
+    with atomic_open(path, "w") as f:
         if cands:
             f.write("# DM      Sigma      Time (s)     Sample    Downfact\n")
             for c in cands:

@@ -27,6 +27,11 @@ class QueueClosed(RuntimeError):
     """The queue has been closed; no further pops/submissions."""
 
 
+class RetryBudgetExceeded(RuntimeError):
+    """A job was re-admitted more than max_retry_depth times: a
+    poisoned job must terminate, not cycle the queue forever."""
+
+
 class JobStatus:
     """Job lifecycle states (plain strings; JSON-friendly)."""
     QUEUED = "queued"
@@ -52,6 +57,7 @@ class Job:
     spec: dict = field(default_factory=dict)   # raw submitted spec
     status: str = JobStatus.QUEUED
     attempts: int = 0
+    requeues: int = 0              # retry re-admissions so far
     error: str = ""
     submitted: float = 0.0
     started: float = 0.0
@@ -66,6 +72,7 @@ class Job:
             "priority": self.priority,
             "bucket": repr(self.bucket),
             "attempts": self.attempts,
+            "requeues": self.requeues,
             "error": self.error,
             "submitted": self.submitted,
             "started": self.started,
@@ -77,10 +84,14 @@ class Job:
 class JobQueue:
     """Thread-safe bounded priority queue with bucket coalescing."""
 
-    def __init__(self, maxdepth: int = 64):
+    def __init__(self, maxdepth: int = 64,
+                 max_retry_depth: Optional[int] = 8):
         if maxdepth < 1:
             raise ValueError("maxdepth must be >= 1")
         self.maxdepth = maxdepth
+        # retry re-admissions allowed per job (None = unbounded, the
+        # pre-fix behavior); see requeue()
+        self.max_retry_depth = max_retry_depth
         self._heap: List[Tuple[int, int, Job]] = []
         self._count = itertools.count()
         self._lock = threading.Lock()
@@ -126,10 +137,21 @@ class JobQueue:
     def requeue(self, job: Job) -> None:
         """Re-admit a retrying job.  Retries bypass the depth bound —
         the job already held a slot when first admitted; bouncing it
-        now would turn a transient failure into a drop."""
+        now would turn a transient failure into a drop.  They count
+        against max_retry_depth instead: a job that keeps failing its
+        way back in (poisoned input, permanently broken executor)
+        raises RetryBudgetExceeded so the scheduler can terminate it
+        with a final `fail` event rather than cycle it forever."""
         with self._lock:
             if self._closed:
                 raise QueueClosed("queue is closed")
+            if (self.max_retry_depth is not None
+                    and job.requeues >= self.max_retry_depth):
+                raise RetryBudgetExceeded(
+                    "job %s re-admitted %d times (max_retry_depth=%d)"
+                    % (job.job_id, job.requeues,
+                       self.max_retry_depth))
+            job.requeues += 1
             job.status = JobStatus.QUEUED
             heapq.heappush(self._heap,
                            (job.priority, next(self._count), job))

@@ -34,7 +34,7 @@ from dataclasses import dataclass
 from typing import Callable, List, Optional
 
 from presto_tpu.serve.queue import (Job, JobQueue, JobStatus,
-                                    QueueClosed)
+                                    QueueClosed, RetryBudgetExceeded)
 
 
 class JobTimeout(RuntimeError):
@@ -162,6 +162,20 @@ class Scheduler:
                 job.status = JobStatus.FAILED
                 job.error = "queue closed during retry wait"
                 job.finished = time.time()
+            except RetryBudgetExceeded as e:
+                # poisoned job: terminate with the LAST execution
+                # error preserved (the budget note rides along), and
+                # emit the terminal `fail` event observers wait on.
+                job.status = JobStatus.FAILED
+                job.error = "%s [%s]" % (job.error or "retry", e)
+                job.finished = time.time()
+                with self._stats_lock:
+                    self._failed += 1
+                if self.events is not None:
+                    self.events.emit("fail", job=job.job_id,
+                                     attempts=job.attempts,
+                                     error=job.error, timeout=False,
+                                     retry_depth_exceeded=True)
 
     # ---- batch execution ----------------------------------------------
 

@@ -50,10 +50,10 @@ class BadRequest(ValueError):
 
 def _allowed_config_fields():
     """SurveyConfig fields settable over the wire: everything except
-    object-valued hooks (plan_provider/sift_policy are in-process
-    only)."""
+    object-valued hooks (plan_provider/sift_policy/fault_injector are
+    in-process only)."""
     from presto_tpu.pipeline.survey import SurveyConfig
-    blocked = {"plan_provider", "sift_policy"}
+    blocked = {"plan_provider", "sift_policy", "fault_injector"}
     return {f.name for f in dataclass_fields(SurveyConfig)
             if f.name not in blocked}
 
@@ -65,12 +65,14 @@ class SearchService:
     def __init__(self, workroot: str, queue_depth: int = 64,
                  plan_capacity: int = 32,
                  scheduler_cfg: Optional[SchedulerConfig] = None,
-                 events_path: Optional[str] = None, mesh=None):
+                 events_path: Optional[str] = None, mesh=None,
+                 max_retry_depth: Optional[int] = 8):
         os.makedirs(workroot, exist_ok=True)
         self.workroot = os.path.abspath(workroot)
         self.events = EventLog(path=events_path)
         self.latency = LatencyStats()
-        self.queue = JobQueue(maxdepth=queue_depth)
+        self.queue = JobQueue(maxdepth=queue_depth,
+                              max_retry_depth=max_retry_depth)
         self.plans = PlanCache(capacity=plan_capacity,
                                events=self.events)
         self.provider = SearcherProvider(self.plans, mesh=mesh)

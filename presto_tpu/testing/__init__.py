@@ -1,0 +1,1 @@
+"""Reusable test infrastructure (chaos/fault-injection harness)."""

@@ -1,0 +1,183 @@
+"""Pipeline-wide chaos harness: reusable fault injection.
+
+One injector serves every layer:
+
+  * process-kill points — the survey driver calls
+    ``cfg.fault_injector.point("stage-name")`` at stage and chunk
+    boundaries; a scheduled FaultInjector raises SimulatedCrash (or
+    hard-exits) there, simulating a preempted TPU host.  Tests catch
+    the crash, re-run the survey, and assert resume equivalence.
+  * file corruption — truncate_file / bitflip_file / zero_fill_file
+    mutate artifacts and raw inputs on disk for ingest-fuzz tests, and
+    ShortReadFile wraps a file object to starve a parser mid-read.
+  * transient device errors — TransientFaults plugs into the serve
+    scheduler's ``SchedulerConfig.fault_injector`` seam (called as
+    fn(job, attempt)) and fails the first N attempts, exercising
+    retry/backoff and the queue's retry-depth bound.
+
+SimulatedCrash derives from BaseException (like KeyboardInterrupt) so
+recovery code catching plain Exception cannot accidentally swallow an
+injected kill — a kill is a kill.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+from typing import Callable, List, Optional
+
+
+class SimulatedCrash(BaseException):
+    """Injected process death at a named kill point."""
+
+    def __init__(self, point: str):
+        self.point = point
+        super().__init__("simulated crash at kill point %r" % point)
+
+
+class FaultInjector:
+    """Fires once at the Nth matching kill point.
+
+    Parameters
+    ----------
+    kill_at : substring a point name must contain to count (None
+        matches every point).
+    kill_after : fire on the Nth matching call (1-based).
+    mode : "raise" raises SimulatedCrash (in-process tests);
+        "exit" calls os._exit(EXIT_CODE) — a real kill, for
+        subprocess-based harnesses like tools/chaos_survey.py.
+    """
+
+    EXIT_CODE = 43
+
+    def __init__(self, kill_at: Optional[str] = None,
+                 kill_after: int = 1, mode: str = "raise"):
+        if mode not in ("raise", "exit", "off"):
+            raise ValueError("mode must be raise|exit|off")
+        self.kill_at = kill_at
+        self.kill_after = max(1, int(kill_after))
+        self.mode = mode
+        self.fired: Optional[str] = None
+        self.matched = 0
+        self.points_seen: List[str] = []
+
+    def point(self, name: str) -> None:
+        """Instrumentation hook: called by the pipeline at kill
+        points.  No-op once fired (so a resumed in-process run with
+        the same injector proceeds)."""
+        self.points_seen.append(name)
+        if self.fired is not None or self.mode == "off":
+            return
+        if self.kill_at is not None and self.kill_at not in name:
+            return
+        self.matched += 1
+        if self.matched < self.kill_after:
+            return
+        self.fired = name
+        if self.mode == "exit":
+            os._exit(self.EXIT_CODE)
+        raise SimulatedCrash(name)
+
+
+def run_to_completion(fn: Callable, max_crashes: int = 32):
+    """Drive `fn` through injected crashes: call it until it returns
+    without raising SimulatedCrash (the kill-resume loop in one
+    line).  Returns fn()'s result."""
+    for _ in range(max_crashes):
+        try:
+            return fn()
+        except SimulatedCrash:
+            continue
+    raise RuntimeError("still crashing after %d resumes" % max_crashes)
+
+
+class TransientFaults:
+    """serve-scheduler fault injector: fail the first `fail_attempts`
+    execution attempts of each (matching) job, then let it succeed.
+    With fail_attempts >= the retry budget this is the poisoned-job
+    case the queue's max_retry_depth bound must contain."""
+
+    def __init__(self, fail_attempts: int = 1,
+                 exc: Callable[[str], Exception] = RuntimeError,
+                 match: Optional[Callable] = None):
+        self.fail_attempts = fail_attempts
+        self.exc = exc
+        self.match = match
+        self.calls = 0
+
+    def __call__(self, job, attempt: int) -> None:
+        self.calls += 1
+        if self.match is not None and not self.match(job):
+            return
+        if attempt <= self.fail_attempts:
+            raise self.exc("injected transient device error "
+                           "(job %s attempt %d)"
+                           % (getattr(job, "job_id", "?"), attempt))
+
+
+# ----------------------------------------------------------------------
+# On-disk corruption (ingest fuzzing)
+# ----------------------------------------------------------------------
+
+def truncate_file(path: str, keep_bytes: Optional[int] = None,
+                  keep_frac: Optional[float] = None) -> int:
+    """Truncate `path`; returns the new size."""
+    size = os.path.getsize(path)
+    if keep_bytes is None:
+        keep_bytes = int(size * (1.0 if keep_frac is None
+                                 else keep_frac))
+    keep_bytes = max(0, min(size, keep_bytes))
+    with open(path, "r+b") as f:
+        f.truncate(keep_bytes)
+    return keep_bytes
+
+
+def bitflip_file(path: str, nflips: int = 1, seed: int = 0,
+                 lo: int = 0, hi: Optional[int] = None) -> List[int]:
+    """Flip `nflips` random bits in [lo, hi) (deterministic per seed);
+    returns the byte offsets touched."""
+    size = os.path.getsize(path)
+    hi = size if hi is None else min(hi, size)
+    if hi <= lo:
+        return []
+    rng = random.Random(seed)
+    offsets = []
+    with open(path, "r+b") as f:
+        for _ in range(nflips):
+            off = rng.randrange(lo, hi)
+            bit = rng.randrange(8)
+            f.seek(off)
+            b = f.read(1)[0]
+            f.seek(off)
+            f.write(bytes([b ^ (1 << bit)]))
+            offsets.append(off)
+    return offsets
+
+
+def zero_fill_file(path: str, offset: int, length: int) -> None:
+    """Overwrite [offset, offset+length) with zeros (the dropped-block
+    signature many backends write on packet loss)."""
+    with open(path, "r+b") as f:
+        f.seek(offset)
+        f.write(b"\x00" * length)
+
+
+class ShortReadFile:
+    """File-object wrapper whose reads go dry after `budget` bytes —
+    simulates a reader racing a truncation/unmount without touching
+    the disk.  Proxies seek/tell/close to the underlying file."""
+
+    def __init__(self, f, budget: int):
+        self._f = f
+        self.budget = budget
+
+    def read(self, n: int = -1) -> bytes:
+        if n is None or n < 0:
+            data = self._f.read(self.budget)
+        else:
+            data = self._f.read(min(n, max(self.budget, 0)))
+        self.budget -= len(data)
+        return data
+
+    def __getattr__(self, name):
+        return getattr(self._f, name)

@@ -1,0 +1,254 @@
+"""Survey chaos matrix (ISSUE 2 tentpole part 3): kill-resume
+equivalence and corruption containment for the one-command survey
+driver.
+
+Equivalence contract: a survey killed at ANY instrumented point and
+resumed must produce byte-identical final artifacts (.dat/.fft/
+ACCEL_*/cands_sifted.txt/.singlepulse/mask) to an uninterrupted run —
+the manifest journal redoes exactly the work whose outputs can't be
+verified, and every stage is deterministic.
+
+Containment contract: corrupt input (NaN/Inf samples, zero-filled
+dropout stretches) never crashes run_survey; the damage lands in the
+DataQualityReport and the rfifind mask, and candidate lists are still
+produced.
+"""
+
+import glob
+import os
+
+import numpy as np
+import pytest
+
+from presto_tpu.models.synth import FakeSignal, fake_filterbank_file
+from presto_tpu.pipeline.survey import SurveyConfig, run_survey
+from presto_tpu.testing import chaos
+
+N, NCHAN, DT = 1 << 13, 16, 2e-4
+
+#: artifacts whose bytes must match between runs (basename -> bytes);
+#: .inf/manifest/quality/png are excluded — they embed workdir paths
+#: or are journal metadata, not survey outputs
+COMPARABLE = (".dat", ".fft", ".cand", ".singlepulse", ".mask",
+              ".stats", ".txt")
+
+
+def _comparable(name):
+    return (name.endswith(COMPARABLE) or "_ACCEL_" in name) \
+        and not name.endswith(".inf")
+
+
+def _artifacts(workdir):
+    out = {}
+    for p in sorted(glob.glob(os.path.join(workdir, "*"))):
+        name = os.path.basename(p)
+        if os.path.isfile(p) and _comparable(name):
+            with open(p, "rb") as f:
+                out[name] = f.read()
+    return out
+
+
+def _assert_equal_artifacts(got, ref):
+    assert set(got) == set(ref), (
+        "artifact sets differ: only-in-resumed=%s only-in-ref=%s"
+        % (sorted(set(got) - set(ref)), sorted(set(ref) - set(got))))
+    diff = [n for n in ref if got[n] != ref[n]]
+    assert not diff, "artifacts differ after resume: %s" % diff
+
+
+@pytest.fixture(scope="module")
+def tiny_obs(tmp_path_factory):
+    d = tmp_path_factory.mktemp("obs")
+    raw = str(d / "psr.fil")
+    sig = FakeSignal(f=17.0, dm=10.0, shape="gauss", width=0.08,
+                     amp=0.8)
+    fake_filterbank_file(raw, N, DT, NCHAN, 400.0, 1.0, sig,
+                         noise_sigma=2.0, nbits=8)
+    return raw
+
+
+@pytest.fixture(scope="module")
+def provider():
+    """One compiled-plan cache for every run in this module: the
+    chaos matrix re-runs the same-shaped search many times and must
+    not pay the jit compile each time."""
+    from presto_tpu.serve.plancache import PlanCache, SearcherProvider
+    return SearcherProvider(PlanCache(capacity=8))
+
+
+def _cfg(provider, **kw):
+    base = dict(lodm=5.0, hidm=12.0, nsub=16, zmax=0, numharm=2,
+                sigma=3.0, fold_top=0, rfi_time=0.4, singlepulse=True,
+                plan_provider=provider)
+    base.update(kw)
+    return SurveyConfig(**base)
+
+
+@pytest.fixture(scope="module")
+def reference_run(tiny_obs, provider, tmp_path_factory):
+    work = str(tmp_path_factory.mktemp("ref"))
+    res = run_survey([tiny_obs], _cfg(provider), workdir=work)
+    arts = _artifacts(work)
+    assert any("_ACCEL_" in n for n in arts)
+    assert "cands_sifted.txt" in arts
+    assert any(n.endswith(".singlepulse") for n in arts)
+    return res, arts
+
+
+# ----------------------------------------------------------------------
+# kill-resume equivalence (acceptance: >= 3 kill points)
+# ----------------------------------------------------------------------
+
+@pytest.mark.chaos
+@pytest.mark.parametrize("kill_at", ["prepsubband-method",
+                                     "fused-chunk",
+                                     "post-sift"])
+def test_kill_resume_equivalence(tiny_obs, provider, reference_run,
+                                 tmp_path, kill_at):
+    """Kill at three different pipeline depths; resumed artifacts are
+    byte-identical to the uninterrupted reference run."""
+    _, ref_arts = reference_run
+    work = str(tmp_path)
+    fi = chaos.FaultInjector(kill_at=kill_at, kill_after=1)
+    with pytest.raises(chaos.SimulatedCrash):
+        run_survey([tiny_obs], _cfg(provider, fault_injector=fi),
+                   workdir=work)
+    assert fi.fired is not None and kill_at in fi.fired
+    res = run_survey([tiny_obs], _cfg(provider), workdir=work)
+    assert res.candfile and os.path.exists(res.candfile)
+    _assert_equal_artifacts(_artifacts(work), ref_arts)
+
+
+@pytest.mark.chaos
+def test_resume_redoes_corrupted_artifacts(tiny_obs, provider,
+                                           reference_run, tmp_path):
+    """Post-hoc corruption (truncated .dat, bitflipped .fft, deleted
+    ACCEL) is caught by the manifest verify pass and redone; final
+    artifacts still match the reference byte-for-byte."""
+    _, ref_arts = reference_run
+    work = str(tmp_path)
+    run_survey([tiny_obs], _cfg(provider), workdir=work)
+    dats = sorted(glob.glob(os.path.join(work, "*.dat")))
+    ffts = sorted(glob.glob(os.path.join(work, "*.fft")))
+    accels = sorted(glob.glob(os.path.join(work, "*_ACCEL_0")))
+    chaos.truncate_file(dats[0], keep_frac=0.5)
+    chaos.bitflip_file(ffts[-1], nflips=3, seed=9)
+    os.remove(accels[1])
+    res = run_survey([tiny_obs], _cfg(provider), workdir=work)
+    assert res.candfile and os.path.exists(res.candfile)
+    _assert_equal_artifacts(_artifacts(work), ref_arts)
+
+
+@pytest.mark.chaos
+def test_interrupted_run_leaves_no_partial_artifacts(tiny_obs,
+                                                     provider,
+                                                     tmp_path):
+    """Right after a kill, every artifact on disk verifies against the
+    journal or is absent from it — nothing partial under a final
+    name, no temp residue."""
+    from presto_tpu.io.atomic import TMP_PREFIX
+    from presto_tpu.pipeline.manifest import SurveyManifest
+    work = str(tmp_path)
+    fi = chaos.FaultInjector(kill_at="fused-chunk", kill_after=1)
+    with pytest.raises(chaos.SimulatedCrash):
+        run_survey([tiny_obs], _cfg(provider, fault_injector=fi),
+                   workdir=work)
+    assert not [n for n in os.listdir(work)
+                if n.startswith(TMP_PREFIX)]
+    m = SurveyManifest.load(work)
+    for rel in m.entries:
+        assert m.verify(os.path.join(work, rel)) == "ok", rel
+
+
+@pytest.mark.chaos
+@pytest.mark.slow
+def test_kill_resume_matrix_extended(tiny_obs, provider,
+                                     reference_run, tmp_path):
+    """Wider kill matrix, including repeated kills in ONE workdir
+    (crash -> resume -> crash again at a later point -> resume)."""
+    _, ref_arts = reference_run
+    points = ["pre-rfifind", "post-rfifind", "prepsubband-method",
+              "post-prepsubband", "fused-chunk", "pre-sift",
+              "post-sift", "pre-singlepulse"]
+    work = str(tmp_path / "cascade")
+    os.makedirs(work)
+    for k, kill_at in enumerate(points):
+        fi = chaos.FaultInjector(kill_at=kill_at, kill_after=1)
+        try:
+            run_survey([tiny_obs],
+                       _cfg(provider, fault_injector=fi),
+                       workdir=work)
+        except chaos.SimulatedCrash:
+            pass
+    res = run_survey([tiny_obs], _cfg(provider), workdir=work)
+    assert res.candfile and os.path.exists(res.candfile)
+    _assert_equal_artifacts(_artifacts(work), ref_arts)
+
+
+# ----------------------------------------------------------------------
+# corruption containment (acceptance criterion 2)
+# ----------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def corrupt_obs(tmp_path_factory):
+    """32-bit observation with injected NaN/Inf samples and a long
+    zero-filled dropout."""
+    d = tmp_path_factory.mktemp("corrupt")
+    raw = str(d / "bad.fil")
+    rng = np.random.default_rng(13)
+    data = rng.normal(20.0, 3.0, size=(N, NCHAN)).astype(np.float32)
+    data[1000:1100, :] = np.nan            # poisoned stretch
+    data[1500, 3] = np.inf
+    data[2000:2200, :] = 0.0               # backend dropout
+    from presto_tpu.io.sigproc import FilterbankHeader, \
+        write_filterbank
+    hdr = FilterbankHeader(
+        source_name="CORRUPT", machine_id=10, telescope_id=6,
+        fch1=400.0 + (NCHAN - 1) * 1.0, foff=-1.0, nchans=NCHAN,
+        nbits=32, tstart=59000.0, tsamp=DT, nifs=1)
+    write_filterbank(raw, hdr, data)
+    return raw
+
+
+@pytest.mark.chaos
+def test_corrupt_input_contained_not_crashed(corrupt_obs, provider,
+                                             tmp_path):
+    """NaN/Inf + zero-fill input: run_survey completes, the damage is
+    in the DataQualityReport and the mask, candidates are produced."""
+    work = str(tmp_path)
+    res = run_survey([corrupt_obs], _cfg(provider), workdir=work)
+    # 1. quality report exists and records both corruption classes
+    assert res.quality is not None and not res.quality.clean
+    reasons = {iv.reason for iv in res.quality.intervals}
+    assert "nan-inf" in reasons and "zero-fill" in reasons
+    assert res.quality.scrubbed_samples >= 100 * NCHAN
+    qjson = glob.glob(os.path.join(work, "*_rfifind_quality.json"))
+    assert len(qjson) == 1
+    # 2. the quarantined stretches are zapped in the mask
+    from presto_tpu.io.maskfile import read_mask
+    m = read_mask(res.maskfile)
+    ptsperint = m.ptsperint
+    want = {1000 // ptsperint, 2000 // ptsperint}
+    assert want <= set(m.zap_ints.tolist())
+    # 3. downstream artifacts all exist: the search ran to completion
+    assert res.datfiles and os.path.exists(res.candfile)
+    assert glob.glob(os.path.join(work, "*_ACCEL_0"))
+    # 4. nothing non-finite leaked into the dedispersed series
+    from presto_tpu.io.datfft import read_dat
+    for f in res.datfiles:
+        assert np.all(np.isfinite(read_dat(f)))
+
+
+@pytest.mark.chaos
+def test_corrupt_input_with_kill_and_resume(corrupt_obs, provider,
+                                            tmp_path):
+    """Corruption containment and crash-resume compose: corrupt input
+    + a mid-search kill still converges to a complete survey."""
+    work = str(tmp_path)
+    fi = chaos.FaultInjector(kill_at="fused-chunk", kill_after=1)
+    with pytest.raises(chaos.SimulatedCrash):
+        run_survey([corrupt_obs],
+                   _cfg(provider, fault_injector=fi), workdir=work)
+    res = run_survey([corrupt_obs], _cfg(provider), workdir=work)
+    assert res.quality is not None and not res.quality.clean
+    assert os.path.exists(res.candfile)

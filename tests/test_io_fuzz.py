@@ -1,0 +1,286 @@
+"""Truncation/bitflip/corruption fuzz for the ingest readers (ISSUE 2
+satellite; extends the test_psrfits_pathology.py pattern to
+io/sigproc.py, io/psrfits.py, and io/datfft.py).
+
+Contract under fuzz: a corrupt input either (a) reads successfully
+with the damage quarantined into the reader's DataQualityReport, or
+(b) raises a *typed* error — PrestoIOError or ValueError — never a
+bare struct.error / EOFError / ZeroDivisionError / numpy reshape
+explosion from deep inside a parser.
+"""
+
+import os
+import shutil
+import struct
+
+import numpy as np
+import pytest
+
+from presto_tpu.io.datfft import (read_dat, read_dat_with_inf,
+                                  read_fft, write_dat, write_fft)
+from presto_tpu.io.errors import PrestoIOError
+from presto_tpu.io.psrfits import PsrfitsFile, write_psrfits
+from presto_tpu.io.sigproc import FilterbankFile, FilterbankHeader, \
+    write_filterbank
+from presto_tpu.testing import chaos
+
+ACCEPTABLE = (PrestoIOError, ValueError)
+
+NCHAN = 8
+FREQS = 1400.0 + 1.5 * np.arange(NCHAN)
+
+
+def _fil(path, nspec=512, nbits=8, data=None):
+    if data is None:
+        rng = np.random.default_rng(7)
+        data = rng.integers(5, 20, size=(nspec, NCHAN))
+    hdr = FilterbankHeader(
+        source_name="FUZZ", machine_id=10, telescope_id=6,
+        fch1=1410.5, foff=-1.5, nchans=NCHAN, nbits=nbits,
+        tstart=59000.0, tsamp=1e-3, nifs=1)
+    arr = data.astype(np.float32 if nbits == 32 else np.uint8)
+    write_filterbank(path, hdr, arr)
+    return data
+
+
+def _read_all_fil(path):
+    with FilterbankFile(path) as fb:
+        got = fb.read_spectra(0, max(fb.nspectra, 1))
+        return got, fb.quality
+
+
+# ----------------------------------------------------------------------
+# SIGPROC
+# ----------------------------------------------------------------------
+
+def test_sigproc_header_truncation_is_typed(tmp_path):
+    """Cut the file inside the header at EVERY byte offset: always a
+    clean typed error, never struct.error."""
+    p = str(tmp_path / "h.fil")
+    _fil(p)
+    with open(p, "rb") as f:
+        headerlen = FilterbankFile(p).header.headerlen
+    for cut in range(0, headerlen, 3):
+        q = str(tmp_path / "cut.fil")
+        shutil.copy(p, q)
+        chaos.truncate_file(q, keep_bytes=cut)
+        with pytest.raises(ACCEPTABLE):
+            FilterbankFile(q)
+
+
+def test_sigproc_data_truncation_reads_clean(tmp_path):
+    """A cut anywhere in the data region (including mid-spectrum)
+    shrinks N and reads fine — the partial trailing spectrum is
+    dropped, not decoded as garbage."""
+    p = str(tmp_path / "d.fil")
+    data = _fil(p, nspec=256)
+    full = os.path.getsize(p)
+    with FilterbankFile(p) as fb:
+        headerlen = fb.header.headerlen
+    for cut in (full - 3, full - NCHAN, headerlen + 5 * NCHAN + 3):
+        q = str(tmp_path / "cut.fil")
+        shutil.copy(p, q)
+        chaos.truncate_file(q, keep_bytes=cut)
+        got, quality = _read_all_fil(q)
+        n = (cut - headerlen) // NCHAN
+        np.testing.assert_allclose(got[:n], data[:n], atol=0.5)
+
+
+def test_sigproc_shrink_after_open_quarantined(tmp_path):
+    """The file shrinks AFTER the header was read (writer died,
+    volume detached): the short read zero-fills and is recorded, not
+    an exception."""
+    p = str(tmp_path / "s.fil")
+    data = _fil(p, nspec=256)
+    fb = FilterbankFile(p)
+    chaos.truncate_file(p, keep_bytes=fb.header.headerlen
+                        + 100 * NCHAN)
+    got = fb.read_spectra(0, 256)
+    np.testing.assert_allclose(got[:100], data[:100], atol=0.5)
+    assert np.all(got[100:] == 0.0)
+    assert any(iv.reason == "short-read"
+               for iv in fb.quality.intervals)
+    fb.close()
+
+
+def test_sigproc_nan_inf_scrubbed_to_quality_report(tmp_path):
+    """32-bit data poisoned with NaN/Inf: reads come back finite, the
+    report carries the interval + scrub count."""
+    rng = np.random.default_rng(3)
+    data = rng.normal(10.0, 2.0, size=(512, NCHAN)).astype(np.float32)
+    data[200:210, :] = np.nan
+    data[300, 4] = np.inf
+    p = str(tmp_path / "nan.fil")
+    _fil(p, nbits=32, data=data)
+    got, quality = _read_all_fil(p)
+    assert np.all(np.isfinite(got))
+    assert quality.scrubbed_samples == 10 * NCHAN + 1
+    bad = {r for iv in quality.intervals for r in [iv.reason]}
+    assert "nan-inf" in bad
+    # the poisoned stretch maps onto mask intervals
+    assert 200 // 128 in quality.zap_intervals(128)
+
+
+def test_sigproc_zero_fill_recorded(tmp_path):
+    """A long all-zero stretch (backend dropout) is recorded as
+    zero-fill; data is returned unchanged (masking is downstream)."""
+    rng = np.random.default_rng(5)
+    data = rng.integers(5, 20, size=(512, NCHAN))
+    data[128:128 + 96] = 0                 # 96 >= ZERO_RUN_MIN
+    p = str(tmp_path / "z.fil")
+    _fil(p, data=data)
+    got, quality = _read_all_fil(p)
+    ivs = [iv for iv in quality.intervals if iv.reason == "zero-fill"]
+    assert len(ivs) == 1 and (ivs[0].start, ivs[0].stop) == (128, 224)
+    assert quality.zap_intervals(64) == [2, 3]
+
+
+@pytest.mark.chaos
+@pytest.mark.parametrize("seed", range(12))
+def test_sigproc_bitflip_fuzz(tmp_path, seed):
+    """Random bitflips anywhere in the file: read OK or typed error."""
+    p = str(tmp_path / "bf.fil")
+    _fil(p)
+    chaos.bitflip_file(p, nflips=4, seed=seed)
+    try:
+        _read_all_fil(p)
+    except ACCEPTABLE:
+        pass
+
+
+# ----------------------------------------------------------------------
+# PSRFITS
+# ----------------------------------------------------------------------
+
+def _fits(path, nspec=1024):
+    rng = np.random.default_rng(11)
+    data = rng.integers(1, 30, size=(nspec, 16)).astype(np.float32)
+    write_psrfits(path, data, dt=1e-3,
+                  freqs=1400.0 + 1.5 * np.arange(16), nsblk=256)
+    return data
+
+
+@pytest.mark.parametrize("frac", [0.01, 0.1, 0.3, 0.5, 0.7, 0.9,
+                                  0.98])
+def test_psrfits_truncation_fuzz(tmp_path, frac):
+    """Truncation at any depth: open+read either works (rows past the
+    cut quarantined/padded) or raises a typed error."""
+    p = str(tmp_path / "t.fits")
+    _fits(p)
+    chaos.truncate_file(p, keep_frac=frac)
+    try:
+        with PsrfitsFile(p) as pf:
+            pf.read_spectra(0, min(int(pf.nspectra) or 1, 1024))
+    except ACCEPTABLE:
+        pass
+
+
+@pytest.mark.chaos
+@pytest.mark.parametrize("seed", range(12))
+def test_psrfits_bitflip_fuzz(tmp_path, seed):
+    p = str(tmp_path / "bf.fits")
+    _fits(p)
+    chaos.bitflip_file(p, nflips=4, seed=seed)
+    try:
+        with PsrfitsFile(p) as pf:
+            got = pf.read_spectra(0, 1024)
+            # whatever survived decoding has been scrubbed finite
+            assert np.all(np.isfinite(got))
+    except ACCEPTABLE:
+        pass
+
+
+def test_psrfits_dropped_rows_in_quality_report(tmp_path):
+    """Dropped subints land in the quarantine ledger at open time."""
+    p = str(tmp_path / "drop.fits")
+    rng = np.random.default_rng(2)
+    data = rng.integers(1, 30, size=(2048, 16)).astype(np.float32)
+    write_psrfits(p, data, dt=1e-3,
+                  freqs=1400.0 + 1.5 * np.arange(16), nsblk=256,
+                  drop_rows=[3, 4])
+    with PsrfitsFile(p) as pf:
+        ivs = [iv for iv in pf.quality.intervals
+               if iv.reason == "dropped-rows"]
+        assert len(ivs) == 1
+        assert (ivs[0].start, ivs[0].stop) == (3 * 256, 5 * 256)
+        # mask integration: those spectra map to rfifind intervals
+        assert pf.quality.zap_intervals(256) == [3, 4]
+
+
+# ----------------------------------------------------------------------
+# .dat / .fft
+# ----------------------------------------------------------------------
+
+def test_dat_truncation_and_inf_mismatch(tmp_path):
+    from presto_tpu.models.synth import artificial_inf
+    base = str(tmp_path / "t")
+    data = np.arange(1024, dtype=np.float32)
+    write_dat(base + ".dat", data, artificial_inf(base, 1024, 1e-3))
+    # mid-sample cut -> unaligned -> typed error
+    chaos.truncate_file(base + ".dat", keep_bytes=4 * 100 + 2)
+    with pytest.raises(PrestoIOError) as ei:
+        read_dat(base + ".dat")
+    assert ei.value.path.endswith("t.dat")
+    # aligned cut -> silent short read caught by the .inf cross-check
+    chaos.truncate_file(base + ".dat", keep_bytes=4 * 100)
+    assert len(read_dat(base + ".dat")) == 100
+    with pytest.raises(PrestoIOError) as ei:
+        read_dat_with_inf(base + ".dat")
+    assert ei.value.kind == "size-mismatch"
+
+
+def test_fft_truncation_typed(tmp_path):
+    base = str(tmp_path / "f")
+    amps = (np.arange(512, dtype=np.float32)
+            + 1j * np.ones(512, np.float32)).astype(np.complex64)
+    write_fft(base + ".fft", amps)
+    chaos.truncate_file(base + ".fft", keep_bytes=8 * 64 + 5)
+    with pytest.raises(PrestoIOError):
+        read_fft(base + ".fft")
+    chaos.truncate_file(base + ".fft", keep_bytes=8 * 64)
+    assert len(read_fft(base + ".fft")) == 64
+    with pytest.raises(PrestoIOError):
+        read_fft(base + ".fft", expected_n=512)
+
+
+# ----------------------------------------------------------------------
+# readfile CLI: one-line diagnosis, nonzero exit
+# ----------------------------------------------------------------------
+
+def test_readfile_truncated_fil_one_line(tmp_path, capsys):
+    from presto_tpu.apps.readfile import main
+    p = str(tmp_path / "t.fil")
+    _fil(p)
+    chaos.truncate_file(p, keep_bytes=30)     # inside the header
+    rc = main([p])
+    err = capsys.readouterr().err
+    assert rc != 0
+    assert err.startswith("readfile:") and "t.fil" in err
+    assert "Traceback" not in err
+
+
+def test_readfile_truncated_fits_one_line(tmp_path, capsys):
+    from presto_tpu.apps.readfile import main
+    p = str(tmp_path / "t.fits")
+    _fits(p, nspec=512)
+    chaos.truncate_file(p, keep_bytes=100)    # inside primary header
+    rc = main([p])
+    err = capsys.readouterr().err
+    assert rc != 0 and "readfile:" in err and "Traceback" not in err
+
+
+def test_readfile_misaligned_dat_one_line(tmp_path, capsys):
+    from presto_tpu.apps.readfile import main
+    p = str(tmp_path / "x.dat")
+    np.arange(64, dtype=np.float32).tofile(p)
+    chaos.truncate_file(p, keep_bytes=4 * 10 + 1)
+    rc = main([p])
+    err = capsys.readouterr().err
+    assert rc != 0 and "readfile:" in err
+
+
+def test_readfile_intact_files_still_exit_zero(tmp_path):
+    from presto_tpu.apps.readfile import main
+    p = str(tmp_path / "ok.fil")
+    _fil(p)
+    assert main([p]) == 0

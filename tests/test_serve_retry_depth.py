@@ -1,0 +1,118 @@
+"""Retry re-admission bound (ISSUE 2 satellite): a poisoned job must
+terminate with a final `fail` event instead of cycling the queue
+forever, while transient faults still recover through the existing
+retry/backoff path."""
+
+import time
+
+import pytest
+
+from presto_tpu.serve.queue import (Job, JobQueue, JobStatus,
+                                    RetryBudgetExceeded)
+from presto_tpu.serve.scheduler import Scheduler, SchedulerConfig
+from presto_tpu.testing.chaos import TransientFaults
+
+
+class _Events:
+    def __init__(self):
+        self.events = []
+
+    def emit(self, kind, **kw):
+        self.events.append((kind, kw))
+
+    def of(self, kind):
+        return [kw for k, kw in self.events if k == kind]
+
+
+def _job(jid="j1"):
+    return Job(job_id=jid, rawfiles=[], cfg=None, workdir=".",
+               bucket="b")
+
+
+def _wait(pred, timeout=10.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if pred():
+            return True
+        time.sleep(0.01)
+    return False
+
+
+def test_requeue_counts_against_depth():
+    q = JobQueue(maxdepth=4, max_retry_depth=2)
+    job = _job()
+    q.submit(job)
+    q.pop_batch(1)
+    q.requeue(job)
+    q.pop_batch(1)
+    q.requeue(job)
+    q.pop_batch(1)
+    assert job.requeues == 2
+    with pytest.raises(RetryBudgetExceeded):
+        q.requeue(job)
+    assert job.view()["requeues"] == 2
+
+
+def test_requeue_unbounded_when_disabled():
+    q = JobQueue(maxdepth=2, max_retry_depth=None)
+    job = _job()
+    q.submit(job)
+    for _ in range(50):                    # far past any default bound
+        q.pop_batch(1)
+        q.requeue(job)
+    assert job.requeues == 50
+
+
+def test_poisoned_job_terminates_with_final_fail_event():
+    """Executor that never succeeds + retry budget smaller than the
+    scheduler's retry appetite: the job must end FAILED with the last
+    execution error preserved and a terminal fail event emitted."""
+    q = JobQueue(maxdepth=4, max_retry_depth=2)
+    ev = _Events()
+    poison = TransientFaults(fail_attempts=10 ** 9)
+
+    sched = Scheduler(
+        q, executor=lambda job: {"ok": True},
+        cfg=SchedulerConfig(max_retries=50, backoff_base_s=0.01,
+                            backoff_max_s=0.01, poll_s=0.02,
+                            fault_injector=poison),
+        events=ev)
+    job = _job("poisoned")
+    q.submit(job)
+    sched.start()
+    try:
+        assert _wait(lambda: job.status == JobStatus.FAILED)
+    finally:
+        sched.stop()
+    # initial admission + 2 re-admissions = 3 attempts
+    assert job.attempts == 3
+    assert "injected transient device error" in job.error
+    assert "max_retry_depth" in job.error
+    fails = ev.of("fail")
+    assert len(fails) == 1
+    assert fails[0]["retry_depth_exceeded"] is True
+    assert fails[0]["error"] == job.error
+    assert sched.stats()["jobs_failed"] == 1
+
+
+def test_transient_fault_still_recovers_within_budget():
+    """One injected failure, ample budget: retry/backoff completes the
+    job and the depth bound stays out of the way."""
+    q = JobQueue(maxdepth=4, max_retry_depth=8)
+    ev = _Events()
+    flaky = TransientFaults(fail_attempts=1)
+    sched = Scheduler(
+        q, executor=lambda job: {"ok": True},
+        cfg=SchedulerConfig(max_retries=3, backoff_base_s=0.01,
+                            backoff_max_s=0.01, poll_s=0.02,
+                            fault_injector=flaky),
+        events=ev)
+    job = _job("flaky")
+    q.submit(job)
+    sched.start()
+    try:
+        assert _wait(lambda: job.status == JobStatus.DONE)
+    finally:
+        sched.stop()
+    assert job.attempts == 2 and job.requeues == 1
+    assert not ev.of("fail")
