@@ -1,0 +1,215 @@
+"""presto-report: render a human-readable run report from a workdir.
+
+One survey (or serve-job) working directory accumulates several
+telemetry artifacts — the artifact journal (`manifest.json`), span
+exports (`spans.jsonl` / `trace.perfetto.json`), flight-recorder
+post-mortems (`flightrec-*.json`), and ingest quality ledgers
+(`*_quality.json`).  This tool folds them into one report:
+
+  presto-report <workdir>              full report
+  presto-report <workdir> -json        machine-readable JSON
+  presto-report <workdir> -spans 30    show the 30 slowest spans
+
+Sections render only when their source file exists, so the tool is
+useful on anything from a bare batch run (manifest only) to a chaos
+post-mortem (flight recorder + open spans at death).
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+import time
+from collections import OrderedDict
+from typing import List, Optional
+
+
+def _load_json(path: str) -> Optional[dict]:
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+def _load_jsonl(path: str) -> List[dict]:
+    out: List[dict] = []
+    try:
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if line:
+                    try:
+                        out.append(json.loads(line))
+                    except ValueError:
+                        continue
+    except OSError:
+        pass
+    return out
+
+
+def _fmt_bytes(n: float) -> str:
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if abs(n) < 1024.0 or unit == "GiB":
+            return "%.1f %s" % (n, unit)
+        n /= 1024.0
+    return "%d B" % n
+
+
+# ----------------------------------------------------------------------
+# collectors
+# ----------------------------------------------------------------------
+
+def collect(workdir: str) -> dict:
+    """Everything the report needs, as one JSON-safe dict."""
+    from presto_tpu.obs.flightrec import find_dumps
+    info: dict = {"workdir": os.path.abspath(workdir)}
+
+    manifest = _load_json(os.path.join(workdir, "manifest.json"))
+    if manifest:
+        stages: "OrderedDict[str, dict]" = OrderedDict()
+        for rel, ent in sorted(manifest.get("artifacts", {}).items()):
+            st = stages.setdefault(str(ent.get("stage", "")) or "?",
+                                   {"artifacts": 0, "bytes": 0})
+            st["artifacts"] += 1
+            st["bytes"] += int(ent.get("size", 0))
+        info["manifest"] = {
+            "artifacts": len(manifest.get("artifacts", {})),
+            "stages": stages,
+        }
+
+    spans = _load_jsonl(os.path.join(workdir, "spans.jsonl"))
+    if spans:
+        info["spans"] = spans
+    if os.path.exists(os.path.join(workdir, "trace.perfetto.json")):
+        info["perfetto"] = os.path.join(workdir, "trace.perfetto.json")
+
+    dumps = find_dumps(workdir)
+    if dumps:
+        info["flightrec"] = []
+        for p in dumps:
+            d = _load_json(p) or {}
+            recs = d.get("records", [])
+            last_point = ""
+            for rec in reversed(recs):
+                if rec.get("kind") == "chaos-point":
+                    last_point = rec.get("point", "")
+                    break
+            info["flightrec"].append({
+                "path": p,
+                "reason": d.get("reason", "?"),
+                "ts": d.get("ts", 0.0),
+                "records": len(recs),
+                "open_spans": [s.get("name", "?")
+                               for s in d.get("open_spans", [])],
+                "last_kill_point": last_point,
+            })
+
+    quality = sorted(glob.glob(os.path.join(workdir,
+                                            "*_quality.json")))
+    if quality:
+        info["quality"] = []
+        for p in quality:
+            q = _load_json(p) or {}
+            info["quality"].append({
+                "path": p,
+                "bad_spectra": q.get("bad_spectra", 0),
+                "nspectra": q.get("nspectra", 0),
+                "scrubbed_samples": q.get("scrubbed_samples", 0),
+                "counts": q.get("counts", {}),
+            })
+    return info
+
+
+# ----------------------------------------------------------------------
+# rendering
+# ----------------------------------------------------------------------
+
+def render(info: dict, max_spans: int = 15, file=None) -> None:
+    out = file or sys.stdout
+    w = lambda s="": print(s, file=out)     # noqa: E731
+    w("presto-report: %s" % info["workdir"])
+
+    man = info.get("manifest")
+    if man:
+        w()
+        w("Journal (manifest.json): %d verified artifacts"
+          % man["artifacts"])
+        for stage, st in man["stages"].items():
+            w("  %-16s %4d artifacts  %10s"
+              % (stage, st["artifacts"], _fmt_bytes(st["bytes"])))
+    else:
+        w("  (no manifest.json — unjournaled or pre-obs run)")
+
+    spans = info.get("spans") or []
+    if spans:
+        w()
+        total = sum(s.get("duration_s", 0.0) for s in spans)
+        w("Spans (spans.jsonl): %d spans, %.2f s total"
+          % (len(spans), total))
+        slowest = sorted(spans, key=lambda s: -s.get("duration_s", 0))
+        for s in slowest[:max_spans]:
+            w("  %-32s %9.3f s  [%s]  %s"
+              % (s.get("name", "?"), s.get("duration_s", 0.0),
+                 s.get("status", "?"), s.get("thread", "")))
+        if len(slowest) > max_spans:
+            w("  ... %d more (see spans.jsonl)"
+              % (len(slowest) - max_spans))
+    if info.get("perfetto"):
+        w("  Perfetto trace: %s (open at https://ui.perfetto.dev)"
+          % info["perfetto"])
+
+    for fr in info.get("flightrec", []):
+        w()
+        w("Flight recorder: %s" % fr["path"])
+        w("  reason: %s   records: %d   at %s"
+          % (fr["reason"], fr["records"],
+             time.strftime("%Y-%m-%d %H:%M:%S",
+                           time.localtime(fr["ts"]))))
+        if fr["last_kill_point"]:
+            w("  last kill point: %s" % fr["last_kill_point"])
+        if fr["open_spans"]:
+            w("  open spans at death: %s"
+              % " > ".join(fr["open_spans"]))
+
+    for q in info.get("quality", []):
+        w()
+        w("Data quality: %s" % q["path"])
+        w("  %d/%d spectra quarantined, %d samples scrubbed"
+          % (q["bad_spectra"], q["nspectra"], q["scrubbed_samples"]))
+        for reason, n in sorted(q.get("counts", {}).items()):
+            w("    %-12s %d" % (reason, n))
+
+
+def build_parser():
+    p = argparse.ArgumentParser(
+        prog="presto-report",
+        description="Render a run report from a survey/serve workdir "
+                    "(manifest + spans + flight recorder + quality).")
+    p.add_argument("workdir", help="Survey or serve-job directory")
+    p.add_argument("-json", action="store_true",
+                   help="Emit the collected report as JSON")
+    p.add_argument("-spans", type=int, default=15,
+                   help="Slowest spans to list (default 15)")
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    if not os.path.isdir(args.workdir):
+        print("presto-report: no such directory: %s" % args.workdir,
+              file=sys.stderr)
+        return 1
+    info = collect(args.workdir)
+    if args.json:
+        print(json.dumps(info, indent=1, sort_keys=True))
+    else:
+        render(info, max_spans=args.spans)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -181,6 +181,10 @@ def run(args):
         if not quality.clean:
             print("rfifind: %s" % quality.summary())
         quality.write(outbase + "_rfifind_quality.json")
+        from presto_tpu.obs import get_obs
+        obs = get_obs()
+        if obs.enabled:            # standalone-CLI ingest telemetry
+            quality.publish(obs.metrics)
     write_rfifind_products(res, outbase)
     info = fil_to_inf(fb, outbase + "_rfifind", hdr.N)
     write_inf(info, outbase + "_rfifind.inf")

@@ -46,12 +46,17 @@ def build_parser():
                    help="Compiled-plan cache capacity (LRU)")
     p.add_argument("-events", type=str, default=None,
                    help="Append structured JSON events to this file")
+    p.add_argument("-tracedir", type=str, default=None,
+                   help="Export spans here (spans.jsonl + Perfetto "
+                        "trace.perfetto.json); metrics/flight "
+                        "recorder are always on for the service")
     return p
 
 
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
     ensure_backend()
+    from presto_tpu.obs import ObsConfig
     from presto_tpu.serve.scheduler import SchedulerConfig
     from presto_tpu.serve.server import SearchService, start_http
     scfg = SchedulerConfig(
@@ -62,7 +67,11 @@ def main(argv=None) -> int:
     service = SearchService(args.workdir, queue_depth=args.depth,
                             plan_capacity=args.plans,
                             scheduler_cfg=scfg,
-                            events_path=args.events)
+                            events_path=args.events,
+                            obs_config=ObsConfig(
+                                enabled=True,
+                                trace_dir=args.tracedir,
+                                service="presto-serve"))
     service.start()
     httpd = start_http(service, args.host, args.port)
     host, port = httpd.server_address[:2]

@@ -1,0 +1,110 @@
+"""JAX compile/device telemetry (obs layer).
+
+The expensive, invisible half of a TPU pipeline is everything XLA does
+between our Python lines: compiles, host<->device transfers, buffer
+donation, HBM occupancy.  This module gives those events names on the
+shared metrics registry so the plan cache (serve/plancache.py) and the
+survey driver (pipeline/survey.py) report them per plan bucket:
+
+  jax_compiles_total{kind}        executables built (plan-cache misses)
+  jax_compile_seconds{kind}       build wall time histogram
+  jax_device_put_bytes_total      host -> device upload volume
+  jax_device_get_bytes_total      device -> host download volume
+  jax_donated_bytes_total         buffers handed to XLA via donation
+  jax_live_buffer_bytes           current live device allocation
+  jax_live_buffer_hwm_bytes       high-water mark of the above
+
+Every helper takes the Observability handle and is one branch when
+observability is disabled; all jax imports are local and guarded so
+the module works (as a no-op) on hosts without a usable backend.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+
+def current_device_id() -> Optional[str]:
+    """Stable identity of the default device ('TPU_0(process=0,...)',
+    'TFRT_CPU_0', ...) or None when no backend is reachable.  The plan
+    cache records this per compiled executable so a device reset can
+    evict exactly the poisoned bindings."""
+    try:
+        import jax
+        d = jax.devices()[0]
+        return "%s_%d" % (d.platform, d.id)
+    except Exception:
+        return None
+
+
+def note_compile(obs, kind: str, seconds: float,
+                 key=None, device: Optional[str] = None) -> None:
+    """One executable built: count it, time it, remember it."""
+    if obs is None or not obs.enabled:
+        return
+    obs.metrics.counter(
+        "jax_compiles_total", "XLA executables built",
+        ("kind",)).labels(kind=kind).inc()
+    obs.metrics.histogram(
+        "jax_compile_seconds", "XLA compile wall time",
+        ("kind",)).labels(kind=kind).observe(seconds)
+    obs.flightrec.add("compile", plan_kind=kind,
+                      seconds=round(float(seconds), 4),
+                      key=repr(key) if key is not None else "",
+                      device=device or "")
+
+
+def note_put(obs, nbytes: int) -> None:
+    """Host -> device upload volume."""
+    if obs is None or not obs.enabled:
+        return
+    obs.metrics.counter(
+        "jax_device_put_bytes_total",
+        "Bytes uploaded host to device").inc(int(nbytes))
+
+
+def note_get(obs, nbytes: int) -> None:
+    """Device -> host download volume."""
+    if obs is None or not obs.enabled:
+        return
+    obs.metrics.counter(
+        "jax_device_get_bytes_total",
+        "Bytes downloaded device to host").inc(int(nbytes))
+
+
+def note_donation(obs, nbytes: int) -> None:
+    """Buffer bytes donated to XLA (freed for reuse in-kernel)."""
+    if obs is None or not obs.enabled:
+        return
+    obs.metrics.counter(
+        "jax_donated_bytes_total",
+        "Buffer bytes donated to XLA").inc(int(nbytes))
+
+
+def sample_live_buffers(obs) -> Optional[int]:
+    """Sample current live device-buffer bytes into the gauge pair
+    (current + high-water mark).  Prefers the backend's memory_stats
+    (TPU/GPU); falls back to summing jax.live_arrays() nbytes (CPU).
+    Returns the sampled byte count, or None when unavailable."""
+    if obs is None or not obs.enabled:
+        return None
+    nbytes: Optional[int] = None
+    try:
+        import jax
+        stats = getattr(jax.devices()[0], "memory_stats", None)
+        if callable(stats):
+            s = stats() or {}
+            if "bytes_in_use" in s:
+                nbytes = int(s["bytes_in_use"])
+        if nbytes is None:
+            nbytes = sum(int(getattr(a, "nbytes", 0))
+                         for a in jax.live_arrays())
+    except Exception:
+        return None
+    obs.metrics.gauge(
+        "jax_live_buffer_bytes",
+        "Live device buffer bytes (last sample)").set(nbytes)
+    obs.metrics.gauge(
+        "jax_live_buffer_hwm_bytes",
+        "Live device buffer bytes high-water mark").set_max(nbytes)
+    return nbytes

@@ -1,0 +1,119 @@
+"""The observability name catalog (the contract tools/obs_lint.py
+enforces).
+
+Every survey stage, chaos kill point, serve event kind, and metric
+name the codebase emits must be listed here, and docs/OBSERVABILITY.md
+documents exactly this catalog.  The linter cross-checks the *source*
+(pipeline/survey.py, serve/*.py) against these sets, so adding a stage
+or a scheduler transition without registering (and documenting) its
+telemetry fails CI instead of silently shipping an unobservable code
+path.
+"""
+
+from __future__ import annotations
+
+#: survey stages — every `timer.mark("<stage>")` in pipeline/survey.py
+#: (each becomes a `survey_stage_seconds{stage=...}` sample and a span)
+SURVEY_STAGES = frozenset({
+    "rfifind",
+    "ddplan",
+    "prepsubband",
+    "realfft",
+    "zapbirds",
+    "accelsearch",
+    "realfft+accelsearch (fused)",
+    "sift",
+    "prepfold",
+    "single_pulse",
+})
+
+#: chaos kill points — every `_chaos(cfg, "<point>")` in
+#: pipeline/survey.py (each is recorded in the flight recorder before
+#: the injector may fire, so a dump's last record names the kill)
+KILL_POINTS = frozenset({
+    "pre-rfifind",
+    "post-rfifind",
+    "pre-prepsubband",
+    "prepsubband-method",
+    "post-prepsubband",
+    "zapbirds-file",
+    "fft-chunk",
+    "fused-chunk",
+    "accel-chunk",
+    "pre-sift",
+    "post-sift",
+    "fold-cand",
+    "pre-singlepulse",
+    "post-survey",
+})
+
+#: serve event kinds — every `events.emit("<kind>", ...)` in
+#: presto_tpu/serve/*.py
+SERVE_EVENTS = frozenset({
+    "enqueue",
+    "schedule",
+    "execute",
+    "retry",
+    "degrade",
+    "complete",
+    "fail",
+    "compile",
+    "evict",
+    "plan-evict",
+    "scheduler-error",
+    "http",
+})
+
+#: job lifecycle states -> the event kind that announces the
+#: transition into that state.  The linter checks each mapped kind is
+#: actually emitted somewhere in the serve layer.
+JOB_STATE_EVENTS = {
+    "queued": "enqueue",
+    "scheduled": "schedule",
+    "running": "execute",
+    "retry-wait": "retry",
+    "done": "complete",
+    "failed": "fail",
+    "timeout": "fail",
+}
+
+#: registered metric names (Prometheus side of the contract); the
+#: linter checks every registry.counter/gauge/histogram call in the
+#: tree registers a name listed here.
+METRICS = frozenset({
+    # serve scheduler / queue
+    "serve_jobs_done_total",
+    "serve_jobs_failed_total",
+    "serve_job_retries_total",
+    "serve_batches_total",
+    "serve_batched_jobs_total",
+    "serve_batch_degrades_total",
+    "serve_device_errors_total",
+    "serve_retry_waiting",
+    "serve_queue_depth",
+    "serve_queue_capacity",
+    "serve_uptime_seconds",
+    "serve_jobs",
+    # plan cache
+    "plancache_hits_total",
+    "plancache_misses_total",
+    "plancache_evictions_total",
+    "plancache_size",
+    # latency / stage timing
+    "latency_seconds",
+    "survey_stage_seconds",
+    # ingest quality
+    "ingest_scrubbed_samples_total",
+    "ingest_quarantined_spectra_total",
+    "ingest_reports_total",
+    # jax compile/device telemetry
+    "jax_compiles_total",
+    "jax_compile_seconds",
+    "jax_device_put_bytes_total",
+    "jax_device_get_bytes_total",
+    "jax_donated_bytes_total",
+    "jax_live_buffer_bytes",
+    "jax_live_buffer_hwm_bytes",
+    # flight recorder
+    "flightrec_dumps_total",
+})

@@ -79,6 +79,11 @@ class SurveyConfig:
     # existence checkpoint contract (no manifest journal).
     fault_injector: Optional[object] = None
     verify_resume: bool = True
+    # observability: an obs.ObsConfig or obs.Observability.  None ->
+    # the process default (enabled only when PRESTO_TPU_OBS=1), so an
+    # unconfigured run pays one branch per telemetry point and writes
+    # no telemetry files — byte-identical to an uninstrumented run.
+    obs: Optional[object] = None
 
     @property
     def all_passes(self):
@@ -105,8 +110,12 @@ def _stage(done_glob: str, workdir: str) -> List[str]:
     return sorted(glob.glob(os.path.join(workdir, done_glob)))
 
 
-def _chaos(cfg: SurveyConfig, point: str) -> None:
-    """Fire the configured fault injector at a named kill point."""
+def _chaos(cfg: SurveyConfig, point: str, obs=None) -> None:
+    """Fire the configured fault injector at a named kill point.  The
+    point is flight-recorded FIRST, so a kill here leaves its own name
+    as the dump's final record — the post-mortem starts at the truth."""
+    if obs is not None and obs.enabled:
+        obs.event("chaos-point", point=point)
     fi = getattr(cfg, "fault_injector", None)
     if fi is not None:
         fi.point(point)
@@ -138,6 +147,8 @@ def _drop_stale(manifest, paths) -> List[str]:
 
 def run_survey(rawfiles: Sequence[str], cfg: SurveyConfig,
                workdir: str = ".", timer=None) -> SurveyResult:
+    from presto_tpu.obs import resolve_obs
+    obs = resolve_obs(getattr(cfg, "obs", None))
     os.makedirs(workdir, exist_ok=True)
     rawfiles = [os.path.abspath(f) for f in rawfiles]
     base = os.path.join(
@@ -154,20 +165,33 @@ def run_survey(rawfiles: Sequence[str], cfg: SurveyConfig,
         manifest = SurveyManifest.load(workdir)
     if timer is None:
         from presto_tpu.utils.timing import StageTimer
-        timer = StageTimer()
+        timer = StageTimer(obs=obs)
+    root = obs.span("survey", workdir=workdir,
+                    raw=os.path.basename(rawfiles[0]))
     try:
-        return _run_survey_stages(rawfiles, cfg, workdir, base, res,
-                                  timer, manifest)
+        result = _run_survey_stages(rawfiles, cfg, workdir, base, res,
+                                    timer, manifest, obs)
+        root.finish()
+        return result
+    except BaseException as e:
+        # post-mortem on ANY death: unhandled exceptions, typed
+        # PrestoIOError, and injected SimulatedCrash (a BaseException)
+        # all leave the last N seconds of telemetry next to the
+        # artifacts they orphaned
+        root.finish("error: %s" % type(e).__name__)
+        obs.dump_flight(workdir, reason=type(e).__name__)
+        raise
     finally:
         timer.mark(None)
         timer.report()
+        obs.flush(default_dir=workdir)
 
 
 def _run_survey_stages(rawfiles, cfg, workdir, base, res, timer,
-                       manifest=None):
+                       manifest=None, obs=None):
 
     timer.mark("rfifind")
-    _chaos(cfg, "pre-rfifind")
+    _chaos(cfg, "pre-rfifind", obs)
     # ---- 1. rfifind ---------------------------------------------------
     mask = base + "_rfifind.mask"
     if not cfg.skip_rfifind:
@@ -189,7 +213,11 @@ def _run_survey_stages(rawfiles, cfg, workdir, base, res, timer,
                 res.quality = DataQualityReport.read(qpath)
             except (OSError, ValueError):
                 pass
-    _chaos(cfg, "post-rfifind")
+        if res.quality is not None and obs is not None:
+            # ingest health onto the shared registry: quarantine
+            # tallies become /metrics counters, not just per-run JSON
+            res.quality.publish(obs.metrics)
+    _chaos(cfg, "post-rfifind", obs)
 
     timer.mark("ddplan")
     # ---- 2. DDplan ----------------------------------------------------
@@ -198,16 +226,17 @@ def _run_survey_stages(rawfiles, cfg, workdir, base, res, timer,
     fb = open_raw(rawfiles)
     hdr = fb.header
     fb.close()
-    obs = Observation(dt=hdr.tsamp, f_ctr=hdr.lofreq
-                      + 0.5 * (hdr.nchans - 1) * abs(hdr.foff),
-                      bw=hdr.nchans * abs(hdr.foff),
-                      numchan=hdr.nchans)
-    plan = plan_dedispersion(obs, cfg.lodm, cfg.hidm, numsub=cfg.nsub)
+    observation = Observation(dt=hdr.tsamp, f_ctr=hdr.lofreq
+                              + 0.5 * (hdr.nchans - 1) * abs(hdr.foff),
+                              bw=hdr.nchans * abs(hdr.foff),
+                              numchan=hdr.nchans)
+    plan = plan_dedispersion(observation, cfg.lodm, cfg.hidm,
+                             numsub=cfg.nsub)
     print("survey: DDplan -> %d methods, %d total DMs"
           % (len(plan.methods), plan.total_numdms))
 
     timer.mark("prepsubband")
-    _chaos(cfg, "pre-prepsubband")
+    _chaos(cfg, "pre-prepsubband", obs)
     # ---- 3. prepsubband per method ------------------------------------
     from presto_tpu.apps.prepsubband import main as prepsubband_main
     dat_glob = os.path.basename(base) + "_DM*.dat"
@@ -231,16 +260,16 @@ def _run_survey_stages(rawfiles, cfg, workdir, base, res, timer,
         done = _stage(dat_glob, workdir)
         _record(manifest, done + [f[:-4] + ".inf" for f in done],
                 "prepsubband")
-        _chaos(cfg, "prepsubband-method")
+        _chaos(cfg, "prepsubband-method", obs)
     res.datfiles = _stage(dat_glob, workdir)
     print("survey: %d dedispersed time series" % len(res.datfiles))
-    _chaos(cfg, "post-prepsubband")
+    _chaos(cfg, "post-prepsubband", obs)
 
     from dataclasses import replace as _replace
     passes = cfg.all_passes
     if cfg.zaplist:
         timer.mark("realfft")
-        _staged_fft_search_head(res, cfg, manifest)
+        _staged_fft_search_head(res, cfg, manifest, obs)
         fftfiles = [f[:-4] + ".fft" for f in res.datfiles]
         timer.mark("zapbirds")
         # ---- 5. zapbirds ---------------------------------------------
@@ -254,14 +283,14 @@ def _run_survey_stages(rawfiles, cfg, workdir, base, res, timer,
                 continue
             zap_main(["-zap", "-zapfile", cfg.zaplist, f])
             _record(manifest, [f], "zapbirds")
-            _chaos(cfg, "zapbirds-file")
+            _chaos(cfg, "zapbirds-file", obs)
         timer.mark("accelsearch")
         # ---- 6. accelsearch: BATCHED over the DM fan-out, once per
         # recipe pass (e.g. PALFA's zmax=0/nh=16 + zmax=50/nh=8) -----
         for (zmax, nh, sg, flo) in passes:
             _batched_accelsearch(
                 fftfiles, _replace(cfg, zmax=zmax, numharm=nh,
-                                   sigma=sg, flo=flo), manifest)
+                                   sigma=sg, flo=flo), manifest, obs)
     else:
         # ---- 4+6 fused fast path: realfft -> accelsearch with the
         # spectra RESIDENT on device (no zapbirds in between).  Saves
@@ -269,19 +298,19 @@ def _run_survey_stages(rawfiles, cfg, workdir, base, res, timer,
         # tunneled link's slowest direction; .fft/ACCEL artifacts are
         # still written, preserving the checkpoint contract.
         timer.mark("realfft+accelsearch (fused)")
-        _fused_fft_search(res, cfg, manifest)
+        _fused_fft_search(res, cfg, manifest, obs)
         for (zmax, nh, sg, flo) in passes:
             # resume case for the first pass; full searches for the
             # recipe's additional passes
             _batched_accelsearch(
                 [f[:-4] + ".fft" for f in res.datfiles],
                 _replace(cfg, zmax=zmax, numharm=nh, sigma=sg,
-                         flo=flo), manifest)
+                         flo=flo), manifest, obs)
 
     timer.mark("sift")
-    _chaos(cfg, "pre-sift")
+    _chaos(cfg, "pre-sift", obs)
     return _finish_survey_stages(rawfiles, cfg, workdir, base, res,
-                                 timer, manifest)
+                                 timer, manifest, obs)
 
 
 def _length_groups(files, item_bytes):
@@ -306,7 +335,7 @@ def _survey_searcher(first_file, nbins, cfg):
     return AccelSearch(acfg, T=T, numbins=nbins), T
 
 
-def _fused_fft_search(res, cfg, manifest=None) -> None:
+def _fused_fft_search(res, cfg, manifest=None, obs=None) -> None:
     """Stage 4+6 fused: batched rfft, search_many on the DEVICE
     spectra, one download for the .fft artifacts.  Only processes
     trials with NO verified .fft yet — existing valid spectra (an
@@ -321,6 +350,7 @@ def _fused_fft_search(res, cfg, manifest=None) -> None:
     import jax.numpy as jnp
     import numpy as np
     from presto_tpu.io import datfft
+    from presto_tpu.obs import jaxtel
     from presto_tpu.ops import fftpack
     from presto_tpu.apps.accelsearch import refine_and_write
 
@@ -331,10 +361,14 @@ def _fused_fft_search(res, cfg, manifest=None) -> None:
         per = max(1, int(2 ** 30 // max(n * 4, 1)))
         for g0 in range(0, len(files), per):
             chunk = files[g0:g0 + per]
+            sp = (obs.span("fused-chunk", files=len(chunk), nbins=n)
+                  if obs is not None else None)
             arr = np.stack([datfft.read_dat(f)[:n] for f in chunk])
+            jaxtel.note_put(obs, arr.nbytes)
             pairs_dev = batched(jnp.asarray(arr))    # stays in HBM
             results = searcher.search_many(pairs_dev)
             pairs_host = np.asarray(pairs_dev)       # one download
+            jaxtel.note_get(obs, pairs_host.nbytes)
             arts = []
             for f, pr, raw in zip(chunk, pairs_host, results):
                 amps = fftpack.np_pairs_to_complex64(pr)
@@ -344,12 +378,15 @@ def _fused_fft_search(res, cfg, manifest=None) -> None:
                 acc = f[:-4] + "_ACCEL_%d" % cfg.zmax
                 arts += [f[:-4] + ".fft", acc, acc + ".cand"]
             _record(manifest, arts, "fft+accel")
-            _chaos(cfg, "fused-chunk")
+            jaxtel.sample_live_buffers(obs)
+            if sp is not None:
+                sp.finish()
+            _chaos(cfg, "fused-chunk", obs)
     print("survey: fused realfft+accelsearch over %d trials "
           "(device-resident spectra)" % len(todo))
 
 
-def _staged_fft_search_head(res, cfg, manifest=None):
+def _staged_fft_search_head(res, cfg, manifest=None, obs=None):
     """Stage 4 alone (the staged path used when zapbirds intervenes).
 
     Resume caveat: an .fft the journal marks "zapbirds" is a ZAPPED
@@ -363,6 +400,7 @@ def _staged_fft_search_head(res, cfg, manifest=None):
         import jax.numpy as jnp
         import numpy as np
         from presto_tpu.io import datfft
+        from presto_tpu.obs import jaxtel
         from presto_tpu.ops import fftpack
         batched = jax.jit(jax.vmap(fftpack.realfft_packed_pairs))
         for n, files in _length_groups(
@@ -371,20 +409,26 @@ def _staged_fft_search_head(res, cfg, manifest=None):
             per = max(1, int(2 ** 30 // max(n * 4, 1)))
             for g0 in range(0, len(files), per):
                 chunk = files[g0:g0 + per]
+                sp = (obs.span("fft-chunk", files=len(chunk), nbins=n)
+                      if obs is not None else None)
                 # no mean subtraction: byte parity with the realfft
                 # app (bin 0 is outside the searched range anyway)
                 arr = np.stack([datfft.read_dat(f)[:n] for f in chunk])
+                jaxtel.note_put(obs, arr.nbytes)
                 pairs = np.asarray(batched(jnp.asarray(arr)))
+                jaxtel.note_get(obs, pairs.nbytes)
                 for f, pr in zip(chunk, pairs):
                     datfft.write_fft(f[:-4] + ".fft",
                                      fftpack.np_pairs_to_complex64(pr))
                 _record(manifest, [f[:-4] + ".fft" for f in chunk],
                         "realfft")
-                _chaos(cfg, "fft-chunk")
+                if sp is not None:
+                    sp.finish()
+                _chaos(cfg, "fft-chunk", obs)
         print("survey: realfft over %d series (batched)" % len(todo))
 
 
-def _batched_accelsearch(fftfiles, cfg, manifest=None):
+def _batched_accelsearch(fftfiles, cfg, manifest=None, obs=None):
     """Stage 6 alone (staged path): grouped search_many over .fft
     files already on disk."""
     accs = [f[:-4] + "_ACCEL_%d" % cfg.zmax for f in fftfiles]
@@ -397,6 +441,7 @@ def _batched_accelsearch(fftfiles, cfg, manifest=None):
     if todo:
         import numpy as np
         from presto_tpu.io import datfft
+        from presto_tpu.obs import jaxtel
         from presto_tpu.ops import fftpack
         from presto_tpu.apps.accelsearch import refine_and_write
         for nbins, files in _length_groups(
@@ -406,9 +451,13 @@ def _batched_accelsearch(fftfiles, cfg, manifest=None):
             per = max(1, int(2 ** 30 // max(nbins * 8, 1)))
             for g0 in range(0, len(files), per):
                 chunk = files[g0:g0 + per]
+                sp = (obs.span("accel-chunk", files=len(chunk),
+                               nbins=nbins, zmax=cfg.zmax)
+                      if obs is not None else None)
                 amps_list = [datfft.read_fft(f) for f in chunk]
                 batch = np.stack([fftpack.np_complex64_to_pairs(a)
                                   for a in amps_list])
+                jaxtel.note_put(obs, batch.nbytes)
                 results = searcher.search_many(batch)
                 arts = []
                 for f, amps, raw in zip(chunk, amps_list, results):
@@ -417,13 +466,16 @@ def _batched_accelsearch(fftfiles, cfg, manifest=None):
                     acc = f[:-4] + "_ACCEL_%d" % cfg.zmax
                     arts += [acc, acc + ".cand"]
                 _record(manifest, arts, "accel")
-                _chaos(cfg, "accel-chunk")
+                jaxtel.sample_live_buffers(obs)
+                if sp is not None:
+                    sp.finish()
+                _chaos(cfg, "accel-chunk", obs)
         print("survey: accelsearch over %d trials (batched)"
               % len(todo))
 
 
 def _finish_survey_stages(rawfiles, cfg, workdir, base, res, timer,
-                          manifest=None):
+                          manifest=None, obs=None):
     # ---- 7. sift ------------------------------------------------------
     from presto_tpu.pipeline.sifting import sift_candidates
     accfiles = []
@@ -440,7 +492,7 @@ def _finish_survey_stages(rawfiles, cfg, workdir, base, res, timer,
     res.sifted = cl
     print("survey: %d sifted candidates -> %s"
           % (len(cl), res.candfile))
-    _chaos(cfg, "post-sift")
+    _chaos(cfg, "post-sift", obs)
 
     timer.mark("prepfold")
     # ---- 8. fold the top candidates -----------------------------------
@@ -488,11 +540,11 @@ def _finish_survey_stages(rawfiles, cfg, workdir, base, res, timer,
             _record(manifest, [outbase + ".pfd"], "prepfold")
         except SystemExit as e:
             print("survey: fold of cand %d failed: %s" % (i + 1, e))
-        _chaos(cfg, "fold-cand")
+        _chaos(cfg, "fold-cand", obs)
     print("survey: folded %d candidates" % len(res.folded))
 
     timer.mark("single_pulse")
-    _chaos(cfg, "pre-singlepulse")
+    _chaos(cfg, "pre-singlepulse", obs)
     # ---- 9. single-pulse search --------------------------------------
     if cfg.singlepulse and res.datfiles:
         from presto_tpu.apps.single_pulse_search import main as sp_main
@@ -514,6 +566,6 @@ def _finish_survey_stages(rawfiles, cfg, workdir, base, res, timer,
             if os.path.exists(spf):
                 res.sp_events += len(read_singlepulse(spf))
         print("survey: %d single-pulse events" % res.sp_events)
-    _chaos(cfg, "post-survey")
+    _chaos(cfg, "post-survey", obs)
 
     return res

@@ -82,12 +82,16 @@ def bucket_key(rawfiles, cfg) -> PlanKey:
 
 @dataclass
 class CompiledPlan:
-    """A cached executable bundle + bookkeeping."""
+    """A cached executable bundle + bookkeeping.  `device` records the
+    executable->device binding at build time (obs/jaxtel
+    current_device_id), so a TPU reset can evict exactly the plans
+    bound to the dead device instead of flushing the whole cache."""
     key: PlanKey
     obj: Any
     build_seconds: float
     built_at: float
     uses: int = 0
+    device: Optional[str] = None
 
     def place(self, batch, mesh=None):
         """Mesh-aware placement of a stacked same-bucket batch: shard
@@ -106,20 +110,33 @@ class CompiledPlan:
 
 class PlanCache:
     """Thread-safe LRU cache of compiled plans with hit/miss/eviction
-    accounting (the /metrics `plans` block)."""
+    accounting on the shared metrics registry (the /metrics `plans`
+    block and the `plancache_*` Prometheus series are the same
+    counters)."""
 
-    def __init__(self, capacity: int = 32, events=None):
+    def __init__(self, capacity: int = 32, events=None, obs=None):
         if capacity < 1:
             raise ValueError("capacity must be >= 1")
+        if obs is None:
+            from presto_tpu.obs import Observability, ObsConfig
+            obs = Observability(ObsConfig(enabled=True))
         self.capacity = capacity
+        self.obs = obs
         self._events = events
         self._lock = threading.Lock()
         self._plans: "OrderedDict[PlanKey, CompiledPlan]" = \
             OrderedDict()
-        self._hits = 0
-        self._misses = 0
-        self._evictions = 0
         self._compile_s = 0.0
+        reg = obs.metrics
+        self._c_hits = reg.counter("plancache_hits_total",
+                                   "Plan-cache hits")
+        self._c_misses = reg.counter("plancache_misses_total",
+                                     "Plan-cache misses (compiles)")
+        self._c_evict = reg.counter(
+            "plancache_evictions_total", "Plan-cache evictions",
+            ("reason",))
+        self._g_size = reg.gauge("plancache_size",
+                                 "Compiled plans resident")
 
     def get(self, key: PlanKey, builder: Callable[[], Any]) -> Any:
         """Return the cached plan for `key`, building (and counting a
@@ -130,13 +147,15 @@ class PlanCache:
             plan = self._plans.get(key)
             if plan is not None:
                 self._plans.move_to_end(key)
-                self._hits += 1
+                self._c_hits.inc()
                 plan.uses += 1
                 return plan.obj
-            self._misses += 1
+            self._c_misses.inc()
+        from presto_tpu.obs import jaxtel
         t0 = time.time()
         obj = builder()
         dt = time.time() - t0
+        device = jaxtel.current_device_id()
         with self._lock:
             existing = self._plans.get(key)
             if existing is not None:        # lost the build race
@@ -145,33 +164,64 @@ class PlanCache:
             self._compile_s += dt
             self._plans[key] = CompiledPlan(
                 key=key, obj=obj, build_seconds=dt, built_at=t0,
-                uses=1)
+                uses=1, device=device)
             self._plans.move_to_end(key)
             while len(self._plans) > self.capacity:
                 old_key, _ = self._plans.popitem(last=False)
-                self._evictions += 1
+                self._c_evict.labels(reason="capacity").inc()
                 if self._events is not None:
                     self._events.emit("evict", plan=repr(old_key))
+            self._g_size.set(len(self._plans))
+        jaxtel.note_compile(self.obs, kind=key.kind, seconds=dt,
+                            key=key, device=device)
         if self._events is not None:
             self._events.emit("compile", plan=repr(key), seconds=dt)
         return obj
+
+    def evict_bucket(self, device: Optional[str] = None,
+                     reason: str = "device_error") -> int:
+        """Flush plans bound to `device` (None = every plan): the
+        scheduler's retry path calls this on a device/executable
+        RuntimeError so a retry re-warms a fresh executable instead of
+        re-entering the poisoned one (ROADMAP: plan-cache invalidation
+        on device error).  Returns the number evicted; each eviction
+        counts under `plancache_evictions_total{reason=...}`."""
+        with self._lock:
+            doomed = [k for k, p in self._plans.items()
+                      if device is None or p.device == device
+                      or p.device is None]
+            for k in doomed:
+                del self._plans[k]
+                self._c_evict.labels(reason=reason).inc()
+            self._g_size.set(len(self._plans))
+        for k in doomed:
+            if self._events is not None:
+                self._events.emit("plan-evict", plan=repr(k),
+                                  reason=reason, device=device or "*")
+        self.obs.event("plan-evict", n=len(doomed), reason=reason,
+                       device=device or "*")
+        return len(doomed)
 
     def contains(self, key: PlanKey) -> bool:
         with self._lock:
             return key in self._plans
 
     def stats(self) -> dict:
+        hits = int(self._c_hits.value)
+        misses = int(self._c_misses.value)
+        total = hits + misses
         with self._lock:
-            total = self._hits + self._misses
-            return {
-                "size": len(self._plans),
-                "capacity": self.capacity,
-                "hits": self._hits,
-                "misses": self._misses,
-                "evictions": self._evictions,
-                "compile_s": round(self._compile_s, 3),
-                "hit_rate": (self._hits / total) if total else 0.0,
-            }
+            size = len(self._plans)
+            compile_s = self._compile_s
+        return {
+            "size": size,
+            "capacity": self.capacity,
+            "hits": hits,
+            "misses": misses,
+            "evictions": int(self._c_evict.total()),
+            "compile_s": round(compile_s, 3),
+            "hit_rate": (hits / total) if total else 0.0,
+        }
 
 
 class SearcherProvider:

@@ -41,6 +41,22 @@ class JobTimeout(RuntimeError):
     """A job exceeded its per-job wall-clock budget."""
 
 
+#: substrings that mark a RuntimeError as a device/executable failure
+#: — the poisoned-plan signature (a reset TPU, a dead executable, an
+#: exhausted HBM arena) where retrying into the same compiled plan
+#: cannot succeed.  The retry path evicts the plan cache's affected
+#: bindings first (ROADMAP: plan-cache invalidation on device error).
+_DEVICE_ERROR_MARKERS = ("device", "executable", "xla", "tpu", "hbm",
+                         "dead", "resource exhausted")
+
+
+def is_device_error(exc: BaseException) -> bool:
+    if not isinstance(exc, RuntimeError) or isinstance(exc, JobTimeout):
+        return False
+    msg = str(exc).lower()
+    return any(m in msg for m in _DEVICE_ERROR_MARKERS)
+
+
 @dataclass
 class SchedulerConfig:
     max_batch: int = 8             # coalescing bound per iteration
@@ -62,26 +78,48 @@ class Scheduler:
 
     def __init__(self, queue: JobQueue, executor: Callable,
                  cfg: Optional[SchedulerConfig] = None, events=None,
-                 latency=None, batch_executor: Optional[Callable] = None):
+                 latency=None, batch_executor: Optional[Callable] = None,
+                 obs=None, plans=None):
+        if obs is None:
+            from presto_tpu.obs import Observability, ObsConfig
+            obs = Observability(ObsConfig(enabled=True))
         self.queue = queue
         self.executor = executor
         self.batch_executor = batch_executor
         self.cfg = cfg or SchedulerConfig()
         self.events = events
         self.latency = latency
+        self.obs = obs
+        self.plans = plans          # PlanCache, for device-error evict
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
         self._retry_heap: list = []
         self._retry_seq = itertools.count()
         self._retry_lock = threading.Lock()
         self._pool: Optional[ThreadPoolExecutor] = None
-        self._stats_lock = threading.Lock()
-        self._done = 0
-        self._failed = 0
-        self._retried = 0
-        self._batches = 0
-        self._batched_jobs = 0
-        self._degrades = 0
+        # lifecycle accounting lives on the metrics registry — the
+        # stats() JSON block and the serve_* Prometheus series read
+        # the same counters (one source of truth)
+        reg = obs.metrics
+        self._c_done = reg.counter("serve_jobs_done_total",
+                                   "Jobs completed successfully")
+        self._c_failed = reg.counter(
+            "serve_jobs_failed_total",
+            "Jobs terminally failed (incl. timeouts)")
+        self._c_retried = reg.counter("serve_job_retries_total",
+                                      "Job retry attempts scheduled")
+        self._c_batches = reg.counter("serve_batches_total",
+                                      "Micro-batches executed")
+        self._c_batched = reg.counter("serve_batched_jobs_total",
+                                      "Jobs executed inside batches")
+        self._c_degrades = reg.counter(
+            "serve_batch_degrades_total",
+            "Batch failures degraded to single-job execution")
+        self._c_deverr = reg.counter(
+            "serve_device_errors_total",
+            "Job failures classified as device/executable errors")
+        self._g_retrywait = reg.gauge(
+            "serve_retry_waiting", "Jobs on the retry backoff shelf")
 
     # ---- lifecycle ----------------------------------------------------
 
@@ -155,6 +193,8 @@ class Scheduler:
             while self._retry_heap and self._retry_heap[0][0] <= now:
                 _, _, job = heapq.heappop(self._retry_heap)
                 due.append(job)
+        with self._retry_lock:
+            self._g_retrywait.set(len(self._retry_heap))
         for job in due:
             try:
                 self.queue.requeue(job)
@@ -169,8 +209,7 @@ class Scheduler:
                 job.status = JobStatus.FAILED
                 job.error = "%s [%s]" % (job.error or "retry", e)
                 job.finished = time.time()
-                with self._stats_lock:
-                    self._failed += 1
+                self._c_failed.inc()
                 if self.events is not None:
                     self.events.emit("fail", job=job.job_id,
                                      attempts=job.attempts,
@@ -180,9 +219,8 @@ class Scheduler:
     # ---- batch execution ----------------------------------------------
 
     def _run_batch(self, batch: List[Job]) -> None:
-        with self._stats_lock:
-            self._batches += 1
-            self._batched_jobs += len(batch)
+        self._c_batches.inc()
+        self._c_batched.inc(len(batch))
         if self.events is not None:
             self.events.emit("schedule", jobs=[j.job_id for j in batch],
                              occupancy=len(batch),
@@ -198,8 +236,7 @@ class Scheduler:
                 # graceful degradation: the batch path failing means
                 # each job gets an individual shot (and its own
                 # retry/backoff budget), not a collective failure.
-                with self._stats_lock:
-                    self._degrades += 1
+                self._c_degrades.inc()
                 if self.events is not None:
                     self.events.emit(
                         "degrade", jobs=[j.job_id for j in batch],
@@ -215,14 +252,19 @@ class Scheduler:
         if self.events is not None:
             self.events.emit("execute", job=job.job_id,
                              attempt=job.attempts)
+        span = self.obs.span("serve-job", job=job.job_id,
+                             attempt=job.attempts,
+                             bucket=repr(job.bucket))
         t0 = time.time()
         try:
             if self.cfg.fault_injector is not None:
                 self.cfg.fault_injector(job, job.attempts)
             result = self._with_timeout(lambda: self.executor(job))
         except Exception as e:
+            span.finish("error: %s" % type(e).__name__)
             self._handle_failure(job, e)
             return
+        span.finish()
         if self.latency is not None:
             self.latency.record("job_exec", time.time() - t0)
         self._finish_ok(job, result)
@@ -232,8 +274,7 @@ class Scheduler:
         job.status = JobStatus.DONE
         job.error = ""
         job.finished = time.time()
-        with self._stats_lock:
-            self._done += 1
+        self._c_done.inc()
         if self.latency is not None and job.submitted:
             self.latency.record("job_total",
                                 job.finished - job.submitted)
@@ -246,17 +287,33 @@ class Scheduler:
     def _handle_failure(self, job: Job, exc: Exception) -> None:
         timed_out = isinstance(exc, JobTimeout)
         job.error = "%s: %s" % (type(exc).__name__, exc)
+        if is_device_error(exc):
+            # poisoned-plan containment: a device/executable
+            # RuntimeError means the cached executables bound to that
+            # device may be dead — flush them BEFORE the retry, so the
+            # retry re-warms fresh plans instead of re-entering the
+            # poisoned one (observable as
+            # plancache_evictions_total{reason="device_error"}).
+            self._c_deverr.inc()
+            if self.plans is not None:
+                from presto_tpu.obs import jaxtel
+                n = self.plans.evict_bucket(
+                    device=jaxtel.current_device_id(),
+                    reason="device_error")
+                if self.events is not None:
+                    self.events.emit("plan-evict", job=job.job_id,
+                                     evicted=n, error=job.error)
         if job.attempts <= self.cfg.max_retries:
             delay = min(
                 self.cfg.backoff_base_s * 2.0 ** (job.attempts - 1),
                 self.cfg.backoff_max_s)
             job.status = JobStatus.RETRY_WAIT
-            with self._stats_lock:
-                self._retried += 1
+            self._c_retried.inc()
             with self._retry_lock:
                 heapq.heappush(
                     self._retry_heap,
                     (time.time() + delay, next(self._retry_seq), job))
+                self._g_retrywait.set(len(self._retry_heap))
             if self.events is not None:
                 self.events.emit("retry", job=job.job_id,
                                  attempt=job.attempts,
@@ -266,8 +323,7 @@ class Scheduler:
         job.status = (JobStatus.TIMEOUT if timed_out
                       else JobStatus.FAILED)
         job.finished = time.time()
-        with self._stats_lock:
-            self._failed += 1
+        self._c_failed.inc()
         if self.events is not None:
             self.events.emit("fail", job=job.job_id,
                              attempts=job.attempts, error=job.error,
@@ -298,17 +354,19 @@ class Scheduler:
     # ---- metrics ------------------------------------------------------
 
     def stats(self) -> dict:
-        with self._stats_lock:
-            with self._retry_lock:
-                waiting = len(self._retry_heap)
-            return {
-                "alive": self.alive,
-                "jobs_done": self._done,
-                "jobs_failed": self._failed,
-                "retries": self._retried,
-                "retry_waiting": waiting,
-                "batches": self._batches,
-                "degrades": self._degrades,
-                "batch_occupancy": (self._batched_jobs / self._batches
-                                    if self._batches else 0.0),
-            }
+        """The /metrics `scheduler` JSON block — read straight off the
+        registry counters the Prometheus exposition also serves."""
+        with self._retry_lock:
+            waiting = len(self._retry_heap)
+        batches = self._c_batches.value
+        return {
+            "alive": self.alive,
+            "jobs_done": int(self._c_done.value),
+            "jobs_failed": int(self._c_failed.value),
+            "retries": int(self._c_retried.value),
+            "retry_waiting": waiting,
+            "batches": int(batches),
+            "degrades": int(self._c_degrades.value),
+            "batch_occupancy": (self._c_batched.value / batches
+                                if batches else 0.0),
+        }

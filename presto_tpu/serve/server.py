@@ -50,10 +50,11 @@ class BadRequest(ValueError):
 
 def _allowed_config_fields():
     """SurveyConfig fields settable over the wire: everything except
-    object-valued hooks (plan_provider/sift_policy/fault_injector are
-    in-process only)."""
+    object-valued hooks (plan_provider/sift_policy/fault_injector/obs
+    are in-process only)."""
     from presto_tpu.pipeline.survey import SurveyConfig
-    blocked = {"plan_provider", "sift_policy", "fault_injector"}
+    blocked = {"plan_provider", "sift_policy", "fault_injector",
+               "obs"}
     return {f.name for f in dataclass_fields(SurveyConfig)
             if f.name not in blocked}
 
@@ -66,20 +67,29 @@ class SearchService:
                  plan_capacity: int = 32,
                  scheduler_cfg: Optional[SchedulerConfig] = None,
                  events_path: Optional[str] = None, mesh=None,
-                 max_retry_depth: Optional[int] = 8):
+                 max_retry_depth: Optional[int] = 8, obs=None,
+                 obs_config=None):
+        from presto_tpu.obs import Observability, ObsConfig
         os.makedirs(workroot, exist_ok=True)
         self.workroot = os.path.abspath(workroot)
+        # a resident service is always observed (a server without
+        # /metrics is blind); pass `obs`/`obs_config` to share or tune
+        # the handle — e.g. a trace_dir for span export
+        self.obs = obs or Observability(
+            obs_config or ObsConfig(enabled=True,
+                                    service="presto-serve"))
         self.events = EventLog(path=events_path)
-        self.latency = LatencyStats()
+        self.latency = LatencyStats(registry=self.obs.metrics)
         self.queue = JobQueue(maxdepth=queue_depth,
                               max_retry_depth=max_retry_depth)
         self.plans = PlanCache(capacity=plan_capacity,
-                               events=self.events)
+                               events=self.events, obs=self.obs)
         self.provider = SearcherProvider(self.plans, mesh=mesh)
         self.scheduler = Scheduler(self.queue, self._execute_job,
                                    cfg=scheduler_cfg,
                                    events=self.events,
-                                   latency=self.latency)
+                                   latency=self.latency,
+                                   obs=self.obs, plans=self.plans)
         self._jobs: Dict[str, Job] = {}
         self._jobs_lock = threading.Lock()
         self._ids = itertools.count(1)
@@ -95,6 +105,8 @@ class SearchService:
         self.queue.close()
         self.scheduler.stop()
         self.events.close()
+        self.obs.flush()
+        self.obs.tracer.close()
 
     # ---- job admission ------------------------------------------------
 
@@ -126,6 +138,7 @@ class SearchService:
                              % sorted(unknown))
         cfg = SurveyConfig(**cfg_dict)
         cfg.plan_provider = self.provider
+        cfg.obs = self.obs          # job telemetry -> service registry
         job_id = str(spec.get("job_id") or "job-%06d" % next(self._ids))
         with self._jobs_lock:
             if job_id in self._jobs:
@@ -152,7 +165,7 @@ class SearchService:
         """Run one job as a restartable survey in its own workdir,
         feeding the shared per-stage latency percentiles."""
         from presto_tpu.pipeline.survey import run_survey
-        timer = StageTimer(stats=self.latency)
+        timer = StageTimer(stats=self.latency, obs=self.obs)
         res = run_survey(job.rawfiles, job.cfg, workdir=job.workdir,
                          timer=timer)
         return {
@@ -210,6 +223,8 @@ class SearchService:
         }
 
     def metrics(self) -> dict:
+        """The pre-obs JSON metrics shape, unchanged for backward
+        compat — every number now reads off the shared registry."""
         with self._jobs_lock:
             by_status: Dict[str, int] = {}
             for job in self._jobs.values():
@@ -224,6 +239,31 @@ class SearchService:
             "latency": self.latency.snapshot(),
             "events": self.events.counts(),
         }
+
+    def metrics_prometheus(self) -> str:
+        """Prometheus text exposition of the shared registry (the
+        `Accept: text/plain` answer of GET /metrics).  Scrape-time
+        gauges (queue depth, uptime, jobs by status) are refreshed
+        here so the pull model sees current values."""
+        reg = self.obs.metrics
+        reg.gauge("serve_uptime_seconds",
+                  "Service uptime").set(time.time() - self._t0)
+        reg.gauge("serve_queue_depth",
+                  "Queued jobs").set(len(self.queue))
+        reg.gauge("serve_queue_capacity",
+                  "Queue depth bound").set(self.queue.maxdepth)
+        jobs_g = reg.gauge("serve_jobs", "Jobs by lifecycle status",
+                           ("status",))
+        with self._jobs_lock:
+            by_status: Dict[str, int] = {}
+            for job in self._jobs.values():
+                by_status[job.status] = by_status.get(job.status, 0) + 1
+        from presto_tpu.serve.queue import JobStatus as _JS
+        for status in (_JS.QUEUED, _JS.SCHEDULED, _JS.RUNNING,
+                       _JS.RETRY_WAIT, _JS.DONE, _JS.FAILED,
+                       _JS.TIMEOUT):
+            jobs_g.labels(status=status).set(by_status.get(status, 0))
+        return reg.render_prometheus()
 
 
 # ----------------------------------------------------------------------
@@ -248,6 +288,29 @@ class _Handler(BaseHTTPRequestHandler):
         self.end_headers()
         self.wfile.write(body)
 
+    def _text(self, code: int, text: str,
+              ctype: str = "text/plain; version=0.0.4") -> None:
+        body = text.encode()
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _wants_prometheus(self, url) -> bool:
+        """Content negotiation for /metrics: Prometheus scrapers send
+        `Accept: text/plain` (or the openmetrics type); humans and the
+        pre-obs JSON consumers get the JSON shape.  `?format=` forces
+        either way."""
+        fmt = parse_qs(url.query).get("format", [""])[0]
+        if fmt in ("prometheus", "text"):
+            return True
+        if fmt == "json":
+            return False
+        accept = self.headers.get("Accept", "") or ""
+        return ("text/plain" in accept
+                or "openmetrics-text" in accept)
+
     def do_GET(self) -> None:
         url = urlparse(self.path)
         parts = [p for p in url.path.split("/") if p]
@@ -256,7 +319,10 @@ class _Handler(BaseHTTPRequestHandler):
                 h = self.service.healthz()
                 self._json(200 if h["ok"] else 503, h)
             elif url.path == "/metrics":
-                self._json(200, self.service.metrics())
+                if self._wants_prometheus(url):
+                    self._text(200, self.service.metrics_prometheus())
+                else:
+                    self._json(200, self.service.metrics())
             elif url.path == "/events":
                 n = int(parse_qs(url.query).get("n", ["100"])[0])
                 self._json(200,

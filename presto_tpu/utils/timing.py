@@ -7,86 +7,109 @@ module provides those behaviors plus the TPU-era additions the rebuild
 plan calls for: named per-stage wall-clock accounting and an optional
 JAX profiler trace (set PRESTO_TPU_PROFILE=<dir> to capture a trace
 viewable in TensorBoard/Perfetto).
+
+Since the obs layer landed, the latency accounting here is a *view*
+over the shared metrics registry (presto_tpu/obs/metrics.py) rather
+than a private sample store: LatencyStats keeps its exact API and
+nearest-rank percentile semantics, but every sample it records lands
+in a registry histogram (`latency_seconds{name=...}`), so the serve
+layer's /metrics JSON and the Prometheus exposition read the same
+numbers — one source of truth.
 """
 
 from __future__ import annotations
 
 import os
 import sys
-import threading
 import time
-from collections import deque
 from contextlib import contextmanager
 from typing import Dict, Optional
+
+#: env override for the \r percent meter: "1" forces it on (even when
+#: stdout is piped), "0" forces it off.  Unset -> isatty() decides.
+METER_ENV = "PRESTO_TPU_METER"
+
+
+def _meter_enabled() -> bool:
+    """Should the in-place \r meter run?  Interactive terminals only —
+    a piped stdout (batch logs, the serve event log) must not be
+    spammed with carriage returns."""
+    env = os.environ.get(METER_ENV)
+    if env is not None:
+        return env not in ("", "0")
+    try:
+        return sys.stdout.isatty()
+    except (AttributeError, ValueError):
+        return False
 
 
 def print_percent_complete(current: int, total: int,
                            last: int = -1) -> int:
     """Throttled percent meter (print_percent_complete,
     accelsearch.c:22-41): prints at most once per whole percent.
-    Returns the new 'last' value; pass it back on the next call."""
+    Returns the new 'last' value; pass it back on the next call.
+
+    On a non-TTY stdout the running \r meter is suppressed (only the
+    final 100% line is printed) so piped logs stay one-line-per-event;
+    set PRESTO_TPU_METER=1/0 to force it on/off."""
     pct = int(100.0 * current / max(total, 1))
     if pct != last:
-        sys.stdout.write("\rAmount complete = %3d%%" % pct)
-        if pct >= 100:
-            sys.stdout.write("\n")
-        sys.stdout.flush()
+        meter = _meter_enabled()
+        if meter and pct < 100:
+            sys.stdout.write("\rAmount complete = %3d%%" % pct)
+            sys.stdout.flush()
+        elif pct >= 100:
+            sys.stdout.write("\rAmount complete = %3d%%\n" % pct
+                             if meter
+                             else "Amount complete = 100%\n")
+            sys.stdout.flush()
     return pct
 
 
 class LatencyStats:
     """Per-name latency samples with percentile accounting — the
-    serving layer's /metrics backbone.  Each name keeps a bounded
-    window of recent samples (deque; old samples age out) plus
-    lifetime count/total, and reports p50/p90/p99 over the window.
-    Thread-safe: the service records from scheduler and HTTP threads.
-    """
+    serving layer's /metrics backbone.  Each name is one child of a
+    shared registry histogram (`latency_seconds{name=...}`): lifetime
+    count/sum plus a bounded window of recent samples for p50/p90/p99
+    (nearest-rank, old samples age out).  Thread-safe: the service
+    records from scheduler and HTTP threads.
 
-    def __init__(self, window: int = 2048):
-        self._lock = threading.Lock()
-        self._window = window
-        self._samples: Dict[str, deque] = {}
-        self._count: Dict[str, int] = {}
-        self._total: Dict[str, float] = {}
+    Pass `registry` (obs MetricsRegistry) to share the serve layer's
+    registry; by default a private always-enabled registry backs the
+    instance, preserving the historical standalone behavior."""
+
+    METRIC = "latency_seconds"
+
+    def __init__(self, window: int = 2048, registry=None):
+        if registry is None:
+            from presto_tpu.obs.metrics import MetricsRegistry
+            registry = MetricsRegistry(enabled=True)
+        self.registry = registry
+        self._hist = registry.histogram(
+            self.METRIC, "Recorded latency samples by name",
+            ("name",), window=window)
 
     def record(self, name: str, seconds: float) -> None:
-        with self._lock:
-            if name not in self._samples:
-                self._samples[name] = deque(maxlen=self._window)
-                self._count[name] = 0
-                self._total[name] = 0.0
-            self._samples[name].append(float(seconds))
-            self._count[name] += 1
-            self._total[name] += float(seconds)
+        self._hist.labels(name=name).observe(float(seconds))
 
     def percentiles(self, name: str,
                     qs=(50, 90, 99)) -> Dict[str, float]:
         """Nearest-rank percentiles over the sample window."""
-        with self._lock:
-            xs = sorted(self._samples.get(name, ()))
-        if not xs:
-            return {"p%d" % q: 0.0 for q in qs}
-        n = len(xs)
-        return {"p%d" % q: xs[min(n - 1, max(0, (n * q + 99) // 100 - 1))]
-                for q in qs}
+        return self._hist.labels(name=name).percentiles(qs)
 
     def snapshot(self) -> Dict[str, dict]:
         """{name: {count, mean_s, p50_s, p90_s, p99_s, max_s}} for
         every recorded stage (the /metrics `latency` block)."""
-        with self._lock:
-            names = list(self._samples)
         out = {}
-        for name in names:
-            with self._lock:
-                xs = list(self._samples[name])
-                count = self._count[name]
-                total = self._total[name]
-            if not xs:
+        for labels, child in self._hist.children():
+            count = child.count
+            xs = child.samples()
+            if not count or not xs:
                 continue
-            pcts = self.percentiles(name)
-            out[name] = {
+            pcts = child.percentiles()
+            out[dict(labels)["name"]] = {
                 "count": count,
-                "mean_s": round(total / count, 6),
+                "mean_s": round(child.sum / count, 6),
                 "p50_s": round(pcts["p50"], 6),
                 "p90_s": round(pcts["p90"], 6),
                 "p99_s": round(pcts["p99"], 6),
@@ -100,18 +123,30 @@ class StageTimer:
     The pipeline-driver analog of the reference's per-tool timing.
     With `stats` (a LatencyStats), every closed stage also records a
     latency sample, so a resident service accumulates per-stage
-    percentiles across jobs."""
+    percentiles across jobs.  With `obs` (an Observability), every
+    stage additionally becomes a span and a
+    `survey_stage_seconds{stage=...}` histogram sample."""
 
-    def __init__(self, stats: Optional[LatencyStats] = None):
+    def __init__(self, stats: Optional[LatencyStats] = None,
+                 obs=None):
         self.stages: Dict[str, float] = {}
         self._t0 = time.time()
         self._cur: Optional[tuple] = None
         self._stats = stats
+        self._obs = obs if (obs is not None
+                            and getattr(obs, "enabled", False)) \
+            else None
+        self._span = None
 
     def _close(self, name: str, dt: float) -> None:
         self.stages[name] = self.stages.get(name, 0.0) + dt
         if self._stats is not None:
             self._stats.record(name, dt)
+        if self._obs is not None:
+            self._obs.metrics.histogram(
+                "survey_stage_seconds",
+                "Survey stage wall time",
+                ("stage",)).labels(stage=name).observe(dt)
 
     def mark(self, name: Optional[str]) -> None:
         """Sequential accounting: close the current stage (if any) and
@@ -121,14 +156,23 @@ class StageTimer:
         if self._cur is not None:
             cname, t0 = self._cur
             self._close(cname, now - t0)
+        if self._span is not None:
+            self._span.finish()
+            self._span = None
         self._cur = (name, now) if name else None
+        if name and self._obs is not None:
+            self._span = self._obs.span("stage:" + name, stage=name)
 
     @contextmanager
     def stage(self, name: str):
         t0 = time.time()
+        span = (self._obs.span("stage:" + name, stage=name)
+                if self._obs is not None else None)
         try:
             yield
         finally:
+            if span is not None:
+                span.finish()
             self._close(name, time.time() - t0)
 
     def report(self, file=None) -> str:

@@ -7,13 +7,31 @@ from presto_tpu.utils.timing import (StageTimer, app_timer,
                                      print_percent_complete)
 
 
-def test_percent_meter_throttles(capsys):
+def test_percent_meter_throttles(capsys, monkeypatch):
+    # forced on (as if stdout were a TTY): one print per whole percent
+    monkeypatch.setenv("PRESTO_TPU_METER", "1")
     last = -1
     for i in range(0, 101):
         last = print_percent_complete(i, 100, last)
     out = capsys.readouterr().out
     assert out.count("%") == 101       # one print per whole percent
     assert "100%" in out
+
+
+def test_percent_meter_suppressed_on_non_tty(capsys, monkeypatch):
+    # piped stdout (capsys is not a TTY): the \r meter is suppressed;
+    # only the final 100% line survives, so logs stay greppable
+    monkeypatch.delenv("PRESTO_TPU_METER", raising=False)
+    last = -1
+    for i in range(0, 101):
+        last = print_percent_complete(i, 100, last)
+    out = capsys.readouterr().out
+    assert out == "Amount complete = 100%\n"
+    assert "\r" not in out
+    # forced off beats a TTY
+    monkeypatch.setenv("PRESTO_TPU_METER", "0")
+    print_percent_complete(50, 100)
+    assert capsys.readouterr().out == ""
 
 
 def test_stage_timer_context_and_marks():

@@ -1,0 +1,417 @@
+"""Unit + integration tests for presto_tpu.obs (ISSUE 3 tentpole):
+metrics registry + Prometheus exposition, span nesting/propagation
+(incl. across threads), LatencyStats/histogram agreement, flight
+recorder (incl. dump on an injected SimulatedCrash inside a real
+survey), disabled-path overhead, and the presto-report CLI."""
+
+import glob
+import io
+import json
+import os
+import threading
+import time
+
+import pytest
+
+from presto_tpu.obs import (ObsConfig, Observability, chrome_trace,
+                            resolve_obs)
+from presto_tpu.obs.flightrec import FlightRecorder, find_dumps
+from presto_tpu.obs.metrics import MetricsRegistry
+from presto_tpu.obs.trace import NOOP_SPAN, Tracer
+from presto_tpu.utils.timing import LatencyStats
+
+
+def _obs(**kw):
+    kw.setdefault("enabled", True)
+    return Observability(ObsConfig(**kw))
+
+
+# ----------------------------------------------------------------------
+# metrics registry
+# ----------------------------------------------------------------------
+
+def test_counter_gauge_histogram_basics():
+    reg = MetricsRegistry()
+    c = reg.counter("serve_jobs_done_total", "done")
+    c.inc()
+    c.inc(2.5)
+    assert c.value == 3.5
+    with pytest.raises(ValueError):
+        c.inc(-1)
+    g = reg.gauge("serve_queue_depth", "depth")
+    g.set(4)
+    g.dec()
+    assert g.value == 3
+    g.set_max(10)
+    g.set_max(5)                       # HWM never regresses
+    assert g.value == 10
+    h = reg.histogram("latency_seconds", "lat", ("name",))
+    h.labels(name="fft").observe(0.2)
+    assert h.labels(name="fft").count == 1
+    # same labels -> same child; different labels -> different child
+    assert h.labels(name="fft") is h.labels(name="fft")
+    assert h.labels(name="fft") is not h.labels(name="sift")
+
+
+def test_registry_get_or_create_and_kind_mismatch():
+    reg = MetricsRegistry()
+    a = reg.counter("plancache_hits_total", "hits")
+    assert reg.counter("plancache_hits_total") is a
+    with pytest.raises(ValueError):
+        reg.gauge("plancache_hits_total")
+    with pytest.raises(ValueError):
+        reg.counter("plancache_hits_total", labelnames=("x",))
+
+
+def test_disabled_registry_records_nothing():
+    reg = MetricsRegistry(enabled=False)
+    c = reg.counter("serve_jobs_done_total")
+    c.inc(100)
+    assert c.value == 0
+    h = reg.histogram("latency_seconds")
+    h.observe(1.0)
+    assert h.count == 0
+
+
+def test_prometheus_exposition_golden():
+    reg = MetricsRegistry()
+    reg.counter("serve_jobs_done_total",
+                "Jobs completed successfully").inc(3)
+    ev = reg.counter("plancache_evictions_total",
+                     "Plan-cache evictions", ("reason",))
+    ev.labels(reason="capacity").inc()
+    ev.labels(reason="device_error").inc(2)
+    reg.gauge("serve_queue_depth", "Queued jobs").set(7)
+    h = reg.histogram("survey_stage_seconds", "Stage wall time",
+                      ("stage",), buckets=(0.1, 1.0))
+    for v in (0.05, 0.5, 2.0):
+        h.labels(stage="sift").observe(v)
+    golden = "\n".join([
+        '# HELP plancache_evictions_total Plan-cache evictions',
+        '# TYPE plancache_evictions_total counter',
+        'plancache_evictions_total{reason="capacity"} 1',
+        'plancache_evictions_total{reason="device_error"} 2',
+        '# HELP serve_jobs_done_total Jobs completed successfully',
+        '# TYPE serve_jobs_done_total counter',
+        'serve_jobs_done_total 3',
+        '# HELP serve_queue_depth Queued jobs',
+        '# TYPE serve_queue_depth gauge',
+        'serve_queue_depth 7',
+        '# HELP survey_stage_seconds Stage wall time',
+        '# TYPE survey_stage_seconds histogram',
+        'survey_stage_seconds_bucket{stage="sift",le="0.1"} 1',
+        'survey_stage_seconds_bucket{stage="sift",le="1"} 2',
+        'survey_stage_seconds_bucket{stage="sift",le="+Inf"} 3',
+        'survey_stage_seconds_sum{stage="sift"} 2.55',
+        'survey_stage_seconds_count{stage="sift"} 3',
+    ]) + "\n"
+    assert reg.render_prometheus() == golden
+
+
+def test_histogram_percentiles_agree_with_latencystats():
+    """LatencyStats is now a view over registry histograms; both must
+    report identical nearest-rank percentiles for identical samples."""
+    reg = MetricsRegistry()
+    stats = LatencyStats(registry=reg)
+    raw = MetricsRegistry().histogram("latency_seconds", window=2048)
+    samples = [((i * 37) % 100 + 1) / 1000.0 for i in range(100)]
+    for s in samples:
+        stats.record("stage", s)
+        raw.observe(s)
+    assert stats.percentiles("stage") == raw.percentiles()
+    # and the registry exposes the very same child LatencyStats wrote
+    child = reg.get("latency_seconds").labels(name="stage")
+    assert child.count == 100
+    snap = stats.snapshot()["stage"]
+    assert snap["count"] == 100
+    assert snap["p50_s"] == pytest.approx(raw.percentiles()["p50"])
+
+
+# ----------------------------------------------------------------------
+# tracing
+# ----------------------------------------------------------------------
+
+def test_span_nesting_same_thread():
+    tr = Tracer()
+    with tr.span("survey") as root:
+        assert tr.current() is root
+        with tr.span("stage") as st:
+            assert st.trace_id == root.trace_id
+            assert st.parent_id == root.span_id
+        assert tr.current() is root
+    assert tr.current() is None
+    names = [s.name for s in tr.finished()]
+    assert names == ["stage", "survey"]     # inner finishes first
+
+
+def test_span_propagation_across_threads():
+    tr = Tracer()
+    got = {}
+
+    def worker(parent_ctx):
+        # a fresh thread has NO current span; explicit parenting
+        assert tr.current() is None
+        with tr.span("worker-op", parent=parent_ctx) as sp:
+            got["trace_id"] = sp.trace_id
+            got["parent_id"] = sp.parent_id
+
+    with tr.span("root") as root:
+        t = threading.Thread(target=worker, args=(tr.context(),))
+        t.start()
+        t.join()
+    assert got["trace_id"] == root.trace_id
+    assert got["parent_id"] == root.span_id
+
+
+def test_span_error_status_and_chrome_export(tmp_path):
+    tr = Tracer()
+    with pytest.raises(RuntimeError):
+        with tr.span("boom"):
+            raise RuntimeError("x")
+    spans = tr.finished()
+    assert spans[0].status == "error: RuntimeError"
+    doc = chrome_trace(spans)
+    evs = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+    assert evs[0]["name"] == "boom"
+    assert evs[0]["args"]["status"] == "error: RuntimeError"
+    assert evs[0]["dur"] >= 0
+
+
+def test_disabled_tracer_is_noop_singleton():
+    tr = Tracer(enabled=False)
+    assert tr.span("a") is NOOP_SPAN
+    obs = Observability(ObsConfig(enabled=False))
+    assert obs.span("a") is obs.span("b") is NOOP_SPAN
+    assert tr.finished() == []
+
+
+def test_obs_jsonl_stream_and_flush(tmp_path):
+    obs = _obs(trace_dir=str(tmp_path))
+    with obs.span("outer"):
+        with obs.span("inner"):
+            pass
+    obs.flush()
+    obs.tracer.close()
+    lines = [json.loads(ln)
+             for ln in open(tmp_path / "spans.jsonl")]
+    assert [ln["name"] for ln in lines] == ["inner", "outer"]
+    doc = json.load(open(tmp_path / "trace.perfetto.json"))
+    assert {e["name"] for e in doc["traceEvents"]
+            if e["ph"] == "X"} == {"inner", "outer"}
+
+
+# ----------------------------------------------------------------------
+# flight recorder
+# ----------------------------------------------------------------------
+
+def test_flightrec_ring_bound_and_dump(tmp_path):
+    fr = FlightRecorder(capacity=4)
+    for i in range(10):
+        fr.add("tick", i=i)
+    recs = fr.records()
+    assert len(recs) == 4
+    assert recs[-1]["i"] == 9
+    assert fr.last("tick")["i"] == 9
+    path = fr.dump(str(tmp_path), reason="TestReason")
+    assert path and os.path.exists(path)
+    d = json.load(open(path))
+    assert d["reason"] == "TestReason"
+    assert [r["i"] for r in d["records"]] == [6, 7, 8, 9]
+    assert find_dumps(str(tmp_path)) == [path]
+
+
+def test_flightrec_disabled_is_silent(tmp_path):
+    fr = FlightRecorder(enabled=False)
+    fr.add("tick")
+    assert fr.records() == []
+    assert fr.dump(str(tmp_path), reason="x") is None
+    assert find_dumps(str(tmp_path)) == []
+
+
+def test_dump_flight_includes_open_spans_and_metrics(tmp_path):
+    obs = _obs()
+    obs.metrics.counter("serve_jobs_done_total").inc(2)
+    sp = obs.span("stuck-op")
+    obs.event("chaos-point", point="pre-sift")
+    path = obs.dump_flight(str(tmp_path), reason="SimulatedCrash")
+    sp.finish()
+    d = json.load(open(path))
+    assert [s["name"] for s in d["open_spans"]] == ["stuck-op"]
+    assert d["records"][-1]["kind"] == "chaos-point"
+    done = d["metrics"]["serve_jobs_done_total"]["series"][0]
+    assert done["value"] == 2
+    # the dump itself is counted
+    fam = obs.metrics.get("flightrec_dumps_total")
+    assert fam.labels(reason="SimulatedCrash").value == 1
+
+
+# ----------------------------------------------------------------------
+# resolve / config plumbing
+# ----------------------------------------------------------------------
+
+def test_resolve_obs_accepts_config_handle_and_none():
+    h = _obs()
+    assert resolve_obs(h) is h
+    built = resolve_obs(ObsConfig(enabled=True))
+    assert isinstance(built, Observability) and built.enabled
+    assert isinstance(resolve_obs(None), Observability)
+    with pytest.raises(TypeError):
+        resolve_obs(42)
+
+
+def test_quality_report_publishes_counters():
+    from presto_tpu.io.quality import DataQualityReport
+    rep = DataQualityReport(nspectra=1000, nchan=16,
+                            scrubbed_samples=7)
+    rep.add(0, 100, "zero-fill")
+    rep.add(900, 950, "short-read")
+    reg = MetricsRegistry()
+    rep.publish(reg)
+    assert reg.get("ingest_reports_total").value == 1
+    assert reg.get("ingest_scrubbed_samples_total").value == 7
+    q = reg.get("ingest_quarantined_spectra_total")
+    assert q.labels(reason="zero-fill").value == 100
+    assert q.labels(reason="short-read").value == 50
+
+
+# ----------------------------------------------------------------------
+# disabled-path overhead
+# ----------------------------------------------------------------------
+
+def test_disabled_path_near_zero_overhead():
+    """Disabled observability must cost one branch per call.  100k
+    disabled span+counter+event calls must be fast in absolute terms
+    (generous bound for noisy CI), and comparable to a bare function
+    call, not to real instrumentation."""
+    obs = Observability(ObsConfig(enabled=False))
+    c = obs.metrics.counter("serve_jobs_done_total")
+    n = 100_000
+    t0 = time.perf_counter()
+    for _ in range(n):
+        obs.span("x")
+        c.inc()
+        obs.event("e")
+    dt = time.perf_counter() - t0
+    assert dt < 2.0, "disabled path took %.3fs for %d iterations" \
+        % (dt, n)
+    # and produced zero telemetry
+    assert obs.tracer.finished() == []
+    assert obs.flightrec.records() == []
+    assert c.value == 0
+
+
+# ----------------------------------------------------------------------
+# survey integration: chaos kill -> flight-recorder dump
+# ----------------------------------------------------------------------
+
+N, NCHAN, DT = 1 << 13, 16, 2e-4
+
+
+@pytest.fixture(scope="module")
+def tiny_fil(tmp_path_factory):
+    from presto_tpu.models.synth import FakeSignal, fake_filterbank_file
+    d = tmp_path_factory.mktemp("obsfil")
+    raw = str(d / "psr.fil")
+    sig = FakeSignal(f=17.0, dm=10.0, shape="gauss", width=0.08,
+                     amp=0.8)
+    fake_filterbank_file(raw, N, DT, NCHAN, 400.0, 1.0, sig,
+                         noise_sigma=2.0, nbits=8)
+    return raw
+
+
+def _survey_cfg(**kw):
+    from presto_tpu.pipeline.survey import SurveyConfig
+    base = dict(lodm=5.0, hidm=12.0, nsub=16, zmax=0, numharm=2,
+                sigma=3.0, fold_top=0, rfi_time=0.4,
+                singlepulse=False)
+    base.update(kw)
+    return SurveyConfig(**base)
+
+
+def test_chaos_killed_survey_leaves_flight_recorder_dump(tiny_fil,
+                                                         tmp_path):
+    """Acceptance: a chaos-killed survey leaves a flightrec dump whose
+    last record names the journaled kill point; the resumed run
+    completes and exports its trace."""
+    from presto_tpu.pipeline.survey import run_survey
+    from presto_tpu.testing import chaos
+    work = str(tmp_path)
+    obs = _obs()
+    fi = chaos.FaultInjector(kill_at="post-prepsubband",
+                             kill_after=1)
+    with pytest.raises(chaos.SimulatedCrash):
+        run_survey([tiny_fil], _survey_cfg(fault_injector=fi,
+                                           obs=obs), workdir=work)
+    dumps = find_dumps(work)
+    assert len(dumps) == 1
+    d = json.load(open(dumps[0]))
+    assert d["reason"] == "SimulatedCrash"
+    # the dump's final record IS the kill point the injector fired at
+    points = [r for r in d["records"] if r["kind"] == "chaos-point"]
+    assert points[-1]["point"] == fi.fired == "post-prepsubband"
+    # resume with a fresh handle: completes, no new dump, trace lands
+    obs2 = _obs()
+    res = run_survey([tiny_fil], _survey_cfg(obs=obs2), workdir=work)
+    assert os.path.exists(res.candfile)
+    assert len(find_dumps(work)) == 1
+    assert os.path.exists(os.path.join(work, "trace.perfetto.json"))
+    assert os.path.exists(os.path.join(work, "spans.jsonl"))
+    stages = {json.loads(ln)["attrs"].get("stage")
+              for ln in open(os.path.join(work, "spans.jsonl"))
+              if json.loads(ln)["name"].startswith("stage:")}
+    assert "prepsubband" in stages and "sift" in stages
+    # stage timing landed on the registry histogram, too
+    fam = obs2.metrics.get("survey_stage_seconds")
+    assert fam is not None and fam.labels(stage="sift").count == 1
+
+
+def test_disabled_survey_writes_no_telemetry_files(tiny_fil,
+                                                   tmp_path):
+    """Acceptance: with observability disabled (the default), a survey
+    writes exactly the artifacts an uninstrumented run would — no
+    spans.jsonl / trace.perfetto.json / flightrec dumps."""
+    from presto_tpu.pipeline.survey import run_survey
+    work = str(tmp_path)
+    run_survey([tiny_fil], _survey_cfg(
+        obs=ObsConfig(enabled=False)), workdir=work)
+    leftovers = [os.path.basename(p)
+                 for p in glob.glob(os.path.join(work, "*"))
+                 if os.path.basename(p).startswith(("flightrec-",
+                                                    "spans.",
+                                                    "trace."))]
+    assert leftovers == []
+
+
+# ----------------------------------------------------------------------
+# presto-report CLI
+# ----------------------------------------------------------------------
+
+def test_presto_report_renders_workdir(tmp_path, capsys):
+    from presto_tpu.apps.report import main as report_main
+    work = str(tmp_path)
+    # synthesize a workdir: journal + spans + a flightrec dump
+    from presto_tpu.pipeline.manifest import SurveyManifest
+    art = os.path.join(work, "a.dat")
+    with open(art, "wb") as f:
+        f.write(b"\x00" * 64)
+    m = SurveyManifest(work)
+    m.record(art, stage="prepsubband")
+    m.save()
+    obs = _obs(trace_dir=work)
+    with obs.span("stage:prepsubband", stage="prepsubband"):
+        pass
+    obs.event("chaos-point", point="fused-chunk")
+    obs.dump_flight(work, reason="PrestoIOError")
+    obs.flush()
+    obs.tracer.close()
+    assert report_main([work]) == 0
+    out = capsys.readouterr().out
+    assert "manifest.json" in out and "prepsubband" in out
+    assert "PrestoIOError" in out
+    assert "last kill point: fused-chunk" in out
+    # JSON mode round-trips
+    assert report_main([work, "-json"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["manifest"]["artifacts"] == 1
+    assert doc["flightrec"][0]["last_kill_point"] == "fused-chunk"
+    assert report_main([str(tmp_path / "nope")]) == 1

@@ -353,6 +353,161 @@ def test_batch_sharding_places_batch_across_mesh():
     np.testing.assert_array_equal(np.asarray(y), x)
 
 
+# ----------------------------------------------------------------------
+# observability additions (ISSUE 3, additive)
+# ----------------------------------------------------------------------
+
+def test_plancache_evict_bucket_device_error():
+    """evict_bucket flushes device-bound plans and counts them under
+    plancache_evictions_total{reason="device_error"}."""
+    cache = PlanCache(capacity=8)
+    keys = [PlanKey("accel", 0, n, "f32", (), 0, 1) for n in (1, 2)]
+    for k in keys:
+        cache.get(k, object)
+    # device binding recorded at build time
+    assert all(p.device for p in cache._plans.values())
+    n = cache.evict_bucket(device=None, reason="device_error")
+    assert n == 2
+    assert not cache.contains(keys[0])
+    assert cache.stats()["size"] == 0
+    assert cache.stats()["evictions"] == 2
+    fam = cache.obs.metrics.get("plancache_evictions_total")
+    assert fam.labels(reason="device_error").value == 2
+    # rebuilding after the flush is a fresh compile (re-warm), not
+    # a poisoned reuse
+    cache.get(keys[0], object)
+    assert cache.stats()["misses"] == 3
+
+
+def test_scheduler_device_error_flushes_plan_cache():
+    """ROADMAP closure: a device/executable RuntimeError on the retry
+    path evicts the plan cache before retrying, so the retry re-warms
+    instead of re-entering the poisoned executable."""
+    cache = PlanCache(capacity=8)
+    key = PlanKey("accel", 0, 64, "f32", (), 0, 1)
+    cache.get(key, object)
+    assert cache.contains(key)
+    calls = []
+
+    def executor(job):
+        calls.append(1)
+        if len(calls) == 1:
+            raise RuntimeError("failed to execute XLA executable: "
+                               "device DEAD")
+        return {"ok": True}
+
+    q = JobQueue(maxdepth=8)
+    events = EventLog()
+    cfg = SchedulerConfig(max_batch=1, poll_s=0.005, max_retries=2,
+                          backoff_base_s=0.02)
+    sched = Scheduler(q, executor, cfg=cfg, events=events,
+                      obs=cache.obs, plans=cache)
+    job = _job(1)
+    q.submit(job)
+    sched.start()
+    try:
+        deadline = time.time() + 10
+        while job.status not in JobStatus.TERMINAL \
+                and time.time() < deadline:
+            time.sleep(0.01)
+        assert job.status == JobStatus.DONE
+        assert not cache.contains(key)          # poisoned plan gone
+        kinds = [e["kind"] for e in events.tail(100)]
+        assert "plan-evict" in kinds
+        reg = cache.obs.metrics
+        assert reg.get("plancache_evictions_total").labels(
+            reason="device_error").value == 1
+        assert reg.get("serve_device_errors_total").value == 1
+    finally:
+        sched.stop()
+
+
+def test_scheduler_plain_failure_does_not_touch_plans():
+    """Non-device failures (a bad beam, a ValueError) must NOT flush
+    the plan cache — eviction is reserved for poisoned executables."""
+    cache = PlanCache(capacity=8)
+    key = PlanKey("accel", 0, 64, "f32", (), 0, 1)
+    cache.get(key, object)
+
+    def executor(job):
+        raise ValueError("malformed beam header")
+
+    q = JobQueue(maxdepth=8)
+    cfg = SchedulerConfig(max_batch=1, poll_s=0.005, max_retries=0,
+                          backoff_base_s=0.01)
+    sched = Scheduler(q, executor, cfg=cfg, obs=cache.obs,
+                      plans=cache)
+    job = _job(1)
+    q.submit(job)
+    sched.start()
+    try:
+        deadline = time.time() + 10
+        while job.status not in JobStatus.TERMINAL \
+                and time.time() < deadline:
+            time.sleep(0.01)
+        assert job.status == JobStatus.FAILED
+        assert cache.contains(key)
+        assert cache.obs.metrics.get(
+            "plancache_evictions_total").total() == 0
+    finally:
+        sched.stop()
+
+
+def test_scheduler_stats_read_from_registry():
+    """stats() and the Prometheus exposition are the same counters."""
+    sched, events, _ = _run_scheduler(lambda j: {}, [_job(1)])
+    try:
+        assert sched.stats()["jobs_done"] == 1
+        reg = sched.obs.metrics
+        assert reg.get("serve_jobs_done_total").value == 1
+        text = reg.render_prometheus()
+        assert "serve_jobs_done_total 1" in text
+        assert "# TYPE serve_jobs_done_total counter" in text
+    finally:
+        sched.stop()
+
+
+def test_service_metrics_json_shape_and_prometheus(tmp_path):
+    """GET /metrics backward compat: the JSON shape keeps its keys;
+    the Prometheus twin renders the same registry (Accept-negotiated
+    at the HTTP layer)."""
+    import urllib.request
+    from presto_tpu.serve.server import SearchService, start_http
+    service = SearchService(str(tmp_path / "w"), queue_depth=4)
+    try:
+        m = service.metrics()
+        assert set(m) == {"uptime_s", "queue", "jobs", "scheduler",
+                          "plans", "latency", "events"}
+        assert set(m["scheduler"]) == {
+            "alive", "jobs_done", "jobs_failed", "retries",
+            "retry_waiting", "batches", "degrades",
+            "batch_occupancy"}
+        assert set(m["plans"]) == {"size", "capacity", "hits",
+                                   "misses", "evictions", "compile_s",
+                                   "hit_rate"}
+        text = service.metrics_prometheus()
+        assert "serve_queue_depth 0" in text
+        assert 'serve_jobs{status="done"} 0' in text
+        httpd = start_http(service)
+        host, port = httpd.server_address[:2]
+        url = "http://%s:%d/metrics" % (host, port)
+        with urllib.request.urlopen(url, timeout=10) as r:
+            assert r.headers["Content-Type"] == "application/json"
+            json.loads(r.read())
+        req = urllib.request.Request(
+            url, headers={"Accept": "text/plain"})
+        with urllib.request.urlopen(req, timeout=10) as r:
+            assert "text/plain" in r.headers["Content-Type"]
+            body = r.read().decode()
+            assert "# TYPE serve_queue_depth gauge" in body
+        with urllib.request.urlopen(url + "?format=prometheus",
+                                    timeout=10) as r:
+            assert "# TYPE" in r.read().decode()
+        httpd.shutdown()
+    finally:
+        service.stop()
+
+
 def test_compiled_plan_place_with_mesh():
     import jax
     from presto_tpu.parallel.mesh import make_mesh

@@ -1,0 +1,147 @@
+#!/usr/bin/env python
+"""obs_lint: static instrumentation-coverage check (tier-1).
+
+The observability contract lives in presto_tpu/obs/taxonomy.py; this
+linter cross-checks the *source tree* against it so an uninstrumented
+code path cannot ship silently:
+
+  1. every `timer.mark("<stage>")` in pipeline/survey.py is a
+     registered SURVEY_STAGE (=> it emits a
+     survey_stage_seconds{stage=...} sample and a span);
+  2. every `_chaos(cfg, "<point>", ...)` kill point is a registered
+     KILL_POINT (=> it is flight-recorded before it can fire) — and
+     conversely every registered point still exists in the source;
+  3. every `events.emit("<kind>", ...)` in presto_tpu/serve/ is a
+     registered SERVE_EVENT;
+  4. every job lifecycle state (JobStatus constants in serve/queue.py)
+     maps via JOB_STATE_EVENTS to an event kind that the serve layer
+     actually emits — a new scheduler state transition without
+     telemetry fails here;
+  5. every metric registered anywhere in presto_tpu/ or tools/
+     (`.counter("..." / .gauge("..." / .histogram("...`) is listed in
+     METRICS (the documented catalog).
+
+Run directly (exit 1 lists violations) or via tests/test_obs_lint.py.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import sys
+from typing import Dict, List, Set
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:                  # direct `python tools/...`
+    sys.path.insert(0, REPO)
+
+STAGE_RE = re.compile(r'timer\.mark\(\s*"([^"]+)"\s*\)')
+CHAOS_RE = re.compile(r'_chaos\(\s*cfg\s*,\s*"([^"]+)"')
+EMIT_RE = re.compile(r'events\.emit\(\s*"([^"]+)"')
+STATUS_RE = re.compile(r'^\s+([A-Z_]+)\s*=\s*"([a-z-]+)"\s*$',
+                       re.MULTILINE)
+METRIC_RE = re.compile(
+    r'\.(?:counter|gauge|histogram)\(\s*\n?\s*"([a-z0-9_]+)"')
+
+
+def _read(relpath: str) -> str:
+    with open(os.path.join(REPO, relpath)) as f:
+        return f.read()
+
+
+def _tree_sources(*roots: str) -> Dict[str, str]:
+    out: Dict[str, str] = {}
+    for root in roots:
+        for dirpath, _dirs, files in os.walk(os.path.join(REPO, root)):
+            for name in files:
+                if name.endswith(".py"):
+                    p = os.path.join(dirpath, name)
+                    rel = os.path.relpath(p, REPO)
+                    with open(p) as f:
+                        out[rel] = f.read()
+    return out
+
+
+def lint() -> List[str]:
+    """Run every check; returns a list of violation strings."""
+    from presto_tpu.obs import taxonomy
+
+    problems: List[str] = []
+    survey_src = _read("presto_tpu/pipeline/survey.py")
+
+    # 1. survey stages
+    stages = set(STAGE_RE.findall(survey_src))
+    for s in sorted(stages - taxonomy.SURVEY_STAGES):
+        problems.append(
+            "pipeline/survey.py: stage %r is not registered in "
+            "obs/taxonomy.SURVEY_STAGES (uninstrumented stage)" % s)
+    for s in sorted(taxonomy.SURVEY_STAGES - stages):
+        problems.append(
+            "obs/taxonomy.py: SURVEY_STAGES lists %r but "
+            "pipeline/survey.py never marks it" % s)
+
+    # 2. chaos kill points (both directions: the taxonomy IS the
+    # documented flight-recorder vocabulary)
+    points = set(CHAOS_RE.findall(survey_src))
+    for p in sorted(points - taxonomy.KILL_POINTS):
+        problems.append(
+            "pipeline/survey.py: kill point %r is not registered in "
+            "obs/taxonomy.KILL_POINTS" % p)
+    for p in sorted(taxonomy.KILL_POINTS - points):
+        problems.append(
+            "obs/taxonomy.py: KILL_POINTS lists %r but "
+            "pipeline/survey.py never fires it" % p)
+
+    # 3. serve event kinds
+    serve_srcs = _tree_sources("presto_tpu/serve")
+    emitted: Set[str] = set()
+    for rel, src in sorted(serve_srcs.items()):
+        kinds = set(EMIT_RE.findall(src))
+        emitted |= kinds
+        for k in sorted(kinds - taxonomy.SERVE_EVENTS):
+            problems.append(
+                "%s: event kind %r is not registered in "
+                "obs/taxonomy.SERVE_EVENTS" % (rel, k))
+
+    # 4. every job lifecycle state announces itself
+    queue_src = serve_srcs.get("presto_tpu/serve/queue.py", "")
+    states = {v for _name, v in STATUS_RE.findall(queue_src)}
+    for state in sorted(states):
+        kind = taxonomy.JOB_STATE_EVENTS.get(state)
+        if kind is None:
+            problems.append(
+                "serve/queue.py: JobStatus %r has no event mapping "
+                "in obs/taxonomy.JOB_STATE_EVENTS (silent scheduler "
+                "state transition)" % state)
+        elif kind not in emitted:
+            problems.append(
+                "serve layer: state %r maps to event %r which no "
+                "serve module emits" % (state, kind))
+
+    # 5. metric names vs the documented catalog
+    for rel, src in sorted(_tree_sources("presto_tpu",
+                                         "tools").items()):
+        for m in sorted(set(METRIC_RE.findall(src))):
+            if m not in taxonomy.METRICS:
+                problems.append(
+                    "%s: metric %r is not listed in "
+                    "obs/taxonomy.METRICS (undocumented metric)"
+                    % (rel, m))
+    return problems
+
+
+def main(argv=None) -> int:
+    problems = lint()
+    if problems:
+        print("obs_lint: %d instrumentation-coverage violation(s):"
+              % len(problems))
+        for p in problems:
+            print("  - %s" % p)
+        return 1
+    print("obs_lint: instrumentation coverage OK "
+          "(stages, kill points, serve events, job states, metrics)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
