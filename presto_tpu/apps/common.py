@@ -174,6 +174,46 @@ class BlockPrep:
         return block
 
 
+class CLIResume:
+    """Journal-backed ``-resume`` for standalone app CLIs (the third
+    ROADMAP fault-tolerance gap): a killed `prepdata`/`prepsubband`
+    run re-launched by hand used to *trust* whatever output files
+    existed.  With ``-resume`` the tool journals its outputs into the
+    same ``manifest.json`` the survey driver uses
+    (pipeline/manifest.py, size + CRC-32 per artifact), so a resumed
+    run verifies instead of trusts: outputs are skipped only when they
+    exist AND match their journal entry AND were recorded by the same
+    stage; anything missing/truncated/stale is recomputed.  The
+    journal lives next to the outputs, so a later `run_survey` over
+    the same workdir sees the same verify-not-trust contract."""
+
+    def __init__(self, outbase: str, stage: str):
+        from presto_tpu.pipeline.manifest import SurveyManifest
+        self.workdir = os.path.dirname(os.path.abspath(outbase)) \
+            or "."
+        self.manifest = SurveyManifest.load(self.workdir)
+        self.stage = stage
+
+    def complete(self, paths) -> bool:
+        """Every expected output exists, verifies, and was journaled
+        by this tool's stage tag."""
+        paths = list(paths)
+        return bool(paths) and all(
+            self.manifest.valid(p)
+            and self.manifest.stage_of(p) == self.stage
+            for p in paths)
+
+    def invalidate_stale(self, paths) -> list:
+        """Delete+forget outputs that fail verification (so a partial
+        previous run cannot be half-trusted); returns the stale
+        list."""
+        return self.manifest.invalidate_stale(list(paths))
+
+    def record(self, paths) -> None:
+        self.manifest.record_many(
+            [p for p in paths if os.path.exists(p)], self.stage)
+
+
 def load_timeseries(path: str) -> Tuple[np.ndarray, InfoData]:
     """Load a .dat (+ .inf sidecar) time series."""
     base = path[:-4] if path.endswith(".dat") else path

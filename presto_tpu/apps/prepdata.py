@@ -59,6 +59,11 @@ def build_parser() -> argparse.ArgumentParser:
                    help="Channels to zero out, e.g. '0:5,34'")
     p.add_argument("-shorts", action="store_true",
                    help="Write short ints (.sdat) instead of floats")
+    p.add_argument("-resume", action="store_true",
+                   help="Verify-not-trust resume: skip the run when "
+                        "the outputs exist AND match the manifest.json "
+                        "journal next to them; journal them on "
+                        "completion")
     add_raw_flags(p)
     p.add_argument("rawfiles", nargs="+")
     return p
@@ -66,6 +71,18 @@ def build_parser() -> argparse.ArgumentParser:
 
 def run(args) -> str:
     ensure_backend()
+    outbase_early = args.outfile or "prepdata_out"
+    resume = None
+    if getattr(args, "resume", False):
+        from presto_tpu.apps.common import CLIResume
+        resume = CLIResume(outbase_early, "prepdata-cli")
+        suffix = ".sdat" if args.shorts else ".dat"
+        expected = [outbase_early + suffix, outbase_early + ".inf"]
+        if resume.complete(expected):
+            print("prepdata: -resume verified %s%s + .inf against the "
+                  "journal — skipping" % (outbase_early, suffix))
+            return outbase_early
+        resume.invalidate_stale(expected)
     fb = open_raw_args(args.rawfiles, args)
     hdr = fb.header
     nchan = hdr.nchans
@@ -163,6 +180,8 @@ def run(args) -> str:
     else:
         write_dat(outbase + ".dat", result.astype(np.float32), info)
     fb.close()
+    if resume is not None:
+        resume.record([outbase + suffix, outbase + ".inf"])
     print("Wrote %d samples to %s%s (DM=%g, downsamp=%d)"
           % (result.size, outbase, suffix, args.dm, args.downsamp))
     return outbase

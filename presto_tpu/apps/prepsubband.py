@@ -69,6 +69,32 @@ def build_parser() -> argparse.ArgumentParser:
                    help="Total process count of the multi-host run")
     p.add_argument("-procid", type=int, default=None,
                    help="This process's id (0-based)")
+    # elastic worker-loss recovery (parallel/elastic.py): the DM axis
+    # becomes leased shard rows in a per-survey ledger; a dead member's
+    # shards are re-admitted to the survivors instead of stalling the
+    # collective
+    p.add_argument("-elastic", action="store_true",
+                   help="Run the DM fan-out as leased shards from a "
+                        "crash-safe shard ledger (worker-loss "
+                        "recovery for -coordinator clusters; also "
+                        "valid single-host)")
+    p.add_argument("-shard-rows", dest="shard_rows", type=int,
+                   default=0,
+                   help="DM rows per elastic shard (0 = auto)")
+    p.add_argument("-lease-ttl", dest="lease_ttl", type=float,
+                   default=120.0,
+                   help="Elastic shard lease TTL in seconds")
+    p.add_argument("-barrier-timeout", dest="barrier_timeout",
+                   type=float, default=60.0,
+                   help="Max seconds any cross-host collective may "
+                        "stall before the survivors reform")
+    p.add_argument("-heartbeat-interval", dest="heartbeat_interval",
+                   type=float, default=2.0,
+                   help="Elastic heartbeat cadence in seconds")
+    p.add_argument("-resume", action="store_true",
+                   help="Verify-not-trust resume: skip DMs whose "
+                        ".dat outputs match the manifest.json journal "
+                        "next to them; journal outputs on completion")
     add_raw_flags(p)
     p.add_argument("rawfiles", nargs="+")
     return p
@@ -97,7 +123,72 @@ def plan_delays(hdr, args, avgvoverc=0.0):
     return dms, chan_bins, dm_bins
 
 
+class _Setup:
+    """Everything both execution paths (streaming mesh run and the
+    elastic shard loop) derive from the args + raw header: the open
+    reader, the FULL-range delay plan, preprocessing inputs, and the
+    streaming geometry.  The elastic path computing a shard MUST use
+    the full-range plan (center DM, delay normalization, blocklen,
+    valid length) or its rows would not be byte-equal to an unsharded
+    run's."""
+
+    def __init__(self, args):
+        self.fb = open_raw_args(args.rawfiles, args)
+        hdr = self.fb.header
+        self.hdr = hdr
+        self.nchan, self.dt = hdr.nchans, hdr.tsamp
+        self.skip = start_skip_spectra(args, int(hdr.N))
+        self.Neff = int(hdr.N) - self.skip
+        self.plan = (make_bary_plan(self.fb, self.dt * args.downsamp,
+                                    args.ephem,
+                                    skip_spectra=self.skip)
+                     if not args.nobary else None)
+        avgvoverc = (self.plan.avgvoverc if self.plan is not None
+                     else 0.0)
+        self.dms, self.chan_bins, self.dm_bins = plan_delays(
+            hdr, args, avgvoverc)
+        self.maxd = int(self.chan_bins.max()) + int(self.dm_bins.max())
+        self.mask = read_mask(args.mask) if args.mask else None
+        self.padvals = np.zeros(self.nchan, dtype=np.float32)
+        if args.mask:
+            try:
+                self.padvals = determine_padvals(
+                    args.mask.replace(".mask", ".stats"))
+            except OSError:
+                pass
+        self.ignore = (np.asarray(parse_ranges(args.ignorechan),
+                                  dtype=np.int64)
+                       if args.ignorechan else None)
+        blocklen = stream_blocklen(
+            self.nchan, max(int(self.chan_bins.max()),
+                            int(self.dm_bins.max())), nspec=self.Neff)
+        # the per-block downsampler reshapes [.., blocklen/downsamp,
+        # downsamp]: round blocklen up to a multiple of the factor
+        if blocklen % args.downsamp:
+            blocklen += args.downsamp - blocklen % args.downsamp
+        self.blocklen = blocklen
+
+    def block_prep(self, args) -> BlockPrep:
+        """Fresh per-stream preprocessing (the clipper carries state
+        across blocks, so each full pass over the file needs its own
+        instance)."""
+        return BlockPrep(self.nchan, self.dt, args, mask=self.mask,
+                         padvals=self.padvals if args.mask else None,
+                         ignore=self.ignore)
+
+
+def _expected_outputs(args):
+    """The final artifact paths a (non--sub) run will write — known
+    from the args alone, so -resume can verify before any compute."""
+    outbase = args.outfile or "prepsubband_out"
+    dms = args.lodm + np.arange(args.numdms) * args.dmstep
+    names = ["%s_DM%.*f" % (outbase, args.dmprec, dm) for dm in dms]
+    return outbase, names
+
+
 def run(args):
+    if getattr(args, "elastic", False):
+        return _elastic_run(args)
     if args.coordinator or args.nproc is not None:
         from presto_tpu.parallel.mesh import init_distributed
         nproc = init_distributed(args.coordinator, args.nproc,
@@ -106,40 +197,27 @@ def run(args):
     ensure_backend()
     if args.downsamp < 1:
         raise SystemExit("prepsubband: -downsamp must be >= 1")
-    fb = open_raw_args(args.rawfiles, args)
-    hdr = fb.header
-    nchan, dt = hdr.nchans, hdr.tsamp
-    skip = start_skip_spectra(args, int(hdr.N))
-    Neff = int(hdr.N) - skip
-
-    plan = (make_bary_plan(fb, dt * args.downsamp, args.ephem,
-                           skip_spectra=skip)
-            if not args.nobary else None)
-    avgvoverc = plan.avgvoverc if plan is not None else 0.0
-    dms, chan_bins, dm_bins = plan_delays(hdr, args, avgvoverc)
-    maxd = int(chan_bins.max()) + int(dm_bins.max())
-
-    mask = read_mask(args.mask) if args.mask else None
-    padvals = np.zeros(nchan, dtype=np.float32)
-    if args.mask:
-        try:
-            padvals = determine_padvals(args.mask.replace(".mask",
-                                                          ".stats"))
-        except OSError:
-            pass
-    ignore = (np.asarray(parse_ranges(args.ignorechan), dtype=np.int64)
-              if args.ignorechan else None)
-    prep = BlockPrep(nchan, dt, args, mask=mask,
-                     padvals=padvals if args.mask else None,
-                     ignore=ignore)
-
-    blocklen = stream_blocklen(nchan, max(int(chan_bins.max()),
-                                          int(dm_bins.max())),
-                               nspec=Neff)
-    # the per-block downsampler reshapes [.., blocklen/downsamp,
-    # downsamp]: round blocklen up to a multiple of the factor
-    if blocklen % args.downsamp:
-        blocklen += args.downsamp - blocklen % args.downsamp
+    resume = None
+    if getattr(args, "resume", False) and not args.sub \
+            and jax.process_count() == 1:
+        from presto_tpu.apps.common import CLIResume
+        outbase_r, names = _expected_outputs(args)
+        expected = [n + s for n in names for s in (".dat", ".inf")]
+        resume = CLIResume(outbase_r, "prepsubband-cli")
+        if resume.complete(expected):
+            print("prepsubband: -resume verified %d DM outputs "
+                  "against the journal — skipping" % len(names))
+            return outbase_r, args.lodm + np.arange(args.numdms) \
+                * args.dmstep
+        resume.invalidate_stale(expected)
+    s = _Setup(args)
+    fb, hdr = s.fb, s.hdr
+    nchan, dt = s.nchan, s.dt
+    skip, Neff = s.skip, s.Neff
+    plan, dms = s.plan, s.dms
+    chan_bins, dm_bins, maxd = s.chan_bins, s.dm_bins, s.maxd
+    prep = s.block_prep(args)
+    blocklen = s.blocklen
     chan_bins_d = jnp.asarray(chan_bins)
     # host np for the unsharded loop: float_dedisp_many_block's
     # static-slice fast path dispatches on the host array
@@ -268,10 +346,134 @@ def run(args):
         set_onoff(info, valid, numout)
         write_dat(name + ".dat", result[row], info)
     fb.close()
+    if resume is not None:
+        resume.record(["%s_DM%.*f%s" % (outbase, args.dmprec, dms[i],
+                                        suf)
+                       for i in local_ids for suf in (".dat", ".inf")])
     print("Wrote %d DMs x %d samples (lodm=%g dmstep=%g nsub=%d)"
           % (len(local_ids), result.shape[1], args.lodm, args.dmstep,
              args.nsub))
     return outbase, dms
+
+
+def _dedisperse_rows(s: _Setup, args, rows):
+    """One elastic shard: dedisperse DM rows [lo, hi) of the FULL
+    plan.  Mirrors run()'s unsharded streaming loop exactly — same
+    full-range delays and blocklen, same flush blocks, same valid trim
+    and padding — so each row is byte-equal to the same row of a
+    never-sharded run (the recovery invariant the chaos tests pin)."""
+    lo, hi = rows
+    fb, hdr = s.fb, s.hdr
+    prep = s.block_prep(args)
+    chan_bins_d = jnp.asarray(s.chan_bins)
+    dm_bins_sel = np.asarray(s.dm_bins)[lo:hi]
+    blocklen = s.blocklen
+    prev_raw = None
+    prev_sub = None
+    outs = []
+    nread = s.skip
+    while nread < hdr.N + 2 * blocklen:   # two extra flush blocks
+        if nread < hdr.N:
+            block = fb.read_spectra(nread, blocklen)
+            block = prep(block, nread)
+        else:
+            block = np.zeros((blocklen, s.nchan), dtype=np.float32)
+        cur = jnp.asarray(np.ascontiguousarray(block.T))
+        if prev_raw is not None:
+            sub = dd.dedisp_subbands_block(prev_raw, cur, chan_bins_d,
+                                           args.nsub)
+            if prev_sub is not None:
+                series = dd.float_dedisp_many_block(prev_sub, sub,
+                                                    dm_bins_sel)
+                series = dd.downsample_block(series, args.downsamp)
+                outs.append(series)
+            prev_sub = sub
+        prev_raw = cur
+        nread += blocklen
+    cat = jnp.concatenate(outs, axis=1)         # [hi-lo, T]
+    valid = (s.Neff - s.maxd) // args.downsamp
+    result = np.asarray(cat)[:, :valid]
+    if s.plan is not None and s.plan.diffbins.size:
+        result = np.stack([s.plan.apply(result[i])
+                           for i in range(result.shape[0])])
+    return pad_to_good_N(result, args.numout)
+
+
+def _elastic_run(args):
+    """The worker-loss-tolerant DM fan-out: every DM shard is a leased
+    row in the workdir's shard ledger, any host computes any shard on
+    its LOCAL devices, and commits ride the ledger's epoch fence — so
+    a dead cluster member costs a lease TTL, not the run."""
+    from presto_tpu.io.infodata import write_inf
+    from presto_tpu.parallel import elastic
+    from presto_tpu.pipeline.shardledger import make_dm_shards
+
+    if args.sub:
+        raise SystemExit("prepsubband: -elastic does not support -sub")
+    if args.downsamp < 1:
+        raise SystemExit("prepsubband: -downsamp must be >= 1")
+    outbase, names = _expected_outputs(args)
+    workdir = os.path.dirname(os.path.abspath(outbase)) or "."
+    host = elastic.default_host_id(args.procid)
+    ecfg = elastic.ElasticConfig(
+        barrier_timeout=args.barrier_timeout,
+        lease_ttl=args.lease_ttl,
+        heartbeat_interval=args.heartbeat_interval,
+        shard_rows=args.shard_rows)
+    cluster = elastic.ElasticCluster(workdir, host, ecfg)
+    # join BEFORE the backend spins up: jax.distributed.initialize
+    # must precede first device use, exactly like the -coordinator
+    # path
+    cluster.join(args.coordinator, args.nproc, args.procid)
+    ensure_backend()
+    s = _Setup(args)
+    nproc = max(int(args.nproc or 1), 1)
+    # auto shard size: ~2 shards per host so one loss re-admits at
+    # most half a host's work
+    rows = args.shard_rows or max(1, -(-args.numdms // (2 * nproc)))
+    specs = make_dm_shards(args.numdms, rows)
+    local_dev = jax.local_devices()[0]
+
+    def compute(lease):
+        lo, hi = lease.rows
+        with jax.default_device(local_dev):
+            result, valid, numout = _dedisperse_rows(s, args, (lo, hi))
+        staged = {}
+        for k, i in enumerate(range(lo, hi)):
+            name = names[i]
+            info = fil_to_inf(s.fb, name, result.shape[1],
+                              dm=float(s.dms[i]))
+            if s.plan is not None:
+                set_bary_epoch(info, s.plan)
+            elif s.skip:
+                info.mjd_f += s.skip * s.dt / 86400.0
+                info.mjd_i += int(info.mjd_f)
+                info.mjd_f %= 1.0
+            info.dt = s.dt * args.downsamp
+            set_onoff(info, valid, numout)
+            info.name = name
+            info.N = result.shape[1]
+            dat_tmp = elastic.stage_path(name + ".dat", host,
+                                         lease.epoch)
+            inf_tmp = elastic.stage_path(name + ".inf", host,
+                                         lease.epoch)
+            write_dat(dat_tmp, result[k])
+            write_inf(info, inf_tmp)
+            staged[name + ".dat"] = dat_tmp
+            staged[name + ".inf"] = inf_tmp
+        return staged
+
+    try:
+        n = cluster.run(specs, compute,
+                        meta={"outbase": os.path.basename(outbase),
+                              "numdms": int(args.numdms),
+                              "shard_rows": int(rows)})
+    finally:
+        cluster.close()
+        s.fb.close()
+    print("prepsubband: elastic run complete — %d/%d shards by this "
+          "host (epoch %d)" % (n, len(specs), cluster.epoch))
+    return outbase, s.dms
 
 
 def _write_subbands(args, fb, plan, subouts, dms, dt, maxd, Neff,

@@ -35,6 +35,7 @@ KILL_POINTS = frozenset({
     "post-rfifind",
     "pre-prepsubband",
     "prepsubband-method",
+    "elastic-method",
     "post-prepsubband",
     "zapbirds-file",
     "fft-chunk",
@@ -45,6 +46,36 @@ KILL_POINTS = frozenset({
     "fold-cand",
     "pre-singlepulse",
     "post-survey",
+})
+
+#: elastic-cluster kill points — every `self._point("<point>")` in
+#: parallel/elastic.py (the multi-host analog of KILL_POINTS: each is
+#: flight-recorded before the injector may fire, and
+#: tools/multihost_chaos.py kills/stalls real cluster members at them)
+CLUSTER_KILL_POINTS = frozenset({
+    "shard-leased",
+    "shard-computed",
+    "pre-shard-commit",
+    "post-shard-commit",
+    "post-epoch-bump",
+})
+
+#: elastic-cluster event kinds — every `obs.event(...)` /
+#: `self._event(...)` in parallel/elastic.py and
+#: pipeline/shardledger.py (the flight-recorder vocabulary of a
+#: worker-loss recovery: lease grants, redo admissions, epoch bumps,
+#: fenced zombie writes, membership changes)
+CLUSTER_EVENTS = frozenset({
+    "chaos-point",
+    "cluster-join",
+    "host-dead",
+    "epoch-bump",
+    "mesh-reform",
+    "barrier-timeout",
+    "shard-lease",
+    "shard-done",
+    "shard-redo",
+    "stale-write-rejected",
 })
 
 #: serve event kinds — every `events.emit("<kind>", ...)` in
@@ -116,4 +147,13 @@ METRICS = frozenset({
     "jax_live_buffer_hwm_bytes",
     # flight recorder
     "flightrec_dumps_total",
+    # elastic cluster (parallel/elastic.py)
+    "cluster_epoch",
+    "cluster_alive_hosts",
+    "cluster_shards_done_total",
+    "cluster_shard_redos_total",
+    "cluster_epoch_bumps_total",
+    "cluster_barrier_timeouts_total",
+    "cluster_stale_writes_total",
+    "cluster_heartbeats_total",
 })

@@ -1,0 +1,555 @@
+"""Elastic multi-host execution for the DM-sharded pipeline.
+
+The reference's mpiprepsubband statically partitions the DM axis over
+MPI ranks; one lost rank stalls the collective and loses its DM rows
+forever (ROADMAP "multi-host worker loss").  This module replaces the
+static partition with **leased shards** from a filesystem ledger
+(pipeline/shardledger.py) so a `prepsubband -coordinator` cluster
+keeps making progress when members die:
+
+  * every host runs the same loop: lease a pending DM shard, compute
+    it on **local** devices (no cross-host collective in the compute
+    path — the ledger is the only coordination), stage the outputs,
+    and commit them under the ledger's epoch fence;
+  * hosts heartbeat through the coordinator workdir (one small atomic
+    file per host); a missed heartbeat or an expired lease triggers a
+    reap: survivors bump the cluster epoch and re-admit the dead
+    member's unverified shards;
+  * every cross-host collective that *is* issued (join rendezvous,
+    global-mesh init, final sync) runs under a **barrier timeout**
+    (`timed_call`) instead of stalling forever; on timeout the
+    cluster degrades to independent per-host meshes and the ledger
+    carries the run to completion;
+  * after an epoch bump the survivors attempt to re-form a smaller
+    jax.distributed mesh (best effort — re-initialization is runtime-
+    dependent); when re-init is impossible they continue on their
+    local devices, which the compute path uses anyway.
+
+Why the communicator is per-host by default: on the current XLA
+runtime a jax.distributed member does not merely stall when a peer
+dies — the coordination-service client *terminates the surviving
+process* (coordination_service_agent polls the peer error and the
+default missed-heartbeat handler calls LOG(FATAL); installing a
+custom callback aborts in the status marshalling instead).  Joining
+the global runtime would therefore make every member share the
+victim's fate, which is the opposite of elastic.  So by default
+`join()` performs a *ledger rendezvous* (wait for the expected host
+count under the barrier timeout) and never touches jax.distributed;
+`ElasticConfig.global_mesh=True` opts back into a real
+`mesh.init_distributed` join for runtimes that can survive peer
+loss, and `_reform()` then re-initializes the smaller grid after a
+bump — falling back to per-host meshes whenever any step times out
+or fails.
+
+The invariant the tests pin: a run that lost a member produces
+artifacts byte-equal to a run that never failed, because any host
+computes any shard with the identical deterministic program.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import glob
+import os
+import socket
+import threading
+import time
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from presto_tpu.pipeline.shardledger import (Lease, ShardLedger,
+                                             ShardLedgerError,
+                                             StaleEpochError)
+
+#: staged-output prefix; a host sweeps ITS OWN leftovers at join (a
+#: peer's staged files are never touched — they may be mid-commit)
+STAGE_PREFIX = ".shard-stage."
+
+#: env seam for subprocess chaos harnesses:
+#:   PRESTO_TPU_ELASTIC_KILL="<point>[:<nth>[:<mode>[:<stall_s>]]]"
+#: mode is exit|raise|stall (testing/chaos.FaultInjector modes)
+KILL_ENV = "PRESTO_TPU_ELASTIC_KILL"
+
+
+class BarrierTimeout(RuntimeError):
+    """A cross-host collective exceeded its configured timeout."""
+
+    def __init__(self, name: str, timeout: float):
+        self.name = name
+        self.timeout = timeout
+        super().__init__("collective %r stalled past %.1fs barrier "
+                         "timeout" % (name, timeout))
+
+
+def timed_call(fn: Callable, timeout: float, name: str = "barrier"):
+    """Run `fn` (a possibly-stalling collective) in a worker thread
+    and give up after `timeout` seconds.  The caller's thread never
+    blocks unboundedly; a stalled collective is abandoned to its
+    daemon thread and BarrierTimeout raised so the survivors can
+    reform instead of hanging the whole cluster."""
+    box: dict = {}
+    done = threading.Event()
+
+    def runner():
+        try:
+            box["value"] = fn()
+        except BaseException as e:      # noqa: BLE001 — re-raised
+            box["error"] = e
+        finally:
+            done.set()
+
+    t = threading.Thread(target=runner, daemon=True,
+                         name="timed-%s" % name)
+    t.start()
+    if not done.wait(timeout):
+        raise BarrierTimeout(name, timeout)
+    if "error" in box:
+        raise box["error"]
+    return box.get("value")
+
+
+@dataclass
+class ElasticConfig:
+    """Knobs for the elastic shard loop (wire-safe plain values)."""
+    #: upper bound on any cross-host collective (join, sync, shutdown)
+    barrier_timeout: float = 60.0
+    #: a shard lease not completed/renewed within this window is
+    #: re-admitted — the stalled-worker bound
+    lease_ttl: float = 120.0
+    #: heartbeat write cadence
+    heartbeat_interval: float = 2.0
+    #: a host silent for this long is declared dead (default: 4x the
+    #: heartbeat interval)
+    heartbeat_timeout: Optional[float] = None
+    #: DM rows per shard; 0 = auto (aim for ~2 shards per host)
+    shard_rows: int = 0
+    #: sleep while every pending shard is leased elsewhere
+    idle_poll: float = 0.25
+    #: join the real jax.distributed runtime (cross-host mesh).  OFF
+    #: by default: the current XLA coordination client TERMINATES a
+    #: surviving process when a peer dies, so a global-mesh member
+    #: cannot outlive a worker loss; the default ledger-rendezvous
+    #: mode keeps the communicator per-host and survives.  Enable
+    #: only on runtimes verified to tolerate peer loss.
+    global_mesh: bool = False
+
+    @property
+    def hb_timeout(self) -> float:
+        return (self.heartbeat_timeout
+                if self.heartbeat_timeout is not None
+                else 4.0 * self.heartbeat_interval)
+
+
+def default_host_id(procid: Optional[int] = None) -> str:
+    """Stable-ish identity for the ledger: explicit process id when a
+    cluster grid was given, else host+pid."""
+    if procid is not None:
+        return "proc%d" % int(procid)
+    return "%s-%d" % (socket.gethostname(), os.getpid())
+
+
+def stage_path(final: str, host: str, epoch: int) -> str:
+    """Per-epoch staged name for an artifact a worker is computing —
+    committed onto `final` only if the ledger accepts the lease."""
+    d, b = os.path.split(os.path.abspath(final))
+    return os.path.join(d, "%s%s.%s.e%d" % (STAGE_PREFIX, b, host,
+                                            int(epoch)))
+
+
+def sweep_stale_stage(workdir: str, host: str) -> int:
+    """Remove THIS host's leftover staged files (a previous
+    incarnation died mid-compute).  Peers' staged files are left
+    alone — they may be one ledger-lock away from committing."""
+    n = 0
+    pat = os.path.join(workdir, STAGE_PREFIX + "*.%s.e*" % host)
+    for p in glob.glob(pat):
+        with contextlib.suppress(OSError):
+            os.remove(p)
+            n += 1
+    return n
+
+
+# ----------------------------------------------------------------------
+# process-level seams (CLI entry points can't take objects via argv)
+# ----------------------------------------------------------------------
+
+_process_injector = None
+_process_obs = None
+
+
+def set_process_injector(injector) -> None:
+    """Thread a chaos FaultInjector into elastic runs started through
+    a CLI main() in this process (the survey driver uses this)."""
+    global _process_injector
+    _process_injector = injector
+
+
+def set_process_obs(obs) -> None:
+    global _process_obs
+    _process_obs = obs
+
+
+def _injector_from_env():
+    """Build a FaultInjector from PRESTO_TPU_ELASTIC_KILL — the seam
+    subprocess harnesses (tools/multihost_chaos.py) use to kill or
+    stall one real cluster member at a named point."""
+    spec = os.environ.get(KILL_ENV, "")
+    if not spec:
+        return None
+    from presto_tpu.testing.chaos import FaultInjector
+    parts = spec.split(":")
+    point = parts[0]
+    nth = int(parts[1]) if len(parts) > 1 and parts[1] else 1
+    mode = parts[2] if len(parts) > 2 and parts[2] else "exit"
+    stall = float(parts[3]) if len(parts) > 3 and parts[3] else 3600.0
+    return FaultInjector(kill_at=point, kill_after=nth, mode=mode,
+                         stall_seconds=stall)
+
+
+def process_injector():
+    """The active injector: explicit seam first, then the env spec."""
+    return (_process_injector if _process_injector is not None
+            else _injector_from_env())
+
+
+# ----------------------------------------------------------------------
+# the cluster
+# ----------------------------------------------------------------------
+
+class ElasticCluster:
+    """One host's membership in an elastic DM-shard run.
+
+    Lifecycle::
+
+        cluster = ElasticCluster(workdir, host, cfg)
+        cluster.join(coordinator, nproc, procid)   # timed, may degrade
+        done = cluster.run(shard_specs, compute_fn)
+        cluster.close()
+    """
+
+    def __init__(self, workdir: str, host: str,
+                 cfg: Optional[ElasticConfig] = None, obs=None,
+                 fault_injector=None, ledger_name: Optional[str] = None):
+        from presto_tpu.obs import get_obs
+        self.workdir = os.path.abspath(workdir)
+        self.host = host
+        self.cfg = cfg or ElasticConfig()
+        self.obs = obs if obs is not None else (
+            _process_obs if _process_obs is not None else get_obs())
+        self.fault_injector = (fault_injector
+                               if fault_injector is not None
+                               else process_injector())
+        os.makedirs(self.workdir, exist_ok=True)
+        kw = {} if ledger_name is None else {"name": ledger_name}
+        self.ledger = ShardLedger(self.workdir, obs=self.obs, **kw)
+        self.epoch = 0
+        self.distributed = False
+        self.coordinator: Optional[str] = None
+        self._hb_stop = threading.Event()
+        self._hb_thread: Optional[threading.Thread] = None
+        self._last_reap = 0.0
+        reg = self.obs.metrics
+        self.m_epoch = reg.gauge(
+            "cluster_epoch", "Current elastic-cluster epoch")
+        self.m_alive = reg.gauge(
+            "cluster_alive_hosts", "Hosts with fresh heartbeats")
+        self.m_done = reg.counter(
+            "cluster_shards_done_total", "DM shards committed")
+        self.m_redo = reg.counter(
+            "cluster_shard_redos_total",
+            "DM shards re-admitted after loss/expiry/verify failure")
+        self.m_bumps = reg.counter(
+            "cluster_epoch_bumps_total", "Cluster epoch bumps")
+        self.m_barrier_to = reg.counter(
+            "cluster_barrier_timeouts_total",
+            "Collectives abandoned at the barrier timeout")
+        self.m_stale = reg.counter(
+            "cluster_stale_writes_total",
+            "Epoch-fenced (zombie) shard commits rejected")
+        self.m_hb = reg.counter(
+            "cluster_heartbeats_total", "Heartbeats written")
+
+    # -- chaos / events ----------------------------------------------
+    def _point(self, name: str) -> None:
+        """Chaos kill point: flight-recorded first so a kill here
+        names itself in the dump (the survey._chaos contract)."""
+        if self.obs.enabled:
+            self.obs.event("chaos-point", point=name, host=self.host)
+        if self.fault_injector is not None:
+            self.fault_injector.point(name)
+
+    # -- membership ---------------------------------------------------
+    def join(self, coordinator: Optional[str] = None,
+             nproc: Optional[int] = None,
+             procid: Optional[int] = None) -> int:
+        """Join the cluster: ledger registration, heartbeat thread,
+        and a bounded rendezvous.  Never stalls: with the default
+        per-host communicator (cfg.global_mesh=False) the rendezvous
+        is a ledger poll for the expected host count; with
+        global_mesh=True a real jax.distributed init runs under the
+        barrier timeout.  Either way a timeout degrades to an
+        independent per-host mesh — the compute path only ever uses
+        local devices, so that is a visibility downgrade, not a
+        correctness one.  Returns the epoch joined under."""
+        sweep_stale_stage(self.workdir, self.host)
+        self.coordinator = coordinator
+        if self.cfg.global_mesh and (coordinator
+                                     or nproc is not None):
+            from presto_tpu.parallel.mesh import init_distributed
+            try:
+                timed_call(
+                    lambda: init_distributed(coordinator, nproc,
+                                             procid),
+                    self.cfg.barrier_timeout, "init-distributed")
+                self.distributed = True
+            except BarrierTimeout:
+                self.m_barrier_to.inc()
+                if self.obs.enabled:
+                    self.obs.event("barrier-timeout",
+                                   name="init-distributed",
+                                   timeout=self.cfg.barrier_timeout)
+                print("elastic: cluster join timed out after %.1fs — "
+                      "continuing on the local mesh"
+                      % self.cfg.barrier_timeout)
+            except Exception as e:
+                print("elastic: cluster join failed (%s: %s) — "
+                      "continuing on the local mesh"
+                      % (type(e).__name__, e))
+        self.epoch = self.ledger.join(self.host, addr=coordinator)
+        self._readmit_own_leases()
+        self.ledger.heartbeat(self.host, self.epoch)
+        self.m_hb.inc()
+        self.m_epoch.set(self.epoch)
+        if self.obs.enabled:
+            self.obs.event("cluster-join", host=self.host,
+                           epoch=self.epoch,
+                           distributed=self.distributed)
+        self._hb_thread = threading.Thread(
+            target=self._hb_loop, daemon=True,
+            name="elastic-hb-%s" % self.host)
+        self._hb_thread.start()
+        if not self.distributed and nproc is not None and nproc > 1:
+            self._rendezvous(int(nproc))
+        return self.epoch
+
+    def _rendezvous(self, expected: int) -> bool:
+        """Ledger-based join barrier: wait (bounded by the barrier
+        timeout) until `expected` hosts heartbeat, so a run starts
+        with its full cluster when everyone shows up — but a member
+        that never arrives only costs the timeout, not the run."""
+        deadline = time.time() + self.cfg.barrier_timeout
+        while time.time() < deadline:
+            alive = self.ledger.alive_hosts(ttl=self.cfg.hb_timeout)
+            self.m_alive.set(len(alive))
+            if len(alive) >= expected:
+                return True
+            time.sleep(min(0.05, self.cfg.idle_poll))
+        self.m_barrier_to.inc()
+        if self.obs.enabled:
+            self.obs.event("barrier-timeout", name="join-rendezvous",
+                           timeout=self.cfg.barrier_timeout,
+                           expected=expected)
+        print("elastic: join rendezvous timed out (%d host(s) "
+              "expected) — proceeding with the survivors" % expected)
+        return False
+
+    def _readmit_own_leases(self) -> None:
+        """A restarting host cannot have in-flight work: any lease the
+        ledger still shows under this host's name belongs to a dead
+        incarnation.  Expire it now rather than waiting out the TTL."""
+        redone = self.ledger.readmit_owned(self.host)
+        if redone:
+            self.epoch = self.ledger.epoch
+            self.m_epoch.set(self.epoch)
+            self.m_bumps.inc()
+            self.m_redo.inc(len(redone))
+
+    def _hb_loop(self) -> None:
+        while not self._hb_stop.wait(self.cfg.heartbeat_interval):
+            try:
+                self.ledger.heartbeat(self.host, self.epoch)
+                self.m_hb.inc()
+            except OSError:
+                pass                       # workdir vanished: dying
+
+    def close(self) -> None:
+        self._hb_stop.set()
+        if self._hb_thread is not None:
+            self._hb_thread.join(timeout=2.0)
+
+    # -- failure detection + reform -----------------------------------
+    def _note_reap(self, report) -> None:
+        if report.bumped:
+            self.epoch = report.epoch
+            self.m_epoch.set(self.epoch)
+            self.m_bumps.inc()
+            self.m_redo.inc(len(report.redone))
+
+    def maybe_reap(self, now: Optional[float] = None) -> bool:
+        """Periodic failure detection; returns True when membership
+        changed (epoch bumped) and a mesh reform was attempted."""
+        now = time.time() if now is None else now
+        if now - self._last_reap < self.cfg.heartbeat_interval:
+            return False
+        self._last_reap = now
+        report = self.ledger.reap(self.cfg.hb_timeout, now=now)
+        alive = self.ledger.alive_hosts(now=now,
+                                        ttl=self.cfg.hb_timeout)
+        self.m_alive.set(len(alive))
+        if not report.bumped:
+            if self.epoch < report.epoch:   # a peer bumped it
+                self.epoch = report.epoch
+                self.m_epoch.set(self.epoch)
+            return False
+        self._note_reap(report)
+        self._reform(alive)
+        self._point("post-epoch-bump")
+        return True
+
+    def _reform(self, alive: List[str]) -> None:
+        """Re-form the communicator over the survivors.  Best effort:
+        tear down the stalled runtime under the barrier timeout and
+        try a fresh jax.distributed grid agreed through the ledger
+        (rank = index among sorted survivors, coordinator port offset
+        by epoch).  When any step fails — the common case on runtimes
+        that cannot re-initialize in-process — degrade to independent
+        per-host meshes; the compute path is local-only either way."""
+        if not self.distributed:
+            return
+        import jax
+        with contextlib.suppress(BaseException):
+            timed_call(jax.distributed.shutdown,
+                       self.cfg.barrier_timeout,
+                       "distributed-shutdown")
+        ok = False
+        coord = self._reform_coordinator(alive)
+        if coord is not None and self.host in alive:
+            try:
+                timed_call(
+                    lambda: jax.distributed.initialize(
+                        coordinator_address=coord,
+                        num_processes=len(alive),
+                        process_id=sorted(alive).index(self.host)),
+                    self.cfg.barrier_timeout, "reform")
+                ok = jax.process_count() == len(alive)
+            except BarrierTimeout:
+                self.m_barrier_to.inc()
+                if self.obs.enabled:
+                    self.obs.event("barrier-timeout", name="reform",
+                                   timeout=self.cfg.barrier_timeout)
+            except Exception:
+                ok = False
+        if not ok:
+            self.distributed = False
+        if self.obs.enabled:
+            self.obs.event("mesh-reform",
+                           mode="cluster" if ok else "local",
+                           survivors=sorted(alive),
+                           epoch=self.epoch)
+        print("elastic: epoch %d — %s mesh over %d survivor(s)"
+              % (self.epoch, "re-formed" if ok else "per-host",
+                 max(len(alive), 1)))
+
+    def _reform_coordinator(self, alive: List[str]) -> Optional[str]:
+        if not alive or self.coordinator is None:
+            return None
+        host, _, port = self.coordinator.rpartition(":")
+        try:
+            return "%s:%d" % (host, int(port) + self.epoch)
+        except ValueError:
+            return None
+
+    def barrier(self, name: str = "sync") -> bool:
+        """Timed cross-host sync; False (never a stall) on timeout."""
+        if not self.distributed:
+            return True
+        try:
+            from jax.experimental import multihost_utils
+            timed_call(
+                lambda: multihost_utils.sync_global_devices(name),
+                self.cfg.barrier_timeout, name)
+            return True
+        except BarrierTimeout:
+            self.m_barrier_to.inc()
+            if self.obs.enabled:
+                self.obs.event("barrier-timeout", name=name,
+                               timeout=self.cfg.barrier_timeout)
+            return False
+        except Exception:
+            return False
+
+    # -- the shard loop -----------------------------------------------
+    def run(self, specs: Sequence[Tuple[str, int, int]],
+            compute_fn: Callable[[Lease], Dict[str, str]],
+            meta: Optional[dict] = None) -> int:
+        """Drive the elastic loop until every shard is done.
+
+        `compute_fn(lease)` computes the lease's DM rows and returns
+        {final_path: staged_path}; this loop owns lease/commit/fence
+        handling and failure detection.  Returns the number of shards
+        THIS host committed."""
+        self.ledger.ensure_shards(specs, meta=meta)
+        self.ledger.verify_done()
+        committed = 0
+        while True:
+            self.maybe_reap()
+            if self.ledger.all_done():
+                break
+            lease = self.ledger.lease(self.host, self.cfg.lease_ttl)
+            if lease is None:
+                # every pending shard is leased elsewhere: wait for a
+                # peer commit, or for reap to re-admit a lost lease
+                time.sleep(self.cfg.idle_poll)
+                continue
+            if self.epoch < lease.epoch:
+                self.epoch = lease.epoch
+                self.m_epoch.set(self.epoch)
+            self._point("shard-leased")
+            try:
+                staged = compute_fn(lease)
+            except Exception:
+                # a compute error on this host: release the lease so a
+                # peer (possibly differently configured) can try, then
+                # surface the error — it is a bug, not a membership
+                # event
+                self.ledger.fail(lease, self.host)
+                raise
+            self._point("shard-computed")
+            self._point("pre-shard-commit")
+            try:
+                self.ledger.complete(lease, self.host, staged)
+                committed += 1
+                self.m_done.inc()
+            except StaleEpochError:
+                # fenced: our lease was re-admitted while we computed
+                # (we were presumed dead, or the lease expired).  The
+                # staged files are gone; the shard belongs to whoever
+                # re-leased it.
+                self.m_stale.inc()
+                continue
+            except ShardLedgerError as e:
+                print("elastic: commit of %s failed (%s) — shard "
+                      "re-admitted" % (lease.shard_id, e))
+                continue
+            self._point("post-shard-commit")
+        self.barrier("elastic-done")
+        return committed
+
+
+def run_elastic(workdir: str, host: str,
+                specs: Sequence[Tuple[str, int, int]],
+                compute_fn: Callable[[Lease], Dict[str, str]],
+                cfg: Optional[ElasticConfig] = None,
+                coordinator: Optional[str] = None,
+                nproc: Optional[int] = None,
+                procid: Optional[int] = None, obs=None,
+                fault_injector=None, meta: Optional[dict] = None) -> int:
+    """One-call wrapper: join, run every shard, leave.  Returns the
+    number of shards this host committed."""
+    cluster = ElasticCluster(workdir, host, cfg, obs=obs,
+                             fault_injector=fault_injector)
+    cluster.join(coordinator, nproc, procid)
+    try:
+        return cluster.run(specs, compute_fn, meta=meta)
+    finally:
+        cluster.close()

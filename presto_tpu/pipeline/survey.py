@@ -79,6 +79,14 @@ class SurveyConfig:
     # existence checkpoint contract (no manifest journal).
     fault_injector: Optional[object] = None
     verify_resume: bool = True
+    # elastic worker-loss recovery for the DM-sharded prepsubband
+    # stage: an ElasticConfig (parallel/elastic.py) or True for
+    # defaults.  The stage's DM fan-out then runs as leased shards
+    # from the workdir's shard ledger (pipeline/shardledger.py) —
+    # a cluster member dying mid-method costs a lease TTL instead of
+    # stalling the collective, and a single-host run gains shard-level
+    # crash-safe resume.
+    elastic: Optional[object] = None
     # observability: an obs.ObsConfig or obs.Observability.  None ->
     # the process default (enabled only when PRESTO_TPU_OBS=1), so an
     # unconfigured run pays one branch per telemetry point and writes
@@ -134,6 +142,22 @@ def _record(manifest, paths, stage: str) -> None:
     if manifest is not None:
         manifest.record_many([p for p in paths if os.path.exists(p)],
                              stage)
+
+
+def _elastic_argv(elastic_cfg) -> List[str]:
+    """Map a SurveyConfig.elastic value (True or an ElasticConfig)
+    onto prepsubband -elastic CLI flags."""
+    argv = ["-elastic"]
+    if elastic_cfg is True:
+        return argv
+    for flag, attr in (("-shard-rows", "shard_rows"),
+                       ("-lease-ttl", "lease_ttl"),
+                       ("-barrier-timeout", "barrier_timeout"),
+                       ("-heartbeat-interval", "heartbeat_interval")):
+        val = getattr(elastic_cfg, attr, None)
+        if val:
+            argv += [flag, str(val)]
+    return argv
 
 
 def _drop_stale(manifest, paths) -> List[str]:
@@ -256,7 +280,23 @@ def _run_survey_stages(rawfiles, cfg, workdir, base, res, timer,
                 "-o", base]
         if res.maskfile and os.path.exists(res.maskfile):
             argv += ["-mask", res.maskfile]
-        prepsubband_main(argv + rawfiles)
+        if getattr(cfg, "elastic", None):
+            # worker-loss-tolerant DM fan-out: run the method through
+            # the leased-shard ledger (apps/prepsubband -elastic);
+            # the survey's chaos injector threads through the elastic
+            # layer's process seam (argv can't carry objects)
+            from presto_tpu.parallel import elastic as _elastic
+            argv += _elastic_argv(cfg.elastic)
+            _elastic.set_process_injector(cfg.fault_injector)
+            _elastic.set_process_obs(obs)
+            try:
+                prepsubband_main(argv + rawfiles)
+            finally:
+                _elastic.set_process_injector(None)
+                _elastic.set_process_obs(None)
+            _chaos(cfg, "elastic-method", obs)
+        else:
+            prepsubband_main(argv + rawfiles)
         done = _stage(dat_glob, workdir)
         _record(manifest, done + [f[:-4] + ".inf" for f in done],
                 "prepsubband")

@@ -24,6 +24,7 @@ from __future__ import annotations
 
 import os
 import random
+import time
 from typing import Callable, List, Optional
 
 
@@ -42,21 +43,32 @@ class FaultInjector:
     ----------
     kill_at : substring a point name must contain to count (None
         matches every point).
-    kill_after : fire on the Nth matching call (1-based).
+    kill_after : fire on the Nth matching call (1-based) — so a
+        multi-process test can kill the Nth barrier/shard rather than
+        the first.  `kill_after_n` is an accepted alias.
     mode : "raise" raises SimulatedCrash (in-process tests);
         "exit" calls os._exit(EXIT_CODE) — a real kill, for
-        subprocess-based harnesses like tools/chaos_survey.py.
+        subprocess-based harnesses like tools/chaos_survey.py and
+        tools/multihost_chaos.py;
+        "stall" sleeps `stall_seconds` at the point — a member stuck
+        in a collective (or wedged on IO) rather than dead, the case
+        barrier timeouts and lease expiry must bound.
     """
 
     EXIT_CODE = 43
 
     def __init__(self, kill_at: Optional[str] = None,
-                 kill_after: int = 1, mode: str = "raise"):
-        if mode not in ("raise", "exit", "off"):
-            raise ValueError("mode must be raise|exit|off")
+                 kill_after: int = 1, mode: str = "raise",
+                 kill_after_n: Optional[int] = None,
+                 stall_seconds: float = 3600.0):
+        if mode not in ("raise", "exit", "stall", "off"):
+            raise ValueError("mode must be raise|exit|stall|off")
+        if kill_after_n is not None:
+            kill_after = kill_after_n
         self.kill_at = kill_at
         self.kill_after = max(1, int(kill_after))
         self.mode = mode
+        self.stall_seconds = float(stall_seconds)
         self.fired: Optional[str] = None
         self.matched = 0
         self.points_seen: List[str] = []
@@ -75,20 +87,42 @@ class FaultInjector:
             return
         self.fired = name
         if self.mode == "exit":
-            os._exit(self.EXIT_CODE)
+            kill_process()
+        if self.mode == "stall":
+            stall_collective(self.stall_seconds)
+            return
         raise SimulatedCrash(name)
+
+
+def kill_process(exit_code: int = FaultInjector.EXIT_CODE) -> None:
+    """Hard process death — no atexit, no finally blocks, no flushes.
+    The multi-process analog of SimulatedCrash: a preempted or
+    OOM-killed cluster member."""
+    os._exit(exit_code)
+
+
+def stall_collective(seconds: float = 3600.0) -> None:
+    """Wedge the calling thread, simulating a member stuck inside a
+    collective (or on dead storage).  Peers must make progress via
+    barrier timeouts and lease expiry — never by waiting this out."""
+    time.sleep(seconds)
 
 
 def run_to_completion(fn: Callable, max_crashes: int = 32):
     """Drive `fn` through injected crashes: call it until it returns
     without raising SimulatedCrash (the kill-resume loop in one
     line).  Returns fn()'s result."""
+    last: Optional[SimulatedCrash] = None
     for _ in range(max_crashes):
         try:
             return fn()
-        except SimulatedCrash:
+        except SimulatedCrash as e:
+            last = e
             continue
-    raise RuntimeError("still crashing after %d resumes" % max_crashes)
+    raise RuntimeError(
+        "still crashing after %d resumes (last kill point: %r)"
+        % (max_crashes, last.point if last is not None else None)
+    ) from last
 
 
 class TransientFaults:

@@ -1,0 +1,511 @@
+"""Elastic DM-shard layer (ISSUE 4): shard-ledger semantics, epoch
+fencing, redo computation, the in-process elastic loop, and the CLI
+`-resume` journal satellite.
+
+The multi-process worker-kill matrix lives in
+tests/test_multihost_chaos.py (slow); everything here is
+single-process and tier-1-fast.  The contracts pinned:
+
+  * a lease not completed within its TTL (or whose owner stops
+    heartbeating) is re-admitted and the cluster epoch bumps;
+  * a stale epoch's late write NEVER lands in the ledger or
+    overwrites a journaled artifact (the zombie-worker fence);
+  * done shards are verified (size+CRC) on resume, not trusted;
+  * the elastic prepsubband path is byte-equal to the plain run, and
+    a killed elastic run resumes to the same bytes;
+  * prepdata/prepsubband `-resume` verifies against manifest.json
+    instead of trusting existence.
+"""
+
+import glob
+import json
+import os
+
+import numpy as np
+import pytest
+
+from presto_tpu.pipeline.shardledger import (LEASED, PENDING,
+                                             ShardLedger,
+                                             StaleEpochError,
+                                             make_dm_shards)
+from presto_tpu.testing import chaos
+
+
+def _write(path, data=b"shard-bytes"):
+    with open(path, "wb") as f:
+        f.write(data)
+    return path
+
+
+def _ledger(tmp_path, obs=None):
+    return ShardLedger(str(tmp_path), obs=obs)
+
+
+# ----------------------------------------------------------------------
+# ledger basics
+# ----------------------------------------------------------------------
+
+def test_make_dm_shards_partition():
+    specs = make_dm_shards(10, 4)
+    assert specs == [("dm0000", 0, 4), ("dm0001", 4, 8),
+                     ("dm0002", 8, 10)]
+    assert make_dm_shards(0, 4) == []
+    # every row covered exactly once
+    rows = [i for _sid, lo, hi in make_dm_shards(17, 3)
+            for i in range(lo, hi)]
+    assert rows == list(range(17))
+
+
+def test_lease_complete_roundtrip(tmp_path):
+    led = _ledger(tmp_path)
+    led.join("a")
+    assert led.ensure_shards(make_dm_shards(4, 2)) == 2
+    lease = led.lease("a", ttl=60.0)
+    assert lease is not None and lease.rows == (0, 2)
+    assert led.counts() == {PENDING: 1, LEASED: 1, "done": 0}
+    final = str(tmp_path / "out0.dat")
+    staged = _write(str(tmp_path / "stage0"))
+    arts = led.complete(lease, "a", {final: staged})
+    assert os.path.exists(final) and not os.path.exists(staged)
+    assert arts["out0.dat"]["size"] == len(b"shard-bytes")
+    lease2 = led.lease("a", ttl=60.0)
+    led.complete(lease2, "a", {})
+    assert led.all_done()
+    # ensure_shards is idempotent: nothing resets to pending
+    led.ensure_shards(make_dm_shards(4, 2))
+    assert led.all_done()
+
+
+def test_lease_expiry_is_reaped(tmp_path):
+    led = _ledger(tmp_path)
+    led.join("a", now=1000.0)
+    led.heartbeat("a", 0, now=1000.0)
+    led.ensure_shards(make_dm_shards(2, 1))
+    lease = led.lease("a", ttl=5.0, now=1000.0)
+    # before expiry: nothing to redo
+    assert led.redo_set(heartbeat_ttl=60.0, now=1002.0) == []
+    # after expiry: the lease is in the redo set, and reap re-admits
+    assert led.redo_set(heartbeat_ttl=60.0,
+                        now=1010.0) == [lease.shard_id]
+    report = led.reap(heartbeat_ttl=60.0, now=1010.0)
+    assert report.redone == [lease.shard_id] and report.bumped
+    assert led.epoch == 1
+    # the expired owner's late commit is fenced
+    with pytest.raises(StaleEpochError):
+        led.complete(lease, "a",
+                     {str(tmp_path / "x.dat"):
+                      _write(str(tmp_path / "s"))}, now=1011.0)
+    assert not os.path.exists(str(tmp_path / "x.dat"))
+
+
+def test_dead_host_shards_readmitted(tmp_path):
+    led = _ledger(tmp_path)
+    led.join("a", now=0.0)
+    led.join("b", now=0.0)
+    led.heartbeat("a", 0, now=100.0)
+    led.heartbeat("b", 0, now=100.0)
+    led.ensure_shards(make_dm_shards(4, 1))
+    la = led.lease("a", ttl=1000.0, now=100.0)
+    led.lease("b", ttl=1000.0, now=100.0)
+    # b keeps heartbeating, a goes silent
+    led.heartbeat("b", 0, now=120.0)
+    report = led.reap(heartbeat_ttl=10.0, now=121.0)
+    assert report.dead_hosts == ["a"]
+    assert report.redone == [la.shard_id]
+    assert report.epoch == 1
+    assert led.alive_hosts(now=121.0, ttl=10.0) == ["b"]
+    # b's still-held lease survives the bump and commits fine
+    # (lease fencing, not global-epoch fencing, is the rule)
+    lb = [s for s in led.read()["shards"].values()
+          if s["state"] == LEASED]
+    assert len(lb) == 1 and lb[0]["owner"] == "b"
+
+
+def test_zombie_write_never_overwrites_journaled_artifact(tmp_path):
+    """The acceptance-criterion fence: host a is declared dead while
+    computing; the survivor recomputes and commits the shard; a's
+    zombie commit must be rejected AND the survivor's journaled bytes
+    must stay untouched."""
+    led = _ledger(tmp_path)
+    led.join("a", now=0.0)
+    led.join("b", now=0.0)
+    led.heartbeat("a", 0, now=0.0)
+    led.heartbeat("b", 0, now=0.0)
+    led.ensure_shards(make_dm_shards(1, 1))
+    za = led.lease("a", ttl=1000.0, now=0.0)
+    led.heartbeat("b", 0, now=50.0)
+    report = led.reap(heartbeat_ttl=10.0, now=51.0)   # a is dead
+    assert report.bumped and report.redone == [za.shard_id]
+    lb = led.lease("b", ttl=1000.0, now=51.0)
+    final = str(tmp_path / "row.dat")
+    led.complete(lb, "b", {final: _write(str(tmp_path / "sb"),
+                                         b"good-bytes")}, now=52.0)
+    # the zombie wakes up and tries to land its stale compute
+    stale_staged = _write(str(tmp_path / "sa"), b"zombie-bytes")
+    with pytest.raises(StaleEpochError) as ei:
+        led.complete(za, "a", {final: stale_staged}, now=53.0)
+    assert ei.value.epoch == 0 and ei.value.current_epoch == 1
+    assert not os.path.exists(stale_staged)      # staged discarded
+    with open(final, "rb") as f:
+        assert f.read() == b"good-bytes"          # journal intact
+    entry = led.read()["shards"]["dm0000"]["artifacts"]["row.dat"]
+    assert entry["size"] == len(b"good-bytes")
+
+
+def test_verify_done_readmits_corrupt_shard(tmp_path):
+    led = _ledger(tmp_path)
+    led.join("a")
+    led.ensure_shards(make_dm_shards(1, 1))
+    lease = led.lease("a", ttl=60.0)
+    final = str(tmp_path / "v.dat")
+    led.complete(lease, "a", {final: _write(str(tmp_path / "s"))})
+    assert led.verify_done() == []               # pristine: trusted
+    with open(final, "ab") as f:                 # rot the artifact
+        f.write(b"XX")
+    assert led.verify_done() == ["dm0000"]
+    assert not os.path.exists(final)             # stale bytes removed
+    assert led.counts()[PENDING] == 1
+
+
+def test_restarting_host_readmits_its_own_leases(tmp_path):
+    led = _ledger(tmp_path)
+    led.join("a", now=0.0)
+    led.ensure_shards(make_dm_shards(2, 1))
+    stale = led.lease("a", ttl=3600.0, now=0.0)  # then "a" dies
+    assert led.readmit_owned("a") == [stale.shard_id]
+    assert led.epoch == 1                        # fenced
+    with pytest.raises(StaleEpochError):
+        led.complete(stale, "a",
+                     {str(tmp_path / "y.dat"):
+                      _write(str(tmp_path / "sy"))})
+
+
+def test_ledger_events_reach_flight_recorder(tmp_path):
+    from presto_tpu.obs import ObsConfig, Observability
+    obs = Observability(ObsConfig(enabled=True))
+    led = _ledger(tmp_path, obs=obs)
+    led.join("a", now=0.0)
+    led.heartbeat("a", 0, now=0.0)
+    led.ensure_shards(make_dm_shards(2, 1))
+    lease = led.lease("a", ttl=60.0, now=0.0)
+    led.complete(lease, "a", {}, now=1.0)
+    led.reap(heartbeat_ttl=0.5, now=100.0)       # a dies -> bump
+    kinds = {r["kind"] for r in obs.flightrec.records()}
+    assert {"shard-lease", "shard-done", "host-dead",
+            "epoch-bump"} <= kinds
+
+
+# ----------------------------------------------------------------------
+# the elastic loop (in-process, no jax compute)
+# ----------------------------------------------------------------------
+
+def _loop_cfg(**kw):
+    from presto_tpu.parallel.elastic import ElasticConfig
+    base = dict(barrier_timeout=2.0, lease_ttl=5.0,
+                heartbeat_interval=0.1, idle_poll=0.02)
+    base.update(kw)
+    return ElasticConfig(**base)
+
+
+def _touch_compute(workdir, host, tag="h"):
+    """compute_fn writing one staged artifact per shard row."""
+    from presto_tpu.parallel import elastic
+
+    def compute(lease):
+        staged = {}
+        for i in range(*lease.rows):
+            final = os.path.join(workdir, "row%03d.dat" % i)
+            tmp = elastic.stage_path(final, host, lease.epoch)
+            with open(tmp, "wb") as f:
+                f.write(b"row %03d" % i)
+            staged[final] = tmp
+        return staged
+    return compute
+
+
+def test_elastic_loop_completes_all_shards(tmp_path):
+    from presto_tpu.parallel.elastic import ElasticCluster
+    work = str(tmp_path)
+    c = ElasticCluster(work, "h0", _loop_cfg())
+    c.join()
+    try:
+        n = c.run(make_dm_shards(5, 2), _touch_compute(work, "h0"))
+    finally:
+        c.close()
+    assert n == 3 and c.ledger.all_done()
+    assert sorted(os.path.basename(p)
+                  for p in glob.glob(os.path.join(work, "row*.dat"))) \
+        == ["row%03d.dat" % i for i in range(5)]
+
+
+def test_elastic_loop_kill_and_resume(tmp_path):
+    """SimulatedCrash at a shard kill point, then a fresh incarnation
+    of the same host resumes: its dead lease is re-admitted at join
+    and every shard completes — the single-host kill/resume story at
+    shard granularity."""
+    from presto_tpu.parallel.elastic import ElasticCluster
+    work = str(tmp_path)
+    fi = chaos.FaultInjector(kill_at="shard-computed", kill_after=2)
+    c = ElasticCluster(work, "h0", _loop_cfg(), fault_injector=fi)
+    c.join()
+    with pytest.raises(chaos.SimulatedCrash):
+        c.run(make_dm_shards(6, 2), _touch_compute(work, "h0"))
+    c.close()
+    assert not c.ledger.all_done()
+    # restart: the crashed incarnation's lease is fenced + re-admitted
+    c2 = ElasticCluster(work, "h0", _loop_cfg())
+    c2.join()
+    try:
+        c2.run(make_dm_shards(6, 2), _touch_compute(work, "h0"))
+    finally:
+        c2.close()
+    assert c2.ledger.all_done()
+    assert len(glob.glob(os.path.join(work, "row*.dat"))) == 6
+    # no staged residue under any name
+    assert not glob.glob(os.path.join(work, ".shard-stage.*"))
+
+
+def test_elastic_loop_takes_over_expired_peer_lease(tmp_path):
+    """A peer that leased a shard and went silent: the running host's
+    reap re-admits it (dead-host detection) and the survivor finishes
+    the whole run."""
+    from presto_tpu.parallel.elastic import ElasticCluster
+    work = str(tmp_path)
+    led = ShardLedger(work)
+    led.join("ghost", now=0.0)                  # never heartbeats
+    led.ensure_shards(make_dm_shards(4, 2))
+    led.lease("ghost", ttl=3600.0, now=0.0)
+    c = ElasticCluster(work, "h1",
+                       _loop_cfg(heartbeat_timeout=0.2))
+    c.join()
+    try:
+        n = c.run(make_dm_shards(4, 2), _touch_compute(work, "h1"))
+    finally:
+        c.close()
+    assert n == 2 and c.ledger.all_done()
+    assert c.ledger.epoch >= 1                  # the bump happened
+    state = c.ledger.read()
+    assert state["hosts"]["ghost"]["alive"] is False
+
+
+def test_run_to_completion_drives_elastic_kills(tmp_path):
+    """chaos.run_to_completion composes with the elastic loop (and
+    its exhaustion error now names the last kill point — the
+    satellite fix)."""
+    from presto_tpu.parallel.elastic import ElasticCluster
+    work = str(tmp_path)
+    fi = chaos.FaultInjector(kill_at="pre-shard-commit",
+                             kill_after=1)
+
+    def attempt():
+        c = ElasticCluster(work, "h0", _loop_cfg(),
+                           fault_injector=fi)
+        c.join()
+        try:
+            return c.run(make_dm_shards(3, 1),
+                         _touch_compute(work, "h0"))
+        finally:
+            c.close()
+
+    chaos.run_to_completion(attempt)
+    assert ShardLedger(work).all_done()
+
+
+# ----------------------------------------------------------------------
+# chaos satellite fixes
+# ----------------------------------------------------------------------
+
+def test_run_to_completion_reports_last_kill_point():
+    fi = chaos.FaultInjector(kill_at="spot", kill_after=1)
+
+    def always_dies():
+        fi.fired = None                  # re-arm every attempt
+        fi.point("spot-7")
+
+    with pytest.raises(RuntimeError, match=r"spot-7") as ei:
+        chaos.run_to_completion(always_dies, max_crashes=3)
+    assert isinstance(ei.value.__cause__, chaos.SimulatedCrash)
+
+
+def test_fault_injector_kill_after_n_alias():
+    fi = chaos.FaultInjector(kill_at="b", kill_after_n=3)
+    fi.point("b1")
+    fi.point("b2")
+    with pytest.raises(chaos.SimulatedCrash):
+        fi.point("b3")
+    assert fi.fired == "b3"
+
+
+def test_fault_injector_stall_mode_continues():
+    fi = chaos.FaultInjector(kill_at="x", mode="stall",
+                             stall_seconds=0.01)
+    fi.point("x-pt")                     # stalls briefly, no raise
+    assert fi.fired == "x-pt"
+    fi.point("x-pt")                     # fired once: no-op after
+
+
+def test_injector_from_env(monkeypatch):
+    from presto_tpu.parallel import elastic
+    monkeypatch.setenv(elastic.KILL_ENV, "shard-computed:2:raise")
+    fi = elastic._injector_from_env()
+    assert (fi.kill_at, fi.kill_after, fi.mode) == \
+        ("shard-computed", 2, "raise")
+    monkeypatch.setenv(elastic.KILL_ENV, "shard-leased")
+    fi = elastic._injector_from_env()
+    assert (fi.kill_at, fi.kill_after, fi.mode) == \
+        ("shard-leased", 1, "exit")
+    monkeypatch.delenv(elastic.KILL_ENV)
+    assert elastic._injector_from_env() is None
+
+
+# ----------------------------------------------------------------------
+# elastic prepsubband + CLI -resume (real compute: one tiny obs)
+# ----------------------------------------------------------------------
+
+N, NCHAN, DT = 1 << 12, 8, 5e-4
+
+
+@pytest.fixture(scope="module")
+def tiny_fil(tmp_path_factory):
+    from presto_tpu.models.synth import FakeSignal, \
+        fake_filterbank_file
+    d = tmp_path_factory.mktemp("elobs")
+    raw = str(d / "m.fil")
+    sig = FakeSignal(f=5.0, dm=30.0, shape="gauss", width=0.1,
+                     amp=1.0)
+    fake_filterbank_file(raw, N, DT, NCHAN, 400.0, 1.5, sig,
+                         noise_sigma=2.0, nbits=8)
+    return raw
+
+
+def _psb(outbase, raw, *extra):
+    from presto_tpu.apps import prepsubband as app
+    return app.run(app.build_parser().parse_args(
+        ["-o", outbase, "-lodm", "10", "-dmstep", "2", "-numdms", "4",
+         "-nsub", "8", "-nobary"] + list(extra) + [raw]))
+
+
+def _dat_bytes(d):
+    return {os.path.basename(p): open(p, "rb").read()
+            for p in sorted(glob.glob(os.path.join(d, "*_DM*.dat")))}
+
+
+@pytest.fixture(scope="module")
+def psb_reference(tiny_fil, tmp_path_factory):
+    ref = str(tmp_path_factory.mktemp("psbref"))
+    _psb(os.path.join(ref, "x"), tiny_fil)
+    arts = _dat_bytes(ref)
+    assert len(arts) == 4
+    return arts
+
+
+def test_elastic_prepsubband_byte_equal(tiny_fil, psb_reference,
+                                        tmp_path):
+    work = str(tmp_path)
+    _psb(os.path.join(work, "x"), tiny_fil, "-elastic",
+         "-shard-rows", "2", "-heartbeat-interval", "0.2")
+    assert _dat_bytes(work) == psb_reference
+    led = json.load(open(os.path.join(work, "shards.json")))
+    assert all(s["state"] == "done"
+               for s in led["shards"].values())
+
+
+def test_elastic_prepsubband_kill_resume_byte_equal(tiny_fil,
+                                                    psb_reference,
+                                                    tmp_path):
+    """Killed mid-shard (SimulatedCrash), re-run: recovered output is
+    byte-equal to a never-failed run — the tentpole invariant, single
+    host."""
+    from presto_tpu.parallel import elastic
+    work = str(tmp_path)
+    fi = chaos.FaultInjector(kill_at="shard-computed", kill_after=1)
+    elastic.set_process_injector(fi)
+    try:
+        with pytest.raises(chaos.SimulatedCrash):
+            _psb(os.path.join(work, "x"), tiny_fil, "-elastic",
+                 "-shard-rows", "1", "-heartbeat-interval", "0.2")
+    finally:
+        elastic.set_process_injector(None)
+    assert fi.fired == "shard-computed"
+    done_before = _dat_bytes(work)
+    assert len(done_before) < 4                # the kill cost us rows
+    _psb(os.path.join(work, "x"), tiny_fil, "-elastic",
+         "-shard-rows", "1", "-heartbeat-interval", "0.2")
+    assert _dat_bytes(work) == psb_reference
+    led = json.load(open(os.path.join(work, "shards.json")))
+    assert led["epoch"] >= 1                   # restart fenced epoch
+
+
+def test_prepsubband_cli_resume_verifies_not_trusts(tiny_fil,
+                                                    psb_reference,
+                                                    tmp_path):
+    work = str(tmp_path)
+    out = os.path.join(work, "x")
+    _psb(out, tiny_fil, "-resume")
+    dats = sorted(glob.glob(os.path.join(work, "*_DM*.dat")))
+    assert len(dats) == 4
+    assert os.path.exists(os.path.join(work, "manifest.json"))
+    # second -resume run verifies + skips: bytes untouched
+    mtimes = {p: os.path.getmtime(p) for p in dats}
+    _psb(out, tiny_fil, "-resume")
+    assert {p: os.path.getmtime(p) for p in dats} == mtimes
+    # corrupt one output: -resume must redo, not trust existence
+    chaos.truncate_file(dats[1], keep_frac=0.5)
+    _psb(out, tiny_fil, "-resume")
+    assert _dat_bytes(work) == psb_reference
+
+
+@pytest.mark.chaos
+def test_survey_elastic_stage_kill_resume(tiny_fil, tmp_path):
+    """SurveyConfig.elastic routes the prepsubband stage through the
+    shard ledger: a kill mid-shard resumes to artifacts byte-equal to
+    a plain (non-elastic) survey of the same observation."""
+    from presto_tpu.parallel.elastic import ElasticConfig
+    from presto_tpu.pipeline.survey import SurveyConfig, run_survey
+
+    def _cfg(**kw):
+        return SurveyConfig(lodm=8.0, hidm=12.0, nsub=8, zmax=0,
+                            numharm=2, sigma=3.0, fold_top=0,
+                            rfi_time=0.4, singlepulse=False, **kw)
+
+    def _arts(d):
+        keep = (".dat", ".fft", ".cand", ".txt")
+        return {os.path.basename(p): open(p, "rb").read()
+                for p in sorted(glob.glob(os.path.join(d, "*")))
+                if p.endswith(keep) or "_ACCEL_" in p}
+
+    ref = str(tmp_path / "ref")
+    run_survey([tiny_fil], _cfg(), workdir=ref)
+    el = ElasticConfig(shard_rows=1, heartbeat_interval=0.2,
+                       lease_ttl=30.0)
+    work = str(tmp_path / "el")
+    fi = chaos.FaultInjector(kill_at="shard-computed", kill_after=2)
+    with pytest.raises(chaos.SimulatedCrash):
+        run_survey([tiny_fil], _cfg(elastic=el, fault_injector=fi),
+                   workdir=work)
+    assert fi.fired == "shard-computed"
+    run_survey([tiny_fil], _cfg(elastic=el), workdir=work)
+    assert _arts(work) == _arts(ref)
+    assert os.path.exists(os.path.join(work, "shards.json"))
+
+
+def test_prepdata_cli_resume(tiny_fil, tmp_path):
+    from presto_tpu.apps import prepdata as app
+    work = str(tmp_path)
+    out = os.path.join(work, "pd")
+
+    def run_resume():
+        app.run(app.build_parser().parse_args(
+            ["-o", out, "-dm", "12.0", "-nobary", "-resume",
+             tiny_fil]))
+
+    run_resume()
+    dat = out + ".dat"
+    ref = open(dat, "rb").read()
+    assert os.path.exists(os.path.join(work, "manifest.json"))
+    m0 = os.path.getmtime(dat)
+    run_resume()                               # verified: skipped
+    assert os.path.getmtime(dat) == m0
+    chaos.bitflip_file(dat, nflips=2, seed=3)  # rotted: redone
+    run_resume()
+    assert open(dat, "rb").read() == ref

@@ -1,0 +1,257 @@
+#!/usr/bin/env python
+"""multihost_chaos: randomized worker-kill/stall schedules over a real
+2-process elastic `prepsubband -coordinator` cluster (ISSUE 4 CI
+tool — the multi-host analog of tools/chaos_survey.py).
+
+Each trial draws (seeded, reproducible) a victim process, an elastic
+kill point (obs/taxonomy.CLUSTER_KILL_POINTS), a hit count, and a
+failure mode — `exit` (preemption: os._exit mid-run) or `stall` (a
+member wedged at a point, the stuck-collective case).  Two real
+jax.distributed processes run the elastic DM fan-out against one
+shard ledger; the victim dies or wedges, the survivor reaps it (missed
+heartbeat / expired lease), bumps the epoch, re-admits the lost DM
+shards, and must finish **all** DM rows with bytes equal to an
+unsharded, never-failed single-process reference — within a wall-time
+deadline, so a stalled collective can never exceed the configured
+barrier timeout unnoticed.
+
+Usage:
+    python tools/multihost_chaos.py [--trials 3] [--seed 0] [--fast]
+        [--nspec 8192] [--numdms 8] [--keep] [--workdir DIR]
+
+`--fast` is the tier-1-safe path (virtual CPU devices, 2 processes,
+1 trial, small N) used by tests/test_multihost_chaos.py.  Writes
+MULTIHOST_CHAOS.json; exit status 0 iff every trial converged.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import random
+import shutil
+import subprocess
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+NPROC = 2
+#: points a victim can be scheduled at (post-epoch-bump excluded: the
+#: victim may never observe a bump, so the schedule could no-op)
+VICTIM_POINTS = ["shard-leased", "shard-computed", "pre-shard-commit",
+                 "post-shard-commit"]
+
+SYNTH = r"""
+import os, sys
+sys.path.insert(0, %(repo)r)
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+jax.config.update("jax_platforms", "cpu")
+from presto_tpu.models.synth import FakeSignal, fake_filterbank_file
+sig = FakeSignal(f=5.0, dm=30.0, shape="gauss", width=0.1, amp=1.0)
+fake_filterbank_file(%(raw)r, %(nspec)d, 5e-4, %(nchan)d, 400.0, 1.5,
+                     sig, noise_sigma=2.0, nbits=8)
+"""
+
+REF = r"""
+import os, sys
+sys.path.insert(0, %(repo)r)
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["PRESTO_TPU_DISABLE_MESH"] = "1"
+import jax
+jax.config.update("jax_platforms", "cpu")
+from presto_tpu.apps import prepsubband as app
+app.run(app.build_parser().parse_args(
+    ["-o", %(out)r, "-lodm", "10", "-dmstep", "2",
+     "-numdms", "%(numdms)d", "-nsub", "%(nsub)d", "-nobary",
+     %(raw)r]))
+"""
+
+CHILD = r"""
+import os, sys
+sys.path.insert(0, %(repo)r)
+pid = int(sys.argv[1])
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+import jax
+jax.config.update("jax_platforms", "cpu")
+from presto_tpu.apps import prepsubband as app
+app.run(app.build_parser().parse_args(
+    ["-coordinator", %(coord)r, "-nproc", "%(nproc)d",
+     "-procid", str(pid), "-elastic",
+     "-shard-rows", "%(shard_rows)d", "-lease-ttl", "%(ttl)g",
+     "-heartbeat-interval", "0.2", "-barrier-timeout", "%(bto)g",
+     "-o", %(out)r, "-lodm", "10", "-dmstep", "2",
+     "-numdms", "%(numdms)d", "-nsub", "%(nsub)d", "-nobary",
+     %(raw)r]))
+"""
+
+
+def _env():
+    env = dict(os.environ)
+    env.pop("PYTHONPATH", None)
+    env.pop("PRESTO_TPU_ELASTIC_KILL", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    return env
+
+
+def _run_py(code, env, timeout):
+    return subprocess.run([sys.executable, "-c", code], env=env,
+                          capture_output=True, text=True,
+                          timeout=timeout, cwd=REPO)
+
+
+def run_trial(trial, rng, raw, root, numdms, nsub, shard_rows, ttl,
+              bto, deadline):
+    """One randomized worker-loss trial; returns a result dict with
+    ok/byte_identical/epoch/mode/point."""
+    work = os.path.join(root, "trial%02d" % trial)
+    os.makedirs(work, exist_ok=True)
+    victim = rng.randrange(NPROC)
+    point = rng.choice(VICTIM_POINTS)
+    nth = rng.randrange(1, 3)
+    mode = rng.choice(["exit", "exit", "stall"])   # exit-heavy mix
+    coord = "localhost:%d" % (12820 + (trial * 7) % 400)
+    out = {"victim": victim, "point": point, "nth": nth, "mode": mode,
+           "ok": False}
+    code = CHILD % dict(repo=REPO, coord=coord, nproc=NPROC,
+                        shard_rows=shard_rows, ttl=ttl, bto=bto,
+                        out=os.path.join(work, "mh"), numdms=numdms,
+                        nsub=nsub, raw=raw)
+    procs = []
+    t0 = time.time()
+    for pid in range(NPROC):
+        env = _env()
+        if pid == victim:
+            env["PRESTO_TPU_ELASTIC_KILL"] = "%s:%d:%s" % (point, nth,
+                                                           mode)
+        procs.append(subprocess.Popen(
+            [sys.executable, "-c", code, str(pid)],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            text=True, env=env, cwd=REPO))
+    survivor = procs[1 - victim]
+    try:
+        s_out, s_err = survivor.communicate(timeout=deadline)
+    except subprocess.TimeoutExpired:
+        for p in procs:
+            p.kill()
+        out["stage"] = "survivor-deadline (stalled collective?)"
+        return out
+    out["survivor_seconds"] = round(time.time() - t0, 1)
+    # the victim is either dead (exit) or wedged in its stall: never
+    # wait on it past the survivor
+    try:
+        procs[victim].communicate(timeout=1.0 if mode == "exit"
+                                  else 0.1)
+    except subprocess.TimeoutExpired:
+        procs[victim].kill()
+        procs[victim].communicate()
+    out["victim_rc"] = procs[victim].returncode
+    if survivor.returncode != 0:
+        out["stage"] = "survivor-failed"
+        out["stderr"] = s_err[-1200:]
+        return out
+    refs = sorted(glob.glob(os.path.join(root, "ref", "ref_DM*.dat")))
+    mhs = sorted(glob.glob(os.path.join(work, "mh_DM*.dat")))
+    out["ref_files"], out["mh_files"] = len(refs), len(mhs)
+    same = (len(refs) == len(mhs) == numdms and all(
+        open(a, "rb").read() == open(b, "rb").read()
+        for a, b in zip(refs, mhs)))
+    out["byte_identical"] = bool(same)
+    try:
+        with open(os.path.join(work, "shards.json")) as f:
+            led = json.load(f)
+        out["epoch"] = led.get("epoch")
+        out["redos"] = sum(int(sh.get("redos", 0))
+                           for sh in led.get("shards", {}).values())
+    except (OSError, ValueError):
+        pass
+    out["ok"] = bool(same)
+    return out
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="multihost_chaos",
+        description="randomized worker-kill schedules over a real "
+                    "2-process elastic prepsubband cluster")
+    p.add_argument("--trials", type=int, default=3)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--fast", action="store_true",
+                   help="tier-1-safe path: 1 trial, small N")
+    p.add_argument("--nspec", type=int, default=1 << 13)
+    p.add_argument("--nchan", type=int, default=16)
+    p.add_argument("--numdms", type=int, default=8)
+    p.add_argument("--workdir", type=str, default=None)
+    p.add_argument("--keep", action="store_true")
+    p.add_argument("--json-out", type=str,
+                   default=os.path.join(REPO, "MULTIHOST_CHAOS.json"))
+    args = p.parse_args(argv)
+    if args.fast:
+        args.trials = min(args.trials, 1)
+        args.nspec = min(args.nspec, 1 << 12)
+        args.nchan = min(args.nchan, 8)
+
+    root = args.workdir or tempfile.mkdtemp(prefix="mh_chaos_")
+    os.makedirs(root, exist_ok=True)
+    rng = random.Random(args.seed)
+    raw = os.path.join(root, "m.fil")
+    nsub = min(16, args.nchan)
+    shard_rows = max(1, args.numdms // 4)
+    ttl, bto = 10.0, 8.0
+    deadline = 420.0
+    print("multihost_chaos: scratch=%s seed=%d trials=%d numdms=%d"
+          % (root, args.seed, args.trials, args.numdms))
+
+    env = _env()
+    r = _run_py(SYNTH % dict(repo=REPO, raw=raw, nspec=args.nspec,
+                             nchan=args.nchan), env, 300)
+    if r.returncode != 0:
+        print("synth failed:\n" + r.stderr[-1200:])
+        return 1
+    refdir = os.path.join(root, "ref")
+    os.makedirs(refdir, exist_ok=True)
+    r = _run_py(REF % dict(repo=REPO, out=os.path.join(refdir, "ref"),
+                           numdms=args.numdms, nsub=nsub, raw=raw),
+                env, 600)
+    if r.returncode != 0:
+        print("reference failed:\n" + r.stderr[-1200:])
+        return 1
+    print("reference: %d unsharded .dat files"
+          % len(glob.glob(os.path.join(refdir, "ref_DM*.dat"))))
+
+    results = []
+    failures = 0
+    for trial in range(args.trials):
+        res = run_trial(trial, rng, raw, root, args.numdms, nsub,
+                        shard_rows, ttl, bto, deadline)
+        results.append(res)
+        print("trial %02d [victim=proc%d %s@%s#%d]: %s%s"
+              % (trial, res["victim"], res["mode"], res["point"],
+                 res["nth"], "PASS" if res["ok"] else "FAIL",
+                 "" if res["ok"] else " " + str(res.get("stage",
+                                                res.get("stderr",
+                                                        "")))[:300]))
+        if not res["ok"]:
+            failures += 1
+    art = {"nproc": NPROC, "trials": args.trials, "seed": args.seed,
+           "numdms": args.numdms, "nspec": args.nspec,
+           "lease_ttl": ttl, "barrier_timeout": bto,
+           "results": results, "ok": failures == 0}
+    with open(args.json_out, "w") as f:
+        json.dump(art, f, indent=1)
+    if not args.keep and args.workdir is None:
+        shutil.rmtree(root, ignore_errors=True)
+    print("multihost_chaos: %d/%d trials passed -> %s"
+          % (args.trials - failures, args.trials, args.json_out))
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

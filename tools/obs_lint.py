@@ -11,6 +11,10 @@ code path cannot ship silently:
   2. every `_chaos(cfg, "<point>", ...)` kill point is a registered
      KILL_POINT (=> it is flight-recorded before it can fire) — and
      conversely every registered point still exists in the source;
+  2b. every elastic-cluster kill point (`self._point("...")` in
+     parallel/elastic.py) and event (`.event("...")`/`._event("...")`
+     in parallel/elastic.py + pipeline/shardledger.py) is registered
+     in CLUSTER_KILL_POINTS / CLUSTER_EVENTS — and conversely;
   3. every `events.emit("<kind>", ...)` in presto_tpu/serve/ is a
      registered SERVE_EVENT;
   4. every job lifecycle state (JobStatus constants in serve/queue.py)
@@ -38,6 +42,8 @@ if REPO not in sys.path:                  # direct `python tools/...`
 STAGE_RE = re.compile(r'timer\.mark\(\s*"([^"]+)"\s*\)')
 CHAOS_RE = re.compile(r'_chaos\(\s*cfg\s*,\s*"([^"]+)"')
 EMIT_RE = re.compile(r'events\.emit\(\s*"([^"]+)"')
+POINT_RE = re.compile(r'\._point\(\s*\n?\s*"([^"]+)"')
+CLUSTER_EVENT_RE = re.compile(r'\._?event\(\s*\n?\s*"([^"]+)"')
 STATUS_RE = re.compile(r'^\s+([A-Z_]+)\s*=\s*"([a-z-]+)"\s*$',
                        re.MULTILINE)
 METRIC_RE = re.compile(
@@ -91,6 +97,38 @@ def lint() -> List[str]:
         problems.append(
             "obs/taxonomy.py: KILL_POINTS lists %r but "
             "pipeline/survey.py never fires it" % p)
+
+    # 2b. elastic-cluster kill points and events (parallel/elastic.py
+    # + pipeline/shardledger.py are the worker-loss recovery layer;
+    # its kill points and flight-recorder events are a registered
+    # vocabulary exactly like the survey's)
+    elastic_files = ("presto_tpu/parallel/elastic.py",
+                     "presto_tpu/pipeline/shardledger.py")
+    cpoints: Set[str] = set()
+    cevents: Set[str] = set()
+    for rel in elastic_files:
+        try:
+            src = _read(rel)
+        except OSError:
+            continue
+        cpoints |= set(POINT_RE.findall(src))
+        cevents |= set(CLUSTER_EVENT_RE.findall(src))
+    for p in sorted(cpoints - taxonomy.CLUSTER_KILL_POINTS):
+        problems.append(
+            "parallel/elastic.py: kill point %r is not registered in "
+            "obs/taxonomy.CLUSTER_KILL_POINTS" % p)
+    for p in sorted(taxonomy.CLUSTER_KILL_POINTS - cpoints):
+        problems.append(
+            "obs/taxonomy.py: CLUSTER_KILL_POINTS lists %r but the "
+            "elastic layer never fires it" % p)
+    for k in sorted(cevents - taxonomy.CLUSTER_EVENTS):
+        problems.append(
+            "elastic layer: event kind %r is not registered in "
+            "obs/taxonomy.CLUSTER_EVENTS" % k)
+    for k in sorted(taxonomy.CLUSTER_EVENTS - cevents):
+        problems.append(
+            "obs/taxonomy.py: CLUSTER_EVENTS lists %r but the "
+            "elastic layer never emits it" % k)
 
     # 3. serve event kinds
     serve_srcs = _tree_sources("presto_tpu/serve")
