@@ -82,6 +82,32 @@ def load_cpu_baseline():
 ACCEL_T = 1000.0
 
 
+def tuning_info():
+    """Tuning attribution for this bench run: the device fingerprint,
+    whether lookups are active, what the tuning DB holds for this
+    device, and (after the benches ran) which lookups actually hit.
+    BENCH_r*.json trajectories are only comparable when this block
+    matches — a tuned and an untuned run of the same chip are
+    different configurations."""
+    from presto_tpu import tune
+    info = {"enabled": tune.enabled(),
+            "db_path": tune.default_db_path(),
+            "fingerprint": tune.fingerprint_key(),
+            "db_present": os.path.exists(tune.default_db_path()),
+            "db_configs": {}, "lookups": {}}
+    if info["db_present"]:
+        db = tune.TuneDB.load(info["db_path"])
+        if db.load_error is not None:
+            info["db_load_error"] = db.load_error
+        else:
+            info["db_configs"] = {
+                fam: {skey: rec.get("config")
+                      for skey, rec in sorted(shapes.items())}
+                for fam, shapes in sorted(
+                    db.families(info["fingerprint"]).items())}
+    return info
+
+
 def make_accel_input():
     """The exact accel-bench spectrum BOTH bench scripts must search
     (part of the workload contract, like WORKLOAD): noise + a few
@@ -477,6 +503,10 @@ def main():
                      "floor alone is ~0.12 s); the amortized fan-out "
                      "regime is the dedisp row (config 2)")}
 
+    from presto_tpu import tune
+    tune_attr = tuning_info()
+    tune_attr["lookups"] = tune.provenance()
+
     print(json.dumps({
         "metric": "ffdot_cells_per_sec_zmax200_nh8",
         "value": round(cells_per_sec, 1),
@@ -494,6 +524,10 @@ def main():
         "dm_trials_per_sec": round(dm_per_sec, 1),
         "dm_trials_vs_baseline": round(dm_per_sec / cpu_dmtrials, 2),
         "cpu_baseline_measured": cpu_meta is not None,
+        # config attribution: fingerprint + tuned configs (the
+        # lookups dict is filled only when PRESTO_TPU_TUNE=1 was live
+        # during the benches above)
+        "tuning": tune_attr,
         **extra,
     }))
     print("# device=%s accel: warmup=%.1fs steady=%.2fs "

@@ -108,6 +108,29 @@ def collect(workdir: str) -> dict:
                 "last_kill_point": last_point,
             })
 
+    tuned = _load_json(os.path.join(workdir, "tuned.json"))
+    if tuned:
+        lookups = tuned.get("lookups", {}) or {}
+        fams = {}
+        for family, shapes in sorted(lookups.items()):
+            hits = sum(1 for v in shapes.values()
+                       if v.get("source") == "db")
+            fams[family] = {
+                "shapes": len(shapes),
+                "db_hits": hits,
+                "defaults": len(shapes) - hits,
+                "configs": {k: v.get("config")
+                            for k, v in sorted(shapes.items())
+                            if v.get("source") == "db"},
+            }
+        info["tuning"] = {
+            "fingerprint": tuned.get("fingerprint", "?"),
+            "db_path": tuned.get("db_path", "?"),
+            "db_load_error": tuned.get("db_load_error"),
+            "stats": tuned.get("stats", {}),
+            "families": fams,
+        }
+
     quality = sorted(glob.glob(os.path.join(workdir,
                                             "*_quality.json")))
     if quality:
@@ -174,6 +197,24 @@ def render(info: dict, max_spans: int = 15, file=None) -> None:
         if fr["open_spans"]:
             w("  open spans at death: %s"
               % " > ".join(fr["open_spans"]))
+
+    tuning = info.get("tuning")
+    if tuning:
+        w()
+        w("Tuning provenance (tuned.json): db=%s"
+          % tuning["db_path"])
+        w("  fingerprint: %s" % tuning["fingerprint"])
+        if tuning.get("db_load_error"):
+            w("  !! DB unusable (%s) — every lookup fell back to "
+              "defaults" % tuning["db_load_error"])
+        st = tuning.get("stats", {})
+        w("  lookups: %d hit the DB, %d fell back to defaults"
+          % (st.get("hits", 0), st.get("misses", 0)))
+        for family, f in sorted(tuning.get("families", {}).items()):
+            w("  %-20s %d shape(s): %d tuned, %d default"
+              % (family, f["shapes"], f["db_hits"], f["defaults"]))
+            for skey, config in sorted(f.get("configs", {}).items()):
+                w("      %-24s %s" % (skey, config))
 
     for q in info.get("quality", []):
         w()

@@ -108,6 +108,15 @@ JOB_STATE_EVENTS = {
     "timeout": "fail",
 }
 
+#: tuning-layer span names — every `obs.span("tune:...")` in
+#: presto_tpu/tune/ + apps/tune.py (the linter enforces both
+#: directions, like the kill points)
+TUNE_SPANS = frozenset({
+    "tune:family",
+    "tune:sweep",
+    "tune:candidate",
+})
+
 #: registered metric names (Prometheus side of the contract); the
 #: linter checks every registry.counter/gauge/histogram call in the
 #: tree registers a name listed here.
@@ -156,4 +165,14 @@ METRICS = frozenset({
     "cluster_barrier_timeouts_total",
     "cluster_stale_writes_total",
     "cluster_heartbeats_total",
+    # kernel autotuning (presto_tpu/tune); every tune_* name here must
+    # be registered by the tune layer (obs_lint check 6)
+    "tune_db_hits_total",
+    "tune_db_misses_total",
+    "tune_db_load_errors_total",
+    "tune_db_entries",
+    "tune_candidates_total",
+    "tune_candidates_pruned_total",
+    "tune_candidates_quarantined_total",
+    "tune_sweep_seconds",
 })

@@ -180,7 +180,8 @@ def float_dedisp_block(lastdata, data, delays, approx_mean=0.0):
     return _accum_shifted_rows(x2, delays, numpts) - approx_mean
 
 
-def float_dedisp_many_block(lastdata, data, delays_dm, approx_mean=0.0):
+def float_dedisp_many_block(lastdata, data, delays_dm, approx_mean=0.0,
+                            batch_limit=None):
     """float_dedisp over many DM trials at once.
 
     lastdata, data: [nsub, numpts]; delays_dm: [numdms, nsub] int32.
@@ -200,20 +201,30 @@ def float_dedisp_many_block(lastdata, data, delays_dm, approx_mean=0.0):
     NOT jitted itself: the dispatch must see the host array.  Callers
     may close over it inside their own jit — with np delays the
     static path's constants embed in the enclosing trace.  Plans past
-    _STATIC_SLICE_LIMIT total slices run the SAME static path in DM
+    the batch bound total slices run the SAME static path in DM
     batches (one compiled program per batch, outputs concatenated) so
     the unrolled HLO stays bounded while throughput keeps the fused
     full-width passes; only traced (device-array) delays use the vmap
     path.
+
+    `batch_limit` overrides the unroll bound (numdms*nsub slices per
+    compiled batch).  None resolves it: the tuning DB's
+    `dedisp_dm_batch` entry for this subband count when tuning is
+    active (presto_tpu/tune), else _STATIC_SLICE_LIMIT.  The bound
+    only partitions the DM axis — each row's subband-ascending sum is
+    identical in any partition, so tuned and untuned outputs are
+    byte-equal.
     """
     if isinstance(delays_dm, np.ndarray):
-        if delays_dm.size <= _STATIC_SLICE_LIMIT:
+        limit = (_resolve_batch_limit(delays_dm.shape[1])
+                 if batch_limit is None else max(int(batch_limit), 1))
+        if delays_dm.size <= limit:
             return _static_fn_for(delays_dm)(lastdata, data,
                                              float(approx_mean))
         # bigger plans (the 512-DM x 64-sub per-device target-scale
         # share) stay on the fast path in DM batches: each batch is
         # its own compiled program, outputs concatenate
-        per = max(1, _STATIC_SLICE_LIMIT // delays_dm.shape[1])
+        per = max(1, limit // delays_dm.shape[1])
         outs = [_static_fn_for(delays_dm[i:i + per])(
                     lastdata, data, float(approx_mean))
                 for i in range(0, delays_dm.shape[0], per)]
@@ -224,6 +235,23 @@ def float_dedisp_many_block(lastdata, data, delays_dm, approx_mean=0.0):
 
 _STATIC_SLICE_LIMIT = 16384   # numdms*nsub unroll bound
 _static_fns: dict = {}        # delay-plan bytes -> compiled closure
+
+
+def _resolve_batch_limit(nsub: int) -> int:
+    """The DM-batch unroll bound for an nsub-subband plan: a measured
+    tuning-DB value when tuning is active (clamped to >= nsub so a
+    batch always holds at least one DM row), else the built-in
+    default.  One branch when tuning is disabled."""
+    from presto_tpu import tune
+    if not tune.enabled():
+        return _STATIC_SLICE_LIMIT
+    cfg = tune.best("dedisp_dm_batch", tune.key_dedisp_batch(nsub))
+    if cfg:
+        try:
+            return max(int(cfg.get("limit", 0)), int(nsub), 1)
+        except (TypeError, ValueError):
+            pass
+    return _STATIC_SLICE_LIMIT
 
 
 def _static_fn_for(delays_dm: np.ndarray):

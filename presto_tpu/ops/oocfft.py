@@ -54,6 +54,28 @@ MAXREALFFT = int(os.environ.get("PRESTO_TPU_MAXREALFFT", 10 ** 9))
 _DEF_MAX_MEM = 1 << 28          # 256 MB of block buffer by default
 
 
+def _resolve_max_mem(max_mem):
+    """The block-buffer byte budget: an explicit caller value wins;
+    None consults the tuning DB's `oocfft_block` entry when tuning is
+    active (presto_tpu/tune), else the built-in default.  The block
+    size only partitions the streamed passes — every element's
+    arithmetic is identical in any partition, so tuned and untuned
+    spectra are byte-equal."""
+    if max_mem is not None:
+        return int(max_mem)
+    from presto_tpu import tune
+    if tune.enabled():
+        cfg = tune.best("oocfft_block", tune.GLOBAL_KEY)
+        if cfg:
+            try:
+                m = int(cfg.get("max_mem", 0))
+                if m >= 1 << 16:      # refuse degenerate tiny blocks
+                    return m
+            except (TypeError, ValueError):
+                pass
+    return _DEF_MAX_MEM
+
+
 def _split_n(n: int) -> tuple[int, int]:
     """Factor n = R * C with R the largest divisor <= sqrt(n)
     (pocketfft handles any factor lengths).  For prime n this
@@ -78,7 +100,7 @@ def _split_n(n: int) -> tuple[int, int]:
 
 def ooc_complex_fft(src_path: str, dst_path: str, n: int,
                     forward: bool = True,
-                    max_mem: int = _DEF_MAX_MEM,
+                    max_mem: int | None = None,
                     scratch_path: str | None = None) -> None:
     """Out-of-core complex64 FFT of an n-point file.
 
@@ -86,6 +108,7 @@ def ooc_complex_fft(src_path: str, dst_path: str, n: int,
     forward=False: normalized inverse (numpy ifft).
     src and dst may be the same path (scratch holds the intermediate).
     """
+    max_mem = _resolve_max_mem(max_mem)
     R, C = _split_n(n)
     scratch = scratch_path or (dst_path + ".scratch")
     sgn = -1.0 if forward else 1.0
@@ -184,7 +207,7 @@ def _real_fixup_inverse(path: str, nc: int, max_mem: int) -> None:
 
 
 def realfft_ooc(src_path: str, dst_path: str, forward: bool = True,
-                max_mem: int = _DEF_MAX_MEM,
+                max_mem: int | None = None,
                 tmpdir: str | None = None) -> None:
     """Out-of-core packed real FFT: .dat (float32[n]) <-> .fft
     (packed complex64[n/2]), matching fftpack.realfft_packed /
@@ -195,6 +218,7 @@ def realfft_ooc(src_path: str, dst_path: str, forward: bool = True,
     inverse: copy src -> dst, inverse-separate in place, inverse
     two-pass FFT in place; dst bytes are then the float32 series.
     """
+    max_mem = _resolve_max_mem(max_mem)
     scratch = None
     if tmpdir:
         scratch = os.path.join(

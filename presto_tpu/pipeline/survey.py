@@ -92,6 +92,13 @@ class SurveyConfig:
     # unconfigured run pays one branch per telemetry point and writes
     # no telemetry files — byte-identical to an uninstrumented run.
     obs: Optional[object] = None
+    # device-aware autotuning (presto_tpu/tune): True/False forces
+    # tuning-DB lookups on/off for this survey; None defers to
+    # PRESTO_TPU_TUNE=1.  Tuned knobs pick execution geometry (kernel
+    # tile, DM-batch bound, bucket edges) and never change output
+    # bytes; a tuned run writes <workdir>/tuned.json provenance
+    # (rendered by presto-report).
+    tune: Optional[bool] = None
 
     @property
     def all_passes(self):
@@ -192,9 +199,11 @@ def run_survey(rawfiles: Sequence[str], cfg: SurveyConfig,
         timer = StageTimer(obs=obs)
     root = obs.span("survey", workdir=workdir,
                     raw=os.path.basename(rawfiles[0]))
+    from presto_tpu import tune as _tune
     try:
-        result = _run_survey_stages(rawfiles, cfg, workdir, base, res,
-                                    timer, manifest, obs)
+        with _tune.scoped(cfg.tune):
+            result = _run_survey_stages(rawfiles, cfg, workdir, base,
+                                        res, timer, manifest, obs)
         root.finish()
         return result
     except BaseException as e:
@@ -208,6 +217,11 @@ def run_survey(rawfiles: Sequence[str], cfg: SurveyConfig,
     finally:
         timer.mark(None)
         timer.report()
+        # tuned-config provenance beside the artifacts it shaped
+        # (written even on death — a post-mortem wants to know which
+        # configs were live); no-op when tuning is disabled
+        with _tune.scoped(cfg.tune):
+            _tune.write_provenance(workdir)
         obs.flush(default_dir=workdir)
 
 

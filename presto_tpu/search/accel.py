@@ -1499,6 +1499,19 @@ class AccelSearch:
                 if (ap.pallas_available() and cfg.numharm <= 16
                     and plane_numr % ap.TILE == 0) else None
             if ptile:
+                # tuned engine choice: a measured harmonic_sum_layout
+                # entry may prefer the XLA staged scan for this
+                # geometry (candidate lists are engine-identical, so
+                # this is performance-only)
+                from presto_tpu import tune
+                if tune.enabled():
+                    lay = tune.best(
+                        "harmonic_sum_layout",
+                        tune.key_harm_layout(self.cfg.numz,
+                                             cfg.numharm))
+                    if lay and lay.get("engine") == "xla":
+                        ptile = None
+            if ptile:
                 align = max(align, ptile)
                 use_pallas = True
         except Exception:

@@ -98,19 +98,26 @@ def make_stage_reducer(numharmstages, fracs_zinds, slab: int,
     of the stage-summed powers and its z row — the kernel half of the
     staged search (thresholding/top-k are done by the caller).
 
-    Requires slab % TILE == 0, start_cols % TILE == 0, and P padded
+    Requires slab % tile == 0, start_cols % tile == 0, and P padded
     to ceil(numz/8)*8 rows (zero rows below; `pad_rows` below).
+
+    `tile` (default TILE) is threaded explicitly through the whole
+    build — module state is never consulted or mutated, so concurrent
+    plans with different tiles cannot race.
     """
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
-    TILE = tile or globals()["TILE"]
+    tile = int(tile or TILE)
+    if tile < 128 or tile % 128 or slab % tile:
+        raise ValueError("tile must be a 128-multiple dividing the "
+                         "slab (tile=%d, slab=%d)" % (tile, slab))
     terms, counts = _stage_terms(fracs_zinds)
     nterms = len(terms)
-    ntiles = slab // TILE
+    ntiles = slab // tile
     nstages = numharmstages
     numz_pad = -(-numz // 8) * 8
-    geom = [_term_geom(h, t, zi, TILE) for (h, t, zi) in terms]
+    geom = [_term_geom(h, t, zi, tile) for (h, t, zi) in terms]
 
     # bf16x3 stacked one-hot z-permutation: oh3[t] is [numz_pad,
     # 3*rows] with the same one-hot block repeated for the hi/mid/lo
@@ -133,7 +140,7 @@ def make_stage_reducer(numharmstages, fracs_zinds, slab: int,
 
         s = pl.program_id(0)
         t = pl.program_id(1)
-        j0 = start_cols_ref[s] + t * TILE
+        j0 = start_cols_ref[s] + t * tile
 
         # x2 grid-step parity banks: Mosaic pipelines grid iterations,
         # so the next step's DMAs race this step's reads unless they
@@ -143,7 +150,7 @@ def make_stage_reducer(numharmstages, fracs_zinds, slab: int,
 
         def fund_dma():
             return pltpu.make_async_copy(
-                P_ref.at[:, pl.ds(pl.multiple_of(j0, 128), TILE)],
+                P_ref.at[:, pl.ds(pl.multiple_of(j0, 128), tile)],
                 win_refs[0].at[bank], sems.at[0, bank])
 
         def term_dma(fi):
@@ -186,7 +193,7 @@ def make_stage_reducer(numharmstages, fracs_zinds, slab: int,
                 # indices (no roll)
                 sel_cols = []
                 nchunks = win // 128
-                for c2 in range(TILE // 128):
+                for c2 in range(tile // 128):
                     jj = jax.lax.broadcasted_iota(
                         jnp.int32, (rows, 128), 1) + c2 * 128
                     idx = off + (jj * harm + (htot >> 1)) // htot
@@ -197,7 +204,7 @@ def make_stage_reducer(numharmstages, fracs_zinds, slab: int,
                             jnp.clip(idx - c * 128, 0, 127), axis=1)
                         out = jnp.where(idx // 128 == c, g, out)
                     sel_cols.append(out)
-                sel = jnp.concatenate(sel_cols, axis=1)  # [rows, TILE]
+                sel = jnp.concatenate(sel_cols, axis=1)  # [rows, tile]
                 # exact bf16x3 split: hi+mid+lo == x bit-for-bit
                 hi = sel.astype(jnp.bfloat16)
                 r1 = sel - hi.astype(jnp.float32)
@@ -221,14 +228,14 @@ def make_stage_reducer(numharmstages, fracs_zinds, slab: int,
             in_specs=[pl.BlockSpec(memory_space=pl.ANY)] +   # P (HBM)
                      [pl.BlockSpec(memory_space=pltpu.VMEM)] * nterms,
             out_specs=[
-                pl.BlockSpec((1, nstages, TILE),
+                pl.BlockSpec((1, nstages, tile),
                              lambda s, t, *_: (s, 0, t)),
-                pl.BlockSpec((1, nstages, TILE),
+                pl.BlockSpec((1, nstages, tile),
                              lambda s, t, *_: (s, 0, t)),
             ],
             scratch_shapes=[
-                pltpu.VMEM((numz_pad, TILE), jnp.float32),       # acc
-                pltpu.VMEM((2, numz_pad, TILE), jnp.float32),    # fund
+                pltpu.VMEM((numz_pad, tile), jnp.float32),       # acc
+                pltpu.VMEM((2, numz_pad, tile), jnp.float32),    # fund
             ] + [
                 pltpu.VMEM((2, geom[i][0], geom[i][1]), jnp.float32)
                 for i in range(nterms)
@@ -278,12 +285,35 @@ def scratch_bytes(fracs_zinds, numz: int, tile: int = None) -> int:
 VMEM_BUDGET = 14 * 2 ** 20
 
 
+def _tile_ok(fracs_zinds, numz: int, slab: int, t: int) -> bool:
+    return (128 <= t <= slab and t % 128 == 0 and slab % t == 0
+            and scratch_bytes(fracs_zinds, numz, t) <= VMEM_BUDGET)
+
+
 def pick_tile(fracs_zinds, numz: int, slab: int):
-    """Largest tile whose scratch fits the scoped-vmem budget (None
-    when even the smallest doesn't — caller falls back to XLA)."""
+    """The column tile for this kernel geometry.
+
+    When tuning is active (SurveyConfig.tune / PRESTO_TPU_TUNE=1) a
+    measured tile from the tuning DB wins, provided it still honors
+    the alignment and scoped-VMEM contracts — a stale DB entry (new
+    kernel source changes the fingerprint, but defend anyway) can
+    degrade performance, never correctness.  Otherwise: the largest
+    default tile whose scratch fits the budget (None when even the
+    smallest doesn't — caller falls back to XLA)."""
+    from presto_tpu import tune
+    if tune.enabled():
+        numharm = 1 << len(fracs_zinds)
+        cfg = tune.best("accel_pallas_tile",
+                        tune.key_accel_tile(numz, numharm, slab))
+        if cfg:
+            try:
+                t = int(cfg.get("tile", 0))
+            except (TypeError, ValueError):
+                t = 0
+            if _tile_ok(fracs_zinds, numz, slab, t):
+                return t
     for t in (TILE, 512, 256):
-        if t <= slab and slab % t == 0 and \
-                scratch_bytes(fracs_zinds, numz, t) <= VMEM_BUDGET:
+        if _tile_ok(fracs_zinds, numz, slab, t):
             return t
     return None
 

@@ -25,6 +25,7 @@ from presto_tpu.serve.queue import (Job, JobQueue, QueueClosed,
                                     QueueFull, JobStatus)
 from presto_tpu.serve.plancache import (PlanCache, PlanKey,
                                         SearcherProvider, bucket_key,
+                                        bucket_quantize,
                                         quantize_nsamp)
 from presto_tpu.serve.scheduler import (JobTimeout, Scheduler,
                                         SchedulerConfig)
@@ -34,5 +35,6 @@ __all__ = [
     "EventLog", "Job", "JobQueue", "JobStatus", "JobTimeout",
     "PlanCache", "PlanKey", "QueueClosed", "QueueFull", "Scheduler",
     "SchedulerConfig", "SearchService", "SearcherProvider",
-    "bucket_key", "quantize_nsamp", "start_http",
+    "bucket_key", "bucket_quantize", "quantize_nsamp",
+    "start_http",
 ]

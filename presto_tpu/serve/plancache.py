@@ -44,12 +44,49 @@ class PlanKey:
     extra: Tuple = ()
 
 
+#: bucket-edge schemes: sub-pow2 mantissa steps each scheme admits.
+#: "pow2" is the classic next-power-of-two; the finer schemes add
+#: half/quarter points between octaves (fewer padded samples per job,
+#: more distinct buckets = more compiles — the trade the tuning DB's
+#: `plancache_bucket` family scores offline).
+_BUCKET_SCHEMES = {
+    "pow2": (1.0,),
+    "pow2_half": (1.0, 1.5),
+    "pow2_quarter": (1.0, 1.25, 1.5, 1.75),
+}
+
+
+def bucket_quantize(n: int, scheme: str = "pow2") -> int:
+    """Smallest bucket edge >= n under `scheme`.  Unknown schemes
+    fall back to pow2 (a tuned DB entry can degrade granularity,
+    never produce an undersized bucket)."""
+    n = max(int(n), 1)
+    steps = _BUCKET_SCHEMES.get(scheme) or _BUCKET_SCHEMES["pow2"]
+    p2 = 1 << (n - 1).bit_length()          # next pow2 >= n
+    best = p2
+    for m in steps:
+        edge = int(m * (p2 >> 1))           # edges in (p2/2, p2]
+        if edge >= n and edge < best:
+            best = edge
+    return best
+
+
 def quantize_nsamp(n: int) -> int:
-    """Pad-to-bucket sample-count quantization: next power of two.
+    """Pad-to-bucket sample-count quantization.
 
     Coarse on purpose — the goal is few buckets and many hits, not a
     tight fit; the survey's own choose_N padding happens downstream of
-    this at the actual trial length."""
+    this at the actual trial length.  Default is next power of two;
+    when tuning is active (PRESTO_TPU_TUNE=1 / presto-tune) the
+    bucket-edge scheme comes from the tuning DB's `plancache_bucket`
+    entry, with pow2 as the fallback.  The bucket is a *scheduling*
+    key (what the micro-batching loop coalesces on) — it never changes
+    job outputs."""
+    from presto_tpu import tune
+    if tune.enabled():
+        cfg = tune.best("plancache_bucket", tune.GLOBAL_KEY)
+        if cfg:
+            return bucket_quantize(n, str(cfg.get("scheme", "pow2")))
     from presto_tpu.utils.psr import next2_to_n
     return int(next2_to_n(max(int(n), 1)))
 

@@ -1,0 +1,264 @@
+"""Persistent, schema-versioned tuning database (tune layer).
+
+One JSON file maps a *device fingerprint* to the best measured config
+per (kernel family, shape key):
+
+    {"schema": 1,
+     "entries": {
+       "<fingerprint>": {
+         "<family>": {
+           "<shape_key>": {"config": {...}, "median_s": 0.0042,
+                           "reps": 5, "measured_at": 1754..,
+                           "source": "presto-tune"}}}}}
+
+The fingerprint (platform, device kind, core count, jax/jaxlib
+versions, kernel-source hash) is the cache-correctness boundary: a
+result measured on one chip generation or against one kernel source
+revision never silently drives another.  Durability rules:
+
+  * loads are *defensive*: a corrupted, truncated, or stale-schema
+    file degrades to an empty DB with a warning (``load_error`` set) —
+    a bad tuning DB must never take the pipeline down;
+  * saves go through ``io/atomic`` and re-read the file first, merging
+    under keep-the-best (lowest median_s), so concurrent tuners on a
+    shared filesystem compose instead of clobbering.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import time
+import warnings
+from typing import Dict, Optional, Tuple
+
+SCHEMA_VERSION = 1
+
+#: env override for the DB location (CLI --db wins over this)
+ENV_DB = "PRESTO_TPU_TUNE_DB"
+
+
+def default_db_path() -> str:
+    """The process's tuning-DB path: $PRESTO_TPU_TUNE_DB, else
+    ~/.cache/presto_tpu/tune.json."""
+    env = os.environ.get(ENV_DB, "")
+    if env:
+        return env
+    return os.path.join(os.path.expanduser("~"), ".cache",
+                        "presto_tpu", "tune.json")
+
+
+# ----------------------------------------------------------------------
+# device fingerprint
+# ----------------------------------------------------------------------
+
+#: modules whose source text feeds the kernel-source hash — the tuned
+#: knobs live here, so editing any of them invalidates old timings
+_KERNEL_SOURCES = (
+    "presto_tpu.search.accel_pallas",
+    "presto_tpu.search.build_pallas",
+    "presto_tpu.ops.dedispersion",
+    "presto_tpu.ops.oocfft",
+)
+
+
+def kernel_source_hash() -> str:
+    """Short stable hash over the tuned kernel modules' source."""
+    h = hashlib.sha1()
+    import importlib
+    for modname in _KERNEL_SOURCES:
+        try:
+            mod = importlib.import_module(modname)
+            path = getattr(mod, "__file__", None)
+            if path and os.path.exists(path):
+                with open(path, "rb") as f:
+                    h.update(f.read())
+        except Exception:
+            h.update(modname.encode())
+    return h.hexdigest()[:12]
+
+
+def device_fingerprint() -> Dict[str, str]:
+    """The identity a tuning result is valid for.  Fields:
+
+      platform      jax backend platform ("tpu", "cpu", ...)
+      device_kind   hardware model string ("TPU v5e", "cpu", ...)
+      device_count  visible device count (sharded sweeps differ)
+      jax/jaxlib    library versions (codegen changes re-tune)
+      kernel_hash   hash of the tuned kernel modules' source
+    """
+    platform, kind, count = "none", "none", 0
+    try:
+        import jax
+        devs = jax.devices()
+        platform = devs[0].platform
+        kind = getattr(devs[0], "device_kind", "") or platform
+        count = len(devs)
+    except Exception:
+        pass
+    jax_v = jaxlib_v = "none"
+    try:
+        import jax
+        jax_v = jax.__version__
+        import jaxlib
+        jaxlib_v = getattr(jaxlib, "__version__", jax_v)
+    except Exception:
+        pass
+    return {
+        "platform": str(platform),
+        "device_kind": str(kind),
+        "device_count": str(int(count)),
+        "jax": jax_v,
+        "jaxlib": jaxlib_v,
+        "kernel_hash": kernel_source_hash(),
+    }
+
+
+def fingerprint_key(fp: Optional[Dict[str, str]] = None) -> str:
+    """Canonical string form of a fingerprint dict (the DB key)."""
+    fp = fp or device_fingerprint()
+    return "|".join("%s=%s" % (k, fp[k]) for k in sorted(fp))
+
+
+# ----------------------------------------------------------------------
+# the DB
+# ----------------------------------------------------------------------
+
+class TuneDB:
+    """In-memory view of the tuning database.
+
+    ``entries`` is the raw nested dict (fingerprint -> family ->
+    shape_key -> record).  ``load_error`` records why a file on disk
+    was unusable (None when the load was clean or the file absent).
+    """
+
+    def __init__(self, entries: Optional[dict] = None,
+                 load_error: Optional[str] = None):
+        self.entries: dict = entries if entries is not None else {}
+        self.load_error = load_error
+
+    # -- load/save -----------------------------------------------------
+
+    @classmethod
+    def load(cls, path: str) -> "TuneDB":
+        """Defensive load: any structural problem (unparsable JSON,
+        wrong schema, non-dict entries) yields an EMPTY db with
+        ``load_error`` set and a warning — tuned runs then degrade to
+        built-in defaults instead of crashing."""
+        if not os.path.exists(path):
+            return cls()
+        try:
+            with open(path) as f:
+                raw = json.load(f)
+        except (OSError, ValueError) as e:
+            warnings.warn(
+                "tuning DB %s is unreadable (%s) — falling back to "
+                "default configs" % (path, e), RuntimeWarning,
+                stacklevel=2)
+            return cls(load_error="unreadable: %s" % e)
+        if not isinstance(raw, dict) or \
+                raw.get("schema") != SCHEMA_VERSION:
+            got = raw.get("schema") if isinstance(raw, dict) else None
+            warnings.warn(
+                "tuning DB %s has schema %r (want %d) — falling back "
+                "to default configs" % (path, got, SCHEMA_VERSION),
+                RuntimeWarning, stacklevel=2)
+            return cls(load_error="stale schema: %r" % (got,))
+        entries = raw.get("entries")
+        if not isinstance(entries, dict):
+            warnings.warn(
+                "tuning DB %s has a malformed entries table — falling "
+                "back to default configs" % path, RuntimeWarning,
+                stacklevel=2)
+            return cls(load_error="malformed entries")
+        return cls(entries=entries)
+
+    def save(self, path: str) -> None:
+        """Merge-save: re-read whatever is on disk now, fold this DB
+        in under keep-the-best, and atomically replace the file — two
+        concurrent tuners both land, each key keeping its fastest
+        measurement."""
+        from presto_tpu.io.atomic import atomic_write_text
+        d = os.path.dirname(os.path.abspath(path))
+        os.makedirs(d, exist_ok=True)
+        on_disk = TuneDB.load(path)
+        merged = TuneDB(entries=json.loads(json.dumps(on_disk.entries)))
+        merged.merge(self)
+        atomic_write_text(path, json.dumps(
+            {"schema": SCHEMA_VERSION, "entries": merged.entries},
+            indent=1, sort_keys=True))
+        self.entries = merged.entries
+
+    # -- record/lookup/merge -------------------------------------------
+
+    def record(self, fingerprint: str, family: str, shape_key: str,
+               config: dict, median_s: float, reps: int = 0,
+               source: str = "presto-tune") -> None:
+        fam = self.entries.setdefault(fingerprint, {}) \
+                          .setdefault(family, {})
+        old = fam.get(shape_key)
+        if old is not None and self._valid(old) \
+                and float(old["median_s"]) <= float(median_s):
+            return                      # keep the faster measurement
+        fam[shape_key] = {
+            "config": dict(config),
+            "median_s": float(median_s),
+            "reps": int(reps),
+            "measured_at": time.time(),
+            "source": source,
+        }
+
+    def lookup(self, fingerprint: str, family: str,
+               shape_key: str) -> Optional[dict]:
+        """The best config for (fingerprint, family, shape_key), or
+        None.  Malformed records are treated as absent."""
+        rec = self.entries.get(fingerprint, {}) \
+                          .get(family, {}).get(shape_key)
+        if not self._valid(rec):
+            return None
+        return dict(rec["config"])
+
+    def merge(self, other: "TuneDB") -> None:
+        """Keep-the-best union: for every (fingerprint, family,
+        shape_key) present in either DB, retain the record with the
+        lowest median_s."""
+        for fp, fams in other.entries.items():
+            if not isinstance(fams, dict):
+                continue
+            for family, shapes in fams.items():
+                if not isinstance(shapes, dict):
+                    continue
+                for shape_key, rec in shapes.items():
+                    if not self._valid(rec):
+                        continue
+                    self.record(fp, family, shape_key,
+                                rec["config"],
+                                float(rec["median_s"]),
+                                reps=int(rec.get("reps", 0)),
+                                source=str(rec.get("source",
+                                                   "merge")))
+
+    # -- introspection -------------------------------------------------
+
+    def families(self, fingerprint: str) -> Dict[str, dict]:
+        """{family: {shape_key: record}} for one fingerprint."""
+        fams = self.entries.get(fingerprint, {})
+        return fams if isinstance(fams, dict) else {}
+
+    def size(self) -> Tuple[int, int]:
+        """(fingerprints, total shape-key records)."""
+        n = 0
+        for fams in self.entries.values():
+            if not isinstance(fams, dict):
+                continue
+            for shapes in fams.values():
+                if isinstance(shapes, dict):
+                    n += len(shapes)
+        return len(self.entries), n
+
+    @staticmethod
+    def _valid(rec) -> bool:
+        return (isinstance(rec, dict)
+                and isinstance(rec.get("config"), dict)
+                and isinstance(rec.get("median_s"), (int, float)))

@@ -1,0 +1,205 @@
+"""On-device measurement harness (tune layer).
+
+Timing accelerator kernels honestly requires separating compile from
+steady state, forcing execution (async dispatch makes wall clocks
+lie), and being robust to candidates that are catastrophically slow or
+simply don't fit:
+
+  * the first call of a candidate is its WARMUP — it pays the XLA
+    compile, is excluded from the statistic, and is booked through the
+    shared obs/jaxtel compile accounting (``jax_compiles_total{kind=
+    "tune:<family>"}``);
+  * steady reps are median-of-k with ``block_until_ready`` on the
+    result (a returned scalar is float()ed, which also forces);
+  * a candidate whose first steady rep is already ``prune_factor``
+    slower than the best-so-far median is PRUNED (no more reps);
+  * a candidate that exceeds ``timeout_s`` of accumulated wall time
+    stops early and keeps whatever reps it got;
+  * a candidate that raises an out-of-memory error is QUARANTINED
+    (status "oom") and the sweep continues — an OOM config is a
+    legitimate search-space member on a smaller chip, not a crash.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Callable, List, Optional, Sequence, Tuple
+
+#: substrings identifying an allocation failure in a backend error
+_OOM_MARKERS = ("resource_exhausted", "out of memory", "oom",
+                "resource exhausted", "scoped vmem", "vmem limit",
+                "allocat")
+
+
+def _is_oom(exc: BaseException) -> bool:
+    s = str(exc).lower()
+    return any(m in s for m in _OOM_MARKERS)
+
+
+def _force(x) -> None:
+    """Force async device work to completion before reading the
+    clock."""
+    if x is None:
+        return
+    try:
+        import jax
+        jax.block_until_ready(x)
+        return
+    except Exception:
+        pass
+    try:
+        float(x)                      # scalars / python numbers
+    except Exception:
+        pass
+
+
+@dataclass
+class Measurement:
+    """One candidate's timing verdict."""
+    config: dict
+    status: str                       # ok | pruned | timeout | oom | error
+    median_s: Optional[float] = None
+    compile_s: Optional[float] = None
+    reps: int = 0
+    samples: List[float] = field(default_factory=list)
+    error: str = ""
+
+    @property
+    def usable(self) -> bool:
+        return self.median_s is not None and self.status in (
+            "ok", "pruned", "timeout")
+
+
+def _median(xs: Sequence[float]) -> float:
+    s = sorted(xs)
+    n = len(s)
+    return s[n // 2] if n % 2 else 0.5 * (s[n // 2 - 1] + s[n // 2])
+
+
+class TuneRunner:
+    """Sweep a family's candidates over one shape and pick the
+    fastest."""
+
+    def __init__(self, k: int = 5, warmup: int = 1,
+                 timeout_s: float = 30.0, prune_factor: float = 3.0,
+                 timer: Callable[[], float] = time.perf_counter,
+                 obs=None):
+        if obs is None:
+            from presto_tpu.obs import get_obs
+            obs = get_obs()
+        self.k = max(1, int(k))
+        self.warmup = max(0, int(warmup))
+        self.timeout_s = float(timeout_s)
+        self.prune_factor = float(prune_factor)
+        self.timer = timer
+        self.obs = obs
+
+    # -- one candidate -------------------------------------------------
+
+    def measure(self, fn: Callable[[], object], config: dict,
+                family: str = "?",
+                best_so_far: Optional[float] = None) -> Measurement:
+        """Time one candidate's bench callable.  ``fn`` runs the
+        candidate's device work and returns something forceable."""
+        m = Measurement(config=dict(config), status="ok")
+        sp = self.obs.span("tune:candidate", family=family,
+                           config=repr(config))
+        budget0 = self.timer()
+        try:
+            for _ in range(self.warmup):
+                t0 = self.timer()
+                _force(fn())
+                m.compile_s = self.timer() - t0
+        except BaseException as e:
+            if isinstance(e, (KeyboardInterrupt, SystemExit)):
+                sp.finish("error: interrupted")
+                raise
+            m.status = "oom" if _is_oom(e) else "error"
+            m.error = "%s: %s" % (type(e).__name__, e)
+            self._count_candidate(family, m.status)
+            sp.finish("error: %s" % m.status)
+            return m
+        if m.compile_s is not None:
+            from presto_tpu.obs import jaxtel
+            jaxtel.note_compile(self.obs, kind="tune:%s" % family,
+                                seconds=m.compile_s)
+        try:
+            for rep in range(self.k):
+                t0 = self.timer()
+                _force(fn())
+                m.samples.append(self.timer() - t0)
+                m.reps += 1
+                # early pruning: a first steady rep far beyond the
+                # incumbent can't win — don't burn k reps proving it
+                if (best_so_far is not None and rep == 0
+                        and m.samples[0] >
+                        self.prune_factor * best_so_far):
+                    m.status = "pruned"
+                    break
+                if self.timer() - budget0 > self.timeout_s:
+                    m.status = "timeout"
+                    break
+        except BaseException as e:
+            if isinstance(e, (KeyboardInterrupt, SystemExit)):
+                sp.finish("error: interrupted")
+                raise
+            if not m.samples:
+                m.status = "oom" if _is_oom(e) else "error"
+                m.error = "%s: %s" % (type(e).__name__, e)
+                self._count_candidate(family, m.status)
+                sp.finish("error: %s" % m.status)
+                return m
+            m.status = "error"
+            m.error = "%s: %s" % (type(e).__name__, e)
+        if m.samples:
+            m.median_s = _median(m.samples)
+        self._count_candidate(family, m.status)
+        sp.finish()
+        return m
+
+    # -- one (family, shape) sweep -------------------------------------
+
+    def sweep(self, family: str, shape_key: str,
+              candidates: Sequence[Tuple[dict, Callable[[], object]]],
+              ) -> Tuple[Optional[Measurement], List[Measurement]]:
+        """Measure every (config, bench) candidate; returns (winner,
+        all measurements).  The winner is the usable candidate with
+        the lowest median; None when nothing ran."""
+        sp = self.obs.span("tune:sweep", family=family,
+                           shape=shape_key, n=len(candidates))
+        t0 = time.time()
+        results: List[Measurement] = []
+        best: Optional[Measurement] = None
+        for config, fn in candidates:
+            m = self.measure(fn, config, family=family,
+                             best_so_far=best.median_s
+                             if best is not None else None)
+            results.append(m)
+            if m.usable and (best is None
+                             or m.median_s < best.median_s):
+                best = m
+        if self.obs.enabled:
+            self.obs.metrics.histogram(
+                "tune_sweep_seconds",
+                "Wall time of one (family, shape) tuning sweep",
+                ("family",)).labels(family=family).observe(
+                    time.time() - t0)
+        sp.finish()
+        return best, results
+
+    def _count_candidate(self, family: str, status: str) -> None:
+        if not self.obs.enabled:
+            return
+        reg = self.obs.metrics
+        reg.counter("tune_candidates_total",
+                    "Tuning candidates measured",
+                    ("family",)).labels(family=family).inc()
+        if status == "pruned":
+            reg.counter("tune_candidates_pruned_total",
+                        "Tuning candidates stopped early (too slow)",
+                        ("family",)).labels(family=family).inc()
+        elif status == "oom":
+            reg.counter("tune_candidates_quarantined_total",
+                        "Tuning candidates quarantined (OOM)",
+                        ("family",)).labels(family=family).inc()

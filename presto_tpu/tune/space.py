@@ -1,0 +1,368 @@
+"""Declarative search spaces per kernel family (tune layer).
+
+Each :class:`Family` names one performance-critical knob set, how to
+enumerate its candidates for a given workload *shape*, the shape key
+its results are stored under (so a measurement on one observation
+drives every observation with the same kernel geometry), and how to
+measure a candidate:
+
+  accel_pallas_tile   column tile of the Pallas stage reducer
+                      (search/accel_pallas.py) — candidates gated by
+                      the scoped-VMEM scratch estimate
+  harmonic_sum_layout Pallas stage reducer vs the XLA staged scan for
+                      the harmonic sum (search/accel.py engine choice)
+  dedisp_dm_batch     DM-batch unroll bound of the static-slice
+                      dedispersion path (ops/dedispersion.py)
+  oocfft_block        block-buffer size of the out-of-core two-pass
+                      FFT (ops/oocfft.py)
+  plancache_bucket    pad-to-bucket edge scheme of the serve plan
+                      cache (serve/plancache.py) — a *modeled* family:
+                      its figure of merit is a deterministic cost
+                      (compiles + padding waste), not a wall clock
+
+Families are device-agnostic declarations; ``tune.runner`` does the
+measuring and ``tune.db`` the remembering.  Every family has a tiny
+``smoke`` shape set that runs on the CPU backend (interpret-mode
+Pallas where needed) so ``presto-tune --smoke`` works in CI.
+"""
+
+from __future__ import annotations
+
+import atexit
+import os
+import shutil
+import tempfile
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+
+from presto_tpu import tune
+
+
+@dataclass
+class Family:
+    """One tunable kernel family."""
+    name: str
+    doc: str
+    shape_key: Callable[[dict], str]
+    candidates: Callable[[dict], List[dict]]
+    shapes: Callable[[bool], List[dict]]      # smoke -> shape dicts
+    #: (shape, config) -> zero-arg bench callable (timed families)
+    bench: Optional[Callable[[dict, dict], Callable[[], object]]] = \
+        None
+    #: (shape, config) -> figure of merit, lower = better (modeled
+    #: families; recorded as the DB entry's median_s)
+    score: Optional[Callable[[dict, dict], float]] = None
+    #: smoke -> can this family run on the current backend?
+    available: Callable[[bool], bool] = field(
+        default=lambda smoke: True)
+
+
+# ----------------------------------------------------------------------
+# accel_pallas_tile + harmonic_sum_layout
+# ----------------------------------------------------------------------
+
+def _accel_fz(shape):
+    from presto_tpu.search.accel import (AccelConfig,
+                                         _harm_fracs_and_zinds)
+    cfg = AccelConfig(zmax=int(shape["zmax"]),
+                      numharm=int(shape["numharm"]))
+    return cfg, _harm_fracs_and_zinds(cfg, cfg.numz)
+
+
+def _tile_candidates(shape) -> List[dict]:
+    from presto_tpu.search.accel_pallas import (VMEM_BUDGET,
+                                                scratch_bytes)
+    cfg, fz = _accel_fz(shape)
+    slab = int(shape["slab"])
+    out = []
+    for t in (128, 256, 512, 1024):
+        if t <= slab and slab % t == 0 and \
+                scratch_bytes(fz, cfg.numz, t) <= VMEM_BUDGET:
+            out.append({"tile": t})
+    return out
+
+
+def _bench_plane(shape, tile_mult: int):
+    """Random plane honoring the reducer's padding contract, plus
+    TILE-aligned slab starts."""
+    from presto_tpu.search.accel_pallas import PLANE_PAD, pad_rows
+    cfg, fz = _accel_fz(shape)
+    slab = int(shape["slab"])
+    R = 2 * slab + PLANE_PAD
+    R += (-R) % tile_mult
+    rng = np.random.default_rng(17)
+    P = rng.random((pad_rows(cfg.numz), R)).astype(np.float32)
+    P[cfg.numz:] = 0.0
+    P[:, -PLANE_PAD:] = 0.0
+    starts = np.asarray([0, slab], np.int32)
+    return cfg, fz, P, starts
+
+
+def _tile_bench(shape, config):
+    import jax.numpy as jnp
+    from presto_tpu.search import accel_pallas as ap
+    tile = int(config["tile"])
+    cfg, fz, P, starts = _bench_plane(shape, tile)
+    reducer = ap.make_stage_reducer(
+        cfg.numharmstages, fz, int(shape["slab"]), cfg.numz,
+        P.shape[1], interpret=not ap.pallas_available(), tile=tile)
+    Pd, sd = jnp.asarray(P), jnp.asarray(starts)
+
+    def fn():
+        return reducer(Pd, sd)
+    return fn
+
+
+def _xla_stage_reduce(cfg, fz, P, starts, slab):
+    """The XLA engine stand-in for the layout bench: staged harmonic
+    sum + per-column (max, argmax) with jnp gathers — the memory
+    pattern of search/accel.py's non-Pallas scanner."""
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def run(P, starts):
+        def one(s0):
+            cols = s0 + jnp.arange(slab)
+            acc = jnp.take(P, cols, axis=1)
+            outs = [(acc.max(0), acc.argmax(0))]
+            for stage in fz:
+                for harm, htot, zinds in stage:
+                    rind = ((cols // htot) * harm
+                            + ((cols % htot) * harm + (htot >> 1))
+                            // htot)
+                    acc = acc + jnp.take(
+                        jnp.take(P, jnp.asarray(zinds), axis=0),
+                        rind, axis=1)
+                outs.append((acc.max(0), acc.argmax(0)))
+            return (jnp.stack([o[0] for o in outs]),
+                    jnp.stack([o[1] for o in outs]))
+        return jax.vmap(one)(starts)
+    return run
+
+
+def _layout_bench(shape, config):
+    import jax.numpy as jnp
+    from presto_tpu.search import accel_pallas as ap
+    slab = int(shape.get("slab", 2 * 1024))
+    wshape = dict(shape, slab=slab)
+    if config["engine"] == "pallas":
+        tile = None
+        for t in (1024, 512, 256, 128):
+            if t <= slab and slab % t == 0:
+                tile = t
+                break
+        return _tile_bench(wshape, {"tile": tile})
+    cfg, fz, P, starts = _bench_plane(wshape, 128)
+    run = _xla_stage_reduce(cfg, fz, P, starts, slab)
+    # the XLA engine reads the unpadded plane (numz rows); only the
+    # Pallas kernel needs the 8-row pad
+    Pd, sd = jnp.asarray(P[:cfg.numz]), jnp.asarray(starts)
+
+    def fn():
+        return run(Pd, sd)
+    return fn
+
+
+# ----------------------------------------------------------------------
+# dedisp_dm_batch
+# ----------------------------------------------------------------------
+
+def _dedisp_candidates(shape) -> List[dict]:
+    nsub = int(shape["nsub"])
+    limits = shape.get("limits") or (2048, 4096, 8192, 16384, 32768)
+    return [{"limit": int(l)} for l in limits if int(l) >= nsub]
+
+
+def _dedisp_bench(shape, config):
+    from presto_tpu.ops import dedispersion as dd
+    nsub = int(shape["nsub"])
+    numdms = int(shape.get("numdms", 256))
+    numpts = int(shape.get("numpts", 1 << 16))
+    rng = np.random.default_rng(3)
+    last = rng.random((nsub, numpts)).astype(np.float32)
+    cur = rng.random((nsub, numpts)).astype(np.float32)
+    delays = (rng.integers(0, numpts, size=(numdms, nsub))
+              .astype(np.int32))
+    limit = int(config["limit"])
+
+    def fn():
+        return dd.float_dedisp_many_block(last, cur, delays,
+                                          batch_limit=limit)
+    return fn
+
+
+# ----------------------------------------------------------------------
+# oocfft_block
+# ----------------------------------------------------------------------
+
+_scratch: Optional[str] = None
+
+
+def _scratch_dir() -> str:
+    global _scratch
+    if _scratch is None:
+        _scratch = tempfile.mkdtemp(prefix="presto-tune-")
+        atexit.register(shutil.rmtree, _scratch, True)
+    return _scratch
+
+
+def _oocfft_bench(shape, config):
+    from presto_tpu.ops.oocfft import realfft_ooc
+    n = int(shape.get("n", 1 << 20))
+    max_mem = int(config["max_mem"])
+    d = _scratch_dir()
+    src = os.path.join(d, "tune_%d.dat" % n)
+    if not os.path.exists(src) or os.path.getsize(src) != 4 * n:
+        rng = np.random.default_rng(9)
+        rng.normal(size=n).astype(np.float32).tofile(src)
+    dst = os.path.join(d, "tune_%d_%d.fft" % (n, max_mem))
+
+    def fn():
+        realfft_ooc(src, dst, forward=True, max_mem=max_mem,
+                    tmpdir=d)
+        return None
+    return fn
+
+
+# ----------------------------------------------------------------------
+# plancache_bucket (modeled)
+# ----------------------------------------------------------------------
+
+def _bucket_score(shape, config) -> float:
+    """Deterministic cost of a bucket-edge scheme over synthetic
+    traffic: each distinct bucket is one XLA compile, each job pays
+    its padding overhead.  Lower is better.  Units are modeled
+    seconds (compile_s per bucket + pad cost proportional to wasted
+    fraction), so the DB's median_s stays comparable within the
+    family."""
+    from presto_tpu.serve.plancache import bucket_quantize
+    scheme = config["scheme"]
+    compile_s = float(shape.get("compile_s", 20.0))
+    job_s = float(shape.get("job_s", 30.0))
+    # log-uniform nsamp traffic, fixed seed: the serve regime where
+    # raw beam lengths differ by a few percent to a few x
+    rng = np.random.default_rng(int(shape.get("seed", 23)))
+    lengths = np.exp(rng.uniform(np.log(1 << 16), np.log(1 << 24),
+                                 size=int(shape.get("jobs", 512))))
+    buckets = set()
+    pad_cost = 0.0
+    for n in lengths:
+        q = bucket_quantize(int(n), scheme)
+        buckets.add(q)
+        pad_cost += job_s * (q / float(n) - 1.0)
+    return compile_s * len(buckets) + pad_cost
+
+
+# ----------------------------------------------------------------------
+# the catalog
+# ----------------------------------------------------------------------
+
+def _jax_ok(_smoke: bool) -> bool:
+    try:
+        import jax
+        jax.devices()
+        return True
+    except Exception:
+        return False
+
+
+def _accel_ok(smoke: bool) -> bool:
+    """Production accel sweeps need the real TPU kernel; smoke runs
+    interpret-mode Pallas at tiny geometry on any backend."""
+    if not _jax_ok(smoke):
+        return False
+    if smoke:
+        return True
+    from presto_tpu.search.accel_pallas import pallas_available
+    return pallas_available()
+
+
+FAMILIES: Dict[str, Family] = {
+    "accel_pallas_tile": Family(
+        name="accel_pallas_tile",
+        doc="Column tile (lanes) of the Pallas harmonic-sum stage "
+            "reducer; VMEM-gated",
+        shape_key=lambda s: tune.key_accel_tile(
+            int(s["zmax"]) + 1, int(s["numharm"]), int(s["slab"])),
+        candidates=_tile_candidates,
+        bench=_tile_bench,
+        shapes=lambda smoke: (
+            [{"zmax": 20, "numharm": 2, "slab": 256}] if smoke else
+            [{"zmax": 200, "numharm": 8, "slab": 1 << 17},
+             {"zmax": 200, "numharm": 16, "slab": 1 << 17}]),
+        available=_accel_ok,
+    ),
+    "harmonic_sum_layout": Family(
+        name="harmonic_sum_layout",
+        doc="Harmonic-sum engine choice: Pallas stage reducer vs the "
+            "XLA staged scan",
+        shape_key=lambda s: tune.key_harm_layout(
+            int(s["zmax"]) + 1, int(s["numharm"])),
+        candidates=lambda s: [{"engine": "pallas"},
+                              {"engine": "xla"}],
+        bench=_layout_bench,
+        shapes=lambda smoke: (
+            [{"zmax": 20, "numharm": 2, "slab": 256}] if smoke else
+            [{"zmax": 200, "numharm": 8, "slab": 1 << 15}]),
+        available=_accel_ok,
+    ),
+    "dedisp_dm_batch": Family(
+        name="dedisp_dm_batch",
+        doc="DM-batch unroll bound of the static-slice dedispersion "
+            "fast path",
+        shape_key=lambda s: tune.key_dedisp_batch(int(s["nsub"])),
+        candidates=_dedisp_candidates,
+        bench=_dedisp_bench,
+        shapes=lambda smoke: (
+            [{"nsub": 16, "numdms": 32, "numpts": 2048,
+              "limits": (256, 1024)},
+             {"nsub": 32, "numdms": 32, "numpts": 2048,
+              "limits": (512, 2048)}] if smoke else
+            [{"nsub": 32, "numdms": 256, "numpts": 1 << 17},
+             {"nsub": 64, "numdms": 256, "numpts": 1 << 17},
+             {"nsub": 128, "numdms": 128, "numpts": 1 << 17}]),
+        available=_jax_ok,
+    ),
+    "oocfft_block": Family(
+        name="oocfft_block",
+        doc="Block-buffer bytes of the out-of-core two-pass FFT",
+        shape_key=lambda s: tune.GLOBAL_KEY,
+        candidates=lambda s: [
+            {"max_mem": int(m)} for m in
+            (s.get("max_mems") or (1 << 24, 1 << 26, 1 << 28))],
+        bench=_oocfft_bench,
+        shapes=lambda smoke: (
+            [{"n": 1 << 14, "max_mems": (1 << 16, 1 << 20)}]
+            if smoke else [{"n": 1 << 22}]),
+    ),
+    "plancache_bucket": Family(
+        name="plancache_bucket",
+        doc="Pad-to-bucket edge scheme of the serve plan cache "
+            "(modeled compiles-vs-padding cost)",
+        shape_key=lambda s: tune.GLOBAL_KEY,
+        candidates=lambda s: [{"scheme": "pow2"},
+                              {"scheme": "pow2_half"},
+                              {"scheme": "pow2_quarter"}],
+        score=_bucket_score,
+        shapes=lambda smoke: (
+            [{"jobs": 64}] if smoke else [{"jobs": 512}]),
+    ),
+}
+
+
+def resolve(names: Optional[List[str]] = None) -> List[Family]:
+    """Families by name (comma-list friendly); None/empty = all.
+    Unknown names raise ValueError listing the catalog."""
+    if not names:
+        return list(FAMILIES.values())
+    out = []
+    for n in names:
+        if n not in FAMILIES:
+            raise ValueError(
+                "unknown tuning family %r (have: %s)"
+                % (n, ", ".join(sorted(FAMILIES))))
+        out.append(FAMILIES[n])
+    return out

@@ -1,0 +1,622 @@
+"""presto_tpu.tune: tuning DB robustness, measurement harness,
+search spaces, lookup integration, and the CPU-CI acceptance flow
+(presto-tune --smoke populates a DB; tuned survey/serve runs consult
+it with byte-identical outputs; corrupted DBs degrade to defaults).
+"""
+
+import glob
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+from presto_tpu import tune
+from presto_tpu.tune.db import (SCHEMA_VERSION, TuneDB,
+                                device_fingerprint, fingerprint_key)
+from presto_tpu.tune.runner import Measurement, TuneRunner
+
+
+@pytest.fixture(autouse=True)
+def _fresh_tune_state():
+    tune.reset()
+    yield
+    tune.reset()
+
+
+FP = "platform=test|kind=unit"
+
+
+# ----------------------------------------------------------------------
+# db: roundtrip, merge, robustness
+# ----------------------------------------------------------------------
+
+def test_db_roundtrip(tmp_path):
+    p = str(tmp_path / "tune.json")
+    db = TuneDB()
+    db.record(FP, "fam", "k=1", {"tile": 512}, 0.5, reps=3)
+    db.save(p)
+    got = TuneDB.load(p)
+    assert got.load_error is None
+    assert got.lookup(FP, "fam", "k=1") == {"tile": 512}
+    assert got.lookup(FP, "fam", "k=2") is None
+    assert got.lookup("other", "fam", "k=1") is None
+    assert got.size() == (1, 1)
+
+
+def test_db_record_keeps_best():
+    db = TuneDB()
+    db.record(FP, "fam", "k", {"tile": 512}, 0.5)
+    db.record(FP, "fam", "k", {"tile": 256}, 0.9)   # slower: ignored
+    assert db.lookup(FP, "fam", "k") == {"tile": 512}
+    db.record(FP, "fam", "k", {"tile": 1024}, 0.1)  # faster: wins
+    assert db.lookup(FP, "fam", "k") == {"tile": 1024}
+
+
+def test_db_merge_keeps_best_per_key():
+    a, b = TuneDB(), TuneDB()
+    a.record(FP, "fam", "k1", {"t": 1}, 0.5)
+    a.record(FP, "fam", "k2", {"t": 2}, 0.2)
+    b.record(FP, "fam", "k1", {"t": 9}, 0.1)        # better k1
+    b.record(FP, "other", "k", {"x": 0}, 1.0)       # new family
+    a.merge(b)
+    assert a.lookup(FP, "fam", "k1") == {"t": 9}
+    assert a.lookup(FP, "fam", "k2") == {"t": 2}
+    assert a.lookup(FP, "other", "k") == {"x": 0}
+
+
+def test_db_concurrent_merge_on_save(tmp_path):
+    """Two tuners saving to one path compose: each (fingerprint,
+    family, shape_key) keeps the lowest median."""
+    p = str(tmp_path / "tune.json")
+    t1, t2 = TuneDB(), TuneDB()
+    t1.record(FP, "fam", "shared", {"t": "slow"}, 0.9)
+    t1.record(FP, "fam", "only1", {"t": 1}, 0.3)
+    t2.record(FP, "fam", "shared", {"t": "fast"}, 0.2)
+    t2.record(FP, "fam", "only2", {"t": 2}, 0.4)
+    t1.save(p)
+    t2.save(p)
+    final = TuneDB.load(p)
+    assert final.lookup(FP, "fam", "shared") == {"t": "fast"}
+    assert final.lookup(FP, "fam", "only1") == {"t": 1}
+    assert final.lookup(FP, "fam", "only2") == {"t": 2}
+    # order independence: the slow save landing second cannot clobber
+    t1.save(p)
+    assert TuneDB.load(p).lookup(FP, "fam", "shared") == {"t": "fast"}
+
+
+@pytest.mark.parametrize("payload", [
+    b"{ this is not json",                       # corrupted
+    b'{"schema": 1, "entries": {"a"',            # truncated
+    json.dumps({"schema": 99, "entries": {}}).encode(),   # stale
+    json.dumps({"schema": SCHEMA_VERSION,
+                "entries": "nope"}).encode(),    # malformed table
+])
+def test_db_bad_file_falls_back_with_warning(tmp_path, payload):
+    p = str(tmp_path / "tune.json")
+    with open(p, "wb") as f:
+        f.write(payload)
+    with pytest.warns(RuntimeWarning):
+        db = TuneDB.load(p)
+    assert db.load_error is not None
+    assert db.entries == {}
+    assert db.lookup(FP, "fam", "k") is None
+
+
+def test_db_malformed_record_treated_as_absent():
+    db = TuneDB(entries={FP: {"fam": {"k": {"config": "notadict",
+                                            "median_s": 1.0},
+                                      "ok": {"config": {"t": 1},
+                                             "median_s": 1.0}}}})
+    assert db.lookup(FP, "fam", "k") is None
+    assert db.lookup(FP, "fam", "ok") == {"t": 1}
+
+
+def test_fingerprint_fields_and_stability():
+    fp = device_fingerprint()
+    for field in ("platform", "device_kind", "device_count", "jax",
+                  "jaxlib", "kernel_hash"):
+        assert fp[field]
+    assert device_fingerprint() == fp
+    key = fingerprint_key(fp)
+    assert "platform=" in key and "kernel_hash=" in key
+
+
+# ----------------------------------------------------------------------
+# runner: median, pruning, timeout, OOM quarantine
+# ----------------------------------------------------------------------
+
+def _sleeper(dt):
+    def fn():
+        time.sleep(dt)
+        return None
+    return fn
+
+
+def test_runner_median_of_k():
+    r = TuneRunner(k=3, warmup=1, timeout_s=60.0)
+    m = r.measure(_sleeper(0.002), {"c": 1}, family="f")
+    assert m.status == "ok" and m.reps == 3
+    assert m.median_s >= 0.002
+    assert m.compile_s is not None          # warmup separated out
+
+
+def test_runner_prunes_slow_candidate():
+    r = TuneRunner(k=5, warmup=1, timeout_s=60.0, prune_factor=3.0)
+    best, results = r.sweep("f", "k", [
+        ({"c": "fast"}, _sleeper(0.001)),
+        ({"c": "slow"}, _sleeper(0.05)),
+    ])
+    assert best.config == {"c": "fast"}
+    slow = results[1]
+    assert slow.status == "pruned" and slow.reps == 1
+    # a pruned candidate keeps its (bad) median but cannot win
+    assert slow.median_s > best.median_s
+
+
+def test_runner_timeout_stops_early():
+    r = TuneRunner(k=50, warmup=0, timeout_s=0.05)
+    m = r.measure(_sleeper(0.02), {"c": 1}, family="f")
+    assert m.status == "timeout"
+    assert 1 <= m.reps < 50
+    assert m.median_s is not None           # usable partial result
+
+
+def test_runner_oom_quarantine_continues_sweep():
+    def boom():
+        raise RuntimeError("RESOURCE_EXHAUSTED: out of memory "
+                           "allocating 19MB scoped vmem")
+    best, results = TuneRunner(k=2, warmup=1).sweep("f", "k", [
+        ({"c": "oom"}, boom),
+        ({"c": "ok"}, _sleeper(0.001)),
+    ])
+    assert results[0].status == "oom" and not results[0].usable
+    assert best.config == {"c": "ok"}
+
+
+def test_runner_plain_error_is_not_oom():
+    def bad():
+        raise ValueError("shape mismatch")
+    m = TuneRunner(k=1, warmup=1).measure(bad, {}, family="f")
+    assert m.status == "error" and "shape mismatch" in m.error
+
+
+# ----------------------------------------------------------------------
+# spaces
+# ----------------------------------------------------------------------
+
+def test_space_tile_candidates_vmem_gated():
+    from presto_tpu.tune.space import FAMILIES
+    fam = FAMILIES["accel_pallas_tile"]
+    small = fam.candidates({"zmax": 20, "numharm": 2, "slab": 256})
+    assert {c["tile"] for c in small} == {128, 256}
+    big = fam.candidates({"zmax": 800, "numharm": 8,
+                          "slab": 1 << 20})
+    # huge numz: every default tile's scratch blows the VMEM budget
+    from presto_tpu.search.accel import (AccelConfig,
+                                         _harm_fracs_and_zinds)
+    from presto_tpu.search.accel_pallas import (VMEM_BUDGET,
+                                                scratch_bytes)
+    cfg = AccelConfig(zmax=800, numharm=8)
+    fz = _harm_fracs_and_zinds(cfg, cfg.numz)
+    for c in big:
+        assert scratch_bytes(fz, cfg.numz, c["tile"]) <= VMEM_BUDGET
+
+
+def test_space_shape_keys_generalize():
+    from presto_tpu.tune.space import FAMILIES
+    dd = FAMILIES["dedisp_dm_batch"]
+    # nsub buckets to pow2: 24 and 32 subbands share one entry
+    assert dd.shape_key({"nsub": 24}) == dd.shape_key({"nsub": 32})
+    assert dd.shape_key({"nsub": 16}) != dd.shape_key({"nsub": 32})
+    at = FAMILIES["accel_pallas_tile"]
+    assert at.shape_key({"zmax": 200, "numharm": 8,
+                         "slab": 1 << 17}) == \
+        at.shape_key({"zmax": 200, "numharm": 8,
+                      "slab": (1 << 17) - 4096})
+
+
+def test_space_resolve_unknown_family():
+    from presto_tpu.tune.space import resolve
+    with pytest.raises(ValueError, match="unknown tuning family"):
+        resolve(["nope"])
+
+
+# ----------------------------------------------------------------------
+# lookup semantics
+# ----------------------------------------------------------------------
+
+def _write_db(path, family, shape_key, config, fp=None):
+    db = TuneDB()
+    db.record(fp or fingerprint_key(), family, shape_key, config,
+              0.001)
+    db.save(path)
+
+
+def test_best_disabled_returns_default(tmp_path, monkeypatch):
+    p = str(tmp_path / "tune.json")
+    _write_db(p, "fam", "k", {"t": 1})
+    monkeypatch.delenv(tune.ENV_SWITCH, raising=False)
+    monkeypatch.setenv("PRESTO_TPU_TUNE_DB", p)
+    assert tune.best("fam", "k", default={"t": 0}) == {"t": 0}
+    assert tune.stats() == {"hits": 0, "misses": 0, "load_errors": 0}
+    assert tune.provenance() == {}
+
+
+def test_best_hit_miss_and_provenance(tmp_path, monkeypatch):
+    p = str(tmp_path / "tune.json")
+    _write_db(p, "fam", "k", {"t": 1})
+    monkeypatch.setenv(tune.ENV_SWITCH, "1")
+    monkeypatch.setenv("PRESTO_TPU_TUNE_DB", p)
+    assert tune.best("fam", "k") == {"t": 1}
+    assert tune.best("fam", "other", default={"t": 9}) == {"t": 9}
+    st = tune.stats()
+    assert st["hits"] == 1 and st["misses"] == 1
+    prov = tune.provenance()
+    assert prov["fam"]["k"]["source"] == "db"
+    assert prov["fam"]["other"]["source"] == "default"
+
+
+def test_best_wrong_fingerprint_misses(tmp_path, monkeypatch):
+    p = str(tmp_path / "tune.json")
+    _write_db(p, "fam", "k", {"t": 1}, fp="platform=elsewhere")
+    monkeypatch.setenv(tune.ENV_SWITCH, "1")
+    monkeypatch.setenv("PRESTO_TPU_TUNE_DB", p)
+    assert tune.best("fam", "k") is None
+
+
+def test_best_corrupted_db_degrades(tmp_path, monkeypatch):
+    p = str(tmp_path / "tune.json")
+    with open(p, "w") as f:
+        f.write("{garbage")
+    monkeypatch.setenv(tune.ENV_SWITCH, "1")
+    monkeypatch.setenv("PRESTO_TPU_TUNE_DB", p)
+    with pytest.warns(RuntimeWarning):
+        assert tune.best("fam", "k", default={"t": 5}) == {"t": 5}
+    assert tune.stats()["load_errors"] == 1
+
+
+def test_scoped_overrides_and_restores(monkeypatch):
+    monkeypatch.delenv(tune.ENV_SWITCH, raising=False)
+    assert not tune.enabled()
+    with tune.scoped(True):
+        assert tune.enabled()
+        with tune.scoped(None):             # None = no change
+            assert tune.enabled()
+        with tune.scoped(False):
+            assert not tune.enabled()
+        assert tune.enabled()
+    assert not tune.enabled()
+
+
+# ----------------------------------------------------------------------
+# integration points
+# ----------------------------------------------------------------------
+
+def test_pick_tile_honors_tuned_entry(tmp_path, monkeypatch):
+    from presto_tpu.search.accel import (AccelConfig,
+                                         _harm_fracs_and_zinds)
+    from presto_tpu.search.accel_pallas import pick_tile
+    cfg = AccelConfig(zmax=200, numharm=8)
+    fz = _harm_fracs_and_zinds(cfg, cfg.numz)
+    slab = 1 << 20
+    assert pick_tile(fz, cfg.numz, slab) == 1024     # default
+    p = str(tmp_path / "tune.json")
+    _write_db(p, "accel_pallas_tile",
+              tune.key_accel_tile(cfg.numz, 8, slab), {"tile": 512})
+    monkeypatch.setenv(tune.ENV_SWITCH, "1")
+    monkeypatch.setenv("PRESTO_TPU_TUNE_DB", p)
+    assert pick_tile(fz, cfg.numz, slab) == 512      # tuned
+    assert tune.stats()["hits"] == 1
+
+
+def test_pick_tile_rejects_invalid_tuned_entry(tmp_path,
+                                               monkeypatch):
+    """A stale/hostile DB tile violating the alignment or VMEM
+    contract falls back to the default sweep."""
+    from presto_tpu.search.accel import (AccelConfig,
+                                         _harm_fracs_and_zinds)
+    from presto_tpu.search.accel_pallas import pick_tile
+    cfg = AccelConfig(zmax=200, numharm=8)
+    fz = _harm_fracs_and_zinds(cfg, cfg.numz)
+    slab = 1 << 20
+    key = tune.key_accel_tile(cfg.numz, 8, slab)
+    for bad in ({"tile": 384}, {"tile": 4096}, {"tile": "x"},
+                {"tile": 2048}):
+        tune.reset()
+        p = str(tmp_path / ("t%s.json" % bad["tile"]))
+        try:
+            _write_db(p, "accel_pallas_tile", key, bad)
+        except Exception:
+            continue
+        monkeypatch.setenv(tune.ENV_SWITCH, "1")
+        monkeypatch.setenv("PRESTO_TPU_TUNE_DB", p)
+        got = pick_tile(fz, cfg.numz, slab)
+        assert got == 1024, bad
+
+
+def test_stage_reducer_tile_threaded_not_global():
+    """Satellite: make_stage_reducer takes the tile explicitly —
+    module state is untouched, and two concurrent plans with
+    different tiles both honor the numpy reference."""
+    import jax.numpy as jnp
+    from presto_tpu.search import accel_pallas as ap
+    from presto_tpu.search.accel import (AccelConfig,
+                                         _harm_fracs_and_zinds)
+    from tests.test_accel_pallas import _numpy_stage_reduce
+    assert ap.TILE == 1024
+    cfg = AccelConfig(zmax=20, numharm=2)
+    numz, nstages = cfg.numz, cfg.numharmstages
+    fz = _harm_fracs_and_zinds(cfg, numz)
+    rng = np.random.default_rng(5)
+    slab = 256
+    R = 4 * slab + ap.PLANE_PAD
+    P = rng.random((numz, R)).astype(np.float32)
+    P[:, -ap.PLANE_PAD:] = 0.0
+    Ppad = np.pad(P, ((0, ap.pad_rows(numz) - numz), (0, 0)))
+    starts = np.asarray([0, slab], np.int32)
+    want = _numpy_stage_reduce(P, starts, slab, fz, nstages)
+    reducers = [ap.make_stage_reducer(nstages, fz, slab, numz, R,
+                                      interpret=True, tile=t)
+                for t in (128, 256)]
+    assert ap.TILE == 1024                  # no module-state mutation
+    for red in reducers:
+        got_max, got_z = (np.asarray(a) for a in
+                          red(jnp.asarray(Ppad), jnp.asarray(starts)))
+        np.testing.assert_allclose(got_max, want[0], rtol=1e-6)
+        np.testing.assert_array_equal(got_z, want[1])
+    with pytest.raises(ValueError, match="tile"):
+        ap.make_stage_reducer(nstages, fz, slab, numz, R,
+                              interpret=True, tile=100)
+
+
+def test_dedisp_batch_limit_partitions_identically(tmp_path,
+                                                   monkeypatch):
+    """The DM-batch bound only partitions the DM axis: any limit
+    yields byte-equal output, and a tuned limit is consulted."""
+    from presto_tpu.ops import dedispersion as dd
+    rng = np.random.default_rng(0)
+    nsub, numdms, numpts = 8, 24, 512
+    last = rng.random((nsub, numpts)).astype(np.float32)
+    cur = rng.random((nsub, numpts)).astype(np.float32)
+    delays = rng.integers(0, numpts, size=(numdms, nsub)) \
+                .astype(np.int32)
+    ref = np.asarray(dd.float_dedisp_many_block(last, cur, delays))
+    for limit in (8, 64, 100, 10 ** 6):
+        got = np.asarray(dd.float_dedisp_many_block(
+            last, cur, delays, batch_limit=limit))
+        np.testing.assert_array_equal(got, ref)
+    p = str(tmp_path / "tune.json")
+    _write_db(p, "dedisp_dm_batch", tune.key_dedisp_batch(nsub),
+              {"limit": 64})
+    monkeypatch.setenv(tune.ENV_SWITCH, "1")
+    monkeypatch.setenv("PRESTO_TPU_TUNE_DB", p)
+    got = np.asarray(dd.float_dedisp_many_block(last, cur, delays))
+    np.testing.assert_array_equal(got, ref)
+    assert tune.stats()["hits"] == 1
+
+
+def test_oocfft_tuned_block_byte_identical(tmp_path, monkeypatch):
+    from presto_tpu.ops.oocfft import realfft_ooc
+    n = 1 << 12
+    rng = np.random.default_rng(2)
+    src = str(tmp_path / "x.dat")
+    rng.normal(size=n).astype(np.float32).tofile(src)
+    ref, tuned = str(tmp_path / "ref.fft"), str(tmp_path / "tun.fft")
+    realfft_ooc(src, ref, forward=True)
+    p = str(tmp_path / "tune.json")
+    _write_db(p, "oocfft_block", tune.GLOBAL_KEY,
+              {"max_mem": 1 << 16})
+    monkeypatch.setenv(tune.ENV_SWITCH, "1")
+    monkeypatch.setenv("PRESTO_TPU_TUNE_DB", p)
+    realfft_ooc(src, tuned, forward=True)
+    assert tune.stats()["hits"] == 1
+    assert open(ref, "rb").read() == open(tuned, "rb").read()
+
+
+def test_plancache_bucket_schemes(tmp_path, monkeypatch):
+    from presto_tpu.serve.plancache import (bucket_quantize,
+                                            quantize_nsamp)
+    # scheme edge math
+    assert bucket_quantize(1000, "pow2") == 1024
+    assert bucket_quantize(700, "pow2_half") == 768
+    assert bucket_quantize(800, "pow2_half") == 1024
+    assert bucket_quantize(600, "pow2_quarter") == 640
+    assert bucket_quantize(1000, "no_such_scheme") == 1024  # fallback
+    for scheme in ("pow2", "pow2_half", "pow2_quarter"):
+        for n in (1, 7, 100, 131072, 131073):
+            assert bucket_quantize(n, scheme) >= n
+    # untuned default unchanged
+    assert quantize_nsamp(100000) == 131072
+    # tuned scheme consulted (the serve-job lookup path)
+    p = str(tmp_path / "tune.json")
+    _write_db(p, "plancache_bucket", tune.GLOBAL_KEY,
+              {"scheme": "pow2_half"})
+    monkeypatch.setenv(tune.ENV_SWITCH, "1")
+    monkeypatch.setenv("PRESTO_TPU_TUNE_DB", p)
+    assert quantize_nsamp(100000) == 98304 + 32768   # 1.5 * 2^16
+    assert tune.stats()["hits"] == 1
+
+
+# ----------------------------------------------------------------------
+# CLI + acceptance e2e
+# ----------------------------------------------------------------------
+
+def test_cli_list_and_device_report(tmp_path, capsys):
+    from presto_tpu.apps import tune as tapp
+    assert tapp.main(["--list"]) == 0
+    out = capsys.readouterr().out
+    assert "accel_pallas_tile" in out and "plancache_bucket" in out
+    assert tapp.main(["--device-report",
+                      "--db", str(tmp_path / "t.json")]) == 0
+    rep = json.loads(capsys.readouterr().out)
+    assert rep["fingerprint"]["platform"]
+    assert rep["db_records"] == 0
+
+
+@pytest.fixture(scope="module")
+def smoke_db(tmp_path_factory):
+    """One smoke sweep shared by the acceptance tests below."""
+    from presto_tpu.apps import tune as tapp
+    p = str(tmp_path_factory.mktemp("tunedb") / "tune.json")
+    tune.reset()
+    assert tapp.main(["--smoke", "--db", p]) == 0
+    tune.reset()
+    return p
+
+
+def test_smoke_populates_db(smoke_db):
+    db = TuneDB.load(smoke_db)
+    assert db.load_error is None
+    fams = db.families(fingerprint_key())
+    # every CPU-safe family landed at least one record
+    for family in ("accel_pallas_tile", "harmonic_sum_layout",
+                   "dedisp_dm_batch", "oocfft_block",
+                   "plancache_bucket"):
+        assert fams.get(family), family
+    # recorded configs are drawn from the declared candidate sets
+    tile = fams["accel_pallas_tile"]
+    assert all(rec["config"]["tile"] in (128, 256)
+               for rec in tile.values())
+    assert fams["plancache_bucket"]["*"]["config"]["scheme"] in (
+        "pow2", "pow2_half", "pow2_quarter")
+
+
+N, NCHAN, DT = 1 << 13, 16, 2e-4
+
+
+@pytest.fixture(scope="module")
+def tiny_fil(tmp_path_factory):
+    from presto_tpu.models.synth import FakeSignal, \
+        fake_filterbank_file
+    d = tmp_path_factory.mktemp("tunefil")
+    raw = str(d / "psr.fil")
+    sig = FakeSignal(f=17.0, dm=10.0, shape="gauss", width=0.08,
+                     amp=0.8)
+    fake_filterbank_file(raw, N, DT, NCHAN, 400.0, 1.0, sig,
+                         noise_sigma=2.0, nbits=8)
+    return raw
+
+
+def _survey_cfg(**kw):
+    from presto_tpu.pipeline.survey import SurveyConfig
+    base = dict(lodm=5.0, hidm=12.0, nsub=16, zmax=0, numharm=2,
+                sigma=3.0, fold_top=0, rfi_time=0.4,
+                singlepulse=False)
+    base.update(kw)
+    return SurveyConfig(**base)
+
+
+def _artifact_bytes(work):
+    out = {}
+    for pat in ("*.dat", "*.fft", "*_ACCEL_0", "*_ACCEL_0.cand"):
+        for p in glob.glob(os.path.join(work, pat)):
+            with open(p, "rb") as f:
+                out[os.path.basename(p)] = f.read()
+    return out
+
+
+def test_survey_tuned_outputs_byte_identical(tiny_fil, smoke_db,
+                                             tmp_path, monkeypatch):
+    """ACCEPTANCE: a survey with PRESTO_TPU_TUNE=1 consults the
+    smoke-populated DB (tune_db_hits_total > 0) and its artifacts are
+    byte-identical to the untuned run; tuned.json provenance lands in
+    the workdir and presto-report renders it."""
+    from presto_tpu.apps import report as rapp
+    from presto_tpu.obs import ObsConfig, configure
+    from presto_tpu.pipeline.survey import run_survey
+
+    # single-device regime (the real-TPU production shape): the
+    # conftest's 8 virtual CPU devices would otherwise route the DM
+    # fan-out through the sharded step, whose traced delays bypass
+    # the tuned static-slice path entirely
+    monkeypatch.setenv("PRESTO_TPU_DISABLE_MESH", "1")
+    ref_work = str(tmp_path / "untuned")
+    monkeypatch.delenv(tune.ENV_SWITCH, raising=False)
+    run_survey([tiny_fil], _survey_cfg(), workdir=ref_work)
+    assert not os.path.exists(os.path.join(ref_work, "tuned.json"))
+    ref = _artifact_bytes(ref_work)
+    assert any(k.endswith(".dat") for k in ref)
+    assert any(k.endswith(".fft") for k in ref)
+
+    tune.reset()
+    monkeypatch.setenv(tune.ENV_SWITCH, "1")
+    monkeypatch.setenv("PRESTO_TPU_TUNE_DB", smoke_db)
+    obs = configure(ObsConfig(enabled=True))
+    try:
+        tuned_work = str(tmp_path / "tuned")
+        run_survey([tiny_fil], _survey_cfg(), workdir=tuned_work)
+    finally:
+        configure(ObsConfig.from_env())
+    got = _artifact_bytes(tuned_work)
+    assert set(got) == set(ref)
+    for name in sorted(ref):
+        assert got[name] == ref[name], "artifact differs: %s" % name
+
+    # the DB was really consulted, observably
+    st = tune.stats()
+    assert st["hits"] > 0
+    fam = obs.metrics.get("tune_db_hits_total")
+    assert fam is not None and fam.total() > 0
+
+    # provenance written + rendered
+    prov = json.load(open(os.path.join(tuned_work, "tuned.json")))
+    assert prov["fingerprint"] == fingerprint_key()
+    assert prov["stats"]["hits"] == st["hits"]
+    assert "dedisp_dm_batch" in prov["lookups"]
+    assert rapp.main([tuned_work]) == 0
+    info = rapp.collect(tuned_work)
+    assert info["tuning"]["families"]["dedisp_dm_batch"]["db_hits"] \
+        >= 1
+
+
+def test_serve_bucket_key_consults_db(tiny_fil, smoke_db,
+                                      monkeypatch):
+    """ACCEPTANCE (serve side): a serve job's scheduling-bucket
+    computation under PRESTO_TPU_TUNE=1 consults the DB's bucket-edge
+    scheme; the bucket still covers the raw length."""
+    from presto_tpu.serve.plancache import bucket_key
+    monkeypatch.setenv(tune.ENV_SWITCH, "1")
+    monkeypatch.setenv("PRESTO_TPU_TUNE_DB", smoke_db)
+    key = bucket_key([tiny_fil], _survey_cfg())
+    assert key.nsamp >= N
+    st = tune.stats()
+    assert st["hits"] + st["misses"] >= 1
+    prov = tune.provenance()
+    assert "plancache_bucket" in prov
+
+
+def test_survey_with_corrupted_db_degrades(tiny_fil, tmp_path,
+                                           monkeypatch):
+    """ACCEPTANCE: a tuned survey pointed at a corrupted DB completes
+    with default configs (load_error recorded in tuned.json)."""
+    from presto_tpu.pipeline.survey import run_survey
+    monkeypatch.setenv("PRESTO_TPU_DISABLE_MESH", "1")
+    bad = str(tmp_path / "bad.json")
+    with open(bad, "w") as f:
+        f.write('{"schema": 1, "entries"')
+    monkeypatch.setenv(tune.ENV_SWITCH, "1")
+    monkeypatch.setenv("PRESTO_TPU_TUNE_DB", bad)
+    work = str(tmp_path / "work")
+    with pytest.warns(RuntimeWarning):
+        res = run_survey([tiny_fil], _survey_cfg(), workdir=work)
+    assert os.path.exists(res.candfile)
+    prov = json.load(open(os.path.join(work, "tuned.json")))
+    assert prov["db_load_error"]
+    assert prov["stats"]["hits"] == 0
+
+
+def test_bench_tuning_attribution(smoke_db, monkeypatch):
+    """bench.py records the fingerprint + DB configs in its JSON."""
+    import bench
+    monkeypatch.setenv("PRESTO_TPU_TUNE_DB", smoke_db)
+    monkeypatch.setenv(tune.ENV_SWITCH, "1")
+    info = bench.tuning_info()
+    assert info["enabled"] is True
+    assert info["fingerprint"] == fingerprint_key()
+    assert info["db_present"] is True
+    assert "dedisp_dm_batch" in info["db_configs"]
+    monkeypatch.delenv(tune.ENV_SWITCH)
+    tune.reset()
+    assert bench.tuning_info()["enabled"] is False

@@ -23,7 +23,13 @@ code path cannot ship silently:
      telemetry fails here;
   5. every metric registered anywhere in presto_tpu/ or tools/
      (`.counter("..." / .gauge("..." / .histogram("...`) is listed in
-     METRICS (the documented catalog).
+     METRICS (the documented catalog);
+  6. the tune layer (presto_tpu/tune/ + apps/tune.py): every
+     `obs.span("...")` name it opens is registered in TUNE_SPANS —
+     and conversely; and every `tune_*` metric listed in METRICS is
+     actually registered by the tune layer (the forward direction is
+     check 5), so a tuning code path cannot ship unobservable and the
+     catalog cannot list dead tuning telemetry.
 
 Run directly (exit 1 lists violations) or via tests/test_obs_lint.py.
 """
@@ -48,6 +54,7 @@ STATUS_RE = re.compile(r'^\s+([A-Z_]+)\s*=\s*"([a-z-]+)"\s*$',
                        re.MULTILINE)
 METRIC_RE = re.compile(
     r'\.(?:counter|gauge|histogram)\(\s*\n?\s*"([a-z0-9_]+)"')
+SPAN_RE = re.compile(r'\.span\(\s*\n?\s*"([^"]+)"')
 
 
 def _read(relpath: str) -> str:
@@ -165,6 +172,35 @@ def lint() -> List[str]:
                     "%s: metric %r is not listed in "
                     "obs/taxonomy.METRICS (undocumented metric)"
                     % (rel, m))
+
+    # 6. tune layer: spans both ways + tune_* metric reverse direction
+    tune_srcs = _tree_sources("presto_tpu/tune")
+    try:
+        tune_srcs["presto_tpu/apps/tune.py"] = \
+            _read("presto_tpu/apps/tune.py")
+    except OSError:
+        pass
+    tspans: Set[str] = set()
+    tmetrics: Set[str] = set()
+    for rel, src in sorted(tune_srcs.items()):
+        spans = set(SPAN_RE.findall(src))
+        tspans |= spans
+        tmetrics |= set(METRIC_RE.findall(src))
+        for s in sorted(spans - taxonomy.TUNE_SPANS):
+            problems.append(
+                "%s: span %r is not registered in "
+                "obs/taxonomy.TUNE_SPANS (uninstrumented tuning "
+                "path)" % (rel, s))
+    for s in sorted(taxonomy.TUNE_SPANS - tspans):
+        problems.append(
+            "obs/taxonomy.py: TUNE_SPANS lists %r but the tune layer "
+            "never opens it" % s)
+    cataloged_tune = {m for m in taxonomy.METRICS
+                      if m.startswith("tune_")}
+    for m in sorted(cataloged_tune - tmetrics):
+        problems.append(
+            "obs/taxonomy.py: METRICS lists %r but the tune layer "
+            "never registers it" % m)
     return problems
 
 
