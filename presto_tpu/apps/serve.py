@@ -46,6 +46,10 @@ def build_parser():
                    help="Compiled-plan cache capacity (LRU)")
     p.add_argument("-events", type=str, default=None,
                    help="Append structured JSON events to this file")
+    p.add_argument("-heartbeat", type=float, default=0.0,
+                   help="Emit a heartbeat event on /events every this "
+                        "many seconds (0 = off) so subscribers can "
+                        "tell a quiet service from a dead one")
     p.add_argument("-tracedir", type=str, default=None,
                    help="Export spans here (spans.jsonl + Perfetto "
                         "trace.perfetto.json); metrics/flight "
@@ -68,6 +72,7 @@ def main(argv=None) -> int:
                             plan_capacity=args.plans,
                             scheduler_cfg=scfg,
                             events_path=args.events,
+                            heartbeat_s=args.heartbeat,
                             obs_config=ObsConfig(
                                 enabled=True,
                                 trace_dir=args.tracedir,

@@ -23,9 +23,12 @@ import numpy as np
 
 from presto_tpu.io.atomic import atomic_write_text
 
-#: reasons a stretch of spectra can be quarantined
+#: reasons a stretch of spectra can be quarantined.  "ring-drop" and
+#: "stall" belong to the live-feed path (presto_tpu/stream/source.py):
+#: blocks shed under ring-buffer backpressure, and zero-fill inserted
+#: to hold real-time cadence across a producer stall.
 REASONS = ("nan-inf", "zero-fill", "truncated", "dropped-rows",
-           "short-read")
+           "short-read", "ring-drop", "stall")
 
 #: minimum run of consecutive all-zero spectra flagged as zero-fill.
 #: Real zero-fill (backend dropouts, padded gaps) comes in long runs;

@@ -13,6 +13,7 @@ data has both a NumPy path and (when built) a C++ fast path
 
 from __future__ import annotations
 
+import io
 import os
 import struct
 from dataclasses import dataclass, field
@@ -165,11 +166,18 @@ def read_filterbank_header(f: BinaryIO,
             "invalid SIGPROC geometry (nchans=%d nifs=%d nbits=%d)"
             % (hdr.nchans, hdr.nifs, hdr.nbits), path=path,
             kind="bad-header")
-    pos = f.tell()
-    f.seek(0, os.SEEK_END)
-    filelen = f.tell()
-    f.seek(pos)
-    hdr.N = (filelen - hdr.headerlen) * 8 // (hdr.nbits * hdr.nchans * hdr.nifs)
+    try:
+        pos = f.tell()
+        f.seek(0, os.SEEK_END)
+        filelen = f.tell()
+        f.seek(pos)
+        hdr.N = (filelen - hdr.headerlen) * 8 \
+            // (hdr.nbits * hdr.nchans * hdr.nifs)
+    except (OSError, io.UnsupportedOperation):
+        # unseekable stream (live socket/pipe feed): the observation
+        # length is unknown until EOF — N stays 0 and the streaming
+        # consumer accounts spectra as they arrive
+        hdr.N = 0
     return hdr
 
 
@@ -220,6 +228,26 @@ def pack_bits(data: np.ndarray, nbits: int) -> np.ndarray:
     if nbits == 1:
         return np.packbits(d.reshape(-1, 8), axis=1, bitorder="big").ravel()
     raise ValueError("unsupported nbits=%d" % nbits)
+
+
+def decode_spectra_block(hdr: FilterbankHeader, raw: np.ndarray,
+                         nspec: int) -> np.ndarray:
+    """Packed filterbank bytes -> [nspec, nchans] float32, channels in
+    ASCENDING frequency order.  The one decode sequence shared by the
+    file reader, the prefetched feeder path, and the live socket /
+    file-tail producers (presto_tpu/stream/source.py): native decoder
+    when available, numpy unpack + IF-sum + descending-band flip
+    otherwise."""
+    arr = native.decode_spectra(raw, nspec, hdr.nifs, hdr.nchans,
+                                hdr.nbits, hdr.foff < 0)
+    if arr is None:
+        vals = unpack_bits(raw, hdr.nbits)
+        arr = vals.astype(np.float32).reshape(nspec, hdr.nifs,
+                                              hdr.nchans)
+        arr = arr.sum(axis=1) if hdr.nifs > 1 else arr[:, 0, :]
+        if hdr.foff < 0:
+            arr = np.ascontiguousarray(arr[:, ::-1])
+    return arr
 
 
 class FilterbankFile:
@@ -313,17 +341,7 @@ class FilterbankFile:
         """Packed bytes -> [nspec, nchans] float32 ascending (the ONE
         decode sequence shared by the random-access and prefetched
         read paths)."""
-        hdr = self.header
-        arr = native.decode_spectra(raw, nspec, hdr.nifs, hdr.nchans,
-                                    hdr.nbits, hdr.foff < 0)
-        if arr is None:
-            vals = unpack_bits(raw, hdr.nbits)
-            arr = vals.astype(np.float32).reshape(nspec, hdr.nifs,
-                                                  hdr.nchans)
-            arr = arr.sum(axis=1) if hdr.nifs > 1 else arr[:, 0, :]
-            if hdr.foff < 0:
-                arr = np.ascontiguousarray(arr[:, ::-1])
-        return arr
+        return decode_spectra_block(self.header, raw, nspec)
 
     def iter_blocks(self, block_size: int,
                     start: int = 0) -> Iterator[np.ndarray]:

@@ -79,7 +79,9 @@ CLUSTER_EVENTS = frozenset({
 })
 
 #: serve event kinds — every `events.emit("<kind>", ...)` in
-#: presto_tpu/serve/*.py
+#: presto_tpu/serve/*.py ("heartbeat" is emitted by the EventLog's own
+#: heartbeat thread so /events subscribers can tell a quiet service
+#: from a dead one)
 SERVE_EVENTS = frozenset({
     "enqueue",
     "schedule",
@@ -93,6 +95,27 @@ SERVE_EVENTS = frozenset({
     "plan-evict",
     "scheduler-error",
     "http",
+    "heartbeat",
+})
+
+#: streaming-layer event kinds — every `events.emit("<kind>", ...)`
+#: in presto_tpu/stream/ (enforced both directions by obs_lint check
+#: 7: the live trigger path may not emit unregistered kinds, and the
+#: catalog may not list dead ones)
+STREAM_EVENTS = frozenset({
+    "stream-start",
+    "stream-eof",
+    "stream-drop",
+    "stream-quarantine",
+    "trigger",
+})
+
+#: streaming-layer span names — every `obs.span("stream:...")` in
+#: presto_tpu/stream/ (both directions, like TUNE_SPANS)
+STREAM_SPANS = frozenset({
+    "stream:block",
+    "stream:dedisp",
+    "stream:search",
 })
 
 #: job lifecycle states -> the event kind that announces the
@@ -175,4 +198,15 @@ METRICS = frozenset({
     "tune_candidates_pruned_total",
     "tune_candidates_quarantined_total",
     "tune_sweep_seconds",
+    # scheduler lanes (serve/scheduler.py)
+    "serve_lane_batches_total",
+    # streaming search (presto_tpu/stream); every stream_* name here
+    # must be registered by the stream layer (obs_lint check 7)
+    "stream_blocks_total",
+    "stream_candidates_total",
+    "stream_triggers_total",
+    "stream_drops_total",
+    "stream_gap_spectra_total",
+    "stream_backlog_blocks",
+    "stream_latency_seconds",
 })

@@ -617,6 +617,258 @@ def _collect_chunk_hits(vals_c, idx_c, counts_c, chunknum, widths,
                                      downfact=df, dm=dm))
 
 
+class SinglePulseStream:
+    """Incremental (online) single-pulse search over a growing series.
+
+    The explicit-carry counterpart of :meth:`SinglePulseSearch.search`:
+    feed dedispersed samples as they arrive and get back candidates as
+    soon as they are *final* — i.e. no future sample can change them —
+    instead of waiting for the whole observation.  This is the state
+    the streaming trigger path (presto_tpu/stream/rolling.py) and any
+    future drift-scan search share; the batch path stays the reference
+    implementation.
+
+    Equivalence contract: fed the same samples (in any chunking) as a
+    batch ``search.search(ts, dt, dm)`` sees, the concatenation of
+    every ``feed()`` result plus ``flush()`` is the same candidate set,
+    PROVIDED ``search.badblocks`` is False (the batch bad-block cut
+    ranks every block's std against the *whole observation's*
+    distribution, which no online pass can know; construct the search
+    with ``badblocks=False``) and no detrend block has near-zero
+    variance (the batch zero-variance guard compares against the
+    global median std — here the cut uses the *running* median, see
+    ``_absorb_detrended``).  The carry reproduces the batch path's
+    exact geometry: detrend blocks of ``detrendlen``, matched-filter
+    chunks of ``chunklen`` with ``overlap`` margins, per-(chunk,width)
+    ``prune_related1``, and ``prune_related2`` over bin-sorted
+    candidates — made incremental by the chain-segment argument: the
+    greedy cross-width prune only couples candidates through adjacent
+    (sorted) pairs within ``maxdf//2`` bins, so a run of candidates
+    separated from everything later by a larger gap is final.
+
+    Dedup across block seams: a chunk is only searched once the NEXT
+    chunk's samples exist (so its right overlap holds real data exactly
+    like the batch padded buffer), and candidates within ``maxdf//2``
+    bins of un-searched territory are held pending — no candidate is
+    ever emitted twice or differently from the batch path.
+    """
+
+    def __init__(self, search: SinglePulseSearch, dt: float,
+                 dm: float = 0.0,
+                 downfacts: Optional[Sequence[int]] = None):
+        if search.badblocks:
+            raise ValueError(
+                "SinglePulseStream requires badblocks=False: the batch "
+                "bad-block cut needs the whole observation's std "
+                "distribution (see class docstring)")
+        self.search = search
+        self.dt = float(dt)
+        self.dm = float(dm)
+        if downfacts is None:
+            downfacts = search.downfacts_for(dt)
+        (self.widths, self.chunklen, self.fftlen, self.overlap,
+         self._kern_pairs) = search._chunk_geometry(
+            widths=[1] + list(downfacts))
+        self.maxdf = max(self.widths)
+        self.dlen = search.detrendlen
+        self._k = min(search.topk, self.chunklen)
+        self._tail = np.zeros(0, np.float32)    # raw, < detrendlen
+        self._nfed = 0                          # raw samples fed
+        self._nnormed = 0                       # normalized samples
+        self._nbuf = np.zeros(0, np.float32)    # normalized suffix
+        self._nbuf_start = 0                    # abs index of _nbuf[0]
+        self._next_chunk = 0
+        self._pending: List[SPCandidate] = []
+        self._stds: List[float] = []
+        self._bad: set = set()                  # bad detrend blocks
+        self._offregions: List[Tuple[int, int]] = []
+        self._flushed = False
+
+    # -- carry state views --------------------------------------------
+    @property
+    def stds(self) -> np.ndarray:
+        """Per-detrend-block stds seen so far (the running carry the
+        batch path returns all at once)."""
+        return np.asarray(self._stds, np.float32)
+
+    @property
+    def bad_blocks(self) -> np.ndarray:
+        return np.asarray(sorted(self._bad), np.int64)
+
+    @property
+    def samples_fed(self) -> int:
+        return self._nfed
+
+    @property
+    def pending(self) -> int:
+        """Candidates held back pending cross-seam dedup."""
+        return len(self._pending)
+
+    def emission_floor(self) -> int:
+        """Lower bound (bin) on every candidate this stream can still
+        emit: future chunks produce bins >= next_chunk*chunklen, the
+        chain guard can reach maxdf//2 below that, and held pending
+        candidates may sit lower still.  Consumers clustering across
+        streams (stream/rolling's trigger dedup) emit a cluster only
+        once every contributing stream's floor has passed it."""
+        floor = self._next_chunk * self.chunklen - self.maxdf // 2
+        if self._pending:
+            floor = min(floor, min(c.bin for c in self._pending))
+        return floor
+
+    def add_offregion(self, lo: int, hi: int) -> None:
+        """Register a data/padding boundary region (normalized-series
+        bins) for border pruning; must be added before the region's
+        candidates finalize (the streaming caller learns of dropouts
+        while the affected samples are still upstream of the search
+        frontier, so this holds by construction)."""
+        self._offregions.append((int(lo), int(hi)))
+
+    # -- feeding ------------------------------------------------------
+    def feed(self, x: np.ndarray) -> List[SPCandidate]:
+        """Append raw dedispersed samples; returns newly-final
+        candidates (bin-sorted, pruned exactly like the batch path)."""
+        if self._flushed:
+            raise RuntimeError("stream already flushed")
+        x = np.asarray(x, np.float32).ravel()
+        buf = np.concatenate([self._tail, x]) if self._tail.size else x
+        nblk = buf.size // self.dlen
+        if nblk:
+            blocks = buf[:nblk * self.dlen].reshape(nblk, self.dlen)
+            resid, stds = _detrend_blocks(jnp.asarray(blocks),
+                                          self.dlen,
+                                          self.search.fast_detrend)
+            self._absorb_detrended(np.asarray(resid), np.asarray(stds))
+        self._tail = buf[nblk * self.dlen:]
+        self._nfed += x.size
+        ready = []
+        while self._nnormed >= (self._next_chunk + 2) * self.chunklen:
+            ready.append(self._next_chunk)
+            self._next_chunk += 1
+        if ready:
+            # mid-stream a chunk is searched only when the next chunk's
+            # samples exist, so its window is all real data — exactly
+            # what the batch padded buffer holds for a non-final chunk
+            self._search_chunks(ready, limit=self._nnormed,
+                                ncut=None)
+        return self._finalize(final=False)
+
+    def flush(self) -> List[SPCandidate]:
+        """End of stream: search the remaining chunks with the batch
+        path's zero padding, emit everything still pending.  The raw
+        tail below one detrend block is dropped, matching the batch
+        truncation to a whole number of detrend blocks."""
+        if self._flushed:
+            return []
+        self._flushed = True
+        self._tail = np.zeros(0, np.float32)
+        N = self._nnormed
+        if N == 0:
+            self._pending = []
+            return []
+        numchunks = max(N // self.chunklen, 1)
+        ready = list(range(self._next_chunk, numchunks))
+        self._next_chunk = numchunks
+        if ready:
+            self._search_chunks(
+                ready, limit=min(N, numchunks * self.chunklen), ncut=N)
+        return self._finalize(final=True)
+
+    # -- internals ----------------------------------------------------
+    def _absorb_detrended(self, resid: np.ndarray,
+                          stds: np.ndarray) -> None:
+        """Normalize freshly-detrended blocks.  Zero-variance guard:
+        the batch path cuts stds <= 1e-4 x the observation-wide median
+        — online, the median of every block seen so far stands in (the
+        only divergence from batch, and only for degenerate blocks)."""
+        base = len(self._stds)
+        self._stds.extend(float(s) for s in stds)
+        medstd = float(np.median(np.asarray(self._stds)))
+        bad = np.flatnonzero(stds <= 1e-4 * medstd)
+        adj = np.where(stds <= 0.0, 1.0, stds)
+        normed = resid / adj[:, None]
+        normed[bad] = 0.0
+        for r in bad:
+            self._bad.add(base + int(r))
+        self._nbuf = (np.concatenate([self._nbuf, normed.reshape(-1)])
+                      if self._nbuf.size else normed.reshape(-1))
+        self._nnormed += normed.size
+
+    def _chunk_row(self, c: int, limit: int) -> np.ndarray:
+        """The batch padded-buffer window for chunk `c`: normalized
+        samples [c*chunklen - overlap, +fftlen), zeros outside
+        [0, limit)."""
+        row = np.zeros(self.fftlen, np.float32)
+        lo = c * self.chunklen - self.overlap
+        a = max(lo, 0)
+        b = min(lo + self.fftlen, limit)
+        if b > a:
+            row[a - lo:b - lo] = \
+                self._nbuf[a - self._nbuf_start:b - self._nbuf_start]
+        return row
+
+    def _search_chunks(self, chunks: List[int], limit: int,
+                       ncut: Optional[int]) -> None:
+        rows = [self._chunk_row(c, limit) for c in chunks]
+        # pad the group to a power-of-two row count: one jit shape per
+        # bucket instead of one per distinct ready-chunk count
+        B = 1
+        while B < len(rows):
+            B *= 2
+        rows += [np.zeros(self.fftlen, np.float32)] * (B - len(rows))
+        vals, idx, counts = _convolve_topk(
+            np.stack(rows), self._kern_pairs,
+            np.float32(self.search.threshold), self.fftlen,
+            self.overlap, self._k)
+        vals = np.asarray(vals)
+        idx = np.asarray(idx)
+        counts = np.asarray(counts)
+        # ncut None: mid-stream no bin can reach the eventual N (bins
+        # are < (c+1)*chunklen <= nnormed at search time, and N only
+        # grows) — the batch bb >= N guard cannot fire, skip it
+        N = (1 << 62) if ncut is None else ncut
+        for ri, c in enumerate(chunks):
+            _collect_chunk_hits(vals[ri], idx[ri], counts[ri], c,
+                                self.widths, self.chunklen, N,
+                                self.dt, self.dm, self._pending)
+        # drop normalized samples no chunk will need again
+        keep_from = max(self._next_chunk * self.chunklen - self.overlap,
+                        0)
+        if keep_from > self._nbuf_start:
+            self._nbuf = self._nbuf[keep_from - self._nbuf_start:]
+            self._nbuf_start = keep_from
+
+    def _finalize(self, final: bool) -> List[SPCandidate]:
+        """Emit candidates no future sample can affect.  Future
+        candidates all land at bins >= next_chunk*chunklen, and the
+        greedy cross-width prune couples candidates only through
+        adjacent sorted pairs within maxdf//2 bins — so chain segments
+        ending before that frontier minus maxdf//2 prune identically
+        to the batch path's single global pass."""
+        if not self._pending:
+            return []
+        self._pending.sort()
+        frontier = self._next_chunk * self.chunklen
+        guard = self.maxdf // 2
+        out: List[SPCandidate] = []
+        keep: List[SPCandidate] = []
+        seg: List[SPCandidate] = []
+        for c in self._pending + [None]:
+            if c is not None and (not seg
+                                  or c.bin - seg[-1].bin <= guard):
+                seg.append(c)
+                continue
+            if seg:
+                if final or seg[-1].bin < frontier - guard:
+                    out.extend(prune_related2(seg, self.widths))
+                else:
+                    keep.extend(seg)
+            seg = [c] if c is not None else []
+        self._pending = keep
+        return self.search._post_filter(out, self.bad_blocks,
+                                        tuple(self._offregions))
+
+
 def write_singlepulse(path: str, cands: Sequence[SPCandidate]) -> None:
     """Write the .singlepulse ASCII artifact (reference column format,
     atomic on disk)."""

@@ -16,7 +16,7 @@ import itertools
 import threading
 import time
 from dataclasses import dataclass, field
-from typing import Any, List, Optional, Tuple
+from typing import Any, Callable, List, Optional, Tuple
 
 
 class QueueFull(RuntimeError):
@@ -30,6 +30,23 @@ class QueueClosed(RuntimeError):
 class RetryBudgetExceeded(RuntimeError):
     """A job was re-admitted more than max_retry_depth times: a
     poisoned job must terminate, not cycle the queue forever."""
+
+
+class Lanes:
+    """Scheduler lanes: two SLO classes sharing one process/device.
+
+    DEADLINE jobs (the live-telescope trigger path) sort ahead of
+    every THROUGHPUT job regardless of priority — a batch survey and a
+    live feed share the scheduler without the feed waiting behind a
+    queue of surveys.  There is no preemption: a deadline job still
+    waits out the currently-executing job, so the deadline lane's SLO
+    floor is the longest single throughput execution (see
+    docs/STREAMING.md, lane semantics).
+    """
+    DEADLINE = "deadline"
+    THROUGHPUT = "throughput"
+
+    ORDER = {DEADLINE: 0, THROUGHPUT: 1}
 
 
 class JobStatus:
@@ -52,9 +69,13 @@ class Job:
     rawfiles: List[str]
     cfg: Any                       # pipeline.survey.SurveyConfig
     workdir: str
-    priority: int = 10             # lower sorts first
+    priority: int = 10             # lower sorts first (within a lane)
     bucket: Any = None             # plancache.bucket_key() result
     spec: dict = field(default_factory=dict)   # raw submitted spec
+    lane: str = Lanes.THROUGHPUT   # deadline | throughput (Lanes)
+    #: in-process callable jobs (the streaming tick): when set, the
+    #: service executes run(job) instead of a survey
+    run: Optional[Callable] = None
     status: str = JobStatus.QUEUED
     attempts: int = 0
     requeues: int = 0              # retry re-admissions so far
@@ -69,6 +90,7 @@ class Job:
         return {
             "job_id": self.job_id,
             "status": self.status,
+            "lane": self.lane,
             "priority": self.priority,
             "bucket": repr(self.bucket),
             "attempts": self.attempts,
@@ -105,17 +127,27 @@ class JobQueue:
 
     depth = __len__
 
+    def _key(self, job: Job) -> Tuple[int, int, int]:
+        """Heap key: lane beats priority beats arrival — deadline-lane
+        jobs always pop before throughput jobs."""
+        return (Lanes.ORDER.get(job.lane, 1), job.priority,
+                next(self._count))
+
     def submit(self, job: Job, block: bool = False,
-               timeout: Optional[float] = None) -> None:
+               timeout: Optional[float] = None,
+               force: bool = False) -> None:
         """Enqueue `job`.  Non-blocking by default: raises QueueFull at
         the depth bound (the server maps this to HTTP 429).  With
-        block=True, waits up to `timeout` seconds for a slot."""
+        block=True, waits up to `timeout` seconds for a slot.
+        force=True bypasses the depth bound — reserved for the
+        deadline lane's (self-bounded) stream ticks, which must not be
+        shed behind a backlog of throughput submissions."""
         deadline = None if timeout is None else time.time() + timeout
         with self._lock:
             while True:
                 if self._closed:
                     raise QueueClosed("queue is closed")
-                if len(self._heap) < self.maxdepth:
+                if force or len(self._heap) < self.maxdepth:
                     break
                 if not block:
                     raise QueueFull(
@@ -130,8 +162,7 @@ class JobQueue:
             job.status = JobStatus.QUEUED
             if not job.submitted:
                 job.submitted = time.time()
-            heapq.heappush(self._heap,
-                           (job.priority, next(self._count), job))
+            heapq.heappush(self._heap, self._key(job) + (job,))
             self._not_empty.notify()
 
     def requeue(self, job: Job) -> None:
@@ -153,8 +184,7 @@ class JobQueue:
                        self.max_retry_depth))
             job.requeues += 1
             job.status = JobStatus.QUEUED
-            heapq.heappush(self._heap,
-                           (job.priority, next(self._count), job))
+            heapq.heappush(self._heap, self._key(job) + (job,))
             self._not_empty.notify()
 
     def pop_batch(self, max_batch: int = 8,
@@ -172,17 +202,18 @@ class JobQueue:
                 if self._closed:
                     raise QueueClosed("queue is closed")
                 return []
-            _, _, head = heapq.heappop(self._heap)
+            head = heapq.heappop(self._heap)[-1]
             batch = [head]
             if max_batch > 1:
                 keep, take = [], []
                 for entry in sorted(self._heap):
                     if (len(batch) + len(take) < max_batch
-                            and entry[2].bucket == head.bucket):
+                            and entry[-1].bucket == head.bucket
+                            and entry[-1].lane == head.lane):
                         take.append(entry)
                     else:
                         keep.append(entry)
-                batch += [e[2] for e in take]
+                batch += [e[-1] for e in take]
                 self._heap = keep
                 heapq.heapify(self._heap)
             for j in batch:

@@ -118,6 +118,9 @@ class Scheduler:
         self._c_deverr = reg.counter(
             "serve_device_errors_total",
             "Job failures classified as device/executable errors")
+        self._c_lanes = reg.counter(
+            "serve_lane_batches_total",
+            "Micro-batches executed per scheduler lane", ("lane",))
         self._g_retrywait = reg.gauge(
             "serve_retry_waiting", "Jobs on the retry backoff shelf")
 
@@ -221,9 +224,11 @@ class Scheduler:
     def _run_batch(self, batch: List[Job]) -> None:
         self._c_batches.inc()
         self._c_batched.inc(len(batch))
+        self._c_lanes.labels(lane=batch[0].lane).inc()
         if self.events is not None:
             self.events.emit("schedule", jobs=[j.job_id for j in batch],
                              occupancy=len(batch),
+                             lane=batch[0].lane,
                              bucket=repr(batch[0].bucket))
         if self.batch_executor is not None and len(batch) > 1:
             try:

@@ -68,7 +68,7 @@ class SearchService:
                  scheduler_cfg: Optional[SchedulerConfig] = None,
                  events_path: Optional[str] = None, mesh=None,
                  max_retry_depth: Optional[int] = 8, obs=None,
-                 obs_config=None):
+                 obs_config=None, heartbeat_s: float = 0.0):
         from presto_tpu.obs import Observability, ObsConfig
         os.makedirs(workroot, exist_ok=True)
         self.workroot = os.path.abspath(workroot)
@@ -79,6 +79,8 @@ class SearchService:
             obs_config or ObsConfig(enabled=True,
                                     service="presto-serve"))
         self.events = EventLog(path=events_path)
+        if heartbeat_s > 0:
+            self.events.start_heartbeat(heartbeat_s)
         self.latency = LatencyStats(registry=self.obs.metrics)
         self.queue = JobQueue(maxdepth=queue_depth,
                               max_retry_depth=max_retry_depth)
@@ -159,11 +161,31 @@ class SearchService:
                          depth=len(self.queue))
         return job.view()
 
+    def submit_callable(self, fn, job_id: Optional[str] = None,
+                        lane: str = "deadline", priority: int = 0,
+                        bucket=None) -> Job:
+        """Admit an in-process callable job (the streaming tick):
+        `fn(job)` runs on the scheduler thread in lane order.  Deadline
+        -lane callables bypass the depth bound — they are self-bounded
+        by their submitter (at most one outstanding tick per stream),
+        and shedding them behind a throughput backlog is exactly the
+        SLO inversion the lane exists to prevent."""
+        job = Job(job_id=job_id or "call-%06d" % next(self._ids),
+                  rawfiles=[], cfg=None, workdir=self.workroot,
+                  priority=priority, bucket=bucket, lane=lane, run=fn)
+        self.queue.submit(job, force=(lane == "deadline"))
+        self.events.emit("enqueue", job=job.job_id, lane=lane,
+                         bucket=repr(bucket), priority=priority,
+                         depth=len(self.queue))
+        return job
+
     # ---- job execution (scheduler thread) -----------------------------
 
     def _execute_job(self, job: Job) -> dict:
         """Run one job as a restartable survey in its own workdir,
         feeding the shared per-stage latency percentiles."""
+        if job.run is not None:
+            return job.run(job) or {}
         from presto_tpu.pipeline.survey import run_survey
         timer = StageTimer(stats=self.latency, obs=self.obs)
         res = run_survey(job.rawfiles, job.cfg, workdir=job.workdir,
@@ -324,9 +346,22 @@ class _Handler(BaseHTTPRequestHandler):
                 else:
                     self._json(200, self.service.metrics())
             elif url.path == "/events":
-                n = int(parse_qs(url.query).get("n", ["100"])[0])
-                self._json(200,
-                           {"events": self.service.events.tail(n)})
+                q = parse_qs(url.query)
+                n = int(q.get("n", ["100"])[0])
+                log = self.service.events
+                if "since" in q:
+                    # resume-from-cursor: a reconnecting trigger
+                    # consumer passes its last seen seq and gets every
+                    # later event exactly once; `lost` > 0 flags events
+                    # that aged out of the ring while it was gone
+                    evs, lost, latest = log.since(
+                        int(q["since"][0]), limit=n)
+                    self._json(200, {"events": evs, "lost": lost,
+                                     "cursor": latest})
+                else:
+                    evs = log.tail(n)
+                    self._json(200, {"events": evs,
+                                     "cursor": log.cursor()})
             elif len(parts) == 2 and parts[0] == "jobs":
                 view = self.service.status(parts[1])
                 if view is None:

@@ -1,0 +1,31 @@
+"""presto_tpu.stream — real-time streaming search (live-telescope
+scenario).
+
+Turns the file-at-rest pipeline into a live FRB/single-pulse trigger
+engine with a bounded latency budget:
+
+  * source.py  — bounded ring-buffer block source behind the reader
+    seam, fed by a socket or file-tail producer; backpressure with
+    drop accounting, dropout quarantine via io/quality.
+  * rolling.py — rolling dedispersion over the DM grid using the
+    two-block carry from ops/dedispersion, plus incremental
+    single-pulse triggering (search/singlepulse.SinglePulseStream)
+    that matches the batch search on the same bytes.
+  * service.py — the presto-stream CLI and the deadline-lane glue
+    into the serve scheduler; triggers stream on serve's /events.
+
+See docs/STREAMING.md for the architecture and the latency budget.
+"""
+
+from presto_tpu.stream.rolling import (RollingDedisp, StreamConfig,
+                                       StreamSearch, Trigger)
+from presto_tpu.stream.source import (FileTailProducer,
+                                      RingBlockSource, SocketProducer,
+                                      StreamBlock, feed_stream)
+from presto_tpu.stream.service import StreamService
+
+__all__ = [
+    "RollingDedisp", "StreamConfig", "StreamSearch", "Trigger",
+    "FileTailProducer", "RingBlockSource", "SocketProducer",
+    "StreamBlock", "feed_stream", "StreamService",
+]

@@ -149,6 +149,40 @@ class TransientFaults:
                            % (getattr(job, "job_id", "?"), attempt))
 
 
+class StreamFaults:
+    """Live-feed fault schedule: the producer-side chaos seam for
+    presto_tpu/stream (feed_stream / FileTailProducer call this as
+    faults(spectra_pushed_so_far) before every read).
+
+    schedule: list of (at_spectra, kind, arg) triples, fired once each
+    when the feed position passes `at_spectra`:
+
+      ("stall", seconds)   — sleep, simulating a wedged backend; with
+                             a source stall_timeout the gap becomes
+                             quarantined zero fill.
+      ("raise", exc)       — die mid-stream (connection loss); the
+                             source quarantines the partial spectrum
+                             and EOFs.
+    """
+
+    def __init__(self, schedule):
+        self.schedule = sorted(
+            (int(at), kind, arg) for at, kind, arg in schedule)
+        self.fired: List[tuple] = []
+
+    def __call__(self, pushed: int) -> None:
+        while self.schedule and self.schedule[0][0] <= pushed:
+            at, kind, arg = self.schedule.pop(0)
+            self.fired.append((at, kind, arg))
+            if kind == "stall":
+                time.sleep(float(arg))
+            elif kind == "raise":
+                raise (arg if isinstance(arg, BaseException)
+                       else RuntimeError(str(arg)))
+            else:
+                raise ValueError("unknown stream fault %r" % kind)
+
+
 # ----------------------------------------------------------------------
 # On-disk corruption (ingest fuzzing)
 # ----------------------------------------------------------------------

@@ -29,7 +29,13 @@ code path cannot ship silently:
      and conversely; and every `tune_*` metric listed in METRICS is
      actually registered by the tune layer (the forward direction is
      check 5), so a tuning code path cannot ship unobservable and the
-     catalog cannot list dead tuning telemetry.
+     catalog cannot list dead tuning telemetry;
+  7. the streaming layer (presto_tpu/stream/): spans vs STREAM_SPANS
+     and event kinds vs STREAM_EVENTS, BOTH directions, plus every
+     `stream_*` metric listed in METRICS registered by the stream
+     layer — the live trigger path is the one place an unobservable
+     code path costs real pulses, so its whole telemetry vocabulary
+     is pinned.
 
 Run directly (exit 1 lists violations) or via tests/test_obs_lint.py.
 """
@@ -148,9 +154,14 @@ def lint() -> List[str]:
                 "%s: event kind %r is not registered in "
                 "obs/taxonomy.SERVE_EVENTS" % (rel, k))
 
-    # 4. every job lifecycle state announces itself
+    # 4. every job lifecycle state announces itself (scoped to the
+    # JobStatus class body: queue.py also defines the Lanes constants,
+    # which are scheduling classes, not lifecycle states)
     queue_src = serve_srcs.get("presto_tpu/serve/queue.py", "")
-    states = {v for _name, v in STATUS_RE.findall(queue_src)}
+    m = re.search(r'class JobStatus.*?(?=\nclass |\Z)', queue_src,
+                  re.DOTALL)
+    states = {v for _name, v in STATUS_RE.findall(m.group(0) if m
+                                                  else queue_src)}
     for state in sorted(states):
         kind = taxonomy.JOB_STATE_EVENTS.get(state)
         if kind is None:
@@ -200,6 +211,41 @@ def lint() -> List[str]:
     for m in sorted(cataloged_tune - tmetrics):
         problems.append(
             "obs/taxonomy.py: METRICS lists %r but the tune layer "
+            "never registers it" % m)
+
+    # 7. streaming layer: spans + events both ways, stream_* metric
+    # reverse direction (forward is check 5)
+    stream_srcs = _tree_sources("presto_tpu/stream")
+    sspans: Set[str] = set()
+    sevents: Set[str] = set()
+    smetrics: Set[str] = set()
+    for rel, src in sorted(stream_srcs.items()):
+        spans = set(SPAN_RE.findall(src))
+        sspans |= spans
+        sevents |= set(EMIT_RE.findall(src))
+        smetrics |= set(METRIC_RE.findall(src))
+        for s in sorted(spans - taxonomy.STREAM_SPANS):
+            problems.append(
+                "%s: span %r is not registered in "
+                "obs/taxonomy.STREAM_SPANS (uninstrumented streaming "
+                "path)" % (rel, s))
+    for s in sorted(taxonomy.STREAM_SPANS - sspans):
+        problems.append(
+            "obs/taxonomy.py: STREAM_SPANS lists %r but the stream "
+            "layer never opens it" % s)
+    for k in sorted(sevents - taxonomy.STREAM_EVENTS):
+        problems.append(
+            "stream layer: event kind %r is not registered in "
+            "obs/taxonomy.STREAM_EVENTS" % k)
+    for k in sorted(taxonomy.STREAM_EVENTS - sevents):
+        problems.append(
+            "obs/taxonomy.py: STREAM_EVENTS lists %r but the stream "
+            "layer never emits it" % k)
+    cataloged_stream = {m for m in taxonomy.METRICS
+                        if m.startswith("stream_")}
+    for m in sorted(cataloged_stream - smetrics):
+        problems.append(
+            "obs/taxonomy.py: METRICS lists %r but the stream layer "
             "never registers it" % m)
     return problems
 

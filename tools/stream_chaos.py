@@ -1,0 +1,255 @@
+#!/usr/bin/env python
+"""stream_chaos: dropout trials for the live streaming search.
+
+Three failure classes a live beam feed actually exhibits, each driven
+against a real socket feed with fault injection (testing/chaos
+.StreamFaults + raw-socket truncation), each asserting the streaming
+contract: the service KEEPS RUNNING, every lost spectrum is a
+quarantine ledger entry (io/quality.DataQualityReport) — never a
+silent gap — and pulses outside the damaged window still trigger
+exactly once.
+
+  stall       — the producer freezes mid-stream longer than the
+                source's stall budget: zero fill is inserted (reason
+                "stall") to hold cadence, the late data is discarded
+                on resume, and post-stall pulses still trigger.
+  truncation  — the connection dies mid-spectrum: the partial
+                spectrum is quarantined ("truncated"), the stream
+                EOFs cleanly, pre-cut pulses trigger, and the serve
+                scheduler is still alive to take new work.
+  ring-drop   — a burst feed against a tiny ring: backpressure sheds
+                blocks (drop-oldest), every shed block is quarantined
+                ("ring-drop") and counted, and no trigger duplicates.
+
+Writes the committed STREAM_CHAOS.json verdict:
+
+  python tools/stream_chaos.py --out STREAM_CHAOS.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import socket
+import sys
+import tempfile
+import threading
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+import stream_loadgen  # noqa: E402  (sibling tool: feed synthesis)
+
+
+def _setup(workdir, seed, seconds, npulses, stall_timeout_s=None,
+           ring=64, nchan=32, numdms=5, blocklen=4096,
+           threshold=7.0, use_socket=True):
+    from presto_tpu.serve.server import SearchService
+    from presto_tpu.stream import (RingBlockSource, SocketProducer,
+                                   StreamConfig, StreamService)
+    hdr, wire, truth = stream_loadgen.make_feed(
+        seed=seed, nchan=nchan, dt=5e-4, seconds=seconds,
+        npulses=npulses, dm=45.0)
+    cfg = StreamConfig(lodm=25.0, dmstep=5.0, numdms=numdms, nsub=32,
+                       threshold=threshold, blocklen=blocklen,
+                       ring_capacity=ring,
+                       stall_timeout_s=stall_timeout_s)
+    service = SearchService(os.path.join(workdir, "serve"),
+                            heartbeat_s=0.5)
+    service.start()
+    source = RingBlockSource(capacity=ring, policy="drop-oldest",
+                             stall_timeout_s=stall_timeout_s)
+    producer = (SocketProducer(source).start() if use_socket
+                else None)
+    stream = StreamService(service, source, cfg).start()
+    return hdr, wire, truth, service, source, producer, stream
+
+
+def _triggers(service):
+    return [e for e in service.events.tail(100000)
+            if e["kind"] == "trigger"]
+
+
+def _matched(trigs, truth, tol=0.2):
+    """truth-index -> trigger count (exactly-once check per pulse)."""
+    out = {i: 0 for i in range(len(truth))}
+    for ev in trigs:
+        for i, t in enumerate(truth):
+            if abs(ev["time"] - t) <= tol:
+                out[i] += 1
+                break
+    return out
+
+
+def _scheduler_alive(service) -> bool:
+    """The service must still take and run work after the fault."""
+    done = threading.Event()
+    service.submit_callable(lambda job: done.set() or {},
+                            lane="deadline")
+    return done.wait(10.0)
+
+
+def trial_stall(workdir: str, seed: int = 1) -> dict:
+    """Producer freeze mid-stream, longer than the stall budget."""
+    from presto_tpu.testing.chaos import StreamFaults
+    seconds, npulses = 24.0, 4
+    hdr, wire, truth, service, source, producer, stream = _setup(
+        workdir, seed, seconds, npulses, stall_timeout_s=0.3)
+    # freeze right between the 2nd and 3rd pulse
+    stall_at = int((truth[1] + 1.0) / hdr.tsamp)
+    faults = StreamFaults([(stall_at, "stall", 1.0)])
+    sender = threading.Thread(
+        target=stream_loadgen.send_wire,
+        args=(producer.address, wire, hdr),
+        kwargs=dict(mode="paced", speed=16.0, faults=faults),
+        daemon=True)
+    sender.start()
+    finished = stream.wait(240.0)
+    trigs = _triggers(service)
+    counts = _matched(trigs, truth)
+    q = source.quality.counts()
+    alive = _scheduler_alive(service)
+    # the stall window destroys ~stall-seconds of data around pulse
+    # positions stall_at..+debt; every OTHER pulse must trigger once
+    safe = [i for i, t in enumerate(truth)
+            if not (stall_at * hdr.tsamp - 0.5 <= t
+                    <= stall_at * hdr.tsamp + 2.0)]
+    ok = (finished and stream.failed is None and alive
+          and q.get("stall", 0) > 0
+          and all(counts[i] == 1 for i in safe)
+          and all(c <= 1 for c in counts.values()))
+    service.stop()
+    producer.close()
+    return {"trial": "stall", "ok": bool(ok), "finished": finished,
+            "scheduler_alive": alive, "quarantine": q,
+            "stall_fired": faults.fired != [],
+            "triggers": len(trigs),
+            "pulse_hits": {round(truth[i], 2): c
+                           for i, c in counts.items()},
+            "safe_pulses": [round(truth[i], 2) for i in safe]}
+
+
+def trial_truncation(workdir: str, seed: int = 2) -> dict:
+    """Connection dies mid-spectrum partway through the stream."""
+    seconds, npulses = 24.0, 4
+    hdr, wire, truth, service, source, producer, stream = _setup(
+        workdir, seed, seconds, npulses)
+    bps = hdr.bytes_per_spectrum
+    hdrlen = len(wire) - hdr.N * bps
+    # cut after the 2nd pulse, mid-spectrum (half a spectrum extra)
+    cut_spectra = int((truth[1] + 1.5) / hdr.tsamp)
+    cut = hdrlen + cut_spectra * bps + bps // 2
+
+    def sender():
+        s = socket.create_connection(producer.address)
+        s.sendall(wire[:cut])
+        s.close()
+
+    threading.Thread(target=sender, daemon=True).start()
+    finished = stream.wait(240.0)
+    trigs = _triggers(service)
+    counts = _matched(trigs, truth)
+    q = source.quality.counts()
+    alive = _scheduler_alive(service)
+    margin = 1.5    # dedispersion sweep + detrend/chunk holdback
+    expected = [i for i, t in enumerate(truth)
+                if t < cut_spectra * hdr.tsamp - margin]
+    ok = (finished and stream.failed is None and alive
+          and q.get("truncated", 0) > 0
+          and all(counts[i] == 1 for i in expected)
+          and all(c <= 1 for c in counts.values()))
+    service.stop()
+    producer.close()
+    return {"trial": "truncation", "ok": bool(ok),
+            "finished": finished, "scheduler_alive": alive,
+            "quarantine": q, "cut_at_s": round(cut_spectra
+                                               * hdr.tsamp, 2),
+            "triggers": len(trigs),
+            "pulse_hits": {round(truth[i], 2): c
+                           for i, c in counts.items()},
+            "expected_pulses": [round(truth[i], 2)
+                                for i in expected]}
+
+
+def trial_ringdrop(workdir: str, seed: int = 3) -> dict:
+    """Overload a 2-block ring faster than any socket can (direct
+    producer pushes): backpressure must shed blocks with full
+    accounting, not stall or crash."""
+    seconds, npulses = 24.0, 4
+    hdr, wire, truth, service, source, producer, stream = _setup(
+        workdir, seed, seconds, npulses, ring=2, use_socket=False)
+    bps = hdr.bytes_per_spectrum
+    body = wire[len(wire) - hdr.N * bps:]
+    raw = np.frombuffer(bytearray(body), np.float32).reshape(
+        hdr.N, hdr.nchans)[:, ::-1]     # wire order -> ascending
+
+    def pusher():
+        source.set_header(hdr)
+        step = 8192
+        for i in range(0, hdr.N, step):
+            source.push_spectra(raw[i:i + step])
+        source.eof()
+
+    threading.Thread(target=pusher, daemon=True).start()
+    finished = stream.wait(240.0)
+    trigs = _triggers(service)
+    counts = _matched(trigs, truth)
+    stats = source.stats()
+    q = source.quality.counts()
+    alive = _scheduler_alive(service)
+    accounted = stats["dropped_spectra"] <= q.get("ring-drop", 0)
+    ok = (finished and stream.failed is None and alive and accounted
+          and stats["dropped_blocks"] > 0
+          and all(c <= 1 for c in counts.values()))
+    service.stop()
+    return {"trial": "ring-drop", "ok": bool(ok),
+            "finished": finished, "scheduler_alive": alive,
+            "dropped_blocks": stats["dropped_blocks"],
+            "dropped_spectra": stats["dropped_spectra"],
+            "quarantine": q, "accounted": bool(accounted),
+            "triggers": len(trigs),
+            "pulse_hits": {round(truth[i], 2): c
+                           for i, c in counts.items()}}
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="stream_chaos")
+    ap.add_argument("--out", type=str, default=None,
+                    help="Write the verdict JSON here (the committed "
+                         "STREAM_CHAOS.json artifact)")
+    ap.add_argument("--trials", type=str,
+                    default="stall,truncation,ring-drop")
+    args = ap.parse_args(argv)
+    runners = {"stall": trial_stall, "truncation": trial_truncation,
+               "ring-drop": trial_ringdrop}
+    results = []
+    for name in args.trials.split(","):
+        workdir = tempfile.mkdtemp(prefix="streamchaos-")
+        t0 = time.time()
+        res = runners[name.strip()](workdir)
+        res["wall_s"] = round(time.time() - t0, 2)
+        results.append(res)
+        print("trial %-12s %s  (%.1fs)"
+              % (name, "PASS" if res["ok"] else "FAIL",
+                 res["wall_s"]))
+    verdict = {
+        "trials": results,
+        "passed": sum(1 for r in results if r["ok"]),
+        "total": len(results),
+        "ok": all(r["ok"] for r in results),
+    }
+    print(json.dumps(verdict, indent=1, sort_keys=True))
+    if args.out:
+        from presto_tpu.io.atomic import atomic_write_text
+        atomic_write_text(args.out, json.dumps(verdict, indent=1,
+                                               sort_keys=True) + "\n")
+    return 0 if verdict["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
