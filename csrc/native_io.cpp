@@ -225,6 +225,10 @@ struct Feeder {
     int64_t *sizes;        // bytes valid in each slot
     int head, tail, count; // ring state (filled by reader at head)
     int eof, err, stop;
+    // overlap attribution: how often each side waited on the other
+    // (consumer_waits = device-feed loop arrived before a block was
+    // ready: disk-bound; producer_waits = ring full: compute-bound)
+    int64_t n_blocks, consumer_waits, producer_waits;
     pthread_mutex_t mu;
     pthread_cond_t can_fill, can_take;
     pthread_t thread;
@@ -234,6 +238,8 @@ static void *feeder_main(void *arg) {
     Feeder *fd = (Feeder *)arg;
     for (;;) {
         pthread_mutex_lock(&fd->mu);
+        if (fd->count == fd->nbuf && !fd->stop)
+            fd->producer_waits++;
         while (fd->count == fd->nbuf && !fd->stop)
             pthread_cond_wait(&fd->can_fill, &fd->mu);
         if (fd->stop) {
@@ -303,6 +309,8 @@ void *pt_feeder_open(const char *path, int64_t start_offset,
 int64_t pt_feeder_next(void *h, uint8_t *dst) {
     Feeder *fd = (Feeder *)h;
     pthread_mutex_lock(&fd->mu);
+    if (fd->count == 0 && !fd->eof)
+        fd->consumer_waits++;
     while (fd->count == 0 && !fd->eof)
         pthread_cond_wait(&fd->can_take, &fd->mu);
     if (fd->count == 0 && fd->eof) {
@@ -316,9 +324,21 @@ int64_t pt_feeder_next(void *h, uint8_t *dst) {
         memcpy(dst, fd->bufs[slot], (size_t)n);
     fd->tail = (fd->tail + 1) % fd->nbuf;
     fd->count--;
+    fd->n_blocks++;
     pthread_cond_signal(&fd->can_fill);
     pthread_mutex_unlock(&fd->mu);
     return n;
+}
+
+// Fills out[0..2] with (blocks delivered, consumer waits, producer
+// waits) — the ingest-overlap attribution the obs layer reports.
+void pt_feeder_stats(void *h, int64_t *out) {
+    Feeder *fd = (Feeder *)h;
+    pthread_mutex_lock(&fd->mu);
+    out[0] = fd->n_blocks;
+    out[1] = fd->consumer_waits;
+    out[2] = fd->producer_waits;
+    pthread_mutex_unlock(&fd->mu);
 }
 
 void pt_feeder_close(void *h) {

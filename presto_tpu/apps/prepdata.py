@@ -117,29 +117,38 @@ def run(args) -> str:
     out = []
     bins_d = jnp.asarray(bins)
     prev = jnp.zeros((nchan, blocklen), dtype=jnp.float32)
-    # prefetched sequential reads where the reader supports it (the
-    # native feeder overlaps disk IO with device compute); -offset/
-    # -start fall back to positioned reads
-    block_iter = (fb.stream_blocks(blocklen)
-                  if skip == 0 and hasattr(fb, "stream_blocks")
-                  else None)
-    nread = skip
+
+    def _produce_blocks():
+        """Decoded+preprocessed channel-major blocks (ingest worker
+        thread: block k+1's decode/mask/clip/transpose overlaps the
+        device dedispersion of block k, pipeline/fusion.py).  The
+        native feeder already prefetches the raw reads underneath."""
+        block_iter = (fb.stream_blocks(blocklen)
+                      if skip == 0 and hasattr(fb, "stream_blocks")
+                      else None)
+        nread = skip
+        while nread < hdr.N:
+            block = (next(block_iter) if block_iter is not None
+                     else fb.read_spectra(nread, blocklen))  # [T, C]
+            block = prep(block, nread)
+            yield np.ascontiguousarray(block.T)              # [C, T]
+            nread += blocklen
+
+    from presto_tpu.pipeline import fusion
     first = True
-    while nread < hdr.N:
-        block = (next(block_iter) if block_iter is not None
-                 else fb.read_spectra(nread, blocklen))  # [T, C] asc
-        block = prep(block, nread)
-        # upload each block ONCE and carry the device array as prev
-        # (re-uploading prev doubled the host->device traffic); results
-        # stay on device and download once at the end — both directions
-        # of the tunnel pay seconds per transfer
-        cur = jnp.asarray(np.ascontiguousarray(block.T))   # [C, T]
-        series = dd.float_dedisp_block(prev, cur, bins_d)
-        if not first:
-            out.append(series)
-        first = False
-        prev = cur
-        nread += blocklen
+    with fusion.DoubleBufferedIngest(_produce_blocks()) as ingest:
+        for blockT in ingest:
+            # upload each block ONCE and carry the device array as
+            # prev (re-uploading prev doubled the host->device
+            # traffic); results stay on device and download once at
+            # the end — both directions of the tunnel pay seconds per
+            # transfer
+            cur = jnp.asarray(blockT)
+            series = dd.float_dedisp_block(prev, cur, bins_d)
+            if not first:
+                out.append(series)
+            first = False
+            prev = cur
     # flush the final window with a zero block
     series = dd.float_dedisp_block(prev, jnp.zeros_like(prev), bins_d)
     out.append(series[:blocklen - maxd] if maxd else series)

@@ -256,58 +256,92 @@ def run(args):
                % (args.numdms, ndev))
         print("prepsubband: %d devices visible but %s — running "
               "single-device" % (ndev, why))
+    block_step = (dd.make_block_step(chan_bins, dm_bins_d, args.nsub,
+                                     args.downsamp)
+                  if sh_step is None and not args.sub else None)
     prev_raw = None
     prev_sub = None
     outs = []
     subouts = []
-    # prefetched sequential reads where the reader supports it (the
-    # native feeder overlaps disk IO with device compute)
-    block_iter = (fb.stream_blocks(blocklen)
-                  if skip == 0 and hasattr(fb, "stream_blocks")
-                  else None)
+    # in-memory stage seam (pipeline/fusion.py): when the survey
+    # driver installed a process seam and this run's path is
+    # seam-compatible, the DM fan-out is handed over device-resident
+    # instead of (only) being written to .dat files
+    from presto_tpu.pipeline import fusion
+    seam = fusion.current_process_seam()
+    use_seam = (seam is not None and not args.sub and sh_step is None
+                and jax.process_count() == 1 and plan is None)
+    ingest_depth = (seam.depths["ingest_depth"] if use_seam
+                    else fusion.DEFAULT_INGEST_DEPTH)
+
+    def _produce_blocks():
+        """Decoded+preprocessed channel-major blocks, in stream order
+        (runs on the ingest worker thread: the decode/mask/clip/
+        transpose of block k+1 overlaps the device compute of block
+        k, generalizing the native feeder's raw-read prefetch)."""
+        # prefetched sequential reads where the reader supports it
+        # (the native feeder overlaps disk IO with this decode)
+        block_iter = (fb.stream_blocks(blocklen)
+                      if skip == 0 and hasattr(fb, "stream_blocks")
+                      else None)
+        nread = skip
+        while nread < hdr.N + 2 * blocklen:   # two extra flush blocks
+            if nread < hdr.N:
+                block = (next(block_iter) if block_iter is not None
+                         else fb.read_spectra(nread, blocklen))
+                block = prep(block, nread)
+            else:
+                block = np.zeros((blocklen, nchan), dtype=np.float32)
+            yield nread, np.ascontiguousarray(block.T)
+            nread += blocklen
+
     from presto_tpu.utils.timing import print_percent_complete
-    nread = skip
     nblocks = 0
     pct = -1
-    while nread < hdr.N + 2 * blocklen:   # two extra flush blocks
-        pct = print_percent_complete(min(nread - skip, Neff), Neff, pct)
-        if nread < hdr.N:
-            block = (next(block_iter) if block_iter is not None
-                     else fb.read_spectra(nread, blocklen))
-            block = prep(block, nread)
-        else:
-            block = np.zeros((blocklen, nchan), dtype=np.float32)
-        cur = jnp.asarray(np.ascontiguousarray(block.T))
-        if prev_raw is not None:
-            if sh_step is not None and prev_sub is not None:
-                # sharded step: subbands on replicated data, the DM
-                # fan-out split over the mesh (mpiprepsubband's
-                # compute-everywhere/Bcast pattern, SURVEY s2.5)
-                sub, series = sh_step(prev_raw, cur, prev_sub,
-                                      chan_bins_d, dm_bins_d)
-                outs.append(series)
-            else:
-                sub = dd.dedisp_subbands_block(prev_raw, cur,
-                                               chan_bins_d, args.nsub)
-                if args.sub:
-                    subouts.append(sub)
-                elif prev_sub is not None:
-                    series = dd.float_dedisp_many_block(prev_sub, sub,
-                                                        dm_bins_d)
-                    series = dd.downsample_block(series, args.downsamp)
-                    # stays on device: one download at the end (the
-                    # tunnel pays seconds of latency per transfer)
+    ingest = fusion.DoubleBufferedIngest(_produce_blocks(),
+                                         depth=ingest_depth)
+    try:
+        for nread, blockT in ingest:
+            pct = print_percent_complete(min(nread - skip, Neff),
+                                         Neff, pct)
+            cur = jnp.asarray(blockT)
+            if prev_raw is not None:
+                if sh_step is not None and prev_sub is not None:
+                    # sharded step: subbands on replicated data, the
+                    # DM fan-out split over the mesh (mpiprepsubband's
+                    # compute-everywhere/Bcast pattern, SURVEY s2.5)
+                    sub, series = sh_step(prev_raw, cur, prev_sub,
+                                          chan_bins_d, dm_bins_d)
                     outs.append(series)
-            prev_sub = sub
-        prev_raw = cur
-        nread += blocklen
-        nblocks += 1
+                elif args.sub or prev_sub is None:
+                    sub = dd.dedisp_subbands_block(prev_raw, cur,
+                                                   chan_bins_d,
+                                                   args.nsub)
+                    if args.sub:
+                        subouts.append(sub)
+                else:
+                    # steady state: ONE composed dispatch per block
+                    # (subbands + DM fan-out + downsample) instead of
+                    # three — the link's dispatch floor is the
+                    # single-DM regime's bound (BENCH_r05 config 1)
+                    sub, series = block_step(prev_raw, cur, prev_sub)
+                    # stays on device: one download at the end (the
+                    # tunnel pays seconds per transfer)
+                    outs.append(series)
+                prev_sub = sub
+            prev_raw = cur
+            nblocks += 1
+    finally:
+        ingest.close()
 
     if args.sub:
         return _write_subbands(args, fb, plan, subouts, dms, dt,
                                int(chan_bins.max()), Neff, skip)
 
     cat = jnp.concatenate(outs, axis=1)                 # [numdms, T]
+    if use_seam:
+        return _seam_handoff(args, fb, seam, cat, dms, dt, Neff, maxd,
+                             skip)
     if jax.process_count() > 1:
         # multi-host: each process materializes and writes ONLY its
         # own DM rows — the reference's workers write their own .dat
@@ -356,6 +390,58 @@ def run(args):
     return outbase, dms
 
 
+def _seam_handoff(args, fb, seam, cat, dms, dt, Neff, maxd, skip):
+    """Deposit the DM fan-out at the survey's in-memory stage seam
+    (pipeline/fusion.py) instead of round-tripping it through .dat
+    files: the device block stays resident for the FFT/search stages,
+    and ONE host download (the same single download the staged path
+    pays before writing .dat) provides the bit-identical artifact
+    bytes for spills, prepfold, and the pad computation.
+
+    Byte-identity: the pad tail is computed on HOST with
+    pad_to_good_N's exact NumPy semantics and uploaded, so the device
+    series equals the staged .dat bytes bit-for-bit."""
+    from presto_tpu.pipeline.fusion import SeamBlock
+
+    valid = (Neff - maxd) // args.downsamp
+    trimmed = cat[:, :max(valid, 0)]
+    host = np.asarray(trimmed)                  # the one download
+    from presto_tpu.obs import jaxtel
+    jaxtel.note_get(getattr(seam, "obs", None), host.nbytes)
+    host, valid, numout = pad_to_good_N(host, args.numout)
+    if numout > trimmed.shape[1]:
+        dev = jnp.concatenate(
+            [trimmed, jnp.asarray(host[:, trimmed.shape[1]:])], axis=1)
+    else:
+        dev = trimmed[:, :numout]
+
+    outbase = args.outfile or "prepsubband_out"
+    names, infos = [], []
+    for i, dmval in enumerate(dms):
+        name = "%s_DM%.*f" % (outbase, args.dmprec, dmval)
+        info = fil_to_inf(fb, name, numout, dm=float(dmval))
+        if skip:
+            info.mjd_f += skip * dt / 86400.0
+            info.mjd_i += int(info.mjd_f)
+            info.mjd_f %= 1.0
+        info.dt = dt * args.downsamp
+        set_onoff(info, valid, numout)
+        info.name = name
+        info.N = numout
+        names.append(name)
+        infos.append(info)
+    seam.add_block(SeamBlock(
+        names=names, infos=infos, dms=[float(d) for d in dms],
+        series_dev=dev, series_host=host, valid=valid, numout=numout,
+        dt=dt * args.downsamp))
+    fb.close()
+    print("Handed %d DMs x %d samples across the stage seam "
+          "(lodm=%g dmstep=%g nsub=%d, durable=%s)"
+          % (len(names), numout, args.lodm, args.dmstep, args.nsub,
+             seam.durable))
+    return outbase, dms
+
+
 def _dedisperse_rows(s: _Setup, args, rows):
     """One elastic shard: dedisperse DM rows [lo, hi) of the FULL
     plan.  Mirrors run()'s unsharded streaming loop exactly — same
@@ -367,6 +453,10 @@ def _dedisperse_rows(s: _Setup, args, rows):
     prep = s.block_prep(args)
     chan_bins_d = jnp.asarray(s.chan_bins)
     dm_bins_sel = np.asarray(s.dm_bins)[lo:hi]
+    # same one-dispatch composed step as the unsharded loop (a shard
+    # row must be byte-equal to the same row of a never-sharded run)
+    block_step = dd.make_block_step(s.chan_bins, dm_bins_sel,
+                                    args.nsub, args.downsamp)
     blocklen = s.blocklen
     prev_raw = None
     prev_sub = None
@@ -380,12 +470,11 @@ def _dedisperse_rows(s: _Setup, args, rows):
             block = np.zeros((blocklen, s.nchan), dtype=np.float32)
         cur = jnp.asarray(np.ascontiguousarray(block.T))
         if prev_raw is not None:
-            sub = dd.dedisp_subbands_block(prev_raw, cur, chan_bins_d,
-                                           args.nsub)
-            if prev_sub is not None:
-                series = dd.float_dedisp_many_block(prev_sub, sub,
-                                                    dm_bins_sel)
-                series = dd.downsample_block(series, args.downsamp)
+            if prev_sub is None:
+                sub = dd.dedisp_subbands_block(prev_raw, cur,
+                                               chan_bins_d, args.nsub)
+            else:
+                sub, series = block_step(prev_raw, cur, prev_sub)
                 outs.append(series)
             prev_sub = sub
         prev_raw = cur

@@ -44,6 +44,23 @@ def build_parser() -> argparse.ArgumentParser:
     return p
 
 
+def sp_input_plan(info, nraw):
+    """(nuse, offregions) for one series: the searchable sample count
+    (padding excluded via the .inf onoff pairs) and the off regions
+    the detrender must not normalize across.  Shared by this CLI and
+    the survey's seam path (pipeline/survey._seam_singlepulse) so both
+    search bit-identical inputs."""
+    offregions = []
+    nuse = nraw
+    if info.numonoff > 1:
+        ons = [int(a) for a, b in info.onoff]
+        offs = [int(b) for a, b in info.onoff]
+        offregions = list(zip(offs[:-1], ons[1:]))
+        if offregions and offregions[-1][1] >= info.N - 1:
+            nuse = min(nraw, offregions[-1][0] + 1)
+    return nuse, offregions
+
+
 def run(args) -> list:
     ensure_backend()
     allcands = []
@@ -72,14 +89,7 @@ def run(args) -> list:
         base = fn[:-4] if fn.endswith(".dat") else fn
         info = read_inf(base)
         nraw = os.path.getsize(base + ".dat") // 4
-        offregions = []
-        nuse = nraw
-        if info.numonoff > 1:
-            ons = [int(a) for a, b in info.onoff]
-            offs = [int(b) for a, b in info.onoff]
-            offregions = list(zip(offs[:-1], ons[1:]))
-            if offregions and offregions[-1][1] >= info.N - 1:
-                nuse = min(nraw, offregions[-1][0] + 1)
+        nuse, offregions = sp_input_plan(info, nraw)
         planned.append((fn, base, nuse, info, offregions))
 
     groups = {}

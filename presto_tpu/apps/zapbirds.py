@@ -99,14 +99,15 @@ def _measure_bird(amps: np.ndarray, predbin: float, T: float,
     return lobin / T, hibin / T
 
 
-def zap_fft_file(fftpath: str, zapfile: str, baryv: float = 0.0) -> int:
-    """-zap path: rewrite fftpath with the zapfile's ranges replaced by
-    local-median noise.  Returns the number of ranges zapped."""
-    base = fftpath[:-4] if fftpath.endswith(".fft") else fftpath
-    info = read_inf(base)
-    T = info.dt * info.N
-    amps = datfft.read_fft(fftpath)
-    hibin = info.N / 2
+def zap_amps(amps: np.ndarray, zapfile: str, T: float, N: int,
+             baryv: float = 0.0):
+    """In-memory -zap: the zapfile's ranges replaced by local-median
+    noise in a COPY of ``amps``.  Returns (zapped, nranges).  Shared
+    by the file path below and the survey's seam search
+    (pipeline/survey._seam_fft_search), which zaps the device-FFT'd
+    spectrum without a .fft round-trip; zap_bins is deterministic, so
+    both produce identical bytes from identical spectra."""
+    hibin = N / 2
     birds = read_birds_bary(zapfile)
     ranges = birds_to_bin_ranges(birds, T, baryv)
     kept = []
@@ -114,9 +115,19 @@ def zap_fft_file(fftpath: str, zapfile: str, baryv: float = 0.0) -> int:
         if lob >= hibin - 1:     # zapbirds.c:295-299 clamp + early stop
             break
         kept.append((lob, min(hib, hibin - 1)))
-    out = zap_bins(amps, kept)
+    return zap_bins(amps, kept), len(kept)
+
+
+def zap_fft_file(fftpath: str, zapfile: str, baryv: float = 0.0) -> int:
+    """-zap path: rewrite fftpath with the zapfile's ranges replaced by
+    local-median noise.  Returns the number of ranges zapped."""
+    base = fftpath[:-4] if fftpath.endswith(".fft") else fftpath
+    info = read_inf(base)
+    T = info.dt * info.N
+    amps = datfft.read_fft(fftpath)
+    out, nz = zap_amps(amps, zapfile, T, info.N, baryv)
     datfft.write_fft(fftpath, out)
-    return len(kept)
+    return nz
 
 
 def measure_birds(fftpath: str, inzapfile: str, outzapfile: str,

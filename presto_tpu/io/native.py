@@ -73,6 +73,13 @@ def _load():
     lib.pt_feeder_next.argtypes = [ctypes.c_void_p, u8p]
     lib.pt_feeder_next.restype = i64
     lib.pt_feeder_close.argtypes = [ctypes.c_void_p]
+    try:
+        # added after the first shipped .so: a stale library without
+        # the symbol still serves every older entry point
+        lib.pt_feeder_stats.argtypes = [ctypes.c_void_p,
+                                        ctypes.POINTER(i64)]
+    except AttributeError:
+        pass
     _lib = lib
     return lib
 
@@ -192,6 +199,19 @@ class BlockFeeder:
             if n == 0:
                 return
             yield buf[:n]
+
+    def stats(self) -> Optional[dict]:
+        """Ingest-overlap attribution: blocks delivered plus how often
+        each side of the ring waited on the other (consumer_waits ->
+        disk-bound, producer_waits -> compute-bound).  None when the
+        loaded library predates the symbol."""
+        if not self._h or not hasattr(self._lib, "pt_feeder_stats"):
+            return None
+        out = (ctypes.c_int64 * 3)()
+        self._lib.pt_feeder_stats(self._h, out)
+        return {"blocks": int(out[0]),
+                "consumer_waits": int(out[1]),
+                "producer_waits": int(out[2])}
 
     def close(self) -> None:
         if self._h:

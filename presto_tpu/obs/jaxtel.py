@@ -81,6 +81,36 @@ def note_donation(obs, nbytes: int) -> None:
         "Buffer bytes donated to XLA").inc(int(nbytes))
 
 
+def transfer_snapshot(obs) -> dict:
+    """Current host<->device transfer/donation/compile totals off the
+    shared registry — the attribution block the fused pipeline's
+    before/after comparison reads (bench.py inclusive_breakdown, the
+    survey's end-of-run span).  Returns zeros when observability is
+    disabled, so callers can diff snapshots unconditionally."""
+    out = {"put_bytes": 0, "get_bytes": 0, "donated_bytes": 0,
+           "compiles": 0, "compile_seconds": 0.0}
+    if obs is None or not obs.enabled:
+        return out
+    reg = obs.metrics
+    out["put_bytes"] = int(reg.counter(
+        "jax_device_put_bytes_total",
+        "Bytes uploaded host to device").value)
+    out["get_bytes"] = int(reg.counter(
+        "jax_device_get_bytes_total",
+        "Bytes downloaded device to host").value)
+    out["donated_bytes"] = int(reg.counter(
+        "jax_donated_bytes_total",
+        "Buffer bytes donated to XLA").value)
+    comp = reg.counter("jax_compiles_total",
+                       "XLA executables built", ("kind",))
+    hist = reg.histogram("jax_compile_seconds",
+                         "XLA compile wall time", ("kind",))
+    out["compiles"] = int(comp.total())
+    out["compile_seconds"] = float(
+        sum(h.sum for _lbl, h in hist.children()))
+    return out
+
+
 def sample_live_buffers(obs) -> Optional[int]:
     """Sample current live device-buffer bytes into the gauge pair
     (current + high-water mark).  Prefers the backend's memory_stats

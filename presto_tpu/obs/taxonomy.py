@@ -37,6 +37,8 @@ KILL_POINTS = frozenset({
     "prepsubband-method",
     "elastic-method",
     "post-prepsubband",
+    "seam-handoff",
+    "sp-seam-chunk",
     "zapbirds-file",
     "fft-chunk",
     "fused-chunk",
@@ -140,6 +142,14 @@ TUNE_SPANS = frozenset({
     "tune:candidate",
 })
 
+#: fused-pipeline span names — every `obs.span("pipeline:...")` in
+#: pipeline/fusion.py (enforced both directions by obs_lint check 8:
+#: the in-memory data path may not open unregistered spans, and the
+#: catalog may not list dead ones)
+FUSION_SPANS = frozenset({
+    "pipeline:seam",
+})
+
 #: registered metric names (Prometheus side of the contract); the
 #: linter checks every registry.counter/gauge/histogram call in the
 #: tree registers a name listed here.
@@ -200,6 +210,11 @@ METRICS = frozenset({
     "tune_sweep_seconds",
     # scheduler lanes (serve/scheduler.py)
     "serve_lane_batches_total",
+    # device-resident pipeline fusion (pipeline/fusion.py); every
+    # survey_fused_* name here must be registered by the fusion layer
+    # (obs_lint check 8)
+    "survey_fused_trials_total",
+    "survey_fused_bytes_spilled_total",
     # streaming search (presto_tpu/stream); every stream_* name here
     # must be registered by the stream layer (obs_lint check 7)
     "stream_blocks_total",

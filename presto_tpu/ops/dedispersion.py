@@ -309,6 +309,40 @@ def _float_dedisp_static_body(lastdata, data, dkey, approx_mean):
     return jnp.stack(rows, axis=0) - approx_mean
 
 
+def make_block_step(chan_delays, dm_delays, numsubbands, downsamp=1):
+    """ONE-dispatch streaming step for the prep family's block loop:
+    channels->subbands shift-add + per-DM dedispersion + downsample
+    composed into a single jitted program.
+
+    The separate-op loop paid the link's dispatch floor three times
+    per streamed block; the survey's fused pipeline (pipeline/
+    fusion.py) issues blocks back-to-back, so the composed step cuts
+    the per-block dispatch count to one.  Results are bit-identical
+    to calling the three ops separately — XLA preserves the add order
+    of the composed graph, and the DM-sharded mesh step
+    (parallel/sharded.make_sharded_dedisperse_step) has always relied
+    on exactly this composition equivalence, pinned by the multi-host
+    byte-equality tests.
+
+    chan_delays: [numchan] int32 bins; dm_delays: [numdms, nsub] —
+    keep it a HOST np.ndarray so the static-slice fast path embeds
+    the plan as constants (see float_dedisp_many_block).
+
+    Returns step(prev_raw, cur, prev_sub) -> (sub, series).
+    """
+    chan_dev = jnp.asarray(chan_delays, dtype=jnp.int32)
+
+    @jax.jit
+    def step(prev_raw, cur, prev_sub):
+        sub = dedisp_subbands_block(prev_raw, cur, chan_dev,
+                                    numsubbands)
+        series = float_dedisp_many_block(prev_sub, sub, dm_delays)
+        series = downsample_block(series, downsamp)
+        return sub, series
+
+    return step
+
+
 def dedisperse_series(data, delays):
     """Whole-series dedispersion of an in-memory [numchan, N] array.
 

@@ -99,6 +99,22 @@ class SurveyConfig:
     # bytes; a tuned run writes <workdir>/tuned.json provenance
     # (rendered by presto-report).
     tune: Optional[bool] = None
+    # stage durability tier (pipeline/fusion.py): stages hand their
+    # successors device-resident arrays across an in-memory seam
+    # whenever the execution path allows it; durable_stages decides
+    # whether the would-be intermediate artifacts (.dat/.fft) are
+    # ALSO written+journaled at each boundary.  True (the resolved
+    # default) keeps the staged checkpoint contract byte-for-byte
+    # (write-through, no read-back); False — the presto-serve/bench
+    # tier — skips them, spilling only on demand (prepfold) so a
+    # killed run simply redoes the fused stages from the last durable
+    # artifact.  None resolves to True unless PRESTO_TPU_DURABLE=0.
+    durable_stages: Optional[bool] = None
+    # cross-stage in-flight window depth (FFT of DM-group i overlaps
+    # search of group i-1); None resolves via the tuning DB's
+    # pipeline_inflight_depth family, else the built-in default of 2.
+    # Depth only changes dispatch overlap, never output bytes.
+    inflight_depth: Optional[int] = None
 
     @property
     def all_passes(self):
@@ -276,7 +292,19 @@ def _run_survey_stages(rawfiles, cfg, workdir, base, res, timer,
     timer.mark("prepsubband")
     _chaos(cfg, "pre-prepsubband", obs)
     # ---- 3. prepsubband per method ------------------------------------
+    # The DM fan-out crosses an IN-MEMORY stage seam
+    # (pipeline/fusion.py): prepsubband deposits the device-resident
+    # series for the FFT/search/single-pulse stages, and
+    # cfg.durable_stages decides whether the .dat artifacts are also
+    # written at the boundary (write-through) or only spilled on
+    # demand.  Elastic, sharded, and multi-process runs are
+    # seam-incompatible and keep the staged disk contract — the seam
+    # just stays empty and every consumer below falls back to disk.
     from presto_tpu.apps.prepsubband import main as prepsubband_main
+    from presto_tpu.pipeline import fusion
+    seam = fusion.StageSeam(workdir, durable=_durable(cfg),
+                            manifest=manifest, obs=obs,
+                            inflight_depth=cfg.inflight_depth)
     dat_glob = os.path.basename(base) + "_DM*.dat"
     # verify survivors of a previous run ONCE, before the loop — this
     # run's own per-method outputs are journaled as each method lands,
@@ -309,22 +337,62 @@ def _run_survey_stages(rawfiles, cfg, workdir, base, res, timer,
                 _elastic.set_process_injector(None)
                 _elastic.set_process_obs(None)
             _chaos(cfg, "elastic-method", obs)
-        else:
+        elif os.environ.get("PRESTO_TPU_FUSION", "1") == "0":
+            # operational kill switch: keep the pre-fusion staged
+            # contract exactly (every stage boundary on disk)
             prepsubband_main(argv + rawfiles)
+        else:
+            fusion.set_process_seam(seam)
+            try:
+                prepsubband_main(argv + rawfiles)
+            finally:
+                fusion.set_process_seam(None)
         done = _stage(dat_glob, workdir)
         _record(manifest, done + [f[:-4] + ".inf" for f in done],
                 "prepsubband")
         _chaos(cfg, "prepsubband-method", obs)
-    res.datfiles = _stage(dat_glob, workdir)
-    print("survey: %d dedispersed time series" % len(res.datfiles))
+    disk_dats = _stage(dat_glob, workdir)
+    seam_set = {os.path.abspath(p) for p in seam.dat_paths()}
+    res.datfiles = sorted(set(disk_dats)
+                          | {os.path.join(workdir, os.path.basename(p))
+                             for p in seam.dat_paths()})
+    # trials the seam does NOT hold (a previous staged run's verified
+    # survivors, or a seam-incompatible execution path): these flow
+    # through the original disk consumers below
+    disk_only = [f for f in res.datfiles
+                 if os.path.abspath(f) not in seam_set]
+    print("survey: %d dedispersed time series (%d seam-resident)"
+          % (len(res.datfiles), len(seam)))
+    _chaos(cfg, "seam-handoff", obs)
     _chaos(cfg, "post-prepsubband", obs)
+
+    # ---- 9a. single-pulse search over the seam-resident series ------
+    # runs BEFORE the FFT consumes (and may donate) the series block;
+    # artifacts and candidate sets are byte-identical to the staged
+    # stage-ordered run — only the wall-clock attribution moves.
+    if cfg.singlepulse and len(seam):
+        timer.mark("single_pulse")
+        _seam_singlepulse(seam, cfg, manifest, obs)
 
     from dataclasses import replace as _replace
     passes = cfg.all_passes
     if cfg.zaplist:
         timer.mark("realfft")
-        _staged_fft_search_head(res, cfg, manifest, obs)
-        fftfiles = [f[:-4] + ".fft" for f in res.datfiles]
+        if len(seam):
+            # seam trials: FFT + in-memory zap + every accel pass
+            # without touching disk (spectra spilled only on the
+            # durable tier, journaled at the post-zap "zapbirds" state)
+            timer.mark("realfft+accelsearch (fused)")
+            _seam_fft_search(seam, cfg, passes, manifest, obs,
+                             zap=True)
+            timer.mark("realfft")
+        _staged_fft_search_head(disk_only, cfg, manifest, obs)
+        # the staged sweep covers disk trials AND any seam trial whose
+        # zapped spectrum already sits journaled on disk (re-zapping
+        # is excluded by contract, so those search from the artifact)
+        fftfiles = sorted({f[:-4] + ".fft" for f in disk_only}
+                          | {f[:-4] + ".fft" for f in res.datfiles
+                             if os.path.exists(f[:-4] + ".fft")})
         timer.mark("zapbirds")
         # ---- 5. zapbirds ---------------------------------------------
         # zapping mutates the .fft in place and is NOT idempotent, so
@@ -347,24 +415,27 @@ def _run_survey_stages(rawfiles, cfg, workdir, base, res, timer,
                                    sigma=sg, flo=flo), manifest, obs)
     else:
         # ---- 4+6 fused fast path: realfft -> accelsearch with the
-        # spectra RESIDENT on device (no zapbirds in between).  Saves
-        # a download + re-upload of every trial's spectrum — the
-        # tunneled link's slowest direction; .fft/ACCEL artifacts are
-        # still written, preserving the checkpoint contract.
+        # spectra RESIDENT on device (no zapbirds in between).  Seam
+        # trials never touch disk at all (the dedisp output block is
+        # the FFT input block, donated where the backend supports it);
+        # disk trials keep the read-once upload path.  ACCEL artifacts
+        # are always written, preserving the checkpoint contract.
         timer.mark("realfft+accelsearch (fused)")
-        _fused_fft_search(res, cfg, manifest, obs)
+        if len(seam):
+            _seam_fft_search(seam, cfg, passes, manifest, obs)
+        _fused_fft_search(disk_only, cfg, manifest, obs)
         for (zmax, nh, sg, flo) in passes:
             # resume case for the first pass; full searches for the
             # recipe's additional passes
             _batched_accelsearch(
-                [f[:-4] + ".fft" for f in res.datfiles],
+                [f[:-4] + ".fft" for f in disk_only],
                 _replace(cfg, zmax=zmax, numharm=nh, sigma=sg,
                          flo=flo), manifest, obs)
 
     timer.mark("sift")
     _chaos(cfg, "pre-sift", obs)
     return _finish_survey_stages(rawfiles, cfg, workdir, base, res,
-                                 timer, manifest, obs)
+                                 timer, manifest, obs, seam=seam)
 
 
 def _length_groups(files, item_bytes):
@@ -376,27 +447,254 @@ def _length_groups(files, item_bytes):
     return by_len
 
 
-def _survey_searcher(first_file, nbins, cfg):
-    """(searcher, T) for one same-length trial group."""
-    from presto_tpu.io.infodata import read_inf
+def _durable(cfg) -> bool:
+    """Resolve the stage-durability tier: an explicit
+    cfg.durable_stages wins; None defaults to durable (the
+    resume-critical contract) unless PRESTO_TPU_DURABLE=0."""
+    d = getattr(cfg, "durable_stages", None)
+    if d is not None:
+        return bool(d)
+    return os.environ.get("PRESTO_TPU_DURABLE", "1") != "0"
+
+
+def _searcher_for(cfg, T, nbins):
+    """One accel searcher for a (pass config, duration, length) —
+    through the plan provider when a resident service shares one
+    (serve/plancache), so same-shaped trial groups reuse compiled
+    plans across the staged AND seam paths."""
     from presto_tpu.search.accel import AccelConfig, AccelSearch
-    info = read_inf(first_file[:-4] + ".inf")
-    T = info.N * info.dt
     acfg = AccelConfig(zmax=cfg.zmax, numharm=cfg.numharm,
                        sigma=cfg.sigma, flo=cfg.flo)
     if cfg.plan_provider is not None:
-        return cfg.plan_provider.searcher(acfg, T, nbins), T
-    return AccelSearch(acfg, T=T, numbins=nbins), T
+        return cfg.plan_provider.searcher(acfg, T, nbins)
+    return AccelSearch(acfg, T=T, numbins=nbins)
 
 
-def _fused_fft_search(res, cfg, manifest=None, obs=None) -> None:
-    """Stage 4+6 fused: batched rfft, search_many on the DEVICE
-    spectra, one download for the .fft artifacts.  Only processes
-    trials with NO verified .fft yet — existing valid spectra (an
-    interrupted run's checkpoints) are left to _batched_accelsearch so
-    their upload isn't paid twice."""
-    _drop_stale(manifest, [f[:-4] + ".fft" for f in res.datfiles])
-    todo = [f for f in res.datfiles
+def _survey_searcher(first_file, nbins, cfg):
+    """(searcher, T) for one same-length trial group."""
+    from presto_tpu.io.infodata import read_inf
+    info = read_inf(first_file[:-4] + ".inf")
+    T = info.N * info.dt
+    return _searcher_for(cfg, T, nbins), T
+
+
+def _seam_fft_search(seam, cfg, passes, manifest=None, obs=None,
+                     zap=False) -> None:
+    """Every accel pass over the seam-resident series: batched rfft
+    straight off the dedisp output block (donated to the FFT where
+    the backend supports aliasing), search_many on the device
+    spectra, ONE download per chunk for candidate refinement (and the
+    durable tier's .fft spill).  Dispatch of chunk i+1's FFT is
+    admitted to the in-flight window before chunk i's results are
+    collected, so the host-side refine/write of one chunk overlaps
+    the device work of the next.
+
+    With ``zap`` the downloaded spectrum is zapped in memory
+    (apps/zapbirds.zap_amps) and the ZAPPED pairs are what the search
+    consumes — the staged rfft->zapbirds->accelsearch flow without
+    the two disk round-trips.  Durable spills journal the .fft at its
+    post-zap state (stage "zapbirds"), matching the staged journal's
+    non-idempotency contract; a trial whose .fft is already journaled
+    zapped is left to the disk consumers (re-zapping is not
+    byte-stable)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from dataclasses import replace as _replace
+    from presto_tpu.apps.accelsearch import refine_and_write
+    from presto_tpu.io import datfft
+    from presto_tpu.obs import jaxtel
+    from presto_tpu.ops import fftpack
+    from presto_tpu.pipeline import fusion
+
+    try:
+        can_donate = jax.devices()[0].platform != "cpu"
+    except Exception:
+        can_donate = False
+
+    def collect(ent):
+        """Search + refine + write one FFT'd chunk (the sync point)."""
+        (block, rows, pairs_dev, todo_passes, n) = ent
+        nbins = n // 2
+        T = block.numout * fusion.inf_float(block.dt)
+        pairs_host = np.array(pairs_dev)          # one download
+        jaxtel.note_get(obs, pairs_host.nbytes)
+        search_dev = pairs_dev
+        if zap and cfg.zaplist:
+            from presto_tpu.apps.zapbirds import zap_amps
+            for i in range(pairs_host.shape[0]):
+                amps = fftpack.np_pairs_to_complex64(pairs_host[i])
+                amps, _nz = zap_amps(amps, cfg.zaplist, T,
+                                     block.numout)
+                pairs_host[i] = np.stack([amps.real, amps.imag], -1)
+            search_dev = jnp.asarray(pairs_host)  # re-upload zapped
+            jaxtel.note_put(obs, pairs_host.nbytes)
+            _chaos(cfg, "zapbirds-file", obs)
+        for pcfg in todo_passes:
+            searcher = _searcher_for(pcfg, T, nbins)
+            results = searcher.search_many(search_dev)
+            arts = []
+            for row, pr, raw in zip(rows, pairs_host, results):
+                name = block.names[row]
+                amps = fftpack.np_pairs_to_complex64(pr)
+                refine_and_write(raw, amps, T, searcher, name,
+                                 pcfg.zmax, quiet=True)
+                acc = name + "_ACCEL_%d" % pcfg.zmax
+                arts += [acc, acc + ".cand"]
+            _record(manifest, arts, "accel" if zap else "fft+accel")
+        if seam.durable:
+            ffts = []
+            for row, pr in zip(rows, pairs_host):
+                f = block.names[row] + ".fft"
+                datfft.write_fft(f, fftpack.np_pairs_to_complex64(pr))
+                ffts.append(f)
+            _record(manifest, ffts, "zapbirds" if zap else "fft+accel")
+        jaxtel.sample_live_buffers(obs)
+        _chaos(cfg, "fused-chunk", obs)
+
+    ndone = 0
+    pending = []          # the cross-stage in-flight window: chunk
+    depth = seam.depths["window"]   # i+1's FFT is queued on the
+    for numout, blocks in sorted(seam.groups().items()):  # device
+        n = numout & ~1   # before chunk i's host collection starts
+        for block in blocks:
+            # the staged consumers' verify-or-redo contract, per trial
+            arts = []
+            for name in block.names:
+                for (zmax, _nh, _sg, _flo) in passes:
+                    acc = name + "_ACCEL_%d" % zmax
+                    arts += [acc, acc + ".cand"]
+            _drop_stale(manifest, arts)
+            rows = []
+            for row, name in enumerate(block.names):
+                if zap and manifest is not None and \
+                        _valid(manifest, name + ".fft") and \
+                        manifest.stage_of(name + ".fft") == "zapbirds":
+                    continue     # journaled zapped spectrum: disk path
+                need = any(
+                    not (_valid(manifest, name + "_ACCEL_%d" % zmax)
+                         and _valid(manifest,
+                                    name + "_ACCEL_%d.cand" % zmax))
+                    for (zmax, _nh, _sg, _flo) in passes)
+                if need or (seam.durable
+                            and not _valid(manifest, name + ".fft")):
+                    rows.append(row)
+            if not rows:
+                continue
+            todo_passes = [_replace(cfg, zmax=z, numharm=nh, sigma=sg,
+                                    flo=flo)
+                           for (z, nh, sg, flo) in passes]
+            per = max(1, int(2 ** 30 // max(n * 4, 1)))
+            whole = rows == list(range(len(block.names))) \
+                and len(rows) <= per
+            for g0 in range(0, len(rows), per):
+                chunk_rows = rows[g0:g0 + per]
+                span = (obs.span("fused-chunk",
+                                 files=len(chunk_rows), nbins=n)
+                        if obs is not None else None)
+                if whole and can_donate:
+                    # the dedisp output block IS the FFT input block:
+                    # donate it (input [nd, n] f32 and output
+                    # [nd, n/2, 2] f32 are the same size, so the seam
+                    # crossing is allocation-neutral); the host copy
+                    # stays for spills.  CPU's XLA cannot alias these
+                    # and would only warn.
+                    chunk_dev = block.series_dev[:, :n]
+                    seam.release(block)
+                    pairs_dev = fusion.fused_rfft_batch(
+                        chunk_dev, donate=True, obs=obs)
+                elif whole:
+                    pairs_dev = fusion.fused_rfft_batch(
+                        block.series_dev[:, :n])
+                else:
+                    pairs_dev = fusion.fused_rfft_batch(
+                        block.series_dev[np.asarray(chunk_rows), :n])
+                pending.append((block, chunk_rows, pairs_dev,
+                                todo_passes, n))
+                while len(pending) >= max(depth, 1):
+                    collect(pending.pop(0))
+                    ndone += 1
+                if span is not None:
+                    span.finish()
+    while pending:
+        collect(pending.pop(0))
+        ndone += 1
+    if ndone:
+        print("survey: fused realfft+accelsearch over %d seam chunks "
+              "(device-resident, %d passes%s)"
+              % (ndone, len(passes), ", zap" if zap else ""))
+
+
+def _seam_singlepulse(seam, cfg, manifest=None, obs=None) -> None:
+    """Single-pulse search over the seam-resident series: the exact
+    app pipeline (apps/single_pulse_search) fed from HBM instead of a
+    third .dat disk read + re-upload.  Inputs are bit-equal to the
+    staged path's (same padded series, same .inf-roundtripped dt/dm,
+    same onoff-derived offregions), so the .singlepulse artifacts are
+    byte-identical."""
+    import jax.numpy as jnp
+    from presto_tpu.apps.single_pulse_search import sp_input_plan
+    from presto_tpu.pipeline import fusion
+    from presto_tpu.search.singlepulse import (SinglePulseSearch,
+                                               write_singlepulse)
+
+    sp = SinglePulseSearch(threshold=cfg.sp_threshold,
+                           maxwidth=cfg.sp_maxwidth)
+    planned = []          # (block, row, nuse, offregions)
+    spfiles = [name + ".singlepulse" for b in seam.blocks
+               for name in b.names]
+    _drop_stale(manifest, spfiles)
+    for block in seam.blocks:
+        for row, name in enumerate(block.names):
+            if _valid(manifest, name + ".singlepulse"):
+                continue
+            nuse, offregions = sp_input_plan(block.infos[row],
+                                             block.numout)
+            planned.append((block, row, nuse, offregions))
+    if not planned:
+        return
+    groups = {}
+    for item in planned:
+        key = (item[2], fusion.inf_float(item[0].dt))
+        groups.setdefault(key, []).append(item)
+    nev = 0
+    for (nuse, dt), items in sorted(groups.items()):
+        per = max(1, int(2 ** 30 // max(nuse * 4, 1)))
+        for g0 in range(0, len(items), per):
+            chunk = items[g0:g0 + per]
+            span = (obs.span("sp-seam-chunk", files=len(chunk),
+                             nuse=nuse)
+                    if obs is not None else None)
+            batch = jnp.stack([b.series_dev[row, :nuse]
+                               for (b, row, _n, _o) in chunk])
+            results = sp.search_many_resident(
+                batch, dt,
+                dms=[fusion.inf_float(b.infos[row].dm, 12)
+                     for (b, row, _n, _o) in chunk],
+                offregions_list=[o for (_b, _r, _n, o) in chunk])
+            written = []
+            for (b, row, _n, _o), (cands, _stds, bad) in zip(chunk,
+                                                             results):
+                f = b.names[row] + ".singlepulse"
+                write_singlepulse(f, cands)
+                written.append(f)
+                nev += len(cands)
+            _record(manifest, written, "singlepulse")
+            if span is not None:
+                span.finish()
+            _chaos(cfg, "sp-seam-chunk", obs)
+    print("survey: single-pulse search over %d seam-resident series "
+          "(%d events)" % (len(planned), nev))
+
+
+def _fused_fft_search(datfiles, cfg, manifest=None, obs=None) -> None:
+    """Stage 4+6 fused (disk trials): batched rfft, search_many on the
+    DEVICE spectra, one download for the .fft artifacts.  Only
+    processes trials with NO verified .fft yet — existing valid
+    spectra (an interrupted run's checkpoints) are left to
+    _batched_accelsearch so their upload isn't paid twice."""
+    _drop_stale(manifest, [f[:-4] + ".fft" for f in datfiles])
+    todo = [f for f in datfiles
             if not _valid(manifest, f[:-4] + ".fft")]
     if not todo:
         return
@@ -440,14 +738,14 @@ def _fused_fft_search(res, cfg, manifest=None, obs=None) -> None:
           "(device-resident spectra)" % len(todo))
 
 
-def _staged_fft_search_head(res, cfg, manifest=None, obs=None):
+def _staged_fft_search_head(datfiles, cfg, manifest=None, obs=None):
     """Stage 4 alone (the staged path used when zapbirds intervenes).
 
     Resume caveat: an .fft the journal marks "zapbirds" is a ZAPPED
     spectrum — still valid, must not be regenerated (that would undo
     the zap and desync the stage tag)."""
-    _drop_stale(manifest, [f[:-4] + ".fft" for f in res.datfiles])
-    todo = [f for f in res.datfiles
+    _drop_stale(manifest, [f[:-4] + ".fft" for f in datfiles])
+    todo = [f for f in datfiles
             if not _valid(manifest, f[:-4] + ".fft")]
     if todo:
         import jax
@@ -529,7 +827,7 @@ def _batched_accelsearch(fftfiles, cfg, manifest=None, obs=None):
 
 
 def _finish_survey_stages(rawfiles, cfg, workdir, base, res, timer,
-                          manifest=None, obs=None):
+                          manifest=None, obs=None, seam=None):
     # ---- 7. sift ------------------------------------------------------
     from presto_tpu.pipeline.sifting import sift_candidates
     accfiles = []
@@ -581,6 +879,11 @@ def _finish_survey_stages(rawfiles, cfg, workdir, base, res, timer,
             accpath = os.path.join(c.path, c.filename)
         candfile = accpath + ".cand"
         datfile = accpath.split("_ACCEL_")[0] + ".dat"
+        if seam is not None:
+            # prepfold reads its series from disk: spill this one
+            # trial from the seam on demand (a no-op when the durable
+            # tier already wrote it)
+            seam.ensure_dat(datfile)
         outbase = os.path.join(workdir, "fold_cand%d" % (i + 1))
         if _valid(manifest, outbase + ".pfd"):
             res.folded.append(outbase + ".pfd")
@@ -602,10 +905,18 @@ def _finish_survey_stages(rawfiles, cfg, workdir, base, res, timer,
     # ---- 9. single-pulse search --------------------------------------
     if cfg.singlepulse and res.datfiles:
         from presto_tpu.apps.single_pulse_search import main as sp_main
+        # seam trials were searched device-resident (stage 9a) and
+        # their .singlepulse artifacts verify here; anything else goes
+        # through the app — spilled from the seam first if its .dat
+        # never hit disk.
         _drop_stale(manifest,
                     [f[:-4] + ".singlepulse" for f in res.datfiles])
         sp_todo = [f for f in res.datfiles
                    if not _valid(manifest, f[:-4] + ".singlepulse")]
+        if seam is not None:
+            for f in sp_todo:
+                seam.ensure_dat(f)
+            sp_todo = [f for f in sp_todo if os.path.exists(f)]
         if sp_todo:
             argv = ["-t", str(cfg.sp_threshold)]
             if cfg.sp_maxwidth:

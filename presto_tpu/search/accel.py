@@ -1651,12 +1651,14 @@ class AccelSearch:
         del p0
         # per-spectrum footprint in the vmapped build: plane + stacked
         # ys + the [chunk, numz, fftlen] complex FFT intermediate
-        # (vmap multiplies ALL of them by the group size)
+        # (vmap multiplies ALL of them by the group size).  The group
+        # budget is HALF the old 6 GB because up to TWO groups are now
+        # in flight (the window below) — same peak residency.
         g = self._plane_geom()
         plane_bytes = numz * plane_numr * 4
         per_bytes = plane_bytes * 2 + (
             g.chunk * numz * self.kern.fftlen * 8 if g else 0)
-        group = max(1, int(6 * 2 ** 30 // max(per_bytes, 1)))
+        group = max(1, int(3 * 2 ** 30 // max(per_bytes, 1)))
         group = min(group, max(nd - 1, 1))
         # back-overlap the final group so every dispatch shares ONE jit
         # shape (the tail would otherwise retrace the two heaviest
@@ -1666,17 +1668,12 @@ class AccelSearch:
         if starts and starts[-1] + group > nd:
             starts[-1] = max(nd - group, 1)
         done = 1
-        for g0 in starts:
-            sub = jnp.asarray(batch[g0:g0 + group])
-            planes = build_many(sub, self._kern_dev)
-            # per-trial top-m compaction rides the scan dispatch: the
-            # dense top-k tensor stays on device (compact_m slots per
-            # trial cross instead — the D2H that dominated slow-link
-            # surveys).  A trial overflowing the budget (pathological
-            # RFI forest) falls back to the lossless dense fetch for
-            # its group.
-            comp = np.asarray(scanner.many_compact(planes, scols,
-                                                   compact_m))
+
+        def collect_group(ent):
+            """The host sync for one dispatched group."""
+            nonlocal done
+            g0, planes, comp_dev = ent
+            comp = np.asarray(comp_dev)
             dense = None
             for d in range(comp.shape[0]):
                 if g0 + d < done:
@@ -1692,6 +1689,31 @@ class AccelSearch:
                     cands = collect_dm(vals[d], cidx[d], zrow[d])
                 out.append(cands)
                 done = g0 + d + 1
+
+        # 2-deep in-flight window (the jerk ladder's pattern, see
+        # pipeline/fusion.InflightWindow): group i+1's build+scan is
+        # queued on the device before group i's host collection syncs,
+        # so candidate decoding overlaps device work instead of
+        # paying the link's dispatch+sync floor once per group.
+        # `planes` rides in the window entry because the pathological
+        # dense fallback needs it alive until its group is collected.
+        pend: list = []
+        for g0 in starts:
+            sub = jnp.asarray(batch[g0:g0 + group])
+            planes = build_many(sub, self._kern_dev)
+            # per-trial top-m compaction rides the scan dispatch: the
+            # dense top-k tensor stays on device (compact_m slots per
+            # trial cross instead — the D2H that dominated slow-link
+            # surveys).  A trial overflowing the budget (pathological
+            # RFI forest) falls back to the lossless dense fetch for
+            # its group.
+            pend.append((g0, planes,
+                         scanner.many_compact(planes, scols,
+                                              compact_m)))
+            if len(pend) >= 2:
+                collect_group(pend.pop(0))
+        while pend:
+            collect_group(pend.pop(0))
         return out
 
     def _collect_group(self, vals: np.ndarray, cidx: np.ndarray,

@@ -251,6 +251,13 @@ class Scheduler:
 
     def _run_single(self, job: Job) -> None:
         job.attempts += 1
+        if job.attempts > 1 and \
+                getattr(job.cfg, "durable_stages", None) is False:
+            # a retry is by definition resume-critical: flip the
+            # survey from the fused tier to durable stage artifacts so
+            # THIS attempt journals its boundaries and a further
+            # failure resumes from the last stage instead of the top
+            job.cfg.durable_stages = True
         job.status = JobStatus.RUNNING
         if not job.started:
             job.started = time.time()

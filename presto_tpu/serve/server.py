@@ -141,6 +141,14 @@ class SearchService:
         cfg = SurveyConfig(**cfg_dict)
         cfg.plan_provider = self.provider
         cfg.obs = self.obs          # job telemetry -> service registry
+        if "durable_stages" not in cfg_dict:
+            # serve jobs default to the fused tier: stages hand device
+            # arrays across the in-memory seam under the shared plan
+            # cache, skipping the .dat/.fft disk round-trips.  A job
+            # that fails and retries is flipped back to the durable
+            # tier by the scheduler (resume-critical); clients can pin
+            # either tier via config.durable_stages.
+            cfg.durable_stages = False
         job_id = str(spec.get("job_id") or "job-%06d" % next(self._ids))
         with self._jobs_lock:
             if job_id in self._jobs:

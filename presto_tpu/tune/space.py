@@ -19,6 +19,10 @@ measure a candidate:
                       cache (serve/plancache.py) — a *modeled* family:
                       its figure of merit is a deterministic cost
                       (compiles + padding waste), not a wall clock
+  pipeline_inflight_depth
+                      cross-stage in-flight window and host ingest
+                      double-buffer depth of the fused survey
+                      pipeline (pipeline/fusion.py)
 
 Families are device-agnostic declarations; ``tune.runner`` does the
 measuring and ``tune.db`` the remembering.  Every family has a tiny
@@ -228,6 +232,55 @@ def _oocfft_bench(shape, config):
 
 
 # ----------------------------------------------------------------------
+# pipeline_inflight_depth
+# ----------------------------------------------------------------------
+
+def _inflight_candidates(shape) -> List[dict]:
+    windows = shape.get("windows") or (1, 2, 3, 4)
+    depths = shape.get("ingest_depths") or (2, 4)
+    return [{"window": int(w), "ingest_depth": int(b)}
+            for w in windows for b in depths]
+
+
+def _inflight_bench(shape, config):
+    """The fused pipeline in miniature: a host ingest stage double-
+    buffered behind a device FFT stage, with the cross-stage in-flight
+    window bounding queued dispatches (pipeline/fusion.py).  Depths
+    only change overlap — every candidate computes identical floats —
+    so the figure of merit is pure pipeline wall time."""
+    import jax
+    import jax.numpy as jnp
+    from presto_tpu.ops import fftpack
+    from presto_tpu.pipeline.fusion import (DoubleBufferedIngest,
+                                            InflightWindow)
+    nblocks = int(shape.get("nblocks", 8))
+    n = int(shape.get("n", 1 << 16))
+    rng = np.random.default_rng(21)
+    blocks = [rng.random(n).astype(np.float32)
+              for _ in range(nblocks)]
+    fft = jax.jit(fftpack.realfft_packed_pairs)
+    window_depth = int(config["window"])
+    ingest_depth = int(config["ingest_depth"])
+
+    def fn():
+        def produce():
+            for b in blocks:
+                # the host half of the seam: a fresh copy stands in
+                # for decode/mask/clip work
+                yield np.ascontiguousarray(b)
+        window = InflightWindow(window_depth)
+        last = None
+        with DoubleBufferedIngest(produce(),
+                                  depth=ingest_depth) as ingest:
+            for b in ingest:
+                last = fft(jnp.asarray(b))
+                window.admit(last)
+        window.drain()
+        return last
+    return fn
+
+
+# ----------------------------------------------------------------------
 # plancache_bucket (modeled)
 # ----------------------------------------------------------------------
 
@@ -337,6 +390,20 @@ FAMILIES: Dict[str, Family] = {
         shapes=lambda smoke: (
             [{"n": 1 << 14, "max_mems": (1 << 16, 1 << 20)}]
             if smoke else [{"n": 1 << 22}]),
+    ),
+    "pipeline_inflight_depth": Family(
+        name="pipeline_inflight_depth",
+        doc="Fused-pipeline depths: cross-stage in-flight window "
+            "(1-4) x host ingest double-buffer; overlap only, "
+            "byte-identical outputs",
+        shape_key=lambda s: tune.GLOBAL_KEY,
+        candidates=_inflight_candidates,
+        bench=_inflight_bench,
+        shapes=lambda smoke: (
+            [{"nblocks": 4, "n": 1 << 12,
+              "windows": (1, 2), "ingest_depths": (2,)}] if smoke
+            else [{"nblocks": 16, "n": 1 << 20}]),
+        available=_jax_ok,
     ),
     "plancache_bucket": Family(
         name="plancache_bucket",

@@ -186,6 +186,104 @@ def test_kill_resume_matrix_extended(tiny_obs, provider,
 
 
 # ----------------------------------------------------------------------
+# fused tier (pipeline/fusion.py): durable_stages=False keeps the data
+# path in HBM; a kill anywhere in it must resume cleanly on the
+# durable staged tier and converge to byte-identical artifacts
+# ----------------------------------------------------------------------
+
+#: final artifacts the fused tier must still produce (the .dat/.fft
+#: intermediates are exactly what it skips)
+FINAL_ONLY = (".cand", ".singlepulse", ".mask", ".stats", ".txt")
+
+
+@pytest.mark.chaos
+def test_fused_tier_artifacts_byte_equal(tiny_obs, provider,
+                                         reference_run, tmp_path,
+                                         monkeypatch):
+    """A durable_stages=False survey writes no .dat/.fft
+    intermediates, and every artifact it does write is byte-identical
+    to the staged run's.  (The conftest's 8-device virtual mesh would
+    route prepsubband through the seam-incompatible sharded path —
+    whose rows are byte-equal to single-device by the elastic tests —
+    so pin the single-device seam path here.)"""
+    monkeypatch.setenv("PRESTO_TPU_DISABLE_MESH", "1")
+    _, ref_arts = reference_run
+    work = str(tmp_path)
+    res = run_survey([tiny_obs],
+                     _cfg(provider, durable_stages=False),
+                     workdir=work)
+    assert res.candfile and os.path.exists(res.candfile)
+    got = _artifacts(work)
+    assert not any(n.endswith((".dat", ".fft")) for n in got), \
+        "fused tier must not write stage intermediates"
+    finals = {n: b for n, b in ref_arts.items()
+              if n.endswith(FINAL_ONLY) or "_ACCEL_" in n}
+    missing = sorted(set(finals) - set(got))
+    assert not missing, "fused tier lost final artifacts: %s" % missing
+    diff = [n for n in finals if got[n] != finals[n]]
+    assert not diff, "fused artifacts differ from staged: %s" % diff
+
+
+@pytest.mark.chaos
+@pytest.mark.parametrize("kill_at", ["seam-handoff",
+                                     "sp-seam-chunk",
+                                     "fused-chunk"])
+def test_kill_in_fused_path_resumes_durable(tiny_obs, provider,
+                                            reference_run, tmp_path,
+                                            kill_at, monkeypatch):
+    """Kill INSIDE the fused (non-durable) path; a resume on the
+    default durable tier redoes the unjournaled stages and the final
+    artifacts are byte-equal to a never-failed staged run."""
+    monkeypatch.setenv("PRESTO_TPU_DISABLE_MESH", "1")
+    _, ref_arts = reference_run
+    work = str(tmp_path)
+    fi = chaos.FaultInjector(kill_at=kill_at, kill_after=1)
+    with pytest.raises(chaos.SimulatedCrash):
+        run_survey([tiny_obs],
+                   _cfg(provider, durable_stages=False,
+                        fault_injector=fi), workdir=work)
+    assert fi.fired is not None and kill_at in fi.fired
+    res = run_survey([tiny_obs], _cfg(provider), workdir=work)
+    assert res.candfile and os.path.exists(res.candfile)
+    _assert_equal_artifacts(_artifacts(work), ref_arts)
+
+
+@pytest.mark.chaos
+def test_fused_spill_on_demand_for_prepfold(tiny_obs, provider,
+                                            tmp_path, monkeypatch):
+    """fold_sigma low enough to fold something: the fused tier spills
+    exactly the folded candidates' .dat series on demand (prepfold
+    reads from disk), nothing else."""
+    monkeypatch.setenv("PRESTO_TPU_DISABLE_MESH", "1")
+    work = str(tmp_path)
+    res = run_survey(
+        [tiny_obs],
+        _cfg(provider, durable_stages=False, fold_top=2,
+             min_dm_hits=1, sigma=2.0),
+        workdir=work)
+    dats = glob.glob(os.path.join(work, "*_DM*.dat"))
+    if res.folded:
+        # every fold had its series spilled; the rest stayed seam-only
+        assert 0 < len(dats) <= len(res.folded)
+    else:
+        assert not dats
+
+
+@pytest.mark.chaos
+def test_fusion_kill_switch_keeps_staged_contract(tiny_obs, provider,
+                                                  reference_run,
+                                                  tmp_path,
+                                                  monkeypatch):
+    """PRESTO_TPU_FUSION=0 runs the pre-fusion staged path end to end
+    and produces identical bytes (the operational escape hatch)."""
+    _, ref_arts = reference_run
+    monkeypatch.setenv("PRESTO_TPU_FUSION", "0")
+    work = str(tmp_path)
+    run_survey([tiny_obs], _cfg(provider), workdir=work)
+    _assert_equal_artifacts(_artifacts(work), ref_arts)
+
+
+# ----------------------------------------------------------------------
 # corruption containment (acceptance criterion 2)
 # ----------------------------------------------------------------------
 

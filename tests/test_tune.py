@@ -223,6 +223,40 @@ def test_space_resolve_unknown_family():
         resolve(["nope"])
 
 
+def test_inflight_depth_family(tmp_path, monkeypatch):
+    """pipeline_inflight_depth: candidates span the window x ingest
+    grid, results are stored under the global shape key, every
+    candidate computes IDENTICAL bytes (depths only change overlap),
+    and a measured DB entry drives fusion.resolve_depths."""
+    import numpy as np
+    from presto_tpu import tune
+    from presto_tpu.pipeline import fusion
+    from presto_tpu.tune.space import FAMILIES
+    fam = FAMILIES["pipeline_inflight_depth"]
+    cands = fam.candidates({})
+    assert {c["window"] for c in cands} == {1, 2, 3, 4}
+    assert {c["ingest_depth"] for c in cands} == {2, 4}
+    assert fam.shape_key({}) == tune.GLOBAL_KEY
+    # byte-identity invariant: the pipelined chain's result is depth-
+    # independent (same floats through the same fft, any overlap)
+    shape = {"nblocks": 3, "n": 1 << 10}
+    outs = [np.asarray(fam.bench(shape, c)())
+            for c in ({"window": 1, "ingest_depth": 2},
+                      {"window": 4, "ingest_depth": 4})]
+    assert np.array_equal(outs[0], outs[1])
+    # a measured entry reaches the fused pipeline's depth resolution
+    dbp = str(tmp_path / "tune.json")
+    _write_db(dbp, "pipeline_inflight_depth", tune.GLOBAL_KEY,
+              {"window": 4, "ingest_depth": 2})
+    monkeypatch.setenv(tune.ENV_SWITCH, "1")
+    tune.configure(db_path=dbp)
+    try:
+        assert fusion.resolve_depths() == {"window": 4,
+                                           "ingest_depth": 2}
+    finally:
+        tune.reset()
+
+
 # ----------------------------------------------------------------------
 # lookup semantics
 # ----------------------------------------------------------------------

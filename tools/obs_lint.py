@@ -35,7 +35,14 @@ code path cannot ship silently:
      `stream_*` metric listed in METRICS registered by the stream
      layer — the live trigger path is the one place an unobservable
      code path costs real pulses, so its whole telemetry vocabulary
-     is pinned.
+     is pinned;
+  8. the fused pipeline (presto_tpu/pipeline/fusion.py): every
+     `obs.span("pipeline:...")` it opens is registered in
+     FUSION_SPANS — and conversely — and every `survey_fused_*`
+     metric listed in METRICS is actually registered by the fusion
+     layer, so the in-memory data path (which deliberately SKIPS the
+     durable artifacts a post-mortem would otherwise read) cannot
+     ship with its telemetry dark.
 
 Run directly (exit 1 lists violations) or via tests/test_obs_lint.py.
 """
@@ -246,6 +253,31 @@ def lint() -> List[str]:
     for m in sorted(cataloged_stream - smetrics):
         problems.append(
             "obs/taxonomy.py: METRICS lists %r but the stream layer "
+            "never registers it" % m)
+
+    # 8. fused pipeline: seam spans both ways, survey_fused_* metric
+    # reverse direction (forward is check 5)
+    try:
+        fusion_src = _read("presto_tpu/pipeline/fusion.py")
+    except OSError:
+        fusion_src = ""
+    fspans = {s for s in SPAN_RE.findall(fusion_src)
+              if s.startswith("pipeline:")}
+    fmetrics = set(METRIC_RE.findall(fusion_src))
+    for s in sorted(fspans - taxonomy.FUSION_SPANS):
+        problems.append(
+            "pipeline/fusion.py: span %r is not registered in "
+            "obs/taxonomy.FUSION_SPANS (uninstrumented fused path)"
+            % s)
+    for s in sorted(taxonomy.FUSION_SPANS - fspans):
+        problems.append(
+            "obs/taxonomy.py: FUSION_SPANS lists %r but the fusion "
+            "layer never opens it" % s)
+    cataloged_fused = {m for m in taxonomy.METRICS
+                       if m.startswith("survey_fused_")}
+    for m in sorted(cataloged_fused - fmetrics):
+        problems.append(
+            "obs/taxonomy.py: METRICS lists %r but the fusion layer "
             "never registers it" % m)
     return problems
 
