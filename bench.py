@@ -565,6 +565,184 @@ def bench_jerk():
     return cells / best, warm, best, cells, len(cands)
 
 
+def bench_multichip_inclusive(fast: bool = False):
+    """The MULTICHIP twin of inclusive_breakdown: fused vs staged
+    INCLUSIVE throughput of the DM-sharded chain (dedisp -> rFFT ->
+    accelsearch) on the current device mesh, with transfer/compile/
+    compute/disk attribution.  The fused regime is the sharded seam
+    (pipeline/fusion.ShardedSeamBlock): per-device static-delay
+    dedispersion feeds a dm-sharded batched rFFT and a shard_map'd
+    search in place, with ONE per-shard gather at candidate
+    collection.  The staged regime is the pre-seam sharded contract:
+    gather the fan-out to host, round-trip every trial through a
+    .dat/.fft write+read, re-upload to one device, search there.
+    Returns None on a single-device host (nothing to shard).
+
+    Identical inputs, identical candidate counts both regimes (the
+    byte-level proof lives in tests/test_sharded_fusion.py; this
+    block measures the wall-clock and transfer shares) — emitted into
+    MULTICHIP_*.json via __graft_entry__.dryrun_multichip and onto
+    the bench line when the bench host is a mesh."""
+    import tempfile
+    import jax
+    import jax.numpy as jnp
+    from presto_tpu.obs import Observability, ObsConfig, jaxtel
+    from presto_tpu.ops import fftpack
+    from presto_tpu.parallel.mesh import dm_sharding, make_mesh
+    from presto_tpu.parallel.sharded import ShardedDedispPlan
+    from presto_tpu.pipeline import fusion
+    from presto_tpu.search.accel import AccelConfig, AccelSearch
+
+    ndev = len(jax.devices())
+    if ndev < 2:
+        return None
+    obs = Observability(ObsConfig(enabled=True))
+    mesh = make_mesh()
+    numchan, nsub = (32, 16) if fast else (64, 32)
+    numdms = 2 * ndev if fast else 8 * ndev
+    blocklen = (1 << 11) if fast else (1 << 14)
+    nblocks = 4 if fast else 8
+    rng = np.random.default_rng(17)
+    blocks = [rng.normal(size=(numchan, blocklen)).astype(np.float32)
+              for _ in range(nblocks)]
+    chan_d = (np.arange(numchan) % 64).astype(np.int32)
+    dm_d = (np.arange(numdms)[:, None]
+            * np.linspace(0, 4, nsub)[None, :]).astype(np.int32)
+    plan = ShardedDedispPlan(mesh, nsub, 1, chan_d, dm_d)
+    T_s = 200.0
+
+    def dedisperse():
+        prev_raw = prev_sub = None
+        outs = []
+        for b in blocks:
+            cur = plan.put_block(b)
+            if prev_raw is not None:
+                if prev_sub is None:
+                    prev_sub = plan.prime(prev_raw, cur)
+                else:
+                    prev_sub, series = plan.step(prev_raw, cur,
+                                                 prev_sub)
+                    outs.append(series)
+            prev_raw = cur
+        return plan.concat(outs)       # [numdms, T] dm-sharded
+
+    def fft_len(cat):
+        return int(cat.shape[1]) & ~1
+
+    # ---- warmup / compile (both regimes' programs) -----------------
+    t0 = time.time()
+    cat = dedisperse()
+    n = fft_len(cat)
+    searcher = AccelSearch(AccelConfig(zmax=0, numharm=2, sigma=3.0),
+                           T=T_s, numbins=n // 2)
+    pairs = fusion.fused_rfft_batch(cat[:, :n], mesh=mesh)
+    res = searcher.search_many(pairs, mesh=mesh)
+    host0 = fusion.gather_shards(cat, obs=obs)
+    sp_fft = jax.jit(jax.vmap(fftpack.realfft_packed_pairs))
+    res_staged = searcher.search_many(
+        np.asarray(sp_fft(jnp.asarray(host0[:, :n]))))
+    compile_s = time.time() - t0
+
+    # ---- fused sharded regime (min of 2: the virtual-mesh CPU
+    # backend shows 10-20% run-to-run variance) --------------------
+    snap0 = jaxtel.transfer_snapshot(obs)
+    fused_s = float("inf")
+    t_fgather = 0.0
+    for _ in range(2):
+        t0 = time.time()
+        cat = dedisperse()
+        pairs = fusion.fused_rfft_batch(cat[:, :n], mesh=mesh)
+        res = searcher.search_many(pairs, mesh=mesh)
+        tg = time.time()
+        pairs_host = fusion.gather_shards(pairs, obs=obs)
+        total = time.time() - t0
+        if total < fused_s:
+            fused_s, t_fgather = total, time.time() - tg
+    snap1 = jaxtel.transfer_snapshot(obs)
+    ncands_fused = sum(len(c) for c in res)
+
+    # ---- staged sharded regime (pre-seam contract): every trial
+    # round-trips through an ATOMIC .dat (tmp+fsync+rename — what
+    # io/atomic pays for every staged artifact), then re-uploads to
+    # one device and searches there ----------------------------------
+    staged_s = float("inf")
+    t_dedisp = t_gather = t_disk = t_upload = t_search = 0.0
+    for _ in range(2):
+        t0 = time.time()
+        cat = dedisperse()
+        jax.block_until_ready(cat)   # attribution boundary: without
+        s_dedisp = time.time() - t0  # the force, the async dedisp
+        t0 = time.time()             # wall lands in the gather below
+        host = fusion.gather_shards(cat, obs=obs)   # gather to host
+        s_gather = time.time() - t0
+        t0 = time.time()                        # per-trial disk trip
+        with tempfile.TemporaryDirectory() as td:
+            for i in range(numdms):
+                p = os.path.join(td, "t%d.dat" % i)
+                with open(p + ".tmp", "wb") as f:
+                    host[i].tofile(f)
+                    f.flush()
+                    os.fsync(f.fileno())
+                os.rename(p + ".tmp", p)
+            back = np.stack([
+                np.fromfile(os.path.join(td, "t%d.dat" % i),
+                            dtype=np.float32)
+                for i in range(numdms)])
+        s_disk = time.time() - t0
+        t0 = time.time()
+        dev = jnp.asarray(back[:, :n])          # re-upload, 1 device
+        jax.block_until_ready(dev)
+        jaxtel.note_put(obs, back[:, :n].nbytes)
+        s_upload = time.time() - t0
+        t0 = time.time()
+        res2 = searcher.search_many(np.asarray(sp_fft(dev)))
+        s_search = time.time() - t0
+        total = s_dedisp + s_gather + s_disk + s_upload + s_search
+        if total < staged_s:        # keep the best iteration's own
+            staged_s = total        # components so shares sum to 1
+            t_dedisp, t_gather, t_disk = s_dedisp, s_gather, s_disk
+            t_upload, t_search = s_upload, s_search
+    ncands_staged = sum(len(c) for c in res2)
+
+    cells = searcher.cfg.numz * int(searcher.rhi - searcher.rlo) * 2
+    return {
+        "n_devices": ndev,
+        "numdms": numdms,
+        "fused_s": round(fused_s, 3),
+        "staged_s": round(staged_s, 3),
+        "speedup": round(staged_s / max(fused_s, 1e-9), 2),
+        "fused_cells_per_sec": round(cells * numdms / fused_s, 1),
+        "staged_cells_per_sec": round(cells * numdms / staged_s, 1),
+        "compile_s": round(compile_s, 2),
+        "ncands": {"fused": ncands_fused, "staged": ncands_staged,
+                   "equal": ncands_fused == ncands_staged},
+        "staged_breakdown_s": {
+            "dedisp": round(t_dedisp, 3),
+            "gather": round(t_gather, 3),
+            "disk": round(t_disk, 3),
+            "reupload": round(t_upload, 3),
+            "fft+search": round(t_search, 3)},
+        "shares_staged": {
+            "transfer": round((t_gather + t_upload) / staged_s, 3),
+            "disk": round(t_disk / staged_s, 3),
+            "compute": round((t_dedisp + t_search) / staged_s, 3)},
+        # fused: the ONLY host transfer is the candidate-collection
+        # gather — no per-DM re-upload, no disk
+        "shares_fused": {
+            "transfer": round(t_fgather / max(fused_s, 1e-9), 3),
+            "disk": 0.0,
+            "compute": round(1.0 - t_fgather / max(fused_s, 1e-9),
+                             3)},
+        # the fused regime's only bulk transfer is the candidate-
+        # collection gather; the per-DM host round-trip is gone
+        "fused_gather_bytes": int(pairs_host.nbytes),
+        "staged_roundtrip_bytes": int(host.nbytes
+                                      + back[:, :n].nbytes),
+        "jaxtel_put_bytes": snap1["put_bytes"] - snap0["put_bytes"],
+        "jaxtel_get_bytes": snap1["get_bytes"] - snap0["get_bytes"],
+    }
+
+
 def main():
     import jax
 
@@ -633,6 +811,12 @@ def main():
                      "pipeline/fusion.py) — the per-call dispatch "
                      "floor that bound BENCH_r05's ~0.1 s serial "
                      "number (dispatch_bound_s) amortizes away")}
+
+    # fused vs staged sharded regime, when this host IS a mesh (the
+    # same block rides into MULTICHIP_*.json via dryrun_multichip)
+    mc = bench_multichip_inclusive()
+    if mc is not None:
+        extra["multichip_inclusive"] = mc
 
     from presto_tpu import tune
     tune_attr = tuning_info()

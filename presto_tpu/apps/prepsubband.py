@@ -239,16 +239,36 @@ def run(args):
             "— numdms (%d) must divide the global device count (%d), "
             "-sub is single-host only, and PRESTO_TPU_DISABLE_MESH "
             "must be unset" % (args.numdms, ndev))
+    mesh = None
+    sh_plan = None
     if use_mesh:
         from presto_tpu.parallel.mesh import make_mesh
-        from presto_tpu.parallel.sharded import (
-            make_sharded_dedisperse_step, shard_dm_array)
         mesh = make_mesh()
-        sh_step = make_sharded_dedisperse_step(mesh, args.nsub,
-                                               args.downsamp)
-        dm_bins_d = shard_dm_array(dm_bins_d, mesh)
-        print("prepsubband: DM fan-out sharded over %d devices"
-              % ndev)
+        if jax.process_count() == 1:
+            # static per-device delay plans (parallel/sharded.
+            # ShardedDedispPlan): each device compiles its DM
+            # sub-range's delays as constants, so the static-slice
+            # fast path and its dedisp_dm_batch tuning bound drive
+            # the multi-device loop too — and the per-device outputs
+            # assemble into one dm-sharded global array the fused
+            # seam consumes in place
+            from presto_tpu.parallel.sharded import ShardedDedispPlan
+            sh_plan = ShardedDedispPlan(mesh, args.nsub,
+                                        args.downsamp, chan_bins,
+                                        np.asarray(dm_bins))
+            sh_step = sh_plan
+            print("prepsubband: DM fan-out sharded over %d devices "
+                  "(static per-device delay plans)" % ndev)
+        else:
+            # multi-host keeps the traced shard_map step: the MPMD
+            # per-device dispatch model has no cross-process story
+            from presto_tpu.parallel.sharded import (
+                make_sharded_dedisperse_step, shard_dm_array)
+            sh_step = make_sharded_dedisperse_step(mesh, args.nsub,
+                                                   args.downsamp)
+            dm_bins_d = shard_dm_array(dm_bins_d, mesh)
+            print("prepsubband: DM fan-out sharded over %d devices"
+                  % ndev)
     elif ndev > 1 and not args.sub:
         why = ("PRESTO_TPU_DISABLE_MESH is set"
                if os.environ.get("PRESTO_TPU_DISABLE_MESH")
@@ -266,11 +286,18 @@ def run(args):
     # in-memory stage seam (pipeline/fusion.py): when the survey
     # driver installed a process seam and this run's path is
     # seam-compatible, the DM fan-out is handed over device-resident
-    # instead of (only) being written to .dat files
+    # instead of (only) being written to .dat files.  Sharded mesh
+    # runs deposit a ShardedSeamBlock (one DM sub-range per device);
+    # barycentred runs resample on host and re-deposit.  Only
+    # multi-process (-coordinator) and -sub runs keep the staged
+    # contract.
     from presto_tpu.pipeline import fusion
     seam = fusion.current_process_seam()
-    use_seam = (seam is not None and not args.sub and sh_step is None
-                and jax.process_count() == 1 and plan is None)
+    use_seam = (seam is not None and not args.sub
+                and jax.process_count() == 1)
+    if use_mesh:
+        print("prepsubband: sharded routing = %s"
+              % ("fused-seam" if use_seam else "staged"))
     ingest_depth = (seam.depths["ingest_depth"] if use_seam
                     else fusion.DEFAULT_INGEST_DEPTH)
 
@@ -304,12 +331,24 @@ def run(args):
         for nread, blockT in ingest:
             pct = print_percent_complete(min(nread - skip, Neff),
                                          Neff, pct)
-            cur = jnp.asarray(blockT)
+            cur = (sh_plan.put_block(blockT) if sh_plan is not None
+                   else jnp.asarray(blockT))
             if prev_raw is not None:
-                if sh_step is not None and prev_sub is not None:
-                    # sharded step: subbands on replicated data, the
-                    # DM fan-out split over the mesh (mpiprepsubband's
+                if sh_plan is not None:
+                    # static per-device sharded step: replicated raw
+                    # blocks, each device running its own compiled
+                    # DM-sub-range program (mpiprepsubband's
                     # compute-everywhere/Bcast pattern, SURVEY s2.5)
+                    if prev_sub is None:
+                        sub = sh_plan.prime(prev_raw, cur)
+                    else:
+                        sub, series = sh_plan.step(prev_raw, cur,
+                                                   prev_sub)
+                        outs.append(series)
+                elif sh_step is not None and prev_sub is not None:
+                    # traced sharded step (multi-host): subbands on
+                    # replicated data, the DM fan-out split over the
+                    # mesh
                     sub, series = sh_step(prev_raw, cur, prev_sub,
                                           chan_bins_d, dm_bins_d)
                     outs.append(series)
@@ -338,10 +377,12 @@ def run(args):
         return _write_subbands(args, fb, plan, subouts, dms, dt,
                                int(chan_bins.max()), Neff, skip)
 
-    cat = jnp.concatenate(outs, axis=1)                 # [numdms, T]
+    # [numdms, T] — ONE dm-sharded global array on the mesh path
+    cat = (sh_plan.concat(outs) if sh_plan is not None
+           else jnp.concatenate(outs, axis=1))
     if use_seam:
         return _seam_handoff(args, fb, seam, cat, dms, dt, Neff, maxd,
-                             skip)
+                             skip, plan=plan, mesh=mesh)
     if jax.process_count() > 1:
         # multi-host: each process materializes and writes ONLY its
         # own DM rows — the reference's workers write their own .dat
@@ -390,7 +431,8 @@ def run(args):
     return outbase, dms
 
 
-def _seam_handoff(args, fb, seam, cat, dms, dt, Neff, maxd, skip):
+def _seam_handoff(args, fb, seam, cat, dms, dt, Neff, maxd, skip,
+                  plan=None, mesh=None):
     """Deposit the DM fan-out at the survey's in-memory stage seam
     (pipeline/fusion.py) instead of round-tripping it through .dat
     files: the device block stays resident for the FFT/search stages,
@@ -400,18 +442,52 @@ def _seam_handoff(args, fb, seam, cat, dms, dt, Neff, maxd, skip):
 
     Byte-identity: the pad tail is computed on HOST with
     pad_to_good_N's exact NumPy semantics and uploaded, so the device
-    series equals the staged .dat bytes bit-for-bit."""
-    from presto_tpu.pipeline.fusion import SeamBlock
+    series equals the staged .dat bytes bit-for-bit.
+
+    Sharded (``mesh``): ``cat`` is one global dm-sharded array; the
+    download is per-shard (fusion.gather_shards — parallel D2H, no
+    single-device gather), only the pad TAIL is re-uploaded (sharded),
+    and the deposit is a ShardedSeamBlock whose consumers stay on the
+    shards.  Barycentred (``plan``): the diffbin resampling runs on
+    the downloaded series with the staged path's exact host semantics,
+    then the resampled+padded series is RE-DEPOSITED to the device(s)
+    — one download + one upload, versus the staged download + .dat
+    write + read + re-upload."""
+    from presto_tpu.pipeline import fusion
+    from presto_tpu.pipeline.fusion import SeamBlock, ShardedSeamBlock
+    from presto_tpu.obs import jaxtel
 
     valid = (Neff - maxd) // args.downsamp
     trimmed = cat[:, :max(valid, 0)]
-    host = np.asarray(trimmed)                  # the one download
-    from presto_tpu.obs import jaxtel
-    jaxtel.note_get(getattr(seam, "obs", None), host.nbytes)
+    obs = getattr(seam, "obs", None)
+    if mesh is not None:
+        host = fusion.gather_shards(trimmed, obs=obs)  # per-shard D2H
+    else:
+        host = np.asarray(trimmed)              # the one download
+        jaxtel.note_get(obs, host.nbytes)
+    resampled = plan is not None and plan.diffbins.size
+    if resampled:
+        # same diffbin schedule applies to every DM series (exact
+        # staged semantics: resample the trimmed series, then pad)
+        host = np.stack([plan.apply(host[i])
+                         for i in range(host.shape[0])])
     host, valid, numout = pad_to_good_N(host, args.numout)
-    if numout > trimmed.shape[1]:
-        dev = jnp.concatenate(
-            [trimmed, jnp.asarray(host[:, trimmed.shape[1]:])], axis=1)
+
+    from presto_tpu.parallel.mesh import dm_sharding
+    if resampled:
+        # the bary resampling changed the sample schedule on host:
+        # re-deposit the full padded series (sharded when on a mesh)
+        if mesh is not None:
+            dev = jax.device_put(host, dm_sharding(mesh, 2))
+        else:
+            dev = jnp.asarray(host)
+        jaxtel.note_put(obs, host.nbytes)
+    elif numout > trimmed.shape[1]:
+        tail = host[:, trimmed.shape[1]:]
+        tail_dev = (jax.device_put(tail, dm_sharding(mesh, 2))
+                    if mesh is not None else jnp.asarray(tail))
+        jaxtel.note_put(obs, tail.nbytes)
+        dev = jnp.concatenate([trimmed, tail_dev], axis=1)
     else:
         dev = trimmed[:, :numout]
 
@@ -420,7 +496,9 @@ def _seam_handoff(args, fb, seam, cat, dms, dt, Neff, maxd, skip):
     for i, dmval in enumerate(dms):
         name = "%s_DM%.*f" % (outbase, args.dmprec, dmval)
         info = fil_to_inf(fb, name, numout, dm=float(dmval))
-        if skip:
+        if plan is not None:
+            set_bary_epoch(info, plan)
+        elif skip:
             info.mjd_f += skip * dt / 86400.0
             info.mjd_i += int(info.mjd_f)
             info.mjd_f %= 1.0
@@ -430,15 +508,21 @@ def _seam_handoff(args, fb, seam, cat, dms, dt, Neff, maxd, skip):
         info.N = numout
         names.append(name)
         infos.append(info)
-    seam.add_block(SeamBlock(
-        names=names, infos=infos, dms=[float(d) for d in dms],
-        series_dev=dev, series_host=host, valid=valid, numout=numout,
-        dt=dt * args.downsamp))
+    kw = dict(names=names, infos=infos,
+              dms=[float(d) for d in dms], series_dev=dev,
+              series_host=host, valid=valid, numout=numout,
+              dt=dt * args.downsamp)
+    if mesh is not None:
+        seam.add_block(ShardedSeamBlock(mesh=mesh, **kw))
+    else:
+        seam.add_block(SeamBlock(**kw))
     fb.close()
     print("Handed %d DMs x %d samples across the stage seam "
-          "(lodm=%g dmstep=%g nsub=%d, durable=%s)"
+          "(lodm=%g dmstep=%g nsub=%d, durable=%s%s%s)"
           % (len(names), numout, args.lodm, args.dmstep, args.nsub,
-             seam.durable))
+             seam.durable,
+             ", sharded" if mesh is not None else "",
+             ", bary" if plan is not None else ""))
     return outbase, dms
 
 

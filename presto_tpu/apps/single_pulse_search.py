@@ -61,6 +61,23 @@ def sp_input_plan(info, nraw):
     return nuse, offregions
 
 
+def sp_block_plan(infos, nraw):
+    """One shared (nuse, offregions) for a whole prepsubband fan-out,
+    or None when the trials disagree: every DM series of one method
+    has the same N/dt/onoff (set_onoff runs with the same valid/numout
+    for each), so the survey's sharded seam path
+    (pipeline/survey._seam_singlepulse) can search each device's shard
+    as ONE batch without per-row re-planning.  Disagreement (mixed
+    resumes, hand-edited .inf) falls back to per-trial planning."""
+    plans = {(nuse, tuple(off))
+             for nuse, off in (sp_input_plan(info, nraw)
+                               for info in infos)}
+    if len(plans) != 1:
+        return None
+    nuse, off = next(iter(plans))
+    return nuse, list(off)
+
+
 def run(args) -> list:
     ensure_backend()
     allcands = []

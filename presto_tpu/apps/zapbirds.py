@@ -118,6 +118,24 @@ def zap_amps(amps: np.ndarray, zapfile: str, T: float, N: int,
     return zap_bins(amps, kept), len(kept)
 
 
+def zap_pairs_batch(pairs_host: np.ndarray, zapfile: str, T: float,
+                    N: int, baryv: float = 0.0) -> np.ndarray:
+    """In-memory -zap over a BATCH of packed-pair spectra
+    ([ntrials, numbins, 2] float32, the seam's download layout):
+    every row zapped with the same deterministic zap_amps, rows
+    rewritten in place.  Shared by the survey's fused search
+    (pipeline/survey._seam_fft_search) for both the single-device and
+    the DM-sharded seam paths — all trials of a fan-out share T and N,
+    so one parsed zapfile covers the batch; zapped bytes are identical
+    to per-file `zapbirds -zap` on the same spectra."""
+    from presto_tpu.ops import fftpack
+    for i in range(pairs_host.shape[0]):
+        amps = fftpack.np_pairs_to_complex64(pairs_host[i])
+        amps, _nz = zap_amps(amps, zapfile, T, N, baryv)
+        pairs_host[i] = np.stack([amps.real, amps.imag], -1)
+    return pairs_host
+
+
 def zap_fft_file(fftpath: str, zapfile: str, baryv: float = 0.0) -> int:
     """-zap path: rewrite fftpath with the zapfile's ranges replaced by
     local-median noise.  Returns the number of ranges zapped."""

@@ -38,10 +38,12 @@ KILL_POINTS = frozenset({
     "elastic-method",
     "post-prepsubband",
     "seam-handoff",
+    "shard-seam-handoff",
     "sp-seam-chunk",
     "zapbirds-file",
     "fft-chunk",
     "fused-chunk",
+    "sharded-fused-chunk",
     "accel-chunk",
     "pre-sift",
     "post-sift",
@@ -148,6 +150,26 @@ TUNE_SPANS = frozenset({
 #: catalog may not list dead ones)
 FUSION_SPANS = frozenset({
     "pipeline:seam",
+    "pipeline:shard-seam",
+})
+
+#: the DM-sharded subset of the fused-pipeline vocabulary (obs_lint
+#: check 9 pins all three sets BOTH directions: the sharded seam is
+#: the one data path that holds an entire survey's fan-out across
+#: devices with nothing durable on disk until spill, so its spans,
+#: kill points, and metrics may neither go dark nor go stale)
+SHARDED_FUSION_SPANS = frozenset({
+    "pipeline:shard-seam",
+})
+
+SHARDED_KILL_POINTS = frozenset({
+    "shard-seam-handoff",
+    "sharded-fused-chunk",
+})
+
+SHARDED_FUSION_METRICS = frozenset({
+    "survey_fused_shard_trials_total",
+    "survey_fused_shard_gather_bytes_total",
 })
 
 #: registered metric names (Prometheus side of the contract); the
@@ -215,6 +237,10 @@ METRICS = frozenset({
     # (obs_lint check 8)
     "survey_fused_trials_total",
     "survey_fused_bytes_spilled_total",
+    # DM-sharded seam (pipeline/fusion.ShardedSeamBlock); pinned both
+    # directions by obs_lint check 9 via SHARDED_FUSION_METRICS
+    "survey_fused_shard_trials_total",
+    "survey_fused_shard_gather_bytes_total",
     # streaming search (presto_tpu/stream); every stream_* name here
     # must be registered by the stream layer (obs_lint check 7)
     "stream_blocks_total",

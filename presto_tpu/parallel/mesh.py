@@ -83,6 +83,21 @@ def replicated(mesh: Mesh):
     return NamedSharding(mesh, P())
 
 
+def shard_row_ranges(mesh: Mesh, nrows: int):
+    """Contiguous [lo, hi) row ranges of a `nrows`-long leading axis
+    sharded over the mesh's first axis, in mesh device order — the
+    host-side twin of dm_sharding's partition (each range is the slice
+    `NamedSharding.addressable_devices_indices_map` would assign to
+    that device).  `nrows` must divide evenly; callers pad first."""
+    devs = list(mesh.devices.flat)
+    if nrows % len(devs):
+        raise ValueError(
+            "shard_row_ranges: %d rows do not divide over %d devices"
+            % (nrows, len(devs)))
+    per = nrows // len(devs)
+    return [(k * per, (k + 1) * per) for k in range(len(devs))]
+
+
 def batch_sharding(mesh: Mesh, ndim: int = 2, batch_axis: int = 0):
     """NamedSharding for a stacked micro-batch (serve layer): the
     leading batch axis — coalesced same-bucket jobs, or a job's DM

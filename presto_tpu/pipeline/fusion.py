@@ -44,7 +44,14 @@ The seam crosses the survey's app-CLI boundary (argv cannot carry
 objects) the same way the elastic layer's injector does: the survey
 installs a process-level seam with :func:`set_process_seam`, and
 apps/prepsubband.py picks it up when its execution path is
-seam-compatible (single-process, unsharded, non-bary, non--sub).
+seam-compatible (single-process, non--sub; sharded mesh and
+barycentred runs included).  On the DM-sharded mesh path the deposit
+is a :class:`ShardedSeamBlock`: one global jax.Array whose DM axis is
+sharded over the mesh, each device holding the sub-range it
+dedispersed (parallel/sharded.ShardedDedispPlan) — the downstream
+sharded rFFT, in-memory zap, accel and single-pulse searches consume
+the shards in place, and host download happens only at candidate
+collection and durable spill (``gather_shards``).
 
 Byte-identity invariant: fusion only changes WHERE bytes live between
 stages, never their values.  The seam's device series are bit-equal
@@ -76,12 +83,18 @@ DEFAULT_INGEST_DEPTH = 2     # host blocks decoded ahead of the device
 
 def resolve_depths(inflight_depth: Optional[int] = None,
                    obs=None) -> Dict[str, int]:
-    """The fused pipeline's depth pair: an explicit caller value wins
-    for the window; otherwise the tuning DB's ``pipeline_inflight_depth``
-    entry when tuning is active (presto_tpu/tune), else the defaults.
-    Clamped to [1, 8] — a depth only changes overlap, so any clamp is
-    safe."""
+    """The fused pipeline's depth knobs: an explicit caller value wins
+    for the windows; otherwise the tuning DB's
+    ``pipeline_inflight_depth`` (and, for the DM-sharded seam path,
+    ``sharded_inflight_depth``) entries when tuning is active
+    (presto_tpu/tune), else the defaults.  ``shard_window`` paces the
+    sharded fused chain — its sweet spot differs from the
+    single-device window because each in-flight chunk pins HBM on
+    EVERY mesh device — and falls back to ``window`` when the sharded
+    family has no measurement.  Clamped to [1, 8] — a depth only
+    changes overlap, so any clamp is safe."""
     window, ingest = DEFAULT_WINDOW_DEPTH, DEFAULT_INGEST_DEPTH
+    shard_window = None
     from presto_tpu import tune
     if tune.enabled():
         cfg = tune.best("pipeline_inflight_depth", tune.GLOBAL_KEY,
@@ -92,10 +105,21 @@ def resolve_depths(inflight_depth: Optional[int] = None,
                 ingest = int(cfg.get("ingest_depth", ingest))
             except (TypeError, ValueError):
                 pass
+        scfg = tune.best("sharded_inflight_depth", tune.GLOBAL_KEY,
+                         obs=obs)
+        if scfg:
+            try:
+                shard_window = int(scfg.get("window"))
+            except (TypeError, ValueError):
+                pass
     if inflight_depth is not None:
         window = int(inflight_depth)
+        shard_window = int(inflight_depth)
+    if shard_window is None:
+        shard_window = window
     return {"window": max(1, min(int(window), 8)),
-            "ingest_depth": max(1, min(int(ingest), 8))}
+            "ingest_depth": max(1, min(int(ingest), 8)),
+            "shard_window": max(1, min(int(shard_window), 8))}
 
 
 def inf_float(x, digits: int = 15) -> float:
@@ -247,6 +271,24 @@ class SeamBlock:
             self.T = self.numout * self.dt
 
 
+@dataclass
+class ShardedSeamBlock(SeamBlock):
+    """A SeamBlock whose ``series_dev`` is ONE global jax.Array with
+    the DM axis sharded over ``mesh`` (parallel/mesh dm_sharding):
+    each device holds exactly the DM sub-range it dedispersed
+    (parallel/sharded.ShardedDedispPlan), and downstream consumers —
+    the DM-sharded batched rFFT, in-memory zapbirds, search_many and
+    single-pulse — operate on the shards IN PLACE.  The host copy is
+    assembled per shard (``gather_shards``: parallel per-device D2H,
+    no cross-device gather) and exists for the same reason the
+    unsharded block's does: the pad tail must be computed with
+    pad_to_good_N's exact NumPy semantics, and spills/folds/candidate
+    refinement read host bytes.  Placement-aware spill = the durable
+    tier writes each DM trial's ``.dat`` from that assembled copy
+    without ever staging the fan-out through a single device."""
+    mesh: object = None
+
+
 class StageSeam:
     """In-memory seam between survey stages (see module docstring).
 
@@ -277,7 +319,8 @@ class StageSeam:
         prepfold) read from disk, not the bulk data path."""
         from presto_tpu.io.infodata import write_inf
         sp = self._span("handoff", trials=len(block.names),
-                        numout=block.numout)
+                        numout=block.numout,
+                        sharded=is_sharded(block))
         self.blocks.append(block)
         infs = []
         for row, name in enumerate(block.names):
@@ -292,6 +335,12 @@ class StageSeam:
                 "survey_fused_trials_total",
                 "DM trials handed across the in-memory stage seam"
             ).inc(len(block.names))
+            if is_sharded(block):
+                self.obs.metrics.counter(
+                    "survey_fused_shard_trials_total",
+                    "DM trials handed across the seam as device "
+                    "shards (one DM sub-range per mesh device)"
+                ).inc(len(block.names))
         if self.durable:
             self.spill(block)
         if sp is not None:
@@ -325,7 +374,7 @@ class StageSeam:
         total = 0
         for b in blocks:
             sp = self._span("spill", trials=len(b.names),
-                            numout=b.numout)
+                            numout=b.numout, sharded=is_sharded(b))
             written = []
             for row, name in enumerate(b.names):
                 dat = name + ".dat"
@@ -357,7 +406,7 @@ class StageSeam:
         from presto_tpu.io.datfft import write_dat
         block, row = ent
         sp = self._span("spill", trials=1, numout=block.numout,
-                        on_demand=True)
+                        on_demand=True, sharded=is_sharded(block))
         write_dat(datpath, block.series_host[row], block.infos[row])
         self._spilled.add(key)
         if self.manifest is not None:
@@ -377,9 +426,11 @@ class StageSeam:
 
     # -- internals -----------------------------------------------------
 
-    def _span(self, op: str, **attrs):
+    def _span(self, op: str, sharded: bool = False, **attrs):
         if self.obs is None or not self.obs.enabled:
             return None
+        if sharded:
+            return self.obs.span("pipeline:shard-seam", op=op, **attrs)
         return self.obs.span("pipeline:seam", op=op, **attrs)
 
     def _count_spill(self, nbytes: int) -> None:
@@ -394,26 +445,71 @@ class StageSeam:
 # fused device helpers
 # ----------------------------------------------------------------------
 
+def is_sharded(block) -> bool:
+    """Is this seam block's device series mesh-sharded on the DM axis?"""
+    return getattr(block, "mesh", None) is not None
+
+
+def gather_shards(arr, obs=None) -> np.ndarray:
+    """Placement-aware D2H of a DM-sharded device array: each device's
+    shard downloads independently into its row range of the host
+    buffer (parallel per-device transfers, never a cross-device gather
+    through one chip).  This is the sharded seam's ONLY bulk download
+    — it feeds the pad computation, the durable spill, and candidate
+    refinement; counted on survey_fused_shard_gather_bytes_total."""
+    out = np.empty(arr.shape, dtype=arr.dtype)
+    total = 0
+    for sh in arr.addressable_shards:
+        data = np.asarray(sh.data)
+        out[sh.index] = data
+        total += data.nbytes
+    if obs is not None and getattr(obs, "enabled", False):
+        obs.metrics.counter(
+            "survey_fused_shard_gather_bytes_total",
+            "Bytes downloaded per-shard from the DM-sharded seam "
+            "(pad/spill/candidate collection)").inc(int(total))
+        from presto_tpu.obs import jaxtel
+        jaxtel.note_get(obs, total)
+    return out
+
+
 _fft_fns: dict = {}
 
 
-def fused_rfft_batch(series_dev, donate: bool = False, obs=None):
+def fused_rfft_batch(series_dev, donate: bool = False, obs=None,
+                     mesh=None):
     """Batched packed real FFT of the seam's series block, optionally
     DONATING the input buffer to XLA (the dedisp output block becomes
     the FFT's workspace — input [n, N] float32 and output [n, N/2, 2]
     float32 are the same size, so donation makes the seam crossing
     allocation-neutral).  Identical floats either way; donation only
-    changes buffer lifetime."""
+    changes buffer lifetime.
+
+    With ``mesh`` the batch axis is the DM-sharded axis and the FFT
+    runs shard_map'd: each device transforms ONLY its own rows and
+    the spectra stay on the device that dedispersed the series.  The
+    shard_map is load-bearing, not style — a plain jit (even with
+    out_shardings pinned) lets GSPMD compute the batched FFT
+    replicated and slice afterwards, which both re-gathers the
+    fan-out and multiplies the FLOPs by the device count (measured 7x
+    slower on the 8-device CPU mesh).  Per-row FFTs are independent,
+    so the per-shard program computes identical floats."""
     import jax
     from presto_tpu.ops import fftpack
-    key = bool(donate)
+    key = (bool(donate), mesh)
     fn = _fft_fns.get(key)
     if fn is None:
-        if donate:
-            fn = jax.jit(jax.vmap(fftpack.realfft_packed_pairs),
-                         donate_argnums=0)
+        kw = {"donate_argnums": 0} if donate else {}
+        if mesh is not None:
+            from jax.sharding import PartitionSpec as P
+            from presto_tpu.parallel.sharded import _shard_map
+            axis = mesh.axis_names[0]
+            fn = jax.jit(_shard_map(
+                jax.vmap(fftpack.realfft_packed_pairs), mesh=mesh,
+                in_specs=P(axis, None),
+                out_specs=P(axis, None, None)), **kw)
         else:
-            fn = jax.jit(jax.vmap(fftpack.realfft_packed_pairs))
+            fn = jax.jit(jax.vmap(fftpack.realfft_packed_pairs), **kw)
         _fft_fns[key] = fn
     if donate:
         from presto_tpu.obs import jaxtel

@@ -68,6 +68,11 @@ class SurveyConfig:
     sp_maxwidth: float = 0.0
     singlepulse: bool = True
     skip_rfifind: bool = False
+    # barycentre the dedispersed series (drops prepsubband's -nobary).
+    # Bary runs flow through the same in-memory stage seam: the
+    # resampling consumes the seam series on host and re-deposits, so
+    # the .dat spill is byte-equal to a staged bary run's.
+    bary: bool = False
     # serving hook: an object with .searcher(acfg, T, numbins) (serve/
     # plancache.SearcherProvider).  None -> build searchers inline, the
     # batch-driver behavior.  A resident service shares one provider
@@ -297,9 +302,13 @@ def _run_survey_stages(rawfiles, cfg, workdir, base, res, timer,
     # series for the FFT/search/single-pulse stages, and
     # cfg.durable_stages decides whether the .dat artifacts are also
     # written at the boundary (write-through) or only spilled on
-    # demand.  Elastic, sharded, and multi-process runs are
-    # seam-incompatible and keep the staged disk contract — the seam
-    # just stays empty and every consumer below falls back to disk.
+    # demand.  The DM-sharded mesh path deposits a ShardedSeamBlock
+    # (one DM sub-range per device, consumed in place by the sharded
+    # FFT/search below) and barycentred runs re-deposit after the
+    # host resampling; only elastic and multi-process runs are
+    # seam-incompatible and keep the staged/ledger disk contract —
+    # there the seam just stays empty and every consumer below falls
+    # back to disk.
     from presto_tpu.apps.prepsubband import main as prepsubband_main
     from presto_tpu.pipeline import fusion
     seam = fusion.StageSeam(workdir, durable=_durable(cfg),
@@ -318,8 +327,9 @@ def _run_survey_stages(rawfiles, cfg, workdir, base, res, timer,
             continue
         argv = ["-lodm", str(m.lodm), "-dmstep", str(m.ddm),
                 "-numdms", str(m.numdms), "-nsub", str(cfg.nsub),
-                "-downsamp", str(m.downsamp), "-nobary",
-                "-o", base]
+                "-downsamp", str(m.downsamp), "-o", base]
+        if not getattr(cfg, "bary", False):
+            argv += ["-nobary"]
         if res.maskfile and os.path.exists(res.maskfile):
             argv += ["-mask", res.maskfile]
         if getattr(cfg, "elastic", None):
@@ -361,9 +371,13 @@ def _run_survey_stages(rawfiles, cfg, workdir, base, res, timer,
     # through the original disk consumers below
     disk_only = [f for f in res.datfiles
                  if os.path.abspath(f) not in seam_set]
-    print("survey: %d dedispersed time series (%d seam-resident)"
-          % (len(res.datfiles), len(seam)))
+    n_sharded = sum(len(b.names) for b in seam.blocks
+                    if fusion.is_sharded(b))
+    print("survey: %d dedispersed time series (%d seam-resident, "
+          "%d sharded)" % (len(res.datfiles), len(seam), n_sharded))
     _chaos(cfg, "seam-handoff", obs)
+    if n_sharded:
+        _chaos(cfg, "shard-seam-handoff", obs)
     _chaos(cfg, "post-prepsubband", obs)
 
     # ---- 9a. single-pulse search over the seam-resident series ------
@@ -490,13 +504,19 @@ def _seam_fft_search(seam, cfg, passes, manifest=None, obs=None,
     the device work of the next.
 
     With ``zap`` the downloaded spectrum is zapped in memory
-    (apps/zapbirds.zap_amps) and the ZAPPED pairs are what the search
-    consumes — the staged rfft->zapbirds->accelsearch flow without
-    the two disk round-trips.  Durable spills journal the .fft at its
-    post-zap state (stage "zapbirds"), matching the staged journal's
-    non-idempotency contract; a trial whose .fft is already journaled
-    zapped is left to the disk consumers (re-zapping is not
-    byte-stable)."""
+    (apps/zapbirds.zap_pairs_batch) and the ZAPPED pairs are what the
+    search consumes — the staged rfft->zapbirds->accelsearch flow
+    without the two disk round-trips.  Durable spills journal the
+    .fft at its post-zap state (stage "zapbirds"), matching the
+    staged journal's non-idempotency contract; a trial whose .fft is
+    already journaled zapped is left to the disk consumers
+    (re-zapping is not byte-stable).
+
+    Sharded seam blocks stay sharded through the whole chain: the
+    batched rFFT keeps each device's spectra resident
+    (fused_rfft_batch with the mesh's out_shardings), the search runs
+    shard_map'd in place (search_many(mesh=...)), and the single bulk
+    download is the per-shard gather that feeds zap/refine/spill."""
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -514,25 +534,31 @@ def _seam_fft_search(seam, cfg, passes, manifest=None, obs=None,
 
     def collect(ent):
         """Search + refine + write one FFT'd chunk (the sync point)."""
-        (block, rows, pairs_dev, todo_passes, n) = ent
+        (block, rows, pairs_dev, todo_passes, n, mesh) = ent
         nbins = n // 2
         T = block.numout * fusion.inf_float(block.dt)
-        pairs_host = np.array(pairs_dev)          # one download
-        jaxtel.note_get(obs, pairs_host.nbytes)
+        if mesh is not None:
+            # per-shard D2H (candidate collection + durable spill)
+            pairs_host = fusion.gather_shards(pairs_dev, obs=obs)
+        else:
+            pairs_host = np.array(pairs_dev)      # one download
+            jaxtel.note_get(obs, pairs_host.nbytes)
         search_dev = pairs_dev
         if zap and cfg.zaplist:
-            from presto_tpu.apps.zapbirds import zap_amps
-            for i in range(pairs_host.shape[0]):
-                amps = fftpack.np_pairs_to_complex64(pairs_host[i])
-                amps, _nz = zap_amps(amps, cfg.zaplist, T,
-                                     block.numout)
-                pairs_host[i] = np.stack([amps.real, amps.imag], -1)
-            search_dev = jnp.asarray(pairs_host)  # re-upload zapped
+            from presto_tpu.apps.zapbirds import zap_pairs_batch
+            pairs_host = zap_pairs_batch(pairs_host, cfg.zaplist, T,
+                                         block.numout)
+            if mesh is not None:      # re-upload zapped, per shard
+                from presto_tpu.parallel.mesh import dm_sharding
+                search_dev = jax.device_put(pairs_host,
+                                            dm_sharding(mesh, 3))
+            else:
+                search_dev = jnp.asarray(pairs_host)
             jaxtel.note_put(obs, pairs_host.nbytes)
             _chaos(cfg, "zapbirds-file", obs)
         for pcfg in todo_passes:
             searcher = _searcher_for(pcfg, T, nbins)
-            results = searcher.search_many(search_dev)
+            results = searcher.search_many(search_dev, mesh=mesh)
             arts = []
             for row, pr, raw in zip(rows, pairs_host, results):
                 name = block.names[row]
@@ -551,13 +577,19 @@ def _seam_fft_search(seam, cfg, passes, manifest=None, obs=None,
             _record(manifest, ffts, "zapbirds" if zap else "fft+accel")
         jaxtel.sample_live_buffers(obs)
         _chaos(cfg, "fused-chunk", obs)
+        if mesh is not None:
+            _chaos(cfg, "sharded-fused-chunk", obs)
 
     ndone = 0
     pending = []          # the cross-stage in-flight window: chunk
     depth = seam.depths["window"]   # i+1's FFT is queued on the
-    for numout, blocks in sorted(seam.groups().items()):  # device
-        n = numout & ~1   # before chunk i's host collection starts
+    shard_depth = seam.depths["shard_window"]   # device before chunk
+    for numout, blocks in sorted(seam.groups().items()):  # i's host
+        n = numout & ~1   # collection starts
         for block in blocks:
+            sharded = fusion.is_sharded(block)
+            mesh = block.mesh if sharded else None
+            ndev = (len(list(mesh.devices.flat)) if sharded else 1)
             # the staged consumers' verify-or-redo contract, per trial
             arts = []
             for name in block.names:
@@ -584,12 +616,18 @@ def _seam_fft_search(seam, cfg, passes, manifest=None, obs=None,
             todo_passes = [_replace(cfg, zmax=z, numharm=nh, sigma=sg,
                                     flo=flo)
                            for (z, nh, sg, flo) in passes]
-            per = max(1, int(2 ** 30 // max(n * 4, 1)))
+            # memory budget is per DEVICE: a sharded whole-block holds
+            # numdms/ndev rows on each chip
+            per = max(1, int(2 ** 30 // max(n * 4, 1))) * ndev
             whole = rows == list(range(len(block.names))) \
                 and len(rows) <= per
+            # a partial sharded block (mixed resume) gathers its rows
+            # off the mesh and takes the single-device path below
+            chunk_mesh = mesh if (sharded and whole) else None
             for g0 in range(0, len(rows), per):
                 chunk_rows = rows[g0:g0 + per]
-                span = (obs.span("fused-chunk",
+                span = (obs.span("sharded-fused-chunk" if chunk_mesh
+                                 is not None else "fused-chunk",
                                  files=len(chunk_rows), nbins=n)
                         if obs is not None else None)
                 if whole and can_donate:
@@ -602,16 +640,19 @@ def _seam_fft_search(seam, cfg, passes, manifest=None, obs=None,
                     chunk_dev = block.series_dev[:, :n]
                     seam.release(block)
                     pairs_dev = fusion.fused_rfft_batch(
-                        chunk_dev, donate=True, obs=obs)
+                        chunk_dev, donate=True, obs=obs,
+                        mesh=chunk_mesh)
                 elif whole:
                     pairs_dev = fusion.fused_rfft_batch(
-                        block.series_dev[:, :n])
+                        block.series_dev[:, :n], mesh=chunk_mesh)
                 else:
                     pairs_dev = fusion.fused_rfft_batch(
                         block.series_dev[np.asarray(chunk_rows), :n])
                 pending.append((block, chunk_rows, pairs_dev,
-                                todo_passes, n))
-                while len(pending) >= max(depth, 1):
+                                todo_passes, n, chunk_mesh))
+                window = (shard_depth if chunk_mesh is not None
+                          else depth)
+                while len(pending) >= max(window, 1):
                     collect(pending.pop(0))
                     ndone += 1
                 if span is not None:
@@ -631,9 +672,17 @@ def _seam_singlepulse(seam, cfg, manifest=None, obs=None) -> None:
     third .dat disk read + re-upload.  Inputs are bit-equal to the
     staged path's (same padded series, same .inf-roundtripped dt/dm,
     same onoff-derived offregions), so the .singlepulse artifacts are
-    byte-identical."""
+    byte-identical.
+
+    Sharded blocks search PER SHARD: each mesh device's DM sub-range
+    runs search_many_resident on the device that dedispersed it (the
+    per-file results are independent of batch composition, so shard
+    batches equal the whole-batch candidate sets) — no gather, no
+    re-upload.  A partially-resumed sharded block falls back to the
+    row-stacking path below."""
     import jax.numpy as jnp
-    from presto_tpu.apps.single_pulse_search import sp_input_plan
+    from presto_tpu.apps.single_pulse_search import (sp_block_plan,
+                                                     sp_input_plan)
     from presto_tpu.pipeline import fusion
     from presto_tpu.search.singlepulse import (SinglePulseSearch,
                                                write_singlepulse)
@@ -641,23 +690,62 @@ def _seam_singlepulse(seam, cfg, manifest=None, obs=None) -> None:
     sp = SinglePulseSearch(threshold=cfg.sp_threshold,
                            maxwidth=cfg.sp_maxwidth)
     planned = []          # (block, row, nuse, offregions)
+    sharded_todo = []     # (block, nuse, offregions): whole blocks
     spfiles = [name + ".singlepulse" for b in seam.blocks
                for name in b.names]
     _drop_stale(manifest, spfiles)
+    nsh = 0
     for block in seam.blocks:
-        for row, name in enumerate(block.names):
-            if _valid(manifest, name + ".singlepulse"):
+        rows_todo = [row for row, name in enumerate(block.names)
+                     if not _valid(manifest, name + ".singlepulse")]
+        if not rows_todo:
+            continue
+        if fusion.is_sharded(block) and \
+                rows_todo == list(range(len(block.names))):
+            bplan = sp_block_plan(block.infos, block.numout)
+            if bplan is not None:
+                sharded_todo.append((block,) + tuple(bplan))
+                nsh += len(rows_todo)
                 continue
+        for row in rows_todo:
             nuse, offregions = sp_input_plan(block.infos[row],
                                              block.numout)
             planned.append((block, row, nuse, offregions))
+
+    nev = 0
+    for block, nuse, offregions in sharded_todo:
+        bdt = fusion.inf_float(block.dt)
+        for sh in block.series_dev.addressable_shards:
+            lo = sh.index[0].start or 0
+            batch = sh.data[:, :nuse]       # stays on sh's device
+            rows = list(range(lo, lo + int(batch.shape[0])))
+            span = (obs.span("sp-seam-chunk", files=len(rows),
+                             nuse=nuse, sharded=True)
+                    if obs is not None else None)
+            results = sp.search_many_resident(
+                batch, bdt,
+                dms=[fusion.inf_float(block.infos[r].dm, 12)
+                     for r in rows],
+                offregions_list=[offregions] * len(rows))
+            written = []
+            for r, (cands, _stds, _bad) in zip(rows, results):
+                f = block.names[r] + ".singlepulse"
+                write_singlepulse(f, cands)
+                written.append(f)
+                nev += len(cands)
+            _record(manifest, written, "singlepulse")
+            if span is not None:
+                span.finish()
+            _chaos(cfg, "sp-seam-chunk", obs)
     if not planned:
+        if nsh:
+            print("survey: single-pulse search over %d seam-resident "
+                  "series (%d events, sharded)" % (nsh, nev))
         return
     groups = {}
     for item in planned:
         key = (item[2], fusion.inf_float(item[0].dt))
         groups.setdefault(key, []).append(item)
-    nev = 0
     for (nuse, dt), items in sorted(groups.items()):
         per = max(1, int(2 ** 30 // max(nuse * 4, 1)))
         for g0 in range(0, len(items), per):
@@ -684,7 +772,8 @@ def _seam_singlepulse(seam, cfg, manifest=None, obs=None) -> None:
                 span.finish()
             _chaos(cfg, "sp-seam-chunk", obs)
     print("survey: single-pulse search over %d seam-resident series "
-          "(%d events)" % (len(planned), nev))
+          "(%d events%s)" % (len(planned) + nsh, nev,
+                             ", %d sharded" % nsh if nsh else ""))
 
 
 def _fused_fft_search(datfiles, cfg, manifest=None, obs=None) -> None:

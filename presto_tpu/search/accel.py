@@ -1583,8 +1583,8 @@ class AccelSearch:
 
     def search_many(self, pairs_batch: np.ndarray,
                     slab: int = 1 << 20,
-                    compact_m: int = COMPACT_CANDS
-                    ) -> List[List[AccelCand]]:
+                    compact_m: int = COMPACT_CANDS,
+                    mesh=None) -> List[List[AccelCand]]:
         """Batched search over many same-length spectra — the survey's
         DM fan-out (one plane build + one scanned search dispatch per
         memory-budgeted DM group instead of per-trial dispatch storms;
@@ -1596,8 +1596,20 @@ class AccelSearch:
         re-upload per DM trial (each direction of the tunneled link
         costs seconds per group).  Returns per-DM candidate lists
         (same semantics as search() per spectrum).
+
+        ``mesh``: a jax Mesh whose first axis shards the DM trials —
+        the sharded seam's per-device spectra search in place via
+        parallel/sharded.sharded_accel_search_many (candidate lists
+        are test-pinned equal to this method's); None keeps the
+        single-device grouped path.
         """
         cfg = self.cfg
+        if mesh is not None and len(list(mesh.devices.flat)) > 1:
+            from presto_tpu.parallel.sharded import (
+                sharded_accel_search_many)
+            return sharded_accel_search_many(self, pairs_batch, mesh,
+                                             slab=slab,
+                                             compact_m=compact_m)
         if isinstance(pairs_batch, jax.Array):
             batch = pairs_batch
             if batch.dtype != jnp.float32:    # same boundary cast the

@@ -23,6 +23,10 @@ measure a candidate:
                       cross-stage in-flight window and host ingest
                       double-buffer depth of the fused survey
                       pipeline (pipeline/fusion.py)
+  sharded_inflight_depth
+                      cross-stage in-flight window of the DM-sharded
+                      fused chain (pipeline/fusion.py sharded seam;
+                      measured on a miniature sharded fused chain)
 
 Families are device-agnostic declarations; ``tune.runner`` does the
 measuring and ``tune.db`` the remembering.  Every family has a tiny
@@ -281,6 +285,62 @@ def _inflight_bench(shape, config):
 
 
 # ----------------------------------------------------------------------
+# sharded_inflight_depth
+# ----------------------------------------------------------------------
+
+def _sharded_inflight_candidates(shape) -> List[dict]:
+    windows = shape.get("windows") or (1, 2, 3, 4)
+    return [{"window": int(w)} for w in windows]
+
+
+def _sharded_inflight_bench(shape, config):
+    """The sharded fused chain in miniature: a dm-sharded series
+    batch FFT'd per chunk with the cross-stage window bounding queued
+    mesh-wide dispatches, then a per-shard host gather standing in
+    for candidate collection (pipeline/survey._seam_fft_search).  The
+    sweet spot differs from the single-device window because every
+    in-flight chunk pins HBM on EVERY device; the figure of merit is
+    pure pipeline wall time — identical floats at any depth.  On a
+    single device the mesh degenerates to one shard, which still
+    measures the window-vs-collect overlap."""
+    import jax
+    from presto_tpu.parallel.mesh import dm_sharding, make_mesh
+    from presto_tpu.pipeline.fusion import InflightWindow
+    ndev = len(jax.devices())
+    nd = int(shape.get("numdms", 2 * ndev))
+    nd = max(nd - nd % ndev, ndev)
+    n = int(shape.get("n", 1 << 14))
+    nchunks = int(shape.get("nchunks", 6))
+    from presto_tpu.pipeline.fusion import fused_rfft_batch
+    mesh = make_mesh()
+    rng = np.random.default_rng(29)
+    host = rng.random((nd, n)).astype(np.float32)
+    batch = jax.device_put(host, dm_sharding(mesh, 2))
+
+    def fft(x):
+        return fused_rfft_batch(x, mesh=mesh)
+    window_depth = int(config["window"])
+
+    def fn():
+        window = InflightWindow(window_depth)
+        pending = []
+        for _ in range(nchunks):
+            pairs = fft(batch)
+            window.admit(pairs)
+            pending.append(pairs)
+            while len(pending) >= window_depth:
+                # the host sync of the oldest chunk (per-shard D2H)
+                for sh in pending.pop(0).addressable_shards:
+                    np.asarray(sh.data)
+        while pending:
+            for sh in pending.pop(0).addressable_shards:
+                np.asarray(sh.data)
+        window.drain()
+        return None
+    return fn
+
+
+# ----------------------------------------------------------------------
 # plancache_bucket (modeled)
 # ----------------------------------------------------------------------
 
@@ -403,6 +463,20 @@ FAMILIES: Dict[str, Family] = {
             [{"nblocks": 4, "n": 1 << 12,
               "windows": (1, 2), "ingest_depths": (2,)}] if smoke
             else [{"nblocks": 16, "n": 1 << 20}]),
+        available=_jax_ok,
+    ),
+    "sharded_inflight_depth": Family(
+        name="sharded_inflight_depth",
+        doc="Cross-stage in-flight window of the DM-sharded fused "
+            "chain (every queued chunk pins HBM on every mesh "
+            "device); overlap only, byte-identical outputs",
+        shape_key=lambda s: tune.GLOBAL_KEY,
+        candidates=_sharded_inflight_candidates,
+        bench=_sharded_inflight_bench,
+        shapes=lambda smoke: (
+            [{"numdms": 8, "n": 1 << 10, "nchunks": 3,
+              "windows": (1, 2)}] if smoke
+            else [{"numdms": 64, "n": 1 << 18, "nchunks": 8}]),
         available=_jax_ok,
     ),
     "plancache_bucket": Family(

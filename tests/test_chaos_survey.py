@@ -198,15 +198,14 @@ FINAL_ONLY = (".cand", ".singlepulse", ".mask", ".stats", ".txt")
 
 @pytest.mark.chaos
 def test_fused_tier_artifacts_byte_equal(tiny_obs, provider,
-                                         reference_run, tmp_path,
-                                         monkeypatch):
+                                         reference_run, tmp_path):
     """A durable_stages=False survey writes no .dat/.fft
     intermediates, and every artifact it does write is byte-identical
-    to the staged run's.  (The conftest's 8-device virtual mesh would
-    route prepsubband through the seam-incompatible sharded path —
-    whose rows are byte-equal to single-device by the elastic tests —
-    so pin the single-device seam path here.)"""
-    monkeypatch.setenv("PRESTO_TPU_DISABLE_MESH", "1")
+    to the staged run's.  On the conftest's 8-device virtual mesh the
+    prepsubband stage routes through the SHARDED seam
+    (fusion.ShardedSeamBlock, one DM sub-range per device) — the
+    fused-vs-staged equality here is the multi-device acceptance
+    criterion of ISSUE 8, no PRESTO_TPU_DISABLE_MESH pin needed."""
     _, ref_arts = reference_run
     work = str(tmp_path)
     res = run_survey([tiny_obs],
@@ -226,15 +225,18 @@ def test_fused_tier_artifacts_byte_equal(tiny_obs, provider,
 
 @pytest.mark.chaos
 @pytest.mark.parametrize("kill_at", ["seam-handoff",
+                                     "shard-seam-handoff",
                                      "sp-seam-chunk",
-                                     "fused-chunk"])
+                                     "fused-chunk",
+                                     "sharded-fused-chunk"])
 def test_kill_in_fused_path_resumes_durable(tiny_obs, provider,
                                             reference_run, tmp_path,
-                                            kill_at, monkeypatch):
-    """Kill INSIDE the fused (non-durable) path; a resume on the
+                                            kill_at):
+    """Kill INSIDE the fused (non-durable) path — including the
+    sharded seam's own points (the fan-out dies while resident across
+    all 8 mesh devices with nothing durable on disk); a resume on the
     default durable tier redoes the unjournaled stages and the final
     artifacts are byte-equal to a never-failed staged run."""
-    monkeypatch.setenv("PRESTO_TPU_DISABLE_MESH", "1")
     _, ref_arts = reference_run
     work = str(tmp_path)
     fi = chaos.FaultInjector(kill_at=kill_at, kill_after=1)
@@ -250,11 +252,11 @@ def test_kill_in_fused_path_resumes_durable(tiny_obs, provider,
 
 @pytest.mark.chaos
 def test_fused_spill_on_demand_for_prepfold(tiny_obs, provider,
-                                            tmp_path, monkeypatch):
+                                            tmp_path):
     """fold_sigma low enough to fold something: the fused tier spills
     exactly the folded candidates' .dat series on demand (prepfold
-    reads from disk), nothing else."""
-    monkeypatch.setenv("PRESTO_TPU_DISABLE_MESH", "1")
+    reads from disk), nothing else — the sharded seam's host copy
+    serves the spill without touching the mesh."""
     work = str(tmp_path)
     res = run_survey(
         [tiny_obs],

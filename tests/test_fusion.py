@@ -236,11 +236,13 @@ def test_seam_release_drops_device_reference(tmp_path):
 def test_resolve_depths_defaults():
     d = fusion.resolve_depths()
     assert d == {"window": fusion.DEFAULT_WINDOW_DEPTH,
-                 "ingest_depth": fusion.DEFAULT_INGEST_DEPTH}
+                 "ingest_depth": fusion.DEFAULT_INGEST_DEPTH,
+                 "shard_window": fusion.DEFAULT_WINDOW_DEPTH}
 
 
 def test_resolve_depths_explicit_and_clamped():
     assert fusion.resolve_depths(4)["window"] == 4
+    assert fusion.resolve_depths(4)["shard_window"] == 4
     assert fusion.resolve_depths(100)["window"] == 8
     assert fusion.resolve_depths(0)["window"] == 1
 
@@ -259,7 +261,10 @@ def test_resolve_depths_consults_tune_db(tmp_path, monkeypatch):
     tune.reset()
     try:
         d = fusion.resolve_depths()
-        assert d == {"window": 3, "ingest_depth": 4}
+        # shard_window falls back to the tuned single-device window
+        # when the sharded family has no measurement
+        assert d == {"window": 3, "ingest_depth": 4,
+                     "shard_window": 3}
     finally:
         monkeypatch.delenv("PRESTO_TPU_TUNE")
         tune.reset()

@@ -1,0 +1,371 @@
+"""Sharded pipeline fusion (ISSUE 8 tentpole): the DM-sharded mesh
+path's device-resident seam.
+
+Contracts pinned here (on the conftest's 8-device virtual CPU mesh):
+
+* the static per-device delay plans (parallel/sharded.
+  ShardedDedispPlan) produce bytes identical to BOTH the traced
+  shard_map step and the unsharded composed block step — the
+  mpiprepsubband invariant survives the MPMD rewrite that lets the
+  dedisp_dm_batch tune family drive the multi-device path;
+* a sharded prepsubband with a process seam installed deposits ONE
+  ShardedSeamBlock (global dm-sharded jax.Array, one DM sub-range
+  per device), writes no .dat on the non-durable tier, and its host
+  copy / spills are byte-equal to a staged sharded run's artifacts;
+* barycentred runs ride the seam too: the host resampling re-deposits
+  and the spilled .dat equals the staged bary path byte-for-byte;
+* the in-memory zap helper equals per-file `zapbirds -zap`;
+* resolve_depths consults the sharded_inflight_depth tune family.
+
+The full-survey fused-vs-staged byte equality and the sharded kill
+points (shard-seam-handoff, sharded-fused-chunk) run in
+tests/test_chaos_survey.py against its module reference.
+"""
+
+import glob
+import os
+
+import numpy as np
+import pytest
+
+from presto_tpu.pipeline import fusion
+
+
+NSUB, NUMDMS, NCHAN = 8, 16, 32
+
+
+def _mesh():
+    import jax
+    from presto_tpu.parallel.mesh import make_mesh
+    assert len(jax.devices()) == 8, "conftest must pin the 8-dev mesh"
+    return make_mesh()
+
+
+# ----------------------------------------------------------------------
+# static per-device delay plans (parallel/sharded.ShardedDedispPlan)
+# ----------------------------------------------------------------------
+
+def _stream_plan(plan, blocks):
+    prev_raw = prev_sub = None
+    outs = []
+    for b in blocks:
+        cur = plan.put_block(b)
+        if prev_raw is not None:
+            if prev_sub is None:
+                prev_sub = plan.prime(prev_raw, cur)
+            else:
+                prev_sub, series = plan.step(prev_raw, cur, prev_sub)
+                outs.append(series)
+        prev_raw = cur
+    return plan.concat(outs)
+
+
+def test_static_sharded_plan_equals_traced_and_unsharded():
+    """ShardedDedispPlan == sharded_dedisperse_stream (traced SPMD)
+    == the unsharded composed block step, byte for byte."""
+    import jax.numpy as jnp
+    from presto_tpu.ops import dedispersion as dd
+    from presto_tpu.parallel import sharded
+
+    mesh = _mesh()
+    rng = np.random.default_rng(5)
+    nblocks, numpts = 5, 256
+    blocks = rng.normal(size=(nblocks, NCHAN, numpts)).astype(
+        np.float32)
+    chan_d = rng.integers(0, 40, size=NCHAN).astype(np.int32)
+    dm_d = rng.integers(0, 60, size=(NUMDMS, NSUB)).astype(np.int32)
+
+    traced = np.asarray(sharded.sharded_dedisperse_stream(
+        blocks, chan_d, dm_d, mesh, NSUB))
+
+    plan = sharded.ShardedDedispPlan(mesh, NSUB, 1, chan_d, dm_d)
+    got = _stream_plan(plan, blocks)
+    from presto_tpu.parallel.mesh import dm_sharding
+    assert got.sharding == dm_sharding(mesh, 2)
+    assert np.array_equal(np.asarray(got), traced)
+
+    # unsharded composed step (the single-device loop's program)
+    step = dd.make_block_step(chan_d, dm_d, NSUB, 1)
+    prev_raw = prev_sub = None
+    outs = []
+    for b in blocks:
+        cur = jnp.asarray(b)
+        if prev_raw is not None:
+            if prev_sub is None:
+                prev_sub = dd.dedisp_subbands_block(
+                    prev_raw, cur, jnp.asarray(chan_d), NSUB)
+            else:
+                prev_sub, series = step(prev_raw, cur, prev_sub)
+                outs.append(series)
+        prev_raw = cur
+    single = np.asarray(jnp.concatenate(outs, axis=1))
+    assert np.array_equal(np.asarray(got), single)
+
+
+def test_static_sharded_plan_respects_tuned_batch_limit(tmp_path,
+                                                        monkeypatch):
+    """The PR 5 caveat, closed: with tuning active, the per-device
+    static programs resolve their DM-batch bound through the
+    dedisp_dm_batch family — and the tuned partition never changes
+    bytes."""
+    from presto_tpu import tune
+    from presto_tpu.parallel import sharded
+
+    mesh = _mesh()
+    rng = np.random.default_rng(7)
+    blocks = rng.normal(size=(4, NCHAN, 128)).astype(np.float32)
+    chan_d = rng.integers(0, 20, size=NCHAN).astype(np.int32)
+    dm_d = rng.integers(0, 30, size=(NUMDMS, NSUB)).astype(np.int32)
+
+    plain = np.asarray(_stream_plan(
+        sharded.ShardedDedispPlan(mesh, NSUB, 1, chan_d, dm_d),
+        blocks))
+
+    monkeypatch.setenv("PRESTO_TPU_TUNE", "1")
+    monkeypatch.setenv("PRESTO_TPU_TUNE_DB", str(tmp_path / "t.json"))
+    tune.reset()
+    db = tune.TuneDB()
+    # bound = nsub: every per-device batch holds exactly one DM row
+    db.record(tune.fingerprint_key(), "dedisp_dm_batch",
+              tune.key_dedisp_batch(NSUB), {"limit": NSUB},
+              median_s=0.01)
+    db.save(str(tmp_path / "t.json"))
+    tune.reset()
+    try:
+        tuned = np.asarray(_stream_plan(
+            sharded.ShardedDedispPlan(mesh, NSUB, 1, chan_d, dm_d),
+            blocks))
+    finally:
+        monkeypatch.delenv("PRESTO_TPU_TUNE")
+        tune.reset()
+    assert np.array_equal(plain, tuned)
+
+
+# ----------------------------------------------------------------------
+# sharded seam handoff (prepsubband -> ShardedSeamBlock)
+# ----------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def sharded_raw(tmp_path_factory):
+    from presto_tpu.models.synth import FakeSignal, fake_filterbank_file
+    d = tmp_path_factory.mktemp("shraw")
+    raw = str(d / "m.fil")
+    sig = FakeSignal(f=5.0, dm=30.0, shape="gauss", width=0.1,
+                     amp=1.0)
+    fake_filterbank_file(raw, 1 << 13, 5e-4, NCHAN, 400.0, 1.5, sig,
+                         noise_sigma=2.0, nbits=8)
+    return raw
+
+
+def _psb(raw, outbase, extra=()):
+    from presto_tpu.apps import prepsubband as app
+    app.run(app.build_parser().parse_args(
+        ["-o", outbase, "-lodm", "10", "-dmstep", "2",
+         "-numdms", str(NUMDMS), "-nsub", "16"] + list(extra)
+        + [raw]))
+
+
+def test_sharded_seam_handoff_byte_equal(sharded_raw, tmp_path):
+    """Mesh prepsubband through the seam: one ShardedSeamBlock, DM
+    axis sharded over all 8 devices, no .dat written non-durable,
+    host copy and on-demand spills byte-equal to the staged sharded
+    run (which the mesh==single CLI test pins against unsharded)."""
+    from presto_tpu.io.datfft import read_dat
+    from presto_tpu.parallel.mesh import dm_sharding
+
+    work = str(tmp_path)
+    _psb(sharded_raw, os.path.join(work, "ref"), ("-nobary",))
+    refs = sorted(glob.glob(os.path.join(work, "ref_DM*.dat")))
+    assert len(refs) == NUMDMS
+
+    seam = fusion.StageSeam(work, durable=False)
+    fusion.set_process_seam(seam)
+    try:
+        _psb(sharded_raw, os.path.join(work, "fs"), ("-nobary",))
+    finally:
+        fusion.set_process_seam(None)
+    assert len(seam.blocks) == 1
+    b = seam.blocks[0]
+    assert isinstance(b, fusion.ShardedSeamBlock)
+    assert fusion.is_sharded(b)
+    assert b.series_dev.sharding == dm_sharding(b.mesh, 2)
+    assert not glob.glob(os.path.join(work, "fs_DM*.dat"))
+    # the .inf sidecars are metadata and written on every tier
+    assert len(glob.glob(os.path.join(work, "fs_DM*.inf"))) == NUMDMS
+    for i, r in enumerate(refs):
+        assert np.array_equal(read_dat(r), b.series_host[i])
+    assert np.array_equal(np.asarray(b.series_dev), b.series_host)
+    # placement-aware spill: journal-grade bytes from the host copy
+    seam.spill()
+    spilled = sorted(glob.glob(os.path.join(work, "fs_DM*.dat")))
+    assert len(spilled) == NUMDMS
+    for r, s in zip(refs, spilled):
+        with open(r, "rb") as fa, open(s, "rb") as fb:
+            assert fa.read() == fb.read()
+
+
+def test_gather_shards_counts_bytes(sharded_raw, tmp_path):
+    import jax
+    from presto_tpu.obs import Observability, ObsConfig
+    from presto_tpu.parallel.mesh import dm_sharding, make_mesh
+
+    mesh = make_mesh()
+    host = np.arange(NUMDMS * 64, dtype=np.float32).reshape(NUMDMS,
+                                                            64)
+    arr = jax.device_put(host, dm_sharding(mesh, 2))
+    obs = Observability(ObsConfig(enabled=True))
+    got = fusion.gather_shards(arr, obs=obs)
+    assert np.array_equal(got, host)
+    c = obs.metrics.counter(
+        "survey_fused_shard_gather_bytes_total",
+        "Bytes downloaded per-shard from the DM-sharded seam "
+        "(pad/spill/candidate collection)")
+    assert c.value == host.nbytes
+
+
+def test_sharded_fused_rfft_keeps_shards_resident():
+    """fused_rfft_batch(mesh=...) pins the output's DM sharding (the
+    default propagation would replicate it) and computes the same
+    floats as the unsharded batched FFT."""
+    import jax
+    from presto_tpu.parallel.mesh import make_mesh
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    mesh = make_mesh()
+    rng = np.random.default_rng(3)
+    host = rng.normal(size=(NUMDMS, 256)).astype(np.float32)
+    from presto_tpu.parallel.mesh import dm_sharding
+    dev = jax.device_put(host, dm_sharding(mesh, 2))
+    out = fusion.fused_rfft_batch(dev, mesh=mesh)
+    assert out.sharding.is_equivalent_to(
+        NamedSharding(mesh, P("dm", None, None)), out.ndim)
+    # every device holds exactly its DM sub-range's spectra
+    assert {s.data.shape[0] for s in out.addressable_shards} \
+        == {NUMDMS // 8}
+    import jax.numpy as jnp
+    ref = fusion.fused_rfft_batch(jnp.asarray(host))
+    assert np.array_equal(np.asarray(out), np.asarray(ref))
+
+
+# ----------------------------------------------------------------------
+# barycentred runs through the seam
+# ----------------------------------------------------------------------
+
+def test_bary_seam_spill_matches_staged_bary(sharded_raw, tmp_path):
+    """Bary + sharded: the seam consumes the device series, resamples
+    on host with the staged path's exact semantics, re-deposits, and
+    the spilled .dat is byte-equal to a staged bary run's."""
+    work = str(tmp_path)
+    _psb(sharded_raw, os.path.join(work, "ref"))       # staged bary
+    refs = sorted(glob.glob(os.path.join(work, "ref_DM*.dat")))
+    assert len(refs) == NUMDMS
+
+    seam = fusion.StageSeam(work, durable=True)        # write-through
+    fusion.set_process_seam(seam)
+    try:
+        _psb(sharded_raw, os.path.join(work, "fb"))
+    finally:
+        fusion.set_process_seam(None)
+    assert len(seam.blocks) == 1
+    b = seam.blocks[0]
+    assert fusion.is_sharded(b)
+    # the re-deposited device series equals the resampled host bytes
+    assert np.array_equal(np.asarray(b.series_dev), b.series_host)
+    spilled = sorted(glob.glob(os.path.join(work, "fb_DM*.dat")))
+    assert len(spilled) == NUMDMS
+    for r, s in zip(refs, spilled):
+        with open(r, "rb") as fa, open(s, "rb") as fb:
+            assert fa.read() == fb.read()
+    # bary epoch rides the sidecar exactly like the staged path
+    from presto_tpu.io.infodata import read_inf
+    ri = read_inf(refs[0][:-4])
+    si = read_inf(spilled[0][:-4])
+    assert (ri.bary, ri.mjd_i, ri.mjd_f) == (si.bary, si.mjd_i,
+                                             si.mjd_f)
+    assert ri.bary == 1
+
+
+# ----------------------------------------------------------------------
+# in-memory zap + single-pulse block planning helpers
+# ----------------------------------------------------------------------
+
+def test_zap_pairs_batch_matches_per_file(tmp_path):
+    from presto_tpu.apps.zapbirds import zap_fft_file, zap_pairs_batch
+    from presto_tpu.io import datfft
+    from presto_tpu.io.infodata import InfoData, write_inf
+    from presto_tpu.ops import fftpack
+
+    rng = np.random.default_rng(11)
+    N, dt = 2048, 1e-3
+    T = N * dt
+    zap = str(tmp_path / "z.zaplist")
+    with open(zap, "w") as f:
+        f.write("  60.0  2.0\n 120.0  1.0\n")
+    batch = rng.normal(size=(3, N // 2, 2)).astype(np.float32)
+    want = []
+    for i in range(3):
+        base = str(tmp_path / ("t%d" % i))
+        amps = fftpack.np_pairs_to_complex64(batch[i])
+        datfft.write_fft(base + ".fft", amps)
+        write_inf(InfoData(name=base, N=N, dt=dt), base + ".inf")
+        zap_fft_file(base + ".fft", zap)
+        want.append(datfft.read_fft(base + ".fft"))
+    got = zap_pairs_batch(batch.copy(), zap, T, N)
+    for i in range(3):
+        assert np.array_equal(fftpack.np_pairs_to_complex64(got[i]),
+                              want[i])
+
+
+def test_sp_block_plan_uniform_and_mixed():
+    from presto_tpu.apps.single_pulse_search import (sp_block_plan,
+                                                     sp_input_plan)
+    from presto_tpu.models.synth import artificial_inf
+
+    infos = []
+    for i in range(4):
+        info = artificial_inf("t%d" % i, 4096, 1e-3, dm=float(i))
+        info.numonoff = 2
+        info.onoff = [(0.0, 3000.0), (4095.0, 4095.0)]
+        infos.append(info)
+    plan = sp_block_plan(infos, 4096)
+    assert plan is not None
+    assert plan == sp_input_plan(infos[0], 4096)
+    infos[2].onoff = [(0.0, 2000.0), (4095.0, 4095.0)]
+    assert sp_block_plan(infos, 4096) is None
+
+
+# ----------------------------------------------------------------------
+# sharded depth knob
+# ----------------------------------------------------------------------
+
+def test_resolve_depths_shard_window_tune(tmp_path, monkeypatch):
+    from presto_tpu import tune
+    monkeypatch.setenv("PRESTO_TPU_TUNE", "1")
+    monkeypatch.setenv("PRESTO_TPU_TUNE_DB",
+                       str(tmp_path / "tune.json"))
+    tune.reset()
+    db = tune.TuneDB()
+    db.record(tune.fingerprint_key(), "sharded_inflight_depth",
+              tune.GLOBAL_KEY, {"window": 4}, median_s=0.01)
+    db.save(str(tmp_path / "tune.json"))
+    tune.reset()
+    try:
+        d = fusion.resolve_depths()
+        assert d["shard_window"] == 4
+        # the single-device window keeps its own default
+        assert d["window"] == fusion.DEFAULT_WINDOW_DEPTH
+        # an explicit caller depth overrides both
+        assert fusion.resolve_depths(3)["shard_window"] == 3
+    finally:
+        monkeypatch.delenv("PRESTO_TPU_TUNE")
+        tune.reset()
+
+
+def test_sharded_inflight_family_registered():
+    from presto_tpu.tune.space import FAMILIES
+    fam = FAMILIES["sharded_inflight_depth"]
+    cands = fam.candidates({"windows": (1, 2)})
+    assert cands == [{"window": 1}, {"window": 2}]
+    fn = fam.bench({"numdms": 8, "n": 1 << 9, "nchunks": 2},
+                   {"window": 2})
+    fn()          # one miniature sharded fused chain, no assertion

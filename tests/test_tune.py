@@ -251,8 +251,11 @@ def test_inflight_depth_family(tmp_path, monkeypatch):
     monkeypatch.setenv(tune.ENV_SWITCH, "1")
     tune.configure(db_path=dbp)
     try:
+        # shard_window follows the tuned window until the
+        # sharded_inflight_depth family has its own measurement
         assert fusion.resolve_depths() == {"window": 4,
-                                           "ingest_depth": 2}
+                                           "ingest_depth": 2,
+                                           "shard_window": 4}
     finally:
         tune.reset()
 

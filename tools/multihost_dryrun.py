@@ -392,11 +392,22 @@ def _prepsubband_cli_check():
     mhs = sorted(glob.glob(os.path.join(work, "mh_DM*.dat")))
     out["ref_files"] = len(refs)
     out["mh_files"] = len(mhs)
+    # fused-vs-staged ROUTING visibility (PR 8): prepsubband prints
+    # which contract its sharded path took; a multi-process cluster
+    # must stay on the staged contract (the seam is single-process),
+    # so anything else here is a routing regression.  The fused-seam
+    # counterpart is asserted by __graft_entry__.dryrun_multichip's
+    # routing probe and lands in MULTICHIP_*.json.
+    routing = sorted({ln.split("= ", 1)[1].strip()
+                      for o in outs for ln in o[0].splitlines()
+                      if ln.startswith("prepsubband: sharded routing")})
+    out["sharded_routing"] = routing
+    out["routing_ok"] = routing == ["staged"]
     same = (len(refs) == len(mhs) == 16 and all(
         open(a, "rb").read() == open(b, "rb").read()
         for a, b in zip(refs, mhs)))
     out["byte_identical"] = bool(same)
-    out["ok"] = bool(same)
+    out["ok"] = bool(same and out["routing_ok"])
     return out
 
 

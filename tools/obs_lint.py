@@ -42,7 +42,19 @@ code path cannot ship silently:
      metric listed in METRICS is actually registered by the fusion
      layer, so the in-memory data path (which deliberately SKIPS the
      durable artifacts a post-mortem would otherwise read) cannot
-     ship with its telemetry dark.
+     ship with its telemetry dark;
+  9. the DM-SHARDED seam (the multi-device arm of the fused
+     pipeline): SHARDED_FUSION_SPANS / SHARDED_KILL_POINTS /
+     SHARDED_FUSION_METRICS are pinned BOTH directions against the
+     source — every registered sharded span is opened by the fusion
+     layer, every registered sharded kill point is fired by
+     pipeline/survey.py, every registered sharded metric is
+     registered by fusion.py, and conversely any "shard"-named span/
+     kill point/`survey_fused_shard_*` metric in those sources must
+     be in the sharded sets (and the sets must be subsets of their
+     parent catalogs) — the sharded seam holds an entire survey's
+     fan-out across devices with nothing durable until spill, so its
+     telemetry may neither go dark nor go stale.
 
 Run directly (exit 1 lists violations) or via tests/test_obs_lint.py.
 """
@@ -279,6 +291,53 @@ def lint() -> List[str]:
         problems.append(
             "obs/taxonomy.py: METRICS lists %r but the fusion layer "
             "never registers it" % m)
+
+    # 9. DM-sharded seam: spans/kill points/metrics both directions
+    # (the sharded sets must also be subsets of their parent catalogs,
+    # so a rename cannot leave a dangling sharded entry)
+    for s in sorted(taxonomy.SHARDED_FUSION_SPANS
+                    - taxonomy.FUSION_SPANS):
+        problems.append(
+            "obs/taxonomy.py: SHARDED_FUSION_SPANS lists %r which is "
+            "not in FUSION_SPANS" % s)
+    for p in sorted(taxonomy.SHARDED_KILL_POINTS
+                    - taxonomy.KILL_POINTS):
+        problems.append(
+            "obs/taxonomy.py: SHARDED_KILL_POINTS lists %r which is "
+            "not in KILL_POINTS" % p)
+    for m in sorted(taxonomy.SHARDED_FUSION_METRICS
+                    - taxonomy.METRICS):
+        problems.append(
+            "obs/taxonomy.py: SHARDED_FUSION_METRICS lists %r which "
+            "is not in METRICS" % m)
+    for s in sorted(taxonomy.SHARDED_FUSION_SPANS - fspans):
+        problems.append(
+            "obs/taxonomy.py: SHARDED_FUSION_SPANS lists %r but the "
+            "fusion layer never opens it" % s)
+    for s in sorted({x for x in fspans if "shard" in x}
+                    - taxonomy.SHARDED_FUSION_SPANS):
+        problems.append(
+            "pipeline/fusion.py: sharded span %r is not registered "
+            "in obs/taxonomy.SHARDED_FUSION_SPANS" % s)
+    for p in sorted(taxonomy.SHARDED_KILL_POINTS - points):
+        problems.append(
+            "obs/taxonomy.py: SHARDED_KILL_POINTS lists %r but "
+            "pipeline/survey.py never fires it" % p)
+    for p in sorted({x for x in points if "shard" in x}
+                    - taxonomy.SHARDED_KILL_POINTS):
+        problems.append(
+            "pipeline/survey.py: sharded kill point %r is not "
+            "registered in obs/taxonomy.SHARDED_KILL_POINTS" % p)
+    for m in sorted(taxonomy.SHARDED_FUSION_METRICS - fmetrics):
+        problems.append(
+            "obs/taxonomy.py: SHARDED_FUSION_METRICS lists %r but "
+            "the fusion layer never registers it" % m)
+    for m in sorted({x for x in fmetrics
+                     if x.startswith("survey_fused_shard_")}
+                    - taxonomy.SHARDED_FUSION_METRICS):
+        problems.append(
+            "pipeline/fusion.py: sharded metric %r is not registered "
+            "in obs/taxonomy.SHARDED_FUSION_METRICS" % m)
     return problems
 
 
