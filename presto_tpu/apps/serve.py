@@ -54,10 +54,35 @@ def build_parser():
                    help="Export spans here (spans.jsonl + Perfetto "
                         "trace.perfetto.json); metrics/flight "
                         "recorder are always on for the service")
+    # fleet membership (docs/SERVING.md, "Fleet-scale serving")
+    p.add_argument("-fleet", type=str, default=None,
+                   help="Join the fleet whose job ledger lives in "
+                        "this shared directory: lease jobs from it "
+                        "instead of only serving local /submit")
+    p.add_argument("-replica", type=str, default=None,
+                   help="Fleet replica name (default <host>-<pid>)")
+    p.add_argument("-lease-ttl", type=float, default=30.0,
+                   help="Job lease TTL in seconds")
+    p.add_argument("-hb-interval", type=float, default=1.0,
+                   help="Fleet heartbeat interval in seconds")
+    p.add_argument("-hb-timeout", type=float, default=10.0,
+                   help="Heartbeat TTL before a replica is reaped")
+    p.add_argument("-inflight", type=int, default=2,
+                   help="Leased jobs held concurrently")
+    p.add_argument("-planstore", type=str, default=None,
+                   help="Persistent compiled-plan tier root "
+                        "(default <fleet>/planstore when -fleet is "
+                        "set); JAX's compilation cache + plan-recipe "
+                        "sidecar keyed by device fingerprint")
+    p.add_argument("-no-prewarm", action="store_true",
+                   help="Skip the plan-cache warm-up before leasing")
     return p
 
 
 def main(argv=None) -> int:
+    import os
+    import signal
+    import threading
     args = build_parser().parse_args(argv)
     ensure_backend()
     from presto_tpu.obs import ObsConfig
@@ -68,11 +93,15 @@ def main(argv=None) -> int:
         job_timeout_s=args.timeout or None,
         max_retries=args.retries,
         backoff_base_s=args.backoff)
+    plan_store_dir = args.planstore
+    if plan_store_dir is None and args.fleet:
+        plan_store_dir = os.path.join(args.fleet, "planstore")
     service = SearchService(args.workdir, queue_depth=args.depth,
                             plan_capacity=args.plans,
                             scheduler_cfg=scfg,
                             events_path=args.events,
                             heartbeat_s=args.heartbeat,
+                            plan_store_dir=plan_store_dir,
                             obs_config=ObsConfig(
                                 enabled=True,
                                 trace_dir=args.tracedir,
@@ -80,17 +109,40 @@ def main(argv=None) -> int:
     service.start()
     httpd = start_http(service, args.host, args.port)
     host, port = httpd.server_address[:2]
+    replica = None
+    if args.fleet:
+        from presto_tpu.serve.fleet import FleetConfig, FleetReplica
+        fcfg = FleetConfig(fleetdir=args.fleet,
+                           replica=args.replica or "",
+                           lease_ttl=args.lease_ttl,
+                           heartbeat_s=args.hb_interval,
+                           heartbeat_timeout=args.hb_timeout,
+                           max_inflight=args.inflight,
+                           prewarm=not args.no_prewarm)
+        replica = FleetReplica(
+            service, fcfg,
+            addr="http://%s:%d" % (host, port)).start()
+        print("presto-serve: fleet replica %r leasing from %s"
+              % (replica.replica, args.fleet))
     print("presto-serve: listening on http://%s:%d "
-          "(POST /submit, GET /jobs/<id>, /healthz, /metrics)"
-          % (host, port))
+          "(POST /submit, GET /jobs/<id>, /healthz, /readyz, "
+          "/metrics)" % (host, port))
+
+    # graceful shutdown: SIGTERM drains in-flight jobs, releases the
+    # fleet leases, and writes a heartbeat tombstone so the reaper
+    # re-admits immediately instead of waiting out the TTL
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *_: stop.set())
     try:
-        while True:
-            time.sleep(3600)
+        while not stop.wait(1.0):
+            pass
+        print("presto-serve: SIGTERM — draining")
     except KeyboardInterrupt:
         print("presto-serve: shutting down")
     finally:
         httpd.shutdown()
-        service.stop()
+        report = service.shutdown(drain=True)
+        print("presto-serve: shutdown %s" % report)
     return 0
 
 

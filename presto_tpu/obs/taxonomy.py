@@ -94,12 +94,37 @@ SERVE_EVENTS = frozenset({
     "degrade",
     "complete",
     "fail",
+    "park",
     "compile",
     "evict",
     "plan-evict",
     "scheduler-error",
     "http",
     "heartbeat",
+})
+
+#: fleet-serving event kinds — the multi-replica vocabulary of
+#: serve/jobledger.py (ledger lease/commit/fence flight-recorder
+#: events, via the generic LeaseLedger EV_* bindings), serve/fleet.py
+#: (replica lifecycle on the service event log), and serve/router.py
+#: (admission-control rejections).  Enforced BOTH directions by
+#: obs_lint check 10: the fleet recovery path may not emit
+#: unregistered kinds, and the catalog may not list dead ones.
+FLEET_EVENTS = frozenset({
+    "job-lease",
+    "job-done",
+    "job-redo",
+    "job-failed",
+    "stale-result-rejected",
+    "replica-dead",
+    "fleet-epoch-bump",
+    "quota-exceeded",
+    "shed",
+    "fleet-join",
+    "fleet-drain",
+    "fleet-tombstone",
+    "fleet-pump-error",
+    "router-poll-error",
 })
 
 #: streaming-layer event kinds — every `events.emit("<kind>", ...)`
@@ -130,6 +155,7 @@ JOB_STATE_EVENTS = {
     "scheduled": "schedule",
     "running": "execute",
     "retry-wait": "retry",
+    "parked": "park",
     "done": "complete",
     "failed": "fail",
     "timeout": "fail",
@@ -172,6 +198,26 @@ SHARDED_FUSION_METRICS = frozenset({
     "survey_fused_shard_gather_bytes_total",
 })
 
+#: fleet-serving metrics — every `fleet_*` name must be registered by
+#: the fleet modules (serve/jobledger.py, serve/fleet.py,
+#: serve/router.py) and vice versa (obs_lint check 10, both
+#: directions, the same pinning discipline as the sharded seam: a
+#: replica-loss recovery path may neither go dark nor go stale)
+FLEET_METRICS = frozenset({
+    "fleet_jobs_leased_total",
+    "fleet_jobs_committed_total",
+    "fleet_jobs_redone_total",
+    "fleet_jobs_failed_total",
+    "fleet_stale_results_total",
+    "fleet_inflight",
+    "fleet_epoch",
+    "fleet_submissions_total",
+    "fleet_shed_total",
+    "fleet_quota_rejections_total",
+    "fleet_depth",
+    "fleet_replicas_ready",
+})
+
 #: registered metric names (Prometheus side of the contract); the
 #: linter checks every registry.counter/gauge/histogram call in the
 #: tree registers a name listed here.
@@ -189,11 +235,15 @@ METRICS = frozenset({
     "serve_queue_capacity",
     "serve_uptime_seconds",
     "serve_jobs",
-    # plan cache
+    "serve_jobs_parked_total",
+    # plan cache (incl. the persistent tier, serve/plancache.PlanStore)
     "plancache_hits_total",
     "plancache_misses_total",
     "plancache_evictions_total",
     "plancache_size",
+    "plancache_warm_fraction",
+    "plancache_prewarmed_total",
+    "plancache_store_plans",
     # latency / stage timing
     "latency_seconds",
     "survey_stage_seconds",
@@ -241,6 +291,20 @@ METRICS = frozenset({
     # directions by obs_lint check 9 via SHARDED_FUSION_METRICS
     "survey_fused_shard_trials_total",
     "survey_fused_shard_gather_bytes_total",
+    # fleet serving (serve/fleet.py + jobledger.py + router.py);
+    # pinned both directions by obs_lint check 10 via FLEET_METRICS
+    "fleet_jobs_leased_total",
+    "fleet_jobs_committed_total",
+    "fleet_jobs_redone_total",
+    "fleet_jobs_failed_total",
+    "fleet_stale_results_total",
+    "fleet_inflight",
+    "fleet_epoch",
+    "fleet_submissions_total",
+    "fleet_shed_total",
+    "fleet_quota_rejections_total",
+    "fleet_depth",
+    "fleet_replicas_ready",
     # streaming search (presto_tpu/stream); every stream_* name here
     # must be registered by the stream layer (obs_lint check 7)
     "stream_blocks_total",

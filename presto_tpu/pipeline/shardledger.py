@@ -9,72 +9,41 @@ forever; here every shard is a *leased* row in `shards.json`, and any
 surviving host can re-lease and recompute a dead member's rows because
 each shard's computation is deterministic given its spec.
 
-State machine per shard::
-
-    pending --lease--> leased --complete--> done
-       ^                 |                   |
-       |   (lease expiry, owner death,      | (artifact fails
-       |    explicit fail)                  |  size+CRC verify)
-       +---------------- reap --------------+
-
-Epoch fencing: the ledger carries a cluster **epoch**, bumped whenever
-membership changes (a host misses its heartbeat, a lease is reaped).
-Every lease records the epoch it was granted under; `complete()` is
-accepted only when the shard is still leased to that owner *under that
-epoch*.  A zombie worker — one declared dead whose process lingers —
-therefore cannot land a late write: its lease was re-admitted at the
-bump, the fence check fails, and its staged output files are deleted
-before they can replace a journaled artifact.
-
-Staged commits: workers never write final artifact names directly.
-They stage outputs next to the targets (atomic temp writes) and hand
-the staged map to `complete()`, which performs fence-check -> rename
--> size+CRC journal *under the ledger lock* — so a final artifact name
-only ever holds bytes whose provenance the ledger accepted.
-
-Cross-host coordination is plain shared-filesystem: the ledger file is
-written atomically under a lock directory, and heartbeats are small
-per-host files (`.hb-<host>.json`) so a 1 Hz heartbeat never contends
-with the ledger lock.
+The lease / heartbeat / epoch-fencing / staged-commit mechanics are
+the generic `pipeline/leaseledger.LeaseLedger` (shared with the fleet
+job ledger, `serve/jobledger.py`); this module binds them to the
+DM-shard vocabulary: the `shards.json` schema, the `shard-*`
+flight-recorder events, and the `(shard_id, row_lo, row_hi)` specs of
+`make_dm_shards`.  See the leaseledger docstring for the state
+machine and the zombie-write fence.
 """
 
 from __future__ import annotations
 
-import contextlib
-import errno
-import json
-import os
-import time
-from dataclasses import dataclass, field
-from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+from dataclasses import dataclass
+from typing import List, Optional, Sequence, Tuple
 
-from presto_tpu.io.atomic import atomic_write_text, file_checksum
+from presto_tpu.pipeline.leaseledger import (DONE, LEASED,  # noqa: F401
+                                             HEARTBEAT_PREFIX,
+                                             PENDING, LeaseLedger,
+                                             LedgerError, ReapReport,
+                                             StaleLeaseError)
 
 LEDGER_NAME = "shards.json"
-HEARTBEAT_PREFIX = ".hb-"
-
-PENDING, LEASED, DONE = "pending", "leased", "done"
 
 
-class ShardLedgerError(Exception):
-    """Base class for ledger protocol violations."""
+class ShardLedgerError(LedgerError):
+    """Base class for shard-ledger protocol violations."""
 
 
-class StaleEpochError(ShardLedgerError):
+class StaleEpochError(StaleLeaseError, ShardLedgerError):
     """A write attempted under a lease the cluster has fenced off —
     the zombie-worker case.  The staged outputs were discarded."""
 
     def __init__(self, shard_id: str, host: str, epoch: int,
                  current_epoch: int, why: str):
+        super().__init__(shard_id, host, epoch, current_epoch, why)
         self.shard_id = shard_id
-        self.host = host
-        self.epoch = epoch
-        self.current_epoch = current_epoch
-        self.why = why
-        super().__init__(
-            "stale write rejected: shard %r by %r under epoch %d "
-            "(cluster epoch %d): %s"
-            % (shard_id, host, epoch, current_epoch, why))
 
 
 @dataclass
@@ -85,61 +54,12 @@ class Lease:
     epoch: int                     # fence token for complete()
     expires: float
 
-
-@dataclass
-class ReapReport:
-    """What one reap pass changed."""
-    dead_hosts: List[str] = field(default_factory=list)
-    redone: List[str] = field(default_factory=list)
-    epoch: int = 0
-    bumped: bool = False
+    @property
+    def item_id(self) -> str:      # generic-ledger lease protocol
+        return self.shard_id
 
 
-class _LockDir:
-    """Tiny cross-process mutex: os.mkdir is atomic on POSIX.  A lock
-    older than `stale` seconds is presumed abandoned by a killed
-    process and broken — safe here because every mutation under the
-    lock ends in an atomic whole-file replace, so a breaker can never
-    observe a half-written ledger."""
-
-    def __init__(self, path: str, timeout: float = 30.0,
-                 stale: float = 30.0, poll: float = 0.02):
-        self.path = path
-        self.timeout = timeout
-        self.stale = stale
-        self.poll = poll
-
-    @contextlib.contextmanager
-    def __call__(self):
-        deadline = time.time() + self.timeout
-        while True:
-            try:
-                os.mkdir(self.path)
-                break
-            except OSError as e:
-                if e.errno != errno.EEXIST:
-                    raise
-                try:
-                    age = time.time() - os.path.getmtime(self.path)
-                except OSError:
-                    continue               # raced with the releaser
-                if age > self.stale:
-                    with contextlib.suppress(OSError):
-                        os.rmdir(self.path)
-                    continue
-                if time.time() > deadline:
-                    raise ShardLedgerError(
-                        "could not acquire ledger lock %s within %.1fs"
-                        % (self.path, self.timeout))
-                time.sleep(self.poll)
-        try:
-            yield
-        finally:
-            with contextlib.suppress(OSError):
-                os.rmdir(self.path)
-
-
-class ShardLedger:
+class ShardLedger(LeaseLedger):
     """Leased-shard journal for one survey working directory.
 
     Every public mutator is transactional: it takes the lock, reloads
@@ -149,94 +69,16 @@ class ShardLedger:
     that mutation.
     """
 
-    def __init__(self, workdir: str, name: str = LEDGER_NAME,
-                 obs=None):
-        self.workdir = os.path.abspath(workdir)
-        self.path = os.path.join(self.workdir, name)
-        self._lock = _LockDir(self.path + ".lock")
-        self.obs = obs
-
-    # -- raw state ----------------------------------------------------
-    def _load(self) -> dict:
-        try:
-            with open(self.path) as f:
-                state = json.load(f)
-            if not isinstance(state, dict):
-                raise ValueError("ledger is not an object")
-        except (OSError, ValueError):
-            state = {}
-        state.setdefault("version", 1)
-        state.setdefault("epoch", 0)
-        state.setdefault("shards", {})
-        state.setdefault("hosts", {})
-        return state
-
-    def _save(self, state: dict) -> None:
-        atomic_write_text(self.path, json.dumps(
-            state, indent=1, sort_keys=True) + "\n")
-
-    def read(self) -> dict:
-        """Lock-free snapshot (monitoring / tests)."""
-        return self._load()
-
-    @property
-    def epoch(self) -> int:
-        return int(self._load()["epoch"])
-
-    # -- event plumbing ----------------------------------------------
-    def _event(self, kind: str, **fields) -> None:
-        if self.obs is not None and getattr(self.obs, "enabled",
-                                            False):
-            self.obs.event(kind, **fields)
-
-    # -- membership ---------------------------------------------------
-    def join(self, host: str, addr: Optional[str] = None,
-             now: Optional[float] = None) -> int:
-        """Register (or re-register) a host; returns the epoch it
-        joins under.  A host re-joining after being declared dead is
-        admitted at the current epoch — its fenced leases were already
-        re-admitted, so it simply starts fresh."""
-        now = time.time() if now is None else now
-        with self._lock():
-            state = self._load()
-            state["hosts"][host] = {"joined": now, "alive": True,
-                                    "addr": addr,
-                                    "epoch": int(state["epoch"])}
-            self._save(state)
-            return int(state["epoch"])
-
-    def heartbeat_path(self, host: str) -> str:
-        return os.path.join(self.workdir, HEARTBEAT_PREFIX + host
-                            + ".json")
-
-    def heartbeat(self, host: str, epoch: int,
-                  now: Optional[float] = None) -> None:
-        """Cheap liveness signal: one small atomic file per host, no
-        ledger lock taken."""
-        now = time.time() if now is None else now
-        atomic_write_text(self.heartbeat_path(host), json.dumps(
-            {"host": host, "ts": now, "epoch": int(epoch)}) + "\n")
-
-    def last_heartbeat(self, host: str) -> Optional[float]:
-        try:
-            with open(self.heartbeat_path(host)) as f:
-                return float(json.load(f)["ts"])
-        except (OSError, ValueError, KeyError, TypeError):
-            return None
-
-    def alive_hosts(self, now: Optional[float] = None,
-                    ttl: float = 15.0) -> List[str]:
-        now = time.time() if now is None else now
-        state = self._load()
-        out = []
-        for host, h in sorted(state["hosts"].items()):
-            if not h.get("alive", False):
-                continue
-            hb = self.last_heartbeat(host)
-            seen = hb if hb is not None else float(h.get("joined", 0))
-            if now - seen <= ttl:
-                out.append(host)
-        return out
+    LEDGER_NAME = LEDGER_NAME
+    ITEMS_KEY = "shards"
+    ERROR = ShardLedgerError
+    STALE = StaleEpochError
+    EV_LEASE = "shard-lease"
+    EV_DONE = "shard-done"
+    EV_REDO = "shard-redo"
+    EV_STALE = "stale-write-rejected"
+    EV_HOST_DEAD = "host-dead"
+    EV_EPOCH_BUMP = "epoch-bump"
 
     # -- shard bookkeeping --------------------------------------------
     def ensure_shards(self, specs: Sequence[Tuple[str, int, int]],
@@ -244,288 +86,14 @@ class ShardLedger:
         """Idempotently create shard rows.  `specs` is a sequence of
         (shard_id, row_lo, row_hi).  Existing rows keep their state
         (that is the resume contract); returns the pending count."""
-        with self._lock():
-            state = self._load()
-            if meta:
-                state.setdefault("meta", {}).update(meta)
-            for sid, lo, hi in specs:
-                state["shards"].setdefault(sid, {
-                    "rows": [int(lo), int(hi)],
-                    "state": PENDING,
-                    "owner": None,
-                    "lease_epoch": None,
-                    "lease_expires": None,
-                    "artifacts": {},
-                    "redos": 0,
-                })
-            pending = sum(1 for s in state["shards"].values()
-                          if s["state"] != DONE)
-            self._save(state)
-            return pending
+        return self.ensure_items(
+            [(sid, {"rows": [int(lo), int(hi)]})
+             for sid, lo, hi in specs], meta=meta)
 
-    def lease(self, host: str, ttl: float,
-              now: Optional[float] = None) -> Optional[Lease]:
-        """Claim the first pending shard for `host`; None when no
-        shard is currently pending (all leased or done)."""
-        now = time.time() if now is None else now
-        with self._lock():
-            state = self._load()
-            h = state["hosts"].get(host)
-            if h is not None and not h.get("alive", True):
-                # false-positive death (slow heartbeat): rejoin at the
-                # current epoch and carry on
-                h["alive"] = True
-                h["epoch"] = int(state["epoch"])
-            for sid in sorted(state["shards"]):
-                sh = state["shards"][sid]
-                if sh["state"] != PENDING:
-                    continue
-                sh["state"] = LEASED
-                sh["owner"] = host
-                sh["lease_epoch"] = int(state["epoch"])
-                sh["lease_expires"] = now + ttl
-                self._save(state)
-                self._event("shard-lease", shard=sid, host=host,
-                            epoch=int(state["epoch"]))
-                return Lease(sid, tuple(sh["rows"]),
-                             int(state["epoch"]),
-                             float(sh["lease_expires"]))
-            self._save(state)
-            return None
-
-    def renew(self, lease: Lease, host: str, ttl: float,
-              now: Optional[float] = None) -> bool:
-        """Extend a held lease (long shards).  False when the lease
-        was fenced off meanwhile."""
-        now = time.time() if now is None else now
-        with self._lock():
-            state = self._load()
-            sh = state["shards"].get(lease.shard_id)
-            if (sh is None or sh["state"] != LEASED
-                    or sh["owner"] != host
-                    or int(sh["lease_epoch"]) != int(lease.epoch)):
-                return False
-            sh["lease_expires"] = now + ttl
-            self._save(state)
-            return True
-
-    def complete(self, lease: Lease, host: str,
-                 staged: Dict[str, str],
-                 now: Optional[float] = None) -> Dict[str, dict]:
-        """Commit a computed shard: fence-check, rename each staged
-        file onto its final path, journal size+CRC — all under the
-        ledger lock.  `staged` maps final absolute path -> staged
-        temp path.  Raises StaleEpochError (after deleting the staged
-        files) when the lease was fenced off; a journaled artifact is
-        then never overwritten."""
-        now = time.time() if now is None else now
-        with self._lock():
-            state = self._load()
-            sh = state["shards"].get(lease.shard_id)
-            why = None
-            if sh is None:
-                why = "unknown shard"
-            elif sh["state"] != LEASED:
-                why = "shard is %s, not leased" % sh["state"]
-            elif sh["owner"] != host:
-                why = "lease owned by %r" % sh["owner"]
-            elif int(sh["lease_epoch"]) != int(lease.epoch):
-                why = ("lease epoch %s superseded"
-                       % sh["lease_epoch"])
-            if why is not None:
-                for tmp in staged.values():
-                    with contextlib.suppress(OSError):
-                        os.remove(tmp)
-                self._event("stale-write-rejected",
-                            shard=lease.shard_id, host=host,
-                            epoch=int(lease.epoch),
-                            cluster_epoch=int(state["epoch"]),
-                            why=why)
-                raise StaleEpochError(lease.shard_id, host,
-                                      int(lease.epoch),
-                                      int(state["epoch"]), why)
-            arts: Dict[str, dict] = {}
-            for final, tmp in sorted(staged.items()):
-                os.replace(tmp, final)
-                rel = os.path.relpath(os.path.abspath(final),
-                                      self.workdir)
-                arts[rel] = {"size": os.path.getsize(final),
-                             "checksum": file_checksum(final)}
-            sh["state"] = DONE
-            sh["owner"] = host
-            sh["lease_epoch"] = None
-            sh["lease_expires"] = None
-            sh["artifacts"] = arts
-            sh["completed_epoch"] = int(state["epoch"])
-            sh["completed_at"] = now
-            self._save(state)
-            self._event("shard-done", shard=lease.shard_id,
-                        host=host, artifacts=len(arts))
-            return arts
-
-    def fail(self, lease: Lease, host: str) -> None:
-        """Voluntarily release a held lease back to pending (compute
-        error on this host; let another host try)."""
-        with self._lock():
-            state = self._load()
-            sh = state["shards"].get(lease.shard_id)
-            if (sh is not None and sh["state"] == LEASED
-                    and sh["owner"] == host
-                    and int(sh["lease_epoch"]) == int(lease.epoch)):
-                self._readmit(sh)
-                self._save(state)
-                self._event("shard-redo", shard=lease.shard_id,
-                            why="released", host=host)
-
-    def readmit_owned(self, host: str) -> List[str]:
-        """Re-admit every lease held by `host` — called by a
-        *restarting* host on join (a fresh incarnation cannot have
-        in-flight work, so any lease under its name is a dead one).
-        Bumps the epoch when anything was re-admitted, fencing off the
-        dead incarnation's possible late writes."""
-        redone = []
-        with self._lock():
-            state = self._load()
-            for sid in sorted(state["shards"]):
-                sh = state["shards"][sid]
-                if sh["state"] == LEASED and sh["owner"] == host:
-                    self._readmit(sh)
-                    redone.append(sid)
-            if redone:
-                state["epoch"] = int(state["epoch"]) + 1
-            self._save(state)
-        for sid in redone:
-            self._event("shard-redo", shard=sid, why="owner-restart",
-                        host=host)
-        return redone
-
-    @staticmethod
-    def _readmit(sh: dict) -> None:
-        sh["state"] = PENDING
-        sh["owner"] = None
-        sh["lease_epoch"] = None
-        sh["lease_expires"] = None
-        sh["redos"] = int(sh.get("redos", 0)) + 1
-
-    # -- failure detection / redo -------------------------------------
-    def reap(self, heartbeat_ttl: float,
-             now: Optional[float] = None) -> ReapReport:
-        """One failure-detection pass: mark hosts with stale
-        heartbeats dead, re-admit their leases plus any lease past
-        expiry, bump the epoch when anything changed.  Safe to call
-        from every host (idempotent under the lock)."""
-        now = time.time() if now is None else now
-        report = ReapReport()
-        with self._lock():
-            state = self._load()
-            for host, h in sorted(state["hosts"].items()):
-                if not h.get("alive", False):
-                    continue
-                hb = self.last_heartbeat(host)
-                seen = hb if hb is not None else float(
-                    h.get("joined", 0))
-                if now - seen > heartbeat_ttl:
-                    h["alive"] = False
-                    report.dead_hosts.append(host)
-            dead = {host for host, h in state["hosts"].items()
-                    if not h.get("alive", False)}
-            for sid in sorted(state["shards"]):
-                sh = state["shards"][sid]
-                if sh["state"] != LEASED:
-                    continue
-                expired = (sh["lease_expires"] is not None
-                           and now > float(sh["lease_expires"]))
-                if sh["owner"] in dead or expired:
-                    self._readmit(sh)
-                    report.redone.append(sid)
-            if report.dead_hosts or report.redone:
-                state["epoch"] = int(state["epoch"]) + 1
-                report.bumped = True
-            report.epoch = int(state["epoch"])
-            self._save(state)
-        for host in report.dead_hosts:
-            self._event("host-dead", host=host, epoch=report.epoch)
-        for sid in report.redone:
-            self._event("shard-redo", shard=sid, why="reaped",
-                        epoch=report.epoch)
-        if report.bumped:
-            self._event("epoch-bump", epoch=report.epoch,
-                        dead=report.dead_hosts, redone=report.redone)
-        return report
-
-    def verify_done(self) -> List[str]:
-        """Verify-not-trust for completed shards: any done shard whose
-        journaled artifacts are missing, resized, or checksum-stale on
-        disk is re-admitted (its stale files are deleted so nothing
-        can resurrect them).  Returns the re-admitted shard ids."""
-        redone = []
-        with self._lock():
-            state = self._load()
-            for sid in sorted(state["shards"]):
-                sh = state["shards"][sid]
-                if sh["state"] != DONE:
-                    continue
-                ok = True
-                for rel, ent in sh.get("artifacts", {}).items():
-                    p = os.path.join(self.workdir, rel)
-                    if (not os.path.exists(p)
-                            or os.path.getsize(p) != ent.get("size")
-                            or file_checksum(p) != ent.get(
-                                "checksum")):
-                        ok = False
-                        break
-                if ok:
-                    continue
-                for rel in sh.get("artifacts", {}):
-                    with contextlib.suppress(OSError):
-                        os.remove(os.path.join(self.workdir, rel))
-                sh["artifacts"] = {}
-                self._readmit(sh)
-                redone.append(sid)
-            self._save(state)
-        for sid in redone:
-            self._event("shard-redo", shard=sid, why="verify-failed")
-        return redone
-
-    # -- progress -----------------------------------------------------
-    def counts(self) -> Dict[str, int]:
-        state = self._load()
-        out = {PENDING: 0, LEASED: 0, DONE: 0}
-        for sh in state["shards"].values():
-            out[sh["state"]] = out.get(sh["state"], 0) + 1
-        return out
-
-    def all_done(self) -> bool:
-        state = self._load()
-        shards = state["shards"]
-        return bool(shards) and all(s["state"] == DONE
-                                    for s in shards.values())
-
-    def redo_set(self, heartbeat_ttl: float,
-                 now: Optional[float] = None) -> List[str]:
-        """The shards a reap pass *would* re-admit right now (dead
-        owners or expired leases) — computed without mutating."""
-        now = time.time() if now is None else now
-        state = self._load()
-        dead = set()
-        for host, h in state["hosts"].items():
-            if not h.get("alive", False):
-                dead.add(host)
-                continue
-            hb = self.last_heartbeat(host)
-            seen = hb if hb is not None else float(h.get("joined", 0))
-            if now - seen > heartbeat_ttl:
-                dead.add(host)
-        out = []
-        for sid in sorted(state["shards"]):
-            sh = state["shards"][sid]
-            if sh["state"] != LEASED:
-                continue
-            expired = (sh["lease_expires"] is not None
-                       and now > float(sh["lease_expires"]))
-            if sh["owner"] in dead or expired:
-                out.append(sid)
-        return out
+    def _make_lease(self, item_id: str, row: dict,
+                    epoch: int) -> Lease:
+        return Lease(item_id, tuple(row["rows"]), epoch,
+                     float(row["lease_expires"]))
 
 
 def make_dm_shards(numdms: int, shard_rows: int,

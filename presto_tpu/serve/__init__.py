@@ -9,32 +9,57 @@ mesh saturated:
 
   queue.py      bounded priority job queue with backpressure
   plancache.py  compiled-plan cache (pad-to-bucket shape quantization)
+                + the persistent compiled-plan tier (PlanStore: JAX
+                compilation cache keyed by device fingerprint and a
+                plan-recipe sidecar for cold-replica warm-up)
   scheduler.py  continuous micro-batching loop: same-bucket coalescing,
                 per-job timeout, bounded retry with exponential
                 backoff, graceful degradation to single-job execution
   server.py     SearchService + threaded HTTP front end
-                (/submit /jobs/<id> /healthz /metrics /events)
+                (/submit /jobs/<id> /healthz /readyz /metrics /events)
   events.py     structured JSON event log for tracing
 
-See docs/SERVING.md for the wire protocol, metrics schema, and
-tuning knobs.
+Fleet scale (N replicas, one shared on-disk job ledger):
+
+  jobledger.py  durable job ledger (generic pipeline/leaseledger core:
+                leases, heartbeats, epoch fencing, staged fence-checked
+                commits) + tenant WRR fairness and quotas
+  fleet.py      FleetReplica: the lease-and-execute pump around one
+                SearchService, with graceful drain and a chaos seam
+  router.py     front-door admission (load shedding 429+Retry-After,
+                typed tenant-quota rejections, /fleet topology view)
+                + presto-router CLI
+
+See docs/SERVING.md for the wire protocol, metrics schema, fleet
+topology, and tuning knobs.
 """
 
 from presto_tpu.serve.events import EventLog
 from presto_tpu.serve.queue import (Job, JobQueue, QueueClosed,
                                     QueueFull, JobStatus)
-from presto_tpu.serve.plancache import (PlanCache, PlanKey,
-                                        SearcherProvider, bucket_key,
+from presto_tpu.serve.plancache import (PlanCache, PlanKey, PlanStore,
+                                        SearcherProvider,
+                                        accel_plan_key, bucket_key,
                                         bucket_quantize,
                                         quantize_nsamp)
 from presto_tpu.serve.scheduler import (JobTimeout, Scheduler,
                                         SchedulerConfig)
 from presto_tpu.serve.server import SearchService, start_http
+from presto_tpu.serve.jobledger import (JobLedger, JobLedgerError,
+                                        StaleResultError,
+                                        TenantQuotaExceeded)
+from presto_tpu.serve.fleet import (FleetConfig, FleetReplica,
+                                    artifact_digests)
+from presto_tpu.serve.router import (FleetBusy, FleetRouter,
+                                     NoReadyReplica, RouterConfig)
 
 __all__ = [
-    "EventLog", "Job", "JobQueue", "JobStatus", "JobTimeout",
-    "PlanCache", "PlanKey", "QueueClosed", "QueueFull", "Scheduler",
-    "SchedulerConfig", "SearchService", "SearcherProvider",
-    "bucket_key", "bucket_quantize", "quantize_nsamp",
-    "start_http",
+    "EventLog", "FleetBusy", "FleetConfig", "FleetReplica",
+    "FleetRouter", "Job", "JobLedger", "JobLedgerError", "JobQueue",
+    "JobStatus", "JobTimeout", "NoReadyReplica", "PlanCache",
+    "PlanKey", "PlanStore", "QueueClosed", "QueueFull",
+    "RouterConfig", "Scheduler", "SchedulerConfig", "SearchService",
+    "SearcherProvider", "StaleResultError", "TenantQuotaExceeded",
+    "accel_plan_key", "artifact_digests", "bucket_key",
+    "bucket_quantize", "quantize_nsamp", "start_http",
 ]

@@ -1,0 +1,410 @@
+"""Fleet replica: one presto-serve process leasing jobs from the
+shared job ledger.
+
+Topology (docs/SERVING.md, "Fleet-scale serving")::
+
+    clients ──▶ router.py ──admit──▶ jobs.json (serve/jobledger)
+                                        ▲  lease / commit / redo
+                   ┌────────────────────┼────────────────────┐
+              replica A            replica B            replica C
+           (SearchService +     (SearchService +     (SearchService +
+            FleetReplica)        FleetReplica)        FleetReplica)
+
+Each replica runs the standard single-process service (queue, plan
+cache, micro-batching scheduler) and this pump around it:
+
+  * **lease** — claim pending jobs from the ledger (tenant-WRR order)
+    up to `max_inflight`, build them into local queue jobs whose
+    workdir is the job's *epoch-stamped attempt directory*
+    (`<fleetdir>/jobs/<id>/a<epoch>`), so a zombie incarnation and
+    its successor never write into the same tree;
+  * **commit** — when the local job completes, stage `result.json`
+    (result summary + artifact digests) and commit it through the
+    ledger's fence-checked staged path: a replica the fleet declared
+    dead gets `StaleResultError` and its late result is discarded —
+    never landed twice;
+  * **renew / reap** — heartbeat its own liveness, renew held leases
+    at half-TTL, and run the (idempotent) reaper so any replica can
+    re-admit a dead peer's leases;
+  * **drain** — on SIGTERM: stop leasing, let in-flight work finish
+    and commit, release what never started, park scheduler retries
+    back into the ledger (`Scheduler.park` seam), and write a
+    heartbeat *tombstone* so the reaper re-admits instantly instead
+    of waiting out the TTL.
+
+`kill()` is the chaos seam: it drops the replica exactly the way
+SIGKILL does (heartbeats stop, leases stay claimed, any running
+survey keeps running as a zombie) — tools/fleet_chaos.py and
+tests/test_fleet.py drive it.
+"""
+
+from __future__ import annotations
+
+import glob
+import hashlib
+import json
+import os
+import socket
+import tempfile
+import threading
+import time
+from dataclasses import dataclass
+from typing import Dict, Optional, Tuple
+
+from presto_tpu.serve.jobledger import JobLedger
+from presto_tpu.serve.queue import (Job, JobStatus, QueueClosed,
+                                    QueueFull)
+
+
+def default_replica_name() -> str:
+    return "%s-%d" % (socket.gethostname(), os.getpid())
+
+
+#: attempt-dir artifact patterns whose bytes are deterministic given
+#: the job spec (no embedded timings/paths) — the byte-equality
+#: surface the chaos trials compare against a never-failed run
+ARTIFACT_PATTERNS = ("*.dat", "*.fft", "*.singlepulse", "*_ACCEL_*",
+                     "cands_sifted*")
+
+
+def artifact_digests(workdir: str) -> Dict[str, dict]:
+    """{relative artifact: {size, sha256}} for one attempt dir."""
+    out: Dict[str, dict] = {}
+    for pat in ARTIFACT_PATTERNS:
+        for p in sorted(glob.glob(os.path.join(workdir, "**", pat),
+                                  recursive=True)):
+            h = hashlib.sha256()
+            with open(p, "rb") as f:
+                for chunk in iter(lambda: f.read(1 << 20), b""):
+                    h.update(chunk)
+            rel = os.path.relpath(p, workdir)
+            out[rel] = {"size": os.path.getsize(p),
+                        "sha256": h.hexdigest()}
+    return out
+
+
+@dataclass
+class FleetConfig:
+    """Fleet-membership knobs for one replica."""
+    fleetdir: str
+    replica: str = ""              # default: <hostname>-<pid>
+    lease_ttl: float = 30.0
+    heartbeat_s: float = 1.0
+    heartbeat_timeout: float = 10.0
+    poll_s: float = 0.1
+    max_inflight: int = 2          # leased jobs held at once
+    prewarm: bool = True           # warm the plan cache before leasing
+
+
+class FleetReplica:
+    """The lease-and-execute pump wrapping one SearchService."""
+
+    def __init__(self, service, cfg: FleetConfig,
+                 addr: Optional[str] = None):
+        self.service = service
+        self.cfg = cfg
+        self.replica = cfg.replica or default_replica_name()
+        self.addr = addr
+        os.makedirs(cfg.fleetdir, exist_ok=True)
+        self.ledger = JobLedger(cfg.fleetdir, obs=service.obs)
+        self.jobroot = os.path.join(os.path.abspath(cfg.fleetdir),
+                                    "jobs")
+        os.makedirs(self.jobroot, exist_ok=True)
+        self.epoch = 0
+        self.draining = False
+        self._killed = False
+        self._stop = threading.Event()
+        self._pump_t: Optional[threading.Thread] = None
+        self._hb_t: Optional[threading.Thread] = None
+        self._warmed = threading.Event()
+        #: job_id -> (lease, local Job)
+        self._inflight: Dict[str, Tuple[object, Job]] = {}
+        self._inflight_lock = threading.Lock()
+        #: chaos seam: kill the replica when the pump reaches this
+        #: point ("job-leased" | "job-enqueued")
+        self.kill_on: Optional[str] = None
+        service.fleet = self
+        service.scheduler.park = self._park
+        reg = service.obs.metrics
+        self._c_leased = reg.counter(
+            "fleet_jobs_leased_total",
+            "Jobs this replica leased from the fleet ledger")
+        self._c_committed = reg.counter(
+            "fleet_jobs_committed_total",
+            "Job results committed through the ledger fence")
+        self._c_redone = reg.counter(
+            "fleet_jobs_redone_total",
+            "Leased jobs handed back for another replica")
+        self._c_failed = reg.counter(
+            "fleet_jobs_failed_total",
+            "Jobs terminally failed in the ledger by this replica")
+        self._c_stale = reg.counter(
+            "fleet_stale_results_total",
+            "Late results the ledger fence rejected (zombie commits)")
+        self._g_inflight = reg.gauge(
+            "fleet_inflight", "Leased jobs currently held")
+        self._g_epoch = reg.gauge(
+            "fleet_epoch", "Fleet epoch this replica last observed")
+
+    # ---- lifecycle ----------------------------------------------------
+
+    def start(self) -> "FleetReplica":
+        self.epoch = self.ledger.join(self.replica, addr=self.addr)
+        # a fresh incarnation cannot have in-flight work: anything
+        # leased under this name is a dead predecessor's
+        redone = self.ledger.readmit_owned(self.replica)
+        if redone:
+            self._c_redone.inc(len(redone))
+        self.epoch = self.ledger.epoch
+        self._g_epoch.set(self.epoch)
+        self.ledger.heartbeat(self.replica, self.epoch)
+        self.service.events.emit("fleet-join", replica=self.replica,
+                                 epoch=self.epoch,
+                                 readmitted=len(redone))
+        self._stop.clear()
+        self._hb_t = threading.Thread(
+            target=self._heartbeat_loop,
+            name="presto-fleet-heartbeat", daemon=True)
+        self._hb_t.start()
+        self._pump_t = threading.Thread(
+            target=self._pump, name="presto-fleet-pump", daemon=True)
+        self._pump_t.start()
+        return self
+
+    def kill(self) -> None:
+        """Chaos seam: die the way SIGKILL dies — heartbeats stop,
+        leases stay claimed (the reaper must recover them), any
+        running survey keeps running as a zombie whose late commit
+        the fence must reject."""
+        self._killed = True
+        self._stop.set()
+
+    def stop(self) -> None:
+        self._stop.set()
+        for t in (self._pump_t, self._hb_t):
+            if t is not None:
+                t.join(timeout=10.0)
+
+    def drain(self, timeout: float = 60.0) -> dict:
+        """Graceful departure: stop leasing, finish + commit in-flight
+        work, hand back whatever never ran, tombstone the heartbeat.
+        Returns {drained, released, parked} for the shutdown report."""
+        self.draining = True
+        self.service.draining = True
+        self.service.events.emit("fleet-drain", replica=self.replica,
+                                 inflight=len(self._inflight))
+        deadline = time.time() + timeout
+        drained = True
+        while time.time() < deadline:
+            with self._inflight_lock:
+                if not self._inflight:
+                    break
+            time.sleep(self.cfg.poll_s)
+        else:
+            drained = False
+        released = 0
+        with self._inflight_lock:
+            leftovers = dict(self._inflight)
+            self._inflight.clear()
+            self._g_inflight.set(0)
+        for job_id, (lease, _job) in leftovers.items():
+            # never finished here: back to pending for a live replica
+            self.ledger.fail(lease, self.replica)
+            self._c_redone.inc()
+            released += 1
+        self.stop()
+        self.ledger.tombstone(self.replica)
+        self.service.events.emit("fleet-tombstone",
+                                 replica=self.replica)
+        parked = int(self.service.obs.metrics.get(
+            "serve_jobs_parked_total").value) \
+            if self.service.obs.metrics.get(
+                "serve_jobs_parked_total") else 0
+        return {"drained": drained, "released": released,
+                "parked": parked}
+
+    # ---- readiness ----------------------------------------------------
+
+    def lease_state(self) -> dict:
+        with self._inflight_lock:
+            held = sorted(self._inflight)
+        return {"replica": self.replica, "epoch": self.epoch,
+                "held": held, "draining": bool(self.draining),
+                "warmed": bool(self._warmed.is_set())}
+
+    # ---- the pump -----------------------------------------------------
+
+    def _heartbeat_loop(self) -> None:
+        while not self._stop.wait(self.cfg.heartbeat_s):
+            if self._killed or self.draining:
+                return
+            self.ledger.heartbeat(self.replica, self.epoch)
+
+    def _chaos(self, point: str) -> bool:
+        if self.kill_on == point:
+            self.kill()
+            return True
+        return False
+
+    def _pump(self) -> None:
+        if self.cfg.prewarm:
+            try:
+                self.service.prewarm()
+            finally:
+                self._warmed.set()
+        else:
+            self._warmed.set()
+        while not self._stop.is_set():
+            try:
+                self._tick()
+            except Exception:
+                # a pump error must not kill the replica; the obs
+                # flight recorder carries the traceback
+                self.service.obs.event("fleet-pump-error")
+            self._stop.wait(self.cfg.poll_s)
+
+    _last_reap = 0.0
+
+    def _tick(self) -> None:
+        self._check_inflight()
+        # the reaper is idempotent and any replica may run it, but it
+        # is a ledger transaction — pace it well under the heartbeat
+        # timeout instead of every poll
+        now = time.time()
+        if now - self._last_reap >= min(1.0,
+                                        self.cfg.heartbeat_timeout
+                                        / 4.0):
+            self._last_reap = now
+            report = self.ledger.reap(self.cfg.heartbeat_timeout)
+            self.epoch = report.epoch
+            self._g_epoch.set(self.epoch)
+        while (not self.draining and not self._stop.is_set()
+               and len(self._inflight) < self.cfg.max_inflight):
+            lease = self.ledger.lease(self.replica,
+                                      self.cfg.lease_ttl)
+            if lease is None:
+                break
+            self._c_leased.inc()
+            self.service.events.emit("job-lease",
+                                     job=lease.item_id,
+                                     replica=self.replica,
+                                     epoch=lease.epoch)
+            if self._chaos("job-leased"):
+                return
+            if not self._admit_local(lease):
+                break
+
+    def _attempt_dir(self, job_id: str, epoch: int) -> str:
+        return os.path.join(self.jobroot, job_id, "a%04d" % epoch)
+
+    def _admit_local(self, lease) -> bool:
+        """Build the leased job into the local queue.  False when the
+        local queue refused it (job handed back)."""
+        job_id = lease.item_id
+        spec = dict(lease.data.get("spec") or {})
+        workdir = self._attempt_dir(job_id, lease.epoch)
+        try:
+            job = self.service.build_job(spec, job_id=job_id,
+                                         workdir=workdir)
+            job.priority = int(lease.data.get("priority", 10))
+            self.service.enqueue_job(job)
+        except (QueueFull, QueueClosed):
+            self.ledger.fail(lease, self.replica)
+            self._c_redone.inc()
+            return False
+        except Exception as e:
+            # unexecutable spec: terminal, not a redo loop
+            self.ledger.fail_terminal(lease, self.replica,
+                                      "%s: %s" % (type(e).__name__,
+                                                  e))
+            self._c_failed.inc()
+            return True
+        with self._inflight_lock:
+            self._inflight[job_id] = (lease, job)
+            self._g_inflight.set(len(self._inflight))
+        self._chaos("job-enqueued")
+        return True
+
+    def _check_inflight(self) -> None:
+        now = time.time()
+        with self._inflight_lock:
+            items = list(self._inflight.items())
+        for job_id, (lease, job) in items:
+            if job.status == JobStatus.DONE:
+                self._commit(lease, job)
+                self._drop(job_id)
+            elif job.status in (JobStatus.FAILED, JobStatus.TIMEOUT):
+                try:
+                    self.ledger.fail_terminal(lease, self.replica,
+                                              job.error)
+                    self._c_failed.inc()
+                except self.ledger.STALE:
+                    self._c_stale.inc()
+                self._drop(job_id)
+            elif job.status == JobStatus.PARKED:
+                self._drop(job_id)      # _park already re-admitted it
+            elif lease.expires - now < self.cfg.lease_ttl / 2.0:
+                if self.ledger.renew(lease, self.replica,
+                                     self.cfg.lease_ttl):
+                    lease.expires = now + self.cfg.lease_ttl
+                # a failed renew means the fleet fenced us off; keep
+                # running — the commit fence settles it exactly once
+
+    def _drop(self, job_id: str) -> None:
+        with self._inflight_lock:
+            self._inflight.pop(job_id, None)
+            self._g_inflight.set(len(self._inflight))
+
+    # ---- commit -------------------------------------------------------
+
+    def _commit(self, lease, job: Job) -> bool:
+        """Stage result.json and land it through the ledger fence.
+        Returns False when the fence rejected us (zombie commit)."""
+        job_dir = os.path.join(self.jobroot, job.job_id)
+        os.makedirs(job_dir, exist_ok=True)
+        result = {
+            "job_id": job.job_id,
+            "replica": self.replica,
+            "epoch": int(lease.epoch),
+            "attempt_dir": os.path.relpath(job.workdir, job_dir),
+            "result": job.result,
+            "artifacts": artifact_digests(job.workdir),
+        }
+        fd, tmp = tempfile.mkstemp(prefix=".result-", dir=job_dir)
+        with os.fdopen(fd, "w") as f:
+            json.dump(result, f, indent=1, sort_keys=True)
+        final = os.path.join(job_dir, "result.json")
+        summary = {"n_artifacts": len(result["artifacts"]),
+                   "attempt_dir": result["attempt_dir"],
+                   "replica": self.replica}
+        try:
+            self.ledger.complete(lease, self.replica, {final: tmp},
+                                 extra={"result": summary})
+        except self.ledger.STALE:
+            self._c_stale.inc()
+            self.service.events.emit("stale-result-rejected",
+                                     job=job.job_id,
+                                     replica=self.replica,
+                                     epoch=int(lease.epoch))
+            return False
+        self._c_committed.inc()
+        self.service.events.emit("job-done", job=job.job_id,
+                                 replica=self.replica,
+                                 epoch=int(lease.epoch))
+        return True
+
+    # ---- shutdown parking ---------------------------------------------
+
+    def _park(self, job: Job) -> bool:
+        """Scheduler park seam: a retry that met the closed local
+        queue goes back to the ledger as pending — requeueable by any
+        replica — instead of stranding as a local failure."""
+        with self._inflight_lock:
+            entry = self._inflight.get(job.job_id)
+        if entry is None:
+            return False
+        lease, _ = entry
+        self.ledger.fail(lease, self.replica)
+        self._c_redone.inc()
+        self._drop(job.job_id)
+        return True

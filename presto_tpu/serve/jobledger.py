@@ -1,0 +1,241 @@
+"""Shared on-disk job ledger for a fleet of presto-serve replicas.
+
+One process, one queue, one crash losing everything is the failure
+mode this closes: submissions land here — a durable, transactional
+ledger on the shared filesystem — and N replicas *lease* jobs out of
+it, so a replica crash loses nothing but time.  The lease /
+heartbeat / epoch-fencing / staged-commit mechanics are the generic
+`pipeline/leaseledger.LeaseLedger` (the elastic PR's recovery
+primitives, factored out of `pipeline/shardledger.py`); this module
+binds them to the serve-job vocabulary:
+
+  * an item is a **job row** in `jobs.json`: the submitted spec
+    (rawfiles + SurveyConfig fields), a tenant, a priority, and the
+    usual lease columns;
+  * `complete()` commits the job's `result.json` through the staged
+    fence-checked path, so a zombie replica's late result never
+    lands (`stale-result-rejected`);
+  * jobs add a fence-checked terminal ``failed`` state
+    (`fail_terminal`): a job whose retry budget is exhausted on a
+    live replica must terminate, not cycle the fleet forever;
+  * the lease scheduling policy is **weighted round-robin over
+    tenants** (deficit-style: the pending tenant with the smallest
+    served/weight ratio goes next), so one chatty tenant cannot
+    starve the rest, and per-tenant **quotas** bound admission:
+    `admit()` raises the typed `TenantQuotaExceeded` — a visible,
+    typed rejection, never a silent drop.
+
+The router (`serve/router.py`) is the admission front door; replicas
+(`serve/fleet.py`) are the lease-and-execute loop.  See
+docs/SERVING.md ("Fleet-scale serving") for the full protocol.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional
+
+from presto_tpu.pipeline.leaseledger import (DONE, FAILED, LEASED,
+                                             PENDING, ItemLease,
+                                             LeaseLedger, LedgerError,
+                                             StaleLeaseError)
+
+LEDGER_NAME = "jobs.json"
+
+DEFAULT_TENANT = "default"
+
+
+class JobLedgerError(LedgerError):
+    """Base class for job-ledger protocol violations."""
+
+
+class StaleResultError(StaleLeaseError, JobLedgerError):
+    """A result commit attempted under a lease the fleet has fenced
+    off — the zombie-replica case.  The staged result was discarded
+    and the journaled one (if any) was never overwritten."""
+
+
+class TenantQuotaExceeded(JobLedgerError):
+    """Typed admission rejection: the tenant is at its quota of
+    active (pending + leased) jobs.  Mapped to HTTP 429 by the
+    router; recorded as a `quota-exceeded` event, never a silent
+    drop."""
+
+    def __init__(self, tenant: str, quota: int, active: int):
+        self.tenant = tenant
+        self.quota = quota
+        self.active = active
+        super().__init__(
+            "tenant %r is at its quota (%d active of %d allowed)"
+            % (tenant, active, quota))
+
+
+class JobLedger(LeaseLedger):
+    """Leased-job journal for one fleet directory."""
+
+    LEDGER_NAME = LEDGER_NAME
+    ITEMS_KEY = "jobs"
+    ERROR = JobLedgerError
+    STALE = StaleResultError
+    EV_LEASE = "job-lease"
+    EV_DONE = "job-done"
+    EV_REDO = "job-redo"
+    EV_STALE = "stale-result-rejected"
+    EV_HOST_DEAD = "replica-dead"
+    EV_EPOCH_BUMP = "fleet-epoch-bump"
+
+    # -- tenant configuration ------------------------------------------
+    def set_tenant(self, tenant: str, weight: float = 1.0,
+                   quota: Optional[int] = None) -> None:
+        """Configure one tenant's WRR weight and active-job quota
+        (None = unbounded).  Unknown tenants default to weight 1,
+        no quota."""
+        with self._lock():
+            state = self._load()
+            state.setdefault("tenants", {})[str(tenant)] = {
+                "weight": max(float(weight), 1e-9),
+                "quota": None if quota is None else int(quota),
+            }
+            self._save(state)
+
+    def tenants(self) -> Dict[str, dict]:
+        return dict(self._load().get("tenants", {}))
+
+    @staticmethod
+    def _tenant_cfg(state: dict, tenant: str) -> dict:
+        cfg = state.get("tenants", {}).get(tenant) or {}
+        return {"weight": max(float(cfg.get("weight", 1.0)), 1e-9),
+                "quota": cfg.get("quota")}
+
+    # -- admission ------------------------------------------------------
+    def admit(self, spec: dict, tenant: str = DEFAULT_TENANT,
+              job_id: Optional[str] = None, priority: int = 10,
+              now: Optional[float] = None) -> dict:
+        """Durably admit one job.  Enforces the tenant's quota over
+        its *active* (pending + leased) jobs; raises the typed
+        TenantQuotaExceeded past it.  Returns the job's ledger view.
+        Duplicate explicit job_ids raise JobLedgerError."""
+        now = time.time() if now is None else now
+        tenant = str(tenant or DEFAULT_TENANT)
+        with self._lock():
+            state = self._load()
+            jobs = self._items(state)
+            cfg = self._tenant_cfg(state, tenant)
+            active = sum(1 for j in jobs.values()
+                         if j.get("tenant") == tenant
+                         and j["state"] in (PENDING, LEASED))
+            if cfg["quota"] is not None and active >= cfg["quota"]:
+                self._event("quota-exceeded", tenant=tenant,
+                            quota=cfg["quota"], active=active)
+                raise TenantQuotaExceeded(tenant, int(cfg["quota"]),
+                                          active)
+            if job_id is None:
+                seq = int(state.get("next_id", 1))
+                state["next_id"] = seq + 1
+                job_id = "fjob-%06d" % seq
+            elif job_id in jobs:
+                raise JobLedgerError("duplicate job_id %r" % job_id)
+            jobs[job_id] = self._new_row({
+                "spec": dict(spec),
+                "tenant": tenant,
+                "priority": int(priority),
+                "submitted": now,
+                "error": "",
+            })
+            self._save(state)
+            return self._view(job_id, jobs[job_id])
+
+    # -- scheduling policy: weighted round-robin over tenants ----------
+    def _pick_pending(self, state: dict,
+                      now: float) -> Optional[str]:
+        """Deficit-style WRR: among tenants with pending jobs, grant
+        to the one with the smallest served/weight ratio (ties break
+        by tenant name), then the oldest highest-priority job inside
+        that tenant.  `served` counters persist in the ledger so the
+        rotation is fleet-wide, not per-replica."""
+        jobs = self._items(state)
+        by_tenant: Dict[str, List[str]] = {}
+        for jid, row in jobs.items():
+            if row["state"] == PENDING:
+                by_tenant.setdefault(
+                    str(row.get("tenant", DEFAULT_TENANT)),
+                    []).append(jid)
+        if not by_tenant:
+            return None
+        served = state.setdefault("served", {})
+        tenant = min(
+            by_tenant,
+            key=lambda t: (float(served.get(t, 0))
+                           / self._tenant_cfg(state, t)["weight"], t))
+        jid = min(by_tenant[tenant],
+                  key=lambda j: (int(jobs[j].get("priority", 10)),
+                                 float(jobs[j].get("submitted", 0.0)),
+                                 j))
+        served[tenant] = int(served.get(tenant, 0)) + 1
+        return jid
+
+    # -- terminal failure ----------------------------------------------
+    def fail_terminal(self, lease: ItemLease, host: str, error: str,
+                      now: Optional[float] = None) -> None:
+        """Fence-checked terminal failure: the replica exhausted the
+        job's local retry budget (or the spec is unexecutable), so the
+        job must stop cycling the fleet.  A fenced-off lease raises
+        StaleResultError instead — the fleet already re-admitted the
+        job, and this replica's verdict no longer counts."""
+        now = time.time() if now is None else now
+        with self._lock():
+            state = self._load()
+            row = self._items(state).get(lease.item_id)
+            why = self._fence_why(row, lease, host)
+            if why is not None:
+                self._reject_stale(state, lease, host, {}, why)
+            row["state"] = FAILED
+            row["owner"] = host
+            row["lease_epoch"] = None
+            row["lease_expires"] = None
+            row["error"] = str(error)
+            row["completed_epoch"] = int(state["epoch"])
+            row["completed_at"] = now
+            self._save(state)
+        self._event("job-failed", item=lease.item_id, host=host,
+                    error=str(error))
+
+    # -- introspection --------------------------------------------------
+    @staticmethod
+    def _view(job_id: str, row: dict) -> dict:
+        return {
+            "job_id": job_id,
+            "state": row["state"],
+            "tenant": row.get("tenant", DEFAULT_TENANT),
+            "priority": int(row.get("priority", 10)),
+            "owner": row.get("owner"),
+            "redos": int(row.get("redos", 0)),
+            "error": row.get("error", ""),
+            "submitted": row.get("submitted", 0.0),
+            "artifacts": dict(row.get("artifacts", {})),
+            "result": row.get("result"),
+        }
+
+    def view(self, job_id: str) -> Optional[dict]:
+        row = self._load()[self.ITEMS_KEY].get(job_id)
+        return None if row is None else self._view(job_id, row)
+
+    def depth(self) -> int:
+        """Active fleet depth (pending + leased) — the router's load-
+        shedding signal, mirroring the in-process queue's bound."""
+        counts = self.counts()
+        return counts.get(PENDING, 0) + counts.get(LEASED, 0)
+
+    def tenant_counts(self) -> Dict[str, Dict[str, int]]:
+        out: Dict[str, Dict[str, int]] = {}
+        for row in self._load()[self.ITEMS_KEY].values():
+            t = str(row.get("tenant", DEFAULT_TENANT))
+            st = out.setdefault(t, {PENDING: 0, LEASED: 0, DONE: 0,
+                                    FAILED: 0})
+            st[row["state"]] = st.get(row["state"], 0) + 1
+        return out
+
+    def all_terminal(self) -> bool:
+        jobs = self._load()[self.ITEMS_KEY]
+        return bool(jobs) and all(j["state"] in (DONE, FAILED)
+                                  for j in jobs.values())

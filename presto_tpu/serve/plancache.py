@@ -22,11 +22,15 @@ Two cooperating layers:
 
 from __future__ import annotations
 
+import hashlib
+import json
+import os
 import threading
 import time
+import warnings
 from collections import OrderedDict
-from dataclasses import dataclass
-from typing import Any, Callable, Optional, Tuple
+from dataclasses import asdict, dataclass
+from typing import Any, Callable, Dict, Optional, Tuple
 
 
 @dataclass(frozen=True)
@@ -261,28 +265,240 @@ class PlanCache:
         }
 
 
+def accel_plan_key(acfg, T: float, numbins: int) -> PlanKey:
+    """The execution-plan identity of one accel searcher.  T enters
+    the key (it scales the z grid and candidate frequencies), so only
+    genuinely identical trial geometries share a plan — required for
+    byte-equality with the batch driver."""
+    return PlanKey(kind="accel", nchan=0, nsamp=int(numbins),
+                   dtype="float32", dm_block=(),
+                   zmax=int(acfg.zmax), numharm=int(acfg.numharm),
+                   extra=(float(acfg.sigma), float(acfg.flo),
+                          round(float(T), 9)))
+
+
 class SearcherProvider:
     """The `SurveyConfig.plan_provider` adapter: routes the survey's
     per-trial-group searcher construction through a PlanCache, so a
-    resident service compiles each accel-plan geometry once."""
+    resident service compiles each accel-plan geometry once.  With a
+    PlanStore attached, every plan built is also *recorded* — its
+    rebuild recipe lands in the persistent tier, so a cold replica
+    can re-derive the whole working set before its first job."""
 
-    def __init__(self, cache: PlanCache, mesh=None):
+    def __init__(self, cache: PlanCache, mesh=None,
+                 store: Optional["PlanStore"] = None):
         self.cache = cache
         self.mesh = mesh
+        self.store = store
 
     def searcher(self, acfg, T: float, numbins: int):
-        """Cached AccelSearch for (acfg, T, numbins).  T enters the
-        key (it scales the z grid and candidate frequencies), so only
-        genuinely identical trial geometries share a plan — required
-        for byte-equality with the batch driver."""
-        key = PlanKey(kind="accel", nchan=0, nsamp=int(numbins),
-                      dtype="float32", dm_block=(),
-                      zmax=int(acfg.zmax), numharm=int(acfg.numharm),
-                      extra=(float(acfg.sigma), float(acfg.flo),
-                             round(float(T), 9)))
+        """Cached AccelSearch for (acfg, T, numbins)."""
+        key = accel_plan_key(acfg, T, numbins)
 
         def _build():
             from presto_tpu.search.accel import AccelSearch
-            return AccelSearch(acfg, T=T, numbins=numbins)
+            s = AccelSearch(acfg, T=T, numbins=numbins)
+            if self.store is not None:
+                self.store.record(key, {
+                    "kind": "accel", "acfg": asdict(acfg),
+                    "T": float(T), "numbins": int(numbins)})
+            return s
 
         return self.cache.get(key, _build)
+
+    def prewarm(self, limit: Optional[int] = None) -> int:
+        """Rebuild every plan the persistent tier knows for this
+        device fingerprint into the in-memory cache (a no-op without
+        a store).  With JAX's compilation cache enabled underneath,
+        the XLA executables come off disk instead of recompiling —
+        a freshly joined replica warms in seconds, not per-bucket
+        compile time.  Returns the number of plans warmed."""
+        if self.store is None:
+            return 0
+        from presto_tpu.search.accel import AccelConfig
+        n = 0
+        for recipe in self.store.known().values():
+            if recipe.get("kind") != "accel":
+                continue
+            if limit is not None and n >= limit:
+                break
+            try:
+                acfg = AccelConfig(**recipe["acfg"])
+                self.searcher(acfg, float(recipe["T"]),
+                              int(recipe["numbins"]))
+                n += 1
+            except Exception as e:     # a stale recipe must not
+                warnings.warn(          # block replica start
+                    "plan prewarm skipped a recorded plan: %s" % e,
+                    RuntimeWarning, stacklevel=2)
+        if self.store is not None:
+            self.store.note_warm(self.cache)
+        return n
+
+
+# ----------------------------------------------------------------------
+# persistent compiled-plan tier
+# ----------------------------------------------------------------------
+
+#: sidecar schema version (bumping it orphans old recipes, never
+#: crashes a replica — loads are defensive like tune/db.py)
+STORE_SCHEMA = 1
+
+
+class PlanStore:
+    """Persistent compiled-plan tier keyed by device fingerprint.
+
+    Two cooperating layers close the cold-replica problem:
+
+      * **JAX's compilation cache** (`enable()`): XLA executables are
+        serialized under `<root>/<fingerprint>/xla/`, so rebuilding a
+        known plan on a fresh replica deserializes instead of
+        recompiling.  Where the backend cannot persist executables
+        the store still works — the sidecar below bounds what must be
+        rebuilt, and `supported` records the degradation.
+      * **A plan-recipe sidecar** (`plankeys.json`): every plan the
+        fleet ever built is recorded with enough to rebuild it
+        (`SearcherProvider.prewarm`), merged atomically under a lock
+        directory so concurrent replicas compose.
+
+    The fingerprint is `tune/db.py`'s device fingerprint — the same
+    cache-correctness boundary the tuning DB uses: an executable
+    serialized on one chip generation / jaxlib never warms another.
+    """
+
+    def __init__(self, root: str, fingerprint: Optional[str] = None,
+                 obs=None):
+        from presto_tpu.tune.db import (device_fingerprint,
+                                        fingerprint_key)
+        if obs is None:
+            from presto_tpu.obs import Observability, ObsConfig
+            obs = Observability(ObsConfig(enabled=True))
+        self.obs = obs
+        self.fingerprint = fingerprint or fingerprint_key(
+            device_fingerprint())
+        fp_id = hashlib.sha1(
+            self.fingerprint.encode()).hexdigest()[:16]
+        self.root = os.path.abspath(root)
+        self.dir = os.path.join(self.root, fp_id)
+        self.xla_dir = os.path.join(self.dir, "xla")
+        self.sidecar = os.path.join(self.dir, "plankeys.json")
+        from presto_tpu.pipeline.leaseledger import _LockDir
+        self._lock = _LockDir(self.sidecar + ".lock")
+        self.supported: Optional[bool] = None
+        self.enable_error: Optional[str] = None
+        reg = obs.metrics
+        self._g_warm = reg.gauge(
+            "plancache_warm_fraction",
+            "Fraction of persistently-known plans resident in the "
+            "in-memory cache")
+        self._c_prewarmed = reg.counter(
+            "plancache_prewarmed_total",
+            "Plans rebuilt from the persistent tier at replica start")
+        self._g_known = reg.gauge(
+            "plancache_store_plans",
+            "Plans recorded in the persistent tier sidecar")
+
+    # -- XLA compilation cache ----------------------------------------
+    def enable(self) -> bool:
+        """Point JAX's persistent compilation cache at this store's
+        fingerprint directory (min-size/min-time thresholds dropped so
+        every bucket executable persists).  Best-effort: a backend or
+        jax version without support degrades to sidecar-only warm-up,
+        recorded in `supported`/`enable_error`."""
+        os.makedirs(self.xla_dir, exist_ok=True)
+        try:
+            import jax
+            jax.config.update("jax_compilation_cache_dir",
+                              self.xla_dir)
+            for knob, val in (
+                    ("jax_persistent_cache_min_compile_time_secs", 0.0),
+                    ("jax_persistent_cache_min_entry_size_bytes", -1)):
+                try:
+                    jax.config.update(knob, val)
+                except Exception:
+                    pass                # older jax: keep defaults
+            self.supported = True
+        except Exception as e:
+            self.supported = False
+            self.enable_error = "%s: %s" % (type(e).__name__, e)
+            warnings.warn(
+                "persistent compilation cache unavailable (%s) — "
+                "cold replicas fall back to sidecar prewarm only"
+                % self.enable_error, RuntimeWarning, stacklevel=2)
+        return bool(self.supported)
+
+    def xla_entries(self) -> int:
+        """Serialized executables currently on disk (0 when the
+        backend never persisted any)."""
+        try:
+            return sum(1 for n in os.listdir(self.xla_dir)
+                       if not n.startswith("."))
+        except OSError:
+            return 0
+
+    # -- recipe sidecar ------------------------------------------------
+    def _load_sidecar(self) -> dict:
+        try:
+            with open(self.sidecar) as f:
+                raw = json.load(f)
+            if (isinstance(raw, dict)
+                    and raw.get("schema") == STORE_SCHEMA
+                    and isinstance(raw.get("plans"), dict)):
+                return raw["plans"]
+        except (OSError, ValueError):
+            pass
+        return {}
+
+    def known(self) -> Dict[str, dict]:
+        """{plan-key repr: rebuild recipe} recorded for this
+        fingerprint."""
+        plans = self._load_sidecar()
+        self._g_known.set(len(plans))
+        return plans
+
+    def record(self, key: PlanKey, recipe: dict) -> None:
+        """Merge one rebuild recipe into the sidecar (atomic
+        read-modify-replace under the lock, so concurrent replicas
+        compose instead of clobbering)."""
+        from presto_tpu.io.atomic import atomic_write_text
+        os.makedirs(self.dir, exist_ok=True)
+        with self._lock():
+            plans = self._load_sidecar()
+            plans[repr(key)] = dict(recipe, recorded_at=time.time())
+            atomic_write_text(self.sidecar, json.dumps(
+                {"schema": STORE_SCHEMA, "plans": plans},
+                indent=1, sort_keys=True))
+        self._g_known.set(len(plans))
+
+    # -- warm accounting ----------------------------------------------
+    @staticmethod
+    def _recipe_key(recipe: dict) -> Optional[PlanKey]:
+        if recipe.get("kind") != "accel":
+            return None
+        try:
+            from presto_tpu.search.accel import AccelConfig
+            return accel_plan_key(AccelConfig(**recipe["acfg"]),
+                                  float(recipe["T"]),
+                                  int(recipe["numbins"]))
+        except Exception:
+            return None
+
+    def warm_fraction(self, cache: PlanCache) -> float:
+        """How much of the persistently-known working set is resident
+        in `cache` — the readiness signal a router uses to keep
+        traffic off a cold replica.  An empty store is vacuously warm
+        (a brand-new fleet has nothing to wait for)."""
+        keys = [k for k in (self._recipe_key(r)
+                            for r in self.known().values())
+                if k is not None]
+        if not keys:
+            frac = 1.0
+        else:
+            frac = (sum(1 for k in keys if cache.contains(k))
+                    / float(len(keys)))
+        self._g_warm.set(frac)
+        return frac
+
+    def note_warm(self, cache: PlanCache) -> None:
+        self._c_prewarmed.inc(cache.stats()["size"])
+        self.warm_fraction(cache)

@@ -55,11 +55,15 @@ class JobStatus:
     SCHEDULED = "scheduled"
     RUNNING = "running"
     RETRY_WAIT = "retry-wait"
+    PARKED = "parked"
     DONE = "done"
     FAILED = "failed"
     TIMEOUT = "timeout"
 
     TERMINAL = (DONE, FAILED, TIMEOUT)
+    #: locally finished: terminal, or handed back to a fleet ledger
+    #: for another replica to re-admit (the shutdown-park path)
+    SETTLED = TERMINAL + (PARKED,)
 
 
 @dataclass

@@ -1,0 +1,484 @@
+"""Fleet front door: durable admission, load shedding, tenant quotas.
+
+The router is deliberately *not* a proxy holding jobs in memory — the
+single-process serve layer already showed why that loses: a crash
+forfeits every queued job.  `POST /submit` here lands the job
+directly in the shared on-disk ledger (`serve/jobledger.py`), and the
+replicas *pull* work by leasing — so "fanning submissions across
+replicas" is the lease protocol itself: a draining or cold replica
+(503 on `/readyz`) simply stops leasing and traffic flows around it
+with no routing table to go stale, and a replica crash strands
+nothing the reaper cannot re-admit.
+
+What the router adds on top of the ledger:
+
+  * **Load shedding** — when fleet depth (pending + leased) crosses
+    the high-water mark, `/submit` answers 429 with a `Retry-After`
+    header: the fleet-scale twin of the in-process queue's bounded-
+    depth backpressure (QueueFull -> 429).
+  * **Tenant quotas** — `JobLedger.admit` enforces per-tenant active-
+    job quotas; the typed `TenantQuotaExceeded` maps to a 429 whose
+    body names the tenant and quota (`error: "quota-exceeded"`), and
+    a `quota-exceeded` event is recorded — never a silent drop.
+    Weighted round-robin *fairness* between tenants is the ledger's
+    lease policy (deficit WRR over the `tenant` job field).
+  * **Fleet view** — `/fleet` aggregates the ledger (depth, epoch,
+    tenant counts) with each registered replica's `/readyz` (polled;
+    replicas register their HTTP address at ledger join), and the
+    router runs the idempotent reaper so a fleet whose every replica
+    died still re-admits leases the moment one returns.
+
+Wire protocol (stdlib HTTP + JSON, like server.py):
+
+  POST /submit            {"rawfiles": [...], "config": {...},
+                           "tenant": "...", "priority": int}
+                          -> 202 ledger job view
+                          429 shed (Retry-After) / quota-exceeded
+                          503 no ready replica registered
+  GET  /jobs/<id>         ledger job view (404 unknown)
+  GET  /jobs/<id>/result  committed result.json (409 until done)
+  GET  /fleet             topology + readiness + tenant counts
+  GET  /healthz           router liveness
+  GET  /metrics           fleet metrics (JSON; ?format=prometheus)
+  GET  /events?n=100      router event tail
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+from dataclasses import dataclass, field
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, List, Optional
+from urllib.parse import urlparse, parse_qs
+
+from presto_tpu.serve.events import EventLog
+from presto_tpu.serve.jobledger import (DEFAULT_TENANT, JobLedger,
+                                        TenantQuotaExceeded)
+from presto_tpu.serve.queue import QueueFull
+
+
+class FleetBusy(QueueFull):
+    """Fleet depth crossed the high-water mark: shed with 429 +
+    Retry-After (the ledger-scale twin of QueueFull)."""
+
+    def __init__(self, depth: int, high_water: int,
+                 retry_after_s: float):
+        self.depth = depth
+        self.high_water = high_water
+        self.retry_after_s = retry_after_s
+        super().__init__("fleet depth %d at high-water mark %d"
+                         % (depth, high_water))
+
+
+class NoReadyReplica(RuntimeError):
+    """No registered replica is currently ready (503: clients should
+    retry; jobs already admitted keep draining when one returns)."""
+
+
+@dataclass
+class RouterConfig:
+    fleetdir: str
+    high_water: int = 256          # shed point over pending+leased
+    retry_after_s: float = 2.0
+    heartbeat_timeout: float = 10.0
+    poll_s: float = 2.0            # replica /readyz poll cadence
+    require_ready: bool = True     # 503 /submit with no ready replica
+    #: "name:weight[:quota]" tenant configs applied at start
+    tenants: List[str] = field(default_factory=list)
+
+
+class FleetRouter:
+    """Admission + observation front door over one fleet directory."""
+
+    def __init__(self, cfg: RouterConfig, obs=None):
+        from presto_tpu.obs import Observability, ObsConfig
+        self.cfg = cfg
+        self.obs = obs or Observability(
+            ObsConfig(enabled=True, service="presto-router"))
+        os.makedirs(cfg.fleetdir, exist_ok=True)
+        self.ledger = JobLedger(cfg.fleetdir, obs=self.obs)
+        self.events = EventLog()
+        self._t0 = time.time()
+        self._ready: Dict[str, Optional[dict]] = {}
+        self._ready_lock = threading.Lock()
+        self._stop = threading.Event()
+        self._poll_t: Optional[threading.Thread] = None
+        for spec in cfg.tenants:
+            parts = spec.split(":")
+            self.ledger.set_tenant(
+                parts[0],
+                weight=float(parts[1]) if len(parts) > 1 else 1.0,
+                quota=int(parts[2]) if len(parts) > 2 else None)
+        reg = self.obs.metrics
+        self._c_submissions = reg.counter(
+            "fleet_submissions_total",
+            "Jobs durably admitted to the fleet ledger", ("tenant",))
+        self._c_shed = reg.counter(
+            "fleet_shed_total",
+            "Submissions shed at the high-water mark (429)")
+        self._c_quota = reg.counter(
+            "fleet_quota_rejections_total",
+            "Submissions rejected by tenant quota (typed 429)",
+            ("tenant",))
+        self._g_depth = reg.gauge(
+            "fleet_depth", "Fleet depth (pending + leased jobs)")
+        self._g_ready = reg.gauge(
+            "fleet_replicas_ready", "Replicas currently ready")
+
+    # ---- lifecycle ----------------------------------------------------
+
+    def start(self) -> "FleetRouter":
+        self._stop.clear()
+        self._poll_t = threading.Thread(
+            target=self._poll_loop, name="presto-router-poll",
+            daemon=True)
+        self._poll_t.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._poll_t is not None:
+            self._poll_t.join(timeout=10.0)
+        self.events.close()
+
+    # ---- replica health -----------------------------------------------
+
+    def _replica_addrs(self) -> Dict[str, Optional[str]]:
+        state = self.ledger.read()
+        return {host: h.get("addr")
+                for host, h in sorted(state["hosts"].items())
+                if h.get("alive", False)}
+
+    @staticmethod
+    def _get_readyz(addr: str, timeout: float = 2.0) \
+            -> Optional[dict]:
+        try:
+            with urllib.request.urlopen(addr.rstrip("/") + "/readyz",
+                                        timeout=timeout) as r:
+                return json.loads(r.read())
+        except urllib.error.HTTPError as e:
+            try:        # 503 still carries the readiness payload
+                return json.loads(e.read())
+            except Exception:
+                return None
+        except Exception:
+            return None
+
+    def poll_replicas(self) -> Dict[str, Optional[dict]]:
+        """One health sweep: /readyz every registered live replica
+        (None for unreachable ones) + the idempotent reap pass."""
+        out: Dict[str, Optional[dict]] = {}
+        for host, addr in self._replica_addrs().items():
+            out[host] = self._get_readyz(addr) if addr else None
+        with self._ready_lock:
+            self._ready = out
+        self._g_ready.set(sum(1 for r in out.values()
+                              if r and r.get("ready")))
+        self.ledger.reap(self.cfg.heartbeat_timeout)
+        self._g_depth.set(self.ledger.depth())
+        return out
+
+    def ready_replicas(self) -> List[str]:
+        with self._ready_lock:
+            return sorted(h for h, r in self._ready.items()
+                          if r and r.get("ready"))
+
+    def _poll_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self.poll_replicas()
+            except Exception:
+                self.obs.event("router-poll-error")
+            self._stop.wait(self.cfg.poll_s)
+
+    # ---- admission ----------------------------------------------------
+
+    def submit(self, spec: dict) -> dict:
+        """Durably admit one job.  Raises FleetBusy (shed),
+        TenantQuotaExceeded (typed), NoReadyReplica (503)."""
+        if not isinstance(spec, dict):
+            raise ValueError("spec must be a JSON object")
+        tenant = str(spec.get("tenant") or DEFAULT_TENANT)
+        depth = self.ledger.depth()
+        self._g_depth.set(depth)
+        if depth >= self.cfg.high_water:
+            self._c_shed.inc()
+            self.events.emit("shed", tenant=tenant, depth=depth,
+                             high_water=self.cfg.high_water)
+            raise FleetBusy(depth, self.cfg.high_water,
+                            self.cfg.retry_after_s)
+        if self.cfg.require_ready and not self.ready_replicas():
+            raise NoReadyReplica(
+                "no ready replica registered in %s"
+                % self.cfg.fleetdir)
+        try:
+            view = self.ledger.admit(
+                spec, tenant=tenant,
+                job_id=spec.get("job_id"),
+                priority=int(spec.get("priority", 10)))
+        except TenantQuotaExceeded as e:
+            self._c_quota.labels(tenant=tenant).inc()
+            self.events.emit("quota-exceeded", tenant=tenant,
+                             quota=e.quota, active=e.active)
+            raise
+        self._c_submissions.labels(tenant=tenant).inc()
+        self.events.emit("enqueue", job=view["job_id"],
+                         tenant=tenant, depth=depth + 1)
+        return view
+
+    # ---- introspection ------------------------------------------------
+
+    def status(self, job_id: str) -> Optional[dict]:
+        return self.ledger.view(job_id)
+
+    def result(self, job_id: str) -> Optional[dict]:
+        view = self.ledger.view(job_id)
+        if view is None:
+            return None
+        if view["state"] == "done":
+            path = os.path.join(self.cfg.fleetdir, "jobs", job_id,
+                                "result.json")
+            try:
+                with open(path) as f:
+                    view["result_detail"] = json.load(f)
+            except (OSError, ValueError):
+                view["result_detail"] = None
+        return view
+
+    def wait(self, job_ids, timeout: float = 300.0,
+             poll: float = 0.1) -> bool:
+        """Block until every listed job is ledger-terminal."""
+        if isinstance(job_ids, str):
+            job_ids = [job_ids]
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            views = [self.ledger.view(j) for j in job_ids]
+            if all(v is not None and v["state"] in ("done", "failed")
+                   for v in views):
+                return True
+            time.sleep(poll)
+        return False
+
+    def fleet_view(self) -> dict:
+        with self._ready_lock:
+            ready = dict(self._ready)
+        counts = self.ledger.counts()
+        return {
+            "uptime_s": round(time.time() - self._t0, 3),
+            "fleetdir": self.cfg.fleetdir,
+            "epoch": self.ledger.epoch,
+            "depth": self.ledger.depth(),
+            "high_water": self.cfg.high_water,
+            "jobs": counts,
+            "tenants": {
+                "config": self.ledger.tenants(),
+                "jobs": self.ledger.tenant_counts(),
+            },
+            "replicas": {
+                host: {"addr": addr,
+                       "ready": bool(ready.get(host)
+                                     and ready[host].get("ready")),
+                       "readyz": ready.get(host)}
+                for host, addr in self._replica_addrs().items()
+            },
+        }
+
+    def metrics(self) -> dict:
+        return {
+            "uptime_s": round(time.time() - self._t0, 3),
+            "depth": self.ledger.depth(),
+            "high_water": self.cfg.high_water,
+            "ready_replicas": len(self.ready_replicas()),
+            "shed": int(self._c_shed.value),
+            "quota_rejections": int(self._c_quota.total()),
+            "submissions": int(self._c_submissions.total()),
+            "jobs": self.ledger.counts(),
+            "events": self.events.counts(),
+        }
+
+
+# ----------------------------------------------------------------------
+# HTTP front end
+# ----------------------------------------------------------------------
+
+class _RouterHandler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+
+    @property
+    def router(self) -> FleetRouter:
+        return self.server.router      # type: ignore[attr-defined]
+
+    def log_message(self, fmt, *args):
+        self.router.events.emit("http", line=fmt % args)
+
+    def _json(self, code: int, payload: dict,
+              headers: Optional[dict] = None) -> None:
+        body = json.dumps(payload, sort_keys=True).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        for k, v in (headers or {}).items():
+            self.send_header(k, v)
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self) -> None:
+        url = urlparse(self.path)
+        parts = [p for p in url.path.split("/") if p]
+        try:
+            if url.path == "/healthz":
+                self._json(200, {"ok": True, "role": "router"})
+            elif url.path == "/fleet":
+                self._json(200, self.router.fleet_view())
+            elif url.path == "/metrics":
+                fmt = parse_qs(url.query).get("format", [""])[0]
+                accept = self.headers.get("Accept", "") or ""
+                if fmt in ("prometheus", "text") \
+                        or "text/plain" in accept:
+                    reg = self.router.obs.metrics
+                    body = reg.render_prometheus().encode()
+                    self.send_response(200)
+                    self.send_header(
+                        "Content-Type",
+                        "text/plain; version=0.0.4")
+                    self.send_header("Content-Length",
+                                     str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                else:
+                    self._json(200, self.router.metrics())
+            elif url.path == "/events":
+                n = int(parse_qs(url.query).get("n", ["100"])[0])
+                self._json(200,
+                           {"events": self.router.events.tail(n)})
+            elif len(parts) == 2 and parts[0] == "jobs":
+                view = self.router.status(parts[1])
+                if view is None:
+                    self._json(404, {"error": "no such job"})
+                else:
+                    self._json(200, view)
+            elif (len(parts) == 3 and parts[0] == "jobs"
+                  and parts[2] == "result"):
+                view = self.router.result(parts[1])
+                if view is None:
+                    self._json(404, {"error": "no such job"})
+                elif view["state"] not in ("done", "failed"):
+                    self._json(409, {"error": "job not finished",
+                                     "state": view["state"]})
+                else:
+                    self._json(200, view)
+            else:
+                self._json(404, {"error": "unknown endpoint"})
+        except Exception as e:
+            self._json(500, {"error": "%s: %s"
+                             % (type(e).__name__, e)})
+
+    def do_POST(self) -> None:
+        if urlparse(self.path).path != "/submit":
+            self._json(404, {"error": "unknown endpoint"})
+            return
+        try:
+            length = int(self.headers.get("Content-Length", "0"))
+            spec = json.loads(self.rfile.read(length) or b"{}")
+            self._json(202, self.router.submit(spec))
+        except FleetBusy as e:
+            self._json(429, {"error": "shed", "detail": str(e),
+                             "retry_after_s": e.retry_after_s},
+                       headers={"Retry-After":
+                                "%d" % max(1, int(e.retry_after_s))})
+        except TenantQuotaExceeded as e:
+            self._json(429, {"error": "quota-exceeded",
+                             "tenant": e.tenant, "quota": e.quota,
+                             "active": e.active},
+                       headers={"Retry-After": "1"})
+        except NoReadyReplica as e:
+            self._json(503, {"error": "no-ready-replica",
+                             "detail": str(e)})
+        except ValueError as e:
+            self._json(400, {"error": str(e)})
+        except Exception as e:
+            self._json(500, {"error": "%s: %s"
+                             % (type(e).__name__, e)})
+
+
+class RouterHTTPServer(ThreadingHTTPServer):
+    daemon_threads = True
+    allow_reuse_address = True
+
+    def __init__(self, addr, router: FleetRouter):
+        super().__init__(addr, _RouterHandler)
+        self.router = router
+
+
+def start_http(router: FleetRouter, host: str = "127.0.0.1",
+               port: int = 0) -> RouterHTTPServer:
+    httpd = RouterHTTPServer((host, port), router)
+    t = threading.Thread(target=httpd.serve_forever,
+                         name="presto-router-http", daemon=True)
+    t.start()
+    return httpd
+
+
+# ----------------------------------------------------------------------
+# CLI: presto-router
+# ----------------------------------------------------------------------
+
+def build_parser():
+    p = argparse.ArgumentParser(prog="presto-router")
+    p.add_argument("-host", type=str, default="127.0.0.1")
+    p.add_argument("-port", type=int, default=8786)
+    p.add_argument("-fleetdir", type=str, required=True,
+                   help="Shared fleet directory (the job ledger)")
+    p.add_argument("-high-water", type=int, default=256,
+                   help="Shed submissions (429 + Retry-After) once "
+                        "pending+leased jobs reach this depth")
+    p.add_argument("-retry-after", type=float, default=2.0)
+    p.add_argument("-hb-timeout", type=float, default=10.0,
+                   help="Replica heartbeat TTL for the reap pass")
+    p.add_argument("-poll", type=float, default=2.0,
+                   help="Replica /readyz poll cadence, seconds")
+    p.add_argument("-tenant", action="append", default=[],
+                   metavar="NAME:WEIGHT[:QUOTA]",
+                   help="Tenant WRR weight and optional active-job "
+                        "quota (repeatable)")
+    p.add_argument("-allow-empty", action="store_true",
+                   help="Admit submissions even with no ready "
+                        "replica (they queue in the ledger)")
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    cfg = RouterConfig(fleetdir=args.fleetdir,
+                       high_water=args.high_water,
+                       retry_after_s=args.retry_after,
+                       heartbeat_timeout=args.hb_timeout,
+                       poll_s=args.poll,
+                       require_ready=not args.allow_empty,
+                       tenants=args.tenant)
+    router = FleetRouter(cfg).start()
+    httpd = start_http(router, args.host, args.port)
+    host, port = httpd.server_address[:2]
+    print("presto-router: fleet %s on http://%s:%d "
+          "(POST /submit, GET /jobs/<id>, /fleet, /metrics)"
+          % (args.fleetdir, host, port))
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        print("presto-router: shutting down")
+    finally:
+        httpd.shutdown()
+        router.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -79,7 +79,7 @@ class Scheduler:
     def __init__(self, queue: JobQueue, executor: Callable,
                  cfg: Optional[SchedulerConfig] = None, events=None,
                  latency=None, batch_executor: Optional[Callable] = None,
-                 obs=None, plans=None):
+                 obs=None, plans=None, park: Optional[Callable] = None):
         if obs is None:
             from presto_tpu.obs import Observability, ObsConfig
             obs = Observability(ObsConfig(enabled=True))
@@ -91,6 +91,11 @@ class Scheduler:
         self.latency = latency
         self.obs = obs
         self.plans = plans          # PlanCache, for device-error evict
+        # fleet seam: park(job) -> bool re-admits a retrying job into
+        # the shared job ledger when the local queue is closed
+        # (shutdown), so a scheduler retry during drain is handed to
+        # another replica instead of stranded as a local failure
+        self.park = park
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
         self._retry_heap: list = []
@@ -121,6 +126,10 @@ class Scheduler:
         self._c_lanes = reg.counter(
             "serve_lane_batches_total",
             "Micro-batches executed per scheduler lane", ("lane",))
+        self._c_parked = reg.counter(
+            "serve_jobs_parked_total",
+            "Retrying jobs parked back into the fleet ledger at "
+            "shutdown")
         self._g_retrywait = reg.gauge(
             "serve_retry_waiting", "Jobs on the retry backoff shelf")
 
@@ -144,6 +153,7 @@ class Scheduler:
         self._stop.set()
         if self._thread is not None:
             self._thread.join(timeout)
+        self._settle_retry_shelf()
         if self._pool is not None:
             self._pool.shutdown(wait=False)
             self._pool = None
@@ -202,9 +212,8 @@ class Scheduler:
             try:
                 self.queue.requeue(job)
             except QueueClosed:
-                job.status = JobStatus.FAILED
-                job.error = "queue closed during retry wait"
-                job.finished = time.time()
+                self._park_or_fail(job, "queue closed during "
+                                        "retry wait")
             except RetryBudgetExceeded as e:
                 # poisoned job: terminate with the LAST execution
                 # error preserved (the budget note rides along), and
@@ -218,6 +227,48 @@ class Scheduler:
                                      attempts=job.attempts,
                                      error=job.error, timeout=False,
                                      retry_depth_exceeded=True)
+
+    # ---- shutdown parking ---------------------------------------------
+
+    def _park_or_fail(self, job: Job, why: str) -> None:
+        """A retry that can no longer re-enter the local queue
+        (shutdown): hand it back to the fleet ledger when a park seam
+        is wired (another replica re-admits it — the requeueable
+        contract), else surface the old terminal failure rather than
+        strand it silently in retry-wait."""
+        if self.park is not None:
+            try:
+                parked = bool(self.park(job))
+            except Exception:
+                parked = False
+            if parked:
+                job.status = JobStatus.PARKED
+                job.finished = time.time()
+                self._c_parked.inc()
+                if self.events is not None:
+                    self.events.emit("park", job=job.job_id,
+                                     attempts=job.attempts, why=why)
+                return
+        job.status = JobStatus.FAILED
+        job.error = job.error or why
+        job.finished = time.time()
+        self._c_failed.inc()
+        if self.events is not None:
+            self.events.emit("fail", job=job.job_id,
+                             attempts=job.attempts, error=why,
+                             timeout=False)
+
+    def _settle_retry_shelf(self) -> None:
+        """Drain the backoff shelf at shutdown: every job still
+        waiting out a retry delay is parked (fleet) or terminally
+        failed (standalone) — never left in retry-wait forever."""
+        with self._retry_lock:
+            shelf = [job for _, _, job in self._retry_heap]
+            self._retry_heap = []
+            self._g_retrywait.set(0)
+        for job in shelf:
+            self._park_or_fail(job, "scheduler stopped during "
+                                    "retry wait")
 
     # ---- batch execution ----------------------------------------------
 

@@ -17,6 +17,9 @@ does the device work):
   GET  /jobs/<id>         job status snapshot
   GET  /jobs/<id>/result  terminal result payload (409 until terminal)
   GET  /healthz           liveness: queue + scheduler state
+  GET  /readyz            readiness: draining / plan-cache warm
+                          fraction / fleet lease state (503 while a
+                          router should route around this replica)
   GET  /metrics           queue/scheduler/plan-cache/latency snapshot
   GET  /events?n=100      tail of the structured event log
 
@@ -36,8 +39,8 @@ from typing import Dict, Optional
 from urllib.parse import urlparse, parse_qs
 
 from presto_tpu.serve.events import EventLog
-from presto_tpu.serve.plancache import (PlanCache, SearcherProvider,
-                                        bucket_key)
+from presto_tpu.serve.plancache import (PlanCache, PlanStore,
+                                        SearcherProvider, bucket_key)
 from presto_tpu.serve.queue import (Job, JobQueue, JobStatus,
                                     QueueClosed, QueueFull)
 from presto_tpu.serve.scheduler import Scheduler, SchedulerConfig
@@ -68,7 +71,8 @@ class SearchService:
                  scheduler_cfg: Optional[SchedulerConfig] = None,
                  events_path: Optional[str] = None, mesh=None,
                  max_retry_depth: Optional[int] = 8, obs=None,
-                 obs_config=None, heartbeat_s: float = 0.0):
+                 obs_config=None, heartbeat_s: float = 0.0,
+                 plan_store_dir: Optional[str] = None):
         from presto_tpu.obs import Observability, ObsConfig
         os.makedirs(workroot, exist_ok=True)
         self.workroot = os.path.abspath(workroot)
@@ -86,7 +90,16 @@ class SearchService:
                               max_retry_depth=max_retry_depth)
         self.plans = PlanCache(capacity=plan_capacity,
                                events=self.events, obs=self.obs)
-        self.provider = SearcherProvider(self.plans, mesh=mesh)
+        # persistent compiled-plan tier: with a store dir configured,
+        # JAX's compilation cache persists executables under the
+        # device fingerprint and every plan built is recorded for
+        # cold-replica prewarm (docs/SERVING.md, warm-start)
+        self.plan_store: Optional[PlanStore] = None
+        if plan_store_dir:
+            self.plan_store = PlanStore(plan_store_dir, obs=self.obs)
+            self.plan_store.enable()
+        self.provider = SearcherProvider(self.plans, mesh=mesh,
+                                         store=self.plan_store)
         self.scheduler = Scheduler(self.queue, self._execute_job,
                                    cfg=scheduler_cfg,
                                    events=self.events,
@@ -96,6 +109,10 @@ class SearchService:
         self._jobs_lock = threading.Lock()
         self._ids = itertools.count(1)
         self._t0 = time.time()
+        self.draining = False
+        #: set by serve/fleet.FleetReplica when this service is a
+        #: fleet member (readiness then reports the lease state)
+        self.fleet = None
 
     # ---- lifecycle ----------------------------------------------------
 
@@ -110,18 +127,52 @@ class SearchService:
         self.obs.flush()
         self.obs.tracer.close()
 
+    def shutdown(self, drain: bool = True,
+                 timeout: float = 60.0) -> dict:
+        """Graceful termination (the SIGTERM path): flip readiness off,
+        drain in-flight and queued jobs, hand the fleet leases back
+        (drained jobs commit; undrained ones are released for another
+        replica), then stop.  Returns a small shutdown report."""
+        self.draining = True
+        report = {"drained": True, "parked": 0, "released": 0}
+        if self.fleet is not None:
+            # fleet drain owns the full sequence: stop leasing, wait
+            # out in-flight work, release/park leftovers, tombstone
+            report.update(self.fleet.drain(timeout=timeout))
+        elif drain:
+            report["drained"] = self.scheduler.drain(timeout=timeout)
+        self.stop()
+        return report
+
+    # ---- plan warm-up --------------------------------------------------
+
+    def prewarm(self, limit: Optional[int] = None) -> int:
+        """Rebuild the persistent tier's recorded plans into the
+        in-memory cache (no-op without a plan store)."""
+        return self.provider.prewarm(limit=limit)
+
+    def warm_fraction(self) -> float:
+        """Persistently-known plans resident in memory (1.0 without a
+        store: nothing to wait for)."""
+        if self.plan_store is None:
+            return 1.0
+        return self.plan_store.warm_fraction(self.plans)
+
     # ---- job admission ------------------------------------------------
 
-    def submit(self, spec: dict) -> dict:
-        """Admit one search job.  spec:
+    def build_job(self, spec: dict, job_id: Optional[str] = None,
+                  workdir: Optional[str] = None) -> Job:
+        """Validate one submission spec into a Job (not yet queued).
+        spec:
 
           rawfiles  [str, ...]  (required; must exist)
           config    {SurveyConfig field: value}   (optional)
           priority  int (optional; lower runs first)
           job_id    str (optional; must be unique)
 
-        Raises BadRequest on malformed specs, QueueFull under
-        backpressure.  Returns the job's status view."""
+        Raises BadRequest on malformed specs.  `job_id`/`workdir`
+        override the spec (the fleet replica pins both to the ledger
+        job id and its epoch-stamped attempt directory)."""
         from presto_tpu.pipeline.survey import SurveyConfig
         if not isinstance(spec, dict):
             raise BadRequest("spec must be a JSON object")
@@ -149,25 +200,41 @@ class SearchService:
             # tier by the scheduler (resume-critical); clients can pin
             # either tier via config.durable_stages.
             cfg.durable_stages = False
-        job_id = str(spec.get("job_id") or "job-%06d" % next(self._ids))
+        job_id = str(job_id or spec.get("job_id")
+                     or "job-%06d" % next(self._ids))
         with self._jobs_lock:
-            if job_id in self._jobs:
+            old = self._jobs.get(job_id)
+            if old is not None and old.status not in JobStatus.SETTLED:
                 raise BadRequest("duplicate job_id %r" % job_id)
         try:
             bucket = bucket_key(rawfiles, cfg)
         except Exception as e:
             raise BadRequest("unreadable observation header: %s" % e)
-        job = Job(job_id=job_id, rawfiles=rawfiles, cfg=cfg,
-                  workdir=os.path.join(self.workroot, job_id),
-                  priority=int(spec.get("priority", 10)),
-                  bucket=bucket, spec=dict(spec))
-        self.queue.submit(job)          # may raise QueueFull
+        return Job(job_id=job_id, rawfiles=rawfiles, cfg=cfg,
+                   workdir=workdir or os.path.join(self.workroot,
+                                                   job_id),
+                   priority=int(spec.get("priority", 10)),
+                   bucket=bucket, spec=dict(spec))
+
+    def enqueue_job(self, job: Job) -> dict:
+        """Admit a built Job into the local queue (may raise
+        QueueFull / QueueClosed) and register it for /jobs lookup."""
+        self.queue.submit(job)
         with self._jobs_lock:
-            self._jobs[job_id] = job
-        self.events.emit("enqueue", job=job_id,
-                         bucket=repr(bucket), priority=job.priority,
+            self._jobs[job.job_id] = job
+        self.events.emit("enqueue", job=job.job_id,
+                         bucket=repr(job.bucket),
+                         priority=job.priority,
                          depth=len(self.queue))
         return job.view()
+
+    def submit(self, spec: dict) -> dict:
+        """Admit one search job (build + enqueue).  Raises BadRequest
+        on malformed specs, QueueFull under backpressure.  Returns
+        the job's status view."""
+        if self.draining:
+            raise QueueClosed("service is draining")
+        return self.enqueue_job(self.build_job(spec))
 
     def submit_callable(self, fn, job_id: Optional[str] = None,
                         lane: str = "deadline", priority: int = 0,
@@ -245,12 +312,42 @@ class SearchService:
         return False
 
     def healthz(self) -> dict:
+        """Liveness: is the process worth keeping alive?  True while
+        the scheduler loop runs — even when draining or cold (those
+        are *readiness* conditions; restarting a draining replica
+        would lose the drain)."""
         return {
             "ok": bool(self.scheduler.alive),
             "uptime_s": round(time.time() - self._t0, 3),
             "queue_depth": len(self.queue),
             "scheduler_alive": self.scheduler.alive,
         }
+
+    def readyz(self) -> dict:
+        """Readiness: should a router send this replica work?  False
+        while draining (shutdown in progress), dead, or cold (the
+        persistent plan tier knows plans this process has not warmed
+        yet) — the router keeps routing *around* it without killing
+        it.  Reports the fleet lease state, plan-cache warm fraction,
+        and queue depth so the router's decision is observable."""
+        warm = self.warm_fraction()
+        ready = bool(self.scheduler.alive) and not self.draining
+        out = {
+            "ready": ready,
+            "draining": bool(self.draining),
+            "scheduler_alive": bool(self.scheduler.alive),
+            "plan_warm_fraction": round(warm, 4),
+            "plan_store": (None if self.plan_store is None else {
+                "supported": self.plan_store.supported,
+                "known_plans": len(self.plan_store.known()),
+                "xla_entries": self.plan_store.xla_entries(),
+            }),
+            "queue_depth": len(self.queue),
+            "queue_capacity": self.queue.maxdepth,
+            "lease": (None if self.fleet is None
+                      else self.fleet.lease_state()),
+        }
+        return out
 
     def metrics(self) -> dict:
         """The pre-obs JSON metrics shape, unchanged for backward
@@ -290,8 +387,8 @@ class SearchService:
                 by_status[job.status] = by_status.get(job.status, 0) + 1
         from presto_tpu.serve.queue import JobStatus as _JS
         for status in (_JS.QUEUED, _JS.SCHEDULED, _JS.RUNNING,
-                       _JS.RETRY_WAIT, _JS.DONE, _JS.FAILED,
-                       _JS.TIMEOUT):
+                       _JS.RETRY_WAIT, _JS.PARKED, _JS.DONE,
+                       _JS.FAILED, _JS.TIMEOUT):
             jobs_g.labels(status=status).set(by_status.get(status, 0))
         return reg.render_prometheus()
 
@@ -348,6 +445,9 @@ class _Handler(BaseHTTPRequestHandler):
             if url.path == "/healthz":
                 h = self.service.healthz()
                 self._json(200 if h["ok"] else 503, h)
+            elif url.path == "/readyz":
+                r = self.service.readyz()
+                self._json(200 if r["ready"] else 503, r)
             elif url.path == "/metrics":
                 if self._wants_prometheus(url):
                     self._text(200, self.service.metrics_prometheus())

@@ -1,0 +1,588 @@
+"""Fleet-scale serving (ISSUE 9): job-ledger lease/fence/redo
+semantics, tenant WRR fairness + quotas, the replica pump
+(lease -> execute -> fence-checked commit), kill-one-replica chaos
+with exactly-once completion, router shedding, graceful drain with
+tombstones, the scheduler's shutdown-park seam, and cold-replica
+warm-start from the persistent plan tier.
+
+Protocol-level chaos runs against a stub executor (deterministic
+artifact bytes, no device work) so the ledger mechanics are pinned
+fast; ONE real-survey kill-one trial proves the end-to-end
+byte-equality claim.  The randomized multi-trial driver is
+tools/fleet_chaos.py (FLEET_CHAOS.json committed).
+"""
+
+import hashlib
+import json
+import os
+import time
+
+import pytest
+
+from presto_tpu.pipeline.leaseledger import DONE, FAILED, PENDING
+from presto_tpu.serve.fleet import (FleetConfig, FleetReplica,
+                                    artifact_digests)
+from presto_tpu.serve.jobledger import (JobLedger, JobLedgerError,
+                                        StaleResultError,
+                                        TenantQuotaExceeded)
+from presto_tpu.serve.queue import JobStatus
+from presto_tpu.serve.router import (FleetBusy, FleetRouter,
+                                     NoReadyReplica, RouterConfig)
+from presto_tpu.serve.router import start_http as start_router_http
+from presto_tpu.serve.server import SearchService
+
+
+# ----------------------------------------------------------------------
+# shared fixtures / helpers
+# ----------------------------------------------------------------------
+
+TINY_CFG = {"lodm": 50.0, "hidm": 56.0, "nsub": 8, "zmax": 0,
+            "numharm": 2, "fold_top": 0, "singlepulse": False,
+            "skip_rfifind": True, "durable_stages": True}
+
+
+@pytest.fixture(scope="module")
+def tiny_beam(tmp_path_factory):
+    from tools.serve_loadgen import make_beams
+    d = tmp_path_factory.mktemp("beams")
+    return make_beams(str(d), 1, nsamp=4096, nchan=8)[0]
+
+
+def _spec(beam, **extra):
+    spec = {"rawfiles": [beam], "config": dict(TINY_CFG)}
+    spec.update(extra)
+    return spec
+
+
+class StubService(SearchService):
+    """SearchService whose executor writes deterministic artifact
+    bytes instead of running a survey — the ledger protocol tests'
+    fast path (bytes depend only on the spec's `seed`)."""
+
+    def _execute_job(self, job):
+        os.makedirs(job.workdir, exist_ok=True)
+        delay = float(job.spec.get("sleep_s", 0.0))
+        if delay:
+            time.sleep(delay)
+        with open(os.path.join(job.workdir, "stub.dat"), "wb") as f:
+            f.write(stub_bytes(job.spec.get("seed", 0)))
+        return {"ok": True, "seed": job.spec.get("seed", 0)}
+
+
+def stub_bytes(seed) -> bytes:
+    return hashlib.sha256(("stub-%s" % seed).encode()).digest() * 64
+
+
+def _stub_fleet(tmp_path, name, fleetdir, tiny_beam=None, **fkw):
+    svc = StubService(str(tmp_path / ("w-" + name)),
+                      queue_depth=8).start()
+    cfg = FleetConfig(fleetdir=str(fleetdir), replica=name,
+                      lease_ttl=20.0, heartbeat_s=0.1,
+                      heartbeat_timeout=0.6, poll_s=0.05,
+                      max_inflight=1, prewarm=False)
+    for k, v in fkw.items():
+        setattr(cfg, k, v)
+    return svc, FleetReplica(svc, cfg)
+
+
+def _wait(cond, timeout=20.0, poll=0.02):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if cond():
+            return True
+        time.sleep(poll)
+    return False
+
+
+# ----------------------------------------------------------------------
+# job ledger unit tests
+# ----------------------------------------------------------------------
+
+def test_jobledger_admit_lease_complete_roundtrip(tmp_path):
+    led = JobLedger(str(tmp_path))
+    led.join("r1")
+    v1 = led.admit({"rawfiles": ["x.fil"]}, tenant="a")
+    v2 = led.admit({"rawfiles": ["y.fil"]}, tenant="a", priority=1)
+    assert v1["job_id"] == "fjob-000001" and v1["state"] == PENDING
+    assert led.depth() == 2
+    # priority orders within the tenant
+    lease = led.lease("r1", ttl=30.0)
+    assert lease.item_id == v2["job_id"]
+    assert lease.data["spec"] == {"rawfiles": ["y.fil"]}
+    staged = str(tmp_path / "stage-result")
+    with open(staged, "w") as f:
+        f.write("{}")
+    final = str(tmp_path / "jobs" / lease.item_id / "result.json")
+    os.makedirs(os.path.dirname(final), exist_ok=True)
+    arts = led.complete(lease, "r1", {final: staged},
+                        extra={"result": {"n": 1}})
+    assert os.path.exists(final) and not os.path.exists(staged)
+    view = led.view(lease.item_id)
+    assert view["state"] == DONE and view["result"] == {"n": 1}
+    assert list(arts) == [os.path.relpath(final, str(tmp_path))]
+    # duplicate explicit ids are rejected
+    with pytest.raises(JobLedgerError):
+        led.admit({}, job_id=v1["job_id"])
+
+
+def test_jobledger_zombie_commit_fenced(tmp_path):
+    """The tentpole invariant: a reaped replica's late result NEVER
+    lands — fence-check-before-commit, staged file deleted, journaled
+    result untouched."""
+    led = JobLedger(str(tmp_path))
+    led.join("a", now=0.0)
+    led.join("b", now=0.0)
+    led.admit({"rawfiles": ["x.fil"]})
+    lease_a = led.lease("a", ttl=30.0, now=0.0)
+    led.heartbeat("b", 0, now=100.0)       # only b still beating
+    report = led.reap(heartbeat_ttl=10.0, now=100.0)
+    assert report.dead_hosts == ["a"] and report.bumped
+    assert led.view(lease_a.item_id)["state"] == PENDING
+    assert led.view(lease_a.item_id)["redos"] == 1
+    # survivor recomputes and commits
+    lease_b = led.lease("b", ttl=30.0, now=100.0)
+    final = str(tmp_path / "result.json")
+    good = str(tmp_path / "stage-b")
+    with open(good, "w") as f:
+        f.write('{"winner": "b"}')
+    led.complete(lease_b, "b", {final: good})
+    # zombie a wakes up and tries to land its stale result
+    late = str(tmp_path / "stage-a")
+    with open(late, "w") as f:
+        f.write('{"winner": "zombie"}')
+    with pytest.raises(StaleResultError):
+        led.complete(lease_a, "a", {final: late})
+    assert not os.path.exists(late)         # staged file discarded
+    assert json.load(open(final)) == {"winner": "b"}
+    # and the zombie's terminal verdict is fenced identically
+    with pytest.raises(StaleResultError):
+        led.fail_terminal(lease_a, "a", "zombie verdict")
+    assert led.view(lease_a.item_id)["state"] == DONE
+
+
+def test_jobledger_tombstone_reaps_without_ttl_wait(tmp_path):
+    led = JobLedger(str(tmp_path))
+    led.join("a", now=0.0)
+    led.admit({})
+    led.lease("a", ttl=1000.0, now=0.0)
+    led.heartbeat("a", 0, now=1.0)
+    led.tombstone("a", now=1.1)
+    # ttl nowhere near expired, heartbeat fresh — tombstone alone
+    # marks the host dead and re-admits its lease
+    report = led.reap(heartbeat_ttl=1000.0, now=1.2)
+    assert report.dead_hosts == ["a"]
+    assert led.counts()[PENDING] == 1
+    # rejoining clears the tombstone
+    led.join("a", now=2.0)
+    assert led.alive_hosts(now=2.1, ttl=10.0) == ["a"]
+
+
+def test_jobledger_tenant_wrr_and_quota(tmp_path):
+    led = JobLedger(str(tmp_path))
+    led.set_tenant("a", weight=2.0)
+    led.set_tenant("b", weight=1.0)
+    for i in range(3):
+        led.admit({"i": i}, tenant="a", job_id="a%d" % i)
+        led.admit({"i": i}, tenant="b", job_id="b%d" % i)
+    order = []
+    while True:
+        lease = led.lease("r", ttl=30.0)
+        if lease is None:
+            break
+        order.append(lease.data["tenant"])
+    # deficit WRR at weight 2:1 serves a twice as often while both
+    # tenants have pending work, then drains the rest
+    assert order[:4] == ["a", "b", "a", "a"]
+    assert sorted(order) == ["a", "a", "a", "b", "b", "b"]
+    # quotas: typed rejection over active (pending+leased) jobs
+    led2 = JobLedger(str(tmp_path / "q"))
+    led2.set_tenant("c", quota=2)
+    led2.admit({}, tenant="c")
+    led2.admit({}, tenant="c")
+    with pytest.raises(TenantQuotaExceeded) as ei:
+        led2.admit({}, tenant="c")
+    assert ei.value.tenant == "c" and ei.value.quota == 2
+    assert ei.value.active == 2
+    # other tenants are unaffected
+    led2.admit({}, tenant="d")
+
+
+# ----------------------------------------------------------------------
+# replica pump (stub executor)
+# ----------------------------------------------------------------------
+
+def test_fleet_replica_executes_ledger_jobs(tmp_path, tiny_beam):
+    fleetdir = tmp_path / "fleet"
+    svc, rep = _stub_fleet(tmp_path, "r1", fleetdir)
+    led = JobLedger(str(fleetdir))
+    try:
+        views = [led.admit(_spec(tiny_beam, seed=i))
+                 for i in range(3)]
+        rep.start()
+        assert _wait(led.all_terminal, timeout=30.0)
+        for i, v in enumerate(views):
+            out = led.view(v["job_id"])
+            assert out["state"] == DONE and out["owner"] == "r1"
+            detail = json.load(open(os.path.join(
+                str(fleetdir), "jobs", v["job_id"], "result.json")))
+            assert detail["result"]["seed"] == i
+            digest = detail["artifacts"]["stub.dat"]["sha256"]
+            assert digest == hashlib.sha256(
+                stub_bytes(i)).hexdigest()
+        reg = svc.obs.metrics
+        assert reg.get("fleet_jobs_leased_total").value == 3
+        assert reg.get("fleet_jobs_committed_total").value == 3
+        assert reg.get("fleet_stale_results_total").value == 0
+    finally:
+        rep.stop()
+        svc.stop()
+
+
+def test_fleet_kill_one_replica_exactly_once(tmp_path, tiny_beam):
+    """Protocol chaos: kill replica A right after it leases; B reaps,
+    re-admits, and completes everything exactly once with bytes equal
+    to what a never-failed run writes."""
+    fleetdir = tmp_path / "fleet"
+    led = JobLedger(str(fleetdir))
+    for i in range(3):
+        led.admit(_spec(tiny_beam, seed=i))
+    svc_a, rep_a = _stub_fleet(tmp_path, "a", fleetdir)
+    rep_a.kill_on = "job-leased"
+    svc_b, rep_b = _stub_fleet(tmp_path, "b", fleetdir)
+    try:
+        rep_a.start()
+        assert _wait(lambda: svc_a.obs.metrics.get(
+            "fleet_jobs_leased_total").value >= 1)
+        assert rep_a._killed                    # died holding a lease
+        stranded = [j for j, v in led.read()["jobs"].items()
+                    if v["owner"] == "a"]
+        assert len(stranded) == 1
+        rep_b.start()
+        assert _wait(led.all_terminal, timeout=30.0)
+        state = led.read()
+        for jid, row in state["jobs"].items():
+            assert row["state"] == DONE
+            assert row["owner"] == "b"          # survivor did them all
+            detail = json.load(open(os.path.join(
+                str(fleetdir), "jobs", jid, "result.json")))
+            seed = detail["result"]["seed"]
+            assert detail["artifacts"]["stub.dat"]["sha256"] == \
+                hashlib.sha256(stub_bytes(seed)).hexdigest()
+        # the stranded job was re-admitted exactly once
+        assert state["jobs"][stranded[0]]["redos"] == 1
+        assert int(state["epoch"]) >= 1         # membership change
+        # exactly-once commit accounting
+        assert svc_b.obs.metrics.get(
+            "fleet_jobs_committed_total").value == 3
+    finally:
+        rep_a.stop()
+        rep_b.stop()
+        svc_a.stop()
+        svc_b.stop()
+
+
+def test_fleet_graceful_drain_commits_and_tombstones(tmp_path,
+                                                     tiny_beam):
+    fleetdir = tmp_path / "fleet"
+    led = JobLedger(str(fleetdir))
+    led.admit(_spec(tiny_beam, seed=7, sleep_s=0.3))
+    svc, rep = _stub_fleet(tmp_path, "r1", fleetdir)
+    try:
+        rep.start()
+        assert _wait(lambda: len(rep._inflight) == 1)
+        report = svc.shutdown(drain=True, timeout=20.0)
+        assert report["drained"] is True
+        # the in-flight job finished and committed during the drain
+        assert led.view("fjob-000001")["state"] == DONE
+        # tombstone: a later reap needs no TTL wait to declare death
+        rec = json.load(open(led.heartbeat_path("r1")))
+        assert rec.get("tombstone") is True
+        report2 = led.reap(heartbeat_ttl=1e9)
+        assert "r1" in report2.dead_hosts
+        kinds = [e["kind"] for e in svc.events.tail(200)]
+        assert "fleet-drain" in kinds and "fleet-tombstone" in kinds
+    finally:
+        svc.queue.close()
+        svc.scheduler.stop(timeout=1.0)
+
+
+def test_scheduler_park_on_closed_queue():
+    """ISSUE 9 satellite: a retry admitted during shutdown parks as
+    requeueable instead of raising QueueClosed and stranding."""
+    from presto_tpu.serve.events import EventLog
+    from presto_tpu.serve.queue import Job, JobQueue
+    from presto_tpu.serve.scheduler import Scheduler, SchedulerConfig
+    parked = []
+    q = JobQueue(maxdepth=8)
+    events = EventLog()
+    cfg = SchedulerConfig(max_batch=1, poll_s=0.005, max_retries=3,
+                          backoff_base_s=30.0)   # park before due
+    sched = Scheduler(q, lambda j: (_ for _ in ()).throw(
+        RuntimeError("flaky")), cfg=cfg, events=events,
+        park=lambda j: parked.append(j.job_id) or True)
+    job = Job(job_id="j1", rawfiles=[], cfg=None, workdir="/tmp/j1")
+    q.submit(job)
+    sched.start()
+    try:
+        assert _wait(lambda: job.status == JobStatus.RETRY_WAIT)
+    finally:
+        q.close()
+        sched.stop()
+    assert parked == ["j1"]
+    assert job.status == JobStatus.PARKED
+    assert any(e["kind"] == "park" for e in events.tail(50))
+    assert sched.obs.metrics.get(
+        "serve_jobs_parked_total").value == 1
+
+
+def test_scheduler_settles_shelf_without_park_seam():
+    """Standalone services (no fleet) keep the old contract: the
+    shelf drains to a terminal failure, never a silent strand."""
+    from presto_tpu.serve.queue import Job, JobQueue
+    from presto_tpu.serve.scheduler import Scheduler, SchedulerConfig
+    q = JobQueue(maxdepth=8)
+    cfg = SchedulerConfig(max_batch=1, poll_s=0.005, max_retries=3,
+                          backoff_base_s=30.0)
+    sched = Scheduler(q, lambda j: (_ for _ in ()).throw(
+        RuntimeError("flaky")), cfg=cfg)
+    job = Job(job_id="j1", rawfiles=[], cfg=None, workdir="/tmp/j1")
+    q.submit(job)
+    sched.start()
+    try:
+        assert _wait(lambda: job.status == JobStatus.RETRY_WAIT)
+    finally:
+        q.close()
+        sched.stop()
+    assert job.status == JobStatus.FAILED
+
+
+# ----------------------------------------------------------------------
+# readiness split
+# ----------------------------------------------------------------------
+
+def test_readyz_liveness_vs_readiness(tmp_path):
+    import urllib.error
+    import urllib.request
+    from presto_tpu.serve.server import start_http
+    svc = StubService(str(tmp_path / "w")).start()
+    httpd = start_http(svc)
+    host, port = httpd.server_address[:2]
+    base = "http://%s:%d" % (host, port)
+    try:
+        r = json.loads(urllib.request.urlopen(
+            base + "/readyz", timeout=10).read())
+        assert r["ready"] is True and r["draining"] is False
+        assert r["plan_warm_fraction"] == 1.0    # no store: warm
+        assert r["lease"] is None
+        assert "queue_depth" in r and "queue_capacity" in r
+        svc.draining = True
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(base + "/readyz", timeout=10)
+        assert ei.value.code == 503
+        assert json.loads(ei.value.read())["draining"] is True
+        # liveness is unaffected: a draining replica must NOT be
+        # restarted by its supervisor
+        h = json.loads(urllib.request.urlopen(
+            base + "/healthz", timeout=10).read())
+        assert h["ok"] is True
+    finally:
+        httpd.shutdown()
+        svc.stop()
+
+
+# ----------------------------------------------------------------------
+# router: shedding + quotas
+# ----------------------------------------------------------------------
+
+def _post(url, payload):
+    import urllib.request
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    return urllib.request.urlopen(req, timeout=10)
+
+
+def test_router_sheds_with_retry_after(tmp_path, tiny_beam):
+    import urllib.error
+    cfg = RouterConfig(fleetdir=str(tmp_path / "fleet"),
+                       high_water=2, retry_after_s=3.0,
+                       require_ready=False)
+    router = FleetRouter(cfg)
+    httpd = start_router_http(router)
+    base = "http://%s:%d" % httpd.server_address[:2]
+    try:
+        for _ in range(2):
+            assert _post(base + "/submit",
+                         _spec(tiny_beam)).status == 202
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _post(base + "/submit", _spec(tiny_beam))
+        assert ei.value.code == 429
+        assert ei.value.headers["Retry-After"] == "3"
+        body = json.loads(ei.value.read())
+        assert body["error"] == "shed"
+        assert router.obs.metrics.get("fleet_shed_total").value == 1
+        assert any(e["kind"] == "shed"
+                   for e in router.events.tail(50))
+        view = router.fleet_view()
+        assert view["depth"] == 2 and view["high_water"] == 2
+    finally:
+        httpd.shutdown()
+        router.stop()
+
+
+def test_router_tenant_quota_typed_rejection(tmp_path, tiny_beam):
+    import urllib.error
+    cfg = RouterConfig(fleetdir=str(tmp_path / "fleet"),
+                       high_water=100, require_ready=False,
+                       tenants=["vip:2:1", "bulk:1"])
+    router = FleetRouter(cfg)
+    httpd = start_router_http(router)
+    base = "http://%s:%d" % httpd.server_address[:2]
+    try:
+        assert _post(base + "/submit",
+                     _spec(tiny_beam, tenant="vip")).status == 202
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _post(base + "/submit", _spec(tiny_beam, tenant="vip"))
+        assert ei.value.code == 429
+        body = json.loads(ei.value.read())
+        assert body == {"error": "quota-exceeded", "tenant": "vip",
+                        "quota": 1, "active": 1}
+        # typed event, not a silent drop
+        assert any(e["kind"] == "quota-exceeded"
+                   for e in router.events.tail(50))
+        assert router.obs.metrics.get(
+            "fleet_quota_rejections_total").labels(
+                tenant="vip").value == 1
+        # unquota'd tenant flows on
+        assert _post(base + "/submit",
+                     _spec(tiny_beam, tenant="bulk")).status == 202
+    finally:
+        httpd.shutdown()
+        router.stop()
+
+
+def test_router_503_with_no_ready_replica(tmp_path, tiny_beam):
+    import urllib.error
+    cfg = RouterConfig(fleetdir=str(tmp_path / "fleet"),
+                       require_ready=True)
+    router = FleetRouter(cfg)
+    httpd = start_router_http(router)
+    base = "http://%s:%d" % httpd.server_address[:2]
+    try:
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _post(base + "/submit", _spec(tiny_beam))
+        assert ei.value.code == 503
+        assert json.loads(ei.value.read())["error"] == \
+            "no-ready-replica"
+    finally:
+        httpd.shutdown()
+        router.stop()
+
+
+# ----------------------------------------------------------------------
+# real-survey chaos e2e + cold-replica warm start
+# ----------------------------------------------------------------------
+
+def test_fleet_real_survey_kill_one_byte_equal(tmp_path, tiny_beam):
+    """The acceptance chaos trial, in-process: two replicas running
+    REAL surveys, replica A killed after enqueuing its lease (its
+    survey keeps running as a zombie), replica B reaps + recomputes;
+    every job completes exactly once with artifacts byte-equal to a
+    never-failed reference run, and the zombie's late commit is
+    fenced off."""
+    from presto_tpu.pipeline.survey import SurveyConfig, run_survey
+    refdir = str(tmp_path / "ref")
+    run_survey([tiny_beam], SurveyConfig(**TINY_CFG), workdir=refdir)
+    ref = artifact_digests(refdir)
+    assert ref                                # non-trivial surface
+
+    fleetdir = tmp_path / "fleet"
+    led = JobLedger(str(fleetdir))
+    for i in range(2):
+        led.admit(_spec(tiny_beam))
+    svc_a = SearchService(str(tmp_path / "wa"), queue_depth=8).start()
+    cfg_a = FleetConfig(fleetdir=str(fleetdir), replica="a",
+                        lease_ttl=20.0, heartbeat_s=0.1,
+                        heartbeat_timeout=0.6, poll_s=0.05,
+                        max_inflight=1, prewarm=False)
+    rep_a = FleetReplica(svc_a, cfg_a)
+    rep_a.kill_on = "job-enqueued"
+    svc_b = SearchService(str(tmp_path / "wb"), queue_depth=8).start()
+    cfg_b = FleetConfig(fleetdir=str(fleetdir), replica="b",
+                        lease_ttl=20.0, heartbeat_s=0.1,
+                        heartbeat_timeout=0.6, poll_s=0.05,
+                        max_inflight=2, prewarm=False)
+    rep_b = FleetReplica(svc_b, cfg_b)
+    try:
+        rep_a.start()
+        assert _wait(lambda: rep_a._killed, timeout=30.0)
+        zombie = dict(rep_a._inflight)
+        assert len(zombie) == 1               # died mid-batch
+        rep_b.start()
+        assert _wait(led.all_terminal, timeout=120.0)
+        state = led.read()
+        assert int(state["epoch"]) >= 1
+        for jid, row in state["jobs"].items():
+            assert row["state"] == DONE and row["owner"] == "b"
+            detail = json.load(open(os.path.join(
+                str(fleetdir), "jobs", jid, "result.json")))
+            # byte-equal to the never-failed reference run
+            assert detail["artifacts"] == ref
+        # the zombie survey finishes on A's (still-running) scheduler;
+        # its late commit must be rejected by the fence
+        (jid, (lease, job)) = next(iter(zombie.items()))
+        assert _wait(lambda: job.status in JobStatus.TERMINAL,
+                     timeout=120.0)
+        before = open(os.path.join(str(fleetdir), "jobs", jid,
+                                   "result.json"), "rb").read()
+        assert rep_a._commit(lease, job) is False
+        after = open(os.path.join(str(fleetdir), "jobs", jid,
+                                  "result.json"), "rb").read()
+        assert before == after                # result landed ONCE
+        assert svc_a.obs.metrics.get(
+            "fleet_stale_results_total").value >= 1
+        kinds = [e["kind"] for e in svc_a.events.tail(200)]
+        assert "stale-result-rejected" in kinds
+    finally:
+        rep_a.stop()
+        rep_b.stop()
+        svc_a.stop()
+        svc_b.stop()
+
+
+def test_cold_replica_warm_start_zero_new_compiles(tmp_path,
+                                                   tiny_beam):
+    """ISSUE 9 acceptance: a freshly joined replica prewarmed from
+    the persistent plan tier serves a known-bucket job with ZERO new
+    plan compiles."""
+    store_dir = str(tmp_path / "planstore")
+    svc1 = SearchService(str(tmp_path / "w1"), queue_depth=8,
+                         plan_store_dir=store_dir).start()
+    try:
+        view = svc1.submit(_spec(tiny_beam))
+        assert svc1.wait([view["job_id"]], timeout=120.0)
+        assert svc1.get_job(view["job_id"]).status == JobStatus.DONE
+        assert svc1.plans.stats()["misses"] >= 1
+        assert len(svc1.plan_store.known()) >= 1
+    finally:
+        svc1.stop()
+
+    # cold replica: fresh process-equivalent (new PlanCache), same
+    # persistent tier
+    svc2 = SearchService(str(tmp_path / "w2"), queue_depth=8,
+                         plan_store_dir=store_dir).start()
+    try:
+        assert svc2.warm_fraction() == 0.0     # cold
+        assert svc2.readyz()["plan_warm_fraction"] == 0.0
+        warmed = svc2.prewarm()
+        assert warmed >= 1
+        assert svc2.warm_fraction() == 1.0
+        misses_after_warm = svc2.plans.stats()["misses"]
+        view = svc2.submit(_spec(tiny_beam))
+        assert svc2.wait([view["job_id"]], timeout=120.0)
+        assert svc2.get_job(view["job_id"]).status == JobStatus.DONE
+        # the job rode the warmed plans: no new compiles
+        assert svc2.plans.stats()["misses"] == misses_after_warm
+        assert svc2.plans.stats()["hits"] >= 1
+    finally:
+        svc2.stop()

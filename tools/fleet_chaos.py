@@ -1,0 +1,220 @@
+"""fleet_chaos: randomized replica-kill driver for fleet serving.
+
+The acceptance proof of ISSUE 9's tentpole is a chaos trial, not a
+demo: kill a replica mid-batch and every leased job must be
+re-admitted and complete **exactly once**, with artifacts byte-equal
+to a never-failed run.  Each trial here:
+
+  1. builds a fresh fleet directory and admits J identical tiny-survey
+     jobs to the ledger;
+  2. starts N replicas; one randomly chosen *victim* is killed at a
+     randomized point (right after leasing, right after enqueuing its
+     lease — leaving a zombie survey running — or at a random wall-
+     clock delay), exactly the way `kill -9` dies: heartbeats stop,
+     leases stay claimed;
+  3. survivors reap, re-admit, and finish everything;
+  4. the trial PASSES iff every job is ledger-done (zero lost), every
+     committed result's artifact digests are byte-equal to the
+     reference run, and — when the schedule produced a zombie — its
+     late commit is rejected by the epoch fence with the journaled
+     result left untouched.
+
+Writes FLEET_CHAOS.json (committed at the repo root).  Run:
+
+  python tools/fleet_chaos.py -trials 3 -seed 9
+  python tools/fleet_chaos.py --fast          # 1-trial smoke
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import random
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+TINY_CFG = {"lodm": 50.0, "hidm": 56.0, "nsub": 8, "zmax": 0,
+            "numharm": 2, "fold_top": 0, "singlepulse": False,
+            "skip_rfifind": True, "durable_stages": True}
+
+KILL_POINTS = ("job-leased", "job-enqueued", "timed")
+
+
+def _wait(cond, timeout, poll=0.05):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if cond():
+            return True
+        time.sleep(poll)
+    return False
+
+
+def run_trial(trial: int, rng: random.Random, beam: str, ref: dict,
+              workdir: str, replicas: int, jobs: int,
+              timeout: float) -> dict:
+    from presto_tpu.serve.fleet import FleetConfig, FleetReplica
+    from presto_tpu.serve.jobledger import JobLedger
+    from presto_tpu.serve.queue import JobStatus
+    from presto_tpu.serve.server import SearchService
+
+    fleetdir = os.path.join(workdir, "trial%02d" % trial, "fleet")
+    led = JobLedger(fleetdir)
+    for _ in range(jobs):
+        led.admit({"rawfiles": [beam], "config": dict(TINY_CFG)})
+    kill_point = rng.choice(KILL_POINTS)
+    kill_delay = rng.uniform(0.2, 2.0)
+    victim_idx = rng.randrange(replicas)
+    rec = {"trial": trial, "kill_point": kill_point,
+           "victim": "rep%d" % victim_idx,
+           "kill_delay_s": round(kill_delay, 3), "ok": False,
+           "checks": {}}
+    members = []
+    try:
+        for i in range(replicas):
+            svc = SearchService(
+                os.path.join(workdir, "trial%02d" % trial,
+                             "rep%d" % i),
+                queue_depth=max(8, jobs + 2)).start()
+            cfg = FleetConfig(fleetdir=fleetdir,
+                              replica="rep%d" % i,
+                              lease_ttl=30.0, heartbeat_s=0.1,
+                              heartbeat_timeout=0.8, poll_s=0.05,
+                              max_inflight=1, prewarm=False)
+            rep = FleetReplica(svc, cfg)
+            if i == victim_idx and kill_point != "timed":
+                rep.kill_on = kill_point
+            members.append((svc, rep))
+        # victim first so it reliably gets a lease before the pack
+        victim_svc, victim = members[victim_idx]
+        victim.start()
+        if kill_point == "timed":
+            time.sleep(kill_delay)
+            victim.kill()
+        else:
+            _wait(lambda: victim._killed, timeout=30.0)
+        rec["checks"]["victim_killed"] = bool(victim._killed)
+        zombies = dict(victim._inflight)
+        rec["zombie_jobs"] = sorted(zombies)
+        for i, (svc, rep) in enumerate(members):
+            if i != victim_idx:
+                rep.start()
+        ok_all = _wait(led.all_terminal, timeout=timeout)
+        rec["checks"]["all_terminal"] = ok_all
+        state = led.read()
+        done = [j for j, r in state["jobs"].items()
+                if r["state"] == "done"]
+        rec["checks"]["zero_lost"] = (len(done) == jobs)
+        rec["epoch"] = int(state["epoch"])
+        rec["redos"] = {j: r["redos"]
+                        for j, r in state["jobs"].items()
+                        if r["redos"]}
+        # byte-equality of every committed result vs the reference
+        equal = True
+        for jid in done:
+            detail = json.load(open(os.path.join(
+                fleetdir, "jobs", jid, "result.json")))
+            if detail["artifacts"] != ref:
+                equal = False
+        rec["checks"]["byte_equal_reference"] = equal
+        # zombie fence: its survey finishes on the victim's still-
+        # running scheduler; the late commit must bounce off the
+        # epoch fence without touching the journaled result
+        fence_ok = True
+        for jid, (lease, job) in zombies.items():
+            if not _wait(lambda: job.status in JobStatus.TERMINAL,
+                         timeout=timeout):
+                fence_ok = False
+                continue
+            final = os.path.join(fleetdir, "jobs", jid,
+                                 "result.json")
+            before = open(final, "rb").read()
+            if victim._commit(lease, job) is not False:
+                fence_ok = False
+            if open(final, "rb").read() != before:
+                fence_ok = False
+        rec["checks"]["zombie_commit_fenced"] = fence_ok
+        rec["stale_rejected"] = int(victim_svc.obs.metrics.get(
+            "fleet_stale_results_total").value)
+        rec["ok"] = all(rec["checks"].values())
+    finally:
+        for svc, rep in members:
+            rep.stop()
+            svc.stop()
+    return rec
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="fleet_chaos")
+    p.add_argument("-trials", type=int, default=3)
+    p.add_argument("-jobs", type=int, default=3)
+    p.add_argument("-replicas", type=int, default=2)
+    p.add_argument("-seed", type=int, default=9)
+    p.add_argument("-nsamp", type=int, default=4096)
+    p.add_argument("-nchan", type=int, default=8)
+    p.add_argument("-timeout", type=float, default=300.0)
+    p.add_argument("-workdir", type=str, default=None)
+    p.add_argument("-out", type=str, default=None,
+                   help="Report path (default <repo>/FLEET_CHAOS.json"
+                        " only with -commit; else stdout)")
+    p.add_argument("-commit", action="store_true",
+                   help="Write the report to <repo>/FLEET_CHAOS.json")
+    p.add_argument("--fast", action="store_true",
+                   help="1 trial, CI smoke")
+    args = p.parse_args(argv)
+    if args.fast:
+        args.trials = 1
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from tools.serve_loadgen import make_beams
+    from presto_tpu.pipeline.survey import SurveyConfig, run_survey
+    from presto_tpu.serve.fleet import artifact_digests
+
+    workdir = args.workdir or tempfile.mkdtemp(prefix="fleet_chaos_")
+    beam = make_beams(workdir, 1, nsamp=args.nsamp,
+                      nchan=args.nchan)[0]
+    # the never-failed reference: one plain batch-driver run
+    refdir = os.path.join(workdir, "reference")
+    run_survey([beam], SurveyConfig(**TINY_CFG), workdir=refdir)
+    ref = artifact_digests(refdir)
+
+    rng = random.Random(args.seed)
+    trials = []
+    for t in range(args.trials):
+        rec = run_trial(t, rng, beam, ref, workdir, args.replicas,
+                        args.jobs, args.timeout)
+        print("fleet_chaos: trial %d kill=%s victim=%s -> %s"
+              % (t, rec["kill_point"], rec["victim"],
+                 "PASS" if rec["ok"] else "FAIL"), flush=True)
+        trials.append(rec)
+
+    report = {
+        "seed": args.seed,
+        "replicas": args.replicas,
+        "jobs_per_trial": args.jobs,
+        "beam": {"nsamp": args.nsamp, "nchan": args.nchan},
+        "config": TINY_CFG,
+        "reference_artifacts": len(ref),
+        "trials": trials,
+        "passed": sum(1 for r in trials if r["ok"]),
+        "failed": sum(1 for r in trials if not r["ok"]),
+    }
+    out = args.out or (os.path.join(REPO, "FLEET_CHAOS.json")
+                       if args.commit else None)
+    text = json.dumps(report, indent=1, sort_keys=True)
+    if out:
+        with open(out, "w") as f:
+            f.write(text + "\n")
+        print("fleet_chaos: report -> %s" % out)
+    else:
+        print(text)
+    return 0 if report["failed"] == 0 else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -54,7 +54,15 @@ code path cannot ship silently:
      be in the sharded sets (and the sets must be subsets of their
      parent catalogs) — the sharded seam holds an entire survey's
      fan-out across devices with nothing durable until spill, so its
-     telemetry may neither go dark nor go stale.
+     telemetry may neither go dark nor go stale;
+  10. the FLEET serving layer (serve/jobledger.py + serve/fleet.py +
+     serve/router.py): FLEET_EVENTS and the `fleet_*` metrics are
+     pinned BOTH directions (event kinds count whether emitted
+     literally or bound as LeaseLedger EV_* class attributes, the
+     same accommodation check 2b makes for the refactored shard
+     ledger) — the fleet recovery path is exactly the code that runs
+     while a replica is dying, so its telemetry may neither go dark
+     nor go stale.
 
 Run directly (exit 1 lists violations) or via tests/test_obs_lint.py.
 """
@@ -77,6 +85,11 @@ POINT_RE = re.compile(r'\._point\(\s*\n?\s*"([^"]+)"')
 CLUSTER_EVENT_RE = re.compile(r'\._?event\(\s*\n?\s*"([^"]+)"')
 STATUS_RE = re.compile(r'^\s+([A-Z_]+)\s*=\s*"([a-z-]+)"\s*$',
                        re.MULTILINE)
+#: event kinds bound as ledger class attributes (the generic
+#: LeaseLedger emits via EV_* names; subclasses declare the literal
+#: vocabulary — see pipeline/leaseledger.py)
+EVENT_ATTR_RE = re.compile(r'^\s*EV_[A-Z_]+\s*=\s*"([^"]+)"',
+                           re.MULTILINE)
 METRIC_RE = re.compile(
     r'\.(?:counter|gauge|histogram)\(\s*\n?\s*"([a-z0-9_]+)"')
 SPAN_RE = re.compile(r'\.span\(\s*\n?\s*"([^"]+)"')
@@ -133,7 +146,9 @@ def lint() -> List[str]:
     # 2b. elastic-cluster kill points and events (parallel/elastic.py
     # + pipeline/shardledger.py are the worker-loss recovery layer;
     # its kill points and flight-recorder events are a registered
-    # vocabulary exactly like the survey's)
+    # vocabulary exactly like the survey's — since the ledger core
+    # moved to pipeline/leaseledger.py, shardledger declares its
+    # event kinds as EV_* class attributes, which count as emitted)
     elastic_files = ("presto_tpu/parallel/elastic.py",
                      "presto_tpu/pipeline/shardledger.py")
     cpoints: Set[str] = set()
@@ -145,6 +160,7 @@ def lint() -> List[str]:
             continue
         cpoints |= set(POINT_RE.findall(src))
         cevents |= set(CLUSTER_EVENT_RE.findall(src))
+        cevents |= set(EVENT_ATTR_RE.findall(src))
     for p in sorted(cpoints - taxonomy.CLUSTER_KILL_POINTS):
         problems.append(
             "parallel/elastic.py: kill point %r is not registered in "
@@ -162,16 +178,20 @@ def lint() -> List[str]:
             "obs/taxonomy.py: CLUSTER_EVENTS lists %r but the "
             "elastic layer never emits it" % k)
 
-    # 3. serve event kinds
+    # 3. serve event kinds (the fleet modules share the serve event
+    # log, so their registered vocabulary — FLEET_EVENTS, pinned both
+    # directions by check 10 — is admissible here too)
     serve_srcs = _tree_sources("presto_tpu/serve")
+    serve_ok = taxonomy.SERVE_EVENTS | taxonomy.FLEET_EVENTS
     emitted: Set[str] = set()
     for rel, src in sorted(serve_srcs.items()):
         kinds = set(EMIT_RE.findall(src))
         emitted |= kinds
-        for k in sorted(kinds - taxonomy.SERVE_EVENTS):
+        for k in sorted(kinds - serve_ok):
             problems.append(
                 "%s: event kind %r is not registered in "
-                "obs/taxonomy.SERVE_EVENTS" % (rel, k))
+                "obs/taxonomy.SERVE_EVENTS or FLEET_EVENTS"
+                % (rel, k))
 
     # 4. every job lifecycle state announces itself (scoped to the
     # JobStatus class body: queue.py also defines the Lanes constants,
@@ -338,6 +358,51 @@ def lint() -> List[str]:
         problems.append(
             "pipeline/fusion.py: sharded metric %r is not registered "
             "in obs/taxonomy.SHARDED_FUSION_METRICS" % m)
+
+    # 10. fleet serving (serve/jobledger.py + fleet.py + router.py):
+    # FLEET_EVENTS and the fleet_* metrics are pinned BOTH directions
+    # — the fleet recovery path (lease, fence, reap, shed, quota) is
+    # exactly the code that runs while a replica is dying, so its
+    # telemetry may neither go dark nor go stale.  Event kinds count
+    # whether emitted literally (events.emit / obs.event) or bound as
+    # LeaseLedger EV_* class attributes.
+    fleet_files = ("presto_tpu/serve/jobledger.py",
+                   "presto_tpu/serve/fleet.py",
+                   "presto_tpu/serve/router.py")
+    fl_events: Set[str] = set()
+    fl_metrics: Set[str] = set()
+    for rel in fleet_files:
+        try:
+            src = _read(rel)
+        except OSError:
+            continue
+        fl_events |= set(EMIT_RE.findall(src))
+        fl_events |= set(CLUSTER_EVENT_RE.findall(src))
+        fl_events |= set(EVENT_ATTR_RE.findall(src))
+        fl_metrics |= set(METRIC_RE.findall(src))
+    for k in sorted(taxonomy.FLEET_EVENTS - fl_events):
+        problems.append(
+            "obs/taxonomy.py: FLEET_EVENTS lists %r but the fleet "
+            "layer never emits it" % k)
+    for k in sorted(fl_events - taxonomy.FLEET_EVENTS
+                    - taxonomy.SERVE_EVENTS):
+        problems.append(
+            "fleet layer: event kind %r is not registered in "
+            "obs/taxonomy.FLEET_EVENTS" % k)
+    for m in sorted(taxonomy.FLEET_METRICS - taxonomy.METRICS):
+        problems.append(
+            "obs/taxonomy.py: FLEET_METRICS lists %r which is not "
+            "in METRICS" % m)
+    for m in sorted(taxonomy.FLEET_METRICS - fl_metrics):
+        problems.append(
+            "obs/taxonomy.py: FLEET_METRICS lists %r but the fleet "
+            "layer never registers it" % m)
+    for m in sorted({x for x in fl_metrics
+                     if x.startswith("fleet_")}
+                    - taxonomy.FLEET_METRICS):
+        problems.append(
+            "fleet layer: metric %r is not registered in "
+            "obs/taxonomy.FLEET_METRICS" % m)
     return problems
 
 

@@ -1,5 +1,6 @@
 """serve_loadgen: replay synthetic beams against a presto-serve
-instance and report throughput + latency percentiles from /metrics.
+instance (or a whole fleet) and report throughput + latency
+percentiles from /metrics.
 
 Generates N same-shaped synthetic beams (so they coalesce into one
 plan bucket), submits them at a fixed rate over the HTTP protocol,
@@ -13,8 +14,15 @@ own job_total p50/p99 from /metrics.
   # self-contained: spin up an in-process service first
   python tools/serve_loadgen.py -selfhost -beams 4 -rate 2
 
-Also importable (`run_loadgen`) — the `-m slow` serve smoke test
-drives it in-process.
+  # multi-replica sustained load: router + N fleet replicas leasing
+  # from one shared job ledger (ISSUE 9); submissions go through the
+  # router's durable admission, p50/p99 aggregate over the replicas'
+  # obs histograms
+  python tools/serve_loadgen.py -selfhost -replicas 2 -beams 8
+
+Also importable (`run_loadgen`, `run_fleet_loadgen`) — the `-m slow`
+serve smoke test drives it in-process, and tools/fleet_chaos.py +
+FLEET_r09.json build on the fleet mode.
 """
 
 from __future__ import annotations
@@ -100,12 +108,224 @@ def run_loadgen(url: str, beams, rate: float = 2.0,
     }
 
 
+# ----------------------------------------------------------------------
+# multi-replica (fleet) mode
+# ----------------------------------------------------------------------
+
+DEFAULT_FLEET_CONFIG = {"lodm": 45.0, "hidm": 65.0, "nsub": 16,
+                        "zmax": 0, "numharm": 4, "fold_top": 0,
+                        "singlepulse": False, "skip_rfifind": True,
+                        "durable_stages": True}
+
+
+def start_fleet(workdir: str, replicas: int, high_water: int = 256,
+                plan_store: bool = True, max_inflight: int = 2,
+                heartbeat_timeout: float = 3.0):
+    """Spin up an in-process fleet: router + N replicas leasing from
+    one shared job ledger.  Returns (router, router_url, members,
+    teardown) where members is [(service, replica)] and teardown()
+    drains everything."""
+    from presto_tpu.serve.fleet import FleetConfig, FleetReplica
+    from presto_tpu.serve.router import (FleetRouter, RouterConfig,
+                                         start_http as router_http)
+    from presto_tpu.serve.server import SearchService, start_http
+    fleetdir = os.path.join(workdir, "fleet")
+    store_dir = (os.path.join(fleetdir, "planstore")
+                 if plan_store else None)
+    router = FleetRouter(RouterConfig(
+        fleetdir=fleetdir, high_water=high_water, poll_s=0.3,
+        heartbeat_timeout=heartbeat_timeout)).start()
+    rhttpd = router_http(router)
+    url = "http://%s:%d" % rhttpd.server_address[:2]
+    members = []
+    for i in range(replicas):
+        svc = SearchService(os.path.join(workdir, "rep%d" % i),
+                            queue_depth=max(8, high_water),
+                            plan_store_dir=store_dir).start()
+        httpd = start_http(svc)
+        addr = "http://%s:%d" % httpd.server_address[:2]
+        cfg = FleetConfig(fleetdir=fleetdir, replica="rep%d" % i,
+                          lease_ttl=60.0, heartbeat_s=0.25,
+                          heartbeat_timeout=heartbeat_timeout,
+                          poll_s=0.05, max_inflight=max_inflight)
+        rep = FleetReplica(svc, cfg, addr=addr).start()
+        members.append((svc, rep, httpd))
+    deadline = time.time() + 60.0
+    while time.time() < deadline:
+        router.poll_replicas()
+        if len(router.ready_replicas()) >= replicas:
+            break
+        time.sleep(0.2)
+
+    def teardown():
+        for svc, rep, httpd in members:
+            httpd.shutdown()
+            svc.shutdown(drain=True, timeout=30.0)
+        rhttpd.shutdown()
+        router.stop()
+
+    return router, url, members, teardown
+
+
+def start_fleet_procs(workdir: str, replicas: int,
+                      high_water: int = 256,
+                      timeout: float = 120.0):
+    """The process-isolated twin of start_fleet: each replica is a
+    real `presto-serve -fleet` subprocess (own interpreter, own XLA
+    client — the production topology), torn down via SIGTERM so every
+    run also exercises the graceful drain + tombstone path."""
+    import signal
+    import subprocess
+    from presto_tpu.serve.router import (FleetRouter, RouterConfig,
+                                         start_http as router_http)
+    fleetdir = os.path.join(workdir, "fleet")
+    router = FleetRouter(RouterConfig(
+        fleetdir=fleetdir, high_water=high_water, poll_s=0.3,
+        heartbeat_timeout=5.0)).start()
+    rhttpd = router_http(router)
+    url = "http://%s:%d" % rhttpd.server_address[:2]
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    procs = []
+    for i in range(replicas):
+        procs.append(subprocess.Popen(
+            [sys.executable, "-m", "presto_tpu.apps.serve",
+             "-fleet", fleetdir, "-replica", "rep%d" % i,
+             "-workdir", os.path.join(workdir, "rep%d" % i),
+             "-port", "0", "-hb-interval", "0.25",
+             "-hb-timeout", "5", "-inflight", "2",
+             "-depth", str(max(8, high_water))],
+            cwd=os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__))),
+            env=env, stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL))
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        router.poll_replicas()
+        if len(router.ready_replicas()) >= replicas:
+            break
+        time.sleep(0.5)
+
+    def teardown():
+        for p in procs:
+            p.send_signal(signal.SIGTERM)
+        for p in procs:
+            try:
+                p.wait(timeout=60.0)
+            except subprocess.TimeoutExpired:
+                p.kill()
+        rhttpd.shutdown()
+        router.stop()
+
+    return router, url, procs, teardown
+
+
+def run_fleet_loadgen(workdir: str, beams, replicas: int = 2,
+                      rate: float = 4.0, config: dict = None,
+                      timeout: float = 900.0,
+                      subprocess_mode: bool = False) -> dict:
+    """Sustained load against a fleet of `replicas` members
+    (in-process threads by default; real presto-serve subprocesses
+    with subprocess_mode); returns throughput + per-replica p50/p99
+    (from the obs latency histograms) + fleet/ledger accounting."""
+    config = config or dict(DEFAULT_FLEET_CONFIG)
+    if subprocess_mode:
+        router, url, _procs, teardown = start_fleet_procs(
+            workdir, replicas, high_water=max(64, 4 * len(beams)))
+        members = []
+    else:
+        router, url, members, teardown = start_fleet(
+            workdir, replicas, high_water=max(64, 4 * len(beams)))
+    try:
+        t0 = time.time()
+        job_ids = []
+        for i, beam in enumerate(beams):
+            target = t0 + i / max(rate, 1e-6)
+            if target > time.time():
+                time.sleep(target - time.time())
+            view = _http_json(url + "/submit",
+                              {"rawfiles": [beam], "config": config})
+            job_ids.append(view["job_id"])
+        ok = router.wait(job_ids, timeout=timeout)
+        wall = time.time() - t0
+        states = [router.status(j)["state"] for j in job_ids]
+        n_done = states.count("done")
+        per_replica = {}
+        for svc, rep, _h in members:
+            lat = svc.latency.snapshot().get("job_exec", {})
+            reg = svc.obs.metrics
+            per_replica[rep.replica] = {
+                "jobs_committed": int(reg.get(
+                    "fleet_jobs_committed_total").value),
+                "jobs_leased": int(reg.get(
+                    "fleet_jobs_leased_total").value),
+                "p50_s": lat.get("p50_s", 0.0),
+                "p99_s": lat.get("p99_s", 0.0),
+                "plan_misses": svc.plans.stats()["misses"],
+                "plan_hits": svc.plans.stats()["hits"],
+            }
+        if not members:       # subprocess mode: scrape over HTTP
+            for host, addr in sorted(
+                    router._replica_addrs().items()):
+                if not addr:
+                    continue
+                try:
+                    m = _http_json(addr.rstrip("/") + "/metrics")
+                except Exception:
+                    continue
+                fleet_counters = {}
+                try:
+                    with urllib.request.urlopen(
+                            addr.rstrip("/")
+                            + "/metrics?format=prometheus",
+                            timeout=10) as r:
+                        for line in r.read().decode().splitlines():
+                            if line.startswith("fleet_jobs_"):
+                                name, _, v = line.partition(" ")
+                                fleet_counters[name] = float(v)
+                except Exception:
+                    pass
+                lat = m.get("latency", {}).get("job_exec", {})
+                per_replica[host] = {
+                    "jobs_committed": int(fleet_counters.get(
+                        "fleet_jobs_committed_total", 0)),
+                    "jobs_leased": int(fleet_counters.get(
+                        "fleet_jobs_leased_total", 0)),
+                    "p50_s": lat.get("p50_s", 0.0),
+                    "p99_s": lat.get("p99_s", 0.0),
+                    "plan_misses": m["plans"]["misses"],
+                    "plan_hits": m["plans"]["hits"],
+                }
+        return {
+            "replicas": replicas,
+            "submitted": len(job_ids),
+            "done": n_done,
+            "failed": states.count("failed"),
+            "unfinished": 0 if ok else len(job_ids) - n_done
+            - states.count("failed"),
+            "wall_s": round(wall, 3),
+            "throughput_jobs_per_s": round(n_done / wall, 4)
+            if wall else 0,
+            "fleet": router.metrics(),
+            "per_replica": per_replica,
+        }
+    finally:
+        teardown()
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="serve_loadgen")
     p.add_argument("-url", type=str, default=None,
                    help="Base URL of a running presto-serve")
     p.add_argument("-selfhost", action="store_true",
                    help="Spin up an in-process service instead")
+    p.add_argument("-replicas", type=int, default=0,
+                   help="Fleet mode: run this many in-process "
+                        "replicas behind a router sharing one job "
+                        "ledger (implies -selfhost)")
+    p.add_argument("-subprocess", action="store_true",
+                   help="Fleet mode: replicas as real presto-serve "
+                        "subprocesses (own interpreter/XLA client) "
+                        "instead of in-process threads")
     p.add_argument("-beams", type=int, default=4)
     p.add_argument("-rate", type=float, default=2.0,
                    help="Submission rate, jobs/s")
@@ -115,14 +335,26 @@ def main(argv=None) -> int:
                    help="Scratch root (default: a temp dir)")
     p.add_argument("-timeout", type=float, default=600.0)
     args = p.parse_args(argv)
-    if not args.url and not args.selfhost:
-        p.error("need -url or -selfhost")
+    if not args.url and not args.selfhost and not args.replicas:
+        p.error("need -url, -selfhost, or -replicas")
 
     sys.path.insert(0, os.path.dirname(
         os.path.dirname(os.path.abspath(__file__))))
     workdir = args.workdir or tempfile.mkdtemp(prefix="loadgen_")
     beams = make_beams(workdir, args.beams, nsamp=args.nsamp,
                        nchan=args.nchan)
+
+    if args.replicas:
+        from presto_tpu.apps.common import ensure_backend
+        ensure_backend()
+        report = run_fleet_loadgen(workdir, beams,
+                                   replicas=args.replicas,
+                                   rate=args.rate,
+                                   timeout=args.timeout,
+                                   subprocess_mode=args.subprocess)
+        print(json.dumps(report, indent=2, sort_keys=True))
+        return 0 if report["failed"] == 0 \
+            and report["unfinished"] == 0 else 1
 
     service = httpd = None
     url = args.url
