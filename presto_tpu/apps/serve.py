@@ -34,6 +34,11 @@ def build_parser():
                    help="Queue depth bound (backpressure above this)")
     p.add_argument("-maxbatch", type=int, default=8,
                    help="Max same-bucket jobs coalesced per batch")
+    p.add_argument("-no-stacked", action="store_true",
+                   help="Disable the stacked cross-job batch "
+                        "executor (coalesced batches then run the "
+                        "per-job loop; PRESTO_TPU_STACKED=0 is the "
+                        "env twin)")
     p.add_argument("-timeout", type=float, default=0.0,
                    help="Per-job wall-clock budget in seconds "
                         "(0 = unlimited)")
@@ -69,6 +74,17 @@ def build_parser():
                    help="Heartbeat TTL before a replica is reaped")
     p.add_argument("-inflight", type=int, default=2,
                    help="Leased jobs held concurrently")
+    p.add_argument("-lease-batch", type=int, default=4,
+                   help="Same-bucket jobs leased per ledger "
+                        "transaction (stacked into one device call; "
+                        "1 = classic single leasing)")
+    p.add_argument("-tune-in-idle", action="store_true",
+                   help="Run bounded presto-tune budget slices when "
+                        "the fleet ledger is empty (merge-saved into "
+                        "<fleet>/tune.json)")
+    p.add_argument("-idle-tune-budget", type=float, default=20.0,
+                   help="Wall-clock budget per idle tuning slice, "
+                        "seconds")
     p.add_argument("-planstore", type=str, default=None,
                    help="Persistent compiled-plan tier root "
                         "(default <fleet>/planstore when -fleet is "
@@ -102,6 +118,8 @@ def main(argv=None) -> int:
                             events_path=args.events,
                             heartbeat_s=args.heartbeat,
                             plan_store_dir=plan_store_dir,
+                            stacked=(False if args.no_stacked
+                                     else None),
                             obs_config=ObsConfig(
                                 enabled=True,
                                 trace_dir=args.tracedir,
@@ -118,7 +136,10 @@ def main(argv=None) -> int:
                            heartbeat_s=args.hb_interval,
                            heartbeat_timeout=args.hb_timeout,
                            max_inflight=args.inflight,
-                           prewarm=not args.no_prewarm)
+                           prewarm=not args.no_prewarm,
+                           lease_batch=args.lease_batch,
+                           tune_in_idle=args.tune_in_idle,
+                           idle_tune_budget_s=args.idle_tune_budget)
         replica = FleetReplica(
             service, fcfg,
             addr="http://%s:%d" % (host, port)).start()

@@ -54,6 +54,21 @@ def note_compile(obs, kind: str, seconds: float,
                       device=device or "")
 
 
+def note_dispatch(obs, kind: str, n: int = 1) -> None:
+    """One batched device-chain dispatch: a single rFFT / accel-scan /
+    single-pulse program launch covering however many trials ride its
+    batch axis.  The stacked serve executor's whole win is fewer of
+    these for the same job count (docs/SERVING.md, stacked batches) —
+    `jax_dispatches_total{kind}` is the counter the stacked-vs-per-job
+    A/B pins."""
+    if obs is None or not obs.enabled:
+        return
+    obs.metrics.counter(
+        "jax_dispatches_total",
+        "Batched device-chain dispatches (rFFT/search/single-pulse "
+        "program launches)", ("kind",)).labels(kind=kind).inc(int(n))
+
+
 def note_put(obs, nbytes: int) -> None:
     """Host -> device upload volume."""
     if obs is None or not obs.enabled:
@@ -88,10 +103,14 @@ def transfer_snapshot(obs) -> dict:
     survey's end-of-run span).  Returns zeros when observability is
     disabled, so callers can diff snapshots unconditionally."""
     out = {"put_bytes": 0, "get_bytes": 0, "donated_bytes": 0,
-           "compiles": 0, "compile_seconds": 0.0}
+           "compiles": 0, "compile_seconds": 0.0, "dispatches": 0}
     if obs is None or not obs.enabled:
         return out
     reg = obs.metrics
+    out["dispatches"] = int(reg.counter(
+        "jax_dispatches_total",
+        "Batched device-chain dispatches (rFFT/search/single-pulse "
+        "program launches)", ("kind",)).total())
     out["put_bytes"] = int(reg.counter(
         "jax_device_put_bytes_total",
         "Bytes uploaded host to device").value)

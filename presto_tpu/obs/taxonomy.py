@@ -125,6 +125,7 @@ FLEET_EVENTS = frozenset({
     "fleet-tombstone",
     "fleet-pump-error",
     "router-poll-error",
+    "fleet-idle-tune",
 })
 
 #: streaming-layer event kinds — every `events.emit("<kind>", ...)`
@@ -145,6 +146,15 @@ STREAM_SPANS = frozenset({
     "stream:block",
     "stream:dedisp",
     "stream:search",
+})
+
+#: serve-layer span names — every `obs.span("...")` in
+#: presto_tpu/serve/ (enforced both directions by obs_lint check 11:
+#: the scheduler's per-job execution span and the stacked batch
+#: executor's cross-job span may neither go dark nor go stale)
+SERVE_SPANS = frozenset({
+    "serve-job",
+    "serve:stacked-batch",
 })
 
 #: job lifecycle states -> the event kind that announces the
@@ -216,6 +226,8 @@ FLEET_METRICS = frozenset({
     "fleet_quota_rejections_total",
     "fleet_depth",
     "fleet_replicas_ready",
+    "fleet_batch_leases_total",
+    "fleet_idle_tune_total",
 })
 
 #: registered metric names (Prometheus side of the contract); the
@@ -236,6 +248,10 @@ METRICS = frozenset({
     "serve_uptime_seconds",
     "serve_jobs",
     "serve_jobs_parked_total",
+    # stacked cross-job batch executor (serve/batchexec.py)
+    "serve_stacked_batches_total",
+    "serve_stacked_jobs_total",
+    "serve_batch_occupancy",
     # plan cache (incl. the persistent tier, serve/plancache.PlanStore)
     "plancache_hits_total",
     "plancache_misses_total",
@@ -254,6 +270,7 @@ METRICS = frozenset({
     # jax compile/device telemetry
     "jax_compiles_total",
     "jax_compile_seconds",
+    "jax_dispatches_total",
     "jax_device_put_bytes_total",
     "jax_device_get_bytes_total",
     "jax_donated_bytes_total",
@@ -305,6 +322,8 @@ METRICS = frozenset({
     "fleet_quota_rejections_total",
     "fleet_depth",
     "fleet_replicas_ready",
+    "fleet_batch_leases_total",
+    "fleet_idle_tune_total",
     # streaming search (presto_tpu/stream); every stream_* name here
     # must be registered by the stream layer (obs_lint check 7)
     "stream_blocks_total",

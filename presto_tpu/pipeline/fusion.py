@@ -511,8 +511,9 @@ def fused_rfft_batch(series_dev, donate: bool = False, obs=None,
         else:
             fn = jax.jit(jax.vmap(fftpack.realfft_packed_pairs), **kw)
         _fft_fns[key] = fn
+    from presto_tpu.obs import jaxtel
+    jaxtel.note_dispatch(obs, "rfft_batch")
     if donate:
-        from presto_tpu.obs import jaxtel
         jaxtel.note_donation(obs, int(np.prod(series_dev.shape)) * 4)
     return fn(series_dev)
 

@@ -248,6 +248,24 @@ def run_survey(rawfiles: Sequence[str], cfg: SurveyConfig,
 
 def _run_survey_stages(rawfiles, cfg, workdir, base, res, timer,
                        manifest=None, obs=None):
+    seam, disk_only = _survey_head(rawfiles, cfg, workdir, base, res,
+                                   timer, manifest, obs)
+    _device_search_stages(seam, disk_only, res.datfiles, cfg,
+                          cfg.all_passes, timer, manifest, obs)
+    timer.mark("sift")
+    _chaos(cfg, "pre-sift", obs)
+    return _finish_survey_stages(rawfiles, cfg, workdir, base, res,
+                                 timer, manifest, obs, seam=seam)
+
+
+def _survey_head(rawfiles, cfg, workdir, base, res, timer,
+                 manifest=None, obs=None):
+    """Stages 1-3 (rfifind -> DDplan -> prepsubband), depositing the
+    DM fan-out at the in-memory stage seam.  Returns (seam,
+    disk_only): the seam plus the trials that must flow through the
+    original disk consumers.  Split out of _run_survey_stages so the
+    stacked cross-job executor (run_survey_stacked) can run N heads
+    and then ONE merged device-search stage."""
 
     timer.mark("rfifind")
     _chaos(cfg, "pre-rfifind", obs)
@@ -379,6 +397,16 @@ def _run_survey_stages(rawfiles, cfg, workdir, base, res, timer,
     if n_sharded:
         _chaos(cfg, "shard-seam-handoff", obs)
     _chaos(cfg, "post-prepsubband", obs)
+    return seam, disk_only
+
+
+def _device_search_stages(seam, disk_only, datfiles, cfg, passes,
+                          timer, manifest=None, obs=None):
+    """Stages 9a + 4/5/6: single-pulse, rFFT, (zapbirds), accelsearch
+    over the seam-resident series plus the disk-trial fallbacks.  This
+    is the survey's device-bound middle — exactly what the stacked
+    serve executor runs ONCE over a merged cross-job seam
+    (run_survey_stacked) instead of once per job."""
 
     # ---- 9a. single-pulse search over the seam-resident series ------
     # runs BEFORE the FFT consumes (and may donate) the series block;
@@ -389,7 +417,6 @@ def _run_survey_stages(rawfiles, cfg, workdir, base, res, timer,
         _seam_singlepulse(seam, cfg, manifest, obs)
 
     from dataclasses import replace as _replace
-    passes = cfg.all_passes
     if cfg.zaplist:
         timer.mark("realfft")
         if len(seam):
@@ -405,7 +432,7 @@ def _run_survey_stages(rawfiles, cfg, workdir, base, res, timer,
         # zapped spectrum already sits journaled on disk (re-zapping
         # is excluded by contract, so those search from the artifact)
         fftfiles = sorted({f[:-4] + ".fft" for f in disk_only}
-                          | {f[:-4] + ".fft" for f in res.datfiles
+                          | {f[:-4] + ".fft" for f in datfiles
                              if os.path.exists(f[:-4] + ".fft")})
         timer.mark("zapbirds")
         # ---- 5. zapbirds ---------------------------------------------
@@ -445,11 +472,6 @@ def _run_survey_stages(rawfiles, cfg, workdir, base, res, timer,
                 [f[:-4] + ".fft" for f in disk_only],
                 _replace(cfg, zmax=zmax, numharm=nh, sigma=sg,
                          flo=flo), manifest, obs)
-
-    timer.mark("sift")
-    _chaos(cfg, "pre-sift", obs)
-    return _finish_survey_stages(rawfiles, cfg, workdir, base, res,
-                                 timer, manifest, obs, seam=seam)
 
 
 def _length_groups(files, item_bytes):
@@ -558,6 +580,7 @@ def _seam_fft_search(seam, cfg, passes, manifest=None, obs=None,
             _chaos(cfg, "zapbirds-file", obs)
         for pcfg in todo_passes:
             searcher = _searcher_for(pcfg, T, nbins)
+            jaxtel.note_dispatch(obs, "accel_search")
             results = searcher.search_many(search_dev, mesh=mesh)
             arts = []
             for row, pr, raw in zip(rows, pairs_host, results):
@@ -644,10 +667,12 @@ def _seam_fft_search(seam, cfg, passes, manifest=None, obs=None,
                         mesh=chunk_mesh)
                 elif whole:
                     pairs_dev = fusion.fused_rfft_batch(
-                        block.series_dev[:, :n], mesh=chunk_mesh)
+                        block.series_dev[:, :n], obs=obs,
+                        mesh=chunk_mesh)
                 else:
                     pairs_dev = fusion.fused_rfft_batch(
-                        block.series_dev[np.asarray(chunk_rows), :n])
+                        block.series_dev[np.asarray(chunk_rows), :n],
+                        obs=obs)
                 pending.append((block, chunk_rows, pairs_dev,
                                 todo_passes, n, chunk_mesh))
                 window = (shard_depth if chunk_mesh is not None
@@ -683,6 +708,7 @@ def _seam_singlepulse(seam, cfg, manifest=None, obs=None) -> None:
     import jax.numpy as jnp
     from presto_tpu.apps.single_pulse_search import (sp_block_plan,
                                                      sp_input_plan)
+    from presto_tpu.obs import jaxtel
     from presto_tpu.pipeline import fusion
     from presto_tpu.search.singlepulse import (SinglePulseSearch,
                                                write_singlepulse)
@@ -722,6 +748,7 @@ def _seam_singlepulse(seam, cfg, manifest=None, obs=None) -> None:
             span = (obs.span("sp-seam-chunk", files=len(rows),
                              nuse=nuse, sharded=True)
                     if obs is not None else None)
+            jaxtel.note_dispatch(obs, "sp_search")
             results = sp.search_many_resident(
                 batch, bdt,
                 dms=[fusion.inf_float(block.infos[r].dm, 12)
@@ -755,6 +782,7 @@ def _seam_singlepulse(seam, cfg, manifest=None, obs=None) -> None:
                     if obs is not None else None)
             batch = jnp.stack([b.series_dev[row, :nuse]
                                for (b, row, _n, _o) in chunk])
+            jaxtel.note_dispatch(obs, "sp_search")
             results = sp.search_many_resident(
                 batch, dt,
                 dms=[fusion.inf_float(b.infos[row].dm, 12)
@@ -806,7 +834,9 @@ def _fused_fft_search(datfiles, cfg, manifest=None, obs=None) -> None:
                   if obs is not None else None)
             arr = np.stack([datfft.read_dat(f)[:n] for f in chunk])
             jaxtel.note_put(obs, arr.nbytes)
+            jaxtel.note_dispatch(obs, "rfft_batch")
             pairs_dev = batched(jnp.asarray(arr))    # stays in HBM
+            jaxtel.note_dispatch(obs, "accel_search")
             results = searcher.search_many(pairs_dev)
             pairs_host = np.asarray(pairs_dev)       # one download
             jaxtel.note_get(obs, pairs_host.nbytes)
@@ -856,6 +886,7 @@ def _staged_fft_search_head(datfiles, cfg, manifest=None, obs=None):
                 # app (bin 0 is outside the searched range anyway)
                 arr = np.stack([datfft.read_dat(f)[:n] for f in chunk])
                 jaxtel.note_put(obs, arr.nbytes)
+                jaxtel.note_dispatch(obs, "rfft_batch")
                 pairs = np.asarray(batched(jnp.asarray(arr)))
                 jaxtel.note_get(obs, pairs.nbytes)
                 for f, pr in zip(chunk, pairs):
@@ -899,6 +930,7 @@ def _batched_accelsearch(fftfiles, cfg, manifest=None, obs=None):
                 batch = np.stack([fftpack.np_complex64_to_pairs(a)
                                   for a in amps_list])
                 jaxtel.note_put(obs, batch.nbytes)
+                jaxtel.note_dispatch(obs, "accel_search")
                 results = searcher.search_many(batch)
                 arts = []
                 for f, amps, raw in zip(chunk, amps_list, results):
@@ -1023,3 +1055,273 @@ def _finish_survey_stages(rawfiles, cfg, workdir, base, res, timer,
     _chaos(cfg, "post-survey", obs)
 
     return res
+
+
+# ----------------------------------------------------------------------
+# Stacked cross-job execution (the serve layer's batch executor)
+# ----------------------------------------------------------------------
+
+class StackedSeamError(RuntimeError):
+    """This job set cannot share one stacked device chain (e.g. the
+    seams hold mesh-sharded blocks, whose concatenation would cross
+    device placements).  The serve scheduler treats it like any batch
+    failure: degrade to the per-job path."""
+
+
+class _FanTimer:
+    """StageTimer fan-out: the merged device stage advances every
+    stacked job's stage clock together (a shared device call IS each
+    job's stage work; attributing it N ways would hide it from N-1
+    of them)."""
+
+    def __init__(self, timers):
+        self.timers = [t for t in timers if t is not None]
+
+    def mark(self, name):
+        for t in self.timers:
+            t.mark(name)
+
+
+class _FanInjector:
+    """Chaos fan-out for the merged chain: a fault injected into ANY
+    stacked job must abort the shared device call (the scheduler then
+    degrades the whole batch to per-job execution)."""
+
+    def __init__(self, injectors):
+        self.injectors = list(injectors)
+
+    def point(self, name):
+        for fi in self.injectors:
+            fi.point(name)
+
+
+class _StackManifest:
+    """Artifact-journal fan-out for a merged seam: every record /
+    verify routes to the manifest of the job whose workdir holds the
+    path, so N stacked jobs' journals end up exactly what N per-job
+    runs would have written."""
+
+    def __init__(self, routes):
+        #: [(abs workdir, manifest-or-None)], deepest path first so a
+        #: nested workdir routes to its own journal
+        self.routes = sorted(((os.path.abspath(w), m)
+                              for w, m in routes),
+                             key=lambda e: -len(e[0]))
+
+    def _for(self, path):
+        p = os.path.abspath(path)
+        for wd, m in self.routes:
+            if p == wd or p.startswith(wd + os.sep):
+                return m
+        return None
+
+    def _grouped(self, paths):
+        groups = {}
+        for p in paths:
+            m = self._for(p)
+            groups.setdefault(id(m), (m, []))[1].append(p)
+        return list(groups.values())
+
+    def valid(self, path):
+        m = self._for(path)
+        return os.path.exists(path) if m is None else m.valid(path)
+
+    def stage_of(self, path):
+        m = self._for(path)
+        return "" if m is None else m.stage_of(path)
+
+    def record_many(self, paths, stage="", save=True):
+        for m, ps in self._grouped(paths):
+            if m is not None:
+                m.record_many(ps, stage, save=save)
+
+    def invalidate_stale(self, paths, remove=True):
+        stale = []
+        for m, ps in self._grouped(paths):
+            if m is not None:
+                stale += list(m.invalidate_stale(ps, remove=remove))
+            else:
+                # journal-less jobs keep the legacy contract: missing
+                # files are simply not survivors
+                stale += [p for p in ps if not os.path.exists(p)]
+        return stale
+
+
+def _merged_seam(ctxs, obs, manifest):
+    """ONE StageSeam over every stacked job's deposited blocks:
+    same-geometry blocks (equal padded length, valid span, and sample
+    time) are concatenated on the batch axis — jobs stacked into one
+    [sum(numdms), numout] device array — so the downstream FFT /
+    accelsearch / single-pulse stages run one batched dispatch where
+    N per-job runs paid N.  Per-trial math is independent of batch
+    composition (the DM-sharded seam's pinned invariant), so every
+    artifact byte matches the per-job run.  Source blocks hand their
+    DEVICE reference to the merged copy (host copies stay with each
+    job's own seam for spills and prepfold)."""
+    import jax.numpy as jnp
+    import numpy as np
+    from presto_tpu.pipeline import fusion
+
+    cfg0 = ctxs[0]["cfg"]
+    seam = fusion.StageSeam(ctxs[0]["workdir"], durable=_durable(cfg0),
+                            manifest=manifest, obs=obs,
+                            inflight_depth=cfg0.inflight_depth)
+    groups = {}
+    order = []
+    for c in ctxs:
+        for b in c["seam"].blocks:
+            if fusion.is_sharded(b):
+                raise StackedSeamError(
+                    "mesh-sharded seam blocks cannot be stacked "
+                    "across jobs")
+            key = (int(b.numout), int(b.valid), float(b.dt))
+            if key not in groups:
+                order.append(key)
+            groups.setdefault(key, []).append(b)
+    for key in order:
+        blocks = groups[key]
+        if len(blocks) == 1:
+            mb = blocks[0]
+        else:
+            mb = fusion.SeamBlock(
+                names=[n for b in blocks for n in b.names],
+                infos=[i for b in blocks for i in b.infos],
+                dms=[d for b in blocks for d in b.dms],
+                series_dev=jnp.concatenate(
+                    [b.series_dev for b in blocks], axis=0),
+                series_host=np.concatenate(
+                    [b.series_host for b in blocks], axis=0),
+                valid=key[1], numout=key[0], dt=key[2])
+            for b in blocks:
+                # the merged copy owns the HBM now; each job's seam
+                # keeps the bit-identical host copy for spills/folds
+                b.series_dev = None
+        seam.blocks.append(mb)
+        for row, name in enumerate(mb.names):
+            seam._by_dat[os.path.abspath(name + ".dat")] = (mb, row)
+    return seam
+
+
+def _stacked_device_stages(ctxs):
+    """The merged middle for one sub-stack: every job's seam blocks
+    concatenated, ONE _device_search_stages pass over the union."""
+    from dataclasses import replace as _replace
+    cfg0 = ctxs[0]["cfg"]
+    obs0 = ctxs[0]["obs"]
+    manifest = _StackManifest([(c["workdir"], c["manifest"])
+                               for c in ctxs])
+    injectors = [c["cfg"].fault_injector for c in ctxs
+                 if c["cfg"].fault_injector is not None]
+    cfg_m = cfg0
+    if injectors and (len(injectors) > 1
+                      or injectors[0] is not cfg0.fault_injector):
+        cfg_m = _replace(cfg0, fault_injector=_FanInjector(injectors))
+    seam = _merged_seam(ctxs, obs0, manifest)
+    disk_only = [f for c in ctxs for f in c["disk_only"]]
+    datfiles = [f for c in ctxs for f in c["res"].datfiles]
+    timer = _FanTimer([c["timer"] for c in ctxs])
+    _device_search_stages(seam, disk_only, datfiles, cfg_m,
+                          cfg_m.all_passes, timer, manifest, obs0)
+
+
+def run_survey_stacked(jobs, stack_planner=None):
+    """Run N same-geometry surveys with the device-bound middle
+    STACKED: per-job heads (rfifind -> DDplan -> prepsubband) deposit
+    N seams, the merged DM fan-outs cross the rFFT -> (zap) ->
+    accelsearch -> single-pulse chain in shared batched dispatches
+    (one H2D already paid at dedisp time, one candidate-collection
+    download per stacked chunk), and per-job tails (sift / fold /
+    residual single-pulse) finish each survey.
+
+    jobs: sequence of (rawfiles, cfg, workdir, timer) tuples whose
+    configs are stack-compatible (serve/batchexec checks the full
+    signature; the chain itself requires equal pass geometry).
+    stack_planner: optional callable(per_job_chain_bytes: list[int])
+    -> sub-stack sizes summing to N (serve/batchexec supplies the
+    tuned max-stack x pad-bucket plan with the HBM-budget clamp);
+    None = one stack spanning every job.
+
+    Byte-identity invariant: stacking only widens the batch axis of
+    dispatches whose per-trial math is independent (the invariant the
+    DM-sharded seam already pins), so every artifact is byte-identical
+    to N independent run_survey calls.  Any failure propagates to the
+    caller — the serve scheduler's existing degradation path then
+    redoes the batch per-job (the verify-not-trust resume contract
+    makes the partial head work safe to redo).
+    """
+    from presto_tpu import tune as _tune
+    from presto_tpu.io.atomic import cleanup_stale_tmp
+    from presto_tpu.obs import resolve_obs
+    from presto_tpu.utils.timing import StageTimer
+
+    ctxs = []
+    for (rawfiles, cfg, workdir, timer) in jobs:
+        obs = resolve_obs(getattr(cfg, "obs", None))
+        os.makedirs(workdir, exist_ok=True)
+        rawfiles = [os.path.abspath(f) for f in rawfiles]
+        base = os.path.join(
+            workdir,
+            os.path.splitext(os.path.basename(rawfiles[0]))[0])
+        cleanup_stale_tmp(workdir)
+        manifest = None
+        if cfg.verify_resume:
+            from presto_tpu.pipeline.manifest import SurveyManifest
+            manifest = SurveyManifest.load(workdir)
+        if timer is None:
+            timer = StageTimer(obs=obs)
+        ctxs.append({
+            "rawfiles": rawfiles, "cfg": cfg, "workdir": workdir,
+            "base": base, "res": SurveyResult(workdir=workdir),
+            "timer": timer, "manifest": manifest, "obs": obs,
+            "span": None, "result": None,
+        })
+    cfg0 = ctxs[0]["cfg"]
+    try:
+        with _tune.scoped(cfg0.tune):
+            for c in ctxs:
+                c["span"] = c["obs"].span(
+                    "survey", workdir=c["workdir"],
+                    raw=os.path.basename(c["rawfiles"][0]),
+                    stacked=len(ctxs))
+                c["seam"], c["disk_only"] = _survey_head(
+                    c["rawfiles"], c["cfg"], c["workdir"], c["base"],
+                    c["res"], c["timer"], c["manifest"], c["obs"])
+            sizes = [len(ctxs)]
+            if stack_planner is not None:
+                per_job = [sum(len(b.names) * b.numout * 4 * 3
+                               for b in c["seam"].blocks)
+                           for c in ctxs]
+                sizes = list(stack_planner(per_job)) or sizes
+            if sum(sizes) != len(ctxs):
+                raise StackedSeamError(
+                    "stack plan %r does not cover %d jobs"
+                    % (sizes, len(ctxs)))
+            i = 0
+            for size in sizes:
+                _stacked_device_stages(ctxs[i:i + size])
+                i += size
+            for c in ctxs:
+                c["timer"].mark("sift")
+                _chaos(c["cfg"], "pre-sift", c["obs"])
+                c["result"] = _finish_survey_stages(
+                    c["rawfiles"], c["cfg"], c["workdir"], c["base"],
+                    c["res"], c["timer"], c["manifest"], c["obs"],
+                    seam=c["seam"])
+                c["span"].finish()
+                c["span"] = None
+    except BaseException as e:
+        for c in ctxs:
+            if c["span"] is not None:
+                c["span"].finish("error: %s" % type(e).__name__)
+                c["span"] = None
+            c["obs"].dump_flight(c["workdir"],
+                                 reason=type(e).__name__)
+        raise
+    finally:
+        for c in ctxs:
+            c["timer"].mark(None)
+            c["timer"].report()
+            with _tune.scoped(c["cfg"].tune):
+                _tune.write_provenance(c["workdir"])
+            c["obs"].flush(default_dir=c["workdir"])
+    return [c["result"] for c in ctxs]

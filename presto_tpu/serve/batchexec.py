@@ -1,0 +1,236 @@
+"""Stacked cross-job batch execution (serve layer).
+
+The micro-batching scheduler has always *coalesced* same-bucket jobs
+(one compiled plan serves the batch warm), but until now the batch
+still executed as a per-job Python loop — `Scheduler.batch_executor`
+was an empty seam.  This module fills it: a coalesced batch of
+same-bucket survey jobs runs its device-bound middle (rFFT -> [zap]
+-> accelsearch -> single-pulse) as ONE stacked chain, the jobs' DM
+fan-outs concatenated on the batch axis into a single
+``[jobs x numdms, nsamp]`` device array (pipeline/survey.py
+``run_survey_stacked``).  This is the continuous-batching shape of an
+inference server — amortize one compiled plan over N requests by
+stacking them — and the FDAS lesson of AstroAccelerate: batch
+geometry is a measured per-device parameter, which is exactly what
+the ``serve_batch_geometry`` tune family provides.
+
+Contracts (docs/SERVING.md, "Stacked cross-job batches"):
+
+  * **Byte-identity** — stacking only widens the batch axis of
+    dispatches whose per-trial math is independent (the DM-sharded
+    seam's pinned invariant), so every artifact a stacked batch
+    writes is byte-identical to N independent per-job runs.
+  * **Graceful degradation** — `StackIncompatible` (mixed configs,
+    sharded seams, callable jobs) and ANY mid-chain failure propagate
+    to the scheduler, whose existing degrade path redoes the batch
+    per-job; the verify-not-trust resume contract makes partial head
+    work safe to redo.
+  * **Geometry is tuned, never trusted** — the sub-stack plan comes
+    from the tuning DB's ``serve_batch_geometry`` entry (max stack
+    size x pad-bucket chunk scheme) clamped by the same HBM group
+    budget the accel slab plan uses, so a deep stack can never OOM
+    the chain.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import List, Optional
+
+from presto_tpu.serve.queue import Job, JobStatus
+
+#: SurveyConfig fields that shape the stacked device chain or the
+#: artifacts it writes.  Two jobs may share one stacked chain only
+#: when every one of these matches — the scheduling bucket (nchan/
+#: nsamp/dm_block/zmax/numharm) is necessary but NOT sufficient,
+#: because e.g. sigma/flo/zaplist change candidate collection without
+#: changing the bucket.
+STACK_FIELDS = (
+    "lodm", "hidm", "nsub", "rfi_time", "zmax", "numharm", "sigma",
+    "flo", "zaplist", "accel_passes", "min_dm_hits", "low_dm_cutoff",
+    "fold_top", "fold_sigma", "max_folds", "max_folds_per_pass",
+    "sp_threshold", "sp_maxwidth", "singlepulse", "skip_rfifind",
+    "bary", "verify_resume", "elastic", "tune", "durable_stages",
+    "inflight_depth",
+)
+
+#: the accel slab plan's group budget (search/accel.py halves 6 GiB
+#: for its 2-deep window); the stack clamp reuses the same figure so
+#: a stacked chain's peak residency matches what the per-job chain
+#: already proved safe
+STACK_HBM_BUDGET = 3 * 2 ** 30
+
+DEFAULT_MAX_STACK = 8
+DEFAULT_SCHEME = "exact"
+
+
+class StackIncompatible(RuntimeError):
+    """This batch cannot run as one stacked chain; the scheduler's
+    degradation path gives each job an individual shot."""
+
+
+def stack_signature(cfg) -> tuple:
+    """The stack-compatibility identity of a SurveyConfig: everything
+    that shapes the merged device chain or its artifacts."""
+    return tuple(repr(getattr(cfg, f, None)) for f in STACK_FIELDS)
+
+
+def plan_stack_sizes(n: int, max_stack: int = DEFAULT_MAX_STACK,
+                     scheme: str = DEFAULT_SCHEME) -> List[int]:
+    """Split an n-job batch into sub-stack sizes.
+
+    ``exact`` takes the largest allowed bite each time (fewest
+    dispatches; every distinct occupancy is a distinct compiled
+    shape).  ``pow2`` bites at power-of-two sizes (one extra dispatch
+    per odd tail, but recurring occupancies reuse one compiled stacked
+    program — the pad-bucket trade the ``serve_batch_geometry`` tune
+    family scores).  Sizes always sum to n and never exceed
+    max_stack."""
+    n = max(int(n), 0)
+    max_stack = max(int(max_stack), 1)
+    sizes: List[int] = []
+    left = n
+    while left > 0:
+        take = min(left, max_stack)
+        if scheme == "pow2" and take > 1:
+            take = 1 << (take.bit_length() - 1)   # largest pow2 <=
+        sizes.append(take)
+        left -= take
+    return sizes
+
+
+def resolve_stack_geometry(per_job_bytes: Optional[List[int]] = None,
+                           obs=None) -> tuple:
+    """(max_stack, scheme) for the next stacked batch: the tuning
+    DB's ``serve_batch_geometry`` entry when tuning is active, else
+    the defaults — then the HBM-budget clamp (the accel slab-plan
+    group budget divided by the heaviest job's chain working set), so
+    a deep stack degrades to more sub-stacks instead of an OOM."""
+    max_stack, scheme = DEFAULT_MAX_STACK, DEFAULT_SCHEME
+    from presto_tpu import tune
+    if tune.enabled():
+        cfg = tune.best("serve_batch_geometry", tune.GLOBAL_KEY,
+                        obs=obs)
+        if cfg:
+            try:
+                max_stack = int(cfg.get("max_stack", max_stack))
+            except (TypeError, ValueError):
+                pass
+            scheme = str(cfg.get("scheme", scheme))
+    if per_job_bytes:
+        heaviest = max(int(b) for b in per_job_bytes)
+        if heaviest > 0:
+            fit = max(1, int(STACK_HBM_BUDGET // heaviest))
+            max_stack = min(max_stack, fit)
+    return max(1, max_stack), scheme
+
+
+class StackedBatchExecutor:
+    """The scheduler's cross-job `batch_executor`: callable(jobs) ->
+    per-job result dicts, executing the whole same-bucket batch
+    through one stacked device chain."""
+
+    def __init__(self, service):
+        self.service = service
+        reg = service.obs.metrics
+        self._c_batches = reg.counter(
+            "serve_stacked_batches_total",
+            "Cross-job stacked device batches executed")
+        self._c_jobs = reg.counter(
+            "serve_stacked_jobs_total",
+            "Jobs executed through the stacked cross-job chain")
+        self._h_occupancy = reg.histogram(
+            "serve_batch_occupancy",
+            "Jobs per executed micro-batch (stacked path)",
+            buckets=(1, 2, 3, 4, 6, 8, 12, 16, 32))
+        self._last_sizes: List[int] = []
+
+    # -- geometry -------------------------------------------------------
+
+    def _plan(self, per_job_bytes: List[int]) -> List[int]:
+        max_stack, scheme = resolve_stack_geometry(
+            per_job_bytes, obs=self.service.obs)
+        self._last_sizes = plan_stack_sizes(len(per_job_bytes),
+                                            max_stack, scheme)
+        return self._last_sizes
+
+    # -- compatibility --------------------------------------------------
+
+    @staticmethod
+    def check_stackable(jobs: List[Job]) -> None:
+        """Raise StackIncompatible unless this batch may share one
+        stacked chain."""
+        if len(jobs) < 2:
+            raise StackIncompatible("nothing to stack")
+        if os.environ.get("PRESTO_TPU_STACKED", "1") == "0":
+            raise StackIncompatible("PRESTO_TPU_STACKED=0 kill switch")
+        for job in jobs:
+            if job.run is not None or job.cfg is None:
+                raise StackIncompatible(
+                    "callable jobs cannot be stacked")
+            if getattr(job.cfg, "elastic", None):
+                raise StackIncompatible(
+                    "elastic surveys keep the staged/ledger contract")
+        sig0 = stack_signature(jobs[0].cfg)
+        for job in jobs[1:]:
+            if job.bucket != jobs[0].bucket:
+                raise StackIncompatible("mixed plan buckets")
+            if stack_signature(job.cfg) != sig0:
+                raise StackIncompatible(
+                    "same bucket but different search configs")
+
+    # -- execution ------------------------------------------------------
+
+    def __call__(self, jobs: List[Job]) -> List[dict]:
+        from presto_tpu.pipeline.survey import run_survey_stacked
+        from presto_tpu.utils.timing import StageTimer
+        self.check_stackable(jobs)
+        injector = self.service.scheduler.cfg.fault_injector
+        timers = []
+        for job in jobs:
+            job.status = JobStatus.RUNNING
+            if not job.started:
+                job.started = time.time()
+            self.service.events.emit("execute", job=job.job_id,
+                                     attempt=job.attempts + 1,
+                                     stacked=True)
+            if injector is not None:
+                injector(job, job.attempts + 1)
+            timers.append(StageTimer(stats=self.service.latency,
+                                     obs=self.service.obs))
+        span = self.service.obs.span("serve:stacked-batch",
+                                     jobs=len(jobs),
+                                     bucket=repr(jobs[0].bucket))
+        self._h_occupancy.observe(len(jobs))
+        t0 = time.time()
+        try:
+            results = run_survey_stacked(
+                [(job.rawfiles, job.cfg, job.workdir, timer)
+                 for job, timer in zip(jobs, timers)],
+                stack_planner=self._plan)
+        except Exception as e:
+            span.finish("error: %s" % type(e).__name__)
+            raise
+        span.finish()
+        self._c_batches.inc(len(self._last_sizes or [jobs]))
+        self._c_jobs.inc(len(jobs))
+        if self.service.latency is not None:
+            self.service.latency.record("job_exec",
+                                        time.time() - t0)
+        out = []
+        for job, res, timer in zip(jobs, results, timers):
+            job.attempts += 1
+            out.append({
+                "workdir": res.workdir,
+                "candfile": res.candfile,
+                "n_datfiles": len(res.datfiles),
+                "n_cands": (len(res.sifted)
+                            if res.sifted is not None else 0),
+                "folded": list(res.folded),
+                "sp_events": res.sp_events,
+                "stacked": len(jobs),
+                "stage_seconds": {k: round(v, 4)
+                                  for k, v in timer.stages.items()},
+            })
+        return out

@@ -94,6 +94,21 @@ class FleetConfig:
     poll_s: float = 0.1
     max_inflight: int = 2          # leased jobs held at once
     prewarm: bool = True           # warm the plan cache before leasing
+    #: same-bucket jobs leased per ledger transaction
+    #: (JobLedger.lease_batch): a whole batch lands in the local
+    #: queue together, coalesces into one micro-batch, and executes
+    #: through the stacked executor as one device call.  Capped by
+    #: the free max_inflight slots; 1 = classic single leasing.
+    lease_batch: int = 4
+    #: idle-capacity tuning (the ROADMAP fleet follow-up): when the
+    #: ledger is empty and nothing is in flight, run ONE bounded
+    #: presto-tune budget slice and merge-save into the fleet's
+    #: shared tuning DB.  Off by default.
+    tune_in_idle: bool = False
+    idle_tune_families: str = "plancache_bucket"
+    idle_tune_budget_s: float = 20.0
+    idle_tune_interval: float = 300.0
+    idle_tune_db: str = ""         # default <fleetdir>/tune.json
 
 
 class FleetReplica:
@@ -141,6 +156,13 @@ class FleetReplica:
         self._c_stale = reg.counter(
             "fleet_stale_results_total",
             "Late results the ledger fence rejected (zombie commits)")
+        self._c_batchlease = reg.counter(
+            "fleet_batch_leases_total",
+            "Multi-job same-bucket batch leases claimed in one "
+            "ledger transaction")
+        self._c_idletune = reg.counter(
+            "fleet_idle_tune_total",
+            "Bounded tuning slices run in fleet idle capacity")
         self._g_inflight = reg.gauge(
             "fleet_inflight", "Leased jobs currently held")
         self._g_epoch = reg.gauge(
@@ -278,21 +300,88 @@ class FleetReplica:
             report = self.ledger.reap(self.cfg.heartbeat_timeout)
             self.epoch = report.epoch
             self._g_epoch.set(self.epoch)
+        leased_any = False
         while (not self.draining and not self._stop.is_set()
                and len(self._inflight) < self.cfg.max_inflight):
-            lease = self.ledger.lease(self.replica,
-                                      self.cfg.lease_ttl)
-            if lease is None:
+            want = min(max(int(self.cfg.lease_batch), 1),
+                       self.cfg.max_inflight - len(self._inflight))
+            if want > 1:
+                # one fenced transaction claims a whole same-bucket
+                # batch: the jobs coalesce into one local micro-batch
+                # and execute through the stacked executor as one
+                # device call (serve/batchexec.py)
+                leases = self.ledger.lease_batch(
+                    self.replica, self.cfg.lease_ttl, want)
+            else:
+                lease = self.ledger.lease(self.replica,
+                                          self.cfg.lease_ttl)
+                leases = [] if lease is None else [lease]
+            if not leases:
                 break
-            self._c_leased.inc()
-            self.service.events.emit("job-lease",
-                                     job=lease.item_id,
-                                     replica=self.replica,
-                                     epoch=lease.epoch)
+            leased_any = True
+            self._c_leased.inc(len(leases))
+            if len(leases) > 1:
+                self._c_batchlease.inc()
+            for lease in leases:
+                self.service.events.emit("job-lease",
+                                         job=lease.item_id,
+                                         replica=self.replica,
+                                         epoch=lease.epoch,
+                                         batch=len(leases))
             if self._chaos("job-leased"):
                 return
-            if not self._admit_local(lease):
+            if len(leases) > 1 and self._chaos("batch-leased"):
+                # chaos seam: die holding a whole leased batch — the
+                # reaper must re-admit every member exactly once
+                return
+            admitted = True
+            for lease in leases:
+                if not self._admit_local(lease):
+                    admitted = False
+            if not admitted:
                 break
+        if (not leased_any and not self._inflight
+                and self.cfg.tune_in_idle and not self.draining
+                and not self._stop.is_set()):
+            self._idle_tune()
+
+    # ---- idle-capacity tuning ------------------------------------------
+
+    _last_idle_tune = 0.0
+
+    def _idle_tune(self) -> None:
+        """One bounded presto-tune budget slice in idle capacity (the
+        ROADMAP fleet follow-up, minimal cut): measurements merge-save
+        into the fleet's shared tuning DB, so every replica's idle
+        time compounds into better execution geometry for all of
+        them.  Paced by idle_tune_interval; a failure is an event,
+        never a dead pump."""
+        now = time.time()
+        if now - self._last_idle_tune < self.cfg.idle_tune_interval:
+            return
+        self._last_idle_tune = now
+        try:
+            from presto_tpu.apps.tune import run_sweeps
+            from presto_tpu.tune.space import resolve
+            names = [f.strip()
+                     for f in self.cfg.idle_tune_families.split(",")
+                     if f.strip()]
+            families = resolve(names or None)
+            db_path = self.cfg.idle_tune_db or os.path.join(
+                os.path.abspath(self.cfg.fleetdir), "tune.json")
+            summary = run_sweeps(families, db_path, smoke=True,
+                                 budget=self.cfg.idle_tune_budget_s,
+                                 k=1, timeout=10.0,
+                                 obs=self.service.obs)
+            self._c_idletune.inc()
+            self.service.events.emit(
+                "fleet-idle-tune", replica=self.replica,
+                db_records=summary.get("db_records", 0),
+                elapsed_s=summary.get("elapsed_s", 0.0),
+                budget_exhausted=bool(
+                    summary.get("budget_exhausted")))
+        except Exception:
+            self.service.obs.event("fleet-pump-error")
 
     def _attempt_dir(self, job_id: str, epoch: int) -> str:
         return os.path.join(self.jobroot, job_id, "a%04d" % epoch)

@@ -110,11 +110,19 @@ class JobLedger(LeaseLedger):
     # -- admission ------------------------------------------------------
     def admit(self, spec: dict, tenant: str = DEFAULT_TENANT,
               job_id: Optional[str] = None, priority: int = 10,
-              now: Optional[float] = None) -> dict:
+              now: Optional[float] = None,
+              bucket: Optional[str] = None) -> dict:
         """Durably admit one job.  Enforces the tenant's quota over
         its *active* (pending + leased) jobs; raises the typed
         TenantQuotaExceeded past it.  Returns the job's ledger view.
-        Duplicate explicit job_ids raise JobLedgerError."""
+        Duplicate explicit job_ids raise JobLedgerError.
+
+        ``bucket`` is the job's plan-bucket hint (the repr of
+        serve/plancache.bucket_key, computed by the router at
+        admission): `lease_batch` stacks only jobs sharing it, so a
+        replica can claim a whole same-bucket batch in one fenced
+        transaction.  None disables batch leasing for this job —
+        never a correctness loss, only a batching one."""
         now = time.time() if now is None else now
         tenant = str(tenant or DEFAULT_TENANT)
         with self._lock():
@@ -141,9 +149,81 @@ class JobLedger(LeaseLedger):
                 "priority": int(priority),
                 "submitted": now,
                 "error": "",
+                "bucket": bucket,
             })
             self._save(state)
             return self._view(job_id, jobs[job_id])
+
+    # -- batch leasing --------------------------------------------------
+    def lease_batch(self, host: str, ttl: float, k: int,
+                    now: Optional[float] = None) -> List[ItemLease]:
+        """Claim up to ``k`` same-bucket pending jobs for ``host`` in
+        ONE fenced ledger transaction (the stacked batch executor's
+        fleet feeder).  The first grant follows the ordinary deficit-
+        WRR policy; the rest are restricted to pending jobs sharing
+        the head's bucket hint, with the deficit selection re-applied
+        over the tenants that still have matching jobs — every grant
+        bumps its tenant's persisted ``served`` counter, so WRR
+        fairness is preserved across the batch exactly as across k
+        single leases.  Each returned lease carries the SAME epoch
+        fence as a single lease: commits land per job, and a zombie's
+        late batch commit is fenced per job.  Returns [] when nothing
+        is pending; a head without a bucket hint returns just itself.
+        """
+        now = time.time() if now is None else now
+        leases: List[ItemLease] = []
+        with self._lock():
+            state = self._load()
+            h = state["hosts"].get(host)
+            if h is not None and not h.get("alive", True):
+                h["alive"] = True
+                h["epoch"] = int(state["epoch"])
+            iid = self._pick_pending(state, now)
+            if iid is None:
+                self._save(state)
+                return []
+            items = self._items(state)
+            epoch = int(state["epoch"])
+
+            def grant(jid):
+                row = items[jid]
+                row["state"] = LEASED
+                row["owner"] = host
+                row["lease_epoch"] = epoch
+                row["lease_expires"] = now + ttl
+                leases.append(self._make_lease(jid, row, epoch))
+
+            grant(iid)
+            hint = items[iid].get("bucket")
+            served = state.setdefault("served", {})
+            while hint is not None and len(leases) < max(int(k), 1):
+                pend: Dict[str, List[str]] = {}
+                for jid, row in items.items():
+                    if (row["state"] == PENDING
+                            and row.get("bucket") == hint):
+                        pend.setdefault(
+                            str(row.get("tenant", DEFAULT_TENANT)),
+                            []).append(jid)
+                if not pend:
+                    break
+                tenant = min(
+                    pend,
+                    key=lambda t: (float(served.get(t, 0))
+                                   / self._tenant_cfg(state,
+                                                      t)["weight"],
+                                   t))
+                jid = min(pend[tenant],
+                          key=lambda j: (int(items[j].get("priority",
+                                                          10)),
+                                         float(items[j].get(
+                                             "submitted", 0.0)), j))
+                served[tenant] = int(served.get(tenant, 0)) + 1
+                grant(jid)
+            self._save(state)
+        for lease in leases:
+            self._event(self.EV_LEASE, item=lease.item_id, host=host,
+                        epoch=lease.epoch, batch=len(leases))
+        return leases
 
     # -- scheduling policy: weighted round-robin over tenants ----------
     def _pick_pending(self, state: dict,

@@ -200,6 +200,22 @@ class FleetRouter:
 
     # ---- admission ----------------------------------------------------
 
+    @staticmethod
+    def _bucket_hint(spec: dict) -> Optional[str]:
+        """Best-effort plan-bucket hint recorded on the job row so
+        `JobLedger.lease_batch` can hand a replica a whole same-bucket
+        batch (the stacked executor's fleet feeder).  Failure — an
+        unreadable header, an unknown config field — degrades to None
+        (single-lease behavior), never to a rejected admission: the
+        replica's own build_job still validates authoritatively."""
+        try:
+            from presto_tpu.pipeline.survey import SurveyConfig
+            from presto_tpu.serve.plancache import bucket_key
+            cfg = SurveyConfig(**dict(spec.get("config") or {}))
+            return repr(bucket_key(list(spec["rawfiles"]), cfg))
+        except Exception:
+            return None
+
     def submit(self, spec: dict) -> dict:
         """Durably admit one job.  Raises FleetBusy (shed),
         TenantQuotaExceeded (typed), NoReadyReplica (503)."""
@@ -222,7 +238,8 @@ class FleetRouter:
             view = self.ledger.admit(
                 spec, tenant=tenant,
                 job_id=spec.get("job_id"),
-                priority=int(spec.get("priority", 10)))
+                priority=int(spec.get("priority", 10)),
+                bucket=self._bucket_hint(spec))
         except TenantQuotaExceeded as e:
             self._c_quota.labels(tenant=tenant).inc()
             self.events.emit("quota-exceeded", tenant=tenant,

@@ -281,7 +281,8 @@ class Scheduler:
                              occupancy=len(batch),
                              lane=batch[0].lane,
                              bucket=repr(batch[0].bucket))
-        if self.batch_executor is not None and len(batch) > 1:
+        if (self.batch_executor is not None and len(batch) > 1
+                and all(j.run is None for j in batch)):
             try:
                 results = self._with_timeout(
                     lambda: self.batch_executor(batch))
@@ -422,6 +423,11 @@ class Scheduler:
         with self._retry_lock:
             waiting = len(self._retry_heap)
         batches = self._c_batches.value
+
+        def _reg(name):
+            fam = self.obs.metrics.get(name)
+            return int(fam.value) if fam is not None else 0
+
         return {
             "alive": self.alive,
             "jobs_done": int(self._c_done.value),
@@ -432,4 +438,9 @@ class Scheduler:
             "degrades": int(self._c_degrades.value),
             "batch_occupancy": (self._c_batched.value / batches
                                 if batches else 0.0),
+            # stacked cross-job execution (serve/batchexec.py
+            # registers these on the same registry; 0 when the
+            # executor is disabled)
+            "stacked_batches": _reg("serve_stacked_batches_total"),
+            "stacked_jobs": _reg("serve_stacked_jobs_total"),
         }

@@ -72,7 +72,8 @@ class SearchService:
                  events_path: Optional[str] = None, mesh=None,
                  max_retry_depth: Optional[int] = 8, obs=None,
                  obs_config=None, heartbeat_s: float = 0.0,
-                 plan_store_dir: Optional[str] = None):
+                 plan_store_dir: Optional[str] = None,
+                 stacked: Optional[bool] = None):
         from presto_tpu.obs import Observability, ObsConfig
         os.makedirs(workroot, exist_ok=True)
         self.workroot = os.path.abspath(workroot)
@@ -105,6 +106,21 @@ class SearchService:
                                    events=self.events,
                                    latency=self.latency,
                                    obs=self.obs, plans=self.plans)
+        # cross-job stacked batch execution (serve/batchexec.py):
+        # the DEFAULT executor — a coalesced same-bucket batch runs
+        # its device chain as one stacked dispatch set, degrading to
+        # the per-job loop on any incompatibility or failure.  Off
+        # when the subclass overrides job execution (the stub-executor
+        # test services), via stacked=False, or PRESTO_TPU_STACKED=0.
+        if stacked is None:
+            stacked = (os.environ.get("PRESTO_TPU_STACKED", "1")
+                       != "0"
+                       and type(self)._execute_job
+                       is SearchService._execute_job)
+        self.stacked = bool(stacked)
+        if self.stacked:
+            from presto_tpu.serve.batchexec import StackedBatchExecutor
+            self.scheduler.batch_executor = StackedBatchExecutor(self)
         self._jobs: Dict[str, Job] = {}
         self._jobs_lock = threading.Lock()
         self._ids = itertools.count(1)

@@ -27,6 +27,12 @@ measure a candidate:
                       cross-stage in-flight window of the DM-sharded
                       fused chain (pipeline/fusion.py sharded seam;
                       measured on a miniature sharded fused chain)
+  serve_batch_geometry
+                      stacked cross-job batch executor geometry
+                      (serve/batchexec.py): max stack size x
+                      sub-stack pad-bucket scheme, measured on a
+                      miniature stacked chain (stack -> batched rFFT
+                      -> candidate-collection reduce)
 
 Families are device-agnostic declarations; ``tune.runner`` does the
 measuring and ``tune.db`` the remembering.  Every family has a tiny
@@ -341,6 +347,60 @@ def _sharded_inflight_bench(shape, config):
 
 
 # ----------------------------------------------------------------------
+# serve_batch_geometry
+# ----------------------------------------------------------------------
+
+def _stack_candidates(shape) -> List[dict]:
+    stacks = shape.get("stacks") or (2, 4, 8)
+    return [{"max_stack": int(s), "scheme": sch}
+            for s in stacks for sch in ("exact", "pow2")]
+
+
+def _stack_bench(shape, config):
+    """The stacked serve chain in miniature: N same-geometry jobs'
+    seam-resident series stacked on the batch axis per the candidate's
+    sub-stack plan (serve/batchexec.plan_stack_sizes), each sub-stack
+    crossing one batched rFFT + one per-trial top-k candidate-
+    collection reduce.  The scheme trades dispatch count against
+    compiled-shape reuse and the max stack bounds residency — stacking
+    never changes per-trial floats, so the figure of merit is pure
+    chain wall time."""
+    import jax
+    import jax.numpy as jnp
+    from presto_tpu.ops import fftpack
+    from presto_tpu.pipeline.fusion import fused_rfft_batch
+    from presto_tpu.serve.batchexec import plan_stack_sizes
+    nd = int(shape.get("numdms", 4))
+    n = int(shape.get("n", 1 << 12))
+    njobs = int(shape.get("jobs", 8))
+    rng = np.random.default_rng(31)
+    # pre-uploaded per-job fan-outs: the seam's device-resident state
+    dev = [jnp.asarray(rng.random((nd, n)).astype(np.float32))
+           for _ in range(njobs)]
+
+    @jax.jit
+    def collect(pairs):
+        p = pairs[..., 0] ** 2 + pairs[..., 1] ** 2
+        return jax.lax.top_k(p.reshape(p.shape[0], -1),
+                             min(8, p.shape[-1]))
+
+    sizes = plan_stack_sizes(njobs, int(config["max_stack"]),
+                             str(config["scheme"]))
+
+    def fn():
+        out = None
+        i = 0
+        for s in sizes:
+            chunk = dev[i:i + s]
+            i += s
+            stacked = (jnp.concatenate(chunk, axis=0)
+                       if len(chunk) > 1 else chunk[0])
+            out = collect(fused_rfft_batch(stacked))
+        return out
+    return fn
+
+
+# ----------------------------------------------------------------------
 # plancache_bucket (modeled)
 # ----------------------------------------------------------------------
 
@@ -477,6 +537,19 @@ FAMILIES: Dict[str, Family] = {
             [{"numdms": 8, "n": 1 << 10, "nchunks": 3,
               "windows": (1, 2)}] if smoke
             else [{"numdms": 64, "n": 1 << 18, "nchunks": 8}]),
+        available=_jax_ok,
+    ),
+    "serve_batch_geometry": Family(
+        name="serve_batch_geometry",
+        doc="Stacked cross-job batch executor geometry: max stack "
+            "size x sub-stack pad-bucket scheme (serve/batchexec.py)",
+        shape_key=lambda s: tune.GLOBAL_KEY,
+        candidates=_stack_candidates,
+        bench=_stack_bench,
+        shapes=lambda smoke: (
+            [{"jobs": 4, "numdms": 2, "n": 1 << 10,
+              "stacks": (2, 4)}] if smoke else
+            [{"jobs": 8, "numdms": 32, "n": 1 << 18}]),
         available=_jax_ok,
     ),
     "plancache_bucket": Family(

@@ -586,3 +586,178 @@ def test_cold_replica_warm_start_zero_new_compiles(tmp_path,
         assert svc2.plans.stats()["hits"] >= 1
     finally:
         svc2.stop()
+
+
+# ----------------------------------------------------------------------
+# batch leasing (ISSUE 10: lease whole same-bucket batches)
+# ----------------------------------------------------------------------
+
+def test_jobledger_lease_batch_same_bucket_wrr(tmp_path):
+    """lease_batch claims up to k same-bucket pending jobs in ONE
+    fenced transaction: the head follows ordinary deficit-WRR, the
+    rest are restricted to the head's bucket with the deficit
+    selection re-applied, and every grant bumps its tenant's served
+    counter (fairness preserved across the batch)."""
+    led = JobLedger(str(tmp_path))
+    led.set_tenant("a", weight=1.0)
+    led.set_tenant("b", weight=1.0)
+    for i in range(2):
+        led.admit({"i": i}, tenant="a", job_id="a%d" % i, bucket="B1")
+        led.admit({"i": i}, tenant="b", job_id="b%d" % i, bucket="B1")
+    led.admit({}, tenant="a", job_id="aX", bucket="B2")
+    leases = led.lease_batch("r1", ttl=30.0, k=4)
+    # the whole B1 batch in one transaction, never the B2 job
+    assert len(leases) == 4
+    assert sorted(l.item_id for l in leases) == ["a0", "a1",
+                                                 "b0", "b1"]
+    # WRR across the batch: tenants alternate (equal weights)
+    tenants = [l.data["tenant"] for l in leases]
+    assert tenants[:2] in (["a", "b"], ["b", "a"])
+    state = led.read()
+    assert state["served"] == {"a": 2, "b": 2}
+    for l in leases:
+        assert state["jobs"][l.item_id]["state"] == "leased"
+        assert state["jobs"][l.item_id]["owner"] == "r1"
+    # the B2 job leases separately afterwards
+    more = led.lease_batch("r1", ttl=30.0, k=4)
+    assert [l.item_id for l in more] == ["aX"]
+    assert led.lease_batch("r1", ttl=30.0, k=4) == []
+
+
+def test_jobledger_lease_batch_no_bucket_hint(tmp_path):
+    """Jobs admitted without a bucket hint never batch — single-lease
+    behavior, no correctness change."""
+    led = JobLedger(str(tmp_path))
+    led.admit({}, job_id="j0")
+    led.admit({}, job_id="j1")
+    leases = led.lease_batch("r1", ttl=30.0, k=4)
+    assert [l.item_id for l in leases] == ["j0"]
+
+
+def test_jobledger_batch_lease_reap_readmits_all(tmp_path):
+    """A dead replica holding a whole leased batch: the reaper
+    re-admits every member, and the zombie's per-job commit is fenced
+    per job (exactly-once under lease_batch)."""
+    led = JobLedger(str(tmp_path))
+    led.join("a", now=0.0)
+    led.join("b", now=0.0)
+    for i in range(3):
+        led.admit({}, job_id="j%d" % i, bucket="B")
+    leases = led.lease_batch("a", ttl=30.0, k=3, now=0.0)
+    assert len(leases) == 3
+    led.heartbeat("b", 0, now=100.0)
+    report = led.reap(heartbeat_ttl=10.0, now=100.0)
+    assert report.dead_hosts == ["a"]
+    assert sorted(report.redone) == ["j0", "j1", "j2"]
+    # survivor completes one; the zombie's late commit for that job
+    # is fenced while its OTHER stale leases fence independently
+    lease_b = led.lease("b", ttl=30.0, now=100.0)
+    final = str(tmp_path / "r.json")
+    staged = str(tmp_path / "stage-b")
+    with open(staged, "w") as f:
+        f.write("{}")
+    led.complete(lease_b, "b", {final: staged})
+    for stale in leases:
+        late = str(tmp_path / ("stage-a-" + stale.item_id))
+        with open(late, "w") as f:
+            f.write("{}")
+        with pytest.raises(StaleResultError):
+            led.complete(stale, "a", {final + ".x": late})
+        assert not os.path.exists(late)
+
+
+def test_fleet_replica_batch_lease_kill_exactly_once(tmp_path,
+                                                     tiny_beam):
+    """Chaos with batches in flight: replica A dies at the
+    batch-leased point holding a whole same-bucket batch; B reaps,
+    re-admits, and completes everything exactly once with the
+    deterministic stub bytes."""
+    fleetdir = tmp_path / "fleet"
+    led = JobLedger(str(fleetdir))
+    for i in range(3):
+        led.admit(_spec(tiny_beam, seed=i), bucket="B")
+    svc_a, rep_a = _stub_fleet(tmp_path, "a", fleetdir,
+                               max_inflight=2, lease_batch=2)
+    rep_a.kill_on = "batch-leased"
+    svc_b, rep_b = _stub_fleet(tmp_path, "b", fleetdir,
+                               max_inflight=2, lease_batch=2)
+    try:
+        rep_a.start()
+        assert _wait(lambda: rep_a._killed, timeout=30.0)
+        state = led.read()
+        stranded = [j for j, v in state["jobs"].items()
+                    if v["owner"] == "a"]
+        assert len(stranded) == 2          # died holding the batch
+        assert svc_a.obs.metrics.get(
+            "fleet_batch_leases_total").value == 1
+        rep_b.start()
+        assert _wait(led.all_terminal, timeout=30.0)
+        state = led.read()
+        for jid, row in state["jobs"].items():
+            assert row["state"] == DONE and row["owner"] == "b"
+            detail = json.load(open(os.path.join(
+                str(fleetdir), "jobs", jid, "result.json")))
+            seed = detail["result"]["seed"]
+            assert detail["artifacts"]["stub.dat"]["sha256"] == \
+                hashlib.sha256(stub_bytes(seed)).hexdigest()
+        for jid in stranded:
+            assert state["jobs"][jid]["redos"] == 1
+        assert svc_b.obs.metrics.get(
+            "fleet_jobs_committed_total").value == 3
+    finally:
+        rep_a.stop()
+        rep_b.stop()
+        svc_a.stop()
+        svc_b.stop()
+
+
+# ----------------------------------------------------------------------
+# idle-capacity tuning (ISSUE 10 satellite)
+# ----------------------------------------------------------------------
+
+def test_fleet_idle_tune_runs_bounded_slice(tmp_path):
+    """An idle replica (empty ledger, tune_in_idle on) runs ONE
+    bounded presto-tune slice and merge-saves into the fleet's shared
+    tuning DB; off by default."""
+    fleetdir = tmp_path / "fleet"
+    svc, rep = _stub_fleet(tmp_path, "r1", fleetdir,
+                           tune_in_idle=True,
+                           idle_tune_families="plancache_bucket",
+                           idle_tune_budget_s=10.0,
+                           idle_tune_interval=3600.0)
+    try:
+        rep.start()
+        assert _wait(lambda: svc.obs.metrics.get(
+            "fleet_idle_tune_total") is not None
+            and svc.obs.metrics.get(
+                "fleet_idle_tune_total").value >= 1, timeout=30.0)
+        db_path = os.path.join(str(fleetdir), "tune.json")
+        assert _wait(lambda: os.path.exists(db_path), timeout=10.0)
+        from presto_tpu.tune import TuneDB
+        db = TuneDB.load(db_path)
+        _nfp, nrec = db.size()
+        assert nrec >= 1
+        assert any(e["kind"] == "fleet-idle-tune"
+                   for e in svc.events.tail(100))
+        # paced: the long interval means exactly one slice ran
+        time.sleep(0.5)
+        assert svc.obs.metrics.get(
+            "fleet_idle_tune_total").value == 1
+    finally:
+        rep.stop()
+        svc.stop()
+
+
+def test_fleet_idle_tune_off_by_default(tmp_path):
+    fleetdir = tmp_path / "fleet"
+    svc, rep = _stub_fleet(tmp_path, "r1", fleetdir)
+    try:
+        rep.start()
+        time.sleep(0.5)
+        fam = svc.obs.metrics.get("fleet_idle_tune_total")
+        assert fam is None or fam.value == 0
+        assert not os.path.exists(
+            os.path.join(str(fleetdir), "tune.json"))
+    finally:
+        rep.stop()
+        svc.stop()

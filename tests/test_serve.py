@@ -481,7 +481,7 @@ def test_service_metrics_json_shape_and_prometheus(tmp_path):
         assert set(m["scheduler"]) == {
             "alive", "jobs_done", "jobs_failed", "retries",
             "retry_waiting", "batches", "degrades",
-            "batch_occupancy"}
+            "batch_occupancy", "stacked_batches", "stacked_jobs"}
         assert set(m["plans"]) == {"size", "capacity", "hits",
                                    "misses", "evictions", "compile_s",
                                    "hit_rate"}

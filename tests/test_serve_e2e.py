@@ -72,8 +72,11 @@ def serve_run(beam_and_batch):
     scfg = SchedulerConfig(max_batch=8, poll_s=0.02, max_retries=2,
                            backoff_base_s=0.05, backoff_max_s=1.0,
                            fault_injector=injector)
+    # stacked=False pins the CLASSIC per-job batch path this fixture's
+    # assertions were written against (per-attempt injector timing);
+    # the stacked executor has its own e2e in test_serve_stacked.py
     service = SearchService(os.path.join(root, "serve"),
-                            scheduler_cfg=scfg)
+                            scheduler_cfg=scfg, stacked=False)
     httpd = start_http(service)
     host, port = httpd.server_address[:2]
     url = "http://%s:%d" % (host, port)

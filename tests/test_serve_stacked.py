@@ -1,0 +1,384 @@
+"""Stacked cross-job batch execution (ISSUE 10): the filled
+`batch_executor` seam.
+
+Covers the geometry planner (sub-stack sizes, tuned max-stack x
+pad-bucket scheme, HBM clamp), the stack-compatibility signature, the
+merged-seam sharding guard, the chaos contract (a fault inside the
+stacked path degrades gracefully to per-job execution with byte-equal
+results), the `serve_batch_geometry` tune family, and the acceptance
+e2e: K same-bucket jobs with the stacked executor ON vs OFF produce
+identical result artifacts with `serve_stacked_jobs_total >= K` and
+strictly fewer device-chain dispatches (compiles no greater — the
+plan cache already amortizes those across the per-job batch)."""
+
+import json
+import os
+import time
+
+import pytest
+
+from presto_tpu.serve.batchexec import (DEFAULT_MAX_STACK,
+                                        StackedBatchExecutor,
+                                        StackIncompatible,
+                                        plan_stack_sizes,
+                                        resolve_stack_geometry,
+                                        stack_signature)
+from presto_tpu.serve.fleet import artifact_digests
+from presto_tpu.serve.queue import Job, JobStatus
+from presto_tpu.serve.server import SearchService
+
+# Small but nontrivial beam: 6 DM trials (never mesh-sharded under
+# the conftest 8-device mesh: 6 % 8 != 0), single-pulse on so the
+# stacked chain covers dedisp -> rFFT -> accelsearch -> single-pulse.
+CFG = {"lodm": 50.0, "hidm": 56.0, "nsub": 8, "zmax": 0,
+       "numharm": 2, "fold_top": 0, "singlepulse": True,
+       "skip_rfifind": True, "durable_stages": True}
+K = 3
+
+
+@pytest.fixture(scope="module")
+def beam_and_ref(tmp_path_factory):
+    """One synthetic beam + the batch driver's never-served reference
+    run (the byte-equality referee for every stacked trial)."""
+    from tools.serve_loadgen import make_beams
+    from presto_tpu.pipeline.survey import SurveyConfig, run_survey
+    root = tmp_path_factory.mktemp("stacked")
+    beam = make_beams(str(root), 1, nsamp=4096, nchan=8)[0]
+    refdir = str(root / "ref")
+    run_survey([beam], SurveyConfig(**CFG), workdir=refdir)
+    ref = artifact_digests(refdir)
+    assert ref, "reference run wrote no comparable artifacts"
+    return beam, ref
+
+
+def _spec(beam, **extra):
+    cfg = dict(CFG)
+    cfg.update(extra)
+    return {"rawfiles": [beam], "config": cfg}
+
+
+def _wait(cond, timeout=300.0, poll=0.05):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if cond():
+            return True
+        time.sleep(poll)
+    return False
+
+
+# ----------------------------------------------------------------------
+# geometry planner
+# ----------------------------------------------------------------------
+
+def test_plan_stack_sizes_schemes():
+    # exact: biggest bite each time; every occupancy its own shape
+    assert plan_stack_sizes(5, 8, "exact") == [5]
+    assert plan_stack_sizes(9, 4, "exact") == [4, 4, 1]
+    # pow2: bites at power-of-two sizes so recurring occupancies
+    # reuse one compiled stacked program
+    assert plan_stack_sizes(5, 8, "pow2") == [4, 1]
+    assert plan_stack_sizes(7, 4, "pow2") == [4, 2, 1]
+    assert plan_stack_sizes(8, 8, "pow2") == [8]
+    # bounds
+    assert plan_stack_sizes(0) == []
+    assert plan_stack_sizes(3, 1, "pow2") == [1, 1, 1]
+    assert sum(plan_stack_sizes(23, 6, "pow2")) == 23
+
+
+def test_resolve_stack_geometry_defaults_and_hbm_clamp():
+    max_stack, scheme = resolve_stack_geometry()
+    assert max_stack == DEFAULT_MAX_STACK and scheme == "exact"
+    # HBM clamp: a job whose chain working set is 1 GiB fits 3 deep
+    # in the 3 GiB group budget regardless of the tuned max
+    max_stack, _ = resolve_stack_geometry([1 << 30, 1 << 20])
+    assert max_stack == 3
+    # a monster job still stacks at least 1 (degrading to per-job
+    # sized sub-stacks, never an OOM plan)
+    max_stack, _ = resolve_stack_geometry([64 << 30])
+    assert max_stack == 1
+
+
+def test_resolve_stack_geometry_consults_tune_db(tmp_path):
+    from presto_tpu import tune
+    from presto_tpu.tune import TuneDB, fingerprint_key
+    db_path = str(tmp_path / "tune.json")
+    db = TuneDB()
+    db.record(fingerprint_key(), "serve_batch_geometry",
+              tune.GLOBAL_KEY, {"max_stack": 2, "scheme": "pow2"},
+              0.001, reps=1)
+    db.save(db_path)
+    tune.configure(enabled=True, db_path=db_path)
+    try:
+        max_stack, scheme = resolve_stack_geometry()
+        assert (max_stack, scheme) == (2, "pow2")
+    finally:
+        tune.reset()
+
+
+def test_serve_batch_geometry_family_smoke():
+    """The tune family enumerates (max_stack x scheme) candidates and
+    its miniature stacked-chain bench runs on the CPU backend."""
+    from presto_tpu.tune.space import FAMILIES
+    fam = FAMILIES["serve_batch_geometry"]
+    shape = fam.shapes(True)[0]
+    cands = fam.candidates(shape)
+    assert {"max_stack": 2, "scheme": "exact"} in cands
+    assert {"max_stack": 4, "scheme": "pow2"} in cands
+    fn = fam.bench(shape, {"max_stack": 2, "scheme": "pow2"})
+    out = fn()
+    assert out is not None
+
+
+# ----------------------------------------------------------------------
+# stack compatibility
+# ----------------------------------------------------------------------
+
+def _fake_job(i, cfg=None, bucket="b", run=None):
+    return Job(job_id="j%d" % i, rawfiles=[], cfg=cfg,
+               workdir="/tmp/j%d" % i, bucket=bucket, run=run)
+
+
+def test_check_stackable_rejections():
+    from presto_tpu.pipeline.survey import SurveyConfig
+    cfg = SurveyConfig(**{k: v for k, v in CFG.items()})
+    jobs = [_fake_job(i, cfg=cfg) for i in range(2)]
+    StackedBatchExecutor.check_stackable(jobs)       # compatible
+    with pytest.raises(StackIncompatible):           # singleton
+        StackedBatchExecutor.check_stackable(jobs[:1])
+    with pytest.raises(StackIncompatible):           # callable job
+        StackedBatchExecutor.check_stackable(
+            [jobs[0], _fake_job(9, cfg=cfg, run=lambda j: {})])
+    other = SurveyConfig(**dict(CFG, sp_threshold=6.5))
+    assert stack_signature(other) != stack_signature(cfg)
+    with pytest.raises(StackIncompatible):           # mixed configs
+        StackedBatchExecutor.check_stackable(
+            [jobs[0], _fake_job(9, cfg=other)])
+    with pytest.raises(StackIncompatible):           # mixed buckets
+        StackedBatchExecutor.check_stackable(
+            [jobs[0], _fake_job(9, cfg=cfg, bucket="c")])
+    ecfg = SurveyConfig(**dict(CFG, elastic=True))
+    with pytest.raises(StackIncompatible):           # elastic
+        StackedBatchExecutor.check_stackable(
+            [_fake_job(0, cfg=ecfg), _fake_job(1, cfg=ecfg)])
+
+
+def test_kill_switch_env(monkeypatch):
+    from presto_tpu.pipeline.survey import SurveyConfig
+    cfg = SurveyConfig(**{k: v for k, v in CFG.items()})
+    jobs = [_fake_job(i, cfg=cfg) for i in range(2)]
+    monkeypatch.setenv("PRESTO_TPU_STACKED", "0")
+    with pytest.raises(StackIncompatible):
+        StackedBatchExecutor.check_stackable(jobs)
+
+
+def test_merged_seam_rejects_sharded_blocks():
+    """Mesh-sharded seam blocks cannot concatenate across jobs: the
+    merge raises and the scheduler's degrade path takes over."""
+    import numpy as np
+    from presto_tpu.pipeline import fusion
+    from presto_tpu.pipeline.survey import (StackedSeamError,
+                                            SurveyConfig,
+                                            _merged_seam)
+
+    class _FakeMesh:
+        pass
+
+    block = fusion.ShardedSeamBlock(
+        names=["a_DM1.00"], infos=[None], dms=[1.0],
+        series_dev=None, series_host=np.zeros((1, 8), np.float32),
+        valid=8, numout=8, dt=1e-3, mesh=_FakeMesh())
+    seam = fusion.StageSeam("/tmp", durable=False)
+    seam.blocks.append(block)
+    ctx = {"cfg": SurveyConfig(), "workdir": "/tmp", "seam": seam}
+    with pytest.raises(StackedSeamError):
+        _merged_seam([ctx], None, None)
+
+
+# ----------------------------------------------------------------------
+# acceptance e2e: stacked ON vs OFF
+# ----------------------------------------------------------------------
+
+def _run_arm(workdir, beam, stacked, n_jobs=K, specs=None,
+             scheduler_cfg=None):
+    """One service arm: submit before start (provable coalescing),
+    wait out the batch, return (service stats + jaxtel snapshot +
+    per-job digests).  The caller stops the service."""
+    from presto_tpu.obs import jaxtel
+    svc = SearchService(workdir, queue_depth=16, stacked=stacked,
+                        scheduler_cfg=scheduler_cfg)
+    specs = specs or [_spec(beam) for _ in range(n_jobs)]
+    jids = [svc.submit(s)["job_id"] for s in specs]
+    svc.start()
+    ok = svc.wait(jids, timeout=600.0)
+    jobs = [svc.get_job(j) for j in jids]
+    return svc, dict(
+        ok=ok, jobs=jobs,
+        statuses=[j.status for j in jobs],
+        digests=[artifact_digests(j.workdir) for j in jobs],
+        snap=jaxtel.transfer_snapshot(svc.obs),
+        stats=svc.scheduler.stats(),
+        kinds=[e["kind"] for e in svc.events.tail(2000)])
+
+
+def test_stacked_vs_perjob_acceptance(tmp_path, beam_and_ref):
+    """ISSUE 10 acceptance: K same-bucket jobs, executor on vs off —
+    identical result artifacts, serve_stacked_jobs_total >= K, and
+    strictly fewer device-chain dispatches on the stacked path (with
+    compiles no greater; the plan cache already holds compiles flat
+    across the per-job batch, so the dispatch collapse is the win)."""
+    beam, ref = beam_and_ref
+    svc_a = svc_b = None
+    try:
+        svc_a, perjob = _run_arm(str(tmp_path / "perjob"), beam,
+                                 stacked=False)
+        svc_b, stacked = _run_arm(str(tmp_path / "stacked"), beam,
+                                  stacked=True)
+        assert perjob["ok"] and stacked["ok"]
+        assert perjob["statuses"] == ["done"] * K
+        assert stacked["statuses"] == ["done"] * K
+
+        # byte-identity: every job in BOTH arms equals the reference
+        for d in perjob["digests"] + stacked["digests"]:
+            assert d == ref
+
+        # the stacked path really ran (no silent degrade)
+        st = stacked["stats"]
+        assert st["stacked_jobs"] >= K
+        assert st["stacked_batches"] >= 1
+        assert st["degrades"] == 0
+        assert perjob["stats"]["stacked_jobs"] == 0
+        reg = svc_b.obs.metrics
+        assert reg.get("serve_stacked_jobs_total").value >= K
+        assert reg.get("serve_batch_occupancy").count >= 1
+
+        # the executor's span + per-job execute events
+        assert "schedule" in stacked["kinds"]
+        assert stacked["kinds"].count("execute") >= K
+
+        # strictly fewer device-chain dispatches; compiles no greater
+        pj, stk = perjob["snap"], stacked["snap"]
+        assert stk["dispatches"] < pj["dispatches"], (stk, pj)
+        assert stk["compiles"] <= pj["compiles"]
+        assert (stk["compiles"] + stk["dispatches"]
+                < pj["compiles"] + pj["dispatches"])
+
+        # result payloads carry the stacked occupancy
+        job = stacked["jobs"][0]
+        assert job.result["stacked"] == K
+        assert job.result["n_datfiles"] >= 1
+    finally:
+        for svc in (svc_a, svc_b):
+            if svc is not None:
+                svc.stop()
+
+
+# ----------------------------------------------------------------------
+# chaos: faults inside the stacked path degrade gracefully
+# ----------------------------------------------------------------------
+
+def test_transient_fault_in_stacked_path_degrades(tmp_path,
+                                                  beam_and_ref):
+    """TransientFaults fired inside the stacked attempt: the whole
+    batch degrades to per-job execution (one degrade event, no
+    collective failure) and every job's artifacts stay byte-equal to
+    the reference."""
+    from presto_tpu.serve.scheduler import SchedulerConfig
+    from presto_tpu.testing.chaos import TransientFaults
+    beam, ref = beam_and_ref
+    faults = TransientFaults(fail_attempts=1)
+    scfg = SchedulerConfig(max_batch=8, poll_s=0.02, max_retries=2,
+                           backoff_base_s=0.05,
+                           fault_injector=faults)
+    svc, arm = _run_arm(str(tmp_path / "chaos"), beam, stacked=True,
+                        n_jobs=2, scheduler_cfg=scfg)
+    try:
+        assert arm["ok"]
+        assert arm["statuses"] == ["done", "done"]
+        assert "degrade" in arm["kinds"]
+        assert arm["stats"]["degrades"] >= 1
+        for d in arm["digests"]:
+            assert d == ref
+        # the injector saw the stacked attempt, then the per-job ones
+        # (after which the retried jobs may legitimately re-coalesce
+        # and complete through a second stacked batch)
+        assert faults.calls >= 3
+    finally:
+        svc.stop()
+
+
+def test_fault_inside_stacked_chain_degrades(tmp_path, beam_and_ref):
+    """A fault raised mid-chain (at the fused-chunk kill point, with
+    the merged cross-job seam resident) aborts the stacked batch;
+    the per-job redo produces byte-equal artifacts — the verify-not-
+    trust resume contract makes the partial head work safe."""
+    beam, ref = beam_and_ref
+
+    class _RaiseOnce:
+        def __init__(self, at):
+            self.at = at
+            self.fired = 0
+
+        def point(self, name):
+            if name == self.at and not self.fired:
+                self.fired += 1
+                raise RuntimeError(
+                    "injected stacked-chain fault at %s" % name)
+
+    injector = _RaiseOnce("fused-chunk")
+    svc = SearchService(str(tmp_path / "midchain"), queue_depth=16,
+                        stacked=True)
+    try:
+        jobs = [svc.build_job(_spec(beam)) for _ in range(2)]
+        for job in jobs:
+            job.cfg.fault_injector = injector
+            svc.enqueue_job(job)
+        svc.start()
+        assert svc.wait([j.job_id for j in jobs], timeout=600.0)
+        assert [j.status for j in jobs] == ["done", "done"]
+        assert injector.fired == 1          # fired inside the chain
+        kinds = [e["kind"] for e in svc.events.tail(2000)]
+        assert "degrade" in kinds
+        for j in jobs:
+            assert artifact_digests(j.workdir) == ref
+    finally:
+        svc.stop()
+
+
+def test_mixed_config_batch_degrades_per_job(tmp_path, beam_and_ref):
+    """Same bucket, different single-pulse thresholds: the signature
+    check refuses to stack and each job runs (correctly) per-job."""
+    beam, _ref = beam_and_ref
+    specs = [_spec(beam), _spec(beam, sp_threshold=6.5)]
+    svc, arm = _run_arm(str(tmp_path / "mixed"), beam, stacked=True,
+                        specs=specs)
+    try:
+        assert arm["ok"]
+        assert arm["statuses"] == ["done", "done"]
+        assert arm["stats"]["stacked_jobs"] == 0
+        assert "degrade" in arm["kinds"]
+        # the two jobs really had one bucket (they were coalesced)
+        scheds = [e for e in svc.events.tail(2000)
+                  if e["kind"] == "schedule"]
+        assert scheds and scheds[0]["occupancy"] == 2
+    finally:
+        svc.stop()
+
+
+def test_stacked_result_equals_perjob_result_payload(tmp_path,
+                                                     beam_and_ref):
+    """The stacked result dict carries the same summary fields the
+    per-job executor returns (plus the stacked occupancy), so /jobs
+    consumers see one schema."""
+    beam, _ref = beam_and_ref
+    svc, arm = _run_arm(str(tmp_path / "payload"), beam,
+                        stacked=True, n_jobs=2)
+    try:
+        assert arm["ok"]
+        for job in arm["jobs"]:
+            assert {"workdir", "candfile", "n_datfiles", "n_cands",
+                    "folded", "sp_events",
+                    "stage_seconds"} <= set(job.result)
+            assert json.dumps(job.result)    # JSON-safe (the /jobs
+            assert job.started > 0           # payload contract)
+    finally:
+        svc.stop()

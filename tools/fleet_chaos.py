@@ -43,7 +43,11 @@ TINY_CFG = {"lodm": 50.0, "hidm": 56.0, "nsub": 8, "zmax": 0,
             "numharm": 2, "fold_top": 0, "singlepulse": False,
             "skip_rfifind": True, "durable_stages": True}
 
-KILL_POINTS = ("job-leased", "job-enqueued", "timed")
+#: "batch-leased" fires while the victim holds a whole same-bucket
+#: batch claimed in one lease_batch transaction (ISSUE 10): the
+#: reaper must re-admit every member and the survivors complete each
+#: exactly once.
+KILL_POINTS = ("job-leased", "job-enqueued", "batch-leased", "timed")
 
 
 def _wait(cond, timeout, poll=0.05):
@@ -57,7 +61,7 @@ def _wait(cond, timeout, poll=0.05):
 
 def run_trial(trial: int, rng: random.Random, beam: str, ref: dict,
               workdir: str, replicas: int, jobs: int,
-              timeout: float) -> dict:
+              timeout: float, lease_batch: int = 2) -> dict:
     from presto_tpu.serve.fleet import FleetConfig, FleetReplica
     from presto_tpu.serve.jobledger import JobLedger
     from presto_tpu.serve.queue import JobStatus
@@ -66,7 +70,9 @@ def run_trial(trial: int, rng: random.Random, beam: str, ref: dict,
     fleetdir = os.path.join(workdir, "trial%02d" % trial, "fleet")
     led = JobLedger(fleetdir)
     for _ in range(jobs):
-        led.admit({"rawfiles": [beam], "config": dict(TINY_CFG)})
+        # one shared bucket hint: lease_batch may claim whole batches
+        led.admit({"rawfiles": [beam], "config": dict(TINY_CFG)},
+                  bucket="chaos-bucket")
     kill_point = rng.choice(KILL_POINTS)
     kill_delay = rng.uniform(0.2, 2.0)
     victim_idx = rng.randrange(replicas)
@@ -85,7 +91,12 @@ def run_trial(trial: int, rng: random.Random, beam: str, ref: dict,
                               replica="rep%d" % i,
                               lease_ttl=30.0, heartbeat_s=0.1,
                               heartbeat_timeout=0.8, poll_s=0.05,
-                              max_inflight=1, prewarm=False)
+                              max_inflight=max(
+                                  1, lease_batch
+                                  if kill_point == "batch-leased"
+                                  else 1),
+                              lease_batch=lease_batch,
+                              prewarm=False)
             rep = FleetReplica(svc, cfg)
             if i == victim_idx and kill_point != "timed":
                 rep.kill_on = kill_point
@@ -158,6 +169,9 @@ def main(argv=None) -> int:
     p.add_argument("-nsamp", type=int, default=4096)
     p.add_argument("-nchan", type=int, default=8)
     p.add_argument("-timeout", type=float, default=300.0)
+    p.add_argument("-lease-batch", type=int, default=2,
+                   help="Same-bucket jobs leased per transaction "
+                        "(drives the batch-leased kill point)")
     p.add_argument("-workdir", type=str, default=None)
     p.add_argument("-out", type=str, default=None,
                    help="Report path (default <repo>/FLEET_CHAOS.json"
@@ -187,7 +201,8 @@ def main(argv=None) -> int:
     trials = []
     for t in range(args.trials):
         rec = run_trial(t, rng, beam, ref, workdir, args.replicas,
-                        args.jobs, args.timeout)
+                        args.jobs, args.timeout,
+                        lease_batch=args.lease_batch)
         print("fleet_chaos: trial %d kill=%s victim=%s -> %s"
               % (t, rec["kill_point"], rec["victim"],
                  "PASS" if rec["ok"] else "FAIL"), flush=True)

@@ -62,7 +62,12 @@ code path cannot ship silently:
      same accommodation check 2b makes for the refactored shard
      ledger) — the fleet recovery path is exactly the code that runs
      while a replica is dying, so its telemetry may neither go dark
-     nor go stale.
+     nor go stale;
+  11. serve-layer spans (presto_tpu/serve/): every `obs.span("...")`
+     name the serve layer opens is registered in SERVE_SPANS — and
+     conversely — so the scheduler's per-job span and the stacked
+     batch executor's cross-job `serve:stacked-batch` span can
+     neither ship dark nor linger in the catalog after a rename.
 
 Run directly (exit 1 lists violations) or via tests/test_obs_lint.py.
 """
@@ -403,6 +408,24 @@ def lint() -> List[str]:
         problems.append(
             "fleet layer: metric %r is not registered in "
             "obs/taxonomy.FLEET_METRICS" % m)
+
+    # 11. serve-layer spans both directions (the stacked batch
+    # executor's cross-job span is the one covering the serving
+    # tier's biggest device calls — it may neither go dark nor stay
+    # in the catalog after a rename)
+    svspans: Set[str] = set()
+    for rel, src in sorted(serve_srcs.items()):
+        spans = set(SPAN_RE.findall(src))
+        svspans |= spans
+        for s in sorted(spans - taxonomy.SERVE_SPANS):
+            problems.append(
+                "%s: span %r is not registered in "
+                "obs/taxonomy.SERVE_SPANS (uninstrumented serve "
+                "path)" % (rel, s))
+    for s in sorted(taxonomy.SERVE_SPANS - svspans):
+        problems.append(
+            "obs/taxonomy.py: SERVE_SPANS lists %r but the serve "
+            "layer never opens it" % s)
     return problems
 
 
