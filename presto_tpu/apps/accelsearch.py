@@ -79,10 +79,19 @@ def write_cand_file(path: str, cands) -> None:
 
 
 def read_cand_file(path: str):
+    """Parse a binary ACCEL .cand companion.  Missing / truncated /
+    malformed files raise the typed PrestoIOError (path + size
+    context): a DAG fold node handed a corrupt candidate file fails
+    terminal with a diagnosable event, never a bare OSError."""
+    from presto_tpu.io.errors import PrestoIOError
     from presto_tpu.search.accel import AccelCand
     rec = struct.calcsize("<ffiddd")          # 36: current format
     legacy = struct.calcsize("<ffidd")        # 28: pre-jerk format
-    size = os.path.getsize(path)
+    try:
+        size = os.path.getsize(path)
+    except OSError as e:
+        raise PrestoIOError("cannot read .cand: %s" % e.strerror,
+                            path=path, kind="missing") from None
 
     def parse(fmt, rlen, has_w):
         cands = []
@@ -112,8 +121,12 @@ def read_cand_file(path: str):
     if size % legacy == 0:
         candidates.append(("<ffidd", legacy, False))
     if not candidates:
-        raise ValueError("%s: not a .cand file (size %d fits neither "
-                         "record format)" % (path, size))
+        raise PrestoIOError(
+            "not a .cand file (size fits neither the %d- nor the "
+            "%d-byte record format)" % (rec, legacy), path=path,
+            offset=size - size % rec,
+            expected_bytes=(size // rec + 1) * rec,
+            actual_bytes=size, kind="truncated-data")
     for fmt, rlen, has_w in candidates:
         out = parse(fmt, rlen, has_w)
         if sane(out):

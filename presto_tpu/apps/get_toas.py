@@ -41,26 +41,53 @@ def _load_template(path: str) -> np.ndarray:
     if path.endswith(".bestprof"):
         from presto_tpu.io.bestprof import read_bestprof
         return read_bestprof(path).profile
-    return np.loadtxt(path, usecols=(-1,))
+    try:
+        return np.loadtxt(path, usecols=(-1,))
+    except OSError as e:
+        from presto_tpu.io.errors import PrestoIOError
+        raise PrestoIOError("cannot read template: %s" % e,
+                            path=path, kind="missing") from None
+
+
+def toa_lines(pfdfiles, ntoa: int = 1, gauss_fwhm: float = 0.1,
+              template: np.ndarray = None, dm: float = None,
+              fmt: str = "princeton"):
+    """The CLI's per-.pfd TOA loop as a function: read each fold,
+    extract `ntoa` TOAs, format one .tim line set — the single source
+    of the get_TOAs byte layout, shared with the discovery-DAG timing
+    node (serve/dag.py) so a DAG's toas.tim is byte-equal to the
+    hand-driven CLI's.  Corrupt/missing .pfd inputs surface the typed
+    PrestoIOError from io/pfd.read_pfd."""
+    from presto_tpu.astro.observatory import tempo1_site_code
+    from presto_tpu.timing.toas import format_tim_lines
+    all_toas, names = [], []
+    for path in pfdfiles:
+        p = read_pfd(path)
+        fold_dm = p.bestdm if dm is not None else None
+        toas = toas_from_pfd(
+            p, template=template, ntoa=ntoa, dm=dm,
+            fold_dm=fold_dm, gauss_fwhm=gauss_fwhm,
+            obs=tempo1_site_code(p.telescope))
+        all_toas.extend(toas)
+        names.extend([p.candnm or "unk"] * len(toas))
+    return format_tim_lines(all_toas, names, fmt)
 
 
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
-    from presto_tpu.astro.observatory import tempo1_site_code
-    from presto_tpu.timing.toas import format_tim_lines
-    template = _load_template(args.t) if args.t else None
-    fmt = "tempo2" if args.tempo2 else "princeton"
-    all_toas, names = [], []
-    for path in args.pfdfiles:
-        p = read_pfd(path)
-        fold_dm = p.bestdm if args.d is not None else None
-        toas = toas_from_pfd(
-            p, template=template, ntoa=args.n, dm=args.d,
-            fold_dm=fold_dm, gauss_fwhm=args.g,
-            obs=tempo1_site_code(p.telescope))
-        all_toas.extend(toas)
-        names.extend([p.candnm or "unk"] * len(toas))
-    lines = format_tim_lines(all_toas, names, fmt)
+    from presto_tpu.io.errors import PrestoIOError
+    try:
+        template = _load_template(args.t) if args.t else None
+        lines = toa_lines(args.pfdfiles, ntoa=args.n,
+                          gauss_fwhm=args.g, template=template,
+                          dm=args.d,
+                          fmt="tempo2" if args.tempo2
+                          else "princeton")
+    except PrestoIOError as e:
+        # one-line diagnosis, not a parser traceback (readfile's
+        # convention for corrupt inputs)
+        print("get_TOAs: %s" % e)
+        return 1
     if args.o:
         with open(args.o, "w") as fh:
             fh.write("\n".join(lines) + "\n")

@@ -583,6 +583,174 @@ def run(args):
     return res
 
 
+# ----------------------------------------------------------------------
+# Stacked .dat candidate folding (the discovery-DAG fold executor)
+# ----------------------------------------------------------------------
+
+from dataclasses import dataclass as _dataclass
+
+
+@_dataclass
+class DatFoldSpec:
+    """One DAG fold node's payload: fold accelsearch candidate
+    ``candnum`` of ``accelfile`` (the binary .cand companion) from
+    the dedispersed series ``datfile``, writing
+    ``outbase``.pfd/.bestprof."""
+    datfile: str
+    accelfile: str
+    candnum: int
+    outbase: str
+    dm: float = 0.0         # CLI -dm parity; .dat folds use the .inf DM
+
+
+def fold_stack_key(N: int, dt: float, proflen: int,
+                   npart: int = 64, subdiv: int = 1) -> str:
+    """The fold stack signature: two fold jobs may share one stacked
+    drizzle dispatch only when series length, sample time, profile
+    bins, sub-integrations, and the drizzle subdivision all match.
+    Used as the DAG fold job's ledger/queue bucket."""
+    return "fold:%d:%r:%d:%d:%d" % (int(N), float(dt), int(proflen),
+                                    int(npart), int(subdiv))
+
+
+def fold_geometry(datfile: str, f: float, fd: float = 0.0,
+                  npart: int = 64):
+    """(N, dt, proflen, subdiv) a fold of `datfile` at frequency `f`
+    will use — computed from the .inf alone (no data read), so the
+    sift node can bucket its fold fan-out at expand time with the
+    exact stack signature fold_dat_cands will group by."""
+    from presto_tpu.io.infodata import read_inf
+    info = read_inf(datfile[:-4] if datfile.endswith(".dat")
+                    else datfile)
+    N, dt = int(info.N), float(info.dt)
+    proflen = _auto_proflen(1.0 / f, dt)
+    fmax = max(abs(f), abs(f + fd * N * dt))     # plan_fold's rule
+    subdiv = max(1, int(np.ceil(fmax * dt * proflen)))
+    return N, dt, proflen, subdiv
+
+
+def accel_cand_fold_params(accelfile: str, candnum: int, T: float):
+    """(f, fd, fdd) for one .cand candidate — the -accelfile branch of
+    _fold_params, shared with the DAG fold executor so both paths do
+    the identical mean-value -> Taylor-coefficient conversion."""
+    from presto_tpu.apps.accelsearch import read_cand_file
+    cands = read_cand_file(accelfile)
+    idx = max(int(candnum), 1) - 1
+    if idx >= len(cands):
+        raise ValueError("accelcand %d not in %s (%d candidates)"
+                         % (candnum, accelfile, len(cands)))
+    c = cands[idx]
+    fdd = c.w / (T * T * T)
+    fd0 = (c.z - c.w / 2.0) / (T * T)
+    f0 = (c.r - c.z / 2.0 + c.w / 12.0) / T
+    return f0, fd0, fdd
+
+
+def fold_dat_cands(specs, obs=None):
+    """Fold accelsearch candidates from .dat series — the discovery
+    DAG's fold-node executor, single or STACKED.
+
+    Same-geometry items (fold_stack_key) coalesce: one batched
+    drizzle dispatch folds every series where N single folds pay N
+    (ops/fold.fold_data_batch), and the profile totals ride one
+    vmapped dispatch (search/prepfold.finish_fold_nosearch).  Device
+    dispatches are accounted on ``jax_dispatches_total{kind=fold*}``
+    — the DAG_r11.json stacked-vs-per-job verdict pins the collapse.
+
+    Byte contract: each .pfd/.bestprof is byte-identical to
+
+        prepfold -accelfile <acc>.cand -accelcand K -dm D -nosearch
+                 -noplot -o <basename outbase> <basename datfile>
+
+    run with the CWD at the artifact locations.  Labels embedded in
+    the artifacts (filenm/pgdev/datnm) are BASENAMES by design: a
+    fleet-served fold must not bake host-specific absolute paths
+    into its science artifacts (the reason .pfd sat outside the
+    fleet byte-equality surface until this existed).
+
+    Returns one result dict per spec (pfd path, best p/pd/redchi)."""
+    from presto_tpu.io.infodata import read_inf
+
+    prepped = []
+    for spec in specs:
+        data, info = load_timeseries(spec.datfile)
+        T = info.N * info.dt
+        f0, fd0, fdd = accel_cand_fold_params(spec.accelfile,
+                                              spec.candnum, T)
+        proflen = _auto_proflen(1.0 / f0, info.dt)
+        cfg = FoldConfig(proflen=proflen, npart=64, nsub=1,
+                         pstep=2, pdstep=4, dmstep=2, npfact=2,
+                         ndmfact=3, search_p=False, search_pd=False,
+                         search_dm=False)
+        fmax = max(abs(f0), abs(f0 + fd0 * data.size * info.dt))
+        subdiv = max(1, int(np.ceil(fmax * info.dt * proflen)))
+        key = fold_stack_key(data.size, info.dt, proflen,
+                             cfg.npart, subdiv)
+        prepped.append({"spec": spec, "data": data, "info": info,
+                        "f": f0, "fd": fd0, "fdd": fdd, "cfg": cfg,
+                        "key": key})
+
+    groups = {}
+    order = []
+    for ent in prepped:
+        if ent["key"] not in groups:
+            order.append(ent["key"])
+        groups.setdefault(ent["key"], []).append(ent)
+
+    from presto_tpu.search.prepfold import (finish_fold_nosearch,
+                                            fold_series_batch)
+    for key in order:
+        ents = groups[key]
+        items = [(e["data"], e["info"].dt, e["f"], e["fd"], e["fdd"],
+                  e["cfg"], e["info"].dm, e["info"].mjd)
+                 for e in ents]
+        results = fold_series_batch(items, obs=obs)
+        finish_fold_nosearch(results, obs=obs)
+        for e, res in zip(ents, results):
+            res.numchan = 1
+            e["res"] = res
+
+    out = []
+    for ent in prepped:
+        spec, res, cfg = ent["spec"], ent["res"], ent["cfg"]
+        info = ent["info"]
+        candnm = info.object or "PSR_CAND"
+        try:
+            perr, pderr = fold_errors(res)
+        except Exception:
+            perr = pderr = 0.0
+        outlabel = os.path.basename(spec.outbase)
+        pfdnm = spec.outbase + ".pfd"
+        pfd = Pfd(
+            numdms=len(res.dms), numperiods=len(res.periods),
+            numpdots=len(res.pdots), nsub=res.nsub, npart=res.npart,
+            proflen=res.proflen, numchan=res.numchan,
+            pstep=cfg.pstep, pdstep=cfg.pdstep, dmstep=cfg.dmstep,
+            ndmfact=cfg.ndmfact, npfact=cfg.npfact,
+            filenm=os.path.basename(spec.datfile), candnm=candnm,
+            telescope=info.telescope or "Unknown",
+            pgdev=outlabel + ".pfd.ps/CPS",
+            dt=res.dt, startT=0.0, endT=1.0, tepoch=res.tepoch,
+            lofreq=res.lofreq, chan_wid=res.chan_wid,
+            bestdm=res.best_dm,
+            topo_p1=res.best_p, topo_p2=res.best_pd,
+            fold_p1=res.fold_f, fold_p2=res.fold_fd,
+            fold_p3=res.fold_fdd,
+            dms=res.dms, periods=res.periods, pdots=res.pdots,
+            profs=res.cube, stats=res.stats)
+        write_pfd(pfdnm, pfd)
+        write_bestprof(pfdnm + ".bestprof", pfd, res.best_prof,
+                       res.best_p, res.best_pd, res.best_redchi,
+                       perr, pderr,
+                       datnm=os.path.basename(spec.datfile),
+                       candnm=candnm)
+        out.append({"pfd": pfdnm, "bestprof": pfdnm + ".bestprof",
+                    "best_p": res.best_p, "best_pd": res.best_pd,
+                    "best_redchi": res.best_redchi,
+                    "stacked": len(groups[ent["key"]])})
+    return out
+
+
 def main(argv=None):
     from presto_tpu.utils.timing import app_timer
     args = build_parser().parse_args(argv)

@@ -32,9 +32,15 @@ def _wstr(f, s: str) -> None:
     f.write(b)
 
 
-def _rstr(f) -> str:
-    n = struct.unpack("<i", f.read(4))[0]
-    return f.read(n).decode()
+def _rstr(f, path: str = "") -> str:
+    from presto_tpu.io.errors import PrestoIOError, read_exact
+    n = struct.unpack("<i", read_exact(f, 4, path,
+                                       "pfd string length"))[0]
+    if n < 0 or n > 1 << 20:
+        raise PrestoIOError("implausible pfd string length %d" % n,
+                            path=path, offset=f.tell() - 4,
+                            kind="bad-magic")
+    return read_exact(f, n, path, "pfd string").decode()
 
 
 @dataclass
@@ -137,35 +143,66 @@ def write_pfd(path: str, p: Pfd) -> None:
 
 
 def read_pfd(path: str) -> Pfd:
+    """Parse one .pfd.  Missing or truncated input raises the typed
+    PrestoIOError (path + byte-offset context) instead of a bare
+    FileNotFoundError / struct.error escape — a discovery-DAG timing
+    node fed a corrupt fold fails terminal with a diagnosable event,
+    not a stack trace into the struct module."""
+    from presto_tpu.io.errors import PrestoIOError, read_exact
     p = Pfd()
-    with open(path, "rb") as f:
+    try:
+        f = open(path, "rb")
+    except OSError as e:
+        raise PrestoIOError("cannot open .pfd: %s" % e.strerror,
+                            path=path, kind="missing") from None
+    with f:
         (p.numdms, p.numperiods, p.numpdots, p.nsub,
-         p.npart) = struct.unpack("<5i", f.read(20))
+         p.npart) = struct.unpack(
+            "<5i", read_exact(f, 20, path, "pfd header"))
         (p.proflen, p.numchan, p.pstep, p.pdstep, p.dmstep, p.ndmfact,
-         p.npfact) = struct.unpack("<7i", f.read(28))
-        p.filenm, p.candnm = _rstr(f), _rstr(f)
-        p.telescope, p.pgdev = _rstr(f), _rstr(f)
-        p.rastr = f.read(16).split(b"\0")[0].decode()
-        p.decstr = f.read(16).split(b"\0")[0].decode()
-        p.dt, p.startT = struct.unpack("<2d", f.read(16))
+         p.npfact) = struct.unpack(
+            "<7i", read_exact(f, 28, path, "pfd header"))
+        p.filenm, p.candnm = _rstr(f, path), _rstr(f, path)
+        p.telescope, p.pgdev = _rstr(f, path), _rstr(f, path)
+        p.rastr = read_exact(f, 16, path,
+                             "pfd header").split(b"\0")[0].decode()
+        p.decstr = read_exact(f, 16, path,
+                              "pfd header").split(b"\0")[0].decode()
+        p.dt, p.startT = struct.unpack(
+            "<2d", read_exact(f, 16, path, "pfd header"))
         (p.endT, p.tepoch, p.bepoch, p.avgvoverc, p.lofreq, p.chan_wid,
-         p.bestdm) = struct.unpack("<7d", f.read(56))
+         p.bestdm) = struct.unpack(
+            "<7d", read_exact(f, 56, path, "pfd header"))
         for pre in ("topo", "bary", "fold"):
-            pow_, _ = struct.unpack("<2f", f.read(8))
-            p1, p2, p3 = struct.unpack("<3d", f.read(24))
+            pow_, _ = struct.unpack(
+                "<2f", read_exact(f, 8, path, "pfd header"))
+            p1, p2, p3 = struct.unpack(
+                "<3d", read_exact(f, 24, path, "pfd header"))
             setattr(p, pre + "_pow", pow_)
             setattr(p, pre + "_p1", p1)
             setattr(p, pre + "_p2", p2)
             setattr(p, pre + "_p3", p3)
         (p.orb_p, p.orb_e, p.orb_x, p.orb_w, p.orb_t, p.orb_pd,
-         p.orb_wd) = struct.unpack("<7d", f.read(56))
-        p.dms = np.fromfile(f, "<f8", p.numdms)
-        p.periods = np.fromfile(f, "<f8", p.numperiods)
-        p.pdots = np.fromfile(f, "<f8", p.numpdots)
+         p.orb_wd) = struct.unpack(
+            "<7d", read_exact(f, 56, path, "pfd header"))
+
+        def _farr(n, what):
+            arr = np.frombuffer(
+                read_exact(f, 8 * n, path, what), "<f8")
+            return arr.copy()
+
+        p.dms = _farr(p.numdms, "pfd dms")
+        p.periods = _farr(p.numperiods, "pfd periods")
+        p.pdots = _farr(p.numpdots, "pfd pdots")
         n = p.npart * p.nsub * p.proflen
-        p.profs = np.fromfile(f, "<f8", n).reshape(
+        if n <= 0 or n > (1 << 28):
+            raise PrestoIOError(
+                "implausible pfd cube %d x %d x %d"
+                % (p.npart, p.nsub, p.proflen), path=path,
+                kind="bad-magic")
+        p.profs = _farr(n, "pfd profile cube").reshape(
             p.npart, p.nsub, p.proflen)
-        p.stats = np.fromfile(f, "<f8", p.npart * p.nsub * 7).reshape(
+        p.stats = _farr(p.npart * p.nsub * 7, "pfd foldstats").reshape(
             p.npart, p.nsub, 7)
     return p
 

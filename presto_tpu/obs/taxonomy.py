@@ -155,6 +155,36 @@ STREAM_SPANS = frozenset({
 SERVE_SPANS = frozenset({
     "serve-job",
     "serve:stacked-batch",
+    "serve:dag-node",
+})
+
+#: discovery-DAG event kinds — the dependency-aware job-graph
+#: vocabulary of serve/dag.py + serve/jobledger.py (graph admission,
+#: the sift node's fenced fan-out transaction, cascade failure of a
+#: failed parent's subtree).  Enforced BOTH directions by obs_lint
+#: check 12: the DAG recovery path (the code that runs while a
+#: mid-graph replica dies) may neither go dark nor go stale.
+DAG_EVENTS = frozenset({
+    "dag-submit",
+    "dag-expand",
+    "dag-cascade-fail",
+})
+
+#: discovery-DAG span names (subset of SERVE_SPANS; check 12 pins the
+#: subset relation and both directions against serve/dag.py)
+DAG_SPANS = frozenset({
+    "serve:dag-node",
+})
+
+#: discovery-DAG metrics — every `dag_*` name must be registered by
+#: the DAG layer (serve/dag.py, serve/jobledger.py, serve/router.py)
+#: and vice versa (obs_lint check 12, both directions)
+DAG_METRICS = frozenset({
+    "dag_submitted_total",
+    "dag_fanout_jobs_total",
+    "dag_cascade_failures_total",
+    "dag_nodes_done_total",
+    "dag_folds_stacked_total",
 })
 
 #: job lifecycle states -> the event kind that announces the
@@ -333,4 +363,11 @@ METRICS = frozenset({
     "stream_gap_spectra_total",
     "stream_backlog_blocks",
     "stream_latency_seconds",
+    # discovery DAGs (serve/dag.py + jobledger.py + router.py);
+    # pinned both directions by obs_lint check 12 via DAG_METRICS
+    "dag_submitted_total",
+    "dag_fanout_jobs_total",
+    "dag_cascade_failures_total",
+    "dag_nodes_done_total",
+    "dag_folds_stacked_total",
 })

@@ -135,6 +135,48 @@ def _drizzle_scatter(vals, b0, b1, w0, w1, nbins, subdiv):
     return out
 
 
+@partial(jax.jit, static_argnames=("nbins", "subdiv"))
+def _drizzle_scatter_rows(vals, b0, b1, w0, w1, nbins, subdiv):
+    """Per-row drizzle: vals [J, T] with per-row index/weight plans
+    b0/b1/w0/w1 [J, T*subdiv] -> [J, nbins].  Row j accumulates
+    bit-identically to _drizzle_scatter run on that row alone (XLA
+    applies scatter updates in update order within each row; pinned
+    by tests/test_dag.py) — the stacked-fold path's byte contract."""
+    if subdiv > 1:
+        vals = jnp.repeat(vals, subdiv, axis=1)
+    rows = jnp.arange(vals.shape[0])[:, None]
+    out = jnp.zeros((vals.shape[0], nbins), jnp.float32)
+    out = out.at[rows, b0].add(vals * w0)
+    out = out.at[rows, b1].add(vals * w1)
+    return out
+
+
+def fold_data_batch(rows, plans) -> np.ndarray:
+    """Fold J one-dimensional series, each under its OWN fold plan,
+    in ONE scatter dispatch (the stacked-fold device call: N
+    same-geometry prepfold jobs ride a single program launch).
+
+    All plans must share (npart, proflen, subdiv) and every series
+    the common length — the fold stack signature (serve/dag.py)
+    guarantees it.  Returns float64 [J, npart, proflen] whose row j
+    is bit-identical to fold_data(rows[j], plans[j])."""
+    p0 = plans[0]
+    if any(p.subdiv != p0.subdiv or p.npart != p0.npart
+           or p.proflen != p0.proflen for p in plans):
+        raise ValueError("fold_data_batch: plans differ in geometry")
+    arr = np.stack([np.asarray(r, np.float32) for r in rows])
+    nbins = p0.npart * p0.proflen
+    out = _drizzle_scatter_rows(
+        jnp.asarray(arr),
+        jnp.asarray(np.stack([p.b0 for p in plans])),
+        jnp.asarray(np.stack([p.b1 for p in plans])),
+        jnp.asarray(np.stack([p.w0 for p in plans])),
+        jnp.asarray(np.stack([p.w1 for p in plans])),
+        nbins, p0.subdiv)
+    return np.asarray(out, dtype=np.float64).reshape(
+        len(plans), p0.npart, p0.proflen)
+
+
 def fold_data(data: np.ndarray, plan: FoldPlan):
     """Fold [C, N] (or [N]) data with a host plan.
 

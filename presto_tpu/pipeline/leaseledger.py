@@ -411,6 +411,32 @@ class LeaseLedger:
         raise self.STALE(lease.item_id, host, int(lease.epoch),
                          int(state["epoch"]), why)
 
+    def _commit_row(self, state: dict, lease, host: str,
+                    staged: Dict[str, str], row: dict, now: float,
+                    extra: Optional[dict] = None) -> Dict[str, dict]:
+        """The commit body shared by complete() and subclass commit
+        transactions (JobLedger.complete_and_expand): rename each
+        staged file onto its final path, journal size+CRC, and flip
+        the row to done.  Must run under the ledger lock, AFTER the
+        fence check; the caller saves the state."""
+        arts: Dict[str, dict] = {}
+        for final, tmp in sorted(staged.items()):
+            os.replace(tmp, final)
+            rel = os.path.relpath(os.path.abspath(final),
+                                  self.workdir)
+            arts[rel] = {"size": os.path.getsize(final),
+                         "checksum": file_checksum(final)}
+        row["state"] = DONE
+        row["owner"] = host
+        row["lease_epoch"] = None
+        row["lease_expires"] = None
+        row["artifacts"] = arts
+        row["completed_epoch"] = int(state["epoch"])
+        row["completed_at"] = now
+        if extra:
+            row.update(extra)
+        return arts
+
     def complete(self, lease, host: str, staged: Dict[str, str],
                  now: Optional[float] = None,
                  extra: Optional[dict] = None) -> Dict[str, dict]:
@@ -428,22 +454,8 @@ class LeaseLedger:
             why = self._fence_why(row, lease, host)
             if why is not None:
                 self._reject_stale(state, lease, host, staged, why)
-            arts: Dict[str, dict] = {}
-            for final, tmp in sorted(staged.items()):
-                os.replace(tmp, final)
-                rel = os.path.relpath(os.path.abspath(final),
-                                      self.workdir)
-                arts[rel] = {"size": os.path.getsize(final),
-                             "checksum": file_checksum(final)}
-            row["state"] = DONE
-            row["owner"] = host
-            row["lease_epoch"] = None
-            row["lease_expires"] = None
-            row["artifacts"] = arts
-            row["completed_epoch"] = int(state["epoch"])
-            row["completed_at"] = now
-            if extra:
-                row.update(extra)
+            arts = self._commit_row(state, lease, host, staged, row,
+                                    now, extra)
             self._save(state)
             self._event(self.EV_DONE, item=lease.item_id, host=host,
                         artifacts=len(arts))

@@ -385,14 +385,54 @@ def read_candidates(filenames: Sequence[str],
                     known_birds_f=(), known_birds_p=(),
                     policy: "SiftPolicy" = None) -> Candlist:
     """Aggregate candidates over many DM trials
-    (sifting.py:1203-1230)."""
+    (sifting.py:1203-1230).
+
+    Ingestion order is made deterministic here — the file list is
+    sorted before reading — because exact-tie resolution in the
+    duplicate/harmonic sifts follows encounter order: a glob whose
+    order depends on the filesystem would make the sifted list (and
+    therefore a discovery DAG's fold fan-out set) differ across
+    hosts byte-for-byte identical inputs."""
     out = Candlist()
-    for fn in filenames:
+    for fn in sorted(filenames):
         cl = candlist_from_accelfile(fn)
         if prelim_reject:
             cl.default_rejection(known_birds_f, known_birds_p, policy)
         out.extend(cl)
     return out
+
+
+def select_fold_candidates(cl: Candlist, fold_top: int = 3,
+                           fold_sigma: Optional[float] = None,
+                           max_folds: int = 150,
+                           max_folds_per_pass: Optional[tuple] = None,
+                           pass_zmaxes: Sequence[int] = ()
+                           ) -> List[Candidate]:
+    """The survey drivers' fold-selection policy, factored so the
+    batch survey (pipeline/survey.py) and the discovery-DAG sift node
+    (serve/dag.py) fan out the SAME candidates.
+
+    With ``fold_sigma`` set: fold everything at or above it, capped at
+    ``max_folds`` — or, with ``max_folds_per_pass``, capped per accel
+    pass (aligned with ``pass_zmaxes``, e.g. GBNCC's 20-lo + 10-hi
+    split).  Otherwise: the top ``fold_top`` by sigma."""
+    ranked = sorted(cl.cands, key=lambda c: -c.sigma)
+    if fold_sigma is not None:
+        above = [c for c in ranked if c.sigma >= fold_sigma]
+        if max_folds_per_pass:
+            if len(max_folds_per_pass) != len(pass_zmaxes):
+                raise ValueError(
+                    "max_folds_per_pass has %d caps for %d accel "
+                    "passes" % (len(max_folds_per_pass),
+                                len(pass_zmaxes)))
+            top = []
+            for zmax, cap in zip(pass_zmaxes, max_folds_per_pass):
+                tag = "_ACCEL_%d" % zmax
+                top += [c for c in above
+                        if c.filename.endswith(tag)][:cap]
+            return top
+        return above[:max_folds]
+    return ranked[:fold_top]
 
 
 def sift_candidates(filenames: Sequence[str], numdms_min: int = 2,

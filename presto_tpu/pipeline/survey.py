@@ -969,30 +969,19 @@ def _finish_survey_stages(rawfiles, cfg, workdir, base, res, timer,
 
     timer.mark("prepfold")
     # ---- 8. fold the top candidates -----------------------------------
+    # recipe policy: fold everything above to_prepfold_sigma, never
+    # more than max_folds (PALFA_presto_search.py:32-33); per-pass
+    # caps split the budget by search pass, e.g. 20 lo-accel + 10
+    # hi-accel (GBNCC_search.py:479-486).  The selection itself is
+    # shared with the discovery-DAG sift node (sifting.py), so a DAG
+    # fans out exactly the folds this driver would run.
     from presto_tpu.apps.prepfold import main as prepfold_main
-    ranked = sorted(cl.cands, key=lambda c: -c.sigma)
-    if cfg.fold_sigma is not None:
-        # recipe policy: fold everything above to_prepfold_sigma,
-        # never more than max_folds (PALFA_presto_search.py:32-33);
-        # per-pass caps split the budget by search pass, e.g. 20
-        # lo-accel + 10 hi-accel (GBNCC_search.py:479-486)
-        above = [c for c in ranked if c.sigma >= cfg.fold_sigma]
-        if cfg.max_folds_per_pass:
-            if len(cfg.max_folds_per_pass) != len(cfg.all_passes):
-                raise ValueError(
-                    "max_folds_per_pass has %d caps for %d accel "
-                    "passes" % (len(cfg.max_folds_per_pass),
-                                len(cfg.all_passes)))
-            top = []
-            for (zmax, _nh, _sg, _flo), cap in zip(
-                    cfg.all_passes, cfg.max_folds_per_pass):
-                tag = "_ACCEL_%d" % zmax
-                top += [c for c in above
-                        if c.filename.endswith(tag)][:cap]
-        else:
-            top = above[:cfg.max_folds]
-    else:
-        top = ranked[:cfg.fold_top]
+    from presto_tpu.pipeline.sifting import select_fold_candidates
+    top = select_fold_candidates(
+        cl, fold_top=cfg.fold_top, fold_sigma=cfg.fold_sigma,
+        max_folds=cfg.max_folds,
+        max_folds_per_pass=cfg.max_folds_per_pass,
+        pass_zmaxes=[z for (z, _nh, _sg, _flo) in cfg.all_passes])
     for i, c in enumerate(top):
         accpath = os.path.join(workdir, c.filename) \
             if not os.path.dirname(c.filename) else c.filename

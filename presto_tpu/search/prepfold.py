@@ -146,8 +146,8 @@ def fold_subband_series(series: np.ndarray, dt: float, f: float,
                         subfreqs: Optional[np.ndarray] = None,
                         tepoch: float = 0.0, phs0: float = 0.0,
                         delays: Optional[np.ndarray] = None,
-                        delaytimes: Optional[np.ndarray] = None
-                        ) -> FoldResult:
+                        delaytimes: Optional[np.ndarray] = None,
+                        precomputed=None) -> FoldResult:
     """Fold [nsub, N] (or [N] -> nsub=1) subband series into the cube.
 
     The phase model is evaluated once (all subbands share it); each
@@ -156,14 +156,23 @@ def fold_subband_series(series: np.ndarray, dt: float, f: float,
     (-phs); delays/delaytimes inject extra time delays (seconds,
     piecewise linear — the binary-orbit folding path, prepfold.c's
     orbit delay array from dorbint, :878-903).
+
+    ``precomputed`` is the stacked-fold seam: (plan, cube, occ) as a
+    batched caller (fold_series_batch) already produced them — the
+    device drizzles are skipped, every host-side bookkeeping line
+    below runs unchanged, so results stay bit-identical to the
+    unbatched call.
     """
     cfg = cfg or FoldConfig()
     arr = np.atleast_2d(np.asarray(series, dtype=np.float32))
     nsub, N = arr.shape
-    plan = fo.plan_fold(N, dt, f, fd, fdd, phs0=phs0,
-                        proflen=cfg.proflen, npart=cfg.npart,
-                        delays=delays, delaytimes=delaytimes)
-    cube = fo.fold_data(arr, plan)            # [npart, nsub, L]
+    if precomputed is not None:
+        plan, cube, occ = precomputed
+    else:
+        plan = fo.plan_fold(N, dt, f, fd, fdd, phs0=phs0,
+                            proflen=cfg.proflen, npart=cfg.npart,
+                            delays=delays, delaytimes=delaytimes)
+        cube = fo.fold_data(arr, plan)        # [npart, nsub, L]
     # occupancy correction: when the fold frequency resonates with the
     # sample grid (samples/period near an integer multiple of proflen),
     # per-bin sample counts quantize unevenly and the DATA BASELINE
@@ -171,7 +180,8 @@ def fold_subband_series(series: np.ndarray, dt: float, f: float,
     # structure and derails the chi2 search.  Folding a ones-array
     # gives the exact per-bin occupancy; flatten the baseline to the
     # uniform expectation (the chi2 model's assumption).
-    occ = fo.fold_data(np.ones(N, np.float32), plan)  # [npart, L]
+    if precomputed is None:
+        occ = fo.fold_data(np.ones(N, np.float32), plan)  # [npart, L]
     stats = np.zeros((cfg.npart, nsub, 7), dtype=np.float64)
     for p in range(cfg.npart):
         nd = plan.parts_numdata[p]
@@ -229,6 +239,93 @@ def fold_events(events_sec: np.ndarray, f: float, fd: float = 0.0,
                      data_avg=float(ev.size) / (npart * L),
                      data_var=max(float(ev.size) / (npart * L), 1e-10))
     return res
+
+
+# ----------------------------------------------------------------------
+# Stacked folding (the discovery-DAG fold coalescing seam)
+# ----------------------------------------------------------------------
+
+#: vmapped profile-total: one dispatch fills every stacked fold's
+#: best summed profile (per-row math identical to _trial_total)
+_trial_total_many = jax.jit(jax.vmap(_interp_shift_sum))
+
+
+def fold_series_batch(items, obs=None) -> List[FoldResult]:
+    """Fold J one-dimensional series in stacked device dispatches.
+
+    ``items``: [(series, dt, f, fd, fdd, cfg, fold_dm, tepoch)] —
+    every item must share the series length, cfg.proflen, cfg.npart,
+    and the drizzle subdivision (the fold stack signature).  ONE
+    scatter folds all the data rows, one more folds the occupancy
+    rows, and the per-item host bookkeeping is fold_subband_series
+    itself (via its ``precomputed`` seam) — so each FoldResult is
+    bit-identical to the unbatched call, with 2 device dispatches
+    where J unbatched calls pay 2*J."""
+    from presto_tpu.obs import jaxtel
+    plans = [fo.plan_fold(np.asarray(s).shape[-1], dt, f, fd, fdd,
+                          proflen=cfg.proflen, npart=cfg.npart)
+             for (s, dt, f, fd, fdd, cfg, _dm, _ep) in items]
+    if len(items) == 1:
+        # the CLI path, bit for bit (and kernel for kernel)
+        (s, dt, f, fd, fdd, cfg, dm, ep) = items[0]
+        jaxtel.note_dispatch(obs, "fold", 2)
+        return [fold_subband_series(s, dt, f, fd, fdd, cfg,
+                                    fold_dm=dm, tepoch=ep)]
+    jaxtel.note_dispatch(obs, "fold_batch", 2)
+    cubes = fo.fold_data_batch([s for (s, *_rest) in items], plans)
+    occs = fo.fold_data_batch(
+        [np.ones(np.asarray(s).shape[-1], np.float32)
+         for (s, *_rest) in items], plans)
+    out = []
+    for (s, dt, f, fd, fdd, cfg, dm, ep), plan, cube, occ in zip(
+            items, plans, cubes, occs):
+        out.append(fold_subband_series(
+            s, dt, f, fd, fdd, cfg, fold_dm=dm, tepoch=ep,
+            precomputed=(plan, cube[:, None, :], occ)))
+    return out
+
+
+def finish_fold_nosearch(results: List[FoldResult],
+                         obs=None) -> List[FoldResult]:
+    """search_fold's ``-nosearch`` endgame for a whole stack: one
+    vmapped profile-total dispatch fills every result's best summed
+    profile; the remaining search fields are the degenerate
+    single-trial values search_fold sets when every axis is disabled
+    (best_* = fold values, one-entry period/pdot/dm arrays) — pinned
+    byte-equal against search_fold in tests/test_dag.py.  The chi2
+    surfaces (plot-only; no artifact reads them without a search)
+    are left at zeros."""
+    import jax.numpy as jnp
+    from presto_tpu.obs import jaxtel
+    if not results:
+        return results
+    for res in results:
+        if res.nsub != 1:
+            raise ValueError("finish_fold_nosearch: nsub must be 1")
+        res.dms = np.array([res.fold_dm])
+        res.dm_chi2 = np.zeros(1)
+        res.best_dm = res.fold_dm
+        res.best_f = res.fold_f - 0.0
+        res.best_fd = res.fold_fd - 0.0
+        res.best_fdd = res.fold_fdd - 0.0
+        res.fdds = res.fold_fdd - np.zeros(1)
+        res.fdd_chi2 = np.zeros(1)
+        res.ppd_chi2 = np.zeros((1, 1))
+        res.periods = np.array([1.0 / res.fold_f])
+        res.pdots = np.array([res.best_pd])
+    profs = np.stack([r.cube[:, 0, :] for r in results])
+    shifts = np.zeros((len(results), results[0].npart), np.float32)
+    jaxtel.note_dispatch(obs, "fold_total")
+    totals = np.asarray(_trial_total_many(
+        jnp.asarray(profs, jnp.float32), jnp.asarray(shifts)))
+    for res, tot in zip(results, totals):
+        res.best_prof = tot.astype(np.float64)
+        Ntot = float(res.stats[:, 0, 0].sum())
+        prof_avg = res.data_avg * Ntot * res.nsub / res.proflen
+        prof_var = res.data_var * Ntot * res.nsub / res.proflen
+        res.best_redchi = float(fo.profile_redchi(
+            res.best_prof, prof_avg, prof_var))
+    return results
 
 
 # ----------------------------------------------------------------------

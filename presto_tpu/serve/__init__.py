@@ -23,12 +23,16 @@ Fleet scale (N replicas, one shared on-disk job ledger):
 
   jobledger.py  durable job ledger (generic pipeline/leaseledger core:
                 leases, heartbeats, epoch fencing, staged fence-checked
-                commits) + tenant WRR fairness and quotas
+                commits) + tenant WRR fairness and quotas + job
+                dependencies (blocked_on, fenced dynamic fan-out)
   fleet.py      FleetReplica: the lease-and-execute pump around one
                 SearchService, with graceful drain and a chaos seam
   router.py     front-door admission (load shedding 429+Retry-After,
                 typed tenant-quota rejections, /fleet topology view)
                 + presto-router CLI
+  dag.py        discovery DAGs: search -> sift -> fold-per-surviving-
+                candidate -> timing as one submitted unit (POST /dag),
+                with stacked same-geometry folds
 
 See docs/SERVING.md for the wire protocol, metrics schema, fleet
 topology, and tuning knobs.
@@ -48,6 +52,8 @@ from presto_tpu.serve.server import SearchService, start_http
 from presto_tpu.serve.jobledger import (JobLedger, JobLedgerError,
                                         StaleResultError,
                                         TenantQuotaExceeded)
+from presto_tpu.serve.dag import (build_node_job, execute_node,
+                                  plan_dag, run_folds_stacked)
 from presto_tpu.serve.fleet import (FleetConfig, FleetReplica,
                                     artifact_digests)
 from presto_tpu.serve.router import (FleetBusy, FleetRouter,
@@ -61,5 +67,6 @@ __all__ = [
     "RouterConfig", "Scheduler", "SchedulerConfig", "SearchService",
     "SearcherProvider", "StaleResultError", "TenantQuotaExceeded",
     "accel_plan_key", "artifact_digests", "bucket_key",
-    "bucket_quantize", "quantize_nsamp", "start_http",
+    "bucket_quantize", "build_node_job", "execute_node", "plan_dag",
+    "quantize_nsamp", "run_folds_stacked", "start_http",
 ]

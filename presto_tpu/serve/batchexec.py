@@ -160,11 +160,24 @@ class StackedBatchExecutor:
     @staticmethod
     def check_stackable(jobs: List[Job]) -> None:
         """Raise StackIncompatible unless this batch may share one
-        stacked chain."""
+        stacked chain.  Two stackable families exist: same-signature
+        survey jobs (the stacked device chain) and same-bucket DAG
+        fold jobs (the stacked drizzle, serve/dag.py) — never
+        mixed."""
         if len(jobs) < 2:
             raise StackIncompatible("nothing to stack")
         if os.environ.get("PRESTO_TPU_STACKED", "1") == "0":
             raise StackIncompatible("PRESTO_TPU_STACKED=0 kill switch")
+        kinds = {getattr(job, "kind", "survey") or "survey"
+                 for job in jobs}
+        if kinds == {"fold"}:
+            if any(job.bucket != jobs[0].bucket for job in jobs[1:]):
+                raise StackIncompatible("mixed fold stack buckets")
+            return
+        if kinds != {"survey"}:
+            raise StackIncompatible(
+                "only survey or fold batches stack (got %s)"
+                % sorted(kinds))
         for job in jobs:
             if job.run is not None or job.cfg is None:
                 raise StackIncompatible(
@@ -182,10 +195,48 @@ class StackedBatchExecutor:
 
     # -- execution ------------------------------------------------------
 
+    def _fold_batch(self, jobs: List[Job]) -> List[dict]:
+        """The fold arm: a coalesced same-bucket DAG fold batch runs
+        as one batched drizzle dispatch set (serve/dag.py), byte-
+        identical to per-job folds, degrading to the per-job path on
+        any failure exactly like the survey arm."""
+        from presto_tpu.serve.dag import run_folds_stacked
+        injector = self.service.scheduler.cfg.fault_injector
+        for job in jobs:
+            job.status = JobStatus.RUNNING
+            if not job.started:
+                job.started = time.time()
+            self.service.events.emit("execute", job=job.job_id,
+                                     attempt=job.attempts + 1,
+                                     stacked=True)
+            if injector is not None:
+                injector(job, job.attempts + 1)
+        span = self.service.obs.span("serve:stacked-batch",
+                                     jobs=len(jobs), kind="fold",
+                                     bucket=repr(jobs[0].bucket))
+        self._h_occupancy.observe(len(jobs))
+        t0 = time.time()
+        try:
+            results = run_folds_stacked(self.service, jobs)
+        except Exception as e:
+            span.finish("error: %s" % type(e).__name__)
+            raise
+        span.finish()
+        self._c_batches.inc()
+        self._c_jobs.inc(len(jobs))
+        if self.service.latency is not None:
+            self.service.latency.record("job_exec",
+                                        time.time() - t0)
+        for job in jobs:
+            job.attempts += 1
+        return results
+
     def __call__(self, jobs: List[Job]) -> List[dict]:
         from presto_tpu.pipeline.survey import run_survey_stacked
         from presto_tpu.utils.timing import StageTimer
         self.check_stackable(jobs)
+        if all(getattr(j, "kind", "survey") == "fold" for j in jobs):
+            return self._fold_batch(jobs)
         injector = self.service.scheduler.cfg.fault_injector
         timers = []
         for job in jobs:

@@ -386,13 +386,45 @@ class FleetReplica:
     def _attempt_dir(self, job_id: str, epoch: int) -> str:
         return os.path.join(self.jobroot, job_id, "a%04d" % epoch)
 
+    def _committed_dir(self, job_id: str) -> str:
+        """Absolute path of a DONE parent's committed attempt dir —
+        resolved from the fence-landed result.json summary, so a
+        child node only ever reads the winning epoch's tree, never a
+        zombie's."""
+        view = self.ledger.view(job_id)
+        if (view is None or view["state"] != "done"
+                or not view.get("result")):
+            raise RuntimeError("dag parent %s is not committed"
+                               % job_id)
+        att = view["result"].get("attempt_dir") or "."
+        return os.path.join(self.jobroot, job_id, att)
+
+    def _resolve_parents(self, spec: dict) -> Dict[str, object]:
+        """spec.parents ({role: job_id | [job_ids]}) resolved to the
+        parents' committed attempt dirs (same shape)."""
+        out: Dict[str, object] = {}
+        for role, val in (spec.get("parents") or {}).items():
+            if isinstance(val, (list, tuple)):
+                out[role] = [self._committed_dir(v) for v in val]
+            else:
+                out[role] = self._committed_dir(val)
+        return out
+
     def _admit_local(self, lease) -> bool:
         """Build the leased job into the local queue.  False when the
         local queue refused it (job handed back)."""
         job_id = lease.item_id
         spec = dict(lease.data.get("spec") or {})
+        kind = str(spec.get("kind", "survey") or "survey")
         workdir = self._attempt_dir(job_id, lease.epoch)
         try:
+            if kind != "survey":
+                # DAG node: hand the executor its parents' committed
+                # attempt dirs and the ledger row's stack bucket (so
+                # same-geometry folds coalesce locally too)
+                spec["parent_dirs"] = self._resolve_parents(spec)
+                if lease.data.get("bucket"):
+                    spec["bucket"] = lease.data["bucket"]
             job = self.service.build_job(spec, job_id=job_id,
                                          workdir=workdir)
             job.priority = int(lease.data.get("priority", 10))
@@ -412,6 +444,9 @@ class FleetReplica:
             self._inflight[job_id] = (lease, job)
             self._g_inflight.set(len(self._inflight))
         self._chaos("job-enqueued")
+        if kind == "fold":
+            # chaos seam: die holding a leased fold mid-DAG
+            self._chaos("mid-fold")
         return True
 
     def _check_inflight(self) -> None:
@@ -448,7 +483,14 @@ class FleetReplica:
 
     def _commit(self, lease, job: Job) -> bool:
         """Stage result.json and land it through the ledger fence.
-        Returns False when the fence rejected us (zombie commit)."""
+        Returns False when the fence rejected us (zombie commit).
+
+        A DAG node whose result carries a dynamic fan-out
+        (``dag_children`` / ``dag_retarget`` — the sift node) commits
+        through `JobLedger.complete_and_expand`: the result and the
+        child rows land in ONE fenced transaction, so a zombie sift
+        expands nothing and a crash can never strand a committed
+        sift without its folds."""
         job_dir = os.path.join(self.jobroot, job.job_id)
         os.makedirs(job_dir, exist_ok=True)
         result = {
@@ -466,9 +508,35 @@ class FleetReplica:
         summary = {"n_artifacts": len(result["artifacts"]),
                    "attempt_dir": result["attempt_dir"],
                    "replica": self.replica}
+        children = retarget = None
+        if isinstance(job.result, dict):
+            children = job.result.get("dag_children")
+            retarget = job.result.get("dag_retarget")
+        if children or retarget:
+            # inherit the graph's tenant/priority onto the fan-out
+            for _cid, fields in children or ():
+                fields.setdefault("tenant",
+                                  lease.data.get("tenant",
+                                                 "default"))
+                fields.setdefault("priority",
+                                  int(lease.data.get("priority",
+                                                     10)))
+            if self._chaos("fold-fanout"):
+                # chaos seam: die AFTER computing the fan-out but
+                # BEFORE the commit transaction — the fan-out is
+                # lost with the attempt; a successor redoes the sift
+                # and expands identically (idempotence)
+                return False
         try:
-            self.ledger.complete(lease, self.replica, {final: tmp},
-                                 extra={"result": summary})
+            if children or retarget:
+                self.ledger.complete_and_expand(
+                    lease, self.replica, {final: tmp},
+                    extra={"result": summary}, children=children,
+                    retarget=retarget)
+            else:
+                self.ledger.complete(lease, self.replica,
+                                     {final: tmp},
+                                     extra={"result": summary})
         except self.ledger.STALE:
             self._c_stale.inc()
             self.service.events.emit("stale-result-rejected",
@@ -480,6 +548,13 @@ class FleetReplica:
         self.service.events.emit("job-done", job=job.job_id,
                                  replica=self.replica,
                                  epoch=int(lease.epoch))
+        if children or retarget:
+            self.service.events.emit("dag-expand", job=job.job_id,
+                                     children=len(children or ()),
+                                     replica=self.replica)
+            # chaos seam: die right after the fan-out transaction
+            # landed — the children exist; survivors lease them
+            self._chaos("post-sift-commit")
         return True
 
     # ---- shutdown parking ---------------------------------------------

@@ -25,15 +25,29 @@ binds them to the serve-job vocabulary:
     `admit()` raises the typed `TenantQuotaExceeded` — a visible,
     typed rejection, never a silent drop.
 
+Discovery DAGs (`serve/dag.py`) add **job dependencies** on top of
+the same lease core: a job may be admitted ``blocked_on`` a list of
+parent job ids and becomes leasable only once every parent's
+fence-checked commit has landed — the parent's state only ever
+becomes ``done`` through the epoch fence, so a zombie replica's late
+result can never unblock a child.  `complete_and_expand` commits a
+node AND creates its dynamically fanned-out children (the sift
+node's per-candidate fold jobs) in ONE fenced transaction, so a
+crash between "result landed" and "children exist" is impossible,
+and a fenced-off zombie expands nothing.  Children of a terminally
+failed parent cascade to ``failed`` (`dag-cascade-fail`) instead of
+blocking the fleet forever.
+
 The router (`serve/router.py`) is the admission front door; replicas
 (`serve/fleet.py`) are the lease-and-execute loop.  See
-docs/SERVING.md ("Fleet-scale serving") for the full protocol.
+docs/SERVING.md ("Fleet-scale serving" and "Discovery DAGs") for the
+full protocol.
 """
 
 from __future__ import annotations
 
 import time
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Sequence, Tuple
 
 from presto_tpu.pipeline.leaseledger import (DONE, FAILED, LEASED,
                                              PENDING, ItemLease,
@@ -111,7 +125,9 @@ class JobLedger(LeaseLedger):
     def admit(self, spec: dict, tenant: str = DEFAULT_TENANT,
               job_id: Optional[str] = None, priority: int = 10,
               now: Optional[float] = None,
-              bucket: Optional[str] = None) -> dict:
+              bucket: Optional[str] = None,
+              blocked_on: Optional[Sequence[str]] = None,
+              dag: Optional[str] = None) -> dict:
         """Durably admit one job.  Enforces the tenant's quota over
         its *active* (pending + leased) jobs; raises the typed
         TenantQuotaExceeded past it.  Returns the job's ledger view.
@@ -122,7 +138,12 @@ class JobLedger(LeaseLedger):
         admission): `lease_batch` stacks only jobs sharing it, so a
         replica can claim a whole same-bucket batch in one fenced
         transaction.  None disables batch leasing for this job —
-        never a correctness loss, only a batching one."""
+        never a correctness loss, only a batching one.
+
+        ``blocked_on`` names parent job ids: the job stays pending
+        but UN-leasable until every parent's fence-checked commit
+        lands (serve/dag.py).  ``dag`` tags the row with its graph id
+        for `dag_view`."""
         now = time.time() if now is None else now
         tenant = str(tenant or DEFAULT_TENANT)
         with self._lock():
@@ -150,9 +171,241 @@ class JobLedger(LeaseLedger):
                 "submitted": now,
                 "error": "",
                 "bucket": bucket,
+                "blocked_on": list(blocked_on or ()),
+                "dag": dag,
             })
             self._save(state)
             return self._view(job_id, jobs[job_id])
+
+    # -- discovery DAGs -------------------------------------------------
+    def _registry(self):
+        """The shared metrics registry (None without an obs handle);
+        dag_* counters register with literal names so the obs_lint
+        catalog check sees them."""
+        return getattr(self.obs, "metrics", None)
+
+    def admit_dag(self, nodes: Sequence[Tuple[str, dict,
+                                              Optional[str],
+                                              Sequence[str]]],
+                  tenant: str = DEFAULT_TENANT, priority: int = 10,
+                  dag_id: Optional[str] = None,
+                  now: Optional[float] = None) -> dict:
+        """Durably admit one job graph as ONE ledger transaction.
+
+        ``nodes`` is a sequence of ``(rel_id, spec, bucket,
+        parent_rel_ids)``; every rel_id becomes ``<dag_id>-<rel_id>``
+        and the parent references (both ``blocked_on`` and the spec's
+        ``parents``/``retarget`` fields, which replicas use to locate
+        committed parent artifact dirs) are prefixed the same way, so
+        a DagSpec is portable across submissions.  The tenant quota
+        counts the whole graph: either every node is admitted or none
+        is (TenantQuotaExceeded / JobLedgerError leave the ledger
+        untouched).  Returns ``{"dag_id", "nodes": {rel: job_id}}``.
+        """
+        now = time.time() if now is None else now
+        tenant = str(tenant or DEFAULT_TENANT)
+        with self._lock():
+            state = self._load()
+            jobs = self._items(state)
+            cfg = self._tenant_cfg(state, tenant)
+            active = sum(1 for j in jobs.values()
+                         if j.get("tenant") == tenant
+                         and j["state"] in (PENDING, LEASED))
+            if (cfg["quota"] is not None
+                    and active + len(nodes) > cfg["quota"]):
+                self._event("quota-exceeded", tenant=tenant,
+                            quota=cfg["quota"], active=active)
+                raise TenantQuotaExceeded(tenant, int(cfg["quota"]),
+                                          active)
+            if dag_id is None:
+                seq = int(state.get("next_dag", 1))
+                state["next_dag"] = seq + 1
+                dag_id = "dag-%06d" % seq
+
+            def _full(rel: str) -> str:
+                return "%s-%s" % (dag_id, rel)
+
+            ids = {}
+            for rel, _spec, _bucket, _parents in nodes:
+                jid = _full(rel)
+                if jid in jobs:
+                    raise JobLedgerError("duplicate job_id %r" % jid)
+                ids[rel] = jid
+            for rel, spec, bucket, parents in nodes:
+                spec = dict(spec, dag=dag_id)
+                raw = spec.get("parents")
+                if isinstance(raw, dict):
+                    spec["parents"] = {
+                        role: ([_full(v) for v in val]
+                               if isinstance(val, (list, tuple))
+                               else _full(val))
+                        for role, val in raw.items()}
+                if isinstance(spec.get("retarget"), str):
+                    spec["retarget"] = _full(spec["retarget"])
+                jobs[ids[rel]] = self._new_row({
+                    "spec": spec,
+                    "tenant": tenant,
+                    "priority": int(priority),
+                    "submitted": now,
+                    "error": "",
+                    "bucket": bucket,
+                    "blocked_on": [_full(p) for p in parents or ()],
+                    "dag": dag_id,
+                })
+            self._save(state)
+        self._event("dag-submit", dag=dag_id, nodes=sorted(ids),
+                    tenant=tenant)
+        reg = self._registry()
+        if reg is not None:
+            reg.counter(
+                "dag_submitted_total",
+                "Job graphs durably admitted to the ledger").inc()
+        return {"dag_id": dag_id, "nodes": dict(ids)}
+
+    @staticmethod
+    def _leasable(items: dict, row: dict) -> bool:
+        """A pending row is leasable once every blocked_on parent has
+        landed its fence-checked commit (state == done).  A parent's
+        state only ever becomes done THROUGH the fence, so a zombie's
+        late result can never make a child leasable."""
+        for pid in row.get("blocked_on") or ():
+            prow = items.get(pid)
+            if prow is None or prow["state"] != DONE:
+                return False
+        return True
+
+    def _cascade_failures(self, state: dict, now: float) -> List[str]:
+        """Terminally fail pending jobs whose parents can never
+        complete (a failed — or missing — parent): the DAG analog of
+        fail_terminal, so a poisoned node's whole downstream subtree
+        settles with a diagnosable error instead of blocking the
+        fleet forever.  Transitive by fixpoint.  Called under the
+        ledger lock from the lease scheduling policy."""
+        items = self._items(state)
+        failed: List[str] = []
+        changed = True
+        while changed:
+            changed = False
+            for jid in sorted(items):
+                row = items[jid]
+                if row["state"] != PENDING:
+                    continue
+                for pid in row.get("blocked_on") or ():
+                    prow = items.get(pid)
+                    if prow is None or prow["state"] == FAILED:
+                        row["state"] = FAILED
+                        row["error"] = (
+                            "dag parent %s %s" % (
+                                pid, "failed: %s"
+                                % prow.get("error", "")
+                                if prow is not None else "missing"))
+                        row["completed_at"] = now
+                        failed.append(jid)
+                        changed = True
+                        break
+        for jid in failed:
+            self._event("dag-cascade-fail", item=jid,
+                        error=items[jid]["error"])
+        reg = self._registry()
+        if failed and reg is not None:
+            reg.counter(
+                "dag_cascade_failures_total",
+                "DAG children terminally failed because a parent "
+                "node failed").inc(len(failed))
+        return failed
+
+    def complete_and_expand(self, lease, host: str,
+                            staged: Dict[str, str],
+                            now: Optional[float] = None,
+                            extra: Optional[dict] = None,
+                            children: Optional[Sequence[Tuple[
+                                str, dict]]] = None,
+                            retarget: Optional[Dict[str, dict]]
+                            = None) -> Dict[str, dict]:
+        """Fence-checked commit PLUS dynamic fan-out, atomically.
+
+        The sift node's surviving-candidate list decides the fold
+        fan-out; committing the list and creating the fold jobs must
+        be one durable step — a crash between them would strand a
+        done parent with no children, and a zombie must expand
+        nothing.  So: under ONE ledger lock, fence-check (STALE
+        raises exactly like complete(), staged files deleted, no row
+        touched), land the staged result, create every child row
+        idempotently (an id that already exists is left alone — the
+        re-commit path), and retarget downstream nodes'
+        ``blocked_on``/``parents`` (the timing node's fold fan-in).
+
+        ``children``: [(job_id, row_fields)] where row_fields carries
+        spec/tenant/priority/bucket/blocked_on/dag.  ``retarget``:
+        {job_id: {"blocked_on": [...], "parents": {...merged into
+        the row's spec...}}} applied only while the target is still
+        pending."""
+        now = time.time() if now is None else now
+        with self._lock():
+            state = self._load()
+            items = self._items(state)
+            row = items.get(lease.item_id)
+            why = self._fence_why(row, lease, host)
+            if why is not None:
+                self._reject_stale(state, lease, host, staged, why)
+            arts = self._commit_row(state, lease, host, staged, row,
+                                    now, extra)
+            created = []
+            for cid, fields in children or ():
+                if cid in items:
+                    continue            # idempotent re-expansion
+                fields = dict(fields)
+                fields.setdefault("submitted", now)
+                fields.setdefault("error", "")
+                items[cid] = self._new_row(fields)
+                created.append(cid)
+            for jid, change in (retarget or {}).items():
+                trow = items.get(jid)
+                if trow is None or trow["state"] != PENDING:
+                    continue
+                if "blocked_on" in change:
+                    trow["blocked_on"] = list(change["blocked_on"])
+                if "parents" in change:
+                    spec = dict(trow.get("spec") or {})
+                    parents = dict(spec.get("parents") or {})
+                    parents.update(change["parents"])
+                    spec["parents"] = parents
+                    trow["spec"] = spec
+            self._save(state)
+        self._event(self.EV_DONE, item=lease.item_id, host=host,
+                    artifacts=len(arts))
+        self._event("dag-expand", item=lease.item_id, host=host,
+                    created=len(created),
+                    retargeted=sorted(retarget or ()))
+        reg = self._registry()
+        if created and reg is not None:
+            reg.counter(
+                "dag_fanout_jobs_total",
+                "Child jobs dynamically fanned out at a DAG node's "
+                "fence-checked commit").inc(len(created))
+        return arts
+
+    def dag_view(self, dag_id: str) -> Optional[dict]:
+        """Aggregate view of one job graph: every node's ledger view
+        plus a graph-level state (failed > running > done)."""
+        state = self._load()
+        nodes = {jid: self._view(jid, row)
+                 for jid, row in self._items(state).items()
+                 if row.get("dag") == dag_id}
+        if not nodes:
+            return None
+        states = {v["state"] for v in nodes.values()}
+        if FAILED in states:
+            agg = FAILED
+        elif states == {DONE}:
+            agg = DONE
+        else:
+            agg = "running"
+        return {"dag_id": dag_id, "state": agg,
+                "counts": {s: sum(1 for v in nodes.values()
+                                  if v["state"] == s)
+                           for s in sorted(states)},
+                "nodes": nodes}
 
     # -- batch leasing --------------------------------------------------
     def lease_batch(self, host: str, ttl: float, k: int,
@@ -200,7 +453,8 @@ class JobLedger(LeaseLedger):
                 pend: Dict[str, List[str]] = {}
                 for jid, row in items.items():
                     if (row["state"] == PENDING
-                            and row.get("bucket") == hint):
+                            and row.get("bucket") == hint
+                            and self._leasable(items, row)):
                         pend.setdefault(
                             str(row.get("tenant", DEFAULT_TENANT)),
                             []).append(jid)
@@ -232,11 +486,18 @@ class JobLedger(LeaseLedger):
         to the one with the smallest served/weight ratio (ties break
         by tenant name), then the oldest highest-priority job inside
         that tenant.  `served` counters persist in the ledger so the
-        rotation is fleet-wide, not per-replica."""
+        rotation is fleet-wide, not per-replica.
+
+        DAG jobs whose parents have not all landed their fenced
+        commits are pending but NOT grantable; children of a failed
+        parent are cascaded to terminal failure first (both mutations
+        persist with the grant — the caller saves state)."""
+        self._cascade_failures(state, now)
         jobs = self._items(state)
         by_tenant: Dict[str, List[str]] = {}
         for jid, row in jobs.items():
-            if row["state"] == PENDING:
+            if (row["state"] == PENDING
+                    and self._leasable(jobs, row)):
                 by_tenant.setdefault(
                     str(row.get("tenant", DEFAULT_TENANT)),
                     []).append(jid)
@@ -276,6 +537,10 @@ class JobLedger(LeaseLedger):
             row["error"] = str(error)
             row["completed_epoch"] = int(state["epoch"])
             row["completed_at"] = now
+            # settle the downstream subtree NOW (not at the next
+            # lease attempt): a drained fleet must not leave a failed
+            # node's children pending forever
+            self._cascade_failures(state, now)
             self._save(state)
         self._event("job-failed", item=lease.item_id, host=host,
                     error=str(error))
@@ -283,6 +548,7 @@ class JobLedger(LeaseLedger):
     # -- introspection --------------------------------------------------
     @staticmethod
     def _view(job_id: str, row: dict) -> dict:
+        spec = row.get("spec") or {}
         return {
             "job_id": job_id,
             "state": row["state"],
@@ -294,6 +560,9 @@ class JobLedger(LeaseLedger):
             "submitted": row.get("submitted", 0.0),
             "artifacts": dict(row.get("artifacts", {})),
             "result": row.get("result"),
+            "kind": str(spec.get("kind", "survey") or "survey"),
+            "blocked_on": list(row.get("blocked_on") or ()),
+            "dag": row.get("dag"),
         }
 
     def view(self, job_id: str) -> Optional[dict]:

@@ -77,6 +77,11 @@ class Job:
     bucket: Any = None             # plancache.bucket_key() result
     spec: dict = field(default_factory=dict)   # raw submitted spec
     lane: str = Lanes.THROUGHPUT   # deadline | throughput (Lanes)
+    #: discovery-DAG node kind: "survey" (the ordinary search job) or
+    #: a dag node type ("sift" | "fold" | "toa", serve/dag.py) — the
+    #: service dispatches execution on it, and the stacked batch
+    #: executor stacks fold batches by it
+    kind: str = "survey"
     #: in-process callable jobs (the streaming tick): when set, the
     #: service executes run(job) instead of a survey
     run: Optional[Callable] = None
@@ -95,6 +100,7 @@ class Job:
             "job_id": self.job_id,
             "status": self.status,
             "lane": self.lane,
+            "kind": self.kind,
             "priority": self.priority,
             "bucket": repr(self.bucket),
             "attempts": self.attempts,

@@ -35,6 +35,14 @@ Wire protocol (stdlib HTTP + JSON, like server.py):
                           -> 202 ledger job view
                           429 shed (Retry-After) / quota-exceeded
                           503 no ready replica registered
+  POST /dag               {"rawfiles": [...], "config": {...},
+                           "sift": {...}, "fold": {...},
+                           "toa": {...}, "tenant": "..."}
+                          -> 202 {dag_id, nodes} — one discovery DAG
+                          (search -> sift -> folds -> timing)
+                          admitted as ONE durable transaction
+                          (serve/dag.py); same 429/503 semantics
+  GET  /dag/<id>          aggregate DAG view (per-node states)
   GET  /jobs/<id>         ledger job view (404 unknown)
   GET  /jobs/<id>/result  committed result.json (409 until done)
   GET  /fleet             topology + readiness + tenant counts
@@ -120,6 +128,9 @@ class FleetRouter:
         self._c_submissions = reg.counter(
             "fleet_submissions_total",
             "Jobs durably admitted to the fleet ledger", ("tenant",))
+        self._c_dags = reg.counter(
+            "dag_submitted_total",
+            "Job graphs durably admitted to the ledger")
         self._c_shed = reg.counter(
             "fleet_shed_total",
             "Submissions shed at the high-water mark (429)")
@@ -250,6 +261,48 @@ class FleetRouter:
                          tenant=tenant, depth=depth + 1)
         return view
 
+    def submit_dag(self, spec: dict) -> dict:
+        """Durably admit one discovery DAG (search -> sift ->
+        fold-fan-out -> timing) as a single ledger transaction
+        (serve/dag.plan_dag + JobLedger.admit_dag).  Shedding, the
+        ready-replica gate, and tenant quotas apply exactly as for
+        single submissions — the quota counts the whole graph."""
+        if not isinstance(spec, dict):
+            raise ValueError("spec must be a JSON object")
+        from presto_tpu.serve.dag import plan_dag
+        tenant = str(spec.get("tenant") or DEFAULT_TENANT)
+        depth = self.ledger.depth()
+        self._g_depth.set(depth)
+        if depth >= self.cfg.high_water:
+            self._c_shed.inc()
+            self.events.emit("shed", tenant=tenant, depth=depth,
+                             high_water=self.cfg.high_water)
+            raise FleetBusy(depth, self.cfg.high_water,
+                            self.cfg.retry_after_s)
+        if self.cfg.require_ready and not self.ready_replicas():
+            raise NoReadyReplica(
+                "no ready replica registered in %s"
+                % self.cfg.fleetdir)
+        nodes = plan_dag(spec)
+        try:
+            out = self.ledger.admit_dag(
+                nodes, tenant=tenant,
+                priority=int(spec.get("priority", 10)),
+                dag_id=spec.get("dag_id"))
+        except TenantQuotaExceeded as e:
+            self._c_quota.labels(tenant=tenant).inc()
+            self.events.emit("quota-exceeded", tenant=tenant,
+                             quota=e.quota, active=e.active)
+            raise
+        self._c_submissions.labels(tenant=tenant).inc(len(nodes))
+        self._c_dags.inc()
+        self.events.emit("dag-submit", dag=out["dag_id"],
+                         tenant=tenant, nodes=len(nodes))
+        return dict(out, tenant=tenant)
+
+    def dag_status(self, dag_id: str) -> Optional[dict]:
+        return self.ledger.dag_view(dag_id)
+
     # ---- introspection ------------------------------------------------
 
     def status(self, job_id: str) -> Optional[dict]:
@@ -375,6 +428,12 @@ class _RouterHandler(BaseHTTPRequestHandler):
                 n = int(parse_qs(url.query).get("n", ["100"])[0])
                 self._json(200,
                            {"events": self.router.events.tail(n)})
+            elif len(parts) == 2 and parts[0] == "dag":
+                view = self.router.dag_status(parts[1])
+                if view is None:
+                    self._json(404, {"error": "no such dag"})
+                else:
+                    self._json(200, view)
             elif len(parts) == 2 and parts[0] == "jobs":
                 view = self.router.status(parts[1])
                 if view is None:
@@ -398,13 +457,17 @@ class _RouterHandler(BaseHTTPRequestHandler):
                              % (type(e).__name__, e)})
 
     def do_POST(self) -> None:
-        if urlparse(self.path).path != "/submit":
+        path = urlparse(self.path).path
+        if path not in ("/submit", "/dag"):
             self._json(404, {"error": "unknown endpoint"})
             return
         try:
             length = int(self.headers.get("Content-Length", "0"))
             spec = json.loads(self.rfile.read(length) or b"{}")
-            self._json(202, self.router.submit(spec))
+            if path == "/dag":
+                self._json(202, self.router.submit_dag(spec))
+            else:
+                self._json(202, self.router.submit(spec))
         except FleetBusy as e:
             self._json(429, {"error": "shed", "detail": str(e),
                              "retry_after_s": e.retry_after_s},

@@ -188,10 +188,19 @@ class SearchService:
 
         Raises BadRequest on malformed specs.  `job_id`/`workdir`
         override the spec (the fleet replica pins both to the ledger
-        job id and its epoch-stamped attempt directory)."""
+        job id and its epoch-stamped attempt directory).
+
+        Discovery-DAG node specs (`spec.kind` of sift/fold/toa) are
+        validated by serve/dag.build_node_job instead — they carry no
+        rawfiles; their inputs are parent nodes' committed attempt
+        dirs."""
         from presto_tpu.pipeline.survey import SurveyConfig
         if not isinstance(spec, dict):
             raise BadRequest("spec must be a JSON object")
+        if str(spec.get("kind", "survey") or "survey") != "survey":
+            from presto_tpu.serve.dag import build_node_job
+            return build_node_job(self, spec, job_id=job_id,
+                                  workdir=workdir)
         rawfiles = spec.get("rawfiles")
         if not rawfiles or not isinstance(rawfiles, (list, tuple)):
             raise BadRequest("spec.rawfiles must be a non-empty list")
@@ -274,9 +283,13 @@ class SearchService:
 
     def _execute_job(self, job: Job) -> dict:
         """Run one job as a restartable survey in its own workdir,
-        feeding the shared per-stage latency percentiles."""
+        feeding the shared per-stage latency percentiles.  DAG node
+        jobs (sift/fold/toa) dispatch to their serve/dag executors."""
         if job.run is not None:
             return job.run(job) or {}
+        if getattr(job, "kind", "survey") != "survey":
+            from presto_tpu.serve.dag import execute_node
+            return execute_node(self, job)
         from presto_tpu.pipeline.survey import run_survey
         timer = StageTimer(stats=self.latency, obs=self.obs)
         res = run_survey(job.rawfiles, job.cfg, workdir=job.workdir,

@@ -1,0 +1,638 @@
+"""Discovery DAGs (ISSUE 11): dependency-aware job graphs on the
+fleet ledger — search -> sift -> fold-per-surviving-candidate ->
+timing as one submitted unit.
+
+Covers: ledger units (blocked admit, fence-checked unblock, zombie
+parent commits never releasing children, atomic + idempotent dynamic
+fan-out, cascade failure), the batched fold drizzle's bit-identity,
+typed PrestoIOError on corrupt .pfd/.cand inputs, stub-executor
+2-replica kill-one chaos over a half-finished DAG, stacked-fold
+byte-equality with fewer dispatches, and the real-survey DAG whose
+final artifacts (sifted list, .pfd, .bestprof, toas.tim) are
+byte-equal to the hand-driven CLI sequence
+(accelsearch -> ACCEL_sift -> prepfold -> get_TOAs).
+"""
+
+import glob
+import hashlib
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from presto_tpu.io.errors import PrestoIOError
+from presto_tpu.pipeline.leaseledger import DONE, FAILED, PENDING
+from presto_tpu.serve.fleet import FleetConfig, FleetReplica
+from presto_tpu.serve.jobledger import (JobLedger, JobLedgerError,
+                                        StaleResultError,
+                                        TenantQuotaExceeded)
+from presto_tpu.serve.server import SearchService
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+DAG_CFG = {"lodm": 50.0, "hidm": 60.0, "nsub": 8, "zmax": 0,
+           "numharm": 4, "singlepulse": False, "skip_rfifind": True}
+
+
+def _wait(cond, timeout=60.0, poll=0.02):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if cond():
+            return True
+        time.sleep(poll)
+    return False
+
+
+def _stage(tmp_path, name, text="{}"):
+    p = str(tmp_path / name)
+    with open(p, "w") as f:
+        f.write(text)
+    return p
+
+
+# ----------------------------------------------------------------------
+# ledger unit tests
+# ----------------------------------------------------------------------
+
+def test_blocked_admit_not_leasable_until_parent_commits(tmp_path):
+    led = JobLedger(str(tmp_path))
+    led.join("r1")
+    led.admit({"x": 1}, job_id="parent")
+    led.admit({"x": 2}, job_id="child", blocked_on=["parent"])
+    lease = led.lease("r1", ttl=30.0)
+    assert lease.item_id == "parent"
+    assert led.lease("r1", ttl=30.0) is None      # child blocked
+    assert led.view("child")["blocked_on"] == ["parent"]
+    final = str(tmp_path / "r.json")
+    led.complete(lease, "r1", {final: _stage(tmp_path, "s1")})
+    got = led.lease("r1", ttl=30.0)
+    assert got is not None and got.item_id == "child"
+
+
+def test_zombie_parent_commit_never_unblocks_child(tmp_path):
+    """The tentpole invariant: a reaped replica's late parent result
+    bounces off the fence, so the child stays blocked until a LIVE
+    replica's commit lands."""
+    led = JobLedger(str(tmp_path))
+    led.join("a", now=0.0)
+    led.join("b", now=0.0)
+    led.admit({}, job_id="parent")
+    led.admit({}, job_id="child", blocked_on=["parent"])
+    lease_a = led.lease("a", ttl=30.0, now=0.0)
+    assert lease_a.item_id == "parent"
+    led.heartbeat("b", 0, now=100.0)
+    report = led.reap(heartbeat_ttl=10.0, now=100.0)
+    assert report.dead_hosts == ["a"]
+    # zombie a tries to land its late result -> fenced; child stays
+    # blocked (the parent is pending again, not done)
+    final = str(tmp_path / "r.json")
+    with pytest.raises(StaleResultError):
+        led.complete(lease_a, "a", {final: _stage(tmp_path, "sa")})
+    assert led.view("parent")["state"] == PENDING
+    lease_b = led.lease("b", ttl=30.0, now=100.0)
+    assert lease_b.item_id == "parent"     # child STILL not leasable
+    led.complete(lease_b, "b", {final: _stage(tmp_path, "sb")})
+    got = led.lease("b", ttl=30.0, now=100.0)
+    assert got is not None and got.item_id == "child"
+
+
+def test_complete_and_expand_atomic_and_idempotent(tmp_path):
+    """Dynamic fan-out: children + retarget land in the SAME fenced
+    transaction as the result; pre-existing child ids are left
+    untouched (idempotent re-expansion); a zombie's expand attempt
+    creates nothing."""
+    led = JobLedger(str(tmp_path))
+    led.join("a", now=0.0)
+    led.join("b", now=0.0)
+    led.admit({"kind": "sift"}, job_id="sift")
+    led.admit({"kind": "toa", "parents": {"fold": []}}, job_id="toa",
+              blocked_on=["sift"])
+    lease_a = led.lease("a", ttl=30.0, now=0.0)
+    children = [
+        ["fold-001", {"spec": {"kind": "fold", "fold": {"seed": 1}},
+                      "tenant": "default", "priority": 10,
+                      "bucket": "B", "blocked_on": ["sift"],
+                      "dag": "d"}],
+        ["fold-002", {"spec": {"kind": "fold", "fold": {"seed": 2}},
+                      "tenant": "default", "priority": 10,
+                      "bucket": "B", "blocked_on": ["sift"],
+                      "dag": "d"}],
+    ]
+    retarget = {"toa": {"blocked_on": ["fold-001", "fold-002"],
+                        "parents": {"fold": ["fold-001",
+                                             "fold-002"]}}}
+    # pre-create fold-001 (the partially-expanded case): its spec
+    # must survive re-expansion untouched
+    led.admit({"kind": "fold", "fold": {"seed": "KEEP"}},
+              job_id="fold-001", blocked_on=["sift"])
+    final = str(tmp_path / "r.json")
+    led.complete_and_expand(lease_a, "a",
+                            {final: _stage(tmp_path, "s1")},
+                            children=children, retarget=retarget)
+    state = led.read()
+    assert state["jobs"]["sift"]["state"] == DONE
+    assert state["jobs"]["fold-001"]["spec"]["fold"]["seed"] == "KEEP"
+    assert state["jobs"]["fold-002"]["spec"]["fold"]["seed"] == 2
+    toa = led.view("toa")
+    assert toa["blocked_on"] == ["fold-001", "fold-002"]
+    # zombie replay: a second expand under the dead lease is fenced —
+    # staged file deleted, no rows created or mutated
+    evil = [["fold-666", {"spec": {"kind": "fold"}, "tenant": "t",
+                          "priority": 1, "bucket": "B",
+                          "blocked_on": [], "dag": "d"}]]
+    late = _stage(tmp_path, "late")
+    with pytest.raises(StaleResultError):
+        led.complete_and_expand(lease_a, "a", {final + ".x": late},
+                                children=evil,
+                                retarget={"toa": {"blocked_on": []}})
+    assert not os.path.exists(late)
+    assert "fold-666" not in led.read()["jobs"]
+    assert led.view("toa")["blocked_on"] == ["fold-001", "fold-002"]
+
+
+def test_cascade_failure_is_transitive(tmp_path):
+    led = JobLedger(str(tmp_path))
+    led.join("r1")
+    led.admit({}, job_id="a")
+    led.admit({}, job_id="b", blocked_on=["a"])
+    led.admit({}, job_id="c", blocked_on=["b"])
+    lease = led.lease("r1", ttl=30.0)
+    led.fail_terminal(lease, "r1", "boom")
+    assert led.lease("r1", ttl=30.0) is None      # triggers cascade
+    assert led.view("b")["state"] == FAILED
+    assert "dag parent a failed" in led.view("b")["error"]
+    assert led.view("c")["state"] == FAILED       # transitive
+    assert led.all_terminal()
+
+
+def test_admit_dag_one_transaction_with_quota(tmp_path):
+    led = JobLedger(str(tmp_path))
+    led.set_tenant("vip", quota=2)
+    nodes = [("search", {"rawfiles": ["x"]}, "B", []),
+             ("sift", {"kind": "sift",
+                       "parents": {"search": "search"},
+                       "retarget": "toa"}, None, ["search"]),
+             ("toa", {"kind": "toa", "parents": {"fold": []}},
+              None, ["sift"])]
+    # 3 nodes > quota 2: the WHOLE graph is rejected, nothing admitted
+    with pytest.raises(TenantQuotaExceeded):
+        led.admit_dag(nodes, tenant="vip")
+    assert led.read()["jobs"] == {}
+    out = led.admit_dag(nodes, tenant="ok")
+    assert sorted(out["nodes"]) == ["search", "sift", "toa"]
+    sift = led.view(out["nodes"]["sift"])
+    assert sift["blocked_on"] == [out["nodes"]["search"]]
+    assert sift["dag"] == out["dag_id"]
+    # parent refs inside the spec were prefixed too
+    row = led.read()["jobs"][out["nodes"]["sift"]]
+    assert row["spec"]["parents"]["search"] == out["nodes"]["search"]
+    assert row["spec"]["retarget"] == out["nodes"]["toa"]
+    # duplicate graph ids are rejected atomically
+    with pytest.raises(JobLedgerError):
+        led.admit_dag(nodes, dag_id=out["dag_id"])
+
+
+# ----------------------------------------------------------------------
+# batched fold drizzle: bit identity
+# ----------------------------------------------------------------------
+
+def test_fold_data_batch_bit_identical():
+    from presto_tpu.ops import fold as fo
+    rng = np.random.default_rng(7)
+    N, L, npart, dt = 2048, 64, 8, 5e-4
+    for f0, label in ((23.0, "subdiv=1"), (40.0, "subdiv=2")):
+        rows, plans = [], []
+        for i in range(4):
+            rows.append(rng.standard_normal(N).astype(np.float32))
+            plans.append(fo.plan_fold(N, dt, f0 + 0.37 * i, 1e-9,
+                                      proflen=L, npart=npart))
+        assert len({p.subdiv for p in plans}) == 1, label
+        batch = fo.fold_data_batch(rows, plans)
+        for i in range(4):
+            ref = fo.fold_data(rows[i], plans[i])
+            assert np.array_equal(ref, batch[i]), (label, i)
+
+
+# ----------------------------------------------------------------------
+# typed PrestoIOError on corrupt fold/timing inputs
+# ----------------------------------------------------------------------
+
+def test_read_pfd_typed_errors(tmp_path):
+    from presto_tpu.io.pfd import read_pfd
+    with pytest.raises(PrestoIOError) as ei:
+        read_pfd(str(tmp_path / "missing.pfd"))
+    assert ei.value.kind == "missing"
+    trunc = str(tmp_path / "trunc.pfd")
+    with open(trunc, "wb") as f:
+        f.write(b"\x01\x00\x00\x00\x02")
+    with pytest.raises(PrestoIOError) as ei:
+        read_pfd(trunc)
+    assert trunc in str(ei.value)
+    assert ei.value.expected_bytes is not None
+
+
+def test_read_cand_typed_errors(tmp_path):
+    from presto_tpu.apps.accelsearch import read_cand_file
+    with pytest.raises(PrestoIOError) as ei:
+        read_cand_file(str(tmp_path / "missing.cand"))
+    assert ei.value.kind == "missing"
+    bad = str(tmp_path / "bad.cand")
+    with open(bad, "wb") as f:
+        f.write(b"\x00" * 17)      # fits neither record format
+    with pytest.raises(PrestoIOError) as ei:
+        read_cand_file(bad)
+    assert ei.value.kind == "truncated-data"
+
+
+def test_get_toas_cli_one_line_diagnosis(tmp_path, capsys):
+    from presto_tpu.apps.get_toas import main as toas_main
+    rc = toas_main([str(tmp_path / "nope.pfd")])
+    assert rc == 1
+    out = capsys.readouterr().out
+    assert out.startswith("get_TOAs:") and "nope.pfd" in out
+
+
+# ----------------------------------------------------------------------
+# stub-executor fleet: protocol-level DAG chaos (fast)
+# ----------------------------------------------------------------------
+
+def stub_bytes(tag) -> bytes:
+    return hashlib.sha256(("dag-%s" % tag).encode()).digest() * 16
+
+
+class StubDagService(SearchService):
+    """Node executors that write deterministic bytes: the ledger /
+    fleet DAG protocol pinned fast, no device work.  The sift stub
+    returns a real dynamic fan-out (2 folds + the timing retarget)
+    so the fenced expand transaction is exercised end to end."""
+
+    def _execute_job(self, job):
+        os.makedirs(job.workdir, exist_ok=True)
+        kind = getattr(job, "kind", "survey")
+        if kind == "survey":
+            with open(os.path.join(job.workdir, "search.dat"),
+                      "wb") as f:
+                f.write(stub_bytes("search"))
+            return {"ok": True}
+        if kind == "sift":
+            pdir = job.spec["parent_dirs"]["search"]
+            assert os.path.exists(os.path.join(pdir, "search.dat"))
+            with open(os.path.join(job.workdir, "cands_sifted.txt"),
+                      "wb") as f:
+                f.write(stub_bytes("sift"))
+            dag = job.spec.get("dag") or "d"
+            search_id = job.spec["parents"]["search"]
+            fold_ids = ["%s-fold-%03d" % (dag, i + 1)
+                        for i in range(2)]
+            children = [[fid, {
+                "spec": {"kind": "fold", "dag": dag,
+                         "parents": {"search": search_id},
+                         "fold": {"seed": i + 1}},
+                "bucket": "stub-fold",
+                "blocked_on": [job.job_id],
+                "dag": dag,
+            }] for i, fid in enumerate(fold_ids)]
+            retarget = {}
+            if job.spec.get("retarget"):
+                retarget[job.spec["retarget"]] = {
+                    "blocked_on": list(fold_ids),
+                    "parents": {"fold": list(fold_ids)}}
+            return {"folds": 2, "dag_children": children,
+                    "dag_retarget": retarget}
+        if kind == "fold":
+            seed = job.spec["fold"]["seed"]
+            with open(os.path.join(job.workdir, "fold.dat"),
+                      "wb") as f:
+                f.write(stub_bytes("fold-%s" % seed))
+            return {"ok": True, "seed": seed}
+        if kind == "toa":
+            blob = b""
+            for d in job.spec["parent_dirs"]["fold"]:
+                with open(os.path.join(d, "fold.dat"), "rb") as f:
+                    blob += hashlib.sha256(f.read()).digest()
+            with open(os.path.join(job.workdir, "toas.dat"),
+                      "wb") as f:
+                f.write(blob)
+            return {"ok": True, "n": len(blob) // 32}
+        raise ValueError(kind)
+
+
+@pytest.fixture(scope="module")
+def tiny_beam(tmp_path_factory):
+    from tools.serve_loadgen import make_beams
+    d = tmp_path_factory.mktemp("dagbeams")
+    return make_beams(str(d), 1, nsamp=4096, nchan=8)[0]
+
+
+def _stub_dag_nodes(beam):
+    from presto_tpu.serve.dag import plan_dag
+    return plan_dag({"rawfiles": [beam],
+                     "config": dict(DAG_CFG, fold_top=0)})
+
+
+def _stub_fleet(tmp_path, name, fleetdir, **fkw):
+    svc = StubDagService(str(tmp_path / ("w-" + name)),
+                         queue_depth=8).start()
+    cfg = FleetConfig(fleetdir=str(fleetdir), replica=name,
+                      lease_ttl=20.0, heartbeat_s=0.1,
+                      heartbeat_timeout=0.6, poll_s=0.05,
+                      max_inflight=2, prewarm=False)
+    for k, v in fkw.items():
+        setattr(cfg, k, v)
+    return svc, FleetReplica(svc, cfg)
+
+
+def _check_stub_dag_done(led, fleetdir, dag_id, nodes):
+    """Every node done exactly once with the deterministic bytes; the
+    fold fan-out exists as ONE set; the toa read both folds."""
+    dv = led.dag_view(dag_id)
+    assert dv["state"] == DONE, dv
+    fold_ids = sorted(j for j in dv["nodes"]
+                      if "-fold-" in j)
+    assert fold_ids == ["%s-fold-001" % dag_id,
+                        "%s-fold-002" % dag_id]
+    assert led.view(nodes["toa"])["blocked_on"] == fold_ids
+    detail = json.load(open(os.path.join(
+        str(fleetdir), "jobs", nodes["toa"], "result.json")))
+    tdir = os.path.join(str(fleetdir), "jobs", nodes["toa"],
+                        detail["attempt_dir"])
+    want = b"".join(hashlib.sha256(
+        stub_bytes("fold-%d" % (i + 1))).digest() for i in range(2))
+    assert open(os.path.join(tdir, "toas.dat"), "rb").read() == want
+
+
+def test_stub_dag_end_to_end(tmp_path, tiny_beam):
+    fleetdir = tmp_path / "fleet"
+    led = JobLedger(str(fleetdir))
+    out = led.admit_dag(_stub_dag_nodes(tiny_beam))
+    svc, rep = _stub_fleet(tmp_path, "r1", fleetdir)
+    try:
+        rep.start()
+        assert _wait(led.all_terminal, timeout=30.0)
+        _check_stub_dag_done(led, fleetdir, out["dag_id"],
+                             out["nodes"])
+        kinds = [e["kind"] for e in svc.events.tail(500)]
+        assert "dag-expand" in kinds
+        reg = svc.obs.metrics
+        assert reg.get("dag_fanout_jobs_total").value == 2
+    finally:
+        rep.stop()
+        svc.stop()
+
+
+@pytest.mark.parametrize("kill_point", ["fold-fanout",
+                                        "post-sift-commit",
+                                        "mid-fold"])
+def test_stub_dag_kill_one_exactly_once(tmp_path, tiny_beam,
+                                        kill_point):
+    """2-replica kill-one over a half-finished DAG: the victim dies
+    while computing the fan-out (pre-commit: the expand is LOST with
+    the attempt and a survivor redoes it identically), right after
+    the fenced expand landed, or holding a leased fold.  Every node
+    completes exactly once, the fold set exists exactly once, and
+    the artifacts match the deterministic reference bytes."""
+    fleetdir = tmp_path / "fleet"
+    led = JobLedger(str(fleetdir))
+    out = led.admit_dag(_stub_dag_nodes(tiny_beam))
+    svc_a, rep_a = _stub_fleet(tmp_path, "a", fleetdir)
+    rep_a.kill_on = kill_point
+    svc_b, rep_b = _stub_fleet(tmp_path, "b", fleetdir)
+    try:
+        rep_a.start()
+        assert _wait(lambda: rep_a._killed, timeout=30.0)
+        rep_b.start()
+        assert _wait(led.all_terminal, timeout=30.0)
+        _check_stub_dag_done(led, fleetdir, out["dag_id"],
+                             out["nodes"])
+        state = led.read()
+        if kill_point == "fold-fanout":
+            # the victim died BEFORE the sift commit: the survivor
+            # redid the sift and the fan-out happened exactly once
+            assert state["jobs"][out["nodes"]["sift"]]["redos"] == 1
+            assert svc_b.obs.metrics.get(
+                "dag_fanout_jobs_total").value == 2
+            fam = svc_a.obs.metrics.get("dag_fanout_jobs_total")
+            assert fam is None or fam.value == 0
+    finally:
+        rep_a.stop()
+        rep_b.stop()
+        svc_a.stop()
+        svc_b.stop()
+
+
+# ----------------------------------------------------------------------
+# real survey DAG: stacked folds + CLI byte-equality + kill-one
+# ----------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def strong_beam(tmp_path_factory):
+    """A beam whose injected pulsar survives the sift (the 4096-
+    sample tiny beam does not)."""
+    from presto_tpu.models.synth import FakeSignal, fake_filterbank_file
+    d = tmp_path_factory.mktemp("strongbeam")
+    path = os.path.join(str(d), "beam.fil")
+    sig = FakeSignal(f=23.0, dm=55.0, shape="gauss", width=0.08,
+                     amp=2.0)
+    fake_filterbank_file(path, 16384, 5e-4, 8, 400.0, 1.0, sig,
+                         noise_sigma=2.0, nbits=8, seed=101)
+    return path
+
+
+@pytest.fixture(scope="module")
+def cli_reference(strong_beam, tmp_path_factory):
+    """The hand-driven CLI sequence on the same input: run the search
+    stages (fold_top=0), then ACCEL_sift / prepfold / get_TOAs as
+    real CLI subprocesses with relative paths (a human's cwd-run) —
+    the byte-equality reference for every DAG artifact."""
+    from presto_tpu.pipeline.sifting import (select_fold_candidates,
+                                             sift_candidates)
+    from presto_tpu.pipeline.survey import SurveyConfig, run_survey
+    refdir = str(tmp_path_factory.mktemp("cliref"))
+    run_survey([strong_beam],
+               SurveyConfig(**dict(DAG_CFG, fold_top=0,
+                                   durable_stages=True)),
+               workdir=refdir)
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO)
+    subprocess.run(
+        [sys.executable, "-m", "presto_tpu.apps.accel_sift",
+         "-o", "cands_sifted.txt"],
+        cwd=refdir, check=True, capture_output=True, env=env)
+    accs = sorted(glob.glob(os.path.join(refdir, "*_ACCEL_0")))
+    cl = sift_candidates(accs, numdms_min=2, low_DM_cutoff=2.0)
+    top = select_fold_candidates(cl, fold_top=3)
+    assert top, "fixture beam must yield surviving candidates"
+    pfds = []
+    for i, c in enumerate(top):
+        acc = os.path.basename(os.path.join(c.path or refdir,
+                                            c.filename))
+        dat = acc.split("_ACCEL_")[0] + ".dat"
+        subprocess.run(
+            [sys.executable, "-m", "presto_tpu.apps.prepfold",
+             "-accelfile", acc + ".cand", "-accelcand",
+             str(c.candnum), "-dm", "%.2f" % c.DM, "-nosearch",
+             "-noplot", "-o", "fold_cand%d" % (i + 1), dat],
+            cwd=refdir, check=True, capture_output=True, env=env)
+        pfds.append("fold_cand%d.pfd" % (i + 1))
+    subprocess.run(
+        [sys.executable, "-m", "presto_tpu.apps.get_toas",
+         "-n", "1", "-o", "toas.tim"] + pfds,
+        cwd=refdir, check=True, capture_output=True, env=env)
+    return {"dir": refdir, "cands": cl, "top": top, "pfds": pfds}
+
+
+def _read(*parts) -> bytes:
+    with open(os.path.join(*parts), "rb") as f:
+        return f.read()
+
+
+def test_stacked_folds_byte_equal_fewer_dispatches(cli_reference,
+                                                   tmp_path):
+    """Same-geometry fold jobs provably coalesce: at N=4 the stacked
+    drizzle pays 3 device dispatches where per-job folding pays 12,
+    with .pfd/.bestprof bytes equal to the CLI reference."""
+    from presto_tpu.apps.prepfold import DatFoldSpec, fold_dat_cands
+    from presto_tpu.obs import Observability, ObsConfig, jaxtel
+    ref = cli_reference
+    c = ref["top"][0]
+    accpath = os.path.join(c.path or ref["dir"], c.filename)
+    dat = accpath.split("_ACCEL_")[0] + ".dat"
+
+    def spec(outdir):
+        os.makedirs(outdir, exist_ok=True)
+        return DatFoldSpec(datfile=dat,
+                           accelfile=accpath + ".cand",
+                           candnum=c.candnum,
+                           outbase=os.path.join(outdir,
+                                                "fold_cand1"),
+                           dm=c.DM)
+
+    obs = Observability(ObsConfig(enabled=True))
+    n0 = jaxtel.transfer_snapshot(obs)["dispatches"]
+    singles = [spec(str(tmp_path / ("s%d" % i))) for i in range(4)]
+    for s in singles:
+        fold_dat_cands([s], obs=obs)
+    n1 = jaxtel.transfer_snapshot(obs)["dispatches"]
+    stacked = [spec(str(tmp_path / ("k%d" % i))) for i in range(4)]
+    out = fold_dat_cands(stacked, obs=obs)
+    n2 = jaxtel.transfer_snapshot(obs)["dispatches"]
+    per_job, one_stack = n1 - n0, n2 - n1
+    assert one_stack < per_job, (one_stack, per_job)
+    assert all(o["stacked"] == 4 for o in out)
+    want_pfd = _read(ref["dir"], ref["pfds"][0])
+    want_bp = _read(ref["dir"], ref["pfds"][0] + ".bestprof")
+    for s in singles + stacked:
+        assert _read(s.outbase + ".pfd") == want_pfd
+        assert _read(s.outbase + ".pfd.bestprof") == want_bp
+
+
+def test_fold_jobs_coalesce_through_stacked_executor(cli_reference,
+                                                     tmp_path):
+    """Fold node jobs sharing a stack bucket coalesce in the local
+    queue and execute through StackedBatchExecutor's fold arm as one
+    stacked drizzle — byte-equal to the CLI reference."""
+    ref = cli_reference
+    c = ref["top"][0]
+    accpath = os.path.join(c.path or ref["dir"], c.filename)
+    svc = SearchService(str(tmp_path / "w"), queue_depth=16)
+    try:
+        jobs = []
+        for i in range(4):
+            spec = {"kind": "fold", "bucket": "fold:test",
+                    "parent_dirs": {"search": ref["dir"]},
+                    "parents": {"search": "ref"},
+                    "fold": {"accelfile":
+                             os.path.basename(accpath) + ".cand",
+                             "candnum": c.candnum, "dm": c.DM,
+                             "datfile": os.path.basename(
+                                 accpath.split("_ACCEL_")[0])
+                             + ".dat",
+                             "outname": "fold_cand1"}}
+            job = svc.build_job(spec, job_id="fj%d" % i,
+                                workdir=str(tmp_path / ("f%d" % i)))
+            jobs.append(svc.enqueue_job(job)["job_id"])
+        svc.start()           # all 4 queued before the scheduler runs
+        assert svc.wait(jobs, timeout=120.0)
+        for jid in jobs:
+            assert svc.get_job(jid).status == "done"
+            assert svc.get_job(jid).result["stacked"] == 4
+        reg = svc.obs.metrics
+        assert reg.get("dag_folds_stacked_total").value == 4
+        assert reg.get("serve_stacked_jobs_total").value == 4
+        want = _read(ref["dir"], ref["pfds"][0])
+        for i in range(4):
+            assert _read(str(tmp_path / ("f%d" % i)),
+                         "fold_cand1.pfd") == want
+    finally:
+        svc.stop()
+
+
+def test_real_dag_kill_one_byte_equal_cli(cli_reference, strong_beam,
+                                          tmp_path):
+    """The acceptance trial: a real discovery DAG on a 2-replica
+    fleet with the victim killed right after the sift's fenced
+    fan-out landed (a half-finished DAG); the survivor finishes, and
+    every final artifact — sifted candidate list, .pfd outputs,
+    toas.tim — is byte-equal to the hand-driven CLI sequence."""
+    from presto_tpu.serve.dag import plan_dag
+    ref = cli_reference
+    fleetdir = tmp_path / "fleet"
+    led = JobLedger(str(fleetdir))
+    out = led.admit_dag(plan_dag(
+        {"rawfiles": [strong_beam], "config": dict(DAG_CFG),
+         "sift": {"min_dm_hits": 2, "low_dm_cutoff": 2.0},
+         "fold": {"fold_top": 3}, "toa": {"ntoa": 1}}))
+
+    def member(name, kill=None):
+        svc = SearchService(str(tmp_path / ("w-" + name)),
+                            queue_depth=8).start()
+        cfg = FleetConfig(fleetdir=str(fleetdir), replica=name,
+                          lease_ttl=30.0, heartbeat_s=0.1,
+                          heartbeat_timeout=0.8, poll_s=0.05,
+                          max_inflight=2, prewarm=False)
+        rep = FleetReplica(svc, cfg)
+        if kill:
+            rep.kill_on = kill
+        return svc, rep
+
+    svc_a, rep_a = member("a", kill="post-sift-commit")
+    svc_b, rep_b = member("b")
+    try:
+        rep_a.start()
+        assert _wait(lambda: rep_a._killed, timeout=240.0)
+        # half-finished: search + sift committed, folds fanned out
+        assert led.view(out["nodes"]["sift"])["state"] == DONE
+        rep_b.start()
+        assert _wait(led.all_terminal, timeout=240.0)
+        dv = led.dag_view(out["dag_id"])
+        assert dv["state"] == DONE, dv
+
+        def committed_dir(jid):
+            detail = json.load(open(os.path.join(
+                str(fleetdir), "jobs", jid, "result.json")))
+            return os.path.join(str(fleetdir), "jobs", jid,
+                                detail["attempt_dir"])
+
+        sdir = committed_dir(out["nodes"]["sift"])
+        assert _read(sdir, "cands_sifted.txt") == \
+            _read(ref["dir"], "cands_sifted.txt")
+        fold_ids = sorted(j for j in dv["nodes"] if "-fold-" in j)
+        assert len(fold_ids) == len(ref["pfds"])
+        for i, fid in enumerate(fold_ids):
+            fdir = committed_dir(fid)
+            assert _read(fdir, "fold_cand%d.pfd" % (i + 1)) == \
+                _read(ref["dir"], ref["pfds"][i])
+            assert _read(fdir,
+                         "fold_cand%d.pfd.bestprof" % (i + 1)) == \
+                _read(ref["dir"], ref["pfds"][i] + ".bestprof")
+        tdir = committed_dir(out["nodes"]["toa"])
+        assert _read(tdir, "toas.tim") == _read(ref["dir"],
+                                                "toas.tim")
+    finally:
+        rep_a.stop()
+        rep_b.stop()
+        svc_a.stop()
+        svc_b.stop()

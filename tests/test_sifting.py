@@ -124,6 +124,45 @@ def test_sift_end_to_end_with_accel_files(tmp_path, monkeypatch):
     assert all(abs(c.r - 777.0) > 100 for c in cl.cands)
 
 
+def test_sift_order_deterministic(tmp_path, monkeypatch):
+    """ISSUE 11 satellite regression: candidate-file ingestion order
+    is sorted inside read_candidates, so the sifted list — and
+    therefore a discovery DAG's fold fan-out set — is byte-stable no
+    matter what order a filesystem's glob returns (exact ties in the
+    duplicate/harmonic sifts resolve by encounter order)."""
+    import random
+    from presto_tpu.apps.accelsearch import write_accel_file
+    from presto_tpu.io.infodata import InfoData, write_inf
+    from presto_tpu.pipeline.sifting import select_fold_candidates
+    from presto_tpu.search.accel import AccelCand
+
+    T, N, dt = 1000.0, 1 << 20, 1000.0 / (1 << 20)
+    monkeypatch.chdir(tmp_path)
+    for dm in (10.0, 20.0, 30.0, 40.0):
+        base = "fake_DM%.2f" % dm
+        write_inf(InfoData(name=base, N=N, dt=dt), base + ".inf")
+        # identical sigma across DMs: an exact tie, the order trap
+        cands = [AccelCand(power=60.0, sigma=9.0, numharm=4,
+                           r=12345.0, z=2.0)]
+        write_accel_file(base + "_ACCEL_200", cands, T)
+    files = sorted(str(p) for p in tmp_path.glob("*_ACCEL_200"))
+    ref = sift_candidates(files, numdms_min=2, low_DM_cutoff=2.0)
+    ref.to_file("ref.txt")
+    ref_top = [(c.filename, c.candnum)
+               for c in select_fold_candidates(ref, fold_top=4)]
+    for seed in (1, 2, 3):
+        shuffled = list(files)
+        random.Random(seed).shuffle(shuffled)
+        cl = sift_candidates(shuffled, numdms_min=2,
+                             low_DM_cutoff=2.0)
+        cl.to_file("got.txt")
+        assert open("got.txt", "rb").read() == \
+            open("ref.txt", "rb").read()
+        assert [(c.filename, c.candnum)
+                for c in select_fold_candidates(cl, fold_top=4)] \
+            == ref_top
+
+
 def test_ddplan_basic_properties():
     obs = Observation(dt=72e-6, f_ctr=1400.0, bw=300.0, numchan=1024)
     plan = plan_dedispersion(obs, 0.0, 500.0)

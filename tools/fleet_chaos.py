@@ -3,7 +3,15 @@
 The acceptance proof of ISSUE 9's tentpole is a chaos trial, not a
 demo: kill a replica mid-batch and every leased job must be
 re-admitted and complete **exactly once**, with artifacts byte-equal
-to a never-failed run.  Each trial here:
+to a never-failed run.  `-dag` runs the ISSUE 11 analog over whole
+discovery DAGs (search -> sift -> fold fan-out -> timing): the
+victim dies at a DAG-aware kill point — while computing the fold
+fan-out (pre-commit), right after the fenced expand landed
+(post-sift-commit), or holding a leased fold (mid-fold) — and the
+trial passes iff every node runs exactly once, the fold set exists
+exactly once, and the final artifacts (sifted list, .pfd,
+.bestprof, toas.tim) are byte-equal to a never-failed reference
+(-> DAG_CHAOS.json).  Each classic trial here:
 
   1. builds a fresh fleet directory and admits J identical tiny-survey
      jobs to the ledger;
@@ -48,6 +56,19 @@ TINY_CFG = {"lodm": 50.0, "hidm": 56.0, "nsub": 8, "zmax": 0,
 #: reaper must re-admit every member and the survivors complete each
 #: exactly once.
 KILL_POINTS = ("job-leased", "job-enqueued", "batch-leased", "timed")
+
+#: DAG-aware kill points (-dag): "fold-fanout" dies with the sift's
+#: fan-out computed but UNcommitted (the expand is lost with the
+#: attempt; a survivor redoes the sift and expands identically),
+#: "post-sift-commit" dies right after the fenced expand landed,
+#: "mid-fold" dies holding a leased fold job.
+DAG_KILL_POINTS = ("fold-fanout", "post-sift-commit", "mid-fold",
+                   "timed")
+
+#: DAG trial search config (needs a sift-surviving candidate, so the
+#: beam is longer/stronger than the classic trials')
+DAG_CFG = {"lodm": 50.0, "hidm": 60.0, "nsub": 8, "zmax": 0,
+           "numharm": 4, "singlepulse": False, "skip_rfifind": True}
 
 
 def _wait(cond, timeout, poll=0.05):
@@ -160,6 +181,166 @@ def run_trial(trial: int, rng: random.Random, beam: str, ref: dict,
     return rec
 
 
+def make_dag_beam(workdir: str) -> str:
+    """One strong synthetic beam whose injected pulsar survives the
+    sift (the DAG trial's fan-out must be non-empty)."""
+    from presto_tpu.models.synth import FakeSignal, fake_filterbank_file
+    path = os.path.join(workdir, "dagbeam", "beam.fil")
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    sig = FakeSignal(f=23.0, dm=55.0, shape="gauss", width=0.08,
+                     amp=2.0)
+    fake_filterbank_file(path, 16384, 5e-4, 8, 400.0, 1.0, sig,
+                         noise_sigma=2.0, nbits=8, seed=101)
+    return path
+
+
+def dag_reference(beam: str, workdir: str) -> dict:
+    """The never-failed reference for DAG trials: the hand-driven
+    sequence (search stages -> sift -> per-candidate folds -> TOAs)
+    through the same library entry points the CLIs wrap (prepfold
+    byte-parity with the cwd-run CLI is pinned by tests/test_dag.py).
+    Returns {relative artifact name: bytes}."""
+    import glob as _glob
+    from presto_tpu.apps.get_toas import toa_lines
+    from presto_tpu.apps.prepfold import DatFoldSpec, fold_dat_cands
+    from presto_tpu.pipeline.sifting import (select_fold_candidates,
+                                             sift_candidates)
+    from presto_tpu.pipeline.survey import SurveyConfig, run_survey
+    refdir = os.path.join(workdir, "dag-reference")
+    run_survey([beam], SurveyConfig(**dict(DAG_CFG, fold_top=0,
+                                           durable_stages=True)),
+               workdir=refdir)
+    accs = sorted(_glob.glob(os.path.join(refdir, "*_ACCEL_0")))
+    cl = sift_candidates(accs, numdms_min=2, low_DM_cutoff=2.0)
+    cl.to_file(os.path.join(refdir, "cands_sifted.txt"))
+    top = select_fold_candidates(cl, fold_top=3)
+    specs = []
+    for i, c in enumerate(top):
+        accpath = os.path.join(c.path or refdir, c.filename)
+        specs.append(DatFoldSpec(
+            datfile=accpath.split("_ACCEL_")[0] + ".dat",
+            accelfile=accpath + ".cand", candnum=c.candnum,
+            outbase=os.path.join(refdir, "fold_cand%d" % (i + 1)),
+            dm=c.DM))
+    fold_dat_cands(specs)
+    pfds = [s.outbase + ".pfd" for s in specs]
+    with open(os.path.join(refdir, "toas.tim"), "w") as f:
+        f.write("\n".join(toa_lines(pfds, ntoa=1)) + "\n")
+    out = {}
+    for name in (["cands_sifted.txt", "toas.tim"]
+                 + ["fold_cand%d.pfd" % (i + 1)
+                    for i in range(len(specs))]
+                 + ["fold_cand%d.pfd.bestprof" % (i + 1)
+                    for i in range(len(specs))]):
+        with open(os.path.join(refdir, name), "rb") as f:
+            out[name] = f.read()
+    return out
+
+
+def run_dag_trial(trial: int, rng: random.Random, beam: str,
+                  ref: dict, workdir: str, replicas: int,
+                  timeout: float) -> dict:
+    """One DAG kill-one trial: admit a whole discovery DAG, kill the
+    victim at a DAG-aware point, let survivors finish, and check
+    exactly-once + single-fan-out + byte-equality to the
+    never-failed reference."""
+    from presto_tpu.serve.dag import plan_dag
+    from presto_tpu.serve.fleet import FleetConfig, FleetReplica
+    from presto_tpu.serve.jobledger import JobLedger
+    from presto_tpu.serve.server import SearchService
+
+    fleetdir = os.path.join(workdir, "dagtrial%02d" % trial, "fleet")
+    led = JobLedger(fleetdir)
+    out = led.admit_dag(plan_dag(
+        {"rawfiles": [beam], "config": dict(DAG_CFG),
+         "sift": {"min_dm_hits": 2, "low_dm_cutoff": 2.0},
+         "fold": {"fold_top": 3}, "toa": {"ntoa": 1}}))
+    # first len(DAG_KILL_POINTS) trials sweep every point once (the
+    # committed artifact must cover the whole matrix); extra trials
+    # randomize
+    kill_point = (DAG_KILL_POINTS[trial % len(DAG_KILL_POINTS)]
+                  if trial < len(DAG_KILL_POINTS)
+                  else rng.choice(DAG_KILL_POINTS))
+    kill_delay = rng.uniform(0.5, 4.0)
+    victim_idx = rng.randrange(replicas)
+    rec = {"trial": trial, "mode": "dag", "kill_point": kill_point,
+           "victim": "rep%d" % victim_idx, "dag": out["dag_id"],
+           "kill_delay_s": round(kill_delay, 3), "ok": False,
+           "checks": {}}
+    members = []
+    try:
+        for i in range(replicas):
+            svc = SearchService(
+                os.path.join(workdir, "dagtrial%02d" % trial,
+                             "rep%d" % i), queue_depth=8).start()
+            cfg = FleetConfig(fleetdir=fleetdir,
+                              replica="rep%d" % i, lease_ttl=30.0,
+                              heartbeat_s=0.1, heartbeat_timeout=0.8,
+                              poll_s=0.05, max_inflight=2,
+                              prewarm=False)
+            rep = FleetReplica(svc, cfg)
+            if i == victim_idx and kill_point != "timed":
+                rep.kill_on = kill_point
+            members.append((svc, rep))
+        victim_svc, victim = members[victim_idx]
+        victim.start()
+        if kill_point == "timed":
+            time.sleep(kill_delay)
+            victim.kill()
+        else:
+            _wait(lambda: victim._killed, timeout=timeout)
+        rec["checks"]["victim_killed"] = bool(victim._killed)
+        for i, (svc, rep) in enumerate(members):
+            if i != victim_idx:
+                rep.start()
+        ok_all = _wait(led.all_terminal, timeout=timeout)
+        rec["checks"]["all_terminal"] = ok_all
+        dv = led.dag_view(out["dag_id"])
+        rec["node_counts"] = dv["counts"]
+        rec["checks"]["dag_done"] = (dv["state"] == "done")
+        fold_ids = sorted(j for j in dv["nodes"] if "-fold-" in j)
+        rec["folds"] = len(fold_ids)
+        # the fan-out exists exactly once (sequential ids, one set)
+        rec["checks"]["single_fanout"] = fold_ids == [
+            "%s-fold-%03d" % (out["dag_id"], i + 1)
+            for i in range(len(fold_ids))]
+        rec["redos"] = {j: r["redos"] for j, r in
+                        led.read()["jobs"].items() if r["redos"]}
+
+        def committed(jid, name):
+            detail = json.load(open(os.path.join(
+                fleetdir, "jobs", jid, "result.json")))
+            p = os.path.join(fleetdir, "jobs", jid,
+                             detail["attempt_dir"], name)
+            with open(p, "rb") as f:
+                return f.read()
+
+        equal = True
+        try:
+            if committed(out["nodes"]["sift"],
+                         "cands_sifted.txt") != \
+                    ref["cands_sifted.txt"]:
+                equal = False
+            for i, fid in enumerate(fold_ids):
+                for suffix in (".pfd", ".pfd.bestprof"):
+                    if committed(fid, "fold_cand%d%s"
+                                 % (i + 1, suffix)) != \
+                            ref["fold_cand%d%s" % (i + 1, suffix)]:
+                        equal = False
+            if committed(out["nodes"]["toa"], "toas.tim") != \
+                    ref["toas.tim"]:
+                equal = False
+        except (OSError, ValueError, KeyError):
+            equal = False
+        rec["checks"]["byte_equal_reference"] = equal
+        rec["ok"] = all(rec["checks"].values())
+    finally:
+        for svc, rep in members:
+            rep.stop()
+            svc.stop()
+    return rec
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="fleet_chaos")
     p.add_argument("-trials", type=int, default=3)
@@ -173,11 +354,17 @@ def main(argv=None) -> int:
                    help="Same-bucket jobs leased per transaction "
                         "(drives the batch-leased kill point)")
     p.add_argument("-workdir", type=str, default=None)
+    p.add_argument("-dag", action="store_true",
+                   help="DAG mode: kill-one trials over whole "
+                        "discovery DAGs at DAG-aware kill points "
+                        "(-> DAG_CHAOS.json with -commit)")
     p.add_argument("-out", type=str, default=None,
                    help="Report path (default <repo>/FLEET_CHAOS.json"
-                        " only with -commit; else stdout)")
+                        " or DAG_CHAOS.json only with -commit; else "
+                        "stdout)")
     p.add_argument("-commit", action="store_true",
-                   help="Write the report to <repo>/FLEET_CHAOS.json")
+                   help="Write the report to <repo>/FLEET_CHAOS.json "
+                        "(or DAG_CHAOS.json with -dag)")
     p.add_argument("--fast", action="store_true",
                    help="1 trial, CI smoke")
     args = p.parse_args(argv)
@@ -190,6 +377,40 @@ def main(argv=None) -> int:
     from presto_tpu.serve.fleet import artifact_digests
 
     workdir = args.workdir or tempfile.mkdtemp(prefix="fleet_chaos_")
+    rng = random.Random(args.seed)
+    trials = []
+    if args.dag:
+        beam = make_dag_beam(workdir)
+        ref = dag_reference(beam, workdir)
+        for t in range(args.trials):
+            rec = run_dag_trial(t, rng, beam, ref, workdir,
+                                args.replicas, args.timeout)
+            print("fleet_chaos: dag trial %d kill=%s victim=%s -> %s"
+                  % (t, rec["kill_point"], rec["victim"],
+                     "PASS" if rec["ok"] else "FAIL"), flush=True)
+            trials.append(rec)
+        report = {
+            "mode": "dag",
+            "seed": args.seed,
+            "replicas": args.replicas,
+            "config": DAG_CFG,
+            "kill_points": list(DAG_KILL_POINTS),
+            "reference_artifacts": len(ref),
+            "trials": trials,
+            "passed": sum(1 for r in trials if r["ok"]),
+            "failed": sum(1 for r in trials if not r["ok"]),
+        }
+        out = args.out or (os.path.join(REPO, "DAG_CHAOS.json")
+                           if args.commit else None)
+        text = json.dumps(report, indent=1, sort_keys=True)
+        if out:
+            with open(out, "w") as f:
+                f.write(text + "\n")
+            print("fleet_chaos: report -> %s" % out)
+        else:
+            print(text)
+        return 0 if report["failed"] == 0 else 1
+
     beam = make_beams(workdir, 1, nsamp=args.nsamp,
                       nchan=args.nchan)[0]
     # the never-failed reference: one plain batch-driver run
@@ -197,8 +418,6 @@ def main(argv=None) -> int:
     run_survey([beam], SurveyConfig(**TINY_CFG), workdir=refdir)
     ref = artifact_digests(refdir)
 
-    rng = random.Random(args.seed)
-    trials = []
     for t in range(args.trials):
         rec = run_trial(t, rng, beam, ref, workdir, args.replicas,
                         args.jobs, args.timeout,

@@ -67,7 +67,13 @@ code path cannot ship silently:
      name the serve layer opens is registered in SERVE_SPANS — and
      conversely — so the scheduler's per-job span and the stacked
      batch executor's cross-job `serve:stacked-batch` span can
-     neither ship dark nor linger in the catalog after a rename.
+     neither ship dark nor linger in the catalog after a rename;
+  12. discovery DAGs (serve/dag.py + jobledger.py + router.py +
+     fleet.py): DAG_EVENTS / DAG_SPANS / DAG_METRICS pinned BOTH
+     directions (and as subsets of their parent catalogs) — the
+     dependency-aware job graph's fenced fan-out and cascade-failure
+     paths run exactly while a mid-graph replica is dying, so their
+     telemetry may neither go dark nor go stale.
 
 Run directly (exit 1 lists violations) or via tests/test_obs_lint.py.
 """
@@ -183,11 +189,13 @@ def lint() -> List[str]:
             "obs/taxonomy.py: CLUSTER_EVENTS lists %r but the "
             "elastic layer never emits it" % k)
 
-    # 3. serve event kinds (the fleet modules share the serve event
-    # log, so their registered vocabulary — FLEET_EVENTS, pinned both
-    # directions by check 10 — is admissible here too)
+    # 3. serve event kinds (the fleet and DAG modules share the serve
+    # event log, so their registered vocabularies — FLEET_EVENTS /
+    # DAG_EVENTS, pinned both directions by checks 10/12 — are
+    # admissible here too)
     serve_srcs = _tree_sources("presto_tpu/serve")
-    serve_ok = taxonomy.SERVE_EVENTS | taxonomy.FLEET_EVENTS
+    serve_ok = (taxonomy.SERVE_EVENTS | taxonomy.FLEET_EVENTS
+                | taxonomy.DAG_EVENTS)
     emitted: Set[str] = set()
     for rel, src in sorted(serve_srcs.items()):
         kinds = set(EMIT_RE.findall(src))
@@ -195,8 +203,8 @@ def lint() -> List[str]:
         for k in sorted(kinds - serve_ok):
             problems.append(
                 "%s: event kind %r is not registered in "
-                "obs/taxonomy.SERVE_EVENTS or FLEET_EVENTS"
-                % (rel, k))
+                "obs/taxonomy.SERVE_EVENTS, FLEET_EVENTS, or "
+                "DAG_EVENTS" % (rel, k))
 
     # 4. every job lifecycle state announces itself (scoped to the
     # JobStatus class body: queue.py also defines the Lanes constants,
@@ -390,7 +398,7 @@ def lint() -> List[str]:
             "obs/taxonomy.py: FLEET_EVENTS lists %r but the fleet "
             "layer never emits it" % k)
     for k in sorted(fl_events - taxonomy.FLEET_EVENTS
-                    - taxonomy.SERVE_EVENTS):
+                    - taxonomy.SERVE_EVENTS - taxonomy.DAG_EVENTS):
         problems.append(
             "fleet layer: event kind %r is not registered in "
             "obs/taxonomy.FLEET_EVENTS" % k)
@@ -426,6 +434,66 @@ def lint() -> List[str]:
         problems.append(
             "obs/taxonomy.py: SERVE_SPANS lists %r but the serve "
             "layer never opens it" % s)
+
+    # 12. discovery DAGs (serve/dag.py + jobledger.py + router.py +
+    # fleet.py): DAG_EVENTS / DAG_SPANS / DAG_METRICS pinned BOTH
+    # directions — the dependency-aware job graph is exactly the code
+    # that runs while a mid-graph replica is dying (fenced fan-out,
+    # cascade failure), so its telemetry may neither go dark nor go
+    # stale; the dag sets must also be subsets of their parent
+    # catalogs so a rename cannot leave a dangling entry.
+    dag_files = ("presto_tpu/serve/dag.py",
+                 "presto_tpu/serve/jobledger.py",
+                 "presto_tpu/serve/router.py",
+                 "presto_tpu/serve/fleet.py")
+    dg_events: Set[str] = set()
+    dg_spans: Set[str] = set()
+    dg_metrics: Set[str] = set()
+    for rel in dag_files:
+        try:
+            src = _read(rel)
+        except OSError:
+            continue
+        dg_events |= set(EMIT_RE.findall(src))
+        dg_events |= set(CLUSTER_EVENT_RE.findall(src))
+        dg_spans |= set(SPAN_RE.findall(src))
+        dg_metrics |= set(METRIC_RE.findall(src))
+    for s in sorted(taxonomy.DAG_SPANS - taxonomy.SERVE_SPANS):
+        problems.append(
+            "obs/taxonomy.py: DAG_SPANS lists %r which is not in "
+            "SERVE_SPANS" % s)
+    for m in sorted(taxonomy.DAG_METRICS - taxonomy.METRICS):
+        problems.append(
+            "obs/taxonomy.py: DAG_METRICS lists %r which is not in "
+            "METRICS" % m)
+    for k in sorted(taxonomy.DAG_EVENTS - dg_events):
+        problems.append(
+            "obs/taxonomy.py: DAG_EVENTS lists %r but the dag layer "
+            "never emits it" % k)
+    for k in sorted({x for x in dg_events if x.startswith("dag-")}
+                    - taxonomy.DAG_EVENTS):
+        problems.append(
+            "dag layer: event kind %r is not registered in "
+            "obs/taxonomy.DAG_EVENTS" % k)
+    for s in sorted(taxonomy.DAG_SPANS - dg_spans):
+        problems.append(
+            "obs/taxonomy.py: DAG_SPANS lists %r but the dag layer "
+            "never opens it" % s)
+    for s in sorted({x for x in dg_spans
+                     if x.startswith("serve:dag")}
+                    - taxonomy.DAG_SPANS):
+        problems.append(
+            "dag layer: span %r is not registered in "
+            "obs/taxonomy.DAG_SPANS" % s)
+    for m in sorted(taxonomy.DAG_METRICS - dg_metrics):
+        problems.append(
+            "obs/taxonomy.py: DAG_METRICS lists %r but the dag "
+            "layer never registers it" % m)
+    for m in sorted({x for x in dg_metrics if x.startswith("dag_")}
+                    - taxonomy.DAG_METRICS):
+        problems.append(
+            "dag layer: metric %r is not registered in "
+            "obs/taxonomy.DAG_METRICS" % m)
     return problems
 
 
