@@ -13,6 +13,16 @@ post-mortems (`flightrec-*.json`), and ingest quality ledgers
 Sections render only when their source file exists, so the tool is
 useful on anything from a bare batch run (manifest only) to a chaos
 post-mortem (flight recorder + open spans at death).
+
+`presto-report -fleet DIR` switches to FLEET mode: DIR is a fleet
+working directory (the job ledger + `obs/` telemetry), and the report
+merges the ledger state, every replica's metric snapshot
+(fleet-wide `job_e2e_seconds` percentiles), the cross-process span
+streams joined by trace id (`obs/fleetagg.py`; `-trace-out` exports
+them as ONE Perfetto file), any dead replica's flight-recorder dump
+(discovered via the ledger's tombstone/reap host records), and a
+per-DAG critical-path breakdown — which node gated end-to-end
+latency, lease-wait vs device-execute share.
 """
 
 from __future__ import annotations
@@ -148,6 +158,178 @@ def collect(workdir: str) -> dict:
 
 
 # ----------------------------------------------------------------------
+# fleet mode
+# ----------------------------------------------------------------------
+
+def collect_fleet(fleetdir: str,
+                  trace_out: Optional[str] = None) -> dict:
+    """Everything the FLEET report needs: ledger state, merged
+    metric snapshots, cross-process traces, dead-replica flight
+    recorder dumps, per-DAG critical paths."""
+    from presto_tpu.obs import fleetagg
+    from presto_tpu.obs.flightrec import find_dumps
+    from presto_tpu.serve.jobledger import JobLedger
+
+    info: dict = {"fleetdir": os.path.abspath(fleetdir)}
+    ledger = JobLedger(fleetdir)
+    state = ledger.read()
+    jobs = state.get("jobs", {})
+    counts: dict = {}
+    for row in jobs.values():
+        counts[row["state"]] = counts.get(row["state"], 0) + 1
+    hosts = {}
+    for host, h in sorted(state.get("hosts", {}).items()):
+        _ts, tombstoned = ledger._hb_record(host)
+        hosts[host] = {"alive": bool(h.get("alive", False)),
+                       "tombstoned": tombstoned,
+                       "addr": h.get("addr")}
+    info["ledger"] = {"epoch": int(state.get("epoch", 0)),
+                      "jobs": counts, "hosts": hosts,
+                      "tenants": state.get("tenants", {})}
+
+    # per-replica metric snapshots -> one fleet-wide registry
+    agg = fleetagg.aggregate(fleetdir)
+    if agg["replicas"]:
+        merged = agg["merged"]
+        info["snapshots"] = agg["replicas"]
+        info["job_e2e"] = fleetagg.rollup(merged,
+                                          "job_e2e_seconds",
+                                          "phase")
+        info["latency"] = fleetagg.rollup(merged,
+                                          "latency_seconds",
+                                          "name")
+
+    # cross-process traces joined by trace id
+    spans = fleetagg.load_fleet_spans(fleetdir)
+    if spans:
+        traces = fleetagg.spans_by_trace(spans)
+        orphans = fleetagg.orphan_spans(spans)
+        info["traces"] = {
+            "spans": len(spans),
+            "processes": len({s.get("pid") for s in spans}),
+            "n_traces": len(traces),
+            "orphan_spans": len(orphans),
+        }
+        if trace_out:
+            fleetagg.write_merged_chrome(trace_out, spans)
+            info["traces"]["merged_perfetto"] = \
+                os.path.abspath(trace_out)
+
+    # dead replicas' flight-recorder dumps: the ledger's host table
+    # (reaped rows + heartbeat tombstones) says who died; their dumps
+    # live under <fleet>/obs/<replica>/
+    flight = []
+    for host, h in hosts.items():
+        for p in find_dumps(fleetagg.replica_dump_dir(fleetdir,
+                                                      host)):
+            d = _load_json(p) or {}
+            recs = d.get("records", [])
+            last_point = ""
+            for rec in reversed(recs):
+                if rec.get("kind") in ("chaos-point",
+                                       "fleet-chaos-point"):
+                    last_point = rec.get("point", "")
+                    break
+            flight.append({
+                "replica": host,
+                "dead": not h["alive"] or h["tombstoned"],
+                "path": p,
+                "reason": d.get("reason", "?"),
+                "records": len(recs),
+                "open_spans": [s.get("name", "?")
+                               for s in d.get("open_spans", [])],
+                "last_kill_point": last_point,
+            })
+    if flight:
+        info["flightrec"] = flight
+
+    # per-DAG critical-path attribution
+    from presto_tpu.obs.fleetagg import dag_critical_path
+    dag_ids = sorted({row.get("dag") for row in jobs.values()
+                      if row.get("dag")})
+    if dag_ids:
+        info["dags"] = {d: dag_critical_path(jobs, d)
+                        for d in dag_ids}
+    return info
+
+
+def render_fleet(info: dict, file=None) -> None:
+    out = file or sys.stdout
+    w = lambda s="": print(s, file=out)     # noqa: E731
+    w("presto-report (fleet): %s" % info["fleetdir"])
+    led = info["ledger"]
+    w()
+    w("Ledger: epoch %d   jobs: %s"
+      % (led["epoch"],
+         " ".join("%s=%d" % kv for kv in sorted(
+             led["jobs"].items())) or "none"))
+    for host, h in led["hosts"].items():
+        w("  replica %-16s %s%s" % (
+            host,
+            "alive" if h["alive"] and not h["tombstoned"]
+            else "DEAD",
+            " (tombstoned)" if h["tombstoned"] else ""))
+
+    for name, snap in (info.get("snapshots") or {}).items():
+        w("  snapshot %-15s ts=%s%s"
+          % (name,
+             time.strftime("%H:%M:%S",
+                           time.localtime(snap.get("ts", 0))),
+             " (tombstone)" if snap.get("tombstone") else ""))
+
+    e2e = info.get("job_e2e")
+    if e2e:
+        w()
+        w("Fleet job_e2e_seconds (merged over replicas):")
+        for phase, st in e2e.items():
+            w("  %-12s n=%-5d p50=%8.3fs  p99=%8.3fs"
+              % (phase, st["count"], st["p50"], st["p99"]))
+
+    tr = info.get("traces")
+    if tr:
+        w()
+        w("Traces: %d spans over %d process(es), %d trace(s), "
+          "%d orphan span(s)"
+          % (tr["spans"], tr["processes"], tr["n_traces"],
+             tr["orphan_spans"]))
+        if tr.get("merged_perfetto"):
+            w("  merged Perfetto trace: %s "
+              "(open at https://ui.perfetto.dev)"
+              % tr["merged_perfetto"])
+
+    for fr in info.get("flightrec", []):
+        w()
+        w("Flight recorder (%s%s): %s"
+          % (fr["replica"], " — DEAD" if fr["dead"] else "",
+             fr["path"]))
+        w("  reason: %s   records: %d" % (fr["reason"],
+                                          fr["records"]))
+        if fr["last_kill_point"]:
+            w("  last kill point: %s" % fr["last_kill_point"])
+        if fr["open_spans"]:
+            w("  open spans at death: %s"
+              % " > ".join(fr["open_spans"]))
+
+    for dag_id, cp in (info.get("dags") or {}).items():
+        w()
+        w("DAG %s: %d/%d nodes done, e2e %s"
+          % (dag_id, cp.get("n_done", 0), cp.get("n_nodes", 0),
+             "%.3fs" % cp["e2e_s"] if cp.get("e2e_s") is not None
+             else "incomplete"))
+        if cp.get("critical_path"):
+            w("  critical path (wait %.1f%% / run %.1f%% of e2e):"
+              % (100 * (cp.get("wait_share") or 0.0),
+                 100 * (cp.get("run_share") or 0.0)))
+            for n in cp["critical_path"]:
+                w("    %-28s %-7s wait %ss  run %ss"
+                  % (n["job_id"], n["kind"],
+                     "%7.3f" % n["wait_s"]
+                     if n["wait_s"] is not None else "      ?",
+                     "%7.3f" % n["run_s"]
+                     if n["run_s"] is not None else "      ?"))
+
+
+# ----------------------------------------------------------------------
 # rendering
 # ----------------------------------------------------------------------
 
@@ -229,8 +411,20 @@ def build_parser():
     p = argparse.ArgumentParser(
         prog="presto-report",
         description="Render a run report from a survey/serve workdir "
-                    "(manifest + spans + flight recorder + quality).")
-    p.add_argument("workdir", help="Survey or serve-job directory")
+                    "(manifest + spans + flight recorder + quality), "
+                    "or a whole fleet directory with -fleet.")
+    p.add_argument("workdir", nargs="?", default=None,
+                   help="Survey or serve-job directory")
+    p.add_argument("-fleet", type=str, default=None, metavar="DIR",
+                   help="FLEET mode: merge this fleet directory's "
+                        "ledger, per-replica metric snapshots, "
+                        "cross-process traces, and dead-replica "
+                        "flight-recorder dumps into one report with "
+                        "per-DAG critical-path attribution")
+    p.add_argument("-trace-out", type=str, default=None,
+                   metavar="PATH",
+                   help="Fleet mode: write the merged cross-process "
+                        "Perfetto trace here")
     p.add_argument("-json", action="store_true",
                    help="Emit the collected report as JSON")
     p.add_argument("-spans", type=int, default=15,
@@ -240,7 +434,18 @@ def build_parser():
 
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
-    if not os.path.isdir(args.workdir):
+    if args.fleet:
+        if not os.path.isdir(args.fleet):
+            print("presto-report: no such fleet directory: %s"
+                  % args.fleet, file=sys.stderr)
+            return 1
+        info = collect_fleet(args.fleet, trace_out=args.trace_out)
+        if args.json:
+            print(json.dumps(info, indent=1, sort_keys=True))
+        else:
+            render_fleet(info)
+        return 0
+    if not args.workdir or not os.path.isdir(args.workdir):
         print("presto-report: no such directory: %s" % args.workdir,
               file=sys.stderr)
         return 1

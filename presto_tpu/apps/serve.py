@@ -78,6 +78,11 @@ def build_parser():
                    help="Same-bucket jobs leased per ledger "
                         "transaction (stacked into one device call; "
                         "1 = classic single leasing)")
+    p.add_argument("-snapshot-interval", type=float, default=2.0,
+                   help="Fleet-observability snapshot cadence in "
+                        "seconds: publish this replica's metrics "
+                        "state into <fleet>/obs/ for the router's "
+                        "GET /fleet/metrics aggregation (0 = off)")
     p.add_argument("-tune-in-idle", action="store_true",
                    help="Run bounded presto-tune budget slices when "
                         "the fleet ledger is empty (merge-saved into "
@@ -139,7 +144,8 @@ def main(argv=None) -> int:
                            prewarm=not args.no_prewarm,
                            lease_batch=args.lease_batch,
                            tune_in_idle=args.tune_in_idle,
-                           idle_tune_budget_s=args.idle_tune_budget)
+                           idle_tune_budget_s=args.idle_tune_budget,
+                           snapshot_s=args.snapshot_interval)
         replica = FleetReplica(
             service, fcfg,
             addr="http://%s:%d" % (host, port)).start()

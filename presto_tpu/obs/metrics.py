@@ -381,6 +381,41 @@ class MetricsRegistry:
                                     _fmt(child.value)))
         return "\n".join(lines) + "\n"
 
+    def export_state(self) -> Dict[str, dict]:
+        """Full, *mergeable* registry state (the fleet-aggregation
+        wire format, obs/fleetagg.py).  Unlike `snapshot`, histograms
+        carry their raw bucket counts AND the percentile sample
+        window, so N replicas' exports can be bucket-merged into one
+        fleet-wide histogram whose nearest-rank percentiles equal a
+        single shared registry's.  `inf` bucket bounds are encoded as
+        None (strict-JSON safe)."""
+        fams: Dict[str, dict] = {}
+        for fam in self.families():
+            series = []
+            for labels, child in fam.children():
+                entry: dict = {"labels": dict(labels)}
+                if isinstance(child, HistogramChild):
+                    with child._lock:
+                        entry.update({
+                            "count": child._count,
+                            "sum": child._sum,
+                            "bucket_counts": list(
+                                child._bucket_counts),
+                            "samples": list(child._window),
+                        })
+                else:
+                    entry["value"] = child.value
+                series.append(entry)
+            ent = {"kind": fam.kind, "help": fam.help,
+                   "labelnames": list(fam.labelnames),
+                   "series": series}
+            if isinstance(fam, HistogramFamily):
+                ent["buckets"] = [None if b == math.inf else b
+                                  for b in fam.buckets]
+                ent["window"] = fam.window
+            fams[fam.name] = ent
+        return {"families": fams}
+
     def snapshot(self) -> Dict[str, dict]:
         """JSON twin of the exposition (presto-report, tests)."""
         out: Dict[str, dict] = {}

@@ -126,6 +126,37 @@ FLEET_EVENTS = frozenset({
     "fleet-pump-error",
     "router-poll-error",
     "fleet-idle-tune",
+    "fleet-obs-snapshot",
+    "fleet-chaos-point",
+})
+
+#: fleet-observability event kinds (subset of FLEET_EVENTS; obs_lint
+#: check 13 pins them BOTH directions against serve/fleet.py +
+#: serve/router.py + obs/fleetagg.py): the snapshot publication that
+#: feeds `GET /fleet/metrics`, and the recorded-BEFORE-fire chaos
+#: stamp that guarantees a killed replica's flight-recorder dump
+#: names its kill point (batch-leased / fold-fanout included)
+FLEET_OBS_EVENTS = frozenset({
+    "fleet-obs-snapshot",
+    "fleet-chaos-point",
+})
+
+#: fleet-observability span names — the router's admission-time root
+#: spans whose SpanContext is stamped into the ledger row so the
+#: leasing replica resumes the SAME trace (subset of SERVE_SPANS;
+#: obs_lint check 13, both directions, `fleet:` prefix pinned)
+FLEET_SPANS = frozenset({
+    "fleet:submit",
+    "fleet:dag-submit",
+})
+
+#: fleet-observability metrics (obs_lint check 13, both directions):
+#: every `fleet_obs_*` name plus the end-to-end job decomposition
+#: histogram the control-plane item consumes
+FLEET_OBS_METRICS = frozenset({
+    "fleet_obs_snapshots_total",
+    "fleet_obs_aggregations_total",
+    "job_e2e_seconds",
 })
 
 #: streaming-layer event kinds — every `events.emit("<kind>", ...)`
@@ -156,6 +187,8 @@ SERVE_SPANS = frozenset({
     "serve-job",
     "serve:stacked-batch",
     "serve:dag-node",
+    "fleet:submit",
+    "fleet:dag-submit",
 })
 
 #: discovery-DAG event kinds — the dependency-aware job-graph
@@ -258,6 +291,8 @@ FLEET_METRICS = frozenset({
     "fleet_replicas_ready",
     "fleet_batch_leases_total",
     "fleet_idle_tune_total",
+    "fleet_obs_snapshots_total",
+    "fleet_obs_aggregations_total",
 })
 
 #: registered metric names (Prometheus side of the contract); the
@@ -354,6 +389,13 @@ METRICS = frozenset({
     "fleet_replicas_ready",
     "fleet_batch_leases_total",
     "fleet_idle_tune_total",
+    # fleet-wide observability (serve/fleet.py snapshot publisher,
+    # serve/router.py aggregation endpoint, the admit->lease-wait->
+    # execute->commit decomposition); pinned both directions by
+    # obs_lint check 13 via FLEET_OBS_METRICS
+    "fleet_obs_snapshots_total",
+    "fleet_obs_aggregations_total",
+    "job_e2e_seconds",
     # streaming search (presto_tpu/stream); every stream_* name here
     # must be registered by the stream layer (obs_lint check 7)
     "stream_blocks_total",

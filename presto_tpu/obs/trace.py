@@ -42,13 +42,29 @@ def _new_id(nhex: int) -> str:
 
 class SpanContext:
     """Portable span identity for cross-thread / cross-process
-    parenting."""
+    parenting.  `to_dict`/`from_dict` are the wire form the fleet
+    uses to propagate the context through ledger JSON: the router
+    stamps it onto the admitted job row, the leasing replica resumes
+    it as the explicit `parent=` of the job's root span, so one
+    discovery DAG renders as ONE trace even when every node ran on a
+    different replica (docs/OBSERVABILITY.md, "Fleet observability")."""
 
     __slots__ = ("trace_id", "span_id")
 
     def __init__(self, trace_id: str, span_id: str):
         self.trace_id = trace_id
         self.span_id = span_id
+
+    def to_dict(self) -> dict:
+        return {"trace_id": self.trace_id, "span_id": self.span_id}
+
+    @classmethod
+    def from_dict(cls, d) -> "Optional[SpanContext]":
+        """None for anything that is not a usable wire context (a
+        row without a trace field, a disabled-tracer stamp)."""
+        if not isinstance(d, dict) or not d.get("trace_id"):
+            return None
+        return cls(str(d["trace_id"]), str(d.get("span_id") or ""))
 
     def __repr__(self):
         return "SpanContext(%s, %s)" % (self.trace_id, self.span_id)
@@ -104,6 +120,7 @@ class Span:
             "duration_s": round(self.duration, 6),
             "status": self.status,
             "thread": self.thread,
+            "pid": os.getpid(),
             "attrs": self.attrs,
         }
 
@@ -153,10 +170,14 @@ class Tracer:
         self._jsonl_fh = None
 
     # -- span lifecycle -----------------------------------------------
-    def span(self, name: str, parent=None, **attrs):
-        """Start a span (sets it current for this context).  `parent`
-        may be a Span or SpanContext for explicit (e.g. cross-thread)
-        parenting; default is the context's current span."""
+    def span(self, name: str, parent=None, current: bool = True,
+             **attrs):
+        """Start a span (sets it current for this context unless
+        ``current=False`` — sibling spans opened in bulk, e.g. the
+        per-job spans of a stacked batch, must not nest into each
+        other).  `parent` may be a Span or SpanContext for explicit
+        (e.g. cross-thread or cross-process) parenting; default is
+        the context's current span."""
         if not self.enabled:
             return NOOP_SPAN
         if parent is None:
@@ -166,7 +187,8 @@ class Tracer:
         else:
             trace_id, parent_id = parent.trace_id, parent.span_id
         sp = Span(self, name, trace_id, _new_id(16), parent_id, attrs)
-        sp._token = self._cv.set(sp)
+        if current:
+            sp._token = self._cv.set(sp)
         with self._lock:
             self._open[sp.span_id] = sp
         return sp
@@ -214,6 +236,19 @@ class Tracer:
         wants to show about the moment of death)."""
         with self._lock:
             return sorted(self._open.values(), key=lambda s: s.start)
+
+    def attach_jsonl(self, path: str) -> bool:
+        """Late-bind a JSONL streaming sink (the fleet replica wires
+        its spans into `<fleet>/obs/<replica>.spans.jsonl` here).
+        A sink configured at construction (e.g. `-tracedir`) wins —
+        returns False and leaves it untouched."""
+        if not self.enabled:
+            return False
+        with self._lock:
+            if self._jsonl_path is not None:
+                return False
+            self._jsonl_path = path
+            return True
 
     def _ensure_jsonl(self):
         if self._jsonl_path is None:

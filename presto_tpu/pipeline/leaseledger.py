@@ -364,6 +364,10 @@ class LeaseLedger:
             row["owner"] = host
             row["lease_epoch"] = int(state["epoch"])
             row["lease_expires"] = now + ttl
+            # grant timestamp: the admit->lease wait half of the
+            # job_e2e_seconds decomposition (obs/fleetagg.py) and the
+            # fleet report's critical-path attribution read this
+            row["leased_at"] = now
             self._save(state)
             self._event(self.EV_LEASE, item=iid, host=host,
                         epoch=int(state["epoch"]))

@@ -51,6 +51,7 @@ import time
 from dataclasses import dataclass
 from typing import Dict, Optional, Tuple
 
+from presto_tpu.obs import fleetagg
 from presto_tpu.serve.jobledger import JobLedger
 from presto_tpu.serve.queue import (Job, JobStatus, QueueClosed,
                                     QueueFull)
@@ -109,6 +110,12 @@ class FleetConfig:
     idle_tune_budget_s: float = 20.0
     idle_tune_interval: float = 300.0
     idle_tune_db: str = ""         # default <fleetdir>/tune.json
+    #: fleet-observability snapshot cadence: the heartbeat loop
+    #: publishes this replica's full metrics state into
+    #: `<fleet>/obs/<replica>.json` every this many seconds (atomic,
+    #: tombstoned on drain), feeding the router's `GET /fleet/metrics`
+    #: aggregation (obs/fleetagg.py).  0 disables publishing.
+    snapshot_s: float = 2.0
 
 
 class FleetReplica:
@@ -125,6 +132,16 @@ class FleetReplica:
         self.jobroot = os.path.join(os.path.abspath(cfg.fleetdir),
                                     "jobs")
         os.makedirs(self.jobroot, exist_ok=True)
+        # fleet observability: this replica's spans stream into the
+        # shared obs dir (one JSONL per process — what the fleet
+        # report and tools/trace_merge.py join by trace id), and the
+        # heartbeat loop publishes metric snapshots next to them
+        self.obsdir = fleetagg.obs_dir(cfg.fleetdir)
+        os.makedirs(self.obsdir, exist_ok=True)
+        if service.obs.enabled:
+            service.obs.tracer.attach_jsonl(
+                fleetagg.span_stream_path(cfg.fleetdir,
+                                          self.replica))
         self.epoch = 0
         self.draining = False
         self._killed = False
@@ -163,10 +180,18 @@ class FleetReplica:
         self._c_idletune = reg.counter(
             "fleet_idle_tune_total",
             "Bounded tuning slices run in fleet idle capacity")
+        self._c_snapshots = reg.counter(
+            "fleet_obs_snapshots_total",
+            "Metric snapshots published into the fleet obs dir")
         self._g_inflight = reg.gauge(
             "fleet_inflight", "Leased jobs currently held")
         self._g_epoch = reg.gauge(
             "fleet_epoch", "Fleet epoch this replica last observed")
+        self._h_e2e = reg.histogram(
+            "job_e2e_seconds",
+            "End-to-end fleet job decomposition from ledger/event "
+            "timestamps: admit->lease wait, device execute, commit, "
+            "and total, per plan bucket", ("phase", "bucket"))
 
     # ---- lifecycle ----------------------------------------------------
 
@@ -180,6 +205,7 @@ class FleetReplica:
         self.epoch = self.ledger.epoch
         self._g_epoch.set(self.epoch)
         self.ledger.heartbeat(self.replica, self.epoch)
+        self._maybe_snapshot(force=True)
         self.service.events.emit("fleet-join", replica=self.replica,
                                  epoch=self.epoch,
                                  readmitted=len(redone))
@@ -197,7 +223,16 @@ class FleetReplica:
         """Chaos seam: die the way SIGKILL dies — heartbeats stop,
         leases stay claimed (the reaper must recover them), any
         running survey keeps running as a zombie whose late commit
-        the fence must reject."""
+        the fence must reject.  Like every real survey death, the
+        flight recorder dumps first: the ring (whose last record is
+        the `fleet-chaos-point` stamped BEFORE the kill fired) lands
+        in `<fleet>/obs/<replica>/flightrec-*.json`, where the fleet
+        report picks it up via the ledger's tombstone/reap records
+        after the fleet declares this replica dead."""
+        self.service.obs.dump_flight(
+            fleetagg.replica_dump_dir(self.cfg.fleetdir,
+                                      self.replica),
+            reason="replica-killed")
         self._killed = True
         self._stop.set()
 
@@ -236,6 +271,10 @@ class FleetReplica:
             released += 1
         self.stop()
         self.ledger.tombstone(self.replica)
+        # final metric snapshot, tombstoned exactly like the
+        # heartbeat: the aggregation keeps this replica's counters
+        # (its work happened) but drops its point-in-time gauges
+        self._maybe_snapshot(force=True, tombstone=True)
         self.service.events.emit("fleet-tombstone",
                                  replica=self.replica)
         parked = int(self.service.obs.metrics.get(
@@ -261,9 +300,43 @@ class FleetReplica:
             if self._killed or self.draining:
                 return
             self.ledger.heartbeat(self.replica, self.epoch)
+            self._maybe_snapshot()
+
+    # ---- fleet-observability snapshots --------------------------------
+
+    _last_snapshot = 0.0
+
+    def _maybe_snapshot(self, force: bool = False,
+                        tombstone: bool = False) -> None:
+        """Publish this replica's full metrics state atomically into
+        `<fleet>/obs/<replica>.json` (paced by snapshot_s; a failure
+        is an event, never a dead heartbeat loop)."""
+        if self.cfg.snapshot_s <= 0 or not self.service.obs.enabled:
+            return
+        now = time.time()
+        if not force and now - self._last_snapshot \
+                < self.cfg.snapshot_s:
+            return
+        self._last_snapshot = now
+        try:
+            fleetagg.publish_snapshot(self.cfg.fleetdir,
+                                      self.replica,
+                                      self.service.obs,
+                                      tombstone=tombstone)
+            self._c_snapshots.inc()
+            self.service.obs.event("fleet-obs-snapshot",
+                                   replica=self.replica,
+                                   tombstone=tombstone)
+        except Exception:
+            self.service.obs.event("fleet-pump-error")
 
     def _chaos(self, point: str) -> bool:
         if self.kill_on == point:
+            # recorded BEFORE the kill fires — the survey chaos
+            # guarantee extended to the fleet seams (incl.
+            # batch-leased and fold-fanout): the dump's last record
+            # names the kill point
+            self.service.obs.event("fleet-chaos-point", point=point)
             self.kill()
             return True
         return False
@@ -428,6 +501,13 @@ class FleetReplica:
             job = self.service.build_job(spec, job_id=job_id,
                                          workdir=workdir)
             job.priority = int(lease.data.get("priority", 10))
+            # resume the submission's trace (stamped at /submit by
+            # the router, or at a parent's expand) and carry the
+            # lease-grant timestamp for the job_e2e decomposition
+            if lease.data.get("trace"):
+                job.trace = dict(lease.data["trace"])
+            job.leased_at = float(lease.data.get("leased_at")
+                                  or 0.0)
             self.service.enqueue_job(job)
         except (QueueFull, QueueClosed):
             self.ledger.fail(lease, self.replica)
@@ -513,7 +593,13 @@ class FleetReplica:
             children = job.result.get("dag_children")
             retarget = job.result.get("dag_retarget")
         if children or retarget:
-            # inherit the graph's tenant/priority onto the fan-out
+            # inherit the graph's tenant/priority onto the fan-out,
+            # and the DAG's trace: children parent under THIS node's
+            # own span (the sift's folds nest under the sift) or,
+            # failing that, the incoming trace context — either way
+            # the whole expanded subtree stays in the DAG's one trace
+            child_trace = (getattr(job, "span_ctx", None)
+                           or lease.data.get("trace"))
             for _cid, fields in children or ():
                 fields.setdefault("tenant",
                                   lease.data.get("tenant",
@@ -521,6 +607,8 @@ class FleetReplica:
                 fields.setdefault("priority",
                                   int(lease.data.get("priority",
                                                      10)))
+                if child_trace:
+                    fields.setdefault("trace", dict(child_trace))
             if self._chaos("fold-fanout"):
                 # chaos seam: die AFTER computing the fan-out but
                 # BEFORE the commit transaction — the fan-out is
@@ -545,6 +633,7 @@ class FleetReplica:
                                      epoch=int(lease.epoch))
             return False
         self._c_committed.inc()
+        self._observe_e2e(lease, job, time.time())
         self.service.events.emit("job-done", job=job.job_id,
                                  replica=self.replica,
                                  epoch=int(lease.epoch))
@@ -556,6 +645,30 @@ class FleetReplica:
             # landed — the children exist; survivors lease them
             self._chaos("post-sift-commit")
         return True
+
+    def _observe_e2e(self, lease, job: Job, now: float) -> None:
+        """Decompose one committed job's life into the
+        `job_e2e_seconds{phase,bucket}` histogram from ledger/event
+        timestamps: admit->lease wait, device execute, commit, and
+        total — the per-bucket cost model the control-plane item
+        (predictive admission, drain-time Retry-After) consumes
+        through the fleet aggregation."""
+        sub = float(lease.data.get("submitted") or 0.0)
+        leased = float(getattr(job, "leased_at", 0.0) or 0.0)
+        bucket = str(lease.data.get("bucket") or "")
+        h = self._h_e2e
+        if sub and leased:
+            h.labels(phase="lease_wait", bucket=bucket).observe(
+                max(leased - sub, 0.0))
+        if job.started and job.finished:
+            h.labels(phase="execute", bucket=bucket).observe(
+                max(job.finished - job.started, 0.0))
+        if job.finished:
+            h.labels(phase="commit", bucket=bucket).observe(
+                max(now - job.finished, 0.0))
+        if sub:
+            h.labels(phase="total", bucket=bucket).observe(
+                max(now - sub, 0.0))
 
     # ---- shutdown parking ---------------------------------------------
 

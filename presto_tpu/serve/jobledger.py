@@ -127,7 +127,8 @@ class JobLedger(LeaseLedger):
               now: Optional[float] = None,
               bucket: Optional[str] = None,
               blocked_on: Optional[Sequence[str]] = None,
-              dag: Optional[str] = None) -> dict:
+              dag: Optional[str] = None,
+              trace: Optional[dict] = None) -> dict:
         """Durably admit one job.  Enforces the tenant's quota over
         its *active* (pending + leased) jobs; raises the typed
         TenantQuotaExceeded past it.  Returns the job's ledger view.
@@ -143,7 +144,14 @@ class JobLedger(LeaseLedger):
         ``blocked_on`` names parent job ids: the job stays pending
         but UN-leasable until every parent's fence-checked commit
         lands (serve/dag.py).  ``dag`` tags the row with its graph id
-        for `dag_view`."""
+        for `dag_view`.
+
+        ``trace`` is the router's span context
+        (`SpanContext.to_dict`): stamped onto the row so the leasing
+        replica resumes the submission's trace — search on replica A
+        and its folds on replica B render as ONE timeline.  Purely
+        telemetry: never read by the execution path, absent rows
+        simply start fresh traces."""
         now = time.time() if now is None else now
         tenant = str(tenant or DEFAULT_TENANT)
         with self._lock():
@@ -164,7 +172,7 @@ class JobLedger(LeaseLedger):
                 job_id = "fjob-%06d" % seq
             elif job_id in jobs:
                 raise JobLedgerError("duplicate job_id %r" % job_id)
-            jobs[job_id] = self._new_row({
+            row = {
                 "spec": dict(spec),
                 "tenant": tenant,
                 "priority": int(priority),
@@ -173,7 +181,10 @@ class JobLedger(LeaseLedger):
                 "bucket": bucket,
                 "blocked_on": list(blocked_on or ()),
                 "dag": dag,
-            })
+            }
+            if trace:
+                row["trace"] = dict(trace)
+            jobs[job_id] = self._new_row(row)
             self._save(state)
             return self._view(job_id, jobs[job_id])
 
@@ -189,7 +200,8 @@ class JobLedger(LeaseLedger):
                                               Sequence[str]]],
                   tenant: str = DEFAULT_TENANT, priority: int = 10,
                   dag_id: Optional[str] = None,
-                  now: Optional[float] = None) -> dict:
+                  now: Optional[float] = None,
+                  trace: Optional[dict] = None) -> dict:
         """Durably admit one job graph as ONE ledger transaction.
 
         ``nodes`` is a sequence of ``(rel_id, spec, bucket,
@@ -242,7 +254,7 @@ class JobLedger(LeaseLedger):
                         for role, val in raw.items()}
                 if isinstance(spec.get("retarget"), str):
                     spec["retarget"] = _full(spec["retarget"])
-                jobs[ids[rel]] = self._new_row({
+                row = {
                     "spec": spec,
                     "tenant": tenant,
                     "priority": int(priority),
@@ -251,7 +263,13 @@ class JobLedger(LeaseLedger):
                     "bucket": bucket,
                     "blocked_on": [_full(p) for p in parents or ()],
                     "dag": dag_id,
-                })
+                }
+                if trace:
+                    # every node starts under the DAG's trace; the
+                    # sift expand re-parents its fold fan-out under
+                    # the sift node's own span (fleet.py _commit)
+                    row["trace"] = dict(trace)
+                jobs[ids[rel]] = self._new_row(row)
             self._save(state)
         self._event("dag-submit", dag=dag_id, nodes=sorted(ids),
                     tenant=tenant)
@@ -444,6 +462,7 @@ class JobLedger(LeaseLedger):
                 row["owner"] = host
                 row["lease_epoch"] = epoch
                 row["lease_expires"] = now + ttl
+                row["leased_at"] = now
                 leases.append(self._make_lease(jid, row, epoch))
 
             grant(iid)

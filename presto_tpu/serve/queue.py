@@ -85,6 +85,18 @@ class Job:
     #: in-process callable jobs (the streaming tick): when set, the
     #: service executes run(job) instead of a survey
     run: Optional[Callable] = None
+    #: remote trace context (SpanContext wire dict) stamped by the
+    #: router through the job ledger; the scheduler resumes it as the
+    #: explicit parent of this job's `serve-job` span so one fleet
+    #: submission renders as ONE cross-process trace
+    trace: Optional[dict] = None
+    #: this job's own span identity once execution started (set by
+    #: the scheduler) — DAG fan-out children inherit it as THEIR
+    #: trace parent, giving folds correct parenting under the sift
+    span_ctx: Optional[dict] = None
+    #: ledger lease-grant timestamp (fleet jobs; the admit->lease
+    #: wait half of job_e2e_seconds)
+    leased_at: float = 0.0
     status: str = JobStatus.QUEUED
     attempts: int = 0
     requeues: int = 0              # retry re-admissions so far

@@ -47,8 +47,22 @@ Wire protocol (stdlib HTTP + JSON, like server.py):
   GET  /jobs/<id>/result  committed result.json (409 until done)
   GET  /fleet             topology + readiness + tenant counts
   GET  /healthz           router liveness
-  GET  /metrics           fleet metrics (JSON; ?format=prometheus)
+  GET  /metrics           router-process metrics (JSON;
+                          ?format=prometheus)
+  GET  /fleet/metrics     FLEET-WIDE aggregation over the replicas'
+                          atomic snapshots (obs/fleetagg.py):
+                          counters summed, gauges per-replica,
+                          histograms bucket-merged so fleet p50/p99
+                          are real percentiles; JSON by default,
+                          Prometheus via Accept/?format= exactly
+                          like /metrics
   GET  /events?n=100      router event tail
+
+Load shedding quotes `Retry-After` from the fleet-aggregated
+`job_e2e_seconds` drain estimate (backlog x mean execute seconds /
+ready replicas) when replica snapshots are available, falling back
+to the configured constant; the chosen value is recorded in the
+`shed` event payload (docs/OBSERVABILITY.md, "Fleet observability").
 """
 
 from __future__ import annotations
@@ -66,6 +80,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, List, Optional
 from urllib.parse import urlparse, parse_qs
 
+from presto_tpu.obs import fleetagg
 from presto_tpu.serve.events import EventLog
 from presto_tpu.serve.jobledger import (DEFAULT_TENANT, JobLedger,
                                         TenantQuotaExceeded)
@@ -118,6 +133,14 @@ class FleetRouter:
         self._ready_lock = threading.Lock()
         self._stop = threading.Event()
         self._poll_t: Optional[threading.Thread] = None
+        # fleet observability: the router's admission spans stream
+        # into the shared obs dir (they are the ROOT spans of every
+        # cross-process trace), and the poll loop refreshes a cached
+        # fleet metric aggregation for Retry-After quoting
+        if self.obs.enabled:
+            self.obs.tracer.attach_jsonl(fleetagg.span_stream_path(
+                cfg.fleetdir, "router-%d" % os.getpid()))
+        self._agg: Optional[dict] = None
         for spec in cfg.tenants:
             parts = spec.split(":")
             self.ledger.set_tenant(
@@ -142,6 +165,9 @@ class FleetRouter:
             "fleet_depth", "Fleet depth (pending + leased jobs)")
         self._g_ready = reg.gauge(
             "fleet_replicas_ready", "Replicas currently ready")
+        self._c_agg = reg.counter(
+            "fleet_obs_aggregations_total",
+            "Fleet metric aggregation passes (snapshot merges)")
 
     # ---- lifecycle ----------------------------------------------------
 
@@ -158,6 +184,7 @@ class FleetRouter:
         if self._poll_t is not None:
             self._poll_t.join(timeout=10.0)
         self.events.close()
+        self.obs.tracer.close()
 
     # ---- replica health -----------------------------------------------
 
@@ -194,6 +221,11 @@ class FleetRouter:
                               if r and r.get("ready")))
         self.ledger.reap(self.cfg.heartbeat_timeout)
         self._g_depth.set(self.ledger.depth())
+        try:
+            self._agg = fleetagg.aggregate(self.cfg.fleetdir)
+            self._c_agg.inc()
+        except Exception:
+            self.obs.event("router-poll-error")
         return out
 
     def ready_replicas(self) -> List[str]:
@@ -227,35 +259,81 @@ class FleetRouter:
         except Exception:
             return None
 
+    # ---- admission control: Retry-After from fleet telemetry ----------
+
+    @staticmethod
+    def _trace_stamp(span) -> Optional[dict]:
+        """The span's SpanContext as the wire dict stamped onto the
+        admitted ledger row (None with observability disabled)."""
+        ctx = span.context()
+        return None if ctx is None else ctx.to_dict()
+
+    def retry_after_estimate(self, depth: int):
+        """(seconds, source): Retry-After quoted from the fleet-
+        aggregated `job_e2e_seconds` drain estimate — mean device-
+        execute seconds per job x backlog depth / ready replicas —
+        when replica snapshots are available; the configured constant
+        otherwise.  Never below the constant, capped at 600 s."""
+        agg = self._agg
+        if agg:
+            roll = fleetagg.rollup(agg.get("merged") or {},
+                                   "job_e2e_seconds", "phase")
+            ph = roll.get("execute") or roll.get("total")
+            if ph and ph.get("count"):
+                mean = ph["sum"] / ph["count"]
+                ready = max(1, len(self.ready_replicas()))
+                est = depth * mean / ready
+                return (max(self.cfg.retry_after_s,
+                            min(est, 600.0)), "e2e-estimate")
+        return self.cfg.retry_after_s, "constant"
+
+    def _shed(self, tenant: str, depth: int) -> None:
+        """429 + Retry-After at the high-water mark; the chosen value
+        (and whether it came from the e2e estimate or the constant
+        fallback) rides the `fleet_shed_total` event payload."""
+        retry_after_s, source = self.retry_after_estimate(depth)
+        self._c_shed.inc()
+        self.events.emit("shed", tenant=tenant, depth=depth,
+                         high_water=self.cfg.high_water,
+                         retry_after_s=round(retry_after_s, 3),
+                         retry_after_source=source)
+        raise FleetBusy(depth, self.cfg.high_water, retry_after_s)
+
     def submit(self, spec: dict) -> dict:
         """Durably admit one job.  Raises FleetBusy (shed),
-        TenantQuotaExceeded (typed), NoReadyReplica (503)."""
+        TenantQuotaExceeded (typed), NoReadyReplica (503).  The
+        admission span's context is stamped onto the ledger row, so
+        the leasing replica resumes THIS trace."""
         if not isinstance(spec, dict):
             raise ValueError("spec must be a JSON object")
         tenant = str(spec.get("tenant") or DEFAULT_TENANT)
-        depth = self.ledger.depth()
-        self._g_depth.set(depth)
-        if depth >= self.cfg.high_water:
-            self._c_shed.inc()
-            self.events.emit("shed", tenant=tenant, depth=depth,
-                             high_water=self.cfg.high_water)
-            raise FleetBusy(depth, self.cfg.high_water,
-                            self.cfg.retry_after_s)
-        if self.cfg.require_ready and not self.ready_replicas():
-            raise NoReadyReplica(
-                "no ready replica registered in %s"
-                % self.cfg.fleetdir)
+        span = self.obs.span("fleet:submit", tenant=tenant)
         try:
-            view = self.ledger.admit(
-                spec, tenant=tenant,
-                job_id=spec.get("job_id"),
-                priority=int(spec.get("priority", 10)),
-                bucket=self._bucket_hint(spec))
-        except TenantQuotaExceeded as e:
-            self._c_quota.labels(tenant=tenant).inc()
-            self.events.emit("quota-exceeded", tenant=tenant,
-                             quota=e.quota, active=e.active)
+            depth = self.ledger.depth()
+            self._g_depth.set(depth)
+            if depth >= self.cfg.high_water:
+                self._shed(tenant, depth)
+            if self.cfg.require_ready and not self.ready_replicas():
+                raise NoReadyReplica(
+                    "no ready replica registered in %s"
+                    % self.cfg.fleetdir)
+            try:
+                view = self.ledger.admit(
+                    spec, tenant=tenant,
+                    job_id=spec.get("job_id"),
+                    priority=int(spec.get("priority", 10)),
+                    bucket=self._bucket_hint(spec),
+                    trace=self._trace_stamp(span))
+            except TenantQuotaExceeded as e:
+                self._c_quota.labels(tenant=tenant).inc()
+                self.events.emit("quota-exceeded", tenant=tenant,
+                                 quota=e.quota, active=e.active)
+                raise
+        except Exception as e:
+            span.finish("error: %s" % type(e).__name__)
             raise
+        span.set_attr("job", view["job_id"])
+        span.finish()
         self._c_submissions.labels(tenant=tenant).inc()
         self.events.emit("enqueue", job=view["job_id"],
                          tenant=tenant, depth=depth + 1)
@@ -271,29 +349,36 @@ class FleetRouter:
             raise ValueError("spec must be a JSON object")
         from presto_tpu.serve.dag import plan_dag
         tenant = str(spec.get("tenant") or DEFAULT_TENANT)
-        depth = self.ledger.depth()
-        self._g_depth.set(depth)
-        if depth >= self.cfg.high_water:
-            self._c_shed.inc()
-            self.events.emit("shed", tenant=tenant, depth=depth,
-                             high_water=self.cfg.high_water)
-            raise FleetBusy(depth, self.cfg.high_water,
-                            self.cfg.retry_after_s)
-        if self.cfg.require_ready and not self.ready_replicas():
-            raise NoReadyReplica(
-                "no ready replica registered in %s"
-                % self.cfg.fleetdir)
-        nodes = plan_dag(spec)
+        span = self.obs.span("fleet:dag-submit", tenant=tenant)
         try:
-            out = self.ledger.admit_dag(
-                nodes, tenant=tenant,
-                priority=int(spec.get("priority", 10)),
-                dag_id=spec.get("dag_id"))
-        except TenantQuotaExceeded as e:
-            self._c_quota.labels(tenant=tenant).inc()
-            self.events.emit("quota-exceeded", tenant=tenant,
-                             quota=e.quota, active=e.active)
+            depth = self.ledger.depth()
+            self._g_depth.set(depth)
+            if depth >= self.cfg.high_water:
+                self._shed(tenant, depth)
+            if self.cfg.require_ready and not self.ready_replicas():
+                raise NoReadyReplica(
+                    "no ready replica registered in %s"
+                    % self.cfg.fleetdir)
+            nodes = plan_dag(spec)
+            try:
+                # one trace for the whole graph: every node row
+                # carries this span's context, and the sift's fenced
+                # expand re-parents its fan-out under the sift span
+                out = self.ledger.admit_dag(
+                    nodes, tenant=tenant,
+                    priority=int(spec.get("priority", 10)),
+                    dag_id=spec.get("dag_id"),
+                    trace=self._trace_stamp(span))
+            except TenantQuotaExceeded as e:
+                self._c_quota.labels(tenant=tenant).inc()
+                self.events.emit("quota-exceeded", tenant=tenant,
+                                 quota=e.quota, active=e.active)
+                raise
+        except Exception as e:
+            span.finish("error: %s" % type(e).__name__)
             raise
+        span.set_attr("dag", out["dag_id"])
+        span.finish()
         self._c_submissions.labels(tenant=tenant).inc(len(nodes))
         self._c_dags.inc()
         self.events.emit("dag-submit", dag=out["dag_id"],
@@ -373,6 +458,43 @@ class FleetRouter:
             "events": self.events.counts(),
         }
 
+    # ---- fleet-wide metric aggregation --------------------------------
+
+    def _aggregate(self) -> dict:
+        """A fresh snapshot merge (request path; the poll loop keeps
+        `self._agg` warm for Retry-After quoting between requests)."""
+        agg = fleetagg.aggregate(self.cfg.fleetdir)
+        self._agg = agg
+        self._c_agg.inc()
+        return agg
+
+    def fleet_metrics(self) -> dict:
+        """The `GET /fleet/metrics` JSON body: per-replica snapshot
+        freshness, the merged registry (counters summed, gauges
+        per-replica, histogram percentiles over the merged sample
+        windows), and the per-phase `job_e2e_seconds` rollup the
+        control-plane consumers read."""
+        agg = self._aggregate()
+        merged = agg["merged"]
+        return {
+            "fleetdir": self.cfg.fleetdir,
+            "depth": self.ledger.depth(),
+            "jobs": self.ledger.counts(),
+            "replicas": agg["replicas"],
+            "job_e2e": fleetagg.rollup(merged, "job_e2e_seconds",
+                                       "phase"),
+            "latency": fleetagg.rollup(merged, "latency_seconds",
+                                       "name"),
+            "metrics": fleetagg.to_json(merged),
+        }
+
+    def fleet_metrics_prometheus(self) -> str:
+        """Prometheus text exposition of the merged fleet registry
+        (the `Accept: text/plain` / `?format=prometheus` answer of
+        `GET /fleet/metrics`)."""
+        return fleetagg.render_prometheus(
+            self._aggregate()["merged"])
+
 
 # ----------------------------------------------------------------------
 # HTTP front end
@@ -399,6 +521,15 @@ class _RouterHandler(BaseHTTPRequestHandler):
         self.end_headers()
         self.wfile.write(body)
 
+    def _prometheus(self, text: str) -> None:
+        body = text.encode()
+        self.send_response(200)
+        self.send_header("Content-Type",
+                         "text/plain; version=0.0.4")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
     def do_GET(self) -> None:
         url = urlparse(self.path)
         parts = [p for p in url.path.split("/") if p]
@@ -412,18 +543,21 @@ class _RouterHandler(BaseHTTPRequestHandler):
                 accept = self.headers.get("Accept", "") or ""
                 if fmt in ("prometheus", "text") \
                         or "text/plain" in accept:
-                    reg = self.router.obs.metrics
-                    body = reg.render_prometheus().encode()
-                    self.send_response(200)
-                    self.send_header(
-                        "Content-Type",
-                        "text/plain; version=0.0.4")
-                    self.send_header("Content-Length",
-                                     str(len(body)))
-                    self.end_headers()
-                    self.wfile.write(body)
+                    self._prometheus(
+                        self.router.obs.metrics.render_prometheus())
                 else:
                     self._json(200, self.router.metrics())
+            elif url.path == "/fleet/metrics":
+                # fleet-wide aggregation over the replicas' atomic
+                # snapshots: same content negotiation as /metrics
+                fmt = parse_qs(url.query).get("format", [""])[0]
+                accept = self.headers.get("Accept", "") or ""
+                if fmt in ("prometheus", "text") \
+                        or "text/plain" in accept:
+                    self._prometheus(
+                        self.router.fleet_metrics_prometheus())
+                else:
+                    self._json(200, self.router.fleet_metrics())
             elif url.path == "/events":
                 n = int(parse_qs(url.query).get("n", ["100"])[0])
                 self._json(200,

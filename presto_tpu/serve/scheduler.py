@@ -57,6 +57,14 @@ def is_device_error(exc: BaseException) -> bool:
     return any(m in msg for m in _DEVICE_ERROR_MARKERS)
 
 
+def _trace_parent(job: Job):
+    """The job's remote trace context (stamped by the router through
+    the ledger) as an explicit span parent — None for local jobs,
+    which keep the ordinary contextvar parenting."""
+    from presto_tpu.obs.trace import SpanContext
+    return SpanContext.from_dict(getattr(job, "trace", None))
+
+
 @dataclass
 class SchedulerConfig:
     max_batch: int = 8             # coalescing bound per iteration
@@ -283,13 +291,33 @@ class Scheduler:
                              bucket=repr(batch[0].bucket))
         if (self.batch_executor is not None and len(batch) > 1
                 and all(j.run is None for j in batch)):
+            # traced fleet jobs keep per-job spans even through the
+            # stacked path (non-current siblings: they must not nest
+            # into each other), so a stacked DAG fold still lands in
+            # its DAG's cross-process trace
+            spans = []
+            if self.obs.enabled:
+                for job in batch:
+                    parent = _trace_parent(job)
+                    if parent is None:
+                        continue
+                    sp = self.obs.tracer.span(
+                        "serve-job", parent=parent, current=False,
+                        job=job.job_id, stacked=True,
+                        bucket=repr(job.bucket))
+                    job.span_ctx = sp.context().to_dict()
+                    spans.append(sp)
             try:
                 results = self._with_timeout(
                     lambda: self.batch_executor(batch))
+                for sp in spans:
+                    sp.finish()
                 for job, result in zip(batch, results):
                     self._finish_ok(job, result)
                 return
             except Exception as e:
+                for sp in spans:
+                    sp.finish("error: %s" % type(e).__name__)
                 # graceful degradation: the batch path failing means
                 # each job gets an individual shot (and its own
                 # retry/backoff budget), not a collective failure.
@@ -316,9 +344,17 @@ class Scheduler:
         if self.events is not None:
             self.events.emit("execute", job=job.job_id,
                              attempt=job.attempts)
-        span = self.obs.span("serve-job", job=job.job_id,
+        # a fleet job resumes the trace the router started at /submit
+        # (explicit SpanContext across the process hop); survey/DAG
+        # spans opened during execution nest under this via the
+        # ordinary contextvar propagation
+        span = self.obs.span("serve-job", parent=_trace_parent(job),
+                             job=job.job_id,
                              attempt=job.attempts,
                              bucket=repr(job.bucket))
+        ctx = span.context()
+        if ctx is not None:
+            job.span_ctx = ctx.to_dict()
         t0 = time.time()
         try:
             if self.cfg.fault_injector is not None:

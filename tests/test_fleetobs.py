@@ -1,0 +1,600 @@
+"""Fleet-wide observability (ISSUE 12): mergeable metric snapshots
+(obs/fleetagg.py) with property-tested histogram merging, distributed
+trace-context propagation admit -> ledger JSON -> lease -> child
+expand -> cross-process span streams, the job_e2e_seconds
+decomposition, the router's fleet aggregation + drain-estimate
+Retry-After, the replica kill() flight-recorder dump, and the fleet
+report / trace-merge tooling."""
+
+import json
+import os
+import random
+import time
+
+import pytest
+
+from presto_tpu.obs import Observability, ObsConfig, fleetagg
+from presto_tpu.obs.metrics import MetricsRegistry
+from presto_tpu.obs.trace import SpanContext
+from presto_tpu.serve.fleet import FleetConfig, FleetReplica
+from presto_tpu.serve.jobledger import JobLedger
+from presto_tpu.serve.server import SearchService
+
+
+def _obs(**kw):
+    kw.setdefault("enabled", True)
+    return Observability(ObsConfig(**kw))
+
+
+def _wait(cond, timeout=20.0, poll=0.02):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if cond():
+            return True
+        time.sleep(poll)
+    return False
+
+
+# ----------------------------------------------------------------------
+# mergeable export + histogram merge properties
+# ----------------------------------------------------------------------
+
+def test_export_state_carries_buckets_and_samples():
+    reg = MetricsRegistry()
+    h = reg.histogram("job_e2e_seconds", "e2e", ("phase",),
+                      buckets=(0.1, 1.0))
+    for v in (0.05, 0.5, 2.0):
+        h.labels(phase="total").observe(v)
+    reg.counter("fleet_jobs_committed_total", "c").inc(3)
+    state = reg.export_state()
+    fam = state["families"]["job_e2e_seconds"]
+    assert fam["kind"] == "histogram"
+    assert fam["buckets"] == [0.1, 1.0, None]      # inf JSON-safe
+    (series,) = fam["series"]
+    assert series["count"] == 3
+    assert series["bucket_counts"] == [1, 1, 1]
+    assert sorted(series["samples"]) == [0.05, 0.5, 2.0]
+    # strict-JSON round trip (no Infinity literals)
+    parsed = json.loads(json.dumps(state, allow_nan=False))
+    assert parsed["families"]["job_e2e_seconds"]["buckets"][-1] \
+        is None
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2, 3, 4])
+def test_histogram_merge_equals_single_registry_reference(seed):
+    """Property: for ANY split of a sample stream over N replica
+    registries, the merged fleet histogram's nearest-rank
+    percentiles, counts, and bucket counts equal a single shared
+    registry's."""
+    rng = random.Random(seed)
+    n_shards = rng.randint(1, 5)
+    samples = [rng.uniform(0.0005, 400.0)
+               for _ in range(rng.randint(1, 300))]
+    ref = MetricsRegistry()
+    href = ref.histogram("latency_seconds", "lat", ("name",))
+    shards = [MetricsRegistry() for _ in range(n_shards)]
+    for s in samples:
+        href.labels(name="job_total").observe(s)
+        shard = shards[rng.randrange(n_shards)]
+        shard.histogram("latency_seconds", "lat",
+                        ("name",)).labels(
+                            name="job_total").observe(s)
+    merged = fleetagg.merge_states(
+        {"rep%d" % i: r.export_state()
+         for i, r in enumerate(shards)})
+    (series,) = merged["latency_seconds"]["series"].values()
+    assert series["count"] == len(samples)
+    assert fleetagg.percentiles(series["samples"]) == \
+        href.labels(name="job_total").percentiles()
+    ref_buckets = [c for _ub, c in
+                   href.labels(name="job_total")
+                   .cumulative_buckets()]
+    acc, got = 0, []
+    for c in series["bucket_counts"]:
+        acc += c
+        got.append(acc)
+    assert got == ref_buckets
+
+
+@pytest.mark.parametrize("seed", [7, 8, 9])
+def test_merge_is_commutative_and_associative(seed):
+    rng = random.Random(seed)
+    regs = []
+    for i in range(3):
+        reg = MetricsRegistry()
+        for _ in range(rng.randint(1, 50)):
+            reg.histogram("job_e2e_seconds", "e2e",
+                          ("phase",)).labels(
+                phase=rng.choice(("total", "execute"))).observe(
+                rng.random())
+        reg.counter("fleet_jobs_leased_total", "c").inc(
+            rng.randint(0, 9))
+        reg.gauge("fleet_inflight", "g").set(rng.randint(0, 5))
+        regs.append(reg)
+    a, b, c = (fleetagg.canonicalize("rep%d" % i,
+                                     r.export_state())
+               for i, r in enumerate(regs))
+
+    def _comparable(m):
+        """Float sums are only associative to rounding — compare
+        them rounded, everything else exactly."""
+        out = json.loads(json.dumps(
+            {n: {k: (sorted(map(repr, f["series"])) if k == "series"
+                     else f[k]) for k in f} for n, f in m.items()}))
+        for n, fam in m.items():
+            for key, s in fam["series"].items():
+                if "sum" in s:
+                    s = dict(s, sum=round(s["sum"], 9))
+                out.setdefault("_series", []).append(
+                    (n, repr(key), json.dumps(s, sort_keys=True)))
+        out["_series"].sort()
+        return out
+
+    ab_c = fleetagg.merge(fleetagg.merge(a, b), c)
+    a_bc = fleetagg.merge(a, fleetagg.merge(b, c))
+    cba = fleetagg.merge(c, fleetagg.merge(b, a))
+    assert _comparable(ab_c) == _comparable(a_bc) == _comparable(cba)
+
+
+def test_merge_counters_sum_and_gauges_labeled_per_replica():
+    ra, rb = MetricsRegistry(), MetricsRegistry()
+    ra.counter("fleet_jobs_committed_total", "c").inc(2)
+    rb.counter("fleet_jobs_committed_total", "c").inc(5)
+    ra.gauge("fleet_inflight", "g").set(1)
+    rb.gauge("fleet_inflight", "g").set(4)
+    merged = fleetagg.merge_states({"a": ra.export_state(),
+                                    "b": rb.export_state()})
+    doc = fleetagg.to_json(merged)
+    assert doc["fleet_jobs_committed_total"]["series"][0]["value"] \
+        == 7
+    gs = {s["labels"]["replica"]: s["value"]
+          for s in doc["fleet_inflight"]["series"]}
+    assert gs == {"a": 1.0, "b": 4.0}
+    txt = fleetagg.render_prometheus(merged)
+    assert "fleet_jobs_committed_total 7" in txt
+    assert 'fleet_inflight{replica="a"} 1' in txt
+
+
+def test_publish_load_and_tombstone_snapshots(tmp_path):
+    fleetdir = str(tmp_path)
+    oa, ob = _obs(service="rep-a"), _obs(service="rep-b")
+    oa.metrics.counter("fleet_jobs_committed_total", "c").inc(3)
+    oa.metrics.gauge("fleet_inflight", "g").set(2)
+    ob.metrics.counter("fleet_jobs_committed_total", "c").inc(4)
+    ob.metrics.gauge("fleet_inflight", "g").set(1)
+    fleetagg.publish_snapshot(fleetdir, "rep-a", oa)
+    fleetagg.publish_snapshot(fleetdir, "rep-b", ob,
+                              tombstone=True)
+    snaps = fleetagg.load_snapshots(fleetdir)
+    assert set(snaps) == {"rep-a", "rep-b"}
+    assert snaps["rep-b"]["tombstone"] is True
+    agg = fleetagg.aggregate(fleetdir)
+    doc = fleetagg.to_json(agg["merged"])
+    # counters survive the tombstone (that work happened)...
+    assert doc["fleet_jobs_committed_total"]["series"][0]["value"] \
+        == 7
+    # ...but the dead replica's point-in-time gauges do not
+    assert [s["labels"]["replica"]
+            for s in doc["fleet_inflight"]["series"]] == ["rep-a"]
+    # a torn snapshot degrades to absent, never to a failed scrape
+    with open(fleetagg.snapshot_path(fleetdir, "rep-c"), "w") as f:
+        f.write('{"version": 1, "metr')
+    assert set(fleetagg.load_snapshots(fleetdir)) \
+        == {"rep-a", "rep-b"}
+
+
+# ----------------------------------------------------------------------
+# trace-context propagation
+# ----------------------------------------------------------------------
+
+def test_span_context_wire_roundtrip():
+    ctx = SpanContext("t" * 32, "s" * 16)
+    assert SpanContext.from_dict(ctx.to_dict()).trace_id == ctx.trace_id
+    assert SpanContext.from_dict(None) is None
+    assert SpanContext.from_dict({}) is None
+    assert SpanContext.from_dict({"span_id": "x"}) is None
+
+
+def test_trace_survives_admit_ledger_lease_and_child_expand(tmp_path):
+    """The tentpole round trip: a trace stamped at admission rides
+    the ledger JSON to the lease, and a fenced expand's children
+    carry their (re-parented) trace — so folds join the DAG's
+    trace with the sift as parent."""
+    led = JobLedger(str(tmp_path))
+    led.join("r1")
+    trace = {"trace_id": "a" * 32, "span_id": "b" * 16}
+    led.admit({"rawfiles": ["x.fil"]}, trace=trace)
+    lease = led.lease("r1", ttl=30.0)
+    assert lease.data["trace"] == trace
+    assert lease.data["leased_at"] > 0
+    # the sift's own span context becomes the children's parent
+    sift_ctx = {"trace_id": "a" * 32, "span_id": "c" * 16}
+    staged = str(tmp_path / "stage")
+    with open(staged, "w") as f:
+        f.write("{}")
+    final = str(tmp_path / "jobs" / lease.item_id / "result.json")
+    os.makedirs(os.path.dirname(final), exist_ok=True)
+    led.complete_and_expand(
+        lease, "r1", {final: staged},
+        children=[("child-1", {"spec": {"kind": "fold"},
+                               "tenant": "default", "priority": 10,
+                               "bucket": None,
+                               "blocked_on": [lease.item_id],
+                               "dag": "d1", "trace": sift_ctx})])
+    child_lease = led.lease("r1", ttl=30.0)
+    assert child_lease.item_id == "child-1"
+    assert child_lease.data["trace"] == sift_ctx
+    assert child_lease.data["trace"]["trace_id"] == trace["trace_id"]
+
+
+def test_scheduler_resumes_remote_context():
+    """The replica-side half: a leased job's serve-job span is
+    parented to the router's stamped context, survey spans nest
+    under it, and job.span_ctx records this attempt's identity."""
+    from presto_tpu.serve.queue import Job, JobQueue
+    from presto_tpu.serve.scheduler import Scheduler
+    obs = _obs()
+    seen = {}
+
+    def executor(job):
+        cur = obs.tracer.current()
+        seen["trace_id"] = cur.trace_id
+        seen["parent_id"] = cur.parent_id
+        with obs.span("stage:sift", stage="sift") as st:
+            seen["stage_trace"] = st.trace_id
+        return {"ok": True}
+
+    sched = Scheduler(JobQueue(), executor, obs=obs)
+    job = Job(job_id="j1", rawfiles=[], cfg=None, workdir=".",
+              trace={"trace_id": "f" * 32, "span_id": "0" * 16})
+    job.submitted = time.time()
+    sched._run_single(job)
+    assert job.status == "done"
+    assert seen["trace_id"] == "f" * 32
+    assert seen["parent_id"] == "0" * 16
+    assert seen["stage_trace"] == "f" * 32
+    assert job.span_ctx["trace_id"] == "f" * 32
+    # an untraced local job keeps a fresh root trace
+    job2 = Job(job_id="j2", rawfiles=[], cfg=None, workdir=".")
+    job2.submitted = time.time()
+    sched._run_single(job2)
+    assert job2.span_ctx["trace_id"] != "f" * 32
+
+
+# ----------------------------------------------------------------------
+# stub fleet: streams, e2e phases, kill dump
+# ----------------------------------------------------------------------
+
+class StubService(SearchService):
+    def build_job(self, spec, job_id=None, workdir=None):
+        from presto_tpu.serve.queue import Job
+        job_id = str(job_id or "stub-%06d" % next(self._ids))
+        return Job(job_id=job_id, rawfiles=[], cfg=None,
+                   workdir=workdir or os.path.join(self.workroot,
+                                                   job_id),
+                   bucket=spec.get("bucket") or "stub-bucket",
+                   spec=dict(spec))
+
+    def _execute_job(self, job):
+        os.makedirs(job.workdir, exist_ok=True)
+        with open(os.path.join(job.workdir, "stub.dat"), "wb") as f:
+            f.write(b"\x01" * 64)
+        return {"ok": True}
+
+
+def _stub_fleet(tmp_path, name, fleetdir, **fkw):
+    svc = StubService(str(tmp_path / ("w-" + name)),
+                      queue_depth=8).start()
+    cfg = FleetConfig(fleetdir=str(fleetdir), replica=name,
+                      lease_ttl=20.0, heartbeat_s=0.05,
+                      heartbeat_timeout=0.6, poll_s=0.05,
+                      max_inflight=1, prewarm=False,
+                      snapshot_s=0.05)
+    for k, v in fkw.items():
+        setattr(cfg, k, v)
+    return svc, FleetReplica(svc, cfg)
+
+
+def test_stub_fleet_trace_stream_and_e2e_phases(tmp_path):
+    """e2e through a real (stub) replica: the ledger-stamped trace
+    lands in the replica's span stream under <fleet>/obs/, and the
+    commit decomposes into all four job_e2e_seconds phases."""
+    fleetdir = tmp_path / "fleet"
+    led = JobLedger(str(fleetdir))
+    trace = {"trace_id": "e" * 32, "span_id": "1" * 16}
+    view = led.admit({"rawfiles": ["x.fil"], "seed": 1},
+                     bucket="bkt", trace=trace)
+    svc, rep = _stub_fleet(tmp_path, "r1", fleetdir)
+    rep.start()
+    try:
+        assert _wait(lambda: (led.view(view["job_id"]) or
+                              {}).get("state") == "done")
+        reg = svc.obs.metrics
+        h = reg.get("job_e2e_seconds")
+        assert h is not None
+        for phase in ("lease_wait", "execute", "commit", "total"):
+            assert h.labels(phase=phase, bucket="bkt").count == 1, \
+                "missing phase %s" % phase
+        assert reg.get("fleet_obs_snapshots_total").value >= 1
+    finally:
+        rep.stop()
+        svc.stop()
+    # the replica's span stream carries the resumed trace
+    stream = fleetagg.span_stream_path(str(fleetdir), "r1")
+    assert os.path.exists(stream)
+    spans = fleetagg.load_spans([stream])
+    job_spans = [s for s in spans if s["name"] == "serve-job"]
+    assert job_spans and all(s["trace_id"] == "e" * 32
+                             for s in job_spans)
+    assert job_spans[0]["parent_id"] == "1" * 16
+    # and a snapshot was published (readable, not tombstoned)
+    snaps = fleetagg.load_snapshots(str(fleetdir))
+    assert "r1" in snaps and not snaps["r1"]["tombstone"]
+
+
+def test_drain_publishes_tombstone_snapshot(tmp_path):
+    fleetdir = tmp_path / "fleet"
+    svc, rep = _stub_fleet(tmp_path, "r1", fleetdir)
+    rep.start()
+    assert _wait(lambda: "r1" in fleetagg.load_snapshots(
+        str(fleetdir)))
+    rep.drain(timeout=5.0)
+    svc.stop()
+    snaps = fleetagg.load_snapshots(str(fleetdir))
+    assert snaps["r1"]["tombstone"] is True
+
+
+def test_replica_kill_dumps_flight_recorder(tmp_path):
+    """Satellite: kill() (the chaos seam) leaves a flightrec dump
+    exactly like real survey deaths, with the kill point recorded
+    BEFORE the kill fired — incl. the batch-leased point, fired
+    while the victim holds a whole leased batch."""
+    from presto_tpu.obs.flightrec import find_dumps
+    fleetdir = tmp_path / "fleet"
+    led = JobLedger(str(fleetdir))
+    for i in range(2):
+        led.admit({"rawfiles": ["x.fil"], "seed": i}, bucket="bkt")
+    svc, rep = _stub_fleet(tmp_path, "victim", fleetdir,
+                           max_inflight=2, lease_batch=2)
+    rep.kill_on = "batch-leased"
+    rep.start()
+    try:
+        assert _wait(lambda: rep._killed, timeout=10.0)
+    finally:
+        rep.stop()
+        svc.stop()
+    dumps = find_dumps(fleetagg.replica_dump_dir(str(fleetdir),
+                                                 "victim"))
+    assert len(dumps) == 1
+    d = json.load(open(dumps[0]))
+    assert d["reason"] == "replica-killed"
+    points = [r for r in d["records"]
+              if r["kind"] == "fleet-chaos-point"]
+    assert points and points[-1]["point"] == "batch-leased"
+    # the leases are NOT released: the reaper must recover them,
+    # exactly like a SIGKILL
+    assert led.counts()["leased"] == 2
+
+
+# ----------------------------------------------------------------------
+# router: aggregation endpoint + Retry-After estimate
+# ----------------------------------------------------------------------
+
+def _router(tmp_path, **kw):
+    from presto_tpu.serve.router import FleetRouter, RouterConfig
+    kw.setdefault("fleetdir", str(tmp_path / "fleet"))
+    kw.setdefault("require_ready", False)
+    kw.setdefault("retry_after_s", 2.0)
+    return FleetRouter(RouterConfig(**kw))
+
+
+def _fake_snapshot(fleetdir, name, execute_s, n=5, committed=1):
+    obs = _obs(service=name)
+    h = obs.metrics.histogram("job_e2e_seconds", "e2e",
+                              ("phase", "bucket"))
+    for _ in range(n):
+        h.labels(phase="execute", bucket="b").observe(execute_s)
+        h.labels(phase="total", bucket="b").observe(execute_s * 1.5)
+    obs.metrics.counter("fleet_jobs_committed_total",
+                        "c").inc(committed)
+    fleetagg.publish_snapshot(fleetdir, name, obs)
+
+
+def test_router_retry_after_from_e2e_estimate(tmp_path):
+    from presto_tpu.serve.router import FleetBusy
+    router = _router(tmp_path, high_water=1)
+    fleetdir = router.cfg.fleetdir
+    # no snapshots: the constant fallback answers, source recorded
+    router.submit({"rawfiles": ["x.fil"]})
+    with pytest.raises(FleetBusy) as ei:
+        router.submit({"rawfiles": ["y.fil"]})
+    assert ei.value.retry_after_s == 2.0
+    shed = [e for e in router.events.tail(50)
+            if e["kind"] == "shed"]
+    assert shed[-1]["retry_after_source"] == "constant"
+    assert shed[-1]["retry_after_s"] == 2.0
+    # with snapshots: quoted from the drain estimate (depth x mean
+    # execute / ready replicas), never below the constant
+    _fake_snapshot(fleetdir, "rep0", execute_s=30.0)
+    router.poll_replicas()
+    with pytest.raises(FleetBusy) as ei:
+        router.submit({"rawfiles": ["y.fil"]})
+    assert ei.value.retry_after_s == pytest.approx(30.0)
+    shed = [e for e in router.events.tail(50)
+            if e["kind"] == "shed"]
+    assert shed[-1]["retry_after_source"] == "e2e-estimate"
+    assert shed[-1]["retry_after_s"] == pytest.approx(30.0)
+    router.stop()
+
+
+def test_router_fleet_metrics_endpoint(tmp_path):
+    import urllib.request
+    from presto_tpu.serve.router import start_http
+    router = _router(tmp_path)
+    fleetdir = router.cfg.fleetdir
+    _fake_snapshot(fleetdir, "rep0", execute_s=1.0, committed=2)
+    _fake_snapshot(fleetdir, "rep1", execute_s=3.0, committed=3)
+    httpd = start_http(router)
+    url = "http://%s:%d" % httpd.server_address[:2]
+    try:
+        with urllib.request.urlopen(url + "/fleet/metrics",
+                                    timeout=10) as r:
+            doc = json.loads(r.read())
+        assert set(doc["replicas"]) == {"rep0", "rep1"}
+        assert doc["job_e2e"]["execute"]["count"] == 10
+        assert doc["job_e2e"]["execute"]["p99"] == 3.0
+        committed = doc["metrics"][
+            "fleet_jobs_committed_total"]["series"][0]["value"]
+        assert committed == 5
+        with urllib.request.urlopen(
+                url + "/fleet/metrics?format=prometheus",
+                timeout=10) as r:
+            text = r.read().decode()
+        assert "job_e2e_seconds_bucket" in text
+        assert "fleet_jobs_committed_total 5" in text
+        assert router.obs.metrics.get(
+            "fleet_obs_aggregations_total").value >= 2
+    finally:
+        httpd.shutdown()
+        router.stop()
+
+
+def test_router_stamps_trace_on_admitted_rows(tmp_path):
+    router = _router(tmp_path)
+    view = router.submit({"rawfiles": ["x.fil"]})
+    row = router.ledger.read()["jobs"][view["job_id"]]
+    assert row["trace"]["trace_id"]
+    # the admission root landed in the router's span stream
+    spans = fleetagg.load_fleet_spans(router.cfg.fleetdir)
+    roots = [s for s in spans if s["name"] == "fleet:submit"]
+    assert roots and roots[0]["trace_id"] \
+        == row["trace"]["trace_id"]
+    assert roots[0]["span_id"] == row["trace"]["span_id"]
+    router.stop()
+
+
+# ----------------------------------------------------------------------
+# trace joining + critical path + fleet report
+# ----------------------------------------------------------------------
+
+def _span(trace, sid, parent, name, start, dur, pid, **attrs):
+    return {"trace_id": trace, "span_id": sid, "parent_id": parent,
+            "name": name, "start": start, "end": start + dur,
+            "duration_s": dur, "status": "ok", "thread": "t",
+            "pid": pid, "attrs": attrs}
+
+
+def test_orphans_and_merged_chrome_trace(tmp_path):
+    t = "t" * 32
+    spans = [
+        _span(t, "s1", None, "fleet:dag-submit", 0.0, 0.1, 100),
+        _span(t, "s2", "s1", "serve-job", 0.2, 1.0, 200, job="a"),
+        _span(t, "s3", "s2", "stage:sift", 0.3, 0.5, 200),
+    ]
+    assert fleetagg.orphan_spans(spans) == []
+    doc = fleetagg.merged_chrome_trace(spans)
+    xs = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+    assert {e["pid"] for e in xs} == {100, 200}
+    # dropping the cross-process parent orphans the subtree root
+    orphans = fleetagg.orphan_spans(spans[1:])
+    assert [s["span_id"] for s in orphans] == ["s2"]
+    # tools/trace_merge.py exit status doubles as the check
+    import tools.trace_merge as tm
+    p1 = tmp_path / "a.spans.jsonl"
+    p1.write_text("".join(json.dumps(s) + "\n" for s in spans))
+    out = tmp_path / "merged.json"
+    assert tm.main([str(p1), "-o", str(out)]) == 0
+    merged = json.loads(out.read_text())
+    assert {e["pid"] for e in merged["traceEvents"]
+            if e["ph"] == "X"} == {100, 200}
+    p2 = tmp_path / "b.spans.jsonl"
+    p2.write_text("".join(json.dumps(s) + "\n"
+                          for s in spans[1:]))
+    assert tm.main([str(p2)]) == 1          # orphan -> exit 1
+
+
+def test_dag_critical_path_attribution():
+    jobs = {
+        "d-search": {"dag": "d", "state": "done", "blocked_on": [],
+                     "spec": {}, "submitted": 0.0, "leased_at": 1.0,
+                     "completed_at": 11.0},
+        "d-sift": {"dag": "d", "state": "done",
+                   "blocked_on": ["d-search"],
+                   "spec": {"kind": "sift"}, "submitted": 0.0,
+                   "leased_at": 12.0, "completed_at": 13.0},
+        "d-fold-1": {"dag": "d", "state": "done",
+                     "blocked_on": ["d-sift"],
+                     "spec": {"kind": "fold"}, "submitted": 13.0,
+                     "leased_at": 14.0, "completed_at": 15.0},
+        "d-fold-2": {"dag": "d", "state": "done",
+                     "blocked_on": ["d-sift"],
+                     "spec": {"kind": "fold"}, "submitted": 13.0,
+                     "leased_at": 13.5, "completed_at": 19.0},
+        "d-toa": {"dag": "d", "state": "done",
+                  "blocked_on": ["d-fold-1", "d-fold-2"],
+                  "spec": {"kind": "toa"}, "submitted": 0.0,
+                  "leased_at": 19.5, "completed_at": 20.0},
+        "other": {"dag": "x", "state": "done", "blocked_on": [],
+                  "spec": {}, "submitted": 0.0,
+                  "completed_at": 99.0},
+    }
+    cp = fleetagg.dag_critical_path(jobs, "d")
+    assert cp["n_nodes"] == 5 and cp["n_done"] == 5
+    assert cp["e2e_s"] == 20.0
+    # the slow fold (fold-2) gates the path, not fold-1
+    assert [n["job_id"] for n in cp["critical_path"]] == \
+        ["d-search", "d-sift", "d-fold-2", "d-toa"]
+    search = cp["critical_path"][0]
+    assert search["wait_s"] == 1.0 and search["run_s"] == 10.0
+    fold2 = cp["critical_path"][2]
+    assert fold2["wait_s"] == 0.5 and fold2["run_s"] == 5.5
+    assert cp["wait_share"] == pytest.approx(
+        (1.0 + 1.0 + 0.5 + 0.5) / 20.0)
+
+
+def test_fleet_report_renders_everything(tmp_path, capsys):
+    """presto-report -fleet merges ledger + snapshots + spans +
+    dead-replica dumps + DAG critical path into one report."""
+    from presto_tpu.apps.report import main as report_main
+    fleetdir = tmp_path / "fleet"
+    led = JobLedger(str(fleetdir))
+    trace = {"trace_id": "d" * 32, "span_id": "2" * 16}
+    led.admit({"rawfiles": ["x.fil"], "seed": 0},
+              bucket="bkt", trace=trace)
+    # the admission root a router would have streamed
+    os.makedirs(fleetagg.obs_dir(str(fleetdir)), exist_ok=True)
+    with open(fleetagg.span_stream_path(str(fleetdir),
+                                        "router-1"), "w") as f:
+        f.write(json.dumps(_span("d" * 32, "2" * 16, None,
+                                 "fleet:submit", time.time(), 0.01,
+                                 999)) + "\n")
+    svc, rep = _stub_fleet(tmp_path, "r1", fleetdir)
+    rep.start()
+    assert _wait(lambda: led.counts()["done"] == 1)
+    rep.drain(timeout=5.0)
+    svc.stop()
+    # a second replica died a chaos death: its dump must be picked
+    # up via the ledger host table
+    led2 = JobLedger(str(fleetdir))
+    led2.admit({"rawfiles": ["y.fil"], "seed": 1}, bucket="bkt")
+    svc2, rep2 = _stub_fleet(tmp_path, "r2", fleetdir)
+    rep2.kill_on = "job-leased"
+    rep2.start()
+    assert _wait(lambda: rep2._killed, timeout=10.0)
+    rep2.stop()
+    svc2.stop()
+    trace_out = str(tmp_path / "merged.perfetto.json")
+    assert report_main(["-fleet", str(fleetdir),
+                        "-trace-out", trace_out]) == 0
+    out = capsys.readouterr().out
+    assert "Ledger:" in out and "replica r1" in out
+    assert "job_e2e_seconds" in out
+    assert "Flight recorder (r2" in out
+    assert "last kill point: job-leased" in out
+    assert os.path.exists(trace_out)
+    # JSON mode round-trips with the e2e rollup present
+    assert report_main(["-fleet", str(fleetdir), "-json"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["job_e2e"]["total"]["count"] >= 1
+    assert doc["traces"]["orphan_spans"] == 0
+    assert doc["flightrec"][0]["replica"] == "r2"

@@ -173,6 +173,21 @@ def run_trial(trial: int, rng: random.Random, beam: str, ref: dict,
         rec["checks"]["zombie_commit_fenced"] = fence_ok
         rec["stale_rejected"] = int(victim_svc.obs.metrics.get(
             "fleet_stale_results_total").value)
+        # the kill left a post-mortem the fleet report can pick up:
+        # a flightrec dump under <fleet>/obs/<victim>/ whose last
+        # chaos record names the fired kill point (recorded BEFORE
+        # the kill — the survey chaos guarantee on the fleet seams)
+        from presto_tpu.obs import fleetagg
+        from presto_tpu.obs.flightrec import find_dumps
+        dumps = find_dumps(fleetagg.replica_dump_dir(
+            fleetdir, victim.replica))
+        rec["checks"]["flightrec_dump"] = bool(dumps)
+        if dumps and kill_point != "timed":
+            d = json.load(open(dumps[-1]))
+            points = [r for r in d.get("records", [])
+                      if r.get("kind") == "fleet-chaos-point"]
+            rec["checks"]["dump_names_kill_point"] = bool(
+                points and points[-1].get("point") == kill_point)
         rec["ok"] = all(rec["checks"].values())
     finally:
         for svc, rep in members:
@@ -333,6 +348,19 @@ def run_dag_trial(trial: int, rng: random.Random, beam: str,
         except (OSError, ValueError, KeyError):
             equal = False
         rec["checks"]["byte_equal_reference"] = equal
+        # the DAG-aware kill left a fleet-report-visible post-mortem
+        # naming the fired point (fold-fanout and friends)
+        from presto_tpu.obs import fleetagg
+        from presto_tpu.obs.flightrec import find_dumps
+        dumps = find_dumps(fleetagg.replica_dump_dir(
+            fleetdir, victim.replica))
+        rec["checks"]["flightrec_dump"] = bool(dumps)
+        if dumps and kill_point != "timed":
+            d = json.load(open(dumps[-1]))
+            points = [r for r in d.get("records", [])
+                      if r.get("kind") == "fleet-chaos-point"]
+            rec["checks"]["dump_names_kill_point"] = bool(
+                points and points[-1].get("point") == kill_point)
         rec["ok"] = all(rec["checks"].values())
     finally:
         for svc, rep in members:

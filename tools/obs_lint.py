@@ -73,7 +73,16 @@ code path cannot ship silently:
      directions (and as subsets of their parent catalogs) — the
      dependency-aware job graph's fenced fan-out and cascade-failure
      paths run exactly while a mid-graph replica is dying, so their
-     telemetry may neither go dark nor go stale.
+     telemetry may neither go dark nor go stale;
+  13. fleet-wide observability (serve/fleet.py + serve/router.py +
+     obs/fleetagg.py): FLEET_SPANS (the router's `fleet:` admission
+     roots whose SpanContext is stamped through the ledger),
+     FLEET_OBS_EVENTS (the snapshot-publication and recorded-before-
+     fire chaos kinds), and FLEET_OBS_METRICS (`fleet_obs_*` plus
+     `job_e2e_seconds`) pinned BOTH directions and as subsets of
+     their parent catalogs — cross-process trace propagation and the
+     snapshot protocol are exactly what a fleet post-mortem reads,
+     so they may neither go dark nor go stale.
 
 Run directly (exit 1 lists violations) or via tests/test_obs_lint.py.
 """
@@ -494,6 +503,72 @@ def lint() -> List[str]:
         problems.append(
             "dag layer: metric %r is not registered in "
             "obs/taxonomy.DAG_METRICS" % m)
+
+    # 13. fleet-wide observability (serve/fleet.py + serve/router.py
+    # + obs/fleetagg.py): the `fleet:` span prefix, the snapshot/
+    # chaos event kinds, and the fleet_obs_*/job_e2e_seconds metrics
+    # pinned BOTH directions + subset-of-parent — cross-process trace
+    # propagation and the snapshot protocol are the post-mortem's
+    # input, so they may neither go dark nor go stale.
+    fo_files = ("presto_tpu/serve/fleet.py",
+                "presto_tpu/serve/router.py",
+                "presto_tpu/obs/fleetagg.py")
+    fo_events: Set[str] = set()
+    fo_spans: Set[str] = set()
+    fo_metrics: Set[str] = set()
+    for rel in fo_files:
+        try:
+            src = _read(rel)
+        except OSError:
+            continue
+        fo_events |= set(EMIT_RE.findall(src))
+        fo_events |= set(CLUSTER_EVENT_RE.findall(src))
+        fo_spans |= set(SPAN_RE.findall(src))
+        fo_metrics |= set(METRIC_RE.findall(src))
+    for s in sorted(taxonomy.FLEET_SPANS - taxonomy.SERVE_SPANS):
+        problems.append(
+            "obs/taxonomy.py: FLEET_SPANS lists %r which is not in "
+            "SERVE_SPANS" % s)
+    for s in sorted(taxonomy.FLEET_SPANS - fo_spans):
+        problems.append(
+            "obs/taxonomy.py: FLEET_SPANS lists %r but the fleet "
+            "obs layer never opens it" % s)
+    for s in sorted({x for x in fo_spans if x.startswith("fleet:")}
+                    - taxonomy.FLEET_SPANS):
+        problems.append(
+            "fleet obs layer: span %r is not registered in "
+            "obs/taxonomy.FLEET_SPANS" % s)
+    for k in sorted(taxonomy.FLEET_OBS_EVENTS
+                    - taxonomy.FLEET_EVENTS):
+        problems.append(
+            "obs/taxonomy.py: FLEET_OBS_EVENTS lists %r which is "
+            "not in FLEET_EVENTS" % k)
+    for k in sorted(taxonomy.FLEET_OBS_EVENTS - fo_events):
+        problems.append(
+            "obs/taxonomy.py: FLEET_OBS_EVENTS lists %r but the "
+            "fleet obs layer never emits it" % k)
+    for k in sorted({x for x in fo_events
+                     if x.startswith("fleet-obs-")
+                     or x == "fleet-chaos-point"}
+                    - taxonomy.FLEET_OBS_EVENTS):
+        problems.append(
+            "fleet obs layer: event kind %r is not registered in "
+            "obs/taxonomy.FLEET_OBS_EVENTS" % k)
+    for m in sorted(taxonomy.FLEET_OBS_METRICS - taxonomy.METRICS):
+        problems.append(
+            "obs/taxonomy.py: FLEET_OBS_METRICS lists %r which is "
+            "not in METRICS" % m)
+    for m in sorted(taxonomy.FLEET_OBS_METRICS - fo_metrics):
+        problems.append(
+            "obs/taxonomy.py: FLEET_OBS_METRICS lists %r but the "
+            "fleet obs layer never registers it" % m)
+    for m in sorted({x for x in fo_metrics
+                     if x.startswith("fleet_obs_")
+                     or x == "job_e2e_seconds"}
+                    - taxonomy.FLEET_OBS_METRICS):
+        problems.append(
+            "fleet obs layer: metric %r is not registered in "
+            "obs/taxonomy.FLEET_OBS_METRICS" % m)
     return problems
 
 
