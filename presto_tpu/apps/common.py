@@ -9,12 +9,11 @@ from __future__ import annotations
 
 import argparse
 import os
-import sys
 from typing import Optional, Tuple
 
 import numpy as np
 
-from presto_tpu.io.infodata import InfoData, read_inf, ARTIFICIAL_TELESCOPE
+from presto_tpu.io.infodata import InfoData, read_inf
 from presto_tpu.io.sigproc import FilterbankFile
 from presto_tpu.io import datfft
 

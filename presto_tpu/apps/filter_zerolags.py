@@ -11,7 +11,6 @@ the detrended zero-lags feed clipping/RFI excision.
 from __future__ import annotations
 
 import argparse
-import os
 
 import numpy as np
 

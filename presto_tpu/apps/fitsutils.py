@@ -12,7 +12,6 @@ removed and the header cards fixed up.
 from __future__ import annotations
 
 import argparse
-import os
 import sys
 
 import numpy as np

@@ -14,7 +14,6 @@ Usage parity: plotbincand <base> <candnum> [lofreq] [numsumpow]
 from __future__ import annotations
 
 import argparse
-import os
 import sys
 
 import numpy as np

@@ -17,7 +17,6 @@ Pipeline (reference read_psrdata, backend_common.c:505-604):
 from __future__ import annotations
 
 import argparse
-import sys
 
 import numpy as np
 import jax.numpy as jnp

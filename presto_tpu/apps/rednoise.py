@@ -10,7 +10,6 @@ import os
 import shutil
 import sys
 
-import numpy as np
 
 from presto_tpu.io import datfft
 from presto_tpu.ops.rednoise import deredden

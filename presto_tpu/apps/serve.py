@@ -18,7 +18,6 @@ from __future__ import annotations
 
 import argparse
 import sys
-import time
 
 from presto_tpu.apps.common import ensure_backend
 

@@ -24,7 +24,6 @@ before being written back out as topocentric.
 from __future__ import annotations
 
 import argparse
-import os
 
 import numpy as np
 

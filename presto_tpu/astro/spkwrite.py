@@ -21,7 +21,7 @@ coefs], evaluated at tau = (et - mid) / radius.
 from __future__ import annotations
 
 import struct
-from typing import Callable, List, Sequence, Tuple
+from typing import Sequence, Tuple
 
 import numpy as np
 

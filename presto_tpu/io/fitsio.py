@@ -16,9 +16,8 @@ the rest of the file.
 from __future__ import annotations
 
 import mmap
-import os
 from dataclasses import dataclass, field
-from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 

@@ -10,8 +10,6 @@ our artifacts and vice versa.
 
 from __future__ import annotations
 
-import os
-import re
 from dataclasses import dataclass, field
 from typing import List, Tuple
 

@@ -16,8 +16,8 @@ from __future__ import annotations
 import io
 import os
 import struct
-from dataclasses import dataclass, field
-from typing import BinaryIO, Iterator, Optional
+from dataclasses import dataclass
+from typing import BinaryIO, Iterator
 
 import numpy as np
 

@@ -1,0 +1,63 @@
+"""presto-lint: AST-driven invariant analysis for the presto_tpu tree.
+
+The repo's hardest-won correctness properties — crash-atomic artifact
+writes (`io/atomic.py`), epoch-fenced ledger commits
+(`pipeline/leaseledger.py`), lock-guarded replica state
+(`serve/fleet.py`), and the byte-identity contract of jitted stages
+(PAPER.md) — were each proven by construction once and then guarded
+only by chaos tests that *sample* the failure space.  This package
+encodes them as machine-checked rules instead, so a future PR cannot
+silently regress them: every check family walks the real source ASTs
+and fails tier-1 with exact ``file:line`` findings.
+
+Check families (see docs/LINTING.md for the catalog):
+
+  atomic-write      artifact writers in pipeline/ serve/ obs/ must go
+                    through io.atomic.atomic_open or a recognized
+                    tmp+os.replace / fence-staged idiom
+  fence-discipline  ledger-owned state mutates only inside the
+                    fence-checked commit paths
+  lock-guard        attributes declared guarded are only touched with
+                    their lock held
+  lock-order        the lock-acquisition graph across serve/ is acyclic
+  trace-purity      functions reachable from jit/pjit/pallas entry
+                    points never call time/random/host-I/O
+  import-hygiene    no unused or duplicate imports (the in-tree twin
+                    of the pyproject ruff config)
+  obs-coverage      the 13 instrumentation-coverage checks formerly in
+                    tools/obs_lint.py (thin shim kept there)
+
+Use `run_lint()` for the full suite, or `core.run_checks()` for a
+subset over an arbitrary (possibly in-memory) tree.
+"""
+
+from presto_tpu.lint.core import (  # noqa: F401  (public API)
+    Finding,
+    Tree,
+    apply_baseline,
+    baseline_entry,
+    load_baseline,
+    registered_checks,
+    run_checks,
+    save_baseline,
+)
+
+# importing the check modules registers them
+from presto_tpu.lint import atomicwrite  # noqa: F401
+from presto_tpu.lint import fence        # noqa: F401
+from presto_tpu.lint import locks        # noqa: F401
+from presto_tpu.lint import purity       # noqa: F401
+from presto_tpu.lint import imports      # noqa: F401
+from presto_tpu.lint import obscoverage  # noqa: F401
+
+
+def run_lint(root, baseline_path=None, checks=None):
+    """Run every registered family over the repo at `root`, applying
+    the committed baseline.  Returns (findings, suppressed, stale):
+    `findings` must be empty for the tree to pass, `stale` lists
+    baseline entries that no longer match anything (they fail too, so
+    the baseline shrinks monotonically)."""
+    tree = Tree.collect(root)
+    findings = run_checks(tree, checks=checks)
+    baseline = load_baseline(baseline_path) if baseline_path else []
+    return apply_baseline(tree, findings, baseline)

@@ -1,0 +1,244 @@
+"""trace-purity: jit-reachable code never calls time/random/host-I/O.
+
+PAPER.md's determinism contract — re-running a stage produces
+byte-identical artifacts, which is what lets the manifest verify
+instead of trust and lets chaos tests assert equality after a kill —
+holds only if everything that executes *at trace time* inside
+``jax.jit`` / ``pjit`` / Pallas entry points is a pure function of its
+inputs.  A ``time.time()`` or ``np.random`` call in traced code bakes
+a different constant into every compile; host file I/O from inside a
+traced function runs at trace time (once, unpredictably, per compile)
+rather than per call.  Chaos and equality tests only sample this;
+the check proves it over the whole call graph.
+
+Mechanics: over ``ops/``, ``search/``, ``parallel/`` the check
+
+1. marks **entry points**: functions decorated ``@jax.jit`` /
+   ``@partial(jax.jit, ...)`` / ``@pjit``, functions wrapped by a
+   ``jax.jit(f)`` / ``jax.jit(jax.vmap(f))`` call, and kernels handed
+   to ``pl.pallas_call``;
+2. builds the **call graph** by name: bare calls resolve to functions
+   of the same module (including nested defs), ``from``-imports and
+   ``module.func`` attribute calls resolve across the three scanned
+   packages;
+3. flags any **impure call** in a reachable function: ``time.time``
+   and friends, the stateful ``random`` / ``numpy.random`` modules
+   (``jax.random`` is fine — functional PRNG keys are the supported
+   way), builtin ``open`` / ``os`` file mutations, and ``.tofile``.
+
+Per-site escapes use the standard pragma, e.g. a host callback that
+is deliberately impure:  ``# presto-lint: allow(trace-purity)``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from presto_tpu.lint.core import (Finding, SourceFile, Tree,
+                                  dotted_name, function_scopes,
+                                  register)
+
+CHECK = "trace-purity"
+
+SCOPES = ("presto_tpu/ops/", "presto_tpu/search/",
+          "presto_tpu/parallel/")
+
+JIT_WRAPPERS = {"jax.jit", "jit", "pjit", "jax.pjit",
+                "jax.experimental.pjit.pjit"}
+PARTIALS = {"partial", "functools.partial"}
+UNWRAP = {"jax.vmap", "vmap", "jax.named_call", "shard_map",
+          "jax.checkpoint", "checkpoint"} | PARTIALS | JIT_WRAPPERS
+
+IMPURE_EXACT = {
+    "open", "input", "os.fdopen", "os.remove", "os.unlink",
+    "os.replace", "os.rename", "os.makedirs", "os.mkdir",
+    "os.system", "time.time", "time.monotonic", "time.perf_counter",
+    "time.process_time", "time.time_ns", "time.monotonic_ns",
+    "time.sleep",
+}
+IMPURE_PREFIX = ("random.", "numpy.random.")
+
+
+class _Module:
+    """One scanned module: alias maps, function table, jit roots."""
+
+    def __init__(self, sf: SourceFile):
+        self.sf = sf
+        self.aliases: Dict[str, str] = {}      # import numpy as np
+        self.from_imports: Dict[str, str] = {}  # from x import y
+        self.funcs: Dict[str, List] = {}       # bare name -> scopes
+        self.scopes = function_scopes(sf)
+        for scope in self.scopes:
+            bare = scope.qualname.rsplit(".", 1)[-1]
+            self.funcs.setdefault(bare, []).append(scope)
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    local = a.asname or a.name.split(".")[0]
+                    self.aliases[local] = a.name if a.asname \
+                        else a.name.split(".")[0]
+            elif isinstance(node, ast.ImportFrom) and node.module \
+                    and node.level == 0:
+                for a in node.names:
+                    self.from_imports[a.asname or a.name] = \
+                        node.module + "." + a.name
+
+    def resolve_dotted(self, d: str) -> str:
+        head, _, rest = d.partition(".")
+        if head in self.from_imports:
+            base = self.from_imports[head]
+        elif head in self.aliases:
+            base = self.aliases[head]
+        else:
+            return d
+        return base + "." + rest if rest else base
+
+
+def _module_rel(dotted: str) -> str:
+    return dotted.replace(".", "/") + ".py"
+
+
+def _collect_jit_roots(mod: _Module) -> Set[str]:
+    """Qualnames of jit/pallas entry points in one module."""
+    roots: Set[str] = set()
+    by_node = {id(s.node): s for s in mod.scopes}
+
+    def mark_name(name: Optional[str]) -> None:
+        if name:
+            for scope in mod.funcs.get(name, ()):
+                roots.add(scope.qualname)
+
+    def names_under(node: ast.AST) -> List[str]:
+        """Bare function names inside a wrapper expression like
+        jax.jit(jax.vmap(f)) or partial(f, ...)."""
+        out: List[str] = []
+        if isinstance(node, ast.Name):
+            out.append(node.id)
+        elif isinstance(node, ast.Call):
+            fn = dotted_name(node.func)
+            if fn in UNWRAP or fn is None:
+                for a in node.args:
+                    out.extend(names_under(a))
+        return out
+
+    # decorator-based roots
+    for scope in mod.scopes:
+        node = scope.node
+        for dec in getattr(node, "decorator_list", ()):
+            d = dotted_name(dec)
+            if d in JIT_WRAPPERS:
+                roots.add(scope.qualname)
+                continue
+            if isinstance(dec, ast.Call):
+                fn = dotted_name(dec.func)
+                if fn in JIT_WRAPPERS:
+                    roots.add(scope.qualname)
+                elif fn in PARTIALS and dec.args \
+                        and dotted_name(dec.args[0]) in JIT_WRAPPERS:
+                    roots.add(scope.qualname)
+    # call-based roots: jax.jit(f) anywhere, pallas_call(kernel, ...)
+    for node in ast.walk(mod.sf.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = dotted_name(node.func)
+        if fn in JIT_WRAPPERS:
+            for a in node.args:
+                for name in names_under(a):
+                    mark_name(name)
+        elif fn is not None and fn.endswith("pallas_call") \
+                and node.args:
+            for name in names_under(node.args[0]):
+                mark_name(name)
+    del by_node
+    return roots
+
+
+@register(CHECK)
+def check(tree: Tree) -> List[Finding]:
+    mods: Dict[str, _Module] = {}
+    for sf in tree.under(*SCOPES):
+        if sf.tree is not None:
+            mods[sf.path] = _Module(sf)
+
+    # call-graph edges: (path, qualname) -> [(path, qualname)]
+    def edges(path: str, scope) -> List[Tuple[str, str]]:
+        mod = mods[path]
+        out: List[Tuple[str, str]] = []
+        for call in scope.calls:
+            d = dotted_name(call.func)
+            if d is None:
+                continue
+            if "." not in d:
+                # bare call: same-module function (any nesting), or a
+                # from-import from a scanned module
+                if d in mod.funcs:
+                    out.extend((path, s.qualname)
+                               for s in mod.funcs[d])
+                    continue
+                tgt = mod.from_imports.get(d)
+                if tgt:
+                    tmod, _, tname = tgt.rpartition(".")
+                    rel = _module_rel(tmod)
+                    if rel in mods and tname in mods[rel].funcs:
+                        out.extend((rel, s.qualname)
+                                   for s in mods[rel].funcs[tname])
+            else:
+                head, _, attr = d.partition(".")
+                if "." in attr:
+                    continue               # a.b.c: not a module func
+                base = mod.from_imports.get(head) \
+                    or mod.aliases.get(head)
+                if base:
+                    rel = _module_rel(base)
+                    if rel in mods and attr in mods[rel].funcs:
+                        out.extend((rel, s.qualname)
+                                   for s in mods[rel].funcs[attr])
+        return out
+
+    scope_by_key = {(path, s.qualname): s
+                    for path, mod in mods.items()
+                    for s in mod.scopes}
+
+    # BFS from every jit root, remembering which root reached where
+    reached: Dict[Tuple[str, str], str] = {}
+    queue: List[Tuple[Tuple[str, str], str]] = []
+    for path, mod in mods.items():
+        for qual in sorted(_collect_jit_roots(mod)):
+            key = (path, qual)
+            if key in scope_by_key and key not in reached:
+                reached[key] = "%s:%s" % (path, qual)
+                queue.append((key, reached[key]))
+    while queue:
+        key, root = queue.pop()
+        for nxt in edges(key[0], scope_by_key[key]):
+            if nxt not in reached and nxt in scope_by_key:
+                reached[nxt] = root
+                queue.append((nxt, root))
+
+    out: List[Finding] = []
+    for (path, qual), root in sorted(reached.items()):
+        mod = mods[path]
+        for call in scope_by_key[(path, qual)].calls:
+            d = dotted_name(call.func)
+            if d is None:
+                if isinstance(call.func, ast.Attribute) \
+                        and call.func.attr == "tofile":
+                    out.append(Finding(
+                        CHECK, path, call.lineno,
+                        "%s (reachable from jit entry %s) calls "
+                        ".tofile() — host I/O inside traced code "
+                        "breaks the byte-identity contract"
+                        % (qual, root)))
+                continue
+            r = mod.resolve_dotted(d)
+            if r in IMPURE_EXACT \
+                    or r.startswith(IMPURE_PREFIX):
+                out.append(Finding(
+                    CHECK, path, call.lineno,
+                    "%s (reachable from jit entry %s) calls %s — "
+                    "trace-impure: the value is baked in at trace "
+                    "time, so recompiles stop being byte-identical "
+                    "(use jax.random keys / pass host state as an "
+                    "argument)" % (qual, root, r)))
+    return out

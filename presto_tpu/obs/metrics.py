@@ -197,14 +197,14 @@ class _Family:
         self.name = name
         self.help = help
         self.labelnames = tuple(labelnames)
-        self._lock = threading.Lock()
+        self._lock = threading.Lock()  # presto-lint: guards(_children)
         self._children: Dict[Tuple[Tuple[str, str], ...], _Child] = {}
         if not self.labelnames:
             self._default = self._make_child(())
         else:
             self._default = None
 
-    def _make_child(self, labels):
+    def _make_child(self, labels):  # presto-lint: holds(_lock)
         child = self.child_cls(self, labels)
         self._children[labels] = child
         return child
@@ -309,7 +309,7 @@ class MetricsRegistry:
 
     def __init__(self, enabled: bool = True):
         self.enabled = enabled
-        self._lock = threading.Lock()
+        self._lock = threading.Lock()  # presto-lint: guards(_families)
         self._families: "Dict[str, _Family]" = {}
 
     # -- registration -------------------------------------------------

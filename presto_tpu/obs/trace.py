@@ -162,7 +162,7 @@ class Tracer:
         self.enabled = enabled
         self._cv: contextvars.ContextVar = contextvars.ContextVar(
             "presto_tpu_span", default=None)
-        self._lock = threading.Lock()
+        self._lock = threading.Lock()  # presto-lint: guards(_finished, _open, _jsonl_fh)
         self._finished: deque = deque(maxlen=keep)
         self._open: Dict[str, Span] = {}
         self._on_finish = on_finish
@@ -250,7 +250,7 @@ class Tracer:
             self._jsonl_path = path
             return True
 
-    def _ensure_jsonl(self):
+    def _ensure_jsonl(self):  # presto-lint: holds(_lock)
         if self._jsonl_path is None:
             return None
         if self._jsonl_fh is None:

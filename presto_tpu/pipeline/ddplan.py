@@ -21,7 +21,7 @@ Pure planning math — host float64, no device work.
 from __future__ import annotations
 
 from dataclasses import dataclass, field
-from typing import List, Optional
+from typing import List
 
 import numpy as np
 

@@ -36,6 +36,8 @@ from typing import List, Optional, Sequence
 
 import numpy as np
 
+from presto_tpu.io.atomic import atomic_open
+
 SIDEREAL_DAY_S = 86164.0905
 
 # GBT350 drift defaults (GBT350_drift_prep.py:25-27): ~141 s of the
@@ -186,8 +188,8 @@ def split_drift_scan(rawfiles: Sequence[str], outdir: str = ".",
                     reuse = False     # unreadable: rewrite it
                 if reuse:
                     continue
-                # no unlink: .part + os.replace overwrites atomically,
-                # so a crash mid-rewrite leaves the old artifact
+                # no unlink: atomic_open overwrites atomically, so a
+                # crash mid-rewrite leaves the old artifact
             out_hdr = FilterbankHeader(
                 source_name="%s_%s" % (prefix, tag),
                 machine_id=getattr(hdr, "machine_id", 10),
@@ -197,8 +199,7 @@ def split_drift_scan(rawfiles: Sequence[str], outdir: str = ".",
                 else hdr.nbits,
                 tstart=p.tstart, tsamp=hdr.tsamp,
                 src_raj=p.src_raj, src_dej=p.src_dej)
-            tmp = path + ".part"
-            with open(tmp, "wb") as f:
+            with atomic_open(path, "wb") as f:
                 write_filterbank_header(out_hdr, f)
                 # stream in bounded blocks: a full pointing at GBT350
                 # scale is ~3.4 GB of float work otherwise
@@ -220,7 +221,6 @@ def split_drift_scan(rawfiles: Sequence[str], outdir: str = ".",
                     f.write(pack_bits(
                         np.ascontiguousarray(arr).ravel(),
                         out_hdr.nbits).tobytes())
-            os.replace(tmp, path)
         return written
     finally:
         fb.close()

@@ -68,8 +68,8 @@ from __future__ import annotations
 import os
 import queue
 import threading
-from dataclasses import dataclass, field
-from typing import Callable, Dict, Iterator, List, Optional
+from dataclasses import dataclass
+from typing import Dict, Iterator, List, Optional
 
 import numpy as np
 

@@ -18,11 +18,12 @@ reference campaigns are overnight jobs; same code path, bigger N).
 from __future__ import annotations
 
 import json
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Dict, List, Optional
 
 import numpy as np
 
+from presto_tpu.io.atomic import atomic_open
 from presto_tpu.models.synth import pulse_shape
 from presto_tpu.ops.orbit import OrbitParams, orbit_delays
 
@@ -152,5 +153,7 @@ def format_table(res: Dict) -> str:
 
 
 def save_json(res: Dict, path: str) -> None:
-    with open(path, "w") as f:
+    # a campaign is hours of trials; a kill mid-dump must leave the
+    # previous complete results, not a truncated JSON a rerun trusts
+    with atomic_open(path, "w") as f:
         json.dump(res, f, indent=1)

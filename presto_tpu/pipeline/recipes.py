@@ -19,7 +19,7 @@ GBT350_drift_search.py:16-35.
 
 from __future__ import annotations
 
-from dataclasses import dataclass, field, replace
+from dataclasses import dataclass, replace
 from typing import Optional, Tuple
 
 from presto_tpu.pipeline.sifting import SiftPolicy

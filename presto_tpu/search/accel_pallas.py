@@ -44,7 +44,6 @@ source vreg along the gathered dim, so lane gathers decompose into
 
 from __future__ import annotations
 
-from typing import List, Tuple
 
 import numpy as np
 import jax

@@ -22,7 +22,7 @@ from __future__ import annotations
 from dataclasses import dataclass, replace
 from functools import partial
 from itertools import product
-from typing import List, Optional, Tuple
+from typing import List
 
 import numpy as np
 import jax

@@ -27,15 +27,15 @@ Window extraction, prune_powers, candidate merge/dedup stay on host
 from __future__ import annotations
 
 import struct
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from functools import partial
-from typing import List, Optional, Sequence, Tuple
+from typing import List, Optional, Sequence
 
 import numpy as np
 import jax
 import jax.numpy as jnp
 
-from presto_tpu.ops.stats import candidate_sigma, power_for_sigma
+from presto_tpu.ops.stats import candidate_sigma
 
 MININCANDS = 6          # per-miniFFT candidates kept (search_bin.c:5)
 MINORBP = 300.0         # min orbital period, s (search_bin.c:8)

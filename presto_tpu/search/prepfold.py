@@ -17,7 +17,6 @@ plot panels.
 from __future__ import annotations
 
 from dataclasses import dataclass, field
-from functools import partial
 from typing import List, Optional, Tuple
 
 import numpy as np

@@ -49,7 +49,7 @@ from __future__ import annotations
 
 import glob
 import os
-from typing import Dict, List, Optional
+from typing import List, Optional
 
 from presto_tpu.serve.queue import Job, JobStatus
 

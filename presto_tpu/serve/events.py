@@ -28,7 +28,7 @@ class EventLog:
     """Thread-safe event sink: bounded ring + optional file."""
 
     def __init__(self, path: Optional[str] = None, keep: int = 4096):
-        self._lock = threading.Lock()
+        self._lock = threading.Lock()  # presto-lint: guards(_ring, _counts, _seq, _fh)
         self._ring: deque = deque(maxlen=keep)
         self._counts: Counter = Counter()
         self._seq = 0

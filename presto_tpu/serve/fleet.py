@@ -149,9 +149,10 @@ class FleetReplica:
         self._pump_t: Optional[threading.Thread] = None
         self._hb_t: Optional[threading.Thread] = None
         self._warmed = threading.Event()
-        #: job_id -> (lease, local Job)
+        #: job_id -> (lease, local Job); shared between the pump
+        #: thread, drain(), and the HTTP readiness handler
         self._inflight: Dict[str, Tuple[object, Job]] = {}
-        self._inflight_lock = threading.Lock()
+        self._inflight_lock = threading.Lock()  # presto-lint: guards(_inflight)
         #: chaos seam: kill the replica when the pump reaches this
         #: point ("job-leased" | "job-enqueued")
         self.kill_on: Optional[str] = None
@@ -249,13 +250,12 @@ class FleetReplica:
         self.draining = True
         self.service.draining = True
         self.service.events.emit("fleet-drain", replica=self.replica,
-                                 inflight=len(self._inflight))
+                                 inflight=self._inflight_size())
         deadline = time.time() + timeout
         drained = True
         while time.time() < deadline:
-            with self._inflight_lock:
-                if not self._inflight:
-                    break
+            if self._inflight_size() == 0:
+                break
             time.sleep(self.cfg.poll_s)
         else:
             drained = False
@@ -375,9 +375,9 @@ class FleetReplica:
             self._g_epoch.set(self.epoch)
         leased_any = False
         while (not self.draining and not self._stop.is_set()
-               and len(self._inflight) < self.cfg.max_inflight):
+               and self._inflight_size() < self.cfg.max_inflight):
             want = min(max(int(self.cfg.lease_batch), 1),
-                       self.cfg.max_inflight - len(self._inflight))
+                       self.cfg.max_inflight - self._inflight_size())
             if want > 1:
                 # one fenced transaction claims a whole same-bucket
                 # batch: the jobs coalesce into one local micro-batch
@@ -413,7 +413,7 @@ class FleetReplica:
                     admitted = False
             if not admitted:
                 break
-        if (not leased_any and not self._inflight
+        if (not leased_any and self._inflight_size() == 0
                 and self.cfg.tune_in_idle and not self.draining
                 and not self._stop.is_set()):
             self._idle_tune()
@@ -559,6 +559,13 @@ class FleetReplica:
             self._inflight.pop(job_id, None)
             self._g_inflight.set(len(self._inflight))
 
+    def _inflight_size(self) -> int:
+        """Locked read of the in-flight count (the pump's lease
+        budget and drain's progress test both race the executor's
+        _drop without this — found by the lock-guard lint)."""
+        with self._inflight_lock:
+            return len(self._inflight)
+
     # ---- commit -------------------------------------------------------
 
     def _commit(self, lease, job: Job) -> bool:
@@ -581,9 +588,16 @@ class FleetReplica:
             "result": job.result,
             "artifacts": artifact_digests(job.workdir),
         }
+        # staged, NOT atomic_open: result.json may only land through
+        # the ledger fence (complete/complete_and_expand renames it
+        # under the ledger lock after the epoch check) — but the
+        # staged bytes are fsync'd here so the fenced rename promotes
+        # a durable file, mirroring io/atomic's write discipline
         fd, tmp = tempfile.mkstemp(prefix=".result-", dir=job_dir)
         with os.fdopen(fd, "w") as f:
             json.dump(result, f, indent=1, sort_keys=True)
+            f.flush()
+            os.fsync(f.fileno())
         final = os.path.join(job_dir, "result.json")
         summary = {"n_artifacts": len(result["artifacts"]),
                    "attempt_dir": result["attempt_dir"],

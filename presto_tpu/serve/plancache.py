@@ -164,7 +164,7 @@ class PlanCache:
         self.capacity = capacity
         self.obs = obs
         self._events = events
-        self._lock = threading.Lock()
+        self._lock = threading.Lock()  # presto-lint: guards(_plans, _compile_s)
         self._plans: "OrderedDict[PlanKey, CompiledPlan]" = \
             OrderedDict()
         self._compile_s = 0.0

@@ -138,7 +138,7 @@ class JobQueue:
         self.max_retry_depth = max_retry_depth
         self._heap: List[Tuple[int, int, Job]] = []
         self._count = itertools.count()
-        self._lock = threading.Lock()
+        self._lock = threading.Lock()  # presto-lint: guards(_heap, _closed)
         self._not_empty = threading.Condition(self._lock)
         self._not_full = threading.Condition(self._lock)
         self._closed = False

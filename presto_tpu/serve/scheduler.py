@@ -108,7 +108,7 @@ class Scheduler:
         self._stop = threading.Event()
         self._retry_heap: list = []
         self._retry_seq = itertools.count()
-        self._retry_lock = threading.Lock()
+        self._retry_lock = threading.Lock()  # presto-lint: guards(_retry_heap)
         self._pool: Optional[ThreadPoolExecutor] = None
         # lifecycle accounting lives on the metrics registry — the
         # stats() JSON block and the serve_* Prometheus series read

@@ -122,7 +122,7 @@ class SearchService:
             from presto_tpu.serve.batchexec import StackedBatchExecutor
             self.scheduler.batch_executor = StackedBatchExecutor(self)
         self._jobs: Dict[str, Job] = {}
-        self._jobs_lock = threading.Lock()
+        self._jobs_lock = threading.Lock()  # presto-lint: guards(_jobs)
         self._ids = itertools.count(1)
         self._t0 = time.time()
         self.draining = False

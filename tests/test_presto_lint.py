@@ -1,0 +1,574 @@
+"""presto-lint (tier-1): the AST invariant suite holds on the real
+tree, every check family bites on a synthetic violation with an exact
+file:line, pragmas and the committed baseline behave, and the writers
+the atomic-write family got fixed this round really are crash-atomic
+(SimulatedCrash mid-write never leaves a half-written artifact)."""
+
+import importlib.util
+import json
+import os
+
+import numpy as np
+import pytest
+
+from presto_tpu.lint import run_lint
+from presto_tpu.lint.core import (Tree, apply_baseline, load_baseline,
+                                  registered_checks, run_checks,
+                                  save_baseline)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BASELINE = os.path.join(REPO, "tools", "presto_lint_baseline.json")
+
+
+def _mem(sources, checks):
+    """Run selected check families over an in-memory fixture tree."""
+    return run_checks(Tree.from_sources(sources), checks=checks)
+
+
+# ---------------------------------------------------------------------------
+# the real tree
+# ---------------------------------------------------------------------------
+
+def test_real_tree_is_clean():
+    """The acceptance gate: >=5 families active, zero unsuppressed
+    findings, no stale baseline entries, and the baseline stays a
+    short grandfather list (<=10 sites)."""
+    assert len(registered_checks()) >= 5
+    kept, suppressed, stale = run_lint(REPO, baseline_path=BASELINE)
+    assert kept == [], "\n".join(f.format() for f in kept)
+    assert stale == [], "\n".join(f.format() for f in stale)
+    assert len(load_baseline(BASELINE)) <= 10
+
+
+def test_baseline_entries_still_match_a_finding():
+    """Every committed baseline entry suppresses something real (the
+    expiry direction of test_real_tree_is_clean: a fixed site leaves
+    a stale entry, which that test rejects — this one pins that the
+    suppression count equals the entry count)."""
+    entries = load_baseline(BASELINE)
+    _kept, suppressed, stale = run_lint(REPO, baseline_path=BASELINE)
+    assert stale == []
+    assert len(suppressed) >= len(entries)
+
+
+# ---------------------------------------------------------------------------
+# atomic-write
+# ---------------------------------------------------------------------------
+
+BAD_WRITER = '''
+import os
+
+def dump(path, data):
+    with open(path, "w") as f:
+        f.write(data)
+
+def dump_bin(fd):
+    with os.fdopen(fd, "wb") as f:
+        f.write(b"x")
+'''
+
+
+def test_atomic_write_fires_with_exact_lines():
+    fs = _mem({"presto_tpu/pipeline/bad.py": BAD_WRITER},
+              ["atomic-write"])
+    assert [(f.path, f.line) for f in fs] == [
+        ("presto_tpu/pipeline/bad.py", 5),
+        ("presto_tpu/pipeline/bad.py", 9)]
+    assert all(f.check == "atomic-write" for f in fs)
+
+
+def test_atomic_write_tofile_path_inference():
+    src = '''
+import os
+import numpy as np
+
+def scratch(d, arr):
+    dst = os.path.join(d, "x.dat")
+    arr.tofile(dst)
+
+def into_file_object(f, arr):
+    arr.tofile(f)       # a managed file handle: not flagged
+'''
+    fs = _mem({"presto_tpu/serve/t.py": src}, ["atomic-write"])
+    assert [(f.line, f.check) for f in fs] == [(7, "atomic-write")]
+
+
+def test_atomic_write_recognized_idioms_are_silent():
+    src = '''
+import os
+import tempfile
+
+def tmp_replace(path, data):
+    tmp = path + ".part"
+    with open(tmp, "wb") as f:
+        f.write(data)
+    os.replace(tmp, path)
+
+def fence_staged(ledger, lease, final, data):
+    fd, tmp = tempfile.mkstemp(dir=".")
+    with os.fdopen(fd, "w") as f:
+        f.write(data)
+    ledger.complete(lease, "host", {final: tmp})
+'''
+    assert _mem({"presto_tpu/pipeline/ok.py": src},
+                ["atomic-write"]) == []
+
+
+def test_atomic_write_scope_reads_and_appends_exempt():
+    src = '''
+def reader(path):
+    with open(path) as f:
+        return f.read()
+
+def logline(path, ev):
+    with open(path, "a") as f:
+        f.write(ev + "\\n")
+'''
+    assert _mem({"presto_tpu/obs/r.py": src}, ["atomic-write"]) == []
+    # same bad writer outside the artifact layers: out of scope
+    assert _mem({"presto_tpu/apps/w.py": BAD_WRITER},
+                ["atomic-write"]) == []
+
+
+# ---------------------------------------------------------------------------
+# fence-discipline
+# ---------------------------------------------------------------------------
+
+SNEAKY = '''
+import os
+
+def poke(ledger, row):
+    state = ledger._load()
+    state["items"]["x"] = row
+    ledger._save(state)
+
+def clobber(tmp, jobdir):
+    os.replace(tmp, os.path.join(jobdir, "result.json"))
+'''
+
+
+def test_fence_discipline_fires_with_exact_lines():
+    fs = _mem({"presto_tpu/serve/sneaky.py": SNEAKY},
+              ["fence-discipline"])
+    assert [(f.line, f.check) for f in fs] == [
+        (5, "fence-discipline"), (7, "fence-discipline"),
+        (10, "fence-discipline")]
+
+
+def test_fence_discipline_commit_paths_and_reads_exempt():
+    # the identical code inside a ledger module is the commit path
+    assert _mem({"presto_tpu/serve/jobledger.py": SNEAKY},
+                ["fence-discipline"]) == []
+    ok = '''
+import os, json
+
+def monitor(ledger):
+    return ledger.read()            # public, read-only: fine
+
+def locate(jobdir):
+    return os.path.join(jobdir, "result.json")   # not a write
+'''
+    assert _mem({"tools/mon.py": ok}, ["fence-discipline"]) == []
+
+
+# ---------------------------------------------------------------------------
+# lock-guard / lock-order
+# ---------------------------------------------------------------------------
+
+GUARDED = '''
+import threading
+
+class C:
+    def __init__(self):
+        self._lock = threading.Lock()  # presto-lint: guards(_state)
+        self._cv = threading.Condition(self._lock)
+        self._state = {}
+
+    def locked_read(self):
+        with self._lock:
+            return len(self._state)
+
+    def cv_read(self):
+        with self._cv:                 # condition aliases the lock
+            return len(self._state)
+
+    def racy_read(self):
+        return len(self._state)
+
+    def racy_thread(self):
+        def worker():
+            self._state["x"] = 1
+        with self._lock:
+            return worker
+
+    def helper(self):  # presto-lint: holds(_lock)
+        return list(self._state)
+'''
+
+
+def test_lock_guard_fires_and_lock_silences():
+    fs = _mem({"presto_tpu/serve/c.py": GUARDED}, ["lock-guard"])
+    assert [(f.line, f.check) for f in fs] == [
+        (19, "lock-guard"), (23, "lock-guard")]
+    msg = fs[0].message
+    assert "_state" in msg and "_lock" in msg
+
+
+def test_lock_guard_undeclared_class_not_enforced():
+    src = GUARDED.replace("  # presto-lint: guards(_state)", "")
+    assert _mem({"presto_tpu/serve/c.py": src}, ["lock-guard"]) == []
+
+
+def test_lock_order_cycle_detected():
+    cyc = '''
+import threading
+
+class D:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+
+    def m1(self):
+        with self._a:
+            with self._b:
+                pass
+
+    def m2(self):
+        with self._b:
+            with self._a:
+                pass
+'''
+    fs = _mem({"presto_tpu/serve/d.py": cyc}, ["lock-order"])
+    assert len(fs) == 1 and fs[0].check == "lock-order"
+    assert "cycle" in fs[0].message
+    # consistent order: no cycle, no finding
+    acyclic = cyc.replace(
+        "        with self._b:\n            with self._a:",
+        "        with self._a:\n            with self._b:")
+    assert _mem({"presto_tpu/serve/d.py": acyclic},
+                ["lock-order"]) == []
+
+
+# ---------------------------------------------------------------------------
+# trace-purity
+# ---------------------------------------------------------------------------
+
+def test_purity_fires_through_every_root_kind():
+    src = '''
+import time
+from functools import partial
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+@jax.jit
+def decorated(x):
+    return x * time.time()
+
+@partial(jax.jit, static_argnames=("n",))
+def partial_decorated(x, n):
+    return np.random.normal(size=n) + x
+
+def wrapped(x):
+    return x + time.perf_counter()
+
+run = jax.jit(jax.vmap(wrapped))
+
+def kernel(ref, o_ref):
+    o_ref[...] = ref[...] * time.monotonic()
+
+def build(shape):
+    return pl.pallas_call(kernel, out_shape=shape)
+'''
+    fs = _mem({"presto_tpu/ops/k.py": src}, ["trace-purity"])
+    assert [(f.line, f.check) for f in fs] == [
+        (11, "trace-purity"), (15, "trace-purity"),
+        (18, "trace-purity"), (23, "trace-purity")]
+    assert "time.time" in fs[0].message
+    assert "numpy.random" in fs[1].message
+
+
+def test_purity_reaches_across_modules():
+    helper = '''
+import numpy as np
+
+def noisy(x):
+    return np.random.normal() + x
+
+def pure(x):
+    return x + 1
+'''
+    entry = '''
+import jax
+from presto_tpu.ops.helpers import noisy, pure
+
+@jax.jit
+def kernel(x):
+    return noisy(pure(x))
+'''
+    fs = _mem({"presto_tpu/ops/helpers.py": helper,
+               "presto_tpu/search/entry.py": entry},
+              ["trace-purity"])
+    assert [(f.path, f.line) for f in fs] == [
+        ("presto_tpu/ops/helpers.py", 5)]
+    assert "kernel" in fs[0].message     # names the jit root
+
+
+def test_purity_unreachable_and_jax_random_ok():
+    src = '''
+import time
+import jax
+import jax.random as jr
+
+def host_side(path):
+    return time.time()               # never traced: fine
+
+@jax.jit
+def keyed(x, key):
+    return x + jr.normal(key)        # functional PRNG: fine
+'''
+    assert _mem({"presto_tpu/ops/h.py": src}, ["trace-purity"]) == []
+
+
+# ---------------------------------------------------------------------------
+# import-hygiene
+# ---------------------------------------------------------------------------
+
+def test_import_hygiene_unused_and_duplicate():
+    src = '''
+import os
+import os
+import sys
+
+def f():
+    return os.getpid()
+'''
+    fs = _mem({"presto_tpu/utils/u.py": src}, ["import-hygiene"])
+    msgs = [f.message for f in fs]
+    assert any("more than once" in m for m in msgs)
+    assert any("'sys' is imported but never used" in m for m in msgs)
+
+
+def test_import_hygiene_exemptions():
+    src = '''
+import unusedbutnoqa  # noqa
+import urllib.error
+import urllib.request
+
+try:
+    import optionaldep
+except ImportError:
+    optionaldep = None
+
+def f(u):
+    return urllib.request.urlopen(u), urllib.error, optionaldep
+'''
+    assert _mem({"presto_tpu/utils/v.py": src},
+                ["import-hygiene"]) == []
+    # __init__.py re-exports are exempt wholesale
+    assert _mem({"presto_tpu/sub/__init__.py": "import os\n"},
+                ["import-hygiene"]) == []
+    # docstring/doctest mentions count as usage (text backstop)
+    doc = '''
+import math
+
+def f(x):
+    """Uses math.pi conceptually: math."""
+    return x
+'''
+    assert _mem({"presto_tpu/utils/w.py": doc},
+                ["import-hygiene"]) == []
+
+
+# ---------------------------------------------------------------------------
+# pragmas + baseline semantics
+# ---------------------------------------------------------------------------
+
+def test_pragma_allow_suppresses_only_named_check():
+    src = '''
+def dump(path, data):
+    with open(path, "w") as f:  # presto-lint: allow(atomic-write)
+        f.write(data)
+
+def dump2(path, data):
+    # presto-lint: allow(atomic-write)
+    with open(path, "w") as f:
+        f.write(data)
+
+def dump3(path, data):
+    with open(path, "w") as f:  # presto-lint: allow(other-check)
+        f.write(data)
+'''
+    fs = _mem({"presto_tpu/pipeline/p.py": src}, ["atomic-write"])
+    assert [f.line for f in fs] == [12]
+
+
+def test_baseline_add_and_expire(tmp_path):
+    tree = Tree.from_sources({"presto_tpu/pipeline/b.py": BAD_WRITER})
+    findings = run_checks(tree, checks=["atomic-write"])
+    assert len(findings) == 2
+    # grandfather the first finding; context-match the source line
+    entry = {"check": "atomic-write",
+             "path": "presto_tpu/pipeline/b.py",
+             "context": 'with open(path, "w") as f:'}
+    kept, suppressed, stale = apply_baseline(tree, findings, [entry])
+    assert [f.line for f in kept] == [9]
+    assert [f.line for f in suppressed] == [5]
+    assert stale == []
+    # an entry matching nothing is stale and FAILS (baseline shrinks)
+    dead = {"check": "atomic-write",
+            "path": "presto_tpu/pipeline/b.py",
+            "context": "with open(gone, 'w') as f:"}
+    kept2, _sup, stale2 = apply_baseline(tree, findings,
+                                         [entry, dead])
+    assert [f.line for f in kept2] == [9]
+    assert len(stale2) == 1 and stale2[0].check == "baseline"
+    assert "stale baseline entry" in stale2[0].message
+    # save/load round-trip
+    p = str(tmp_path / "base.json")
+    save_baseline(p, [entry])
+    assert load_baseline(p) == [entry]
+
+
+def test_syntax_error_reported_not_raised():
+    fs = _mem({"presto_tpu/pipeline/x.py": "def broken(:\n"}, [])
+    assert [f.check for f in fs] == ["syntax"]
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+
+def _load_cli():
+    spec = importlib.util.spec_from_file_location(
+        "presto_lint_cli", os.path.join(REPO, "tools",
+                                        "presto_lint.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_cli_json_clean_tree(capsys):
+    cli = _load_cli()
+    rc = cli.main(["--json"])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 0
+    assert out["ok"] is True
+    assert out["findings"] == []
+    assert len(out["checks"]) >= 5
+
+
+def test_cli_exit_1_on_violation(tmp_path, capsys):
+    root = tmp_path / "repo"
+    (root / "presto_tpu" / "pipeline").mkdir(parents=True)
+    (root / "presto_tpu" / "pipeline" / "bad.py").write_text(
+        BAD_WRITER)
+    cli = _load_cli()
+    rc = cli.main(["--root", str(root), "--no-baseline", "--json"])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert out["ok"] is False
+    assert [f["line"] for f in out["findings"]] == [5, 9]
+    # human output exits 1 too and names the family
+    rc2 = cli.main(["--root", str(root), "--no-baseline"])
+    human = capsys.readouterr().out
+    assert rc2 == 1 and "[atomic-write]" in human
+
+
+def test_cli_obs_shim_still_works(capsys):
+    """tools/obs_lint.py keeps its historical API (lint(), main(),
+    the regexes) as a shim over the obs-coverage family."""
+    spec = importlib.util.spec_from_file_location(
+        "obs_lint_shim", os.path.join(REPO, "tools", "obs_lint.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    assert mod.lint() == []
+    assert mod.STAGE_RE.findall('timer.mark("sift")') == ["sift"]
+    assert mod.main() == 0
+    assert "OK" in capsys.readouterr().out
+
+
+# ---------------------------------------------------------------------------
+# crash regressions for the writers this round fixed
+# ---------------------------------------------------------------------------
+
+def test_monte_save_json_crash_atomic(monkeypatch, tmp_path):
+    """pipeline/monte.py:save_json used a raw open(path, 'w') — the
+    violation that motivated the atomic-write family.  Now a
+    SimulatedCrash mid-dump must leave the previous complete results
+    and no temp litter."""
+    import json as json_mod
+    from presto_tpu.io.atomic import TMP_PREFIX
+    from presto_tpu.pipeline.monte import save_json
+    from presto_tpu.testing.chaos import SimulatedCrash
+
+    path = str(tmp_path / "monte.json")
+    save_json({"old": 1}, path)
+    assert json_mod.load(open(path)) == {"old": 1}
+
+    real_dump = json_mod.dump
+
+    def crashing_dump(obj, fh, **kw):
+        fh.write('{"half": ')          # bytes are already down...
+        fh.flush()
+        raise SimulatedCrash("mid-dump")
+
+    monkeypatch.setattr(json_mod, "dump", crashing_dump)
+    with pytest.raises(SimulatedCrash):
+        save_json({"new": 2}, path)
+    monkeypatch.setattr(json_mod, "dump", real_dump)
+    # the target kept its previous complete contents
+    assert json_mod.load(open(path)) == {"old": 1}
+    # and the in-flight temp file was removed
+    assert [n for n in os.listdir(str(tmp_path))
+            if n.startswith(TMP_PREFIX)] == []
+
+
+def test_driftprep_crash_leaves_no_partial(monkeypatch, tmp_path):
+    """split_drift_scan streamed into a visible `.part` + os.replace;
+    now it streams through atomic_open.  A SimulatedCrash after the
+    first block must leave NO output file (a resume redoes the
+    pointing) and no temp litter — never a short .fil a later stage
+    would trust."""
+    from presto_tpu.io import sigproc
+    from presto_tpu.io.atomic import TMP_PREFIX
+    from presto_tpu.models.synth import FakeSignal, fake_filterbank_file
+    from presto_tpu.pipeline import driftprep
+    from presto_tpu.testing.chaos import SimulatedCrash
+
+    d = str(tmp_path)
+    scan = os.path.join(d, "scan.fil")
+    fake_filterbank_file(scan, N=6000, dt=1e-3, nchan=8,
+                         lofreq=350.0, chanwidth=1.0,
+                         signal=FakeSignal(f=5.0, dm=10.0, amp=0.5),
+                         noise_sigma=4.0, nbits=8, seed=7)
+    outdir = os.path.join(d, "out")
+
+    calls = {"n": 0}
+    real_pack = sigproc.pack_bits
+
+    def crashing_pack(arr, nbits):
+        calls["n"] += 1
+        if calls["n"] >= 2:            # mid-stream, after real bytes
+            raise SimulatedCrash("mid-pointing")
+        return real_pack(arr, nbits)
+
+    monkeypatch.setattr(sigproc, "pack_bits", crashing_pack)
+    with pytest.raises(SimulatedCrash):
+        driftprep.split_drift_scan([scan], outdir=outdir,
+                                   orig_N=4000, overlap_factor=0.5,
+                                   prefix="tcrash", max_block=1000)
+    monkeypatch.setattr(sigproc, "pack_bits", real_pack)
+    leftovers = os.listdir(outdir)
+    assert [n for n in leftovers if n.endswith(".fil")] == []
+    assert [n for n in leftovers if n.startswith(TMP_PREFIX)] == []
+    # the resumed run completes and produces verifiable pointings
+    out = driftprep.split_drift_scan([scan], outdir=outdir,
+                                     orig_N=4000, overlap_factor=0.5,
+                                     prefix="tcrash", max_block=1000)
+    with sigproc.FilterbankFile(scan) as fb:
+        full = fb.read_spectra(0, 6000)
+    with sigproc.FilterbankFile(out[0]) as fb:
+        got = fb.read_spectra(0, fb.nspectra)
+    np.testing.assert_array_equal(got, full[:4000])

@@ -1,589 +1,37 @@
 #!/usr/bin/env python
-"""obs_lint: static instrumentation-coverage check (tier-1).
+"""obs_lint: thin shim over presto_tpu/lint/obscoverage.py.
 
-The observability contract lives in presto_tpu/obs/taxonomy.py; this
-linter cross-checks the *source tree* against it so an uninstrumented
-code path cannot ship silently:
-
-  1. every `timer.mark("<stage>")` in pipeline/survey.py is a
-     registered SURVEY_STAGE (=> it emits a
-     survey_stage_seconds{stage=...} sample and a span);
-  2. every `_chaos(cfg, "<point>", ...)` kill point is a registered
-     KILL_POINT (=> it is flight-recorded before it can fire) — and
-     conversely every registered point still exists in the source;
-  2b. every elastic-cluster kill point (`self._point("...")` in
-     parallel/elastic.py) and event (`.event("...")`/`._event("...")`
-     in parallel/elastic.py + pipeline/shardledger.py) is registered
-     in CLUSTER_KILL_POINTS / CLUSTER_EVENTS — and conversely;
-  3. every `events.emit("<kind>", ...)` in presto_tpu/serve/ is a
-     registered SERVE_EVENT;
-  4. every job lifecycle state (JobStatus constants in serve/queue.py)
-     maps via JOB_STATE_EVENTS to an event kind that the serve layer
-     actually emits — a new scheduler state transition without
-     telemetry fails here;
-  5. every metric registered anywhere in presto_tpu/ or tools/
-     (`.counter("..." / .gauge("..." / .histogram("...`) is listed in
-     METRICS (the documented catalog);
-  6. the tune layer (presto_tpu/tune/ + apps/tune.py): every
-     `obs.span("...")` name it opens is registered in TUNE_SPANS —
-     and conversely; and every `tune_*` metric listed in METRICS is
-     actually registered by the tune layer (the forward direction is
-     check 5), so a tuning code path cannot ship unobservable and the
-     catalog cannot list dead tuning telemetry;
-  7. the streaming layer (presto_tpu/stream/): spans vs STREAM_SPANS
-     and event kinds vs STREAM_EVENTS, BOTH directions, plus every
-     `stream_*` metric listed in METRICS registered by the stream
-     layer — the live trigger path is the one place an unobservable
-     code path costs real pulses, so its whole telemetry vocabulary
-     is pinned;
-  8. the fused pipeline (presto_tpu/pipeline/fusion.py): every
-     `obs.span("pipeline:...")` it opens is registered in
-     FUSION_SPANS — and conversely — and every `survey_fused_*`
-     metric listed in METRICS is actually registered by the fusion
-     layer, so the in-memory data path (which deliberately SKIPS the
-     durable artifacts a post-mortem would otherwise read) cannot
-     ship with its telemetry dark;
-  9. the DM-SHARDED seam (the multi-device arm of the fused
-     pipeline): SHARDED_FUSION_SPANS / SHARDED_KILL_POINTS /
-     SHARDED_FUSION_METRICS are pinned BOTH directions against the
-     source — every registered sharded span is opened by the fusion
-     layer, every registered sharded kill point is fired by
-     pipeline/survey.py, every registered sharded metric is
-     registered by fusion.py, and conversely any "shard"-named span/
-     kill point/`survey_fused_shard_*` metric in those sources must
-     be in the sharded sets (and the sets must be subsets of their
-     parent catalogs) — the sharded seam holds an entire survey's
-     fan-out across devices with nothing durable until spill, so its
-     telemetry may neither go dark nor go stale;
-  10. the FLEET serving layer (serve/jobledger.py + serve/fleet.py +
-     serve/router.py): FLEET_EVENTS and the `fleet_*` metrics are
-     pinned BOTH directions (event kinds count whether emitted
-     literally or bound as LeaseLedger EV_* class attributes, the
-     same accommodation check 2b makes for the refactored shard
-     ledger) — the fleet recovery path is exactly the code that runs
-     while a replica is dying, so its telemetry may neither go dark
-     nor go stale;
-  11. serve-layer spans (presto_tpu/serve/): every `obs.span("...")`
-     name the serve layer opens is registered in SERVE_SPANS — and
-     conversely — so the scheduler's per-job span and the stacked
-     batch executor's cross-job `serve:stacked-batch` span can
-     neither ship dark nor linger in the catalog after a rename;
-  12. discovery DAGs (serve/dag.py + jobledger.py + router.py +
-     fleet.py): DAG_EVENTS / DAG_SPANS / DAG_METRICS pinned BOTH
-     directions (and as subsets of their parent catalogs) — the
-     dependency-aware job graph's fenced fan-out and cascade-failure
-     paths run exactly while a mid-graph replica is dying, so their
-     telemetry may neither go dark nor go stale;
-  13. fleet-wide observability (serve/fleet.py + serve/router.py +
-     obs/fleetagg.py): FLEET_SPANS (the router's `fleet:` admission
-     roots whose SpanContext is stamped through the ledger),
-     FLEET_OBS_EVENTS (the snapshot-publication and recorded-before-
-     fire chaos kinds), and FLEET_OBS_METRICS (`fleet_obs_*` plus
-     `job_e2e_seconds`) pinned BOTH directions and as subsets of
-     their parent catalogs — cross-process trace propagation and the
-     snapshot protocol are exactly what a fleet post-mortem reads,
-     so they may neither go dark nor go stale.
-
-Run directly (exit 1 lists violations) or via tests/test_obs_lint.py.
+The 13 instrumentation-coverage checks that used to live here are now
+the `obs-coverage` family of the presto-lint suite (see
+docs/LINTING.md); this entry point, the `lint()` API, and the regexes
+are re-exported so existing callers and tests/test_obs_lint.py keep
+working.  Prefer `tools/presto_lint.py` — it runs this family plus
+the atomic-write / fence-discipline / lock-guard / trace-purity /
+import-hygiene families.
 """
 
 from __future__ import annotations
 
 import os
-import re
 import sys
-from typing import Dict, List, Set
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 if REPO not in sys.path:                  # direct `python tools/...`
     sys.path.insert(0, REPO)
 
-STAGE_RE = re.compile(r'timer\.mark\(\s*"([^"]+)"\s*\)')
-CHAOS_RE = re.compile(r'_chaos\(\s*cfg\s*,\s*"([^"]+)"')
-EMIT_RE = re.compile(r'events\.emit\(\s*"([^"]+)"')
-POINT_RE = re.compile(r'\._point\(\s*\n?\s*"([^"]+)"')
-CLUSTER_EVENT_RE = re.compile(r'\._?event\(\s*\n?\s*"([^"]+)"')
-STATUS_RE = re.compile(r'^\s+([A-Z_]+)\s*=\s*"([a-z-]+)"\s*$',
-                       re.MULTILINE)
-#: event kinds bound as ledger class attributes (the generic
-#: LeaseLedger emits via EV_* names; subclasses declare the literal
-#: vocabulary — see pipeline/leaseledger.py)
-EVENT_ATTR_RE = re.compile(r'^\s*EV_[A-Z_]+\s*=\s*"([^"]+)"',
-                           re.MULTILINE)
-METRIC_RE = re.compile(
-    r'\.(?:counter|gauge|histogram)\(\s*\n?\s*"([a-z0-9_]+)"')
-SPAN_RE = re.compile(r'\.span\(\s*\n?\s*"([^"]+)"')
-
-
-def _read(relpath: str) -> str:
-    with open(os.path.join(REPO, relpath)) as f:
-        return f.read()
-
-
-def _tree_sources(*roots: str) -> Dict[str, str]:
-    out: Dict[str, str] = {}
-    for root in roots:
-        for dirpath, _dirs, files in os.walk(os.path.join(REPO, root)):
-            for name in files:
-                if name.endswith(".py"):
-                    p = os.path.join(dirpath, name)
-                    rel = os.path.relpath(p, REPO)
-                    with open(p) as f:
-                        out[rel] = f.read()
-    return out
-
-
-def lint() -> List[str]:
-    """Run every check; returns a list of violation strings."""
-    from presto_tpu.obs import taxonomy
-
-    problems: List[str] = []
-    survey_src = _read("presto_tpu/pipeline/survey.py")
-
-    # 1. survey stages
-    stages = set(STAGE_RE.findall(survey_src))
-    for s in sorted(stages - taxonomy.SURVEY_STAGES):
-        problems.append(
-            "pipeline/survey.py: stage %r is not registered in "
-            "obs/taxonomy.SURVEY_STAGES (uninstrumented stage)" % s)
-    for s in sorted(taxonomy.SURVEY_STAGES - stages):
-        problems.append(
-            "obs/taxonomy.py: SURVEY_STAGES lists %r but "
-            "pipeline/survey.py never marks it" % s)
-
-    # 2. chaos kill points (both directions: the taxonomy IS the
-    # documented flight-recorder vocabulary)
-    points = set(CHAOS_RE.findall(survey_src))
-    for p in sorted(points - taxonomy.KILL_POINTS):
-        problems.append(
-            "pipeline/survey.py: kill point %r is not registered in "
-            "obs/taxonomy.KILL_POINTS" % p)
-    for p in sorted(taxonomy.KILL_POINTS - points):
-        problems.append(
-            "obs/taxonomy.py: KILL_POINTS lists %r but "
-            "pipeline/survey.py never fires it" % p)
-
-    # 2b. elastic-cluster kill points and events (parallel/elastic.py
-    # + pipeline/shardledger.py are the worker-loss recovery layer;
-    # its kill points and flight-recorder events are a registered
-    # vocabulary exactly like the survey's — since the ledger core
-    # moved to pipeline/leaseledger.py, shardledger declares its
-    # event kinds as EV_* class attributes, which count as emitted)
-    elastic_files = ("presto_tpu/parallel/elastic.py",
-                     "presto_tpu/pipeline/shardledger.py")
-    cpoints: Set[str] = set()
-    cevents: Set[str] = set()
-    for rel in elastic_files:
-        try:
-            src = _read(rel)
-        except OSError:
-            continue
-        cpoints |= set(POINT_RE.findall(src))
-        cevents |= set(CLUSTER_EVENT_RE.findall(src))
-        cevents |= set(EVENT_ATTR_RE.findall(src))
-    for p in sorted(cpoints - taxonomy.CLUSTER_KILL_POINTS):
-        problems.append(
-            "parallel/elastic.py: kill point %r is not registered in "
-            "obs/taxonomy.CLUSTER_KILL_POINTS" % p)
-    for p in sorted(taxonomy.CLUSTER_KILL_POINTS - cpoints):
-        problems.append(
-            "obs/taxonomy.py: CLUSTER_KILL_POINTS lists %r but the "
-            "elastic layer never fires it" % p)
-    for k in sorted(cevents - taxonomy.CLUSTER_EVENTS):
-        problems.append(
-            "elastic layer: event kind %r is not registered in "
-            "obs/taxonomy.CLUSTER_EVENTS" % k)
-    for k in sorted(taxonomy.CLUSTER_EVENTS - cevents):
-        problems.append(
-            "obs/taxonomy.py: CLUSTER_EVENTS lists %r but the "
-            "elastic layer never emits it" % k)
-
-    # 3. serve event kinds (the fleet and DAG modules share the serve
-    # event log, so their registered vocabularies — FLEET_EVENTS /
-    # DAG_EVENTS, pinned both directions by checks 10/12 — are
-    # admissible here too)
-    serve_srcs = _tree_sources("presto_tpu/serve")
-    serve_ok = (taxonomy.SERVE_EVENTS | taxonomy.FLEET_EVENTS
-                | taxonomy.DAG_EVENTS)
-    emitted: Set[str] = set()
-    for rel, src in sorted(serve_srcs.items()):
-        kinds = set(EMIT_RE.findall(src))
-        emitted |= kinds
-        for k in sorted(kinds - serve_ok):
-            problems.append(
-                "%s: event kind %r is not registered in "
-                "obs/taxonomy.SERVE_EVENTS, FLEET_EVENTS, or "
-                "DAG_EVENTS" % (rel, k))
-
-    # 4. every job lifecycle state announces itself (scoped to the
-    # JobStatus class body: queue.py also defines the Lanes constants,
-    # which are scheduling classes, not lifecycle states)
-    queue_src = serve_srcs.get("presto_tpu/serve/queue.py", "")
-    m = re.search(r'class JobStatus.*?(?=\nclass |\Z)', queue_src,
-                  re.DOTALL)
-    states = {v for _name, v in STATUS_RE.findall(m.group(0) if m
-                                                  else queue_src)}
-    for state in sorted(states):
-        kind = taxonomy.JOB_STATE_EVENTS.get(state)
-        if kind is None:
-            problems.append(
-                "serve/queue.py: JobStatus %r has no event mapping "
-                "in obs/taxonomy.JOB_STATE_EVENTS (silent scheduler "
-                "state transition)" % state)
-        elif kind not in emitted:
-            problems.append(
-                "serve layer: state %r maps to event %r which no "
-                "serve module emits" % (state, kind))
-
-    # 5. metric names vs the documented catalog
-    for rel, src in sorted(_tree_sources("presto_tpu",
-                                         "tools").items()):
-        for m in sorted(set(METRIC_RE.findall(src))):
-            if m not in taxonomy.METRICS:
-                problems.append(
-                    "%s: metric %r is not listed in "
-                    "obs/taxonomy.METRICS (undocumented metric)"
-                    % (rel, m))
-
-    # 6. tune layer: spans both ways + tune_* metric reverse direction
-    tune_srcs = _tree_sources("presto_tpu/tune")
-    try:
-        tune_srcs["presto_tpu/apps/tune.py"] = \
-            _read("presto_tpu/apps/tune.py")
-    except OSError:
-        pass
-    tspans: Set[str] = set()
-    tmetrics: Set[str] = set()
-    for rel, src in sorted(tune_srcs.items()):
-        spans = set(SPAN_RE.findall(src))
-        tspans |= spans
-        tmetrics |= set(METRIC_RE.findall(src))
-        for s in sorted(spans - taxonomy.TUNE_SPANS):
-            problems.append(
-                "%s: span %r is not registered in "
-                "obs/taxonomy.TUNE_SPANS (uninstrumented tuning "
-                "path)" % (rel, s))
-    for s in sorted(taxonomy.TUNE_SPANS - tspans):
-        problems.append(
-            "obs/taxonomy.py: TUNE_SPANS lists %r but the tune layer "
-            "never opens it" % s)
-    cataloged_tune = {m for m in taxonomy.METRICS
-                      if m.startswith("tune_")}
-    for m in sorted(cataloged_tune - tmetrics):
-        problems.append(
-            "obs/taxonomy.py: METRICS lists %r but the tune layer "
-            "never registers it" % m)
-
-    # 7. streaming layer: spans + events both ways, stream_* metric
-    # reverse direction (forward is check 5)
-    stream_srcs = _tree_sources("presto_tpu/stream")
-    sspans: Set[str] = set()
-    sevents: Set[str] = set()
-    smetrics: Set[str] = set()
-    for rel, src in sorted(stream_srcs.items()):
-        spans = set(SPAN_RE.findall(src))
-        sspans |= spans
-        sevents |= set(EMIT_RE.findall(src))
-        smetrics |= set(METRIC_RE.findall(src))
-        for s in sorted(spans - taxonomy.STREAM_SPANS):
-            problems.append(
-                "%s: span %r is not registered in "
-                "obs/taxonomy.STREAM_SPANS (uninstrumented streaming "
-                "path)" % (rel, s))
-    for s in sorted(taxonomy.STREAM_SPANS - sspans):
-        problems.append(
-            "obs/taxonomy.py: STREAM_SPANS lists %r but the stream "
-            "layer never opens it" % s)
-    for k in sorted(sevents - taxonomy.STREAM_EVENTS):
-        problems.append(
-            "stream layer: event kind %r is not registered in "
-            "obs/taxonomy.STREAM_EVENTS" % k)
-    for k in sorted(taxonomy.STREAM_EVENTS - sevents):
-        problems.append(
-            "obs/taxonomy.py: STREAM_EVENTS lists %r but the stream "
-            "layer never emits it" % k)
-    cataloged_stream = {m for m in taxonomy.METRICS
-                        if m.startswith("stream_")}
-    for m in sorted(cataloged_stream - smetrics):
-        problems.append(
-            "obs/taxonomy.py: METRICS lists %r but the stream layer "
-            "never registers it" % m)
-
-    # 8. fused pipeline: seam spans both ways, survey_fused_* metric
-    # reverse direction (forward is check 5)
-    try:
-        fusion_src = _read("presto_tpu/pipeline/fusion.py")
-    except OSError:
-        fusion_src = ""
-    fspans = {s for s in SPAN_RE.findall(fusion_src)
-              if s.startswith("pipeline:")}
-    fmetrics = set(METRIC_RE.findall(fusion_src))
-    for s in sorted(fspans - taxonomy.FUSION_SPANS):
-        problems.append(
-            "pipeline/fusion.py: span %r is not registered in "
-            "obs/taxonomy.FUSION_SPANS (uninstrumented fused path)"
-            % s)
-    for s in sorted(taxonomy.FUSION_SPANS - fspans):
-        problems.append(
-            "obs/taxonomy.py: FUSION_SPANS lists %r but the fusion "
-            "layer never opens it" % s)
-    cataloged_fused = {m for m in taxonomy.METRICS
-                       if m.startswith("survey_fused_")}
-    for m in sorted(cataloged_fused - fmetrics):
-        problems.append(
-            "obs/taxonomy.py: METRICS lists %r but the fusion layer "
-            "never registers it" % m)
-
-    # 9. DM-sharded seam: spans/kill points/metrics both directions
-    # (the sharded sets must also be subsets of their parent catalogs,
-    # so a rename cannot leave a dangling sharded entry)
-    for s in sorted(taxonomy.SHARDED_FUSION_SPANS
-                    - taxonomy.FUSION_SPANS):
-        problems.append(
-            "obs/taxonomy.py: SHARDED_FUSION_SPANS lists %r which is "
-            "not in FUSION_SPANS" % s)
-    for p in sorted(taxonomy.SHARDED_KILL_POINTS
-                    - taxonomy.KILL_POINTS):
-        problems.append(
-            "obs/taxonomy.py: SHARDED_KILL_POINTS lists %r which is "
-            "not in KILL_POINTS" % p)
-    for m in sorted(taxonomy.SHARDED_FUSION_METRICS
-                    - taxonomy.METRICS):
-        problems.append(
-            "obs/taxonomy.py: SHARDED_FUSION_METRICS lists %r which "
-            "is not in METRICS" % m)
-    for s in sorted(taxonomy.SHARDED_FUSION_SPANS - fspans):
-        problems.append(
-            "obs/taxonomy.py: SHARDED_FUSION_SPANS lists %r but the "
-            "fusion layer never opens it" % s)
-    for s in sorted({x for x in fspans if "shard" in x}
-                    - taxonomy.SHARDED_FUSION_SPANS):
-        problems.append(
-            "pipeline/fusion.py: sharded span %r is not registered "
-            "in obs/taxonomy.SHARDED_FUSION_SPANS" % s)
-    for p in sorted(taxonomy.SHARDED_KILL_POINTS - points):
-        problems.append(
-            "obs/taxonomy.py: SHARDED_KILL_POINTS lists %r but "
-            "pipeline/survey.py never fires it" % p)
-    for p in sorted({x for x in points if "shard" in x}
-                    - taxonomy.SHARDED_KILL_POINTS):
-        problems.append(
-            "pipeline/survey.py: sharded kill point %r is not "
-            "registered in obs/taxonomy.SHARDED_KILL_POINTS" % p)
-    for m in sorted(taxonomy.SHARDED_FUSION_METRICS - fmetrics):
-        problems.append(
-            "obs/taxonomy.py: SHARDED_FUSION_METRICS lists %r but "
-            "the fusion layer never registers it" % m)
-    for m in sorted({x for x in fmetrics
-                     if x.startswith("survey_fused_shard_")}
-                    - taxonomy.SHARDED_FUSION_METRICS):
-        problems.append(
-            "pipeline/fusion.py: sharded metric %r is not registered "
-            "in obs/taxonomy.SHARDED_FUSION_METRICS" % m)
-
-    # 10. fleet serving (serve/jobledger.py + fleet.py + router.py):
-    # FLEET_EVENTS and the fleet_* metrics are pinned BOTH directions
-    # — the fleet recovery path (lease, fence, reap, shed, quota) is
-    # exactly the code that runs while a replica is dying, so its
-    # telemetry may neither go dark nor go stale.  Event kinds count
-    # whether emitted literally (events.emit / obs.event) or bound as
-    # LeaseLedger EV_* class attributes.
-    fleet_files = ("presto_tpu/serve/jobledger.py",
-                   "presto_tpu/serve/fleet.py",
-                   "presto_tpu/serve/router.py")
-    fl_events: Set[str] = set()
-    fl_metrics: Set[str] = set()
-    for rel in fleet_files:
-        try:
-            src = _read(rel)
-        except OSError:
-            continue
-        fl_events |= set(EMIT_RE.findall(src))
-        fl_events |= set(CLUSTER_EVENT_RE.findall(src))
-        fl_events |= set(EVENT_ATTR_RE.findall(src))
-        fl_metrics |= set(METRIC_RE.findall(src))
-    for k in sorted(taxonomy.FLEET_EVENTS - fl_events):
-        problems.append(
-            "obs/taxonomy.py: FLEET_EVENTS lists %r but the fleet "
-            "layer never emits it" % k)
-    for k in sorted(fl_events - taxonomy.FLEET_EVENTS
-                    - taxonomy.SERVE_EVENTS - taxonomy.DAG_EVENTS):
-        problems.append(
-            "fleet layer: event kind %r is not registered in "
-            "obs/taxonomy.FLEET_EVENTS" % k)
-    for m in sorted(taxonomy.FLEET_METRICS - taxonomy.METRICS):
-        problems.append(
-            "obs/taxonomy.py: FLEET_METRICS lists %r which is not "
-            "in METRICS" % m)
-    for m in sorted(taxonomy.FLEET_METRICS - fl_metrics):
-        problems.append(
-            "obs/taxonomy.py: FLEET_METRICS lists %r but the fleet "
-            "layer never registers it" % m)
-    for m in sorted({x for x in fl_metrics
-                     if x.startswith("fleet_")}
-                    - taxonomy.FLEET_METRICS):
-        problems.append(
-            "fleet layer: metric %r is not registered in "
-            "obs/taxonomy.FLEET_METRICS" % m)
-
-    # 11. serve-layer spans both directions (the stacked batch
-    # executor's cross-job span is the one covering the serving
-    # tier's biggest device calls — it may neither go dark nor stay
-    # in the catalog after a rename)
-    svspans: Set[str] = set()
-    for rel, src in sorted(serve_srcs.items()):
-        spans = set(SPAN_RE.findall(src))
-        svspans |= spans
-        for s in sorted(spans - taxonomy.SERVE_SPANS):
-            problems.append(
-                "%s: span %r is not registered in "
-                "obs/taxonomy.SERVE_SPANS (uninstrumented serve "
-                "path)" % (rel, s))
-    for s in sorted(taxonomy.SERVE_SPANS - svspans):
-        problems.append(
-            "obs/taxonomy.py: SERVE_SPANS lists %r but the serve "
-            "layer never opens it" % s)
-
-    # 12. discovery DAGs (serve/dag.py + jobledger.py + router.py +
-    # fleet.py): DAG_EVENTS / DAG_SPANS / DAG_METRICS pinned BOTH
-    # directions — the dependency-aware job graph is exactly the code
-    # that runs while a mid-graph replica is dying (fenced fan-out,
-    # cascade failure), so its telemetry may neither go dark nor go
-    # stale; the dag sets must also be subsets of their parent
-    # catalogs so a rename cannot leave a dangling entry.
-    dag_files = ("presto_tpu/serve/dag.py",
-                 "presto_tpu/serve/jobledger.py",
-                 "presto_tpu/serve/router.py",
-                 "presto_tpu/serve/fleet.py")
-    dg_events: Set[str] = set()
-    dg_spans: Set[str] = set()
-    dg_metrics: Set[str] = set()
-    for rel in dag_files:
-        try:
-            src = _read(rel)
-        except OSError:
-            continue
-        dg_events |= set(EMIT_RE.findall(src))
-        dg_events |= set(CLUSTER_EVENT_RE.findall(src))
-        dg_spans |= set(SPAN_RE.findall(src))
-        dg_metrics |= set(METRIC_RE.findall(src))
-    for s in sorted(taxonomy.DAG_SPANS - taxonomy.SERVE_SPANS):
-        problems.append(
-            "obs/taxonomy.py: DAG_SPANS lists %r which is not in "
-            "SERVE_SPANS" % s)
-    for m in sorted(taxonomy.DAG_METRICS - taxonomy.METRICS):
-        problems.append(
-            "obs/taxonomy.py: DAG_METRICS lists %r which is not in "
-            "METRICS" % m)
-    for k in sorted(taxonomy.DAG_EVENTS - dg_events):
-        problems.append(
-            "obs/taxonomy.py: DAG_EVENTS lists %r but the dag layer "
-            "never emits it" % k)
-    for k in sorted({x for x in dg_events if x.startswith("dag-")}
-                    - taxonomy.DAG_EVENTS):
-        problems.append(
-            "dag layer: event kind %r is not registered in "
-            "obs/taxonomy.DAG_EVENTS" % k)
-    for s in sorted(taxonomy.DAG_SPANS - dg_spans):
-        problems.append(
-            "obs/taxonomy.py: DAG_SPANS lists %r but the dag layer "
-            "never opens it" % s)
-    for s in sorted({x for x in dg_spans
-                     if x.startswith("serve:dag")}
-                    - taxonomy.DAG_SPANS):
-        problems.append(
-            "dag layer: span %r is not registered in "
-            "obs/taxonomy.DAG_SPANS" % s)
-    for m in sorted(taxonomy.DAG_METRICS - dg_metrics):
-        problems.append(
-            "obs/taxonomy.py: DAG_METRICS lists %r but the dag "
-            "layer never registers it" % m)
-    for m in sorted({x for x in dg_metrics if x.startswith("dag_")}
-                    - taxonomy.DAG_METRICS):
-        problems.append(
-            "dag layer: metric %r is not registered in "
-            "obs/taxonomy.DAG_METRICS" % m)
-
-    # 13. fleet-wide observability (serve/fleet.py + serve/router.py
-    # + obs/fleetagg.py): the `fleet:` span prefix, the snapshot/
-    # chaos event kinds, and the fleet_obs_*/job_e2e_seconds metrics
-    # pinned BOTH directions + subset-of-parent — cross-process trace
-    # propagation and the snapshot protocol are the post-mortem's
-    # input, so they may neither go dark nor go stale.
-    fo_files = ("presto_tpu/serve/fleet.py",
-                "presto_tpu/serve/router.py",
-                "presto_tpu/obs/fleetagg.py")
-    fo_events: Set[str] = set()
-    fo_spans: Set[str] = set()
-    fo_metrics: Set[str] = set()
-    for rel in fo_files:
-        try:
-            src = _read(rel)
-        except OSError:
-            continue
-        fo_events |= set(EMIT_RE.findall(src))
-        fo_events |= set(CLUSTER_EVENT_RE.findall(src))
-        fo_spans |= set(SPAN_RE.findall(src))
-        fo_metrics |= set(METRIC_RE.findall(src))
-    for s in sorted(taxonomy.FLEET_SPANS - taxonomy.SERVE_SPANS):
-        problems.append(
-            "obs/taxonomy.py: FLEET_SPANS lists %r which is not in "
-            "SERVE_SPANS" % s)
-    for s in sorted(taxonomy.FLEET_SPANS - fo_spans):
-        problems.append(
-            "obs/taxonomy.py: FLEET_SPANS lists %r but the fleet "
-            "obs layer never opens it" % s)
-    for s in sorted({x for x in fo_spans if x.startswith("fleet:")}
-                    - taxonomy.FLEET_SPANS):
-        problems.append(
-            "fleet obs layer: span %r is not registered in "
-            "obs/taxonomy.FLEET_SPANS" % s)
-    for k in sorted(taxonomy.FLEET_OBS_EVENTS
-                    - taxonomy.FLEET_EVENTS):
-        problems.append(
-            "obs/taxonomy.py: FLEET_OBS_EVENTS lists %r which is "
-            "not in FLEET_EVENTS" % k)
-    for k in sorted(taxonomy.FLEET_OBS_EVENTS - fo_events):
-        problems.append(
-            "obs/taxonomy.py: FLEET_OBS_EVENTS lists %r but the "
-            "fleet obs layer never emits it" % k)
-    for k in sorted({x for x in fo_events
-                     if x.startswith("fleet-obs-")
-                     or x == "fleet-chaos-point"}
-                    - taxonomy.FLEET_OBS_EVENTS):
-        problems.append(
-            "fleet obs layer: event kind %r is not registered in "
-            "obs/taxonomy.FLEET_OBS_EVENTS" % k)
-    for m in sorted(taxonomy.FLEET_OBS_METRICS - taxonomy.METRICS):
-        problems.append(
-            "obs/taxonomy.py: FLEET_OBS_METRICS lists %r which is "
-            "not in METRICS" % m)
-    for m in sorted(taxonomy.FLEET_OBS_METRICS - fo_metrics):
-        problems.append(
-            "obs/taxonomy.py: FLEET_OBS_METRICS lists %r but the "
-            "fleet obs layer never registers it" % m)
-    for m in sorted({x for x in fo_metrics
-                     if x.startswith("fleet_obs_")
-                     or x == "job_e2e_seconds"}
-                    - taxonomy.FLEET_OBS_METRICS):
-        problems.append(
-            "fleet obs layer: metric %r is not registered in "
-            "obs/taxonomy.FLEET_OBS_METRICS" % m)
-    return problems
-
-
-def main(argv=None) -> int:
-    problems = lint()
-    if problems:
-        print("obs_lint: %d instrumentation-coverage violation(s):"
-              % len(problems))
-        for p in problems:
-            print("  - %s" % p)
-        return 1
-    print("obs_lint: instrumentation coverage OK "
-          "(stages, kill points, serve events, job states, metrics)")
-    return 0
-
+from presto_tpu.lint.obscoverage import (  # noqa: E402,F401
+    CHAOS_RE,
+    CLUSTER_EVENT_RE,
+    EMIT_RE,
+    EVENT_ATTR_RE,
+    METRIC_RE,
+    POINT_RE,
+    SPAN_RE,
+    STAGE_RE,
+    STATUS_RE,
+    lint,
+    main,
+)
 
 if __name__ == "__main__":
     sys.exit(main())
