@@ -192,12 +192,46 @@ def collect_fleet(fleetdir: str,
     if agg["replicas"]:
         merged = agg["merged"]
         info["snapshots"] = agg["replicas"]
+        info["stale_snapshots"] = agg.get("stale_replicas", [])
         info["job_e2e"] = fleetagg.rollup(merged,
                                           "job_e2e_seconds",
                                           "phase")
         info["latency"] = fleetagg.rollup(merged,
                                           "latency_seconds",
                                           "name")
+
+    # SLO observatory: device-seconds usage, per-tenant budget/burn,
+    # and the advisory /scale signal — recomputed from the durable
+    # usage ledger + persisted specs, so the report agrees with the
+    # router byte-for-byte (obs/slo.py)
+    from presto_tpu.obs import slo as slolib
+    usage_rows = ledger.usage.rows()
+    now = time.time()
+    if usage_rows:
+        info["usage"] = slolib.usage_rollup(usage_rows)
+    specs = slolib.load_specs(fleetdir)
+    evals = {}
+    if specs:
+        evals = {spec.tenant: slolib.evaluate(spec, usage_rows, now)
+                 for spec in specs}
+        spark = {}
+        for spec in specs:
+            w = spec.windows[0]
+            spark[spec.tenant] = {
+                "window_s": w.fast_s,
+                "burn": slolib.burn_series(
+                    spec, usage_rows, now, w.fast_s,
+                    max(w.fast_s / 4.0, 1e-3), n=16),
+            }
+        info["slo"] = {"specs": [s.to_dict() for s in specs],
+                       "tenants": evals, "sparklines": spark}
+    if usage_rows or specs:
+        backlog = [row.get("bucket")
+                   for row in jobs.values()
+                   if row.get("state") in ("pending", "leased")]
+        ready = len(ledger.alive_hosts())
+        info["scale"] = slolib.scale_advice(backlog, usage_rows,
+                                            evals, ready, now=now)
 
     # cross-process traces joined by trace id
     spans = fleetagg.load_fleet_spans(fleetdir)
@@ -271,11 +305,18 @@ def render_fleet(info: dict, file=None) -> None:
             " (tombstoned)" if h["tombstoned"] else ""))
 
     for name, snap in (info.get("snapshots") or {}).items():
-        w("  snapshot %-15s ts=%s%s"
+        w("  snapshot %-15s ts=%s%s%s"
           % (name,
              time.strftime("%H:%M:%S",
                            time.localtime(snap.get("ts", 0))),
-             " (tombstone)" if snap.get("tombstone") else ""))
+             " (tombstone)" if snap.get("tombstone") else "",
+             "  !! STALE (%.0fs old, >3x publish interval)"
+             % snap.get("age_s", 0.0) if snap.get("stale") else ""))
+    if info.get("stale_snapshots"):
+        w("  !! %d stale snapshot(s) merged: %s — the fleet view "
+          "is partially out of date"
+          % (len(info["stale_snapshots"]),
+             ", ".join(info["stale_snapshots"])))
 
     e2e = info.get("job_e2e")
     if e2e:
@@ -284,6 +325,60 @@ def render_fleet(info: dict, file=None) -> None:
         for phase, st in e2e.items():
             w("  %-12s n=%-5d p50=%8.3fs  p99=%8.3fs"
               % (phase, st["count"], st["p50"], st["p99"]))
+
+    usage = info.get("usage")
+    if usage:
+        w()
+        w("Usage (usage.jsonl): %.3f device-seconds over %d "
+          "committed job(s)"
+          % (usage["total_device_seconds"], usage["total_jobs"]))
+        for tenant, ent in usage["tenants"].items():
+            w("  %-16s %10.3f dev-s  %4d job(s)  %d failed"
+              % (tenant or "(default)", ent["device_seconds"],
+                 ent["jobs"], ent["failed"]))
+            for bkt, bent in sorted(ent["buckets"].items()):
+                w("      bucket %-24s %10.3f dev-s  %d job(s)"
+                  % ((bkt or "(none)")[:24],
+                     bent["device_seconds"], bent["jobs"]))
+
+    slo_info = info.get("slo")
+    if slo_info:
+        w()
+        w("SLO observatory (slo.json): %d tenant spec(s)"
+          % len(slo_info["specs"]))
+        for tenant, ev in sorted(slo_info["tenants"].items()):
+            w("  %-16s objective=%g%s  events=%d bad=%d  "
+              "budget remaining %.1f%%%s"
+              % (tenant, ev["objective"],
+                 " lat<%gs" % ev["latency_s"]
+                 if ev.get("latency_s") else "",
+                 ev["events"], ev["bad"],
+                 100.0 * ev["budget_remaining"],
+                 "  !! ALERT" if ev["alert"] else ""))
+            for win in ev["windows"]:
+                w("      %-12s burn fast=%-8.2f slow=%-8.2f "
+                  "(threshold %g)%s"
+                  % (win["window"], win["fast_burn"],
+                     win["slow_burn"], win["threshold"],
+                     "  ALERTING" if win["alerting"] else ""))
+            sp = (slo_info.get("sparklines") or {}).get(tenant)
+            if sp and any(sp["burn"]):
+                from presto_tpu.obs.slo import sparkline
+                w("      burn (trailing %gs windows)  %s  max %.1f"
+                  % (sp["window_s"], sparkline(sp["burn"]),
+                     max(sp["burn"])))
+
+    scale = info.get("scale")
+    if scale:
+        w()
+        w("Scale advisory: wanted_replicas=%d  (%s)"
+          % (scale["wanted_replicas"], scale["reason"]))
+        inp = scale["inputs"]
+        w("  backlog %d job(s) = %.1f device-s   capacity "
+          "%.2f/replica   ready %d   SLO pressure: %s"
+          % (inp["backlog_jobs"], inp["backlog_device_seconds"],
+             inp["per_replica_capacity"], inp["ready_replicas"],
+             ", ".join(inp["slo_pressure"]) or "none"))
 
     tr = info.get("traces")
     if tr:

@@ -24,7 +24,7 @@ Check families (see docs/LINTING.md for the catalog):
                     points never call time/random/host-I/O
   import-hygiene    no unused or duplicate imports (the in-tree twin
                     of the pyproject ruff config)
-  obs-coverage      the 13 instrumentation-coverage checks formerly in
+  obs-coverage      the 14 instrumentation-coverage checks formerly in
                     tools/obs_lint.py (thin shim kept there)
 
 Use `run_lint()` for the full suite, or `core.run_checks()` for a

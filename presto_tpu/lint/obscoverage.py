@@ -1,4 +1,4 @@
-"""obs-coverage: the instrumentation-coverage contract (13 checks).
+"""obs-coverage: the instrumentation-coverage contract (14 checks).
 
 Formerly ``tools/obs_lint.py`` (a thin shim remains there for the
 historical entry point); now the fifth presto-lint family.  The
@@ -61,7 +61,14 @@ code path cannot ship silently:
   13. fleet-wide observability (serve/fleet.py + serve/router.py +
      obs/fleetagg.py): FLEET_SPANS / FLEET_OBS_EVENTS /
      FLEET_OBS_METRICS pinned BOTH directions and as subsets of
-     their parent catalogs.
+     their parent catalogs;
+  14. the SLO observatory (obs/slo.py + serve/jobledger.py +
+     serve/router.py): SLO_METRICS / SLO_EVENTS / SLO_SPANS pinned
+     BOTH directions (and as subsets of their parent catalogs) — the
+     usage metering at the fence-checked commit and the burn/scale
+     decision signals are the contract future control-plane PRs
+     (autoscaler, device-seconds admission) inherit, so they may
+     neither go dark nor go stale.
 
 Run via tools/presto_lint.py (exit-1 CLI over every family), the
 legacy tools/obs_lint.py shim, or tests/test_obs_lint.py.
@@ -190,7 +197,7 @@ def lint(root: Optional[str] = None) -> List[str]:
     # admissible here too)
     serve_srcs = _tree_sources(root, "presto_tpu/serve")
     serve_ok = (taxonomy.SERVE_EVENTS | taxonomy.FLEET_EVENTS
-                | taxonomy.DAG_EVENTS)
+                | taxonomy.DAG_EVENTS | taxonomy.SLO_EVENTS)
     emitted: Set[str] = set()
     for rel, src in sorted(serve_srcs.items()):
         kinds = set(EMIT_RE.findall(src))
@@ -198,8 +205,8 @@ def lint(root: Optional[str] = None) -> List[str]:
         for k in sorted(kinds - serve_ok):
             problems.append(
                 "%s: event kind %r is not registered in "
-                "obs/taxonomy.SERVE_EVENTS, FLEET_EVENTS, or "
-                "DAG_EVENTS" % (rel, k))
+                "obs/taxonomy.SERVE_EVENTS, FLEET_EVENTS, "
+                "DAG_EVENTS, or SLO_EVENTS" % (rel, k))
 
     # 4. every job lifecycle state announces itself (scoped to the
     # JobStatus class body: queue.py also defines the Lanes constants,
@@ -393,7 +400,8 @@ def lint(root: Optional[str] = None) -> List[str]:
             "obs/taxonomy.py: FLEET_EVENTS lists %r but the fleet "
             "layer never emits it" % k)
     for k in sorted(fl_events - taxonomy.FLEET_EVENTS
-                    - taxonomy.SERVE_EVENTS - taxonomy.DAG_EVENTS):
+                    - taxonomy.SERVE_EVENTS - taxonomy.DAG_EVENTS
+                    - taxonomy.SLO_EVENTS):
         problems.append(
             "fleet layer: event kind %r is not registered in "
             "obs/taxonomy.FLEET_EVENTS" % k)
@@ -557,6 +565,63 @@ def lint(root: Optional[str] = None) -> List[str]:
         problems.append(
             "fleet obs layer: metric %r is not registered in "
             "obs/taxonomy.FLEET_OBS_METRICS" % name)
+
+    # 14. the SLO observatory (obs/slo.py + serve/jobledger.py +
+    # serve/router.py): SLO_METRICS / SLO_EVENTS / SLO_SPANS pinned
+    # BOTH directions + subset-of-parent — the usage metering at the
+    # fence-checked commit and the burn/scale decision signals are
+    # the contract future control-plane PRs inherit.
+    slo_files = ("presto_tpu/obs/slo.py",
+                 "presto_tpu/serve/jobledger.py",
+                 "presto_tpu/serve/router.py")
+    sl_events: Set[str] = set()
+    sl_spans: Set[str] = set()
+    sl_metrics: Set[str] = set()
+    for rel in slo_files:
+        try:
+            src = _read(rel, root)
+        except OSError:
+            continue
+        sl_events |= set(EMIT_RE.findall(src))
+        sl_events |= set(CLUSTER_EVENT_RE.findall(src))
+        sl_spans |= set(SPAN_RE.findall(src))
+        sl_metrics |= set(METRIC_RE.findall(src))
+    for s in sorted(taxonomy.SLO_SPANS - taxonomy.SERVE_SPANS):
+        problems.append(
+            "obs/taxonomy.py: SLO_SPANS lists %r which is not in "
+            "SERVE_SPANS" % s)
+    for s in sorted(taxonomy.SLO_SPANS - sl_spans):
+        problems.append(
+            "obs/taxonomy.py: SLO_SPANS lists %r but the slo layer "
+            "never opens it" % s)
+    for s in sorted({x for x in sl_spans if x.startswith("slo:")}
+                    - taxonomy.SLO_SPANS):
+        problems.append(
+            "slo layer: span %r is not registered in "
+            "obs/taxonomy.SLO_SPANS" % s)
+    for k in sorted(taxonomy.SLO_EVENTS - sl_events):
+        problems.append(
+            "obs/taxonomy.py: SLO_EVENTS lists %r but the slo layer "
+            "never emits it" % k)
+    for k in sorted({x for x in sl_events if x.startswith("slo-")}
+                    - taxonomy.SLO_EVENTS):
+        problems.append(
+            "slo layer: event kind %r is not registered in "
+            "obs/taxonomy.SLO_EVENTS" % k)
+    for name in sorted(taxonomy.SLO_METRICS - taxonomy.METRICS):
+        problems.append(
+            "obs/taxonomy.py: SLO_METRICS lists %r which is not in "
+            "METRICS" % name)
+    for name in sorted(taxonomy.SLO_METRICS - sl_metrics):
+        problems.append(
+            "obs/taxonomy.py: SLO_METRICS lists %r but the slo "
+            "layer never registers it" % name)
+    for name in sorted({x for x in sl_metrics
+                        if x.startswith("slo_")}
+                       - taxonomy.SLO_METRICS):
+        problems.append(
+            "slo layer: metric %r is not registered in "
+            "obs/taxonomy.SLO_METRICS" % name)
     return problems
 
 

@@ -59,6 +59,17 @@ OBS_DIRNAME = "obs"
 
 SNAPSHOT_VERSION = 1
 
+#: assumed publish cadence for snapshots that predate the
+#: `interval_s` field (FleetConfig.snapshot_s default)
+DEFAULT_SNAPSHOT_INTERVAL = 2.0
+
+#: a live snapshot older than this many publish intervals is STALE:
+#: its publisher missed several heartbeat-paced publishes, so its
+#: counters under-report and its gauges describe the past —
+#: `aggregate()` still merges it (that work happened) but flags it,
+#: and /fleet/metrics + presto-report -fleet surface the warning
+STALE_INTERVALS = 3.0
+
 
 def obs_dir(fleetdir: str) -> str:
     return os.path.join(os.path.abspath(fleetdir), OBS_DIRNAME)
@@ -85,12 +96,15 @@ def replica_dump_dir(fleetdir: str, replica: str) -> str:
 
 def publish_snapshot(fleetdir: str, replica: str, obs,
                      tombstone: bool = False,
-                     now: Optional[float] = None) -> str:
+                     now: Optional[float] = None,
+                     interval: Optional[float] = None) -> str:
     """Atomically publish one replica's full registry state.  A
     tombstone snapshot is the drain-time final word — the metric twin
     of the heartbeat tombstone: aggregation keeps the replica's
     counters (that work happened) but drops its gauges (stale
-    point-in-time facts)."""
+    point-in-time facts).  ``interval`` records the publisher's
+    cadence so `aggregate()` can flag a snapshot that missed
+    STALE_INTERVALS publishes as stale."""
     path = snapshot_path(fleetdir, replica)
     os.makedirs(os.path.dirname(path), exist_ok=True)
     payload = {
@@ -99,6 +113,8 @@ def publish_snapshot(fleetdir: str, replica: str, obs,
         "pid": os.getpid(),
         "ts": time.time() if now is None else now,
         "tombstone": bool(tombstone),
+        "interval_s": float(interval if interval
+                            else DEFAULT_SNAPSHOT_INTERVAL),
         "service": getattr(getattr(obs, "cfg", None), "service",
                            "presto_tpu"),
         "metrics": obs.metrics.export_state(),
@@ -349,13 +365,34 @@ def render_prometheus(merged: dict) -> str:
     return "\n".join(lines) + "\n"
 
 
-def aggregate(fleetdir: str) -> dict:
+def snapshot_is_stale(snap: dict,
+                      now: Optional[float] = None) -> bool:
+    """A LIVE snapshot older than STALE_INTERVALS publish intervals:
+    its publisher stopped publishing without tombstoning (wedged
+    heartbeat loop, paused process, dead-but-unreaped replica).  A
+    tombstone is never stale — it is the intentional final word."""
+    if snap.get("tombstone"):
+        return False
+    now = time.time() if now is None else now
+    interval = float(snap.get("interval_s")
+                     or DEFAULT_SNAPSHOT_INTERVAL)
+    return now - float(snap.get("ts") or 0.0) \
+        > STALE_INTERVALS * interval
+
+
+def aggregate(fleetdir: str, now: Optional[float] = None) -> dict:
     """One full aggregation pass over a fleet directory: load every
     snapshot, merge (tombstoned replicas keep their counters and
     histograms — that work happened — but contribute no gauges), and
-    report per-replica freshness."""
+    report per-replica freshness.  Stale snapshots (older than 3x
+    their publish interval, not tombstoned) still merge — their
+    counters are real work — but are flagged per replica and in the
+    top-level ``stale_replicas`` list so consumers see the fleet
+    view is partially out of date instead of silently trusting it."""
+    now = time.time() if now is None else now
     snaps = load_snapshots(fleetdir)
     states: Dict[str, dict] = {}
+    stale: List[str] = []
     for name, snap in snaps.items():
         state = snap.get("metrics") or {}
         if snap.get("tombstone"):
@@ -363,14 +400,20 @@ def aggregate(fleetdir: str) -> dict:
                     (state.get("families") or {}).items()
                     if f.get("kind") != "gauge"}
             state = {"families": fams}
+        if snapshot_is_stale(snap, now):
+            stale.append(name)
         states[name] = state
     return {
         "replicas": {
             name: {"ts": snap.get("ts", 0.0),
                    "pid": snap.get("pid"),
                    "service": snap.get("service"),
-                   "tombstone": bool(snap.get("tombstone"))}
+                   "tombstone": bool(snap.get("tombstone")),
+                   "stale": name in stale,
+                   "age_s": round(max(now - float(snap.get("ts")
+                                                  or 0.0), 0.0), 3)}
             for name, snap in sorted(snaps.items())},
+        "stale_replicas": sorted(stale),
         "merged": merge_states(states),
     }
 

@@ -159,6 +159,37 @@ FLEET_OBS_METRICS = frozenset({
     "job_e2e_seconds",
 })
 
+#: SLO-observatory event kinds — the decision-signal vocabulary of
+#: the serving-economics layer (obs/slo.py evaluation surfaced by
+#: serve/router.py): a multi-window burn-rate alert's rising edge,
+#: and every change of the advisory wanted-replica count — the event
+#: stream a supervisor (or tools/fleet_chaos.py in reverse) replays
+#: decisions from.  Enforced BOTH directions by obs_lint check 14.
+SLO_EVENTS = frozenset({
+    "slo-burn-alert",
+    "slo-scale-advice",
+})
+
+#: SLO-observatory span names (subset of SERVE_SPANS; check 14 both
+#: directions): the router's per-pass evaluation over the durable
+#: usage ledger
+SLO_SPANS = frozenset({
+    "slo:evaluate",
+})
+
+#: SLO-observatory metrics (obs_lint check 14, both directions,
+#: subset of METRICS): device-seconds metering at the fence-checked
+#: commit (serve/jobledger.py) and the router's budget/burn/scale
+#: gauges — the signals the remaining control-plane actuation
+#: (autoscaler, device-seconds admission) will consume
+SLO_METRICS = frozenset({
+    "slo_device_seconds_total",
+    "slo_error_budget_remaining",
+    "slo_burn_rate",
+    "slo_burn_alerts_total",
+    "slo_wanted_replicas",
+})
+
 #: streaming-layer event kinds — every `events.emit("<kind>", ...)`
 #: in presto_tpu/stream/ (enforced both directions by obs_lint check
 #: 7: the live trigger path may not emit unregistered kinds, and the
@@ -189,6 +220,7 @@ SERVE_SPANS = frozenset({
     "serve:dag-node",
     "fleet:submit",
     "fleet:dag-submit",
+    "slo:evaluate",
 })
 
 #: discovery-DAG event kinds — the dependency-aware job-graph
@@ -396,6 +428,14 @@ METRICS = frozenset({
     "fleet_obs_snapshots_total",
     "fleet_obs_aggregations_total",
     "job_e2e_seconds",
+    # SLO observatory (serve/jobledger.py usage metering +
+    # serve/router.py budget/burn/scale signals); pinned both
+    # directions by obs_lint check 14 via SLO_METRICS
+    "slo_device_seconds_total",
+    "slo_error_budget_remaining",
+    "slo_burn_rate",
+    "slo_burn_alerts_total",
+    "slo_wanted_replicas",
     # streaming search (presto_tpu/stream); every stream_* name here
     # must be registered by the stream layer (obs_lint check 7)
     "stream_blocks_total",

@@ -322,7 +322,8 @@ class FleetReplica:
             fleetagg.publish_snapshot(self.cfg.fleetdir,
                                       self.replica,
                                       self.service.obs,
-                                      tombstone=tombstone)
+                                      tombstone=tombstone,
+                                      interval=self.cfg.snapshot_s)
             self._c_snapshots.inc()
             self.service.obs.event("fleet-obs-snapshot",
                                    replica=self.replica,
@@ -539,8 +540,11 @@ class FleetReplica:
                 self._drop(job_id)
             elif job.status in (JobStatus.FAILED, JobStatus.TIMEOUT):
                 try:
-                    self.ledger.fail_terminal(lease, self.replica,
-                                              job.error)
+                    self.ledger.fail_terminal(
+                        lease, self.replica, job.error,
+                        usage={"phases": self._phases(lease, job,
+                                                      now),
+                               "replica": self.replica})
                     self._c_failed.inc()
                 except self.ledger.STALE:
                     self._c_stale.inc()
@@ -580,6 +584,7 @@ class FleetReplica:
         sift without its folds."""
         job_dir = os.path.join(self.jobroot, job.job_id)
         os.makedirs(job_dir, exist_ok=True)
+        phases = self._phases(lease, job, time.time())
         result = {
             "job_id": job.job_id,
             "replica": self.replica,
@@ -629,16 +634,21 @@ class FleetReplica:
                 # lost with the attempt; a successor redoes the sift
                 # and expands identically (idempotence)
                 return False
+        usage = {"phases": phases,
+                 "kind": str((lease.data.get("spec") or {})
+                             .get("kind", "survey") or "survey"),
+                 "replica": self.replica}
         try:
             if children or retarget:
                 self.ledger.complete_and_expand(
                     lease, self.replica, {final: tmp},
                     extra={"result": summary}, children=children,
-                    retarget=retarget)
+                    retarget=retarget, usage=usage)
             else:
                 self.ledger.complete(lease, self.replica,
                                      {final: tmp},
-                                     extra={"result": summary})
+                                     extra={"result": summary},
+                                     usage=usage)
         except self.ledger.STALE:
             self._c_stale.inc()
             self.service.events.emit("stale-result-rejected",
@@ -647,7 +657,7 @@ class FleetReplica:
                                      epoch=int(lease.epoch))
             return False
         self._c_committed.inc()
-        self._observe_e2e(lease, job, time.time())
+        self._observe_e2e(lease, phases)
         self.service.events.emit("job-done", job=job.job_id,
                                  replica=self.replica,
                                  epoch=int(lease.epoch))
@@ -660,29 +670,38 @@ class FleetReplica:
             self._chaos("post-sift-commit")
         return True
 
-    def _observe_e2e(self, lease, job: Job, now: float) -> None:
-        """Decompose one committed job's life into the
-        `job_e2e_seconds{phase,bucket}` histogram from ledger/event
-        timestamps: admit->lease wait, device execute, commit, and
-        total — the per-bucket cost model the control-plane item
-        (predictive admission, drain-time Retry-After) consumes
-        through the fleet aggregation."""
+    @staticmethod
+    def _phases(lease, job: Job, now: float) -> Dict[str, float]:
+        """One committed job's life decomposed from ledger/event
+        timestamps: admit->lease wait, device execute, commit-prep,
+        and total, in seconds — the per-bucket cost model the
+        control-plane signals (predictive admission, drain-time
+        Retry-After, the /scale advisory) consume.  Computed ONCE per
+        commit and fed verbatim to both the usage ledger row and the
+        `job_e2e_seconds` histogram, so per-tenant device-seconds
+        sums reconcile exactly against the fleet metric aggregation.
+        """
         sub = float(lease.data.get("submitted") or 0.0)
         leased = float(getattr(job, "leased_at", 0.0) or 0.0)
-        bucket = str(lease.data.get("bucket") or "")
-        h = self._h_e2e
+        phases: Dict[str, float] = {}
         if sub and leased:
-            h.labels(phase="lease_wait", bucket=bucket).observe(
-                max(leased - sub, 0.0))
+            phases["lease_wait"] = max(leased - sub, 0.0)
         if job.started and job.finished:
-            h.labels(phase="execute", bucket=bucket).observe(
-                max(job.finished - job.started, 0.0))
+            phases["execute"] = max(job.finished - job.started, 0.0)
         if job.finished:
-            h.labels(phase="commit", bucket=bucket).observe(
-                max(now - job.finished, 0.0))
+            phases["commit"] = max(now - job.finished, 0.0)
         if sub:
-            h.labels(phase="total", bucket=bucket).observe(
-                max(now - sub, 0.0))
+            phases["total"] = max(now - sub, 0.0)
+        return phases
+
+    def _observe_e2e(self, lease, phases: Dict[str, float]) -> None:
+        """Publish the phase decomposition into the
+        `job_e2e_seconds{phase,bucket}` histogram (the snapshot/
+        aggregation path to `GET /fleet/metrics`)."""
+        bucket = str(lease.data.get("bucket") or "")
+        for phase, seconds in phases.items():
+            self._h_e2e.labels(phase=phase,
+                               bucket=bucket).observe(seconds)
 
     # ---- shutdown parking ---------------------------------------------
 

@@ -53,6 +53,7 @@ from presto_tpu.pipeline.leaseledger import (DONE, FAILED, LEASED,
                                              PENDING, ItemLease,
                                              LeaseLedger, LedgerError,
                                              StaleLeaseError)
+from presto_tpu.serve.usage import UsageLedger
 
 LEDGER_NAME = "jobs.json"
 
@@ -194,6 +195,79 @@ class JobLedger(LeaseLedger):
         dag_* counters register with literal names so the obs_lint
         catalog check sees them."""
         return getattr(self.obs, "metrics", None)
+
+    # -- durable usage metering (the SLO observatory's substrate) ------
+    @property
+    def usage(self) -> UsageLedger:
+        """This fleet's crash-atomic `usage.jsonl` journal (lazy; a
+        per-tenant device-seconds record that survives replica death
+        and router restarts — serve/usage.py)."""
+        led = getattr(self, "_usage", None)
+        if led is None:
+            led = self._usage = UsageLedger(self.workdir)
+        return led
+
+    def _usage_append(self, lease: ItemLease, usage: Optional[dict],
+                      state: str, now: float) -> None:
+        """Append one usage row for a terminal transition.  Called
+        strictly AFTER the epoch-fence check accepted this replica's
+        verdict (complete / complete_and_expand / fail_terminal), so
+        a fenced zombie can never meter anything; crash-atomicity is
+        the usage ledger's append contract.  The `execute` phase
+        seconds also feed `slo_device_seconds_total{tenant,bucket}`
+        so the snapshot/aggregation path carries the same number."""
+        if usage is None or not self.usage.enabled:
+            return
+        row = dict(usage)
+        row.setdefault("job_id", lease.item_id)
+        row.setdefault("tenant", str(lease.data.get("tenant")
+                                     or DEFAULT_TENANT))
+        row.setdefault("bucket", lease.data.get("bucket"))
+        row.setdefault("dag", lease.data.get("dag"))
+        row["state"] = state
+        row.setdefault("ts", now)
+        self.usage.append(row)
+        execute = float((row.get("phases") or {}).get("execute")
+                        or 0.0)
+        reg = self._registry()
+        if reg is not None and state == DONE and execute > 0.0:
+            reg.counter(
+                "slo_device_seconds_total",
+                "Device-execute seconds metered per tenant and plan "
+                "bucket at each fence-checked commit (the usage "
+                "ledger's counter twin)",
+                ("tenant", "bucket")).labels(
+                    tenant=row["tenant"],
+                    bucket=str(row.get("bucket") or "")).inc(execute)
+
+    def complete(self, lease, host: str, staged: Dict[str, str],
+                 now: Optional[float] = None,
+                 extra: Optional[dict] = None,
+                 usage: Optional[dict] = None) -> Dict[str, dict]:
+        """Fence-checked commit (the LeaseLedger.complete transaction)
+        plus durable usage metering INSIDE it: the fence check runs
+        first (a zombie raises STALE before ever reaching the append)
+        and the usage row is durable before the ledger state flips to
+        done — a job the fleet can observe as done has always been
+        metered.  A crash between the append and the state save
+        re-admits the job; the redo's row supersedes (usage reader
+        dedups by job_id, last row wins)."""
+        now = time.time() if now is None else now
+        with self._lock():
+            state = self._load()
+            row = self._items(state).get(lease.item_id)
+            why = self._fence_why(row, lease, host)
+            if why is not None:
+                self._reject_stale(state, lease, host, staged, why)
+            arts = self._commit_row(state, lease, host, staged, row,
+                                    now, extra)
+            self._usage_append(lease,
+                               usage if usage is not None else {},
+                               DONE, now)
+            self._save(state)
+        self._event(self.EV_DONE, item=lease.item_id, host=host,
+                    artifacts=len(arts))
+        return arts
 
     def admit_dag(self, nodes: Sequence[Tuple[str, dict,
                                               Optional[str],
@@ -339,7 +413,9 @@ class JobLedger(LeaseLedger):
                             children: Optional[Sequence[Tuple[
                                 str, dict]]] = None,
                             retarget: Optional[Dict[str, dict]]
-                            = None) -> Dict[str, dict]:
+                            = None,
+                            usage: Optional[dict] = None) \
+            -> Dict[str, dict]:
         """Fence-checked commit PLUS dynamic fan-out, atomically.
 
         The sift node's surviving-candidate list decides the fold
@@ -389,6 +465,9 @@ class JobLedger(LeaseLedger):
                     parents.update(change["parents"])
                     spec["parents"] = parents
                     trow["spec"] = spec
+            self._usage_append(lease,
+                               usage if usage is not None else {},
+                               DONE, now)
             self._save(state)
         self._event(self.EV_DONE, item=lease.item_id, host=host,
                     artifacts=len(arts))
@@ -536,7 +615,8 @@ class JobLedger(LeaseLedger):
 
     # -- terminal failure ----------------------------------------------
     def fail_terminal(self, lease: ItemLease, host: str, error: str,
-                      now: Optional[float] = None) -> None:
+                      now: Optional[float] = None,
+                      usage: Optional[dict] = None) -> None:
         """Fence-checked terminal failure: the replica exhausted the
         job's local retry budget (or the spec is unexecutable), so the
         job must stop cycling the fleet.  A fenced-off lease raises
@@ -560,6 +640,11 @@ class JobLedger(LeaseLedger):
             # lease attempt): a drained fleet must not leave a failed
             # node's children pending forever
             self._cascade_failures(state, now)
+            # failures meter too (the availability half of an SLO is
+            # exactly "terminal failures count against the budget")
+            self._usage_append(lease,
+                               usage if usage is not None else {},
+                               FAILED, now)
             self._save(state)
         self._event("job-failed", item=lease.item_id, host=host,
                     error=str(error))

@@ -55,7 +55,21 @@ Wire protocol (stdlib HTTP + JSON, like server.py):
                           histograms bucket-merged so fleet p50/p99
                           are real percentiles; JSON by default,
                           Prometheus via Accept/?format= exactly
-                          like /metrics
+                          like /metrics; snapshots older than 3x
+                          their publish interval are flagged stale
+  GET  /slo               per-tenant SLO state (error budget, multi-
+                          window burn rates, alert state) evaluated
+                          over the durable usage ledger (obs/slo.py)
+  GET  /usage             per-tenant/per-bucket device-seconds
+                          rollup from <fleet>/usage.jsonl
+  GET  /scale             advisory {wanted_replicas, reason}: ledger
+                          backlog priced in expected device-seconds
+                          over per-replica measured capacity, plus
+                          SLO-debt pressure — recorded in the
+                          slo_wanted_replicas gauge and an
+                          slo-scale-advice event on every change so
+                          a supervisor can replay decisions from
+                          telemetry alone
   GET  /events?n=100      router event tail
 
 Load shedding quotes `Retry-After` from the fleet-aggregated
@@ -69,6 +83,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import math
 import os
 import sys
 import threading
@@ -80,7 +95,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, List, Optional
 from urllib.parse import urlparse, parse_qs
 
-from presto_tpu.obs import fleetagg
+from presto_tpu.obs import fleetagg, slo
 from presto_tpu.serve.events import EventLog
 from presto_tpu.serve.jobledger import (DEFAULT_TENANT, JobLedger,
                                         TenantQuotaExceeded)
@@ -115,6 +130,18 @@ class RouterConfig:
     require_ready: bool = True     # 503 /submit with no ready replica
     #: "name:weight[:quota]" tenant configs applied at start
     tenants: List[str] = field(default_factory=list)
+    #: "tenant:objective[:latency_s]" SLO specs (obs/slo.py);
+    #: persisted to <fleet>/slo.json so the fleet report and a
+    #: future supervisor share the source of truth.  Empty: reuse a
+    #: previously persisted spec file, if any.
+    slo: List[str] = field(default_factory=list)
+    #: "fast:slow:threshold[,...]" burn-window override applied to
+    #: every -slo spec ("" keeps the 5m/1h + 30m/6h SRE defaults)
+    slo_windows: str = ""
+    #: /scale advisory knobs (obs/slo.ScaleConfig)
+    scale_target_drain_s: float = 30.0
+    scale_min_replicas: int = 1
+    scale_max_replicas: int = 16
 
 
 class FleetRouter:
@@ -147,6 +174,25 @@ class FleetRouter:
                 parts[0],
                 weight=float(parts[1]) if len(parts) > 1 else 1.0,
                 quota=int(parts[2]) if len(parts) > 2 else None)
+        # SLO observatory: declarative per-tenant specs, persisted as
+        # <fleet>/slo.json (a restarted router with no -slo flags
+        # reuses the persisted set); evaluation runs in the poll loop
+        # and on demand from /slo, /usage, /scale
+        windows = slo.parse_windows(cfg.slo_windows)
+        if cfg.slo:
+            self._slo_specs = [slo.parse_spec(s, windows=windows)
+                               for s in cfg.slo]
+            slo.save_specs(cfg.fleetdir, self._slo_specs)
+        else:
+            self._slo_specs = slo.load_specs(cfg.fleetdir)
+        self._scale_cfg = slo.ScaleConfig(
+            target_drain_s=cfg.scale_target_drain_s,
+            min_replicas=cfg.scale_min_replicas,
+            max_replicas=cfg.scale_max_replicas)
+        self._slo_lock = threading.Lock()  # presto-lint: guards(_slo_view, _alerting, _last_wanted)
+        self._slo_view: Optional[dict] = None
+        self._alerting: set = set()     # (tenant, window) pairs live
+        self._last_wanted: Optional[int] = None
         reg = self.obs.metrics
         self._c_submissions = reg.counter(
             "fleet_submissions_total",
@@ -168,6 +214,23 @@ class FleetRouter:
         self._c_agg = reg.counter(
             "fleet_obs_aggregations_total",
             "Fleet metric aggregation passes (snapshot merges)")
+        self._g_budget = reg.gauge(
+            "slo_error_budget_remaining",
+            "Remaining error-budget fraction per tenant (1 = whole "
+            "budget left, 0 = spent)", ("tenant",))
+        self._g_burn = reg.gauge(
+            "slo_burn_rate",
+            "Fast-window burn rate per tenant and alert window "
+            "(1 = spending exactly the budgeted rate)",
+            ("tenant", "window"))
+        self._c_burn_alerts = reg.counter(
+            "slo_burn_alerts_total",
+            "Multi-window burn-rate alerts fired (rising edges) per "
+            "tenant", ("tenant",))
+        self._g_wanted = reg.gauge(
+            "slo_wanted_replicas",
+            "Advisory wanted-replica count from the /scale signal "
+            "(backlog device-seconds + SLO-debt pressure)")
 
     # ---- lifecycle ----------------------------------------------------
 
@@ -224,6 +287,10 @@ class FleetRouter:
         try:
             self._agg = fleetagg.aggregate(self.cfg.fleetdir)
             self._c_agg.inc()
+        except Exception:
+            self.obs.event("router-poll-error")
+        try:
+            self.evaluate_slo()
         except Exception:
             self.obs.event("router-poll-error")
         return out
@@ -481,6 +548,9 @@ class FleetRouter:
             "depth": self.ledger.depth(),
             "jobs": self.ledger.counts(),
             "replicas": agg["replicas"],
+            # stale = merged anyway but out of date (older than 3x
+            # its publish interval): the fleet view is partial
+            "stale_replicas": agg.get("stale_replicas", []),
             "job_e2e": fleetagg.rollup(merged, "job_e2e_seconds",
                                        "phase"),
             "latency": fleetagg.rollup(merged, "latency_seconds",
@@ -494,6 +564,96 @@ class FleetRouter:
         `GET /fleet/metrics`)."""
         return fleetagg.render_prometheus(
             self._aggregate()["merged"])
+
+    # ---- SLO observatory ----------------------------------------------
+
+    def _backlog_buckets(self,
+                         state: Optional[dict] = None) -> List:
+        """One bucket hint per active (pending + leased) ledger job
+        — what the /scale advisory prices in device-seconds."""
+        state = state or self.ledger.read()
+        return [row.get("bucket")
+                for row in state.get("jobs", {}).values()
+                if row.get("state") in ("pending", "leased")]
+
+    def evaluate_slo(self, now: Optional[float] = None) -> dict:
+        """One SLO observatory pass over the durable usage ledger:
+        per-tenant budget/burn evaluation, gauge updates, rising-edge
+        `slo-burn-alert` events, and the /scale advisory (gauge +
+        `slo-scale-advice` event on every change, so a supervisor
+        replays decisions from telemetry alone).  Runs in the poll
+        loop and on demand from the /slo, /usage, /scale endpoints.
+        """
+        now = time.time() if now is None else now
+        with self.obs.span("slo:evaluate") as span:
+            rows = self.ledger.usage.rows()
+            evals = {spec.tenant: slo.evaluate(spec, rows, now)
+                     for spec in self._slo_specs}
+            alerts = []
+            for tenant, ev in sorted(evals.items()):
+                self._g_budget.labels(tenant=tenant).set(
+                    ev["budget_remaining"])
+                for w in ev["windows"]:
+                    self._g_burn.labels(
+                        tenant=tenant, window=w["window"]).set(
+                            w["fast_burn"])
+                    if w["alerting"]:
+                        alerts.append((tenant, w["window"], w))
+            advice = slo.scale_advice(
+                self._backlog_buckets(), rows, evals,
+                len(self.ready_replicas()),
+                cfg=self._scale_cfg, now=now)
+            wanted = advice["wanted_replicas"]
+            span.set_attr("tenants", len(evals))
+            span.set_attr("wanted_replicas", wanted)
+        live = {(t, w) for t, w, _ in alerts}
+        with self._slo_lock:
+            rising = [(t, w, ev) for t, w, ev in alerts
+                      if (t, w) not in self._alerting]
+            self._alerting = live
+            previous = self._last_wanted
+            changed = wanted != previous
+            self._last_wanted = wanted
+            view = {
+                "ts": now,
+                "specs": [s.to_dict() for s in self._slo_specs],
+                "tenants": evals,
+                "usage": slo.usage_rollup(rows),
+                "scale": advice,
+            }
+            self._slo_view = view
+        for tenant, window, w in rising:
+            self._c_burn_alerts.labels(tenant=tenant).inc()
+            self.events.emit("slo-burn-alert", tenant=tenant,
+                             window=window,
+                             fast_burn=w["fast_burn"],
+                             slow_burn=w["slow_burn"],
+                             threshold=w["threshold"])
+        self._g_wanted.set(wanted)
+        if changed:
+            self.events.emit("slo-scale-advice", wanted=wanted,
+                             previous=previous,
+                             reason=advice["reason"],
+                             **advice["inputs"])
+        return view
+
+    def slo_view(self) -> dict:
+        """The `GET /slo` body: per-tenant budget, burn, and alert
+        state (freshly evaluated)."""
+        view = self.evaluate_slo()
+        return {"ts": view["ts"], "specs": view["specs"],
+                "tenants": view["tenants"]}
+
+    def usage_view(self) -> dict:
+        """The `GET /usage` body: the device-seconds rollup."""
+        view = self.evaluate_slo()
+        return dict(view["usage"], ts=view["ts"])
+
+    def scale_view(self) -> dict:
+        """The `GET /scale` body: the advisory wanted-replica signal
+        and its inputs."""
+        view = self.evaluate_slo()
+        return dict(view["scale"], ts=view["ts"])
 
 
 # ----------------------------------------------------------------------
@@ -558,6 +718,12 @@ class _RouterHandler(BaseHTTPRequestHandler):
                         self.router.fleet_metrics_prometheus())
                 else:
                     self._json(200, self.router.fleet_metrics())
+            elif url.path == "/slo":
+                self._json(200, self.router.slo_view())
+            elif url.path == "/usage":
+                self._json(200, self.router.usage_view())
+            elif url.path == "/scale":
+                self._json(200, self.router.scale_view())
             elif url.path == "/events":
                 n = int(parse_qs(url.query).get("n", ["100"])[0])
                 self._json(200,
@@ -603,10 +769,13 @@ class _RouterHandler(BaseHTTPRequestHandler):
             else:
                 self._json(202, self.router.submit(spec))
         except FleetBusy as e:
+            # ceil, not int(): truncation under-quotes the drain
+            # estimate (2.9s -> "2" tells clients to come back early)
             self._json(429, {"error": "shed", "detail": str(e),
                              "retry_after_s": e.retry_after_s},
                        headers={"Retry-After":
-                                "%d" % max(1, int(e.retry_after_s))})
+                                "%d" % max(1, math.ceil(
+                                    e.retry_after_s))})
         except TenantQuotaExceeded as e:
             self._json(429, {"error": "quota-exceeded",
                              "tenant": e.tenant, "quota": e.quota,
@@ -662,6 +831,24 @@ def build_parser():
                    metavar="NAME:WEIGHT[:QUOTA]",
                    help="Tenant WRR weight and optional active-job "
                         "quota (repeatable)")
+    p.add_argument("-slo", action="append", default=[],
+                   metavar="TENANT:OBJECTIVE[:LATENCY_S]",
+                   help="Per-tenant SLO spec (repeatable): "
+                        "availability objective in (0,1) plus an "
+                        "optional per-job e2e latency objective; "
+                        "persisted to <fleet>/slo.json and "
+                        "evaluated at /slo with multi-window burn-"
+                        "rate alerts")
+    p.add_argument("-slo-windows", type=str, default="",
+                   metavar="FAST:SLOW:THRESHOLD[,...]",
+                   help="Burn-alert window pairs in seconds "
+                        "(default: the 300:3600:14.4 and "
+                        "1800:21600:6 SRE pairs)")
+    p.add_argument("-scale-drain", type=float, default=30.0,
+                   help="/scale advisory: target seconds to drain "
+                        "the backlog")
+    p.add_argument("-scale-min", type=int, default=1)
+    p.add_argument("-scale-max", type=int, default=16)
     p.add_argument("-allow-empty", action="store_true",
                    help="Admit submissions even with no ready "
                         "replica (they queue in the ledger)")
@@ -676,12 +863,18 @@ def main(argv=None) -> int:
                        heartbeat_timeout=args.hb_timeout,
                        poll_s=args.poll,
                        require_ready=not args.allow_empty,
-                       tenants=args.tenant)
+                       tenants=args.tenant,
+                       slo=args.slo,
+                       slo_windows=args.slo_windows,
+                       scale_target_drain_s=args.scale_drain,
+                       scale_min_replicas=args.scale_min,
+                       scale_max_replicas=args.scale_max)
     router = FleetRouter(cfg).start()
     httpd = start_http(router, args.host, args.port)
     host, port = httpd.server_address[:2]
     print("presto-router: fleet %s on http://%s:%d "
-          "(POST /submit, GET /jobs/<id>, /fleet, /metrics)"
+          "(POST /submit, GET /jobs/<id>, /fleet, /metrics, "
+          "/slo, /usage, /scale)"
           % (args.fleetdir, host, port))
     try:
         while True:

@@ -1,0 +1,152 @@
+"""Durable per-tenant usage ledger: `<fleet>/usage.jsonl`.
+
+The fleet's decision signals (per-tenant SLO debt, the `/scale`
+advisory, device-seconds admission) need an accounting record that
+survives replica death and router restarts — a registry counter dies
+with its process and a snapshot is only as old as its publisher.  So
+every **fence-checked** terminal ledger transition appends one row
+here (serve/jobledger.py calls `append` right after the commit
+landed): the job's tenant, plan bucket, DAG id, terminal state, and
+the admit→lease-wait→execute→commit phase decomposition in seconds.
+The `execute` phase IS the device-seconds metering — the same float
+the committing replica observes into `job_e2e_seconds{phase,bucket}`,
+so per-tenant usage sums reconcile exactly against the fleet metric
+aggregation.
+
+Crash model (the append-only twin of `io/atomic`):
+
+  * one row = one complete JSON line written in a SINGLE ``os.write``
+    on an ``O_APPEND`` fd, fsync'd before the append returns —
+    concurrent replicas interleave whole lines, never bytes (a tiny
+    lockdir serializes writers across processes anyway);
+  * a crash mid-append can at worst leave a torn FINAL line with no
+    trailing newline.  Readers skip it (`rows` accepts only complete,
+    parseable lines) and the next writer truncates it away before
+    appending (`_repair`), so the ledger is always parseable and
+    never contains a partial row;
+  * double counting is fenced out: the append happens strictly
+    AFTER the epoch-fence check inside the job ledger's commit
+    transaction (and before the ledger state flips, so a job the
+    fleet observes as terminal has always been metered) — a fenced
+    zombie replica never reaches it.  The one residual case, a crash
+    between the append and the ledger save, re-admits the job and
+    the redo's row supersedes: `rows()` dedups by ``job_id``, last
+    row wins.
+
+`PRESTO_TPU_USAGE=0` disables metering entirely (the byte-equality
+reference arm of tools/serve_loadgen.py -slo); artifacts are
+identical either way — usage is bookkeeping about jobs, never part of
+the data path.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+from typing import Dict, List, Optional
+
+from presto_tpu.pipeline.leaseledger import _LockDir
+
+USAGE_NAME = "usage.jsonl"
+
+
+def usage_path(fleetdir: str) -> str:
+    return os.path.join(os.path.abspath(fleetdir), USAGE_NAME)
+
+
+class UsageLedger:
+    """Append-only, crash-tolerant JSONL usage journal."""
+
+    def __init__(self, fleetdir: str,
+                 enabled: Optional[bool] = None):
+        self.path = usage_path(fleetdir)
+        if enabled is None:
+            enabled = os.environ.get("PRESTO_TPU_USAGE", "1") != "0"
+        self.enabled = bool(enabled)
+        self._lock = _LockDir(self.path + ".lock", timeout=10.0)
+
+    # -- writing --------------------------------------------------------
+
+    @staticmethod
+    def _write(fd: int, data: bytes) -> None:
+        """The single-syscall append (seam: the chaos tests replace
+        this with a torn write + SimulatedCrash)."""
+        os.write(fd, data)
+
+    def _repair(self, fd: int) -> int:
+        """Truncate a torn final line (a predecessor died mid-append)
+        so the file ends at a row boundary.  Returns bytes dropped."""
+        size = os.fstat(fd).st_size
+        if size == 0:
+            return 0
+        os.lseek(fd, size - 1, os.SEEK_SET)
+        if os.read(fd, 1) == b"\n":
+            return 0
+        # walk back to the last complete row
+        keep = 0
+        os.lseek(fd, 0, os.SEEK_SET)
+        data = os.read(fd, size)
+        nl = data.rfind(b"\n")
+        keep = nl + 1 if nl >= 0 else 0
+        os.ftruncate(fd, keep)
+        return size - keep
+
+    def append(self, row: Dict) -> Optional[str]:
+        """Durably append one usage row; returns the ledger path
+        (None when metering is disabled)."""
+        if not self.enabled:
+            return None
+        data = (json.dumps(row, sort_keys=True) + "\n").encode()
+        os.makedirs(os.path.dirname(self.path), exist_ok=True)
+        with self._lock():
+            fd = os.open(self.path,
+                         os.O_RDWR | os.O_CREAT | os.O_APPEND, 0o644)
+            try:
+                self._repair(fd)
+                self._write(fd, data)
+                os.fsync(fd)
+            finally:
+                with contextlib.suppress(OSError):
+                    os.close(fd)
+        return self.path
+
+    # -- reading --------------------------------------------------------
+
+    def raw_rows(self) -> List[dict]:
+        """Every complete parseable row, in append order (torn or
+        corrupt lines skipped, never fatal)."""
+        out: List[dict] = []
+        try:
+            with open(self.path, "rb") as f:
+                data = f.read()
+        except OSError:
+            return out
+        for line in data.split(b"\n"):
+            if not line.strip():
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            if isinstance(rec, dict):
+                out.append(rec)
+        return out
+
+    def rows(self) -> List[dict]:
+        """raw_rows deduplicated by job_id (last row wins — a redo
+        after a crash-between-commit-and-append supersedes), append
+        order preserved."""
+        byid: Dict[str, int] = {}
+        out: List[dict] = []
+        for rec in self.raw_rows():
+            jid = rec.get("job_id")
+            if jid is None:
+                out.append(rec)
+                continue
+            if jid in byid:
+                out[byid[jid]] = rec
+            else:
+                byid[jid] = len(out)
+                out.append(rec)
+        return out

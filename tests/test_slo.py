@@ -1,0 +1,723 @@
+"""SLO observatory (ISSUE 14): the crash-atomic per-tenant usage
+ledger (device-seconds metered at every fence-checked commit, zombie
+commits never metered, SimulatedCrash mid-append leaves a parseable
+ledger), the burn-window algebra (merged-window burn == the
+single-registry computation, property-tested over random shard
+splits), multi-window multi-burn-rate alerting in SLO-priority
+order, the advisory /scale signal (rise with backlog, decay when
+idle), the router's /slo /usage /scale endpoints, the Retry-After
+ceil fix, stale-snapshot flagging, and lint check 14."""
+
+import json
+import math
+import os
+import random
+import time
+
+import pytest
+
+from presto_tpu.obs import Observability, ObsConfig, fleetagg, slo
+from presto_tpu.serve.fleet import FleetConfig, FleetReplica
+from presto_tpu.serve.jobledger import JobLedger
+from presto_tpu.serve.server import SearchService
+from presto_tpu.serve.usage import UsageLedger
+from presto_tpu.testing.chaos import SimulatedCrash
+
+
+def _wait(cond, timeout=30.0, poll=0.02):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if cond():
+            return True
+        time.sleep(poll)
+    return False
+
+
+def _row(tenant="t", job="j1", ts=0.0, state="done", execute=1.0,
+         total=1.0, bucket="b"):
+    return {"tenant": tenant, "job_id": job, "ts": ts,
+            "state": state, "bucket": bucket,
+            "phases": {"execute": execute, "total": total}}
+
+
+# ----------------------------------------------------------------------
+# usage ledger: append semantics + crash atomicity
+# ----------------------------------------------------------------------
+
+def test_usage_append_read_and_dedup(tmp_path):
+    led = UsageLedger(str(tmp_path))
+    led.append(_row(job="a", execute=1.0))
+    led.append(_row(job="b", execute=2.0))
+    led.append(_row(job="a", execute=3.0))      # redo supersedes
+    raw = led.raw_rows()
+    assert [r["job_id"] for r in raw] == ["a", "b", "a"]
+    rows = led.rows()
+    assert [r["job_id"] for r in rows] == ["a", "b"]
+    assert rows[0]["phases"]["execute"] == 3.0   # last row wins
+
+
+def test_usage_disabled_by_env(tmp_path, monkeypatch):
+    monkeypatch.setenv("PRESTO_TPU_USAGE", "0")
+    led = UsageLedger(str(tmp_path))
+    assert led.append(_row()) is None
+    assert not os.path.exists(led.path)
+    assert led.rows() == []
+
+
+def test_usage_torn_tail_skipped_then_repaired(tmp_path):
+    led = UsageLedger(str(tmp_path))
+    led.append(_row(job="a"))
+    with open(led.path, "a") as f:               # torn final line
+        f.write('{"job_id": "half')
+    assert [r["job_id"] for r in led.rows()] == ["a"]
+    led.append(_row(job="b"))
+    # the torn bytes are GONE, not just skipped: every line parses
+    with open(led.path) as f:
+        lines = [ln for ln in f.read().splitlines() if ln]
+    assert [json.loads(ln)["job_id"] for ln in lines] == ["a", "b"]
+
+
+def test_usage_simulated_crash_mid_append(tmp_path, monkeypatch):
+    """SimulatedCrash mid-append (a torn write) leaves a parseable
+    ledger with no partial row once the next writer runs — the
+    io/atomic contract's append-only analog."""
+    led = UsageLedger(str(tmp_path))
+    led.append(_row(job="a"))
+
+    def torn_write(fd, data):
+        os.write(fd, data[: len(data) // 2])
+        raise SimulatedCrash("usage-append")
+
+    monkeypatch.setattr(UsageLedger, "_write",
+                        staticmethod(torn_write))
+    with pytest.raises(SimulatedCrash):
+        led.append(_row(job="b"))
+    monkeypatch.undo()
+    # reader: previous rows intact, torn row invisible
+    survivor = UsageLedger(str(tmp_path))
+    assert [r["job_id"] for r in survivor.rows()] == ["a"]
+    # next append repairs the tail: the file is wholly parseable
+    survivor.append(_row(job="c"))
+    with open(survivor.path) as f:
+        lines = [ln for ln in f.read().splitlines() if ln]
+    assert [json.loads(ln)["job_id"] for ln in lines] == ["a", "c"]
+
+
+# ----------------------------------------------------------------------
+# window algebra: merged-window burn == single computation
+# ----------------------------------------------------------------------
+
+def _spec(**kw):
+    kw.setdefault("tenant", "t")
+    kw.setdefault("objective", 0.99)
+    kw.setdefault("latency_s", 2.0)
+    kw.setdefault("windows", tuple(slo.BurnWindow(*w) for w in
+                                   ((10.0, 40.0, 10.0),
+                                    (40.0, 160.0, 5.0))))
+    return slo.SloSpec(**kw)
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2, 3, 4])
+def test_window_merge_equals_single_computation(seed):
+    """Property (the fleetagg percentile proof's SLO twin): for ANY
+    partition of the usage rows into shards, evaluating the merged
+    window states equals evaluating one state over all rows."""
+    rng = random.Random(seed)
+    spec = _spec()
+    now = 1000.0
+    rows = []
+    for i in range(rng.randint(1, 250)):
+        state = "failed" if rng.random() < 0.2 else "done"
+        rows.append(_row(job="j%d" % i,
+                         ts=now - rng.uniform(0.0, 300.0),
+                         state=state,
+                         total=rng.uniform(0.1, 4.0)))
+    whole = slo.window_state(spec, rows, now)
+    shards = [[] for _ in range(rng.randint(1, 6))]
+    for row in rows:
+        shards[rng.randrange(len(shards))].append(row)
+    states = [slo.window_state(spec, s, now) for s in shards]
+    merged = states[0]
+    for s in states[1:]:
+        merged = slo.merge_states(merged, s)
+    assert merged == whole
+    assert slo.evaluate_state(spec, merged) \
+        == slo.evaluate_state(spec, whole)
+
+
+def test_merge_is_commutative_and_associative():
+    spec = _spec()
+    now = 100.0
+    a = slo.window_state(spec, [_row(job="a", ts=95.0)], now)
+    b = slo.window_state(spec, [_row(job="b", ts=70.0,
+                                     state="failed")], now)
+    c = slo.window_state(spec, [_row(job="c", ts=10.0,
+                                     total=9.0)], now)
+    ab_c = slo.merge_states(slo.merge_states(a, b), c)
+    a_bc = slo.merge_states(a, slo.merge_states(b, c))
+    cba = slo.merge_states(c, slo.merge_states(b, a))
+    assert ab_c == a_bc == cba
+
+
+def test_classify_latency_and_failures():
+    spec = _spec(latency_s=2.0)
+    assert slo.classify(spec, _row(total=1.0))
+    assert not slo.classify(spec, _row(total=3.0))     # over latency
+    assert not slo.classify(spec, _row(state="failed"))
+    # availability-only spec: latency never spends budget
+    assert slo.classify(_spec(latency_s=None), _row(total=99.0))
+
+
+def test_alert_requires_both_windows():
+    """Multi-window: a fast-window spike alone (slow window still
+    quiet) must NOT page — and vice versa."""
+    spec = _spec(windows=(slo.BurnWindow(10.0, 160.0, 5.0),))
+    now = 1000.0
+    # bad events ONLY in the last 10s: fast burns, slow burns too
+    # (the events are inside both windows) -> alert
+    burst = [_row(job="j%d" % i, ts=now - 1.0, state="failed")
+             for i in range(10)]
+    assert slo.evaluate(spec, burst, now)["alert"]
+    # the same burst 100s ago: slow window still sees it, the fast
+    # window is clean -> no alert
+    old = [_row(job="j%d" % i, ts=now - 100.0, state="failed")
+           for i in range(10)]
+    good_now = [_row(job="g%d" % i, ts=now - 1.0)
+                for i in range(10)]
+    ev = slo.evaluate(spec, old + good_now, now)
+    assert not ev["alert"]
+    assert ev["windows"][0]["slow_burn"] > 0
+    assert ev["windows"][0]["fast_burn"] == 0.0
+
+
+def test_burn_alerts_fire_in_slo_priority_order():
+    """The same bad-event stream burns a strict tenant's budget
+    faster than a lenient tenant's: gold (99%) crosses the threshold
+    while bronze (75%) never does."""
+    gold = _spec(tenant="gold", objective=0.99)
+    bronze = _spec(tenant="bronze", objective=0.75)
+    now = 1000.0
+    rows = []
+    for t in ("gold", "bronze"):
+        for i in range(10):
+            rows.append(_row(tenant=t, job="%s-%d" % (t, i),
+                             ts=now - 2.0,
+                             state="failed" if i < 5 else "done"))
+    ev_gold = slo.evaluate(gold, rows, now)
+    ev_bronze = slo.evaluate(bronze, rows, now)
+    assert ev_gold["windows"][0]["fast_burn"] \
+        > ev_bronze["windows"][0]["fast_burn"]
+    assert ev_gold["alert"] and not ev_bronze["alert"]
+
+
+def test_burn_series_and_sparkline():
+    spec = _spec(windows=(slo.BurnWindow(10.0, 40.0, 10.0),))
+    now = 100.0
+    rows = [_row(job="j%d" % i, ts=95.0, state="failed")
+            for i in range(4)]
+    series = slo.burn_series(spec, rows, now, 10.0, 50.0, n=3)
+    assert series[0] == 0.0 and series[-1] > 0.0
+    line = slo.sparkline(series)
+    assert len(line) == 3 and line[-1] == "█"
+    assert slo.sparkline([]) == ""
+
+
+def test_spec_parse_persist_roundtrip(tmp_path):
+    spec = slo.parse_spec("gold:0.995:3.5",
+                          windows=[(5.0, 20.0, 8.0)])
+    assert spec.tenant == "gold"
+    assert spec.objective == 0.995 and spec.latency_s == 3.5
+    slo.save_specs(str(tmp_path), [spec, _spec(tenant="t2")])
+    loaded = slo.load_specs(str(tmp_path))
+    assert [s.tenant for s in loaded] == ["gold", "t2"]
+    assert loaded[0].windows == (slo.BurnWindow(5.0, 20.0, 8.0),)
+    with pytest.raises(ValueError):
+        slo.parse_spec("nocolon")
+    with pytest.raises(ValueError):
+        slo.parse_spec("t:1.5")
+    assert slo.load_specs(str(tmp_path / "nowhere")) == []
+
+
+# ----------------------------------------------------------------------
+# scale advisory
+# ----------------------------------------------------------------------
+
+def test_scale_advice_rises_with_backlog_and_decays():
+    cfg = slo.ScaleConfig(target_drain_s=10.0, min_replicas=1,
+                          max_replicas=8)
+    now = 1000.0
+    # cost model: bucket "b" jobs take 5 device-seconds
+    rows = [_row(job="j%d" % i, ts=now - 30.0, execute=5.0)
+            for i in range(10)]
+    idle = slo.scale_advice([], rows, {}, 2, cfg, now)
+    assert idle["wanted_replicas"] == 1
+    assert "idle" in idle["reason"]
+    spike = slo.scale_advice(["b"] * 12, rows, {}, 2, cfg, now)
+    assert spike["wanted_replicas"] > idle["wanted_replicas"]
+    assert spike["inputs"]["backlog_device_seconds"] \
+        == pytest.approx(60.0)
+    # clamped at max_replicas
+    flood = slo.scale_advice(["b"] * 500, rows, {}, 2, cfg, now)
+    assert flood["wanted_replicas"] == 8
+    # decay: backlog drained -> back to min
+    after = slo.scale_advice([], rows, {}, 2, cfg, now + 60.0)
+    assert after["wanted_replicas"] == 1
+
+
+def test_scale_advice_slo_pressure_and_cost_fallbacks():
+    cfg = slo.ScaleConfig(target_drain_s=30.0, default_job_s=2.0)
+    now = 0.0
+    # no usage history: unknown buckets price at default_job_s
+    adv = slo.scale_advice(["x", None], [], {}, 1, cfg, now)
+    assert adv["inputs"]["backlog_device_seconds"] \
+        == pytest.approx(4.0)
+    assert adv["inputs"]["per_replica_capacity"] == 1.0
+    # an alerting tenant adds pressure above current ready count
+    evals = {"gold": {"alert": True}, "bronze": {"alert": False}}
+    adv = slo.scale_advice([], [], evals, 3, cfg, now)
+    assert adv["wanted_replicas"] == 4
+    assert adv["inputs"]["slo_pressure"] == ["gold"]
+    assert "slo-debt" in adv["reason"]
+
+
+def test_measured_capacity_window_and_clamp():
+    cfg = slo.ScaleConfig(capacity_window_s=100.0,
+                          min_capacity=0.25, max_capacity=4.0)
+    now = 1000.0
+    # 50 device-seconds executed in the last 100s by 1 replica
+    rows = [_row(job="j%d" % i, ts=now - 10.0, execute=5.0)
+            for i in range(10)]
+    assert slo.measured_capacity(rows, now, cfg, 1) \
+        == pytest.approx(0.5)
+    # old work is outside the window -> cold-start fallback
+    assert slo.measured_capacity(rows, now + 500.0, cfg, 1) == 1.0
+    # a trickle clamps at min_capacity instead of exploding /scale
+    trickle = [_row(job="t", ts=now - 1.0, execute=0.001)]
+    assert slo.measured_capacity(trickle, now, cfg, 4) == 0.25
+
+
+# ----------------------------------------------------------------------
+# ledger integration: metering at the fence
+# ----------------------------------------------------------------------
+
+def _commit(led, lease, host, d, usage=None):
+    staged = os.path.join(d, ".stage-%s" % lease.item_id)
+    with open(staged, "w") as f:
+        f.write("{}")
+    final = os.path.join(led.workdir, "jobs", lease.item_id,
+                         "result.json")
+    os.makedirs(os.path.dirname(final), exist_ok=True)
+    return led.complete(lease, host, {final: staged}, usage=usage)
+
+
+def test_commit_appends_usage_and_device_seconds(tmp_path):
+    obs = Observability(ObsConfig(enabled=True))
+    led = JobLedger(str(tmp_path), obs=obs)
+    led.join("r1")
+    led.admit({"rawfiles": ["x"]}, tenant="gold", bucket="bkt")
+    lease = led.lease("r1", ttl=30.0)
+    _commit(led, lease, "r1", str(tmp_path),
+            usage={"phases": {"execute": 1.25, "total": 2.0}})
+    (row,) = led.usage.rows()
+    assert row["tenant"] == "gold" and row["bucket"] == "bkt"
+    assert row["state"] == "done"
+    assert row["phases"]["execute"] == 1.25
+    c = obs.metrics.get("slo_device_seconds_total")
+    assert c.labels(tenant="gold", bucket="bkt").value == 1.25
+    # terminal failure meters availability (no device-seconds)
+    led.admit({"rawfiles": ["x"]}, tenant="gold", bucket="bkt")
+    lease2 = led.lease("r1", ttl=30.0)
+    led.fail_terminal(lease2, "r1", "boom")
+    rows = led.usage.rows()
+    assert [r["state"] for r in rows] == ["done", "failed"]
+    assert c.labels(tenant="gold", bucket="bkt").value == 1.25
+
+
+def test_zombie_commit_never_meters(tmp_path):
+    """The fence runs BEFORE the append: a fenced zombie's late
+    commit (and late terminal verdict) writes no usage row."""
+    led = JobLedger(str(tmp_path))
+    led.join("a")
+    led.join("b")
+    led.admit({"rawfiles": ["x"]}, tenant="gold", bucket="bkt")
+    lease_a = led.lease("a", ttl=30.0)
+    # fleet declares a dead; b redoes and commits
+    led.readmit_owned("a")
+    lease_b = led.lease("b", ttl=30.0)
+    _commit(led, lease_b, "b", str(tmp_path),
+            usage={"phases": {"execute": 2.0}})
+    with pytest.raises(led.STALE):
+        _commit(led, lease_a, "a", str(tmp_path),
+                usage={"phases": {"execute": 99.0}})
+    with pytest.raises(led.STALE):
+        led.fail_terminal(lease_a, "a", "zombie verdict",
+                          usage={"phases": {"execute": 77.0}})
+    rows = led.usage.raw_rows()
+    assert len(rows) == 1
+    assert rows[0]["phases"]["execute"] == 2.0
+
+
+# ----------------------------------------------------------------------
+# stub fleet: conservation + kill-one never double-counts
+# ----------------------------------------------------------------------
+
+class StubService(SearchService):
+    def build_job(self, spec, job_id=None, workdir=None):
+        from presto_tpu.serve.queue import Job
+        job_id = str(job_id or "stub-%06d" % next(self._ids))
+        return Job(job_id=job_id, rawfiles=[], cfg=None,
+                   workdir=workdir or os.path.join(self.workroot,
+                                                   job_id),
+                   bucket=spec.get("bucket") or "stub-bucket",
+                   spec=dict(spec))
+
+    def _execute_job(self, job):
+        os.makedirs(job.workdir, exist_ok=True)
+        time.sleep(float(job.spec.get("sleep_s", 0.01)))
+        with open(os.path.join(job.workdir, "stub.dat"), "wb") as f:
+            f.write(b"\x01" * 64)
+        return {"ok": True}
+
+
+def _stub_fleet(tmp_path, name, fleetdir, **fkw):
+    svc = StubService(str(tmp_path / ("w-" + name)),
+                      queue_depth=16).start()
+    cfg = FleetConfig(fleetdir=str(fleetdir), replica=name,
+                      lease_ttl=20.0, heartbeat_s=0.05,
+                      heartbeat_timeout=0.6, poll_s=0.05,
+                      max_inflight=1, prewarm=False,
+                      snapshot_s=0.05)
+    for k, v in fkw.items():
+        setattr(cfg, k, v)
+    return svc, FleetReplica(svc, cfg)
+
+
+def _execute_samples(svc):
+    """Every execute-phase observation in one replica's
+    job_e2e_seconds histogram."""
+    fam = svc.obs.metrics.get("job_e2e_seconds")
+    out = []
+    if fam is None:
+        return out
+    for labels, child in fam.children():
+        if dict(labels).get("phase") == "execute":
+            out.extend(child.samples())
+    return out
+
+
+def test_stub_fleet_device_seconds_conservation(tmp_path):
+    """The tentpole accounting property: the usage ledger's
+    per-tenant device-seconds are EXACTLY the execute-phase
+    observations the fleet histogram aggregates — same floats, same
+    multiset — so /usage reconciles against /fleet/metrics."""
+    fleetdir = tmp_path / "fleet"
+    led = JobLedger(str(fleetdir))
+    for i in range(3):
+        led.admit({"rawfiles": [], "seed": i, "sleep_s": 0.01},
+                  tenant="gold", bucket="bkt")
+    for i in range(2):
+        led.admit({"rawfiles": [], "seed": i, "sleep_s": 0.01},
+                  tenant="bronze", bucket="bkt")
+    svc, rep = _stub_fleet(tmp_path, "r1", fleetdir)
+    rep.start()
+    try:
+        assert _wait(lambda: led.counts()["done"] == 5)
+    finally:
+        rep.stop()
+        svc.stop()
+    rows = led.usage.rows()
+    assert len(rows) == 5
+    by_tenant = {}
+    for r in rows:
+        by_tenant.setdefault(r["tenant"], []).append(
+            r["phases"]["execute"])
+    assert len(by_tenant["gold"]) == 3
+    assert len(by_tenant["bronze"]) == 2
+    usage_all = sorted(x for xs in by_tenant.values() for x in xs)
+    assert usage_all == sorted(_execute_samples(svc))
+    # the counter twin carries the same totals per tenant
+    fam = svc.obs.metrics.get("slo_device_seconds_total")
+    for tenant, xs in by_tenant.items():
+        assert fam.labels(tenant=tenant, bucket="bkt").value \
+            == pytest.approx(math.fsum(xs), rel=1e-12)
+    # and the rollup agrees
+    roll = slo.usage_rollup(rows)
+    assert roll["total_jobs"] == 5
+    assert roll["total_device_seconds"] \
+        == pytest.approx(sum(usage_all), abs=1e-6)
+
+
+def test_kill_one_never_double_counts_device_seconds(tmp_path):
+    """Satellite: replica kill-one (the fleet_chaos harness seam) —
+    the victim dies holding a leased job whose survey keeps running
+    as a zombie; the survivor re-executes and commits.  The usage
+    ledger must hold EXACTLY one done row per job: the zombie's late
+    commit is fenced before it can meter."""
+    from presto_tpu.serve.queue import JobStatus
+    fleetdir = tmp_path / "fleet"
+    led = JobLedger(str(fleetdir))
+    for i in range(2):
+        led.admit({"rawfiles": [], "seed": i, "sleep_s": 0.05},
+                  tenant="gold", bucket="bkt")
+    svc_a, rep_a = _stub_fleet(tmp_path, "a", fleetdir)
+    rep_a.kill_on = "job-enqueued"
+    rep_a.start()
+    try:
+        assert _wait(lambda: rep_a._killed, timeout=20.0)
+        zombies = dict(rep_a._inflight)
+        assert len(zombies) == 1
+        svc_b, rep_b = _stub_fleet(tmp_path, "b", fleetdir)
+        rep_b.start()
+        try:
+            assert _wait(led.all_terminal, timeout=30.0)
+            # the zombie's local job finishes on a's scheduler; its
+            # late commit must bounce off the fence WITHOUT metering
+            (jid, (lease, job)) = next(iter(zombies.items()))
+            assert _wait(lambda: job.status in JobStatus.TERMINAL,
+                         timeout=20.0)
+            assert rep_a._commit(lease, job) is False
+        finally:
+            rep_b.stop()
+            svc_b.stop()
+    finally:
+        rep_a.stop()
+        svc_a.stop()
+    raw = led.usage.raw_rows()
+    done = [r for r in raw if r["state"] == "done"]
+    per_job = {}
+    for r in done:
+        per_job[r["job_id"]] = per_job.get(r["job_id"], 0) + 1
+    assert sorted(per_job) == sorted(
+        j for j, row in led.read()["jobs"].items()
+        if row["state"] == "done")
+    assert all(n == 1 for n in per_job.values()), per_job
+    # conservation still holds against the SURVIVOR's histogram
+    # (the zombie observed nothing: its commit never landed)
+    usage_all = sorted(r["phases"]["execute"] for r in done)
+    fleet_all = sorted(_execute_samples(svc_a)
+                       + _execute_samples(svc_b))
+    assert usage_all == fleet_all
+
+
+# ----------------------------------------------------------------------
+# router surfaces
+# ----------------------------------------------------------------------
+
+def _router(tmp_path, **kw):
+    from presto_tpu.serve.router import FleetRouter, RouterConfig
+    kw.setdefault("fleetdir", str(tmp_path / "fleet"))
+    kw.setdefault("require_ready", False)
+    return FleetRouter(RouterConfig(**kw))
+
+
+def _seed_usage(router, n_bad=3, n_good=3, execute=1.0):
+    led = router.ledger
+    led.join("r1")
+    for i in range(n_bad + n_good):
+        led.admit({"rawfiles": ["x"]}, tenant="gold", bucket="bkt")
+        lease = led.lease("r1", ttl=30.0)
+        total = 9.0 if i < n_bad else 0.5
+        _commit(led, lease, "r1", router.cfg.fleetdir,
+                usage={"phases": {"execute": execute,
+                                  "total": total}})
+
+
+def test_router_slo_usage_scale_endpoints(tmp_path):
+    import urllib.request
+    from presto_tpu.serve.router import start_http
+    router = _router(tmp_path, slo=["gold:0.99:2.0"],
+                     slo_windows="60:240:5",
+                     scale_target_drain_s=5.0)
+    _seed_usage(router)
+    for _ in range(4):                      # backlog for /scale
+        router.ledger.admit({"rawfiles": ["x"]}, tenant="gold",
+                            bucket="bkt")
+    httpd = start_http(router)
+    url = "http://%s:%d" % httpd.server_address[:2]
+    try:
+        with urllib.request.urlopen(url + "/slo", timeout=10) as r:
+            doc = json.loads(r.read())
+        ev = doc["tenants"]["gold"]
+        assert ev["events"] == 6 and ev["bad"] == 3
+        assert ev["alert"] is True
+        with urllib.request.urlopen(url + "/usage",
+                                    timeout=10) as r:
+            usage = json.loads(r.read())
+        assert usage["tenants"]["gold"]["device_seconds"] \
+            == pytest.approx(6.0)
+        with urllib.request.urlopen(url + "/scale",
+                                    timeout=10) as r:
+            scale = json.loads(r.read())
+        assert scale["wanted_replicas"] >= 1
+        assert scale["inputs"]["backlog_jobs"] == 4
+        assert scale["inputs"]["backlog_device_seconds"] \
+            == pytest.approx(4.0)       # per-bucket mean = 1.0s
+        # gauges + events: rising-edge alert, advice on change
+        reg = router.obs.metrics
+        assert reg.get("slo_wanted_replicas").value \
+            == scale["wanted_replicas"]
+        assert reg.get("slo_burn_alerts_total").labels(
+            tenant="gold").value == 1
+        kinds = [e["kind"] for e in router.events.tail(100)]
+        assert "slo-burn-alert" in kinds
+        assert "slo-scale-advice" in kinds
+        # alert already live: a second evaluation is NOT a new edge
+        router.evaluate_slo()
+        assert reg.get("slo_burn_alerts_total").labels(
+            tenant="gold").value == 1
+    finally:
+        httpd.shutdown()
+        router.stop()
+
+
+def test_router_persists_and_reloads_slo_specs(tmp_path):
+    router = _router(tmp_path, slo=["gold:0.99", "bronze:0.9:5"])
+    assert os.path.exists(slo.spec_path(router.cfg.fleetdir))
+    router.stop()
+    # a restarted router with NO -slo flags reuses the persisted set
+    router2 = _router(tmp_path)
+    assert sorted(s.tenant for s in router2._slo_specs) \
+        == ["bronze", "gold"]
+    router2.stop()
+
+
+def test_scale_advice_decays_after_backlog_drains(tmp_path):
+    router = _router(tmp_path, scale_target_drain_s=2.0)
+    _seed_usage(router, n_bad=0, n_good=4, execute=2.0)
+    led = router.ledger
+    for _ in range(8):
+        led.admit({"rawfiles": ["x"]}, tenant="gold", bucket="bkt")
+    spike = router.evaluate_slo()["scale"]
+    assert spike["wanted_replicas"] > 1
+    # drain the backlog
+    while True:
+        lease = led.lease("r1", ttl=30.0)
+        if lease is None:
+            break
+        _commit(led, lease, "r1", router.cfg.fleetdir,
+                usage={"phases": {"execute": 0.01, "total": 0.01}})
+    after = router.evaluate_slo()["scale"]
+    assert after["wanted_replicas"] == 1
+    kinds = [e["kind"] for e in router.events.tail(100)]
+    assert kinds.count("slo-scale-advice") >= 2    # rise + decay
+    router.stop()
+
+
+def test_router_retry_after_header_uses_ceil(tmp_path):
+    """Satellite: 2.9s must quote Retry-After: 3, not 2 — int()
+    truncation under-quoted the drain estimate."""
+    import urllib.error
+    import urllib.request
+    from presto_tpu.serve.router import start_http
+    router = _router(tmp_path, high_water=1, retry_after_s=2.2)
+    httpd = start_http(router)
+    url = "http://%s:%d" % httpd.server_address[:2]
+    try:
+        req = urllib.request.Request(
+            url + "/submit",
+            data=json.dumps({"rawfiles": ["x.fil"]}).encode(),
+            headers={"Content-Type": "application/json"})
+        assert urllib.request.urlopen(req, timeout=10).status == 202
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req, timeout=10)
+        assert ei.value.code == 429
+        assert ei.value.headers["Retry-After"] == "3"
+        assert json.loads(ei.value.read())["retry_after_s"] == 2.2
+    finally:
+        httpd.shutdown()
+        router.stop()
+
+
+# ----------------------------------------------------------------------
+# stale-snapshot flagging
+# ----------------------------------------------------------------------
+
+def test_aggregate_flags_stale_snapshots(tmp_path):
+    fleetdir = str(tmp_path)
+    now = time.time()
+    obs = Observability(ObsConfig(enabled=True))
+    obs.metrics.counter("fleet_jobs_committed_total", "c").inc(2)
+    fleetagg.publish_snapshot(fleetdir, "fresh", obs, now=now,
+                              interval=2.0)
+    fleetagg.publish_snapshot(fleetdir, "wedged", obs,
+                              now=now - 30.0, interval=2.0)
+    # a tombstone is the intentional final word — never stale
+    fleetagg.publish_snapshot(fleetdir, "drained", obs,
+                              now=now - 30.0, interval=2.0,
+                              tombstone=True)
+    agg = fleetagg.aggregate(fleetdir, now=now)
+    assert agg["stale_replicas"] == ["wedged"]
+    assert agg["replicas"]["wedged"]["stale"] is True
+    assert agg["replicas"]["wedged"]["age_s"] == pytest.approx(
+        30.0, abs=0.5)
+    assert agg["replicas"]["fresh"]["stale"] is False
+    assert agg["replicas"]["drained"]["stale"] is False
+    # stale counters still merge — flagged, not dropped
+    doc = fleetagg.to_json(agg["merged"])
+    assert doc["fleet_jobs_committed_total"]["series"][0]["value"] \
+        == 6
+
+
+def test_router_fleet_metrics_surfaces_stale(tmp_path):
+    router = _router(tmp_path)
+    obs = Observability(ObsConfig(enabled=True))
+    fleetagg.publish_snapshot(router.cfg.fleetdir, "wedged", obs,
+                              now=time.time() - 60.0, interval=2.0)
+    doc = router.fleet_metrics()
+    assert doc["stale_replicas"] == ["wedged"]
+    assert doc["replicas"]["wedged"]["stale"] is True
+    router.stop()
+
+
+def test_fleet_report_warns_on_stale_and_shows_slo(tmp_path,
+                                                  capsys):
+    from presto_tpu.apps.report import main as report_main
+    fleetdir = str(tmp_path / "fleet")
+    led = JobLedger(fleetdir)
+    led.join("r1")
+    slo.save_specs(fleetdir, [slo.parse_spec(
+        "gold:0.99:2.0", windows=[(10.0, 40.0, 10.0)])])
+    led.admit({"rawfiles": ["x"]}, tenant="gold", bucket="bkt")
+    lease = led.lease("r1", ttl=30.0)
+    _commit(led, lease, "r1", fleetdir,
+            usage={"phases": {"execute": 0.5, "total": 9.0}})
+    led.admit({"rawfiles": ["x"]}, tenant="gold", bucket="bkt")
+    obs = Observability(ObsConfig(enabled=True))
+    fleetagg.publish_snapshot(fleetdir, "wedged", obs,
+                              now=time.time() - 60.0, interval=2.0)
+    assert report_main(["-fleet", fleetdir]) == 0
+    out = capsys.readouterr().out
+    assert "STALE" in out
+    assert "Usage (usage.jsonl)" in out
+    assert "SLO observatory" in out and "ALERT" in out
+    assert "Scale advisory" in out
+    # JSON mode carries the same sections
+    assert report_main(["-fleet", fleetdir, "-json"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["stale_snapshots"] == ["wedged"]
+    assert doc["usage"]["total_jobs"] == 1
+    assert doc["slo"]["tenants"]["gold"]["alert"] is True
+    assert doc["scale"]["wanted_replicas"] >= 1
+
+
+# ----------------------------------------------------------------------
+# lint contract (check 14)
+# ----------------------------------------------------------------------
+
+def test_slo_taxonomy_subset_relations():
+    from presto_tpu.obs import taxonomy
+    assert taxonomy.SLO_SPANS <= taxonomy.SERVE_SPANS
+    assert taxonomy.SLO_METRICS <= taxonomy.METRICS
+
+
+def test_obs_lint_check14_clean_and_detects_drift(tmp_path,
+                                                  monkeypatch):
+    from presto_tpu.lint import obscoverage
+    from presto_tpu.obs import taxonomy
+    assert obscoverage.lint() == []
+    # a cataloged-but-unregistered SLO metric must fail both ways
+    monkeypatch.setattr(
+        taxonomy, "SLO_METRICS",
+        frozenset(taxonomy.SLO_METRICS | {"slo_ghost_total"}))
+    problems = obscoverage.lint()
+    assert any("slo_ghost_total" in p for p in problems)

@@ -173,6 +173,30 @@ def run_trial(trial: int, rng: random.Random, beam: str, ref: dict,
         rec["checks"]["zombie_commit_fenced"] = fence_ok
         rec["stale_rejected"] = int(victim_svc.obs.metrics.get(
             "fleet_stale_results_total").value)
+        # usage-ledger exactly-once (ISSUE 14): every done job has
+        # EXACTLY one committed usage row — the fenced zombie never
+        # metered — and the device-seconds are the very floats the
+        # replicas' job_e2e_seconds execute-phase histograms hold
+        from presto_tpu.serve.usage import UsageLedger
+        usage_done = [r for r in UsageLedger(fleetdir).raw_rows()
+                      if r.get("state") == "done"]
+        per_job = {}
+        for r in usage_done:
+            per_job[r["job_id"]] = per_job.get(r["job_id"], 0) + 1
+        rec["checks"]["usage_exactly_once"] = (
+            sorted(per_job) == sorted(done)
+            and all(n == 1 for n in per_job.values()))
+        fleet_exec = []
+        for svc, _rep in members:
+            fam = svc.obs.metrics.get("job_e2e_seconds")
+            for labels, child in (fam.children() if fam else ()):
+                if dict(labels).get("phase") == "execute":
+                    fleet_exec.extend(child.samples())
+        usage_exec = sorted(float(r["phases"].get("execute") or 0.0)
+                            for r in usage_done)
+        rec["checks"]["usage_matches_execute_total"] = (
+            usage_exec == sorted(fleet_exec))
+        rec["device_seconds"] = round(sum(usage_exec), 6)
         # the kill left a post-mortem the fleet report can pick up:
         # a flightrec dump under <fleet>/obs/<victim>/ whose last
         # chaos record names the fired kill point (recorded BEFORE

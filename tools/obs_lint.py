@@ -1,7 +1,7 @@
 #!/usr/bin/env python
 """obs_lint: thin shim over presto_tpu/lint/obscoverage.py.
 
-The 13 instrumentation-coverage checks that used to live here are now
+The 14 instrumentation-coverage checks that used to live here are now
 the `obs-coverage` family of the presto-lint suite (see
 docs/LINTING.md); this entry point, the `lint()` API, and the regexes
 are re-exported so existing callers and tests/test_obs_lint.py keep

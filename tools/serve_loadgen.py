@@ -31,6 +31,14 @@ own job_total p50/p99 from /metrics.
   # matching an independent snapshot merge -> OBS_r12.json
   python tools/serve_loadgen.py -obs -commit
 
+  # SLO-observatory verdict (ISSUE 14): a two-tenant traffic spike
+  # against a real router + replicas — the high-SLO tenant's burn
+  # alert fires before the low-SLO tenant's, /scale rises during
+  # the spike and decays after, per-tenant device-seconds sum to
+  # the fleet execute total, artifacts byte-equal an un-metered
+  # run -> SLO_r14.json
+  python tools/serve_loadgen.py -slo -commit
+
 Also importable (`run_loadgen`, `run_fleet_loadgen`,
 `run_stacked_loadgen`) — the `-m slow` serve smoke test drives it
 in-process, and tools/fleet_chaos.py + FLEET_r09.json +
@@ -911,6 +919,265 @@ def run_obs_loadgen(workdir: str, timeout: float = 900.0) -> dict:
     }
 
 
+# ----------------------------------------------------------------------
+# SLO-observatory verdict mode (ISSUE 14)
+# ----------------------------------------------------------------------
+
+SLO_CFG = {"lodm": 50.0, "hidm": 56.0, "nsub": 8, "zmax": 0,
+           "numharm": 2, "fold_top": 0, "singlepulse": False,
+           "skip_rfifind": True, "durable_stages": True}
+
+#: per-job end-to-end latency objective: with a spike of same-bucket
+#: jobs on a small fleet, queue wait pushes most jobs past it, so
+#: both tenants accrue bad events — and the strict tenant's budget
+#: burns proportionally faster
+SLO_LATENCY_S = 2.0
+
+#: gold 99.9% (budget 0.1% — any bad event burns hundreds of times
+#: the budgeted rate), bronze 50% (budget 50% — burn can never
+#: exceed 2): at threshold 8 gold must alert and bronze must not,
+#: which is exactly the SLO-priority ordering the verdict pins
+SLO_SPECS = ("gold:0.999:%g" % SLO_LATENCY_S,
+             "bronze:0.5:%g" % SLO_LATENCY_S)
+SLO_WINDOWS = "15:60:8"
+
+
+def _slo_arm(workdir: str, beam: str, jobs_per_tenant: int,
+             metered: bool, timeout: float) -> dict:
+    """One fleet arm (router + 2 in-process replicas): submit a
+    two-tenant spike, sample /scale through it, drain, and collect
+    per-job artifact digests + telemetry.  `metered=False` is the
+    byte-equality reference: PRESTO_TPU_USAGE=0, no SLO specs — an
+    un-metered fleet whose artifacts the metered arm must reproduce
+    byte-for-byte."""
+    from presto_tpu.obs import fleetagg
+    from presto_tpu.serve.fleet import FleetConfig, FleetReplica
+    from presto_tpu.serve.router import (FleetRouter, RouterConfig,
+                                         start_http as router_http)
+    from presto_tpu.serve.server import SearchService, start_http
+    from presto_tpu.serve.usage import UsageLedger
+    os.environ["PRESTO_TPU_USAGE"] = "1" if metered else "0"
+    fleetdir = os.path.join(workdir, "fleet")
+    router = FleetRouter(RouterConfig(
+        fleetdir=fleetdir, high_water=256, poll_s=0.2,
+        heartbeat_timeout=3.0,
+        slo=list(SLO_SPECS) if metered else [],
+        slo_windows=SLO_WINDOWS if metered else "",
+        scale_target_drain_s=5.0, scale_max_replicas=8)).start()
+    rhttpd = router_http(router)
+    url = "http://%s:%d" % rhttpd.server_address[:2]
+    members = []
+    for i in range(2):
+        svc = SearchService(os.path.join(workdir, "rep%d" % i),
+                            queue_depth=64).start()
+        httpd = start_http(svc)
+        addr = "http://%s:%d" % httpd.server_address[:2]
+        rep = FleetReplica(svc, FleetConfig(
+            fleetdir=fleetdir, replica="rep%d" % i, lease_ttl=60.0,
+            heartbeat_s=0.2, heartbeat_timeout=3.0, poll_s=0.05,
+            max_inflight=1, snapshot_s=0.2), addr=addr).start()
+        members.append((svc, rep, httpd))
+    deadline = time.time() + 60.0
+    while time.time() < deadline:
+        router.poll_replicas()
+        if len(router.ready_replicas()) >= 2:
+            break
+        time.sleep(0.2)
+
+    scale_series = []
+
+    def sample_scale(label):
+        s = _http_json(url + "/scale")
+        scale_series.append({"t": round(time.time() - t0, 3),
+                             "label": label,
+                             "wanted": s["wanted_replicas"],
+                             "backlog_jobs":
+                                 s["inputs"]["backlog_jobs"]})
+        return s
+
+    try:
+        t0 = time.time()
+        initial = sample_scale("pre-spike")
+        job_ids = []
+        for i in range(jobs_per_tenant):
+            for tenant in ("gold", "bronze"):
+                view = _http_json(url + "/submit",
+                                  {"rawfiles": [beam],
+                                   "config": dict(SLO_CFG),
+                                   "tenant": tenant})
+                job_ids.append(view["job_id"])
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            sample_scale("spike")
+            views = [router.status(j) for j in job_ids]
+            if all(v and v["state"] in ("done", "failed")
+                   for v in views):
+                break
+            time.sleep(0.5)
+        final = sample_scale("drained")
+        states = {j: router.status(j)["state"] for j in job_ids}
+        alert_ts = {}
+        for ev in _http_json(url + "/events?n=2000")["events"]:
+            if ev["kind"] == "slo-burn-alert":
+                alert_ts.setdefault(ev["tenant"], ev["ts"] - t0)
+        digests = {}
+        for jid in job_ids:
+            try:
+                detail = json.load(open(os.path.join(
+                    fleetdir, "jobs", jid, "result.json")))
+                digests[jid] = detail["artifacts"]
+            except (OSError, ValueError):
+                digests[jid] = None
+    finally:
+        for svc, rep, httpd in members:
+            httpd.shutdown()
+            svc.shutdown(drain=True, timeout=30.0)
+        rhttpd.shutdown()
+        router.stop()
+    usage = UsageLedger(fleetdir, enabled=True)
+    # drain published tombstone snapshots: counters + histograms of
+    # every commit survive the teardown for the conservation check
+    agg = fleetagg.aggregate(fleetdir)
+    e2e = fleetagg.rollup(agg["merged"], "job_e2e_seconds", "phase")
+    return {
+        "metered": metered,
+        "fleetdir": fleetdir,
+        "states": states,
+        "digests": digests,
+        "scale_series": scale_series,
+        "initial_wanted": initial["wanted_replicas"],
+        "peak_wanted": max(s["wanted"] for s in scale_series),
+        "final_wanted": final["wanted_replicas"],
+        "alert_ts": alert_ts,
+        "usage_raw": usage.raw_rows(),
+        "usage_rows": usage.rows(),
+        "usage_file_exists": os.path.exists(usage.path),
+        "job_e2e_execute": e2e.get("execute", {}),
+    }
+
+
+def run_slo_loadgen(workdir: str, jobs_per_tenant: int = 4,
+                    timeout: float = 900.0) -> dict:
+    """The SLO_r14.json verdict (SLO observatory):
+
+    1. a two-tenant traffic spike through a real router + 2 replicas
+       drives both tenants past the per-job latency objective; the
+       high-SLO tenant (gold, 99.9%) fires its multi-window burn
+       alert while the low-SLO tenant (bronze, 50%) never can —
+       burn-rate alerts fire in SLO-priority order;
+    2. the advisory /scale signal rises above its pre-spike value
+       while the backlog is queued and decays once drained;
+    3. per-tenant device-seconds in the durable usage ledger sum
+       EXACTLY to the fleet-aggregated execute-phase total (one row
+       per committed job, fence-checked);
+    4. every artifact is byte-identical to an un-metered reference
+       fleet (PRESTO_TPU_USAGE=0, no SLO specs): metering is
+       bookkeeping, never part of the data path.
+    """
+    from presto_tpu.obs import slo as slolib
+    beam = make_beams(workdir, 1, nsamp=4096, nchan=8)[0]
+    prev_usage = os.environ.get("PRESTO_TPU_USAGE")
+    try:
+        reference = _slo_arm(os.path.join(workdir, "unmetered"),
+                             beam, jobs_per_tenant, metered=False,
+                             timeout=timeout)
+        metered = _slo_arm(os.path.join(workdir, "metered"),
+                           beam, jobs_per_tenant, metered=True,
+                           timeout=timeout)
+    finally:
+        if prev_usage is None:
+            os.environ.pop("PRESTO_TPU_USAGE", None)
+        else:
+            os.environ["PRESTO_TPU_USAGE"] = prev_usage
+
+    n_jobs = 2 * jobs_per_tenant
+    done_rows = [r for r in metered["usage_raw"]
+                 if r.get("state") == "done"]
+    per_job = {}
+    for r in done_rows:
+        per_job[r["job_id"]] = per_job.get(r["job_id"], 0) + 1
+    by_tenant = {}
+    for r in done_rows:
+        by_tenant.setdefault(r["tenant"], []).append(
+            float(r["phases"].get("execute") or 0.0))
+    usage_total = sum(x for xs in by_tenant.values() for x in xs)
+    fleet_total = float(metered["job_e2e_execute"].get("sum") or 0.0)
+    rollup = slolib.usage_rollup(metered["usage_rows"])
+
+    gold_ts = metered["alert_ts"].get("gold")
+    bronze_ts = metered["alert_ts"].get("bronze")
+    checks = {
+        "all_done": (
+            all(s == "done" for s in metered["states"].values())
+            and all(s == "done"
+                    for s in reference["states"].values())),
+        "byte_equal_unmetered": (
+            list(metered["digests"].values())
+            == list(reference["digests"].values())
+            and all(metered["digests"].values())),
+        "unmetered_arm_wrote_no_usage":
+            not reference["usage_file_exists"],
+        "gold_alert_fired": gold_ts is not None,
+        "alerts_in_slo_priority_order": (
+            gold_ts is not None
+            and (bronze_ts is None or gold_ts < bronze_ts)),
+        "scale_rises_during_spike":
+            metered["peak_wanted"] > metered["initial_wanted"],
+        "scale_decays_after_drain":
+            metered["final_wanted"] < metered["peak_wanted"],
+        "usage_exactly_once_per_job": (
+            len(per_job) == n_jobs
+            and all(n == 1 for n in per_job.values())),
+        "device_seconds_sum_to_fleet_execute_total": (
+            int(metered["job_e2e_execute"].get("count") or 0)
+            == len(done_rows)
+            and abs(usage_total - fleet_total)
+            <= 1e-6 * max(fleet_total, 1.0)),
+    }
+    print("# slo verdict: gold alert @%ss bronze %s  scale %d->%d->"
+          "%d  usage %.3fs vs fleet %.3fs"
+          % ("%.2f" % gold_ts if gold_ts is not None else "?",
+             "@%.2fs" % bronze_ts if bronze_ts is not None
+             else "never",
+             metered["initial_wanted"], metered["peak_wanted"],
+             metered["final_wanted"], usage_total, fleet_total),
+          file=sys.stderr)
+    return {
+        "mode": "slo",
+        "config": SLO_CFG,
+        "slo_specs": list(SLO_SPECS),
+        "slo_windows": SLO_WINDOWS,
+        "jobs_per_tenant": jobs_per_tenant,
+        "alert_ts_s": {t: round(v, 3)
+                       for t, v in metered["alert_ts"].items()},
+        "scale": {
+            "initial": metered["initial_wanted"],
+            "peak": metered["peak_wanted"],
+            "final": metered["final_wanted"],
+            "series": metered["scale_series"],
+        },
+        "usage": rollup,
+        "device_seconds": {
+            "per_tenant": {t: round(sum(xs), 6)
+                           for t, xs in sorted(by_tenant.items())},
+            "usage_total": round(usage_total, 6),
+            "fleet_execute_total": round(fleet_total, 6),
+            "fleet_execute_count":
+                int(metered["job_e2e_execute"].get("count") or 0),
+        },
+        "checks": checks,
+        "verdict": "PASS" if all(checks.values()) else "FAIL",
+        "caveat": (
+            "CI container exposes ONE cpu core, so absolute phase "
+            "times and the alert timestamps are serialized worst "
+            "cases; the pinned wins are the SLO-priority alert "
+            "ordering, the rise-and-decay of the advisory /scale "
+            "signal, exact device-seconds conservation between the "
+            "usage ledger and the fleet aggregation, and "
+            "byte-equality against the un-metered arm."),
+    }
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="serve_loadgen")
     p.add_argument("-url", type=str, default=None,
@@ -944,13 +1211,22 @@ def main(argv=None) -> int:
                         "job_e2e_seconds p99 matching an "
                         "independent snapshot merge (-> "
                         "OBS_r12.json with -commit)")
+    p.add_argument("-slo", action="store_true",
+                   help="SLO-observatory verdict mode: a two-tenant "
+                        "spike against a real router + replicas — "
+                        "burn alerts in SLO-priority order, /scale "
+                        "rise + decay, exact device-seconds "
+                        "conservation, byte-equality vs an "
+                        "un-metered arm (-> SLO_r14.json with "
+                        "-commit)")
     p.add_argument("-Ns", type=str, default="1,4,8",
                    help="Stacked/dag mode: comma list of batch sizes")
     p.add_argument("-commit", action="store_true",
-                   help="Stacked/dag/obs mode: write the report to "
-                        "<repo>/SERVE_BATCH_r10.json (stacked), "
-                        "<repo>/DAG_r11.json (dag), or "
-                        "<repo>/OBS_r12.json (obs)")
+                   help="Stacked/dag/obs/slo mode: write the report "
+                        "to <repo>/SERVE_BATCH_r10.json (stacked), "
+                        "<repo>/DAG_r11.json (dag), "
+                        "<repo>/OBS_r12.json (obs), or "
+                        "<repo>/SLO_r14.json (slo)")
     p.add_argument("-beams", type=int, default=4)
     p.add_argument("-rate", type=float, default=2.0,
                    help="Submission rate, jobs/s")
@@ -961,13 +1237,30 @@ def main(argv=None) -> int:
     p.add_argument("-timeout", type=float, default=600.0)
     args = p.parse_args(argv)
     if (not args.url and not args.selfhost and not args.replicas
-            and not args.stacked and not args.dag and not args.obs):
+            and not args.stacked and not args.dag and not args.obs
+            and not args.slo):
         p.error("need -url, -selfhost, -replicas, -stacked, -dag, "
-                "or -obs")
+                "-obs, or -slo")
 
     sys.path.insert(0, os.path.dirname(
         os.path.dirname(os.path.abspath(__file__))))
     workdir = args.workdir or tempfile.mkdtemp(prefix="loadgen_")
+
+    if args.slo:
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        from presto_tpu.apps.common import ensure_backend
+        ensure_backend()
+        report = run_slo_loadgen(workdir, timeout=args.timeout)
+        text = json.dumps(report, indent=1, sort_keys=True)
+        if args.commit:
+            out = os.path.join(os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__))), "SLO_r14.json")
+            with open(out, "w") as f:
+                f.write(text + "\n")
+            print("serve_loadgen: report -> %s" % out)
+        else:
+            print(text)
+        return 0 if report["verdict"] == "PASS" else 1
 
     if args.obs:
         os.environ.setdefault("JAX_PLATFORMS", "cpu")
