@@ -323,6 +323,11 @@ def run(args):
             nread += blocklen
 
     from presto_tpu.utils.timing import print_percent_complete
+    from presto_tpu.obs import costmodel, jaxtel
+    # kernel-cost accounting rides the survey's obs handle (threaded
+    # through the process seam); a bare CLI run has no handle and
+    # every call below is one branch
+    tel_obs = getattr(seam, "obs", None) if use_seam else None
     nblocks = 0
     pct = -1
     ingest = fusion.DoubleBufferedIngest(_produce_blocks(),
@@ -342,6 +347,13 @@ def run(args):
                     if prev_sub is None:
                         sub = sh_plan.prime(prev_raw, cur)
                     else:
+                        # unit cost of ONE device's program; the
+                        # dispatch count carries the fan-out width
+                        costmodel.probe(tel_obs, "dedisp",
+                                        sh_plan.steps[0], prev_raw[0],
+                                        cur[0], prev_sub[0])
+                        jaxtel.note_dispatch(tel_obs, "dedisp",
+                                             len(sh_plan.steps))
                         sub, series = sh_plan.step(prev_raw, cur,
                                                    prev_sub)
                         outs.append(series)
@@ -363,6 +375,9 @@ def run(args):
                     # (subbands + DM fan-out + downsample) instead of
                     # three — the link's dispatch floor is the
                     # single-DM regime's bound (BENCH_r05 config 1)
+                    costmodel.probe(tel_obs, "dedisp", block_step,
+                                    prev_raw, cur, prev_sub)
+                    jaxtel.note_dispatch(tel_obs, "dedisp")
                     sub, series = block_step(prev_raw, cur, prev_sub)
                     # stays on device: one download at the end (the
                     # tunnel pays seconds per transfer)

@@ -141,6 +141,31 @@ def collect(workdir: str) -> dict:
             "families": fams,
         }
 
+    # kernel observatory: per-kind silicon cost + roofline placement
+    # (obs/costmodel wrote kernel_costs.json at flush; peaks come from
+    # the file when the microbench already ran on the survey host,
+    # else from this host's fingerprint-cached measurement — guarded:
+    # a host without a backend renders "(no peaks)" rows)
+    from presto_tpu.obs import costmodel as _costmodel
+    from presto_tpu.obs import roofline as _roofline
+    costs = _costmodel.load_costs(workdir)
+    if costs:
+        peaks = costs.get("peaks")
+        peaks_source = "survey host" if peaks else None
+        if not peaks:
+            try:
+                peaks = _roofline.device_peaks(measure=True)
+                peaks_source = "report host" if peaks else None
+            except Exception:
+                peaks = None
+        info["kernel_costs"] = {
+            "kinds": costs.get("kinds", {}),
+            "unavailable": costs.get("unavailable", {}),
+            "peaks": peaks,
+            "peaks_source": peaks_source,
+            "roofline": _roofline.roofline_rows(costs, peaks),
+        }
+
     quality = sorted(glob.glob(os.path.join(workdir,
                                             "*_quality.json")))
     if quality:
@@ -199,6 +224,24 @@ def collect_fleet(fleetdir: str,
         info["latency"] = fleetagg.rollup(merged,
                                           "latency_seconds",
                                           "name")
+        # per-stage device-chain dispatch counts (+ the kernel-cost
+        # join when any replica harvested unit costs) — the
+        # jax_dispatches_total{kind} data that was previously only
+        # visible in raw /metrics
+        disp = fleetagg.counter_rollup(merged, "jax_dispatches_total",
+                                       "kind")
+        if disp:
+            flops = fleetagg.counter_rollup(merged,
+                                            "kernel_flops_total",
+                                            "kind")
+            hbm = fleetagg.counter_rollup(merged,
+                                          "kernel_hbm_bytes_total",
+                                          "kind")
+            info["dispatches"] = {
+                kind: {"dispatches": n,
+                       "flops_total": flops.get(kind),
+                       "hbm_bytes_total": hbm.get(kind)}
+                for kind, n in disp.items()}
 
     # SLO observatory: device-seconds usage, per-tenant budget/burn,
     # and the advisory /scale signal — recomputed from the durable
@@ -325,6 +368,19 @@ def render_fleet(info: dict, file=None) -> None:
         for phase, st in e2e.items():
             w("  %-12s n=%-5d p50=%8.3fs  p99=%8.3fs"
               % (phase, st["count"], st["p50"], st["p99"]))
+
+    disp = info.get("dispatches")
+    if disp:
+        w()
+        w("Device dispatches (merged jax_dispatches_total{kind}):")
+        for kind, ent in disp.items():
+            extra = ""
+            if ent.get("flops_total"):
+                extra = "  %10.3g FLOP  %s" % (
+                    ent["flops_total"],
+                    _fmt_bytes(ent.get("hbm_bytes_total") or 0.0))
+            w("  %-16s %8d dispatch(es)%s"
+              % (kind, int(ent["dispatches"]), extra))
 
     usage = info.get("usage")
     if usage:
@@ -492,6 +548,47 @@ def render(info: dict, max_spans: int = 15, file=None) -> None:
               % (family, f["shapes"], f["db_hits"], f["defaults"]))
             for skey, config in sorted(f.get("configs", {}).items()):
                 w("      %-24s %s" % (skey, config))
+
+    kc = info.get("kernel_costs")
+    if kc:
+        w()
+        peaks = kc.get("peaks")
+        if peaks:
+            w("Roofline (kernel_costs.json): peak %.2f GFLOP/s, "
+              "%.2f GB/s, ridge %.2f FLOP/B  [peaks: %s]"
+              % (peaks["flops_per_s"] / 1e9,
+                 peaks["bytes_per_s"] / 1e9,
+                 peaks["flops_per_s"] / peaks["bytes_per_s"],
+                 kc.get("peaks_source") or "?"))
+        else:
+            w("Roofline (kernel_costs.json): no device peaks "
+              "available — intensities only")
+        w("  %-14s %9s %12s %12s %9s %8s  %s"
+          % ("kind", "dispatch", "FLOP/disp", "HBMB/disp",
+             "FLOP/B", "HBM%", "verdict"))
+        for row in kc.get("roofline", []):
+            fl, by = (row.get("flops_per_dispatch"),
+                      row.get("hbm_bytes_per_dispatch"))
+            w("  %-14s %9d %12s %12s %9s %7.1f%%  %s"
+              % (row["kind"], row["dispatches"],
+                 "%.3g" % fl if fl is not None else "?",
+                 _fmt_bytes(by) if by is not None else "?",
+                 "%.2f" % row["intensity"]
+                 if row.get("intensity") is not None else "?",
+                 100.0 * row.get("hbm_share", 0.0),
+                 row.get("verdict", "?")))
+        dd = next((r for r in kc.get("roofline", [])
+                   if r["kind"] == "dedisp"), None)
+        if dd is not None:
+            w("  dedispersion HBM-byte share: %.1f%% of attributed "
+              "traffic (%s over %d dispatches) — the Hot-loop-v2 "
+              "gating number"
+              % (100.0 * dd.get("hbm_share", 0.0),
+                 _fmt_bytes(dd.get("hbm_bytes_total", 0.0) or 0.0),
+                 dd["dispatches"]))
+        for reason, n in sorted((kc.get("unavailable") or {}).items()):
+            w("  !! cost model unavailable %dx (%s) — affected kinds "
+              "report no unit cost" % (n, reason))
 
     for q in info.get("quality", []):
         w()

@@ -1,4 +1,4 @@
-"""obs-coverage: the instrumentation-coverage contract (14 checks).
+"""obs-coverage: the instrumentation-coverage contract (15 checks).
 
 Formerly ``tools/obs_lint.py`` (a thin shim remains there for the
 historical entry point); now the fifth presto-lint family.  The
@@ -68,7 +68,14 @@ code path cannot ship silently:
      usage metering at the fence-checked commit and the burn/scale
      decision signals are the contract future control-plane PRs
      (autoscaler, device-seconds admission) inherit, so they may
-     neither go dark nor go stale.
+     neither go dark nor go stale;
+  15. the kernel observatory (obs/costmodel.py + obs/roofline.py +
+     bench.py): COST_SPANS (`obs:roofline-probe`) / COST_METRICS
+     (kernel_flops_total, kernel_hbm_bytes_total,
+     cost_model_unavailable) pinned BOTH directions (and as a subset
+     of METRICS) — the per-kind FLOP/byte dispatch join is the
+     measurement rig every remaining perf item (Pallas dedisp, GPU
+     backend, learned tuner) is judged by.
 
 Run via tools/presto_lint.py (exit-1 CLI over every family), the
 legacy tools/obs_lint.py shim, or tests/test_obs_lint.py.
@@ -622,6 +629,51 @@ def lint(root: Optional[str] = None) -> List[str]:
         problems.append(
             "slo layer: metric %r is not registered in "
             "obs/taxonomy.SLO_METRICS" % name)
+
+    # 15. the kernel observatory (obs/costmodel.py + obs/roofline.py
+    # + bench.py): COST_SPANS / COST_METRICS pinned BOTH directions
+    # (and as a subset of METRICS) — the per-kind FLOP/byte dispatch
+    # join is the measurement rig every remaining perf item is judged
+    # by, so it may neither go dark nor go stale.  The `obs:` span
+    # prefix scopes the check (bench.py also opens bench:* spans,
+    # which belong to no catalog).
+    cost_files = ("presto_tpu/obs/costmodel.py",
+                  "presto_tpu/obs/roofline.py",
+                  "bench.py")
+    co_spans: Set[str] = set()
+    co_metrics: Set[str] = set()
+    for rel in cost_files:
+        try:
+            src = _read(rel, root)
+        except OSError:
+            continue
+        co_spans |= set(SPAN_RE.findall(src))
+        co_metrics |= set(METRIC_RE.findall(src))
+    for name in sorted(taxonomy.COST_METRICS - taxonomy.METRICS):
+        problems.append(
+            "obs/taxonomy.py: COST_METRICS lists %r which is not in "
+            "METRICS" % name)
+    for s in sorted(taxonomy.COST_SPANS
+                    - {x for x in co_spans if x.startswith("obs:")}):
+        problems.append(
+            "obs/taxonomy.py: COST_SPANS lists %r but the cost layer "
+            "never opens it" % s)
+    for s in sorted({x for x in co_spans if x.startswith("obs:")}
+                    - taxonomy.COST_SPANS):
+        problems.append(
+            "cost layer: span %r is not registered in "
+            "obs/taxonomy.COST_SPANS" % s)
+    for name in sorted(taxonomy.COST_METRICS - co_metrics):
+        problems.append(
+            "obs/taxonomy.py: COST_METRICS lists %r but the cost "
+            "layer never registers it" % name)
+    for name in sorted({x for x in co_metrics
+                        if x.startswith("kernel_")
+                        or x.startswith("cost_model_")}
+                       - taxonomy.COST_METRICS):
+        problems.append(
+            "cost layer: metric %r is not registered in "
+            "obs/taxonomy.COST_METRICS" % name)
     return problems
 
 

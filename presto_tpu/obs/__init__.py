@@ -140,6 +140,11 @@ class Observability:
                         "".join(_json.dumps(s.to_json(),
                                             sort_keys=True) + "\n"
                                 for s in spans))
+            # kernel-cost book -> kernel_costs.json (the roofline
+            # section presto-report renders); no-op when nothing was
+            # harvested, never runs device work
+            from presto_tpu.obs import costmodel
+            costmodel.write_costs(self, d)
         except Exception:
             pass
 

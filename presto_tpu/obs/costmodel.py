@@ -1,0 +1,414 @@
+"""XLA cost attribution per plan kind (obs layer).
+
+The obs stack sees processes, fleets, and SLOs but was blind below the
+dispatch boundary: ``jax_dispatches_total{kind}`` counts how often each
+jitted stage launches, not what a launch *costs the silicon*.  This
+module harvests XLA's own cost model — ``Compiled.cost_analysis()`` /
+``memory_analysis()`` (falling back to the HLO-level
+``Lowered.cost_analysis()`` where backend compile is unavailable) —
+once per (plan kind, input signature), and joins the per-dispatch unit
+cost with the existing dispatch accounting so every survey/serve stage
+gets cumulative FLOPs, HBM bytes-accessed, and operational intensity:
+
+  kernel_flops_total{kind}        cumulative FLOPs attributed per kind
+  kernel_hbm_bytes_total{kind}    cumulative bytes-accessed per kind
+  cost_model_unavailable{reason}  harvest failures (backend/version
+                                  gaps) — degraded, never a crash
+
+Harvest points:
+
+  * ``probe(obs, kind, fn, *args)`` at the dispatch sites that already
+    call ``jaxtel.note_dispatch`` (dedisp / rfft_batch / accel_search /
+    sp_search): AOT-lowers the *exact* jitted program about to run,
+    under an ``obs:roofline-probe`` span, once per shape;
+  * ``jaxtel.note_compile(..., compiled=...)``: plan-cache and AOT
+    call sites hand over anything that quacks like a compiled
+    executable (has ``cost_analysis``); non-harvestable plan objects
+    are silently skipped (absence is not a backend failure).
+
+Every dispatch then attributes ``unit * n`` onto the counters AND onto
+the current span's ``flops``/``hbm_bytes`` attributes, so the Perfetto
+export carries per-chunk silicon cost.  ``Observability.flush`` writes
+the book as ``<workdir>/kernel_costs.json`` (schema-versioned), the
+file ``presto-report`` renders as the roofline section and ``bench.py``
+embeds as ``inclusive_breakdown.kernel_costs``.
+
+Degradation contract (pinned by tests/test_costmodel.py): any backend
+or jax version where cost analysis returns ``None``, raises, or is
+missing entirely yields a ``cost_model_unavailable{reason}`` count and
+an explicit "(unavailable)" report row — never an exception on the
+search path.  ``PRESTO_TPU_COST=0`` disables harvesting outright.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Dict, Optional
+
+#: kernel_costs.json schema (bumping orphans old files, never crashes
+#: a reader — presto-report treats a stale schema as absent)
+COSTS_SCHEMA = 1
+
+#: env kill switch: PRESTO_TPU_COST=0 disables all harvesting
+ENV_SWITCH = "PRESTO_TPU_COST"
+
+
+def enabled() -> bool:
+    return os.environ.get(ENV_SWITCH, "1") != "0"
+
+
+# ----------------------------------------------------------------------
+# the per-handle cost book
+# ----------------------------------------------------------------------
+
+class KindCost:
+    """Per-dispatch unit cost of one plan kind's compiled program."""
+
+    __slots__ = ("kind", "flops", "hbm_bytes", "peak_bytes",
+                 "argument_bytes", "output_bytes", "source",
+                 "harvested_at")
+
+    def __init__(self, kind: str, flops: float, hbm_bytes: float,
+                 peak_bytes: Optional[int] = None,
+                 argument_bytes: Optional[int] = None,
+                 output_bytes: Optional[int] = None,
+                 source: str = "compiled"):
+        self.kind = kind
+        self.flops = float(flops)
+        self.hbm_bytes = float(hbm_bytes)
+        self.peak_bytes = peak_bytes
+        self.argument_bytes = argument_bytes
+        self.output_bytes = output_bytes
+        self.source = source
+        self.harvested_at = time.time()
+
+    def to_json(self) -> dict:
+        return {
+            "flops_per_dispatch": self.flops,
+            "hbm_bytes_per_dispatch": self.hbm_bytes,
+            "peak_bytes": self.peak_bytes,
+            "argument_bytes": self.argument_bytes,
+            "output_bytes": self.output_bytes,
+            "source": self.source,
+        }
+
+
+class CostBook:
+    """Thread-safe registry of per-kind unit costs on one
+    Observability handle.  A kind's unit cost is the LAST successful
+    harvest (re-probes with a new shape update it — attribution tracks
+    the geometry actually in flight); failed (kind, signature) pairs
+    are remembered so a broken backend is asked exactly once per
+    shape."""
+
+    def __init__(self):
+        self._lock = threading.Lock()  # presto-lint: guards(_units, _tried, _pending)
+        self._units: Dict[str, KindCost] = {}
+        self._tried: set = set()
+        # dispatches counted before their kind's first harvest landed
+        # (e.g. the survey notes "accel_search" just before the call
+        # that probes it) — backfilled into the counters at record()
+        self._pending: Dict[str, int] = {}
+
+    def seen(self, kind: str, sig) -> bool:
+        with self._lock:
+            return (kind, sig) in self._tried
+
+    def mark(self, kind: str, sig) -> None:
+        with self._lock:
+            self._tried.add((kind, sig))
+
+    def record(self, unit: KindCost) -> int:
+        """Install a unit cost; returns how many earlier dispatches
+        of this kind were waiting for it (the caller backfills the
+        counters)."""
+        with self._lock:
+            self._units[unit.kind] = unit
+            return self._pending.pop(unit.kind, 0)
+
+    def defer(self, kind: str, n: int) -> None:
+        with self._lock:
+            self._pending[kind] = self._pending.get(kind, 0) + n
+
+    def unit(self, kind: str) -> Optional[KindCost]:
+        with self._lock:
+            return self._units.get(kind)
+
+    def units(self) -> Dict[str, KindCost]:
+        with self._lock:
+            return dict(self._units)
+
+
+def book(obs) -> Optional[CostBook]:
+    """The handle's cost book (lazily attached); None when the handle
+    is disabled or harvesting is switched off."""
+    if obs is None or not getattr(obs, "enabled", False) \
+            or not enabled():
+        return None
+    bk = getattr(obs, "_cost_book", None)
+    if bk is None:
+        bk = obs._cost_book = CostBook()
+    return bk
+
+
+# ----------------------------------------------------------------------
+# harvesting
+# ----------------------------------------------------------------------
+
+def _signature(args, kwargs) -> tuple:
+    """Cheap shape/dtype identity of a call (what decides whether a
+    kind needs re-probing)."""
+    def one(a):
+        shp = getattr(a, "shape", None)
+        if shp is not None:
+            return (tuple(shp), str(getattr(a, "dtype", "?")))
+        if isinstance(a, (list, tuple)):
+            return tuple(one(x) for x in a)
+        return repr(a)[:64]
+    return (tuple(one(a) for a in args),
+            tuple(sorted((k, one(v)) for k, v in kwargs.items())))
+
+
+def _cost_dict(raw) -> Optional[dict]:
+    """Normalize cost_analysis() output across jax versions: older
+    jaxlibs return a one-element list of dicts, newer return the dict
+    itself; anything else is unusable."""
+    if isinstance(raw, (list, tuple)):
+        raw = raw[0] if raw else None
+    return raw if isinstance(raw, dict) else None
+
+
+def _note_unavailable(obs, reason: str) -> None:
+    if obs is None or not obs.enabled:
+        return
+    obs.metrics.counter(
+        "cost_model_unavailable",
+        "Cost-model harvest failures (backend/version gaps)",
+        ("reason",)).labels(reason=reason).inc()
+
+
+def harvest_compiled(compiled) -> KindCost:
+    """Unit cost off a compiled executable (jax ``Compiled`` or
+    anything with the same duck type).  Raises on any gap — callers
+    route failures through the unavailable counter."""
+    cost = _cost_dict(compiled.cost_analysis())
+    if cost is None or "flops" not in cost:
+        raise ValueError("cost_analysis returned no flops")
+    peak = arg_b = out_b = None
+    try:
+        mem = compiled.memory_analysis()
+        arg_b = int(mem.argument_size_in_bytes)
+        out_b = int(mem.output_size_in_bytes)
+        peak = (arg_b + out_b + int(mem.temp_size_in_bytes)
+                - int(getattr(mem, "alias_size_in_bytes", 0)))
+    except Exception:
+        pass                     # memory stats are best-effort extras
+    return KindCost("?", flops=max(float(cost.get("flops", 0.0)), 0.0),
+                    hbm_bytes=max(
+                        float(cost.get("bytes accessed", 0.0)), 0.0),
+                    peak_bytes=peak, argument_bytes=arg_b,
+                    output_bytes=out_b, source="compiled")
+
+
+def probe(obs, kind: str, fn, *args, **kwargs) -> Optional[KindCost]:
+    """Harvest the unit cost of the jitted callable ``fn`` for this
+    call signature, once per (kind, signature), under an
+    ``obs:roofline-probe`` span.  ``fn`` must be a jax-jitted function
+    (has ``.lower``); the probe only lowers/compiles — it never
+    executes, so instrumented paths stay byte-identical.
+
+    Degrades (``cost_model_unavailable{reason}`` + None) when the
+    backend/version cannot lower, compile, or cost-analyze."""
+    bk = book(obs)
+    if bk is None:
+        return None
+    sig = _signature(args, kwargs)
+    if bk.seen(kind, sig):
+        return bk.unit(kind)
+    bk.mark(kind, sig)
+    sp = obs.span("obs:roofline-probe", kind=kind)
+    try:
+        lower = getattr(fn, "lower", None)
+        if lower is None:
+            raise TypeError("not a jitted callable")
+        lowered = lower(*args, **kwargs)
+        try:
+            unit = harvest_compiled(lowered.compile())
+        except Exception:
+            # backend compile (or compiled-level analysis) gap:
+            # degrade to the HLO-level estimate
+            cost = _cost_dict(lowered.cost_analysis())
+            if cost is None or "flops" not in cost:
+                raise
+            unit = KindCost(
+                kind, flops=max(float(cost.get("flops", 0.0)), 0.0),
+                hbm_bytes=max(
+                    float(cost.get("bytes accessed", 0.0)), 0.0),
+                source="lowered")
+        unit.kind = kind
+    except Exception as e:
+        _note_unavailable(obs, type(e).__name__)
+        sp.finish("error: %s" % type(e).__name__)
+        return None
+    _install(obs, bk, unit)
+    sp.finish()
+    return unit
+
+
+def _install(obs, bk: CostBook, unit: KindCost) -> None:
+    """Record a unit and backfill any dispatches counted before the
+    harvest landed (counters only — their spans are long gone)."""
+    pending = bk.record(unit)
+    if pending:
+        _bump_counters(obs, unit.kind, unit, pending)
+
+
+def note_compiled(obs, kind: str, compiled) -> Optional[KindCost]:
+    """``jaxtel.note_compile``'s harvest hook: record the unit cost of
+    a freshly built executable when the call site can hand one over.
+    Objects without a ``cost_analysis`` (e.g. plan-cache AccelSearch
+    bundles) are skipped silently — only a *failed* harvest attempt
+    counts as unavailable."""
+    bk = book(obs)
+    if bk is None or compiled is None:
+        return None
+    if not hasattr(compiled, "cost_analysis"):
+        return None
+    sp = obs.span("obs:roofline-probe", kind=kind)
+    try:
+        unit = harvest_compiled(compiled)
+        unit.kind = kind
+    except Exception as e:
+        _note_unavailable(obs, type(e).__name__)
+        sp.finish("error: %s" % type(e).__name__)
+        return None
+    _install(obs, bk, unit)
+    sp.finish()
+    return unit
+
+
+# ----------------------------------------------------------------------
+# the dispatch join
+# ----------------------------------------------------------------------
+
+def _bump_counters(obs, kind: str, unit: KindCost, n: int) -> None:
+    reg = obs.metrics
+    reg.counter("kernel_flops_total",
+                "Cumulative XLA-modeled FLOPs per plan kind",
+                ("kind",)).labels(kind=kind).inc(unit.flops * n)
+    reg.counter("kernel_hbm_bytes_total",
+                "Cumulative XLA-modeled bytes-accessed per plan kind",
+                ("kind",)).labels(kind=kind).inc(unit.hbm_bytes * n)
+
+
+def attribute_dispatch(obs, kind: str, n: int = 1) -> None:
+    """Join one (batched) dispatch with its kind's unit cost:
+    cumulative counters plus flops/hbm_bytes attributes on the current
+    span (the chunk spans the survey already opens), so the Perfetto
+    export carries silicon cost per chunk.  A dispatch counted before
+    its kind's first harvest is deferred and backfilled into the
+    counters when the unit lands (the survey notes "accel_search"
+    just before the call that probes it).  One dict lookup + two
+    counter incs when a unit exists; one branch otherwise."""
+    bk = book(obs)
+    if bk is None:
+        return
+    unit = bk.unit(kind)
+    if unit is None:
+        bk.defer(kind, n)
+        return
+    _bump_counters(obs, kind, unit, n)
+    sp = obs.tracer.current()
+    if sp is not None:
+        sp.set_attr("flops",
+                    sp.attrs.get("flops", 0.0) + unit.flops * n)
+        sp.set_attr("hbm_bytes",
+                    sp.attrs.get("hbm_bytes", 0.0)
+                    + unit.hbm_bytes * n)
+
+
+# ----------------------------------------------------------------------
+# snapshot / export
+# ----------------------------------------------------------------------
+
+def _counter_by_label(obs, name: str, label: str) -> Dict[str, float]:
+    fam = obs.metrics.get(name)
+    if fam is None:
+        return {}
+    out: Dict[str, float] = {}
+    for labels, child in fam.children():
+        key = dict(labels).get(label, "")
+        out[key] = out.get(key, 0.0) + child.value
+    return out
+
+
+def snapshot(obs) -> dict:
+    """The cost book joined with the live dispatch counters — the
+    ``kernel_costs`` block of serve /metrics and bench.py.  Returns
+    ``{}`` when nothing was harvested (disabled handles included)."""
+    bk = book(obs)
+    if bk is None:
+        return {}
+    units = bk.units()
+    unavailable = _counter_by_label(obs, "cost_model_unavailable",
+                                    "reason")
+    if not units and not unavailable:
+        return {}
+    dispatches = _counter_by_label(obs, "jax_dispatches_total", "kind")
+    flops_tot = _counter_by_label(obs, "kernel_flops_total", "kind")
+    bytes_tot = _counter_by_label(obs, "kernel_hbm_bytes_total",
+                                  "kind")
+    kinds = {}
+    for kind in sorted(set(units) | set(dispatches)):
+        unit = units.get(kind)
+        ent: dict = {"dispatches": int(dispatches.get(kind, 0))}
+        if unit is not None:
+            ent.update(unit.to_json())
+            ent["flops_total"] = flops_tot.get(kind, 0.0)
+            ent["hbm_bytes_total"] = bytes_tot.get(kind, 0.0)
+            if unit.hbm_bytes > 0:
+                ent["intensity"] = unit.flops / unit.hbm_bytes
+        kinds[kind] = ent
+    return {
+        "schema": COSTS_SCHEMA,
+        "kinds": kinds,
+        "unavailable": {k: int(v)
+                        for k, v in sorted(unavailable.items())},
+    }
+
+
+def write_costs(obs, dirpath: str) -> Optional[str]:
+    """Export the book as ``<dirpath>/kernel_costs.json`` (atomic;
+    no-op when nothing was harvested).  Peaks ride along when the
+    roofline microbench has already cached them for this fingerprint —
+    the export never runs device work itself."""
+    snap = snapshot(obs)
+    if not snap:
+        return None
+    from presto_tpu.obs import roofline
+    try:
+        snap["peaks"] = roofline.device_peaks(obs=obs, measure=False)
+    except Exception:
+        snap["peaks"] = None
+    import json
+    from presto_tpu.io.atomic import atomic_write_text
+    path = os.path.join(dirpath, "kernel_costs.json")
+    atomic_write_text(path, json.dumps(snap, indent=1,
+                                       sort_keys=True) + "\n")
+    return path
+
+
+def load_costs(dirpath: str) -> Optional[dict]:
+    """Defensive read of a workdir's kernel_costs.json (None on
+    absence, corruption, or a stale schema)."""
+    import json
+    try:
+        with open(os.path.join(dirpath, "kernel_costs.json")) as f:
+            raw = json.load(f)
+    except (OSError, ValueError):
+        return None
+    if not isinstance(raw, dict) or raw.get("schema") != COSTS_SCHEMA:
+        return None
+    return raw

@@ -325,6 +325,22 @@ def rollup(merged: dict, name: str,
     return out
 
 
+def counter_rollup(merged: dict, name: str,
+                   label: str) -> Dict[str, float]:
+    """Counter rollup across every OTHER label: sum the series
+    sharing each value of ``label`` (e.g. jax_dispatches_total by
+    kind, across replicas) — the fleet-wide per-stage dispatch table
+    presto-report renders."""
+    fam = merged.get(name)
+    if fam is None or fam["kind"] != "counter":
+        return {}
+    acc: Dict[str, float] = {}
+    for s in fam["series"].values():
+        v = str(s["labels"].get(label, ""))
+        acc[v] = acc.get(v, 0.0) + float(s.get("value", 0.0))
+    return dict(sorted(acc.items()))
+
+
 def render_prometheus(merged: dict) -> str:
     """Prometheus text exposition of a merged state (the
     `GET /fleet/metrics?format=prometheus` body).  Histogram series

@@ -14,6 +14,11 @@ survey driver (pipeline/survey.py) report them per plan bucket:
   jax_live_buffer_bytes           current live device allocation
   jax_live_buffer_hwm_bytes       high-water mark of the above
 
+The dispatch counter additionally joins with obs/costmodel's harvested
+per-dispatch unit costs (kernel_flops_total{kind} /
+kernel_hbm_bytes_total{kind}) so every stage's silicon cost
+accumulates next to its launch count.
+
 Every helper takes the Observability handle and is one branch when
 observability is disabled; all jax imports are local and guarded so
 the module works (as a no-op) on hosts without a usable backend.
@@ -38,8 +43,14 @@ def current_device_id() -> Optional[str]:
 
 
 def note_compile(obs, kind: str, seconds: float,
-                 key=None, device: Optional[str] = None) -> None:
-    """One executable built: count it, time it, remember it."""
+                 key=None, device: Optional[str] = None,
+                 compiled=None) -> None:
+    """One executable built: count it, time it, remember it.  Call
+    sites that hold the compiled object (or anything exposing
+    ``cost_analysis``) pass it as ``compiled`` so obs/costmodel can
+    harvest the per-dispatch FLOP/byte unit cost at the same moment
+    the compile is booked; plan bundles without one are skipped
+    silently."""
     if obs is None or not obs.enabled:
         return
     obs.metrics.counter(
@@ -52,6 +63,9 @@ def note_compile(obs, kind: str, seconds: float,
                       seconds=round(float(seconds), 4),
                       key=repr(key) if key is not None else "",
                       device=device or "")
+    if compiled is not None:
+        from presto_tpu.obs import costmodel
+        costmodel.note_compiled(obs, kind, compiled)
 
 
 def note_dispatch(obs, kind: str, n: int = 1) -> None:
@@ -67,6 +81,11 @@ def note_dispatch(obs, kind: str, n: int = 1) -> None:
         "jax_dispatches_total",
         "Batched device-chain dispatches (rFFT/search/single-pulse "
         "program launches)", ("kind",)).labels(kind=kind).inc(int(n))
+    # the cost join: dispatches x harvested per-dispatch unit cost ->
+    # kernel_flops_total{kind} / kernel_hbm_bytes_total{kind} + the
+    # current span's flops/hbm_bytes attrs (obs/costmodel)
+    from presto_tpu.obs import costmodel
+    costmodel.attribute_dispatch(obs, kind, int(n))
 
 
 def note_put(obs, nbytes: int) -> None:
@@ -103,7 +122,8 @@ def transfer_snapshot(obs) -> dict:
     survey's end-of-run span).  Returns zeros when observability is
     disabled, so callers can diff snapshots unconditionally."""
     out = {"put_bytes": 0, "get_bytes": 0, "donated_bytes": 0,
-           "compiles": 0, "compile_seconds": 0.0, "dispatches": 0}
+           "compiles": 0, "compile_seconds": 0.0, "dispatches": 0,
+           "kernel_flops": 0.0, "kernel_hbm_bytes": 0.0}
     if obs is None or not obs.enabled:
         return out
     reg = obs.metrics
@@ -127,6 +147,11 @@ def transfer_snapshot(obs) -> dict:
     out["compiles"] = int(comp.total())
     out["compile_seconds"] = float(
         sum(h.sum for _lbl, h in hist.children()))
+    for snap_key, name in (("kernel_flops", "kernel_flops_total"),
+                           ("kernel_hbm_bytes",
+                            "kernel_hbm_bytes_total")):
+        fam = reg.get(name)
+        out[snap_key] = float(fam.total()) if fam is not None else 0.0
     return out
 
 

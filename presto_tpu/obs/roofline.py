@@ -1,0 +1,208 @@
+"""Device roofline: measured peaks + compute/memory-bound placement.
+
+The roofline model needs two device constants — peak FLOP/s and peak
+memory bandwidth — to place a kernel by its operational intensity
+(FLOPs per HBM byte): below the ridge point
+``peak_flops / peak_bandwidth`` a kernel is memory-bound, above it
+compute-bound.  This module measures both ONCE per device with a
+microbenchmark (a dominant-term matmul for FLOP/s, a streaming triad
+for bytes/s) and caches them in the tune fingerprint DB
+(``tune/db.py``, family ``device_roofline``) — the same
+cache-correctness boundary tuning results use, so a GPU or a new TPU
+generation gets its own peaks automatically and the whole cost stack
+inherits multi-backend support for free (docs/TUNING.md).
+
+Both halves degrade: no usable backend -> ``device_peaks`` returns
+None and every consumer renders "(no peaks)" instead of a verdict;
+the classification itself is pure arithmetic (unit-tested without a
+device).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, Optional
+
+#: tune-DB family holding the cached peaks per device fingerprint
+FAMILY = "device_roofline"
+
+#: shape key under the family (versioned: a methodology change bumps
+#: it, orphaning stale peak records instead of silently mixing them)
+SHAPE_KEY = "peaks_v1"
+
+
+# ----------------------------------------------------------------------
+# the microbench
+# ----------------------------------------------------------------------
+
+def measure_peaks(obs=None, reps: int = 3, n_mm: int = 1024,
+                  n_bw: int = 1 << 24) -> Dict[str, float]:
+    """Measure (peak FLOP/s, peak bytes/s) on the default backend.
+
+    * FLOP/s: an [n, n] @ [n, n] float32 matmul (2*n^3 FLOPs, the
+      highest-intensity program XLA will emit — its rate is the
+      practical FLOP ceiling);
+    * bytes/s: a fused streaming reduce ``sum(a*s + b)`` over n_bw
+      float32 elements (2 full arrays read -> 8*n_bw bytes per run,
+      intensity ~0.25 FLOP/byte — far below any ridge, so its rate is
+      the practical bandwidth ceiling; the reduce keeps XLA from
+      eliding any element).
+
+    Best-of-``reps`` for both (the peak is a ceiling, not an
+    average).  Raises when no backend is usable — callers cache via
+    ``device_peaks`` which degrades to None.
+    """
+    import jax
+    import jax.numpy as jnp
+    sp = (obs.span("obs:roofline-probe", op="peaks", n_mm=n_mm,
+                   n_bw=n_bw)
+          if obs is not None and obs.enabled else None)
+    try:
+        key = jax.random.PRNGKey(0)
+        a = jax.random.normal(key, (n_mm, n_mm), dtype=jnp.float32)
+        mm = jax.jit(lambda x: (x @ x).sum())
+        float(mm(a))                              # compile + settle
+        mm_s = float("inf")
+        for _ in range(max(1, reps)):
+            t0 = time.perf_counter()
+            float(mm(a))
+            mm_s = min(mm_s, time.perf_counter() - t0)
+        flops_per_s = 2.0 * n_mm ** 3 / mm_s
+
+        x = jax.random.normal(key, (n_bw,), dtype=jnp.float32)
+        y = jax.random.normal(jax.random.PRNGKey(1), (n_bw,),
+                              dtype=jnp.float32)
+        triad = jax.jit(lambda a, b: (a * 1.0001 + b).sum())
+        float(triad(x, y))                        # compile + settle
+        bw_s = float("inf")
+        for _ in range(max(1, reps)):
+            t0 = time.perf_counter()
+            float(triad(x, y))
+            bw_s = min(bw_s, time.perf_counter() - t0)
+        bytes_per_s = 8.0 * n_bw / bw_s           # two full reads
+    except BaseException as e:
+        if sp is not None:
+            sp.finish("error: %s" % type(e).__name__)
+        raise
+    if sp is not None:
+        sp.finish()
+    return {
+        "flops_per_s": flops_per_s,
+        "bytes_per_s": bytes_per_s,
+        "ridge_intensity": flops_per_s / bytes_per_s,
+        "matmul_s": mm_s,
+        "triad_s": bw_s,
+        "n_mm": float(n_mm),
+        "n_bw": float(n_bw),
+        "measured_at": time.time(),
+    }
+
+
+# ----------------------------------------------------------------------
+# fingerprint-cached access
+# ----------------------------------------------------------------------
+
+def device_peaks(obs=None, db_path: Optional[str] = None,
+                 measure: bool = True,
+                 reps: int = 3) -> Optional[Dict[str, float]]:
+    """Peaks for the CURRENT device fingerprint, off the tune DB when
+    already measured; with ``measure=True`` a miss runs the microbench
+    once and merge-saves the result (keep-the-best on the matmul wall
+    time, so concurrent measurers keep the fastest = highest ceiling).
+    Returns None when nothing is cached and measurement is off or
+    impossible — consumers degrade to "(no peaks)"."""
+    from presto_tpu.tune.db import TuneDB, default_db_path, \
+        fingerprint_key
+    try:
+        fp = fingerprint_key()
+    except Exception:
+        return None
+    path = db_path or default_db_path()
+    db = TuneDB.load(path)
+    rec = db.lookup(fp, FAMILY, SHAPE_KEY)
+    if rec is not None:
+        return dict(rec)
+    if not measure:
+        return None
+    try:
+        peaks = measure_peaks(obs=obs, reps=reps)
+    except Exception:
+        return None
+    db.record(fp, FAMILY, SHAPE_KEY, peaks,
+              median_s=float(peaks["matmul_s"]), reps=reps,
+              source="roofline")
+    try:
+        db.save(path)
+    except OSError:
+        pass                      # read-only cache dir: still usable
+    return peaks
+
+
+# ----------------------------------------------------------------------
+# classification (pure arithmetic; unit-tested without a device)
+# ----------------------------------------------------------------------
+
+def classify(flops: float, hbm_bytes: float,
+             peaks: Dict[str, float]) -> Optional[dict]:
+    """Place one kernel on the roofline.  Returns None when the cost
+    or the peaks are unusable (zero bytes, missing fields)."""
+    try:
+        pf = float(peaks["flops_per_s"])
+        pb = float(peaks["bytes_per_s"])
+    except (KeyError, TypeError, ValueError):
+        return None
+    if hbm_bytes <= 0 or pf <= 0 or pb <= 0:
+        return None
+    intensity = float(flops) / float(hbm_bytes)
+    ridge = pf / pb
+    # the roofline: attainable FLOP/s = min(peak, intensity * bw)
+    attainable = min(pf, intensity * pb)
+    return {
+        "intensity": intensity,
+        "ridge_intensity": ridge,
+        "bound": "compute" if intensity >= ridge else "memory",
+        "attainable_flops_per_s": attainable,
+        "frac_of_peak_flops": attainable / pf,
+    }
+
+
+def roofline_rows(costs: dict,
+                  peaks: Optional[Dict[str, float]]) -> list:
+    """Per-kind roofline rows for a kernel_costs snapshot (the
+    presto-report table): every kind with a harvested unit gets an
+    intensity + verdict (or "(no peaks)"), every kind that only
+    dispatched gets an explicit "(unavailable)" row, and each row
+    carries its share of the total attributed HBM traffic."""
+    kinds = (costs or {}).get("kinds", {}) or {}
+    total_bytes = sum(float(e.get("hbm_bytes_total", 0.0) or 0.0)
+                      for e in kinds.values())
+    rows = []
+    for kind, ent in sorted(kinds.items()):
+        flops = ent.get("flops_per_dispatch")
+        nbytes = ent.get("hbm_bytes_per_dispatch")
+        row = {
+            "kind": kind,
+            "dispatches": int(ent.get("dispatches", 0)),
+            "flops_per_dispatch": flops,
+            "hbm_bytes_per_dispatch": nbytes,
+            "flops_total": ent.get("flops_total", 0.0),
+            "hbm_bytes_total": ent.get("hbm_bytes_total", 0.0),
+            "hbm_share": (float(ent.get("hbm_bytes_total", 0.0) or
+                                0.0) / total_bytes
+                          if total_bytes > 0 else 0.0),
+            "peak_bytes": ent.get("peak_bytes"),
+        }
+        if flops is None or nbytes is None:
+            row["verdict"] = "(unavailable)"
+        elif peaks is None:
+            row["intensity"] = (flops / nbytes if nbytes else None)
+            row["verdict"] = "(no peaks)"
+        else:
+            cls = classify(flops, nbytes, peaks)
+            if cls is None:
+                row["verdict"] = "(no peaks)"
+            else:
+                row.update(cls)
+                row["verdict"] = "%s-bound" % cls["bound"]
+        rows.append(row)
+    return rows

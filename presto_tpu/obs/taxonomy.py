@@ -252,6 +252,26 @@ DAG_METRICS = frozenset({
     "dag_folds_stacked_total",
 })
 
+#: kernel-observatory span names — the AOT cost-probe / roofline
+#: microbench span opened by obs/costmodel.py + obs/roofline.py
+#: (enforced both directions by obs-coverage check 15: every
+#: `obs:`-prefixed span in the cost layer is registered, and the
+#: catalog may not list dead ones)
+COST_SPANS = frozenset({
+    "obs:roofline-probe",
+})
+
+#: kernel-observatory metrics (obs-coverage check 15, both
+#: directions, subset of METRICS): the per-kind FLOP/byte dispatch
+#: join and the degradation counter — the measurement rig every
+#: remaining perf item (Pallas dedisp, GPU backend, learned tuner)
+#: is judged by, so it may neither go dark nor go stale
+COST_METRICS = frozenset({
+    "kernel_flops_total",
+    "kernel_hbm_bytes_total",
+    "cost_model_unavailable",
+})
+
 #: job lifecycle states -> the event kind that announces the
 #: transition into that state.  The linter checks each mapped kind is
 #: actually emitted somewhere in the serve layer.
@@ -373,6 +393,12 @@ METRICS = frozenset({
     "jax_donated_bytes_total",
     "jax_live_buffer_bytes",
     "jax_live_buffer_hwm_bytes",
+    # kernel observatory (obs/costmodel.py + obs/roofline.py +
+    # bench.py); pinned both directions by obs-coverage check 15 via
+    # COST_METRICS
+    "kernel_flops_total",
+    "kernel_hbm_bytes_total",
+    "cost_model_unavailable",
     # flight recorder
     "flightrec_dumps_total",
     # elastic cluster (parallel/elastic.py)

@@ -243,7 +243,7 @@ def make_sharded_sixstep_fft(mesh: Mesh, rows: int):
 
 def sharded_accel_search_many(searcher, pairs_batch, mesh: Mesh,
                               slab: int = 1 << 20,
-                              compact_m: int = None):
+                              compact_m: int = None, obs=None):
     """Accelsearch over a DM fan-out with the trial axis sharded over
     `mesh` — the search-stage application of the mpiprepsubband
     invariant (SURVEY §4.8; mpiprepsubband.c:288-297's DM partition):
@@ -320,6 +320,10 @@ def sharded_accel_search_many(searcher, pairs_batch, mesh: Mesh,
             in_specs=(P(axis), P(), P()),
             out_specs=P(axis)))
         searcher._fn_cache[fkey] = fn
+    if obs is not None:
+        from presto_tpu.obs import costmodel
+        costmodel.probe(obs, "accel_search", fn, jnp.asarray(batch),
+                        kern_dev, scols)
     comp = np.asarray(fn(jnp.asarray(batch), kern_dev, scols))
     dense = None
     out = []

@@ -511,7 +511,8 @@ def fused_rfft_batch(series_dev, donate: bool = False, obs=None,
         else:
             fn = jax.jit(jax.vmap(fftpack.realfft_packed_pairs), **kw)
         _fft_fns[key] = fn
-    from presto_tpu.obs import jaxtel
+    from presto_tpu.obs import costmodel, jaxtel
+    costmodel.probe(obs, "rfft_batch", fn, series_dev)
     jaxtel.note_dispatch(obs, "rfft_batch")
     if donate:
         jaxtel.note_donation(obs, int(np.prod(series_dev.shape)) * 4)

@@ -581,7 +581,8 @@ def _seam_fft_search(seam, cfg, passes, manifest=None, obs=None,
         for pcfg in todo_passes:
             searcher = _searcher_for(pcfg, T, nbins)
             jaxtel.note_dispatch(obs, "accel_search")
-            results = searcher.search_many(search_dev, mesh=mesh)
+            results = searcher.search_many(search_dev, mesh=mesh,
+                                           obs=obs)
             arts = []
             for row, pr, raw in zip(rows, pairs_host, results):
                 name = block.names[row]
@@ -753,7 +754,7 @@ def _seam_singlepulse(seam, cfg, manifest=None, obs=None) -> None:
                 batch, bdt,
                 dms=[fusion.inf_float(block.infos[r].dm, 12)
                      for r in rows],
-                offregions_list=[offregions] * len(rows))
+                offregions_list=[offregions] * len(rows), obs=obs)
             written = []
             for r, (cands, _stds, _bad) in zip(rows, results):
                 f = block.names[r] + ".singlepulse"
@@ -787,7 +788,8 @@ def _seam_singlepulse(seam, cfg, manifest=None, obs=None) -> None:
                 batch, dt,
                 dms=[fusion.inf_float(b.infos[row].dm, 12)
                      for (b, row, _n, _o) in chunk],
-                offregions_list=[o for (_b, _r, _n, o) in chunk])
+                offregions_list=[o for (_b, _r, _n, o) in chunk],
+                obs=obs)
             written = []
             for (b, row, _n, _o), (cands, _stds, bad) in zip(chunk,
                                                              results):
@@ -819,7 +821,7 @@ def _fused_fft_search(datfiles, cfg, manifest=None, obs=None) -> None:
     import jax.numpy as jnp
     import numpy as np
     from presto_tpu.io import datfft
-    from presto_tpu.obs import jaxtel
+    from presto_tpu.obs import costmodel, jaxtel
     from presto_tpu.ops import fftpack
     from presto_tpu.apps.accelsearch import refine_and_write
 
@@ -834,10 +836,11 @@ def _fused_fft_search(datfiles, cfg, manifest=None, obs=None) -> None:
                   if obs is not None else None)
             arr = np.stack([datfft.read_dat(f)[:n] for f in chunk])
             jaxtel.note_put(obs, arr.nbytes)
+            costmodel.probe(obs, "rfft_batch", batched, arr)
             jaxtel.note_dispatch(obs, "rfft_batch")
             pairs_dev = batched(jnp.asarray(arr))    # stays in HBM
             jaxtel.note_dispatch(obs, "accel_search")
-            results = searcher.search_many(pairs_dev)
+            results = searcher.search_many(pairs_dev, obs=obs)
             pairs_host = np.asarray(pairs_dev)       # one download
             jaxtel.note_get(obs, pairs_host.nbytes)
             arts = []
@@ -871,7 +874,7 @@ def _staged_fft_search_head(datfiles, cfg, manifest=None, obs=None):
         import jax.numpy as jnp
         import numpy as np
         from presto_tpu.io import datfft
-        from presto_tpu.obs import jaxtel
+        from presto_tpu.obs import costmodel, jaxtel
         from presto_tpu.ops import fftpack
         batched = jax.jit(jax.vmap(fftpack.realfft_packed_pairs))
         for n, files in _length_groups(
@@ -886,6 +889,7 @@ def _staged_fft_search_head(datfiles, cfg, manifest=None, obs=None):
                 # app (bin 0 is outside the searched range anyway)
                 arr = np.stack([datfft.read_dat(f)[:n] for f in chunk])
                 jaxtel.note_put(obs, arr.nbytes)
+                costmodel.probe(obs, "rfft_batch", batched, arr)
                 jaxtel.note_dispatch(obs, "rfft_batch")
                 pairs = np.asarray(batched(jnp.asarray(arr)))
                 jaxtel.note_get(obs, pairs.nbytes)
@@ -931,7 +935,7 @@ def _batched_accelsearch(fftfiles, cfg, manifest=None, obs=None):
                                   for a in amps_list])
                 jaxtel.note_put(obs, batch.nbytes)
                 jaxtel.note_dispatch(obs, "accel_search")
-                results = searcher.search_many(batch)
+                results = searcher.search_many(batch, obs=obs)
                 arts = []
                 for f, amps, raw in zip(chunk, amps_list, results):
                     refine_and_write(raw, amps, T, searcher, f[:-4],

@@ -1584,7 +1584,7 @@ class AccelSearch:
     def search_many(self, pairs_batch: np.ndarray,
                     slab: int = 1 << 20,
                     compact_m: int = COMPACT_CANDS,
-                    mesh=None) -> List[List[AccelCand]]:
+                    mesh=None, obs=None) -> List[List[AccelCand]]:
         """Batched search over many same-length spectra — the survey's
         DM fan-out (one plane build + one scanned search dispatch per
         memory-budgeted DM group instead of per-trial dispatch storms;
@@ -1602,6 +1602,11 @@ class AccelSearch:
         parallel/sharded.sharded_accel_search_many (candidate lists
         are test-pinned equal to this method's); None keeps the
         single-device grouped path.
+
+        ``obs``: an Observability handle — when enabled, the scan
+        program's per-dispatch FLOP/byte unit cost is harvested once
+        per geometry (obs/costmodel.probe, kind "accel_search") so
+        the survey's dispatch accounting carries silicon cost.
         """
         cfg = self.cfg
         if mesh is not None and len(list(mesh.devices.flat)) > 1:
@@ -1609,7 +1614,8 @@ class AccelSearch:
                 sharded_accel_search_many)
             return sharded_accel_search_many(self, pairs_batch, mesh,
                                              slab=slab,
-                                             compact_m=compact_m)
+                                             compact_m=compact_m,
+                                             obs=obs)
         if isinstance(pairs_batch, jax.Array):
             batch = pairs_batch
             if batch.dtype != jnp.float32:    # same boundary cast the
@@ -1651,6 +1657,9 @@ class AccelSearch:
         slab, k, scanner, start_cols = splan
         scols = jnp.asarray(start_cols, dtype=jnp.int32)
         self._kern_bank_dev()         # ensure the FFT'd device bank
+        if obs is not None:
+            from presto_tpu.obs import costmodel
+            costmodel.probe(obs, "accel_search", scanner, p0, scols)
 
         def collect_dm(vals, cidx, zrow):
             return self._dedup_sort(

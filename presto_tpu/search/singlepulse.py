@@ -413,7 +413,8 @@ class SinglePulseSearch:
 
     def search_many_resident(self, series, dt: float,
                              dms: Sequence[float],
-                             offregions_list=None, G: int = 2048):
+                             offregions_list=None, G: int = 2048,
+                             obs=None):
         """search_many with the series DEVICE-RESIDENT end to end —
         the survey's fused regime (dedispersed series stay in HBM;
         feeding them back through the host link costs more than the
@@ -463,6 +464,16 @@ class SinglePulseSearch:
             masks[fi, bad] = 0.0
             bads.append(bad)
         # pass 2: normalize + frames + convolve + compact, on device
+        if obs is not None:
+            # unit cost of the stage's dominant program (kind
+            # "sp_search"), harvested once per geometry
+            from presto_tpu.obs import costmodel
+            costmodel.probe(
+                obs, "sp_search", _resident_pipeline,
+                resid, jnp.asarray(scales), jnp.asarray(masks),
+                kern_pairs, np.float32(self.threshold), dlen,
+                nblk, chunklen, fftlen, overlap,
+                min(self.topk, chunklen), G)
         tv, ti, tb, counts = _resident_pipeline(
             resid, jnp.asarray(scales), jnp.asarray(masks), kern_pairs,
             np.float32(self.threshold), dlen,

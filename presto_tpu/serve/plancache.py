@@ -213,8 +213,11 @@ class PlanCache:
                 if self._events is not None:
                     self._events.emit("evict", plan=repr(old_key))
             self._g_size.set(len(self._plans))
+        # the built plan rides along so obs/costmodel can harvest its
+        # unit cost when it IS a compiled executable (AOT bundles);
+        # AccelSearch-style plan objects are skipped silently
         jaxtel.note_compile(self.obs, kind=key.kind, seconds=dt,
-                            key=key, device=device)
+                            key=key, device=device, compiled=obj)
         if self._events is not None:
             self._events.emit("compile", plan=repr(key), seconds=dt)
         return obj

@@ -385,6 +385,7 @@ class SearchService:
             by_status: Dict[str, int] = {}
             for job in self._jobs.values():
                 by_status[job.status] = by_status.get(job.status, 0) + 1
+        from presto_tpu.obs import costmodel
         return {
             "uptime_s": round(time.time() - self._t0, 3),
             "queue": {"depth": len(self.queue),
@@ -394,6 +395,11 @@ class SearchService:
             "plans": self.plans.stats(),
             "latency": self.latency.snapshot(),
             "events": self.events.counts(),
+            # per-kind silicon cost (obs/costmodel): {} until a
+            # dispatch site harvested its unit cost; the labeled
+            # kernel_* counters underneath ride the fleet snapshot
+            # aggregation like every other registry series
+            "kernel_costs": costmodel.snapshot(self.obs),
         }
 
     def metrics_prometheus(self) -> str:

@@ -1,0 +1,455 @@
+"""Kernel observatory (ISSUE 15): XLA cost harvest, the dispatch
+join, roofline classification, degradation, and the presto-report
+roofline section.
+
+The contract under test:
+
+  * `costmodel.probe` harvests real per-dispatch FLOP/byte unit costs
+    on the CPU backend for the survey's actual plan kinds (dedisp /
+    rfft_batch / accel_search / sp_search) — it only lowers/compiles,
+    never executes, so instrumented paths stay byte-identical;
+  * `jaxtel.note_dispatch` joins dispatch counts with unit costs into
+    kernel_flops_total{kind} / kernel_hbm_bytes_total{kind} and the
+    current span's attrs;
+  * any backend/version gap degrades to cost_model_unavailable{reason}
+    and an explicit "(unavailable)" report row — never a crash;
+  * a tier-1-sized survey with obs enabled writes kernel_costs.json
+    whose dedispersion row carries a NON-ZERO HBM-byte share, and
+    presto-report renders the roofline table from it.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from presto_tpu.obs import Observability, ObsConfig, costmodel, jaxtel
+
+
+def _obs():
+    return Observability(ObsConfig(enabled=True))
+
+
+# ----------------------------------------------------------------------
+# harvest on the CPU backend, per plan kind
+# ----------------------------------------------------------------------
+
+def test_probe_dedisp_kind():
+    from presto_tpu.ops import dedispersion as dd
+    obs = _obs()
+    chan = (np.arange(16) % 4).astype(np.int32)
+    dms = (np.arange(8)[:, None]
+           * np.linspace(0, 3, 4)[None, :]).astype(np.int32)
+    step = dd.make_block_step(chan, dms, 4, 1)
+    import jax.numpy as jnp
+    raw = jnp.ones((16, 256), jnp.float32)
+    sub = jnp.ones((4, 256), jnp.float32)
+    unit = costmodel.probe(obs, "dedisp", step, raw, raw, sub)
+    assert unit is not None
+    assert unit.flops > 0 and unit.hbm_bytes > 0
+    assert unit.source in ("compiled", "lowered")
+
+
+def test_probe_fft_kind():
+    import jax
+    from presto_tpu.ops import fftpack
+    obs = _obs()
+    fn = jax.jit(jax.vmap(fftpack.realfft_packed_pairs))
+    x = np.ones((3, 512), np.float32)
+    unit = costmodel.probe(obs, "rfft_batch", fn, x)
+    assert unit is not None and unit.flops > 0 \
+        and unit.hbm_bytes > 0
+
+
+def test_probe_accel_search_kind_via_search_many():
+    from presto_tpu.search.accel import AccelConfig, AccelSearch
+    obs = _obs()
+    rng = np.random.default_rng(0)
+    numbins = 1 << 12
+    pairs = np.stack([rng.normal(size=numbins),
+                      rng.normal(size=numbins)],
+                     -1).astype(np.float32)
+    s = AccelSearch(AccelConfig(zmax=4, numharm=2, sigma=3.0),
+                    T=50.0, numbins=numbins)
+    s.search_many(pairs[None], obs=obs)
+    unit = costmodel.book(obs).unit("accel_search")
+    assert unit is not None and unit.flops > 0 \
+        and unit.hbm_bytes > 0
+
+
+def test_probe_sp_search_kind_via_search_many_resident():
+    from presto_tpu.search.singlepulse import SinglePulseSearch
+    obs = _obs()
+    rng = np.random.default_rng(1)
+    series = rng.normal(size=(2, 1 << 13)).astype(np.float32)
+    sp = SinglePulseSearch(threshold=5.0)
+    sp.search_many_resident(series, dt=1e-3, dms=[0.0, 1.0],
+                            obs=obs)
+    unit = costmodel.book(obs).unit("sp_search")
+    assert unit is not None and unit.flops > 0 \
+        and unit.hbm_bytes > 0
+
+
+# ----------------------------------------------------------------------
+# the dispatch join
+# ----------------------------------------------------------------------
+
+def test_dispatch_join_accumulates_and_annotates_span():
+    import jax
+    obs = _obs()
+    fn = jax.jit(lambda x: (x * 2.0).sum())
+    x = np.ones((64, 64), np.float32)
+    unit = costmodel.probe(obs, "toy", fn, x)
+    sp = obs.span("fused-chunk")
+    jaxtel.note_dispatch(obs, "toy", 3)
+    sp.finish()
+    flops = obs.metrics.counter(
+        "kernel_flops_total", "", ("kind",)).labels(kind="toy").value
+    nbytes = obs.metrics.counter(
+        "kernel_hbm_bytes_total", "",
+        ("kind",)).labels(kind="toy").value
+    assert flops == pytest.approx(3 * unit.flops)
+    assert nbytes == pytest.approx(3 * unit.hbm_bytes)
+    # per-span attrs flow into the Perfetto export args
+    assert sp.attrs["flops"] == pytest.approx(3 * unit.flops)
+    assert sp.attrs["hbm_bytes"] == pytest.approx(3 * unit.hbm_bytes)
+    snap = jaxtel.transfer_snapshot(obs)
+    assert snap["kernel_flops"] == pytest.approx(3 * unit.flops)
+
+
+def test_dispatch_before_probe_is_backfilled():
+    """The survey notes a dispatch just BEFORE the call that probes
+    its kind: the deferred count is backfilled into the counters when
+    the unit lands, so single-chunk surveys still attribute."""
+    import jax
+    obs = _obs()
+    jaxtel.note_dispatch(obs, "late", 2)       # no unit yet
+    unit = costmodel.probe(obs, "late", jax.jit(lambda x: x.sum()),
+                           np.ones(32, np.float32))
+    flops = obs.metrics.counter(
+        "kernel_flops_total", "", ("kind",)).labels(kind="late").value
+    assert flops == pytest.approx(2 * unit.flops)
+    jaxtel.note_dispatch(obs, "late")          # live path afterwards
+    flops = obs.metrics.counter(
+        "kernel_flops_total", "", ("kind",)).labels(kind="late").value
+    assert flops == pytest.approx(3 * unit.flops)
+
+
+def test_probe_is_once_per_signature():
+    import jax
+    obs = _obs()
+    calls = []
+    inner = jax.jit(lambda x: x.sum())
+
+    class Spy:
+        def lower(self, *a, **k):
+            calls.append(a)
+            return inner.lower(*a, **k)
+
+    x = np.ones(8, np.float32)
+    costmodel.probe(obs, "spy", Spy(), x)
+    costmodel.probe(obs, "spy", Spy(), x)          # same sig: cached
+    assert len(calls) == 1
+    costmodel.probe(obs, "spy", Spy(), np.ones(16, np.float32))
+    assert len(calls) == 2                         # new sig: re-probe
+
+
+def test_disabled_obs_is_inert():
+    obs = Observability(ObsConfig(enabled=False))
+    assert costmodel.book(obs) is None
+    assert costmodel.probe(obs, "x", None) is None
+    jaxtel.note_dispatch(obs, "x")                 # no crash
+    assert costmodel.snapshot(obs) == {}
+
+
+def test_env_kill_switch(monkeypatch):
+    monkeypatch.setenv(costmodel.ENV_SWITCH, "0")
+    obs = _obs()
+    assert costmodel.book(obs) is None
+    assert costmodel.probe(obs, "x", None) is None
+
+
+# ----------------------------------------------------------------------
+# degradation: cost model unavailable is a counter, never a crash
+# ----------------------------------------------------------------------
+
+def test_unharvestable_callable_degrades_to_counter():
+    obs = _obs()
+    assert costmodel.probe(obs, "bogus", lambda x: x, 1) is None
+    reasons = costmodel._counter_by_label(
+        obs, "cost_model_unavailable", "reason")
+    assert sum(reasons.values()) == 1
+    # and the failed (kind, sig) is remembered: no retry storm
+    assert costmodel.probe(obs, "bogus", lambda x: x, 1) is None
+    reasons = costmodel._counter_by_label(
+        obs, "cost_model_unavailable", "reason")
+    assert sum(reasons.values()) == 1
+
+
+def test_cost_analysis_raises_degrades():
+    obs = _obs()
+
+    class BadCompiled:
+        def cost_analysis(self):
+            raise RuntimeError("backend says no")
+
+    class BadLowered:
+        def compile(self):
+            return BadCompiled()
+
+        def cost_analysis(self):
+            return None                     # some versions return None
+
+    class BadJit:
+        def lower(self, *a, **k):
+            return BadLowered()
+
+    assert costmodel.probe(obs, "sad", BadJit(), 1) is None
+    reasons = costmodel._counter_by_label(
+        obs, "cost_model_unavailable", "reason")
+    assert sum(reasons.values()) == 1
+    assert costmodel.snapshot(obs)["unavailable"]
+
+
+def test_compile_failure_degrades_to_lowered_estimate():
+    obs = _obs()
+
+    class Lowered:
+        def compile(self):
+            raise RuntimeError("no AOT on this backend")
+
+        def cost_analysis(self):
+            return {"flops": 10.0, "bytes accessed": 40.0}
+
+    class Jit:
+        def lower(self, *a, **k):
+            return Lowered()
+
+    unit = costmodel.probe(obs, "halfway", Jit(), 1)
+    assert unit is not None and unit.source == "lowered"
+    assert unit.flops == 10.0 and unit.hbm_bytes == 40.0
+    assert unit.peak_bytes is None
+
+
+def test_note_compile_skips_unharvestable_silently():
+    """A plan-cache bundle without cost_analysis is NOT a backend
+    failure: no unavailable count, no crash."""
+    obs = _obs()
+    jaxtel.note_compile(obs, "accel", 0.1, compiled=object())
+    reasons = costmodel._counter_by_label(
+        obs, "cost_model_unavailable", "reason")
+    assert sum(reasons.values()) == 0
+
+
+def test_note_compile_harvests_real_compiled():
+    import jax
+    obs = _obs()
+    compiled = jax.jit(lambda x: x * 2.0).lower(
+        np.ones(32, np.float32)).compile()
+    jaxtel.note_compile(obs, "aot", 0.1, compiled=compiled)
+    unit = costmodel.book(obs).unit("aot")
+    assert unit is not None and unit.hbm_bytes > 0
+
+
+# ----------------------------------------------------------------------
+# roofline classification units (pure arithmetic)
+# ----------------------------------------------------------------------
+
+def test_classify_bounds():
+    from presto_tpu.obs import roofline
+    peaks = {"flops_per_s": 1e12, "bytes_per_s": 1e11}  # ridge = 10
+    mem = roofline.classify(flops=1e6, hbm_bytes=1e6, peaks=peaks)
+    assert mem["bound"] == "memory" and mem["intensity"] == 1.0
+    assert mem["attainable_flops_per_s"] == pytest.approx(1e11)
+    comp = roofline.classify(flops=1e8, hbm_bytes=1e6, peaks=peaks)
+    assert comp["bound"] == "compute"
+    assert comp["frac_of_peak_flops"] == pytest.approx(1.0)
+    # exactly at the ridge counts as compute-bound
+    edge = roofline.classify(flops=1e7, hbm_bytes=1e6, peaks=peaks)
+    assert edge["bound"] == "compute"
+    # degenerate inputs -> None, never a crash
+    assert roofline.classify(1.0, 0.0, peaks) is None
+    assert roofline.classify(1.0, 1.0, {}) is None
+
+
+def test_roofline_rows_shares_and_unavailable():
+    from presto_tpu.obs import roofline
+    costs = {"kinds": {
+        "dedisp": {"dispatches": 4, "flops_per_dispatch": 100.0,
+                   "hbm_bytes_per_dispatch": 1000.0,
+                   "flops_total": 400.0, "hbm_bytes_total": 4000.0},
+        "mystery": {"dispatches": 2},      # dispatched, never probed
+    }}
+    rows = roofline.roofline_rows(
+        costs, {"flops_per_s": 1e9, "bytes_per_s": 1e9})
+    by_kind = {r["kind"]: r for r in rows}
+    assert by_kind["dedisp"]["hbm_share"] == pytest.approx(1.0)
+    assert by_kind["dedisp"]["verdict"] == "memory-bound"
+    assert by_kind["mystery"]["verdict"] == "(unavailable)"
+    # no peaks: intensity still reported, verdict degrades
+    rows = roofline.roofline_rows(costs, None)
+    by_kind = {r["kind"]: r for r in rows}
+    assert by_kind["dedisp"]["verdict"] == "(no peaks)"
+    assert by_kind["dedisp"]["intensity"] == pytest.approx(0.1)
+
+
+def test_device_peaks_cached_in_fingerprint_db(tmp_path):
+    from presto_tpu.obs import roofline
+    from presto_tpu.tune.db import TuneDB, fingerprint_key
+    db = str(tmp_path / "tune.json")
+    p1 = roofline.device_peaks(db_path=db, measure=True, reps=1)
+    assert p1 is not None and p1["flops_per_s"] > 0 \
+        and p1["bytes_per_s"] > 0
+    # cached: a second call reads the DB (identical record, no
+    # re-measure — the record round-trips through tune/db.py)
+    p2 = roofline.device_peaks(db_path=db, measure=False)
+    assert p2 is not None
+    assert p2["flops_per_s"] == pytest.approx(p1["flops_per_s"])
+    rec = TuneDB.load(db).lookup(fingerprint_key(), roofline.FAMILY,
+                                 roofline.SHAPE_KEY)
+    assert rec is not None
+
+
+# ----------------------------------------------------------------------
+# export + presto-report rendering
+# ----------------------------------------------------------------------
+
+def test_write_and_load_costs_roundtrip(tmp_path):
+    import jax
+    obs = _obs()
+    costmodel.probe(obs, "toy", jax.jit(lambda x: x.sum()),
+                    np.ones(64, np.float32))
+    jaxtel.note_dispatch(obs, "toy", 2)
+    d = str(tmp_path)
+    path = costmodel.write_costs(obs, d)
+    assert path is not None and os.path.exists(path)
+    loaded = costmodel.load_costs(d)
+    assert loaded["kinds"]["toy"]["dispatches"] == 2
+    # corrupted file degrades to None
+    with open(path, "w") as f:
+        f.write("{nope")
+    assert costmodel.load_costs(d) is None
+
+
+def test_report_renders_roofline_section(tmp_path, capsys):
+    """The report render pin: a workdir with kernel_costs.json gets a
+    roofline table, the dedispersion HBM-share callout, and explicit
+    (unavailable) rows — no device needed (peaks come from the
+    file)."""
+    from presto_tpu.apps import report
+    d = str(tmp_path)
+    costs = {
+        "schema": costmodel.COSTS_SCHEMA,
+        "kinds": {
+            "dedisp": {"dispatches": 10, "flops_per_dispatch": 1e6,
+                       "hbm_bytes_per_dispatch": 8e6,
+                       "flops_total": 1e7, "hbm_bytes_total": 8e7},
+            "accel_search": {"dispatches": 3,
+                             "flops_per_dispatch": 9e8,
+                             "hbm_bytes_per_dispatch": 1e6,
+                             "flops_total": 2.7e9,
+                             "hbm_bytes_total": 3e6},
+            "mystery": {"dispatches": 1},
+        },
+        "unavailable": {"RuntimeError": 1},
+        "peaks": {"flops_per_s": 1e10, "bytes_per_s": 1e9},
+    }
+    with open(os.path.join(d, "kernel_costs.json"), "w") as f:
+        json.dump(costs, f)
+    info = report.collect(d)
+    assert "kernel_costs" in info
+    rows = {r["kind"]: r for r in info["kernel_costs"]["roofline"]}
+    assert rows["dedisp"]["hbm_share"] > 0.9
+    assert rows["dedisp"]["verdict"] == "memory-bound"
+    assert rows["accel_search"]["verdict"] == "compute-bound"
+    report.render(info)
+    out = capsys.readouterr().out
+    assert "Roofline" in out
+    assert "dedispersion HBM-byte share" in out
+    assert "(unavailable)" in out
+    assert "memory-bound" in out and "compute-bound" in out
+    # machine-readable twin carries the same rows
+    assert report.main([d, "-json"]) == 0
+
+
+def test_fleet_dispatch_counter_rollup():
+    """The fleet report's per-stage dispatch table: counter series
+    summed by kind across replicas (obs/fleetagg.counter_rollup)."""
+    from presto_tpu.obs import fleetagg
+    states = {}
+    for name, n in (("r1", 3), ("r2", 5)):
+        obs = _obs()
+        jaxtel.note_dispatch(obs, "dedisp", n)
+        jaxtel.note_dispatch(obs, "rfft_batch", 1)
+        states[name] = obs.metrics.export_state()
+    merged = fleetagg.merge_states(states)
+    disp = fleetagg.counter_rollup(merged, "jax_dispatches_total",
+                                   "kind")
+    assert disp["dedisp"] == 8 and disp["rfft_batch"] == 2
+    # non-counter / absent families degrade to {}
+    assert fleetagg.counter_rollup(merged, "nope", "kind") == {}
+
+
+def test_obs_coverage_check15_clean_and_pins_both_directions():
+    """Check 15 is clean on the real tree, and the COST_METRICS /
+    COST_SPANS sets are wired into taxonomy.METRICS (subset
+    relation)."""
+    from presto_tpu.lint.obscoverage import lint
+    from presto_tpu.obs import taxonomy
+    assert taxonomy.COST_METRICS <= taxonomy.METRICS
+    assert "obs:roofline-probe" in taxonomy.COST_SPANS
+    problems = [p for p in lint() if "COST" in p or "cost layer" in p]
+    assert problems == []
+
+
+# ----------------------------------------------------------------------
+# e2e: a tier-1 survey writes kernel_costs.json with a non-zero
+# dedispersion HBM-byte share, and presto-report renders it
+# ----------------------------------------------------------------------
+
+def test_survey_writes_kernel_costs_with_dedisp_share(tmp_path,
+                                                      capsys):
+    from presto_tpu.models.synth import FakeSignal, \
+        fake_filterbank_file
+    from presto_tpu.pipeline.survey import SurveyConfig, run_survey
+
+    raw = str(tmp_path / "psr.fil")
+    sig = FakeSignal(f=17.0, dm=10.0, shape="gauss", width=0.08,
+                     amp=0.8)
+    fake_filterbank_file(raw, 1 << 13, 2e-4, 16, 400.0, 1.0, sig,
+                         noise_sigma=2.0, nbits=8)
+    work = str(tmp_path / "work")
+    cfg = SurveyConfig(lodm=5.0, hidm=12.0, nsub=16, zmax=0,
+                       numharm=2, sigma=3.0, fold_top=0,
+                       rfi_time=0.4, singlepulse=True,
+                       obs=ObsConfig(enabled=True))
+    run_survey([raw], cfg, workdir=work)
+
+    costs = costmodel.load_costs(work)
+    assert costs is not None, "survey did not write kernel_costs.json"
+    kinds = costs["kinds"]
+    assert "dedisp" in kinds, sorted(kinds)
+    assert kinds["dedisp"]["dispatches"] > 0
+    assert kinds["dedisp"].get("hbm_bytes_total", 0) > 0
+    assert kinds["dedisp"].get("flops_total", 0) > 0
+    # the device search stages harvested too — with their dispatch
+    # counts attributed even when the kind's only dispatch preceded
+    # its probe (the backfill path)
+    for kind in ("rfft_batch", "accel_search", "sp_search"):
+        assert kind in kinds, sorted(kinds)
+        assert kinds[kind].get("hbm_bytes_total", 0) > 0, kind
+
+    from presto_tpu.obs import roofline
+    rows = {r["kind"]: r
+            for r in roofline.roofline_rows(costs, None)}
+    assert rows["dedisp"]["hbm_share"] > 0.0
+
+    # the acceptance rendering: presto-report prints the roofline
+    # table with the dedispersion callout
+    from presto_tpu.apps import report
+    assert report.main([work]) == 0
+    out = capsys.readouterr().out
+    assert "Roofline" in out
+    assert "dedispersion HBM-byte share" in out
+    assert "dedisp" in out

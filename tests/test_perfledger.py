@@ -1,0 +1,219 @@
+"""Perf ledger + regression gate (ISSUE 15 second half): episode
+statistics, append/merge durability, corruption degradation, the gate
+verdict both ways (pass + deliberate-slowdown fail), and the tier-1
+smoke over the COMMITTED PERF_LEDGER.json.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from presto_tpu.obs import perfledger
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+GATE = os.path.join(REPO, "tools", "perf_gate.py")
+COMMITTED = os.path.join(REPO, "PERF_LEDGER.json")
+
+
+def _episode(run_id, value, mad=1.0, metric="rate", ts=None,
+             direction="higher", fingerprint="fp|cpu"):
+    return {
+        "run_id": run_id, "ts": float(ts if ts is not None
+                                      else hash(run_id) % 1000),
+        "fingerprint": fingerprint, "workload": "smoke",
+        "source": "test",
+        "metrics": {metric: {"median": float(value),
+                             "mad": float(mad), "k": 5,
+                             "unit": "x/s",
+                             "direction": direction}},
+    }
+
+
+# ----------------------------------------------------------------------
+# statistics
+# ----------------------------------------------------------------------
+
+def test_median_and_mad():
+    assert perfledger.median([3, 1, 2]) == 2
+    assert perfledger.median([4, 1, 3, 2]) == 2.5
+    assert perfledger.mad([10, 10, 10]) == 0.0
+    assert perfledger.mad([1, 2, 9]) == 1.0
+
+
+def test_metric_from_samples():
+    m = perfledger.metric_from_samples([1.0, 2.0, 3.0], "s", "lower")
+    assert m == {"median": 2.0, "mad": 1.0, "k": 3, "unit": "s",
+                 "direction": "lower"}
+    with pytest.raises(ValueError):
+        perfledger.metric_from_samples([1.0], "s", "sideways")
+
+
+# ----------------------------------------------------------------------
+# ledger durability
+# ----------------------------------------------------------------------
+
+def test_append_merge_save_roundtrip(tmp_path):
+    path = str(tmp_path / "ledger.json")
+    led = perfledger.PerfLedger()
+    led.append(_episode("a", 100.0, ts=1))
+    led.append(_episode("b", 101.0, ts=2))
+    led.save(path)
+    back = perfledger.PerfLedger.load(path)
+    assert [e["run_id"] for e in back.episodes] == ["a", "b"]
+    # concurrent writer composes: a second in-memory ledger with one
+    # overlapping and one new episode merge-saves to the union
+    other = perfledger.PerfLedger()
+    other.append(_episode("b", 999.0, ts=2))     # same run_id: kept once
+    other.append(_episode("c", 102.0, ts=3))
+    other.save(path)
+    merged = perfledger.PerfLedger.load(path)
+    assert [e["run_id"] for e in merged.episodes] == ["a", "b", "c"]
+    # append-only: the original b survived, the duplicate was dropped
+    assert merged.episodes[1]["metrics"]["rate"]["median"] == 101.0
+
+
+def test_corruption_degrades_to_empty_with_load_error(tmp_path):
+    path = str(tmp_path / "ledger.json")
+    with open(path, "w") as f:
+        f.write("{truncated")
+    with pytest.warns(RuntimeWarning):
+        led = perfledger.PerfLedger.load(path)
+    assert led.episodes == [] and "unreadable" in led.load_error
+    # stale schema likewise
+    with open(path, "w") as f:
+        json.dump({"schema": 99, "episodes": []}, f)
+    with pytest.warns(RuntimeWarning):
+        led = perfledger.PerfLedger.load(path)
+    assert "stale schema" in led.load_error
+    # malformed episodes are dropped row-wise, not fatally
+    with open(path, "w") as f:
+        json.dump({"schema": 1,
+                   "episodes": [_episode("ok", 1.0), {"junk": 1}]}, f)
+    led = perfledger.PerfLedger.load(path)
+    assert led.load_error is None
+    assert [e["run_id"] for e in led.episodes] == ["ok"]
+
+
+def test_select_is_fingerprint_and_workload_scoped():
+    led = perfledger.PerfLedger(episodes=[
+        _episode("a", 1.0, fingerprint="fp|cpu"),
+        _episode("b", 2.0, fingerprint="fp|tpu"),
+    ])
+    assert [e["run_id"]
+            for e in led.select(fingerprint="fp|cpu")] == ["a"]
+    assert led.select(workload="full") == []
+
+
+# ----------------------------------------------------------------------
+# the gate
+# ----------------------------------------------------------------------
+
+def _history(values, mad=1.0):
+    return [_episode("h%d" % i, v, mad=mad, ts=i)
+            for i, v in enumerate(values)]
+
+
+def test_gate_passes_within_noise():
+    hist = _history([100, 101, 99, 100, 102], mad=2.0)
+    ep = _episode("new", 97.0, mad=2.0, ts=99)
+    v = perfledger.gate(ep, hist + [ep])
+    assert v["ok"], v
+    (row,) = v["rows"]
+    assert row["status"] == "ok" and row["baseline"] == 100.0
+
+
+def test_gate_fails_on_regression_higher_and_lower():
+    hist = _history([100, 101, 99, 100, 102], mad=1.0)
+    v = perfledger.gate(_episode("bad", 50.0, mad=1.0, ts=99), hist)
+    assert not v["ok"]
+    assert v["rows"][0]["status"] == "regression"
+    # lower-is-better metrics regress upward
+    hist_l = [_episode("l%d" % i, 1.0, mad=0.01, ts=i,
+                       direction="lower") for i in range(5)]
+    v = perfledger.gate(_episode("slow", 2.0, mad=0.01, ts=99,
+                                 direction="lower"), hist_l)
+    assert not v["ok"]
+    # ... and a lower value is an improvement, not a regression
+    v = perfledger.gate(_episode("fast", 0.5, mad=0.01, ts=99,
+                                 direction="lower"), hist_l)
+    assert v["ok"]
+
+
+def test_gate_noise_band_scales_with_mad():
+    # 30% swing but the history itself is that noisy: no regression
+    hist = _history([100, 140, 80, 120, 90], mad=25.0)
+    v = perfledger.gate(_episode("jittery", 70.0, mad=25.0, ts=99),
+                        hist)
+    assert v["ok"], v
+
+
+def test_gate_first_episode_has_no_baseline():
+    ep = _episode("first", 100.0, ts=1)
+    v = perfledger.gate(ep, [ep])
+    assert v["ok"]
+    assert v["rows"][0]["status"] == "no-baseline"
+
+
+def test_inject_slowdown_trips_the_gate():
+    hist = _history([100, 101, 99, 100, 102], mad=1.0)
+    degraded = perfledger.inject_slowdown(hist[-1], 2.0)
+    assert degraded["run_id"] != hist[-1]["run_id"]
+    v = perfledger.gate(degraded, hist)
+    assert not v["ok"]
+    with pytest.raises(ValueError):
+        perfledger.inject_slowdown(hist[-1], 1.0)
+
+
+# ----------------------------------------------------------------------
+# the CLI over the COMMITTED miniature ledger (the tier-1 smoke the
+# ISSUE pins: pass as committed, exit 1 on an injected slowdown)
+# ----------------------------------------------------------------------
+
+def _run_gate(*args):
+    return subprocess.run(
+        [sys.executable, GATE] + list(args), cwd=REPO,
+        capture_output=True, text=True,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"))
+
+
+def test_committed_ledger_exists_and_is_loadable():
+    assert os.path.exists(COMMITTED), \
+        "PERF_LEDGER.json must be committed (ISSUE 15)"
+    led = perfledger.PerfLedger.load(COMMITTED)
+    assert led.load_error is None
+    assert len(led.episodes) >= 2, \
+        "the committed ledger needs a baseline window"
+
+
+def test_perf_gate_smoke_passes_on_committed_ledger():
+    r = _run_gate("--smoke")
+    assert r.returncode == 0, r.stderr
+
+
+def test_perf_gate_exits_1_on_injected_slowdown():
+    r = _run_gate("--smoke", "--inject-slowdown", "2.0")
+    assert r.returncode == 1, r.stderr
+    assert "REGRESSION" in r.stderr
+
+
+def test_perf_gate_exits_1_on_corrupt_ledger(tmp_path):
+    bad = str(tmp_path / "bad.json")
+    with open(bad, "w") as f:
+        f.write("{nope")
+    r = _run_gate("--smoke", "--ledger", bad)
+    assert r.returncode == 1
+    assert "unusable" in r.stderr
+
+
+def test_perf_gate_json_verdict(tmp_path):
+    path = str(tmp_path / "ledger.json")
+    led = perfledger.PerfLedger(episodes=_history(
+        [100, 101, 99, 100, 102]))
+    led.save(path)
+    r = _run_gate("--smoke", "--ledger", path, "--json")
+    assert r.returncode == 0, r.stderr
+    out = json.loads(r.stdout)
+    assert out["verdict"]["ok"] is True

@@ -477,7 +477,11 @@ def test_service_metrics_json_shape_and_prometheus(tmp_path):
     try:
         m = service.metrics()
         assert set(m) == {"uptime_s", "queue", "jobs", "scheduler",
-                          "plans", "latency", "events"}
+                          "plans", "latency", "events",
+                          "kernel_costs"}
+        # no dispatch site has harvested a unit cost yet: the kernel
+        # observatory block starts empty, never absent (r15)
+        assert m["kernel_costs"] == {}
         assert set(m["scheduler"]) == {
             "alive", "jobs_done", "jobs_failed", "retries",
             "retry_waiting", "batches", "degrades",

@@ -1,0 +1,206 @@
+"""perf_gate: the exit-1 perf-regression gate over PERF_LEDGER.json.
+
+The ledger (obs/perfledger.py) is the durable time series of measured
+episodes; this CLI judges the newest episode against the rolling
+baseline — the median of the previous ``--window`` same-fingerprint,
+same-workload episodes per metric — and exits 1 when any metric's
+direction-adjusted delta exceeds ``max(rel_tol * baseline,
+mad_k * noise)`` (noise = the wider of the baseline's and the
+episode's MAD bands, so the gate's tolerance scales with measured
+jitter, never a guessed constant).
+
+Modes:
+
+  --smoke              judge the committed ledger's own newest episode
+                       (pure file arithmetic, no device work — this is
+                       the tier-1 CI tier, tests/test_perfledger.py)
+  --measure            run the miniature smoke workload (seconds on
+                       any backend), append the episode, then gate it
+  --inject-slowdown F  gate a synthetic episode degraded by factor F
+                       instead of a real one — the deliberate-slowdown
+                       proof that the gate actually trips (must exit 1)
+
+The FULL gate — ``python bench.py && python tools/perf_gate.py`` —
+re-measures the real workload contract and belongs to the slow/bench
+tier (docs/PERFORMANCE.md, "Perf-regression ledger").  A corrupted or
+stale-schema ledger exits 1 with the load error spelled out (the
+ledger itself degrades to empty; the GATE failing loudly is the
+point).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from presto_tpu.obs import perfledger  # noqa: E402
+
+#: the miniature measurement contract (--measure): small enough for
+#: seconds-scale CPU reps, same shapes every run so episodes compare
+SMOKE = {"accel_numbins": 1 << 15, "accel_zmax": 20,
+         "accel_numharm": 2, "dedisp_numchan": 64, "dedisp_nsub": 16,
+         "dedisp_numdms": 32, "dedisp_nsamples": 1 << 16}
+
+
+def measure_smoke(k: int = 5) -> dict:
+    """The miniature episode: a small accelsearch + a small
+    dedispersion scan, k steady reps each (compile excluded),
+    median-of-k + MAD via perfledger.metric_from_samples."""
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    from presto_tpu.ops.dedispersion import dedisperse_scan
+    from presto_tpu.search.accel import AccelConfig, AccelSearch
+
+    rng = np.random.default_rng(99)
+    numbins = SMOKE["accel_numbins"]
+    pairs = np.stack([rng.normal(size=numbins),
+                      rng.normal(size=numbins)], -1).astype(np.float32)
+    pairs[1234] = (150.0, 0.0)
+    s = AccelSearch(AccelConfig(zmax=SMOKE["accel_zmax"],
+                                numharm=SMOKE["accel_numharm"],
+                                sigma=4.0),
+                    T=100.0, numbins=numbins)
+    dev = jnp.asarray(pairs)
+    s.search(dev)                              # warmup/compile
+    accel_samples = []
+    for _ in range(k):
+        t0 = time.perf_counter()
+        s.search(dev)
+        accel_samples.append(time.perf_counter() - t0)
+    cells = s.cfg.numz * int(s.rhi - s.rlo) * 2
+
+    numchan, nsub, numdms = (SMOKE["dedisp_numchan"],
+                             SMOKE["dedisp_nsub"],
+                             SMOKE["dedisp_numdms"])
+    nblocks, numpts = 4, SMOKE["dedisp_nsamples"] // 2
+    delays = {"chan": (np.arange(numchan) % 8).astype(np.int32),
+              "dm": (np.arange(numdms)[:, None]
+                     * np.linspace(0, 4, nsub)[None, :]).astype(
+                         np.int32)}
+    blocks = jax.jit(lambda key: jax.random.normal(
+        key, (nblocks, numchan, numpts),
+        dtype=jnp.float32))(jax.random.PRNGKey(3))
+    blocks.block_until_ready()
+
+    @jax.jit
+    def run(b):
+        return dedisperse_scan(b, delays, nsub)[:, ::1024].sum()
+
+    float(run(blocks))                         # warmup/compile
+    dedisp_samples = []
+    for _ in range(k):
+        t0 = time.perf_counter()
+        float(run(blocks))
+        dedisp_samples.append(time.perf_counter() - t0)
+
+    return perfledger.make_episode({
+        "smoke_accel_cells_per_sec": perfledger.metric_from_samples(
+            [cells / t for t in accel_samples], "cells/s", "higher"),
+        "smoke_dedisp_trials_per_sec": perfledger.metric_from_samples(
+            [numdms / t for t in dedisp_samples], "trials/s",
+            "higher"),
+    }, workload="smoke", source="perf-gate",
+        meta={"smoke": SMOKE, "k": k,
+              "device": jax.devices()[0].platform})
+
+
+def render(verdict: dict, episode: dict, file=None) -> None:
+    out = file or sys.stderr
+    w = lambda s="": print(s, file=out)     # noqa: E731
+    w("perf_gate: episode %s (%s, %s)"
+      % (episode.get("run_id"), episode.get("workload"),
+         episode.get("source")))
+    for row in verdict["rows"]:
+        if row["status"] == "no-baseline":
+            w("  %-28s %12.4g %-10s NO BASELINE (seeding)"
+              % (row["metric"], row["value"], row["unit"]))
+            continue
+        w("  %-28s %12.4g vs %12.4g %-10s %s"
+          % (row["metric"], row["value"], row["baseline"],
+             row["unit"],
+             "OK (margin %.3g)" % (row["threshold"]
+                                   - row["delta_worse"])
+             if row["status"] == "ok" else
+             "REGRESSION (worse by %.4g > threshold %.4g)"
+             % (row["delta_worse"], row["threshold"])))
+    w("perf_gate: %s" % ("PASS" if verdict["ok"] else "FAIL"))
+
+
+def build_parser():
+    p = argparse.ArgumentParser(
+        prog="perf_gate",
+        description="Exit-1 perf-regression gate over the "
+                    "fingerprint-keyed PERF_LEDGER.json")
+    p.add_argument("--ledger", default=None,
+                   help="ledger path (default: $%s or the repo's "
+                        "committed PERF_LEDGER.json)"
+                        % perfledger.ENV_LEDGER)
+    p.add_argument("--window", type=int, default=5,
+                   help="rolling-baseline depth (default 5)")
+    p.add_argument("--rel-tol", type=float, default=0.15,
+                   help="relative tolerance floor (default 0.15)")
+    p.add_argument("--mad-k", type=float, default=4.0,
+                   help="noise-band multiplier (default 4.0)")
+    p.add_argument("--smoke", action="store_true",
+                   help="judge the ledger's newest episode as-is "
+                        "(no device work; the tier-1 mode)")
+    p.add_argument("--measure", action="store_true",
+                   help="run the miniature smoke workload, append "
+                        "the episode, then gate it")
+    p.add_argument("--inject-slowdown", type=float, default=None,
+                   metavar="F",
+                   help="gate a synthetic episode degraded by factor "
+                        "F (the gate must exit 1 — the deliberate-"
+                        "slowdown proof)")
+    p.add_argument("--json", action="store_true",
+                   help="emit the verdict as JSON on stdout")
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    path = args.ledger or perfledger.default_ledger_path()
+    led = perfledger.PerfLedger.load(path)
+    if led.load_error is not None:
+        print("perf_gate: ledger %s unusable (%s)"
+              % (path, led.load_error), file=sys.stderr)
+        return 1
+
+    episode = None
+    if args.measure:
+        episode = measure_smoke()
+        led.append(episode)
+        led.save(path)
+    elif led.episodes:
+        episode = led.episodes[-1]
+    if episode is None:
+        print("perf_gate: ledger %s has no episodes" % path,
+              file=sys.stderr)
+        return 1
+
+    if args.inject_slowdown is not None:
+        episode = perfledger.inject_slowdown(episode,
+                                             args.inject_slowdown)
+
+    history = led.select(fingerprint=episode.get("fingerprint"),
+                         workload=episode.get("workload"))
+    verdict = perfledger.gate(episode, history, window=args.window,
+                              rel_tol=args.rel_tol,
+                              mad_k=args.mad_k)
+    if args.json:
+        print(json.dumps({"ledger": os.path.abspath(path),
+                          "episode": episode, "verdict": verdict},
+                         indent=1, sort_keys=True))
+    render(verdict, episode)
+    return 0 if verdict["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
