@@ -268,11 +268,36 @@ def collect_fleet(fleetdir: str,
             }
         info["slo"] = {"specs": [s.to_dict() for s in specs],
                        "tenants": evals, "sparklines": spark}
+    # Fleet supervisor: the on-disk registry + durable decision
+    # stream (serve/supervisor.py) — the scaling-episode timeline is
+    # rebuilt purely from these artifacts and the usage ledger, the
+    # same sources the acceptance harness replays
+    from presto_tpu.serve import supervisor as suplib
+    sup_reg = suplib.load_registry(fleetdir)
+    sup_events = _load_jsonl(suplib.events_path(fleetdir))
+    if sup_reg.get("replicas") or sup_events:
+        by_kind: dict = {}
+        for ev in sup_events:
+            k = ev.get("kind", "?")
+            by_kind[k] = by_kind.get(k, 0) + 1
+        info["supervisor"] = {
+            "replicas": sup_reg.get("replicas", {}),
+            "events": sup_events,
+            "by_kind": by_kind,
+        }
+
     if usage_rows or specs:
         backlog = [row.get("bucket")
                    for row in jobs.values()
                    if row.get("state") in ("pending", "leased")]
-        ready = len(ledger.alive_hosts())
+        # capacity counts ready NON-DRAINING replicas: a draining
+        # replica is already leaving, so counting it would mask
+        # pressure (the same clamp the router's /scale applies)
+        draining = {name for name, r
+                    in sup_reg.get("replicas", {}).items()
+                    if r.get("state") == suplib.DRAINING}
+        ready = len([h for h in ledger.alive_hosts()
+                     if h not in draining])
         info["scale"] = slolib.scale_advice(backlog, usage_rows,
                                             evals, ready, now=now)
 
@@ -435,6 +460,51 @@ def render_fleet(info: dict, file=None) -> None:
           % (inp["backlog_jobs"], inp["backlog_device_seconds"],
              inp["per_replica_capacity"], inp["ready_replicas"],
              ", ".join(inp["slo_pressure"]) or "none"))
+
+    sup = info.get("supervisor")
+    if sup:
+        w()
+        w("Supervisor (supervisor.json + supervisor_events.jsonl):")
+        for name, r in sorted(sup["replicas"].items()):
+            w("  replica %-16s %-9s pid=%s"
+              % (name, r.get("state", "?"), r.get("pid") or "?"))
+        if not sup["replicas"]:
+            w("  no supervised replicas registered")
+        if sup["by_kind"]:
+            w("  episode: %d event(s) — %s"
+              % (len(sup["events"]),
+                 "  ".join("%s=%d" % kv
+                           for kv in sorted(sup["by_kind"].items()))))
+        # the scaling-episode timeline, rebuilt purely from the
+        # durable decision stream: every actuation with the advisory
+        # inputs that drove it
+        acted = [ev for ev in sup["events"]
+                 if ev.get("kind") not in ("supervisor-hold",)]
+        if acted:
+            w("  timeline (holds elided):")
+        for ev in acted[-20:]:
+            what = ev.get("kind", "?").replace("supervisor-", "")
+            detail = ""
+            if ev.get("replica"):
+                detail += " %s" % ev["replica"]
+            if ev.get("replicas"):
+                detail += " %s" % ",".join(ev["replicas"])
+            if ev.get("wanted") is not None:
+                detail += "  wanted=%s" % ev["wanted"]
+            if ev.get("advice_reason"):
+                detail += " (%s)" % ev["advice_reason"]
+            if ev.get("why"):
+                detail += "  why=%s" % ev["why"]
+            if ev.get("warmup_s") is not None:
+                detail += "  warmup=%.2fs" % ev["warmup_s"]
+            w("    %s %-14s%s"
+              % (time.strftime("%H:%M:%S",
+                               time.localtime(ev.get("ts", 0))),
+                 what, detail))
+        holds = sup["by_kind"].get("supervisor-hold", 0)
+        if holds:
+            w("    (+ %d hold(s) withheld by hysteresis/cooldown)"
+              % holds)
 
     tr = info.get("traces")
     if tr:

@@ -1,4 +1,4 @@
-"""obs-coverage: the instrumentation-coverage contract (15 checks).
+"""obs-coverage: the instrumentation-coverage contract (16 checks).
 
 Formerly ``tools/obs_lint.py`` (a thin shim remains there for the
 historical entry point); now the fifth presto-lint family.  The
@@ -75,7 +75,14 @@ code path cannot ship silently:
      cost_model_unavailable) pinned BOTH directions (and as a subset
      of METRICS) — the per-kind FLOP/byte dispatch join is the
      measurement rig every remaining perf item (Pallas dedisp, GPU
-     backend, learned tuner) is judged by.
+     backend, learned tuner) is judged by;
+  16. the fleet supervisor (serve/supervisor.py + serve/router.py +
+     serve/jobledger.py): SUPERVISOR_EVENTS / SUPERVISOR_SPANS /
+     SUPERVISOR_METRICS pinned BOTH directions (and as subsets of
+     their parent catalogs) — the control loop that actuates /scale
+     must leave a reconstructable trail (every spawn/drain/hold with
+     its inputs), so its telemetry vocabulary is pinned the moment it
+     ships.
 
 Run via tools/presto_lint.py (exit-1 CLI over every family), the
 legacy tools/obs_lint.py shim, or tests/test_obs_lint.py.
@@ -204,7 +211,8 @@ def lint(root: Optional[str] = None) -> List[str]:
     # admissible here too)
     serve_srcs = _tree_sources(root, "presto_tpu/serve")
     serve_ok = (taxonomy.SERVE_EVENTS | taxonomy.FLEET_EVENTS
-                | taxonomy.DAG_EVENTS | taxonomy.SLO_EVENTS)
+                | taxonomy.DAG_EVENTS | taxonomy.SLO_EVENTS
+                | taxonomy.SUPERVISOR_EVENTS)
     emitted: Set[str] = set()
     for rel, src in sorted(serve_srcs.items()):
         kinds = set(EMIT_RE.findall(src))
@@ -213,7 +221,8 @@ def lint(root: Optional[str] = None) -> List[str]:
             problems.append(
                 "%s: event kind %r is not registered in "
                 "obs/taxonomy.SERVE_EVENTS, FLEET_EVENTS, "
-                "DAG_EVENTS, or SLO_EVENTS" % (rel, k))
+                "DAG_EVENTS, SLO_EVENTS, or SUPERVISOR_EVENTS"
+                % (rel, k))
 
     # 4. every job lifecycle state announces itself (scoped to the
     # JobStatus class body: queue.py also defines the Lanes constants,
@@ -674,6 +683,67 @@ def lint(root: Optional[str] = None) -> List[str]:
         problems.append(
             "cost layer: metric %r is not registered in "
             "obs/taxonomy.COST_METRICS" % name)
+
+    # 16. the fleet supervisor (serve/supervisor.py + serve/router.py
+    # + serve/jobledger.py): SUPERVISOR_EVENTS / SUPERVISOR_SPANS /
+    # SUPERVISOR_METRICS pinned BOTH directions (and as subsets of
+    # their parent catalogs) — every spawn/drain/hold decision must be
+    # reconstructable from telemetry alone, so the actuation loop's
+    # vocabulary may neither go dark nor go stale.
+    sup_files = ("presto_tpu/serve/supervisor.py",
+                 "presto_tpu/serve/router.py",
+                 "presto_tpu/serve/jobledger.py")
+    su_events: Set[str] = set()
+    su_spans: Set[str] = set()
+    su_metrics: Set[str] = set()
+    for rel in sup_files:
+        try:
+            src = _read(rel, root)
+        except OSError:
+            continue
+        su_events |= set(EMIT_RE.findall(src))
+        su_events |= set(CLUSTER_EVENT_RE.findall(src))
+        su_spans |= set(SPAN_RE.findall(src))
+        su_metrics |= set(METRIC_RE.findall(src))
+    for s in sorted(taxonomy.SUPERVISOR_SPANS - taxonomy.SERVE_SPANS):
+        problems.append(
+            "obs/taxonomy.py: SUPERVISOR_SPANS lists %r which is not "
+            "in SERVE_SPANS" % s)
+    for s in sorted(taxonomy.SUPERVISOR_SPANS - su_spans):
+        problems.append(
+            "obs/taxonomy.py: SUPERVISOR_SPANS lists %r but the "
+            "supervisor layer never opens it" % s)
+    for s in sorted({x for x in su_spans
+                     if x.startswith("supervisor:")}
+                    - taxonomy.SUPERVISOR_SPANS):
+        problems.append(
+            "supervisor layer: span %r is not registered in "
+            "obs/taxonomy.SUPERVISOR_SPANS" % s)
+    for k in sorted(taxonomy.SUPERVISOR_EVENTS - su_events):
+        problems.append(
+            "obs/taxonomy.py: SUPERVISOR_EVENTS lists %r but the "
+            "supervisor layer never emits it" % k)
+    for k in sorted({x for x in su_events
+                     if x.startswith("supervisor-")}
+                    - taxonomy.SUPERVISOR_EVENTS):
+        problems.append(
+            "supervisor layer: event kind %r is not registered in "
+            "obs/taxonomy.SUPERVISOR_EVENTS" % k)
+    for name in sorted(taxonomy.SUPERVISOR_METRICS
+                       - taxonomy.METRICS):
+        problems.append(
+            "obs/taxonomy.py: SUPERVISOR_METRICS lists %r which is "
+            "not in METRICS" % name)
+    for name in sorted(taxonomy.SUPERVISOR_METRICS - su_metrics):
+        problems.append(
+            "obs/taxonomy.py: SUPERVISOR_METRICS lists %r but the "
+            "supervisor layer never registers it" % name)
+    for name in sorted({x for x in su_metrics
+                        if x.startswith("supervisor_")}
+                       - taxonomy.SUPERVISOR_METRICS):
+        problems.append(
+            "supervisor layer: metric %r is not registered in "
+            "obs/taxonomy.SUPERVISOR_METRICS" % name)
     return problems
 
 

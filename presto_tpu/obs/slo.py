@@ -407,6 +407,41 @@ def bucket_cost_model(rows: Iterable[dict]) -> Tuple[Dict[str, float],
     return means, (sum(all_ex) / len(all_ex)) if all_ex else None
 
 
+def fleet_median_cost(means: Dict[str, float],
+                      default_s: float = 5.0) -> float:
+    """The fleet-median per-bucket cost — the estimate an admission
+    gate charges a bucket it has never executed.  Median, not mean:
+    one pathological bucket must not poison every unknown job's
+    price.  `default_s` is the cold-fleet fallback (no bucket has
+    committed yet)."""
+    if not means:
+        return default_s
+    ordered = sorted(means.values())
+    mid = len(ordered) // 2
+    if len(ordered) % 2:
+        return ordered[mid]
+    return (ordered[mid - 1] + ordered[mid]) / 2.0
+
+
+def cost_estimator(rows: Iterable[dict], default_s: float = 5.0):
+    """``bucket -> expected device-seconds`` closure over the usage
+    ledger: known buckets price at their mean committed execute
+    seconds, unknown buckets at the fleet-median bucket cost (the
+    AutoTVM-style measured-cost prior), and a cold fleet at
+    `default_s`.  The closure is what `JobLedger.admit` charges
+    device-second quotas with and what the router's device-second
+    shedding prices backlog with — one model, every consumer."""
+    means, _global_mean = bucket_cost_model(rows)
+    fallback = fleet_median_cost(means, default_s)
+
+    def estimate(bucket) -> float:
+        return means.get(str(bucket or ""), fallback)
+
+    estimate.buckets = len(means)      # type: ignore[attr-defined]
+    estimate.fallback = fallback       # type: ignore[attr-defined]
+    return estimate
+
+
 # ----------------------------------------------------------------------
 # the /scale advisory
 # ----------------------------------------------------------------------

@@ -190,6 +190,52 @@ SLO_METRICS = frozenset({
     "slo_wanted_replicas",
 })
 
+#: fleet-supervisor event kinds — the actuation vocabulary of
+#: serve/supervisor.py (the control loop that closes the /scale
+#: advisory: spawn/drain/hold decisions with the advisory inputs
+#: that drove them, replica lifecycle transitions, dead-replica
+#: replacement, and crash-recovery adoption).  Every decision lands
+#: on the durable `<fleet>/supervisor_events.jsonl` stream so a
+#: whole scaling episode replays from telemetry alone.  Enforced
+#: BOTH directions by obs-coverage check 16 across supervisor.py +
+#: router.py + jobledger.py.
+SUPERVISOR_EVENTS = frozenset({
+    "supervisor-start",
+    "supervisor-stop",
+    "supervisor-adopt",
+    "supervisor-spawn",
+    "supervisor-spawn-failed",
+    "supervisor-up",
+    "supervisor-drain",
+    "supervisor-drained",
+    "supervisor-drain-timeout",
+    "supervisor-replace",
+    "supervisor-hold",
+    "supervisor-step-error",
+})
+
+#: fleet-supervisor span names (check 16, both directions): one span
+#: per gated decision plus one per actuation, so a scaling episode's
+#: trace mirrors its event stream
+SUPERVISOR_SPANS = frozenset({
+    "supervisor:decide",
+    "supervisor:spawn",
+    "supervisor:drain",
+    "supervisor:replace",
+})
+
+#: fleet-supervisor metrics (check 16, both directions, subset of
+#: METRICS): the supervised-fleet gauge and the actuation counters —
+#: holds included, because withheld actuations are the hysteresis
+#: doing its job and must be observable
+SUPERVISOR_METRICS = frozenset({
+    "supervisor_replicas",
+    "supervisor_spawns_total",
+    "supervisor_drains_total",
+    "supervisor_replacements_total",
+    "supervisor_holds_total",
+})
+
 #: streaming-layer event kinds — every `events.emit("<kind>", ...)`
 #: in presto_tpu/stream/ (enforced both directions by obs_lint check
 #: 7: the live trigger path may not emit unregistered kinds, and the
@@ -221,6 +267,10 @@ SERVE_SPANS = frozenset({
     "fleet:submit",
     "fleet:dag-submit",
     "slo:evaluate",
+    "supervisor:decide",
+    "supervisor:spawn",
+    "supervisor:drain",
+    "supervisor:replace",
 })
 
 #: discovery-DAG event kinds — the dependency-aware job-graph
@@ -462,6 +512,13 @@ METRICS = frozenset({
     "slo_burn_rate",
     "slo_burn_alerts_total",
     "slo_wanted_replicas",
+    # fleet supervisor (serve/supervisor.py actuation loop); pinned
+    # both directions by obs-coverage check 16 via SUPERVISOR_METRICS
+    "supervisor_replicas",
+    "supervisor_spawns_total",
+    "supervisor_drains_total",
+    "supervisor_replacements_total",
+    "supervisor_holds_total",
     # streaming search (presto_tpu/stream); every stream_* name here
     # must be registered by the stream layer (obs_lint check 7)
     "stream_blocks_total",

@@ -46,6 +46,7 @@ full protocol.
 
 from __future__ import annotations
 
+import os
 import time
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -71,18 +72,28 @@ class StaleResultError(StaleLeaseError, JobLedgerError):
 
 
 class TenantQuotaExceeded(JobLedgerError):
-    """Typed admission rejection: the tenant is at its quota of
-    active (pending + leased) jobs.  Mapped to HTTP 429 by the
+    """Typed admission rejection: the tenant is at its quota —
+    counted in active (pending + leased) jobs, or priced in expected
+    device-seconds of active work (``unit="device-seconds"``, the
+    measured-cost admission gate).  Mapped to HTTP 429 by the
     router; recorded as a `quota-exceeded` event, never a silent
     drop."""
 
-    def __init__(self, tenant: str, quota: int, active: int):
+    def __init__(self, tenant: str, quota, active,
+                 unit: str = "jobs", cost: float = 0.0):
         self.tenant = tenant
         self.quota = quota
         self.active = active
-        super().__init__(
-            "tenant %r is at its quota (%d active of %d allowed)"
-            % (tenant, active, quota))
+        self.unit = unit
+        self.cost = cost
+        if unit == "jobs":
+            msg = ("tenant %r is at its quota (%d active of %d "
+                   "allowed)" % (tenant, active, quota))
+        else:
+            msg = ("tenant %r is at its device-second quota "
+                   "(%.3f active + %.3f expected of %.3f allowed)"
+                   % (tenant, active, cost, quota))
+        super().__init__(msg)
 
 
 class JobLedger(LeaseLedger):
@@ -99,28 +110,125 @@ class JobLedger(LeaseLedger):
     EV_HOST_DEAD = "replica-dead"
     EV_EPOCH_BUMP = "fleet-epoch-bump"
 
+    #: SLO-class lease-weight multiplier cap: a 99.9 % tenant beats a
+    #: 50 % bronze 100:2 under contention, but no objective — however
+    #: many nines — can starve the rest beyond this ratio
+    CLASS_WEIGHT_CAP = 100.0
+
     # -- tenant configuration ------------------------------------------
     def set_tenant(self, tenant: str, weight: float = 1.0,
-                   quota: Optional[int] = None) -> None:
-        """Configure one tenant's WRR weight and active-job quota
-        (None = unbounded).  Unknown tenants default to weight 1,
-        no quota."""
+                   quota: Optional[int] = None,
+                   ds_quota: Optional[float] = None) -> None:
+        """Configure one tenant's WRR weight, active-job quota, and
+        device-second quota (None = unbounded).  ``ds_quota`` bounds
+        the *expected device-seconds* of the tenant's active
+        (pending + leased) work, priced by the per-bucket execute
+        cost model — the measured-cost admission gate that throttles
+        one tenant's few huge jobs and another's many tiny jobs
+        equivalently.  Unknown tenants default to weight 1, no
+        quotas."""
         with self._lock():
             state = self._load()
             state.setdefault("tenants", {})[str(tenant)] = {
                 "weight": max(float(weight), 1e-9),
                 "quota": None if quota is None else int(quota),
+                "ds_quota": (None if ds_quota is None
+                             else float(ds_quota)),
             }
             self._save(state)
 
     def tenants(self) -> Dict[str, dict]:
         return dict(self._load().get("tenants", {}))
 
-    @staticmethod
-    def _tenant_cfg(state: dict, tenant: str) -> dict:
+    # -- SLO-class lease weights ---------------------------------------
+    def _class_weights(self) -> Dict[str, float]:
+        """Per-tenant lease-weight multipliers derived from the SLO
+        classes in `<fleet>/slo.json` (cached by file stat): a tenant
+        with objective ``o`` multiplies its configured WRR weight by
+        ``min(1/(1-o), CLASS_WEIGHT_CAP)``, so under contention a
+        burning gold tenant's jobs are leased ahead of bronze
+        backfill in proportion to how little error budget its class
+        affords.  Tenants without a spec keep multiplier 1."""
+        from presto_tpu.obs import slo
+        try:
+            st = os.stat(slo.spec_path(self.workdir))
+            key = (st.st_mtime_ns, st.st_size)
+        except OSError:
+            key = None
+        cached = getattr(self, "_class_cache", None)
+        if cached is not None and cached[0] == key:
+            return cached[1]
+        weights: Dict[str, float] = {}
+        if key is not None:
+            for spec in slo.load_specs(self.workdir):
+                mult = 1.0 / max(1.0 - float(spec.objective), 1e-9)
+                weights[spec.tenant] = min(max(mult, 1.0),
+                                           self.CLASS_WEIGHT_CAP)
+        self._class_cache = (key, weights)
+        return weights
+
+    def _tenant_cfg(self, state: dict, tenant: str) -> dict:
         cfg = state.get("tenants", {}).get(tenant) or {}
-        return {"weight": max(float(cfg.get("weight", 1.0)), 1e-9),
-                "quota": cfg.get("quota")}
+        weight = max(float(cfg.get("weight", 1.0)), 1e-9)
+        weight *= self._class_weights().get(tenant, 1.0)
+        return {"weight": weight,
+                "quota": cfg.get("quota"),
+                "ds_quota": cfg.get("ds_quota")}
+
+    # -- the measured-cost admission gate ------------------------------
+    def cost_estimator(self):
+        """``bucket -> expected device-seconds`` from the usage
+        ledger's per-bucket execute cost model (fleet-median fallback
+        for unknown buckets; obs/slo.cost_estimator), cached by the
+        usage file's stat so admission stays O(active jobs), not
+        O(history) per call."""
+        from presto_tpu.obs import slo
+        try:
+            st = os.stat(self.usage.path)
+            key = (st.st_mtime_ns, st.st_size)
+        except OSError:
+            key = None
+        cached = getattr(self, "_cost_cache", None)
+        if cached is not None and cached[0] == key:
+            return cached[1]
+        est = slo.cost_estimator(self.usage.rows())
+        self._cost_cache = (key, est)
+        return est
+
+    def _charge_ds_quota(self, state: dict, tenant: str, cfg: dict,
+                         new_buckets: Sequence) -> None:
+        """Raise the typed device-second rejection when admitting
+        ``new_buckets`` would push the tenant's expected active
+        device-seconds past its ds_quota.  Called under the ledger
+        lock, before any row is created."""
+        if cfg.get("ds_quota") is None:
+            return
+        est = self.cost_estimator()
+        active_ds = sum(
+            est(j.get("bucket"))
+            for j in self._items(state).values()
+            if j.get("tenant") == tenant
+            and j["state"] in (PENDING, LEASED))
+        cost = sum(est(b) for b in new_buckets)
+        if active_ds + cost > float(cfg["ds_quota"]):
+            self._event("quota-exceeded", tenant=tenant,
+                        quota=cfg["ds_quota"],
+                        active=round(active_ds, 6),
+                        cost=round(cost, 6),
+                        unit="device-seconds")
+            raise TenantQuotaExceeded(
+                tenant, float(cfg["ds_quota"]),
+                round(active_ds, 6), unit="device-seconds",
+                cost=round(cost, 6))
+
+    def backlog_device_seconds(self) -> float:
+        """Expected device-seconds of the active (pending + leased)
+        backlog under the cost model — the router's device-second
+        shedding signal (the priced twin of `depth()`)."""
+        est = self.cost_estimator()
+        return sum(est(row.get("bucket"))
+                   for row in self._load()[self.ITEMS_KEY].values()
+                   if row["state"] in (PENDING, LEASED))
 
     # -- admission ------------------------------------------------------
     def admit(self, spec: dict, tenant: str = DEFAULT_TENANT,
@@ -164,9 +272,11 @@ class JobLedger(LeaseLedger):
                          and j["state"] in (PENDING, LEASED))
             if cfg["quota"] is not None and active >= cfg["quota"]:
                 self._event("quota-exceeded", tenant=tenant,
-                            quota=cfg["quota"], active=active)
+                            quota=cfg["quota"], active=active,
+                            unit="jobs")
                 raise TenantQuotaExceeded(tenant, int(cfg["quota"]),
                                           active)
+            self._charge_ds_quota(state, tenant, cfg, [bucket])
             if job_id is None:
                 seq = int(state.get("next_id", 1))
                 state["next_id"] = seq + 1
@@ -300,9 +410,12 @@ class JobLedger(LeaseLedger):
             if (cfg["quota"] is not None
                     and active + len(nodes) > cfg["quota"]):
                 self._event("quota-exceeded", tenant=tenant,
-                            quota=cfg["quota"], active=active)
+                            quota=cfg["quota"], active=active,
+                            unit="jobs")
                 raise TenantQuotaExceeded(tenant, int(cfg["quota"]),
                                           active)
+            self._charge_ds_quota(state, tenant, cfg,
+                                  [b for _, _, b, _ in nodes])
             if dag_id is None:
                 seq = int(state.get("next_dag", 1))
                 state["next_dag"] = seq + 1

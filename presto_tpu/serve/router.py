@@ -15,13 +15,19 @@ What the router adds on top of the ledger:
   * **Load shedding** — when fleet depth (pending + leased) crosses
     the high-water mark, `/submit` answers 429 with a `Retry-After`
     header: the fleet-scale twin of the in-process queue's bounded-
-    depth backpressure (QueueFull -> 429).
-  * **Tenant quotas** — `JobLedger.admit` enforces per-tenant active-
-    job quotas; the typed `TenantQuotaExceeded` maps to a 429 whose
-    body names the tenant and quota (`error: "quota-exceeded"`), and
-    a `quota-exceeded` event is recorded — never a silent drop.
-    Weighted round-robin *fairness* between tenants is the ledger's
-    lease policy (deficit WRR over the `tenant` job field).
+    depth backpressure (QueueFull -> 429).  A second, *priced* mark
+    (`high_water_ds`) sheds on the backlog's expected device-seconds
+    under the per-bucket execute cost model, so few huge jobs and
+    many tiny jobs back the fleet up equivalently.
+  * **Tenant quotas** — `JobLedger.admit` enforces per-tenant quotas
+    counted in active jobs AND priced in expected device-seconds
+    (`ds_quota`); the typed `TenantQuotaExceeded` maps to a 429
+    whose body names the tenant, quota, and unit
+    (`error: "quota-exceeded"`), and a `quota-exceeded` event is
+    recorded — never a silent drop.  Weighted round-robin *fairness*
+    between tenants is the ledger's lease policy (deficit WRR over
+    the `tenant` job field, with SLO-class weight multipliers from
+    `<fleet>/slo.json`).
   * **Fleet view** — `/fleet` aggregates the ledger (depth, epoch,
     tenant counts) with each registered replica's `/readyz` (polled;
     replicas register their HTTP address at ledger join), and the
@@ -124,11 +130,17 @@ class NoReadyReplica(RuntimeError):
 class RouterConfig:
     fleetdir: str
     high_water: int = 256          # shed point over pending+leased
+    #: shed point over the backlog's EXPECTED DEVICE-SECONDS (priced
+    #: by the per-bucket execute cost model, fleet-median fallback);
+    #: 0 disables — the count-based high_water stays the backstop
+    high_water_ds: float = 0.0
     retry_after_s: float = 2.0
     heartbeat_timeout: float = 10.0
     poll_s: float = 2.0            # replica /readyz poll cadence
     require_ready: bool = True     # 503 /submit with no ready replica
-    #: "name:weight[:quota]" tenant configs applied at start
+    #: "name:weight[:quota[:ds_quota]]" tenant configs applied at
+    #: start (empty quota field skips it: "gold:4::120" is weight 4,
+    #: no job-count quota, 120 expected device-seconds)
     tenants: List[str] = field(default_factory=list)
     #: "tenant:objective[:latency_s]" SLO specs (obs/slo.py);
     #: persisted to <fleet>/slo.json so the fleet report and a
@@ -172,8 +184,12 @@ class FleetRouter:
             parts = spec.split(":")
             self.ledger.set_tenant(
                 parts[0],
-                weight=float(parts[1]) if len(parts) > 1 else 1.0,
-                quota=int(parts[2]) if len(parts) > 2 else None)
+                weight=(float(parts[1]) if len(parts) > 1
+                        and parts[1] else 1.0),
+                quota=(int(parts[2]) if len(parts) > 2
+                       and parts[2] else None),
+                ds_quota=(float(parts[3]) if len(parts) > 3
+                          and parts[3] else None))
         # SLO observatory: declarative per-tenant specs, persisted as
         # <fleet>/slo.json (a restarted router with no -slo flags
         # reuses the persisted set); evaluation runs in the poll loop
@@ -300,6 +316,28 @@ class FleetRouter:
             return sorted(h for h, r in self._ready.items()
                           if r and r.get("ready"))
 
+    def serving_replicas(self) -> List[str]:
+        """Ready AND non-draining replicas — the capacity count the
+        /scale advisory prices pressure against.  A draining replica
+        still answers polls (it may be finishing in-flight work) but
+        leases nothing new, so counting it toward capacity masks
+        SLO-debt pressure exactly when the supervisor most needs the
+        signal: mid-scale-down.  Both the readiness payload's own
+        `draining` flag and the fleet lease state's are honored —
+        an in-process replica drained directly (replica.drain())
+        flips the lease state before the service flag."""
+        with self._ready_lock:
+            out = []
+            for host, r in self._ready.items():
+                if not (r and r.get("ready")):
+                    continue
+                if r.get("draining"):
+                    continue
+                if (r.get("lease") or {}).get("draining"):
+                    continue
+                out.append(host)
+            return sorted(out)
+
     def _poll_loop(self) -> None:
         while not self._stop.is_set():
             try:
@@ -354,17 +392,35 @@ class FleetRouter:
                             min(est, 600.0)), "e2e-estimate")
         return self.cfg.retry_after_s, "constant"
 
-    def _shed(self, tenant: str, depth: int) -> None:
+    def _shed(self, tenant: str, depth: int,
+              backlog_ds: Optional[float] = None) -> None:
         """429 + Retry-After at the high-water mark; the chosen value
         (and whether it came from the e2e estimate or the constant
-        fallback) rides the `fleet_shed_total` event payload."""
+        fallback) rides the `fleet_shed_total` event payload.
+        ``backlog_ds`` names the priced backlog when the DEVICE-
+        SECOND mark tripped (the cost-model shed path)."""
         retry_after_s, source = self.retry_after_estimate(depth)
         self._c_shed.inc()
-        self.events.emit("shed", tenant=tenant, depth=depth,
-                         high_water=self.cfg.high_water,
-                         retry_after_s=round(retry_after_s, 3),
-                         retry_after_source=source)
+        fields = dict(tenant=tenant, depth=depth,
+                      high_water=self.cfg.high_water,
+                      retry_after_s=round(retry_after_s, 3),
+                      retry_after_source=source)
+        if backlog_ds is not None:
+            fields["backlog_device_seconds"] = round(backlog_ds, 3)
+            fields["high_water_ds"] = self.cfg.high_water_ds
+        self.events.emit("shed", **fields)
         raise FleetBusy(depth, self.cfg.high_water, retry_after_s)
+
+    def _check_water(self, tenant: str, depth: int) -> None:
+        """Both shed marks: job count (the backstop) and expected
+        device-seconds (the priced gate — a backlog of few huge jobs
+        sheds exactly like one of many tiny jobs)."""
+        if depth >= self.cfg.high_water:
+            self._shed(tenant, depth)
+        if self.cfg.high_water_ds > 0.0:
+            backlog_ds = self.ledger.backlog_device_seconds()
+            if backlog_ds >= self.cfg.high_water_ds:
+                self._shed(tenant, depth, backlog_ds)
 
     def submit(self, spec: dict) -> dict:
         """Durably admit one job.  Raises FleetBusy (shed),
@@ -378,8 +434,7 @@ class FleetRouter:
         try:
             depth = self.ledger.depth()
             self._g_depth.set(depth)
-            if depth >= self.cfg.high_water:
-                self._shed(tenant, depth)
+            self._check_water(tenant, depth)
             if self.cfg.require_ready and not self.ready_replicas():
                 raise NoReadyReplica(
                     "no ready replica registered in %s"
@@ -420,8 +475,7 @@ class FleetRouter:
         try:
             depth = self.ledger.depth()
             self._g_depth.set(depth)
-            if depth >= self.cfg.high_water:
-                self._shed(tenant, depth)
+            self._check_water(tenant, depth)
             if self.cfg.require_ready and not self.ready_replicas():
                 raise NoReadyReplica(
                     "no ready replica registered in %s"
@@ -599,9 +653,11 @@ class FleetRouter:
                             w["fast_burn"])
                     if w["alerting"]:
                         alerts.append((tenant, w["window"], w))
+            # capacity clamps to ready NON-DRAINING replicas: a
+            # draining one is leaving and must not mask pressure
             advice = slo.scale_advice(
                 self._backlog_buckets(), rows, evals,
-                len(self.ready_replicas()),
+                len(self.serving_replicas()),
                 cfg=self._scale_cfg, now=now)
             wanted = advice["wanted_replicas"]
             span.set_attr("tenants", len(evals))
@@ -779,7 +835,8 @@ class _RouterHandler(BaseHTTPRequestHandler):
         except TenantQuotaExceeded as e:
             self._json(429, {"error": "quota-exceeded",
                              "tenant": e.tenant, "quota": e.quota,
-                             "active": e.active},
+                             "active": e.active,
+                             "unit": getattr(e, "unit", "jobs")},
                        headers={"Retry-After": "1"})
         except NoReadyReplica as e:
             self._json(503, {"error": "no-ready-replica",
@@ -822,15 +879,22 @@ def build_parser():
     p.add_argument("-high-water", type=int, default=256,
                    help="Shed submissions (429 + Retry-After) once "
                         "pending+leased jobs reach this depth")
+    p.add_argument("-high-water-ds", type=float, default=0.0,
+                   help="Shed once the backlog's EXPECTED DEVICE-"
+                        "SECONDS (per-bucket execute cost model, "
+                        "fleet-median fallback) reach this; 0 "
+                        "disables the priced gate")
     p.add_argument("-retry-after", type=float, default=2.0)
     p.add_argument("-hb-timeout", type=float, default=10.0,
                    help="Replica heartbeat TTL for the reap pass")
     p.add_argument("-poll", type=float, default=2.0,
                    help="Replica /readyz poll cadence, seconds")
     p.add_argument("-tenant", action="append", default=[],
-                   metavar="NAME:WEIGHT[:QUOTA]",
-                   help="Tenant WRR weight and optional active-job "
-                        "quota (repeatable)")
+                   metavar="NAME:WEIGHT[:QUOTA[:DS_QUOTA]]",
+                   help="Tenant WRR weight, optional active-job "
+                        "quota, and optional expected-device-second "
+                        "quota over active work (repeatable; an "
+                        "empty field skips it: gold:4::120)")
     p.add_argument("-slo", action="append", default=[],
                    metavar="TENANT:OBJECTIVE[:LATENCY_S]",
                    help="Per-tenant SLO spec (repeatable): "
@@ -859,6 +923,7 @@ def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
     cfg = RouterConfig(fleetdir=args.fleetdir,
                        high_water=args.high_water,
+                       high_water_ds=args.high_water_ds,
                        retry_after_s=args.retry_after,
                        heartbeat_timeout=args.hb_timeout,
                        poll_s=args.poll,

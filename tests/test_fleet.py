@@ -271,9 +271,10 @@ def test_fleet_kill_one_replica_exactly_once(tmp_path, tiny_beam):
         # the stranded job was re-admitted exactly once
         assert state["jobs"][stranded[0]]["redos"] == 1
         assert int(state["epoch"]) >= 1         # membership change
-        # exactly-once commit accounting
-        assert svc_b.obs.metrics.get(
-            "fleet_jobs_committed_total").value == 3
+        # exactly-once commit accounting (the counter increments
+        # after the ledger transaction — wait past that window)
+        assert _wait(lambda: svc_b.obs.metrics.get(
+            "fleet_jobs_committed_total").value == 3)
     finally:
         rep_a.stop()
         rep_b.stop()
@@ -446,7 +447,7 @@ def test_router_tenant_quota_typed_rejection(tmp_path, tiny_beam):
         assert ei.value.code == 429
         body = json.loads(ei.value.read())
         assert body == {"error": "quota-exceeded", "tenant": "vip",
-                        "quota": 1, "active": 1}
+                        "quota": 1, "active": 1, "unit": "jobs"}
         # typed event, not a silent drop
         assert any(e["kind"] == "quota-exceeded"
                    for e in router.events.tail(50))

@@ -27,10 +27,18 @@ exactly once, and the final artifacts (sifted list, .pfd,
      late commit is rejected by the epoch fence with the journaled
      result left untouched.
 
+`-supervisor` appends the ISSUE 16 supervised-fleet trial: SIGKILL a
+supervisor-spawned presto-serve subprocess mid-batch (the supervisor
+replaces it and exactly-once survives), then kill the supervisor
+itself (the fleet degrades to advisory-only and a second job wave
+still completes) and restart it (adoption from the persisted
+registry, no orphans).
+
 Writes FLEET_CHAOS.json (committed at the repo root).  Run:
 
   python tools/fleet_chaos.py -trials 3 -seed 9
   python tools/fleet_chaos.py --fast          # 1-trial smoke
+  python tools/fleet_chaos.py -trials 3 -supervisor -commit
 """
 
 from __future__ import annotations
@@ -218,6 +226,170 @@ def run_trial(trial: int, rng: random.Random, beam: str, ref: dict,
             rep.stop()
             svc.stop()
     return rec
+
+
+def run_supervisor_trial(rng: random.Random, beam: str, ref: dict,
+                         workdir: str, jobs: int,
+                         timeout: float) -> dict:
+    """The supervised-fleet kill trial (ISSUE 16): real presto-serve
+    subprocesses under a FleetSupervisor.
+
+      1. the supervisor brings up 2 replicas from the registry floor;
+      2. one replica is SIGKILL'd mid-batch (a lease held); the
+         supervisor replaces it outside the hysteresis/cooldown gates
+         and the lease reaper re-admits — every job still commits
+         exactly once, byte-equal to the never-failed reference;
+      3. the supervisor itself then dies abruptly (loop stops, no
+         graceful stop event): the fleet degrades to advisory-only —
+         a second wave of jobs admitted with NO supervisor running
+         still completes;
+      4. a restarted supervisor adopts every surviving replica from
+         the persisted registry without spawning duplicates — no
+         orphans, no double-supervision.
+    """
+    from presto_tpu.serve.jobledger import JobLedger
+    from presto_tpu.serve.router import (FleetRouter, RouterConfig,
+                                         start_http as router_http)
+    from presto_tpu.serve.supervisor import (FleetSupervisor,
+                                             SupervisorConfig, UP,
+                                             load_registry)
+    from presto_tpu.serve.usage import UsageLedger
+    import signal as _sig
+
+    os.environ["PRESTO_TPU_USAGE"] = "1"
+    base = os.path.join(workdir, "suptrial")
+    fleetdir = os.path.join(base, "fleet")
+    led = JobLedger(fleetdir)
+    wave1 = [led.admit({"rawfiles": [beam],
+                        "config": dict(TINY_CFG)},
+                       bucket="chaos-bucket")["job_id"]
+             for _ in range(jobs)]
+    rec = {"mode": "supervisor", "ok": False, "checks": {}}
+    router = FleetRouter(RouterConfig(
+        fleetdir=fleetdir, high_water=256, poll_s=0.2,
+        heartbeat_timeout=5.0)).start()
+    rhttpd = router_http(router)
+    url = "http://%s:%d" % rhttpd.server_address[:2]
+
+    def mkcfg():
+        return SupervisorConfig(
+            fleetdir=fleetdir, router_url=url, poll_s=0.2,
+            scale_up_after=1, scale_down_after=4, cooldown_s=0.5,
+            min_replicas=2, max_replicas=2, drain_timeout_s=90.0,
+            spawn_timeout_s=240.0, heartbeat_timeout=6.0,
+            hb_interval=0.25, hb_timeout=2.5,
+            replica_args=["-inflight", "1",
+                          "-depth", str(max(8, 2 * jobs + 2))])
+
+    sup = FleetSupervisor(mkcfg())
+    sup2 = None
+    try:
+        sup.start()
+        rec["checks"]["replicas_up"] = _wait(
+            lambda: sorted(r["state"]
+                           for r in sup.replicas().values())
+            == [UP, UP], timeout=timeout, poll=0.2)
+
+        # mid-batch: wait for a supervised replica to hold a lease,
+        # then SIGKILL its process the way a VM dies
+        def lease_holder():
+            st = led.read()
+            for row in st["jobs"].values():
+                if row["state"] == "leased" and row.get("owner"):
+                    pid = (sup.replicas()
+                           .get(row["owner"], {}).get("pid"))
+                    if pid:
+                        return row["owner"], pid
+            return None
+        rec["checks"]["victim_leased"] = _wait(
+            lambda: lease_holder() is not None, timeout=timeout,
+            poll=0.1)
+        victim, vpid = lease_holder() or ("?", 0)
+        rec["victim"] = victim
+        if vpid:
+            os.kill(vpid, _sig.SIGKILL)
+        rec["checks"]["victim_killed"] = _wait(
+            lambda: not _pid_alive(vpid), timeout=30.0)
+
+        # the supervisor must replace the dead replica (repair
+        # bypasses hysteresis/cooldown) and bring the fleet back to 2
+        rec["checks"]["victim_replaced"] = _wait(
+            lambda: victim not in sup.replicas()
+            and sorted(r["state"]
+                       for r in sup.replicas().values())
+            == [UP, UP], timeout=timeout, poll=0.2)
+
+        # abrupt supervisor death: the loop just stops — no graceful
+        # stop event, no drain.  Replicas are real processes and keep
+        # leasing: the fleet degrades to exactly the advisory-only
+        # behavior, so a second wave admitted now still completes.
+        sup._stop.set()
+        if sup._loop_t is not None:
+            sup._loop_t.join(timeout=10.0)
+        wave2 = [led.admit({"rawfiles": [beam],
+                            "config": dict(TINY_CFG)},
+                           bucket="chaos-bucket")["job_id"]
+                 for _ in range(jobs)]
+        rec["checks"]["all_terminal"] = _wait(
+            led.all_terminal, timeout=timeout, poll=0.2)
+        state = led.read()
+        done = [j for j, r in state["jobs"].items()
+                if r["state"] == "done"]
+        rec["checks"]["zero_lost"] = (
+            sorted(done) == sorted(wave1 + wave2))
+        rec["redos"] = {j: r["redos"]
+                       for j, r in state["jobs"].items()
+                       if r["redos"]}
+        equal = True
+        for jid in done:
+            detail = json.load(open(os.path.join(
+                fleetdir, "jobs", jid, "result.json")))
+            if detail["artifacts"] != ref:
+                equal = False
+        rec["checks"]["byte_equal_reference"] = equal
+        per_job = {}
+        for r in UsageLedger(fleetdir).raw_rows():
+            if r.get("state") == "done":
+                per_job[r["job_id"]] = per_job.get(r["job_id"],
+                                                   0) + 1
+        rec["checks"]["usage_exactly_once"] = (
+            sorted(per_job) == sorted(done)
+            and all(n == 1 for n in per_job.values()))
+
+        # restart: the new supervisor adopts every survivor from the
+        # persisted registry — nothing spawned anew, no orphans
+        before = {n: r.get("pid")
+                  for n, r in load_registry(fleetdir)
+                  ["replicas"].items()}
+        sup2 = FleetSupervisor(mkcfg())
+        adopted = sup2.adopt()
+        rec["adopted"] = sorted(adopted)
+        after = {n: r.get("pid") for n, r in
+                 sup2.replicas().items()}
+        rec["checks"]["adopt_no_orphans"] = (
+            sorted(adopted) == sorted(before)
+            and after == before
+            and all(_pid_alive(p) for p in after.values()))
+        rec["ok"] = all(rec["checks"].values())
+    finally:
+        teardown = sup2 or sup
+        teardown.drain_all(timeout=90.0)
+        sup.stop()
+        if sup2 is not None:
+            sup2.stop()
+        rhttpd.shutdown()
+        router.stop()
+    return rec
+
+
+def _pid_alive(pid) -> bool:
+    if not pid:
+        return False
+    try:
+        os.kill(int(pid), 0)
+        return True
+    except OSError:
+        return False
 
 
 def make_dag_beam(workdir: str) -> str:
@@ -410,6 +582,14 @@ def main(argv=None) -> int:
                    help="DAG mode: kill-one trials over whole "
                         "discovery DAGs at DAG-aware kill points "
                         "(-> DAG_CHAOS.json with -commit)")
+    p.add_argument("-supervisor", action="store_true",
+                   help="Also run the supervised-fleet kill trial: "
+                        "SIGKILL a supervisor-spawned replica "
+                        "mid-batch (supervisor replaces it, "
+                        "exactly-once preserved), then kill the "
+                        "supervisor itself (fleet degrades to "
+                        "advisory-only; a restarted supervisor "
+                        "adopts with no orphans)")
     p.add_argument("-out", type=str, default=None,
                    help="Report path (default <repo>/FLEET_CHAOS.json"
                         " or DAG_CHAOS.json only with -commit; else "
@@ -479,6 +659,15 @@ def main(argv=None) -> int:
                  "PASS" if rec["ok"] else "FAIL"), flush=True)
         trials.append(rec)
 
+    sup_rec = None
+    if args.supervisor:
+        sup_rec = run_supervisor_trial(rng, beam, ref, workdir,
+                                       args.jobs, args.timeout)
+        print("fleet_chaos: supervisor trial victim=%s -> %s"
+              % (sup_rec.get("victim", "?"),
+                 "PASS" if sup_rec["ok"] else "FAIL"), flush=True)
+        trials.append(sup_rec)
+
     report = {
         "seed": args.seed,
         "replicas": args.replicas,
@@ -490,6 +679,8 @@ def main(argv=None) -> int:
         "passed": sum(1 for r in trials if r["ok"]),
         "failed": sum(1 for r in trials if not r["ok"]),
     }
+    if sup_rec is not None:
+        report["supervisor_trial"] = sup_rec
     out = args.out or (os.path.join(REPO, "FLEET_CHAOS.json")
                        if args.commit else None)
     text = json.dumps(report, indent=1, sort_keys=True)
