@@ -23,6 +23,12 @@ them as ONE Perfetto file), any dead replica's flight-recorder dump
 (discovered via the ledger's tombstone/reap host records), and a
 per-DAG critical-path breakdown — which node gated end-to-end
 latency, lease-wait vs device-execute share.
+
+`presto-report -fleet DIR -campaign ID` renders one reprocessing
+campaign (serve/campaign.py) from its durable artifacts alone: wave
+progress, the live ETA/cost projection, the projection-convergence
+history replayed from the settle order, and the campaign's decision
+event timeline.
 """
 
 from __future__ import annotations
@@ -551,6 +557,155 @@ def render_fleet(info: dict, file=None) -> None:
 
 
 # ----------------------------------------------------------------------
+# campaign mode
+# ----------------------------------------------------------------------
+
+def collect_campaign(fleetdir: str, campaign_id: str) \
+        -> Optional[dict]:
+    """Everything the CAMPAIGN report needs, rebuilt purely from the
+    durable artifacts — the campaign ledger, its event stream, and
+    the fleet usage ledger (None for an unknown campaign).  The
+    projection-convergence series replays the settle history: after
+    each settled observation, what the projected total device-seconds
+    was at that instant — converging to the measured total as the
+    archive drained."""
+    from presto_tpu.serve.campaign import (CampaignConfig,
+                                           CampaignDriver, TERMINAL,
+                                           events_path,
+                                           load_campaign)
+    doc = load_campaign(fleetdir, campaign_id)
+    if doc is None:
+        return None
+    drv = CampaignDriver(CampaignConfig(fleetdir=fleetdir,
+                                        campaign_id=campaign_id))
+    try:
+        status = drv.status(doc=doc)
+        # device-seconds per observation (usage rows grouped by this
+        # campaign's deterministic dag ids)
+        dags = {r["dag_id"]: oid
+                for oid, r in doc["observations"].items()}
+        ds_by_obs: dict = {}
+        for urow in drv.ledger.usage.rows():
+            oid = dags.get(str(urow.get("dag") or ""))
+            if oid is not None:
+                ex = float((urow.get("phases") or {}).get("execute")
+                           or 0.0)
+                ds_by_obs[oid] = ds_by_obs.get(oid, 0.0) + ex
+    finally:
+        drv.close()
+    settle_order = sorted(
+        (float(r.get("completed_at", 0.0)), oid)
+        for oid, r in doc["observations"].items()
+        if r["state"] in TERMINAL)
+    total_n = len(doc["observations"])
+    series: List[dict] = []
+    ds = 0.0
+    for k, (ts, oid) in enumerate(settle_order, 1):
+        ds += ds_by_obs.get(oid, 0.0)
+        mean = ds / k
+        series.append({
+            "settled": k,
+            "observation": oid,
+            "device_seconds": round(ds, 6),
+            "projected_total_device_seconds":
+                round(ds + mean * (total_n - k), 6),
+        })
+    events = _load_jsonl(events_path(fleetdir, campaign_id))
+    by_kind: dict = {}
+    for ev in events:
+        k = ev.get("kind", "?")
+        by_kind[k] = by_kind.get(k, 0) + 1
+    return {
+        "fleetdir": os.path.abspath(fleetdir),
+        "campaign": status,
+        "created": doc.get("created"),
+        "completed": doc.get("completed"),
+        "convergence": series,
+        "events": events,
+        "by_kind": by_kind,
+    }
+
+
+def render_campaign(info: dict, file=None) -> None:
+    out = file or sys.stdout
+    w = lambda s="": print(s, file=out)     # noqa: E731
+    st = info["campaign"]
+    c = st["counts"]
+    w("presto-report (campaign): %s @ %s"
+      % (st["campaign_id"], info["fleetdir"]))
+    w()
+    w("State: %-8s %d observation(s) over %d wave(s) "
+      "(wave size %d, tenant %s)"
+      % (st["state"], st["observations"], st["waves"],
+         st["wave_size"], st["tenant"]))
+    w("  done=%d failed=%d admitted=%d admitting=%d pending=%d  "
+      "outstanding=%d  yield=%.3f"
+      % (c["done"], c["failed"], c["admitted"], c["admitting"],
+         c["pending"], st["outstanding"], st["yield"]))
+    if info.get("completed") and info.get("created"):
+        w("  elapsed %.1fs (created -> completed)"
+          % (info["completed"] - info["created"]))
+
+    proj = st.get("projection") or {}
+    if proj:
+        w()
+        w("Projection (measured device-seconds x remaining census):")
+        w("  settled %d / remaining %d   measured %.3f dev-s   "
+          "mean/obs %s"
+          % (proj["settled"], proj["remaining"],
+             proj["device_seconds_settled"],
+             "%.3f dev-s" % proj["mean_obs_device_seconds"]
+             if proj.get("mean_obs_device_seconds") is not None
+             else "?"))
+        w("  projected total %s   eta %s   throughput %.3g obs/s"
+          % ("%.3f dev-s" % proj["projected_total_device_seconds"]
+             if proj.get("projected_total_device_seconds")
+             is not None else "?",
+             "%.1fs" % proj["eta_s"]
+             if proj.get("eta_s") is not None else "?",
+             proj["throughput_obs_per_s"]))
+
+    series = info.get("convergence") or []
+    if series:
+        w()
+        final = series[-1]["device_seconds"]
+        w("Projection convergence (replayed from the settle "
+          "history; final measured total %.3f dev-s):" % final)
+        shown = (series if len(series) <= 8
+                 else series[:3] + [None] + series[-4:])
+        for row in shown:
+            if row is None:
+                w("    ...")
+                continue
+            pt = row["projected_total_device_seconds"]
+            err = ((pt - final) / final * 100.0) if final else 0.0
+            w("    after %3d settle(s)  projected %10.3f dev-s  "
+              "(%+6.1f%% vs final)"
+              % (row["settled"], pt, err))
+
+    if info.get("by_kind"):
+        w()
+        w("Events (campaign_events.jsonl): %d — %s"
+          % (len(info["events"]),
+             "  ".join("%s=%d" % kv
+                       for kv in sorted(info["by_kind"].items()))))
+        interesting = [ev for ev in info["events"]
+                       if ev.get("kind") not in ("campaign-obs-done",)]
+        for ev in interesting[-20:]:
+            what = ev.get("kind", "?").replace("campaign-", "")
+            detail = ""
+            for key in ("observations", "wave", "observation",
+                        "factor", "done", "failed", "replica",
+                        "outstanding"):
+                if ev.get(key) is not None:
+                    detail += "  %s=%s" % (key, ev[key])
+            w("    %s %-12s%s"
+              % (time.strftime("%H:%M:%S",
+                               time.localtime(ev.get("ts", 0))),
+                 what, detail))
+
+
+# ----------------------------------------------------------------------
 # rendering
 # ----------------------------------------------------------------------
 
@@ -687,6 +842,13 @@ def build_parser():
                    metavar="PATH",
                    help="Fleet mode: write the merged cross-process "
                         "Perfetto trace here")
+    p.add_argument("-campaign", type=str, default=None,
+                   metavar="ID",
+                   help="With -fleet: CAMPAIGN mode — render the "
+                        "campaign's ledger state, wave progress, "
+                        "live ETA/cost projection with its "
+                        "convergence history, and the decision "
+                        "event timeline")
     p.add_argument("-json", action="store_true",
                    help="Emit the collected report as JSON")
     p.add_argument("-spans", type=int, default=15,
@@ -696,6 +858,21 @@ def build_parser():
 
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
+    if args.campaign:
+        if not args.fleet or not os.path.isdir(args.fleet):
+            print("presto-report: -campaign needs -fleet DIR",
+                  file=sys.stderr)
+            return 1
+        cinfo = collect_campaign(args.fleet, args.campaign)
+        if cinfo is None:
+            print("presto-report: no campaign %r under %s"
+                  % (args.campaign, args.fleet), file=sys.stderr)
+            return 1
+        if args.json:
+            print(json.dumps(cinfo, indent=1, sort_keys=True))
+        else:
+            render_campaign(cinfo)
+        return 0
     if args.fleet:
         if not os.path.isdir(args.fleet):
             print("presto-report: no such fleet directory: %s"

@@ -63,6 +63,17 @@ def build_parser():
     p.add_argument("-replica-arg", action="append", default=[],
                    help="Extra presto-serve argv token appended to "
                         "every spawn (repeatable)")
+    p.add_argument("-preempt-fraction", type=float, default=0.0,
+                   help="Spot capacity as steady state: every "
+                        "-preempt-interval seconds, SIGKILL-and-"
+                        "replace this fraction of the replicas "
+                        "holding campaign-tenant leases (at least "
+                        "one while any does); 0 disables")
+    p.add_argument("-preempt-interval", type=float, default=10.0,
+                   help="Seconds between preempt-fraction rounds")
+    p.add_argument("-preempt-tenant", type=str, default="campaign",
+                   help="The backfill tenant whose lease-holders "
+                        "are preemptable")
     p.add_argument("-teardown", action="store_true",
                    help="Drain the whole supervised fleet on exit "
                         "(default: leave replicas running for the "
@@ -88,7 +99,10 @@ def main(argv=None) -> int:
         heartbeat_timeout=args.hb_timeout,
         workdir=args.workdir,
         replica_prefix=args.replica_prefix,
-        replica_args=list(args.replica_arg))
+        replica_args=list(args.replica_arg),
+        preempt_fraction=args.preempt_fraction,
+        preempt_interval_s=args.preempt_interval,
+        preempt_tenant=args.preempt_tenant)
     sup = FleetSupervisor(cfg).start()
     print("presto-supervise: fleet %s <- %s/scale "
           "(replicas %d..%d, up after %d, down after %d, "

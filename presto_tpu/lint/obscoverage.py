@@ -1,4 +1,4 @@
-"""obs-coverage: the instrumentation-coverage contract (16 checks).
+"""obs-coverage: the instrumentation-coverage contract (17 checks).
 
 Formerly ``tools/obs_lint.py`` (a thin shim remains there for the
 historical entry point); now the fifth presto-lint family.  The
@@ -82,7 +82,15 @@ code path cannot ship silently:
      their parent catalogs) — the control loop that actuates /scale
      must leave a reconstructable trail (every spawn/drain/hold with
      its inputs), so its telemetry vocabulary is pinned the moment it
-     ships.
+     ships;
+  17. the campaign engine (serve/campaign.py + serve/router.py +
+     serve/supervisor.py): CAMPAIGN_EVENTS / CAMPAIGN_SPANS /
+     CAMPAIGN_METRICS pinned BOTH directions (and as subsets of their
+     parent catalogs) — archive-scale reprocessing is driven entirely
+     from a durable ledger, so every admission wave, yield decision,
+     and paced preemption must land on telemetry a post-mortem can
+     replay; a campaign code path without its vocabulary (or a stale
+     vocabulary entry) fails here.
 
 Run via tools/presto_lint.py (exit-1 CLI over every family), the
 legacy tools/obs_lint.py shim, or tests/test_obs_lint.py.
@@ -212,7 +220,8 @@ def lint(root: Optional[str] = None) -> List[str]:
     serve_srcs = _tree_sources(root, "presto_tpu/serve")
     serve_ok = (taxonomy.SERVE_EVENTS | taxonomy.FLEET_EVENTS
                 | taxonomy.DAG_EVENTS | taxonomy.SLO_EVENTS
-                | taxonomy.SUPERVISOR_EVENTS)
+                | taxonomy.SUPERVISOR_EVENTS
+                | taxonomy.CAMPAIGN_EVENTS)
     emitted: Set[str] = set()
     for rel, src in sorted(serve_srcs.items()):
         kinds = set(EMIT_RE.findall(src))
@@ -221,8 +230,8 @@ def lint(root: Optional[str] = None) -> List[str]:
             problems.append(
                 "%s: event kind %r is not registered in "
                 "obs/taxonomy.SERVE_EVENTS, FLEET_EVENTS, "
-                "DAG_EVENTS, SLO_EVENTS, or SUPERVISOR_EVENTS"
-                % (rel, k))
+                "DAG_EVENTS, SLO_EVENTS, SUPERVISOR_EVENTS, or "
+                "CAMPAIGN_EVENTS" % (rel, k))
 
     # 4. every job lifecycle state announces itself (scoped to the
     # JobStatus class body: queue.py also defines the Lanes constants,
@@ -744,6 +753,70 @@ def lint(root: Optional[str] = None) -> List[str]:
         problems.append(
             "supervisor layer: metric %r is not registered in "
             "obs/taxonomy.SUPERVISOR_METRICS" % name)
+
+    # 17. the campaign engine (serve/campaign.py + serve/router.py +
+    # serve/supervisor.py): CAMPAIGN_EVENTS / CAMPAIGN_SPANS /
+    # CAMPAIGN_METRICS pinned BOTH directions (and as subsets of
+    # their parent catalogs) — a whole archive campaign (every wave,
+    # settle, yield change, and preemption) must be reconstructable
+    # from campaign_events.jsonl + spans + metrics alone, so the
+    # vocabulary may neither go dark nor go stale.  The supervisor's
+    # preempt pacer deliberately speaks campaign-prefixed telemetry
+    # (it actuates the campaign's preemption mode), hence the
+    # cross-file gather.
+    camp_files = ("presto_tpu/serve/campaign.py",
+                  "presto_tpu/serve/router.py",
+                  "presto_tpu/serve/supervisor.py")
+    ca_events: Set[str] = set()
+    ca_spans: Set[str] = set()
+    ca_metrics: Set[str] = set()
+    for rel in camp_files:
+        try:
+            src = _read(rel, root)
+        except OSError:
+            continue
+        ca_events |= set(EMIT_RE.findall(src))
+        ca_events |= set(CLUSTER_EVENT_RE.findall(src))
+        ca_spans |= set(SPAN_RE.findall(src))
+        ca_metrics |= set(METRIC_RE.findall(src))
+    for s in sorted(taxonomy.CAMPAIGN_SPANS - taxonomy.SERVE_SPANS):
+        problems.append(
+            "obs/taxonomy.py: CAMPAIGN_SPANS lists %r which is not "
+            "in SERVE_SPANS" % s)
+    for s in sorted(taxonomy.CAMPAIGN_SPANS - ca_spans):
+        problems.append(
+            "obs/taxonomy.py: CAMPAIGN_SPANS lists %r but the "
+            "campaign layer never opens it" % s)
+    for s in sorted({x for x in ca_spans
+                     if x.startswith("campaign:")}
+                    - taxonomy.CAMPAIGN_SPANS):
+        problems.append(
+            "campaign layer: span %r is not registered in "
+            "obs/taxonomy.CAMPAIGN_SPANS" % s)
+    for k in sorted(taxonomy.CAMPAIGN_EVENTS - ca_events):
+        problems.append(
+            "obs/taxonomy.py: CAMPAIGN_EVENTS lists %r but the "
+            "campaign layer never emits it" % k)
+    for k in sorted({x for x in ca_events
+                     if x.startswith("campaign-")}
+                    - taxonomy.CAMPAIGN_EVENTS):
+        problems.append(
+            "campaign layer: event kind %r is not registered in "
+            "obs/taxonomy.CAMPAIGN_EVENTS" % k)
+    for name in sorted(taxonomy.CAMPAIGN_METRICS - taxonomy.METRICS):
+        problems.append(
+            "obs/taxonomy.py: CAMPAIGN_METRICS lists %r which is "
+            "not in METRICS" % name)
+    for name in sorted(taxonomy.CAMPAIGN_METRICS - ca_metrics):
+        problems.append(
+            "obs/taxonomy.py: CAMPAIGN_METRICS lists %r but the "
+            "campaign layer never registers it" % name)
+    for name in sorted({x for x in ca_metrics
+                        if x.startswith("campaign_")}
+                       - taxonomy.CAMPAIGN_METRICS):
+        problems.append(
+            "campaign layer: metric %r is not registered in "
+            "obs/taxonomy.CAMPAIGN_METRICS" % name)
     return problems
 
 

@@ -344,6 +344,100 @@ def sparkline(values: Sequence[float]) -> str:
 
 
 # ----------------------------------------------------------------------
+# the backfill lane (campaign traffic yields to interactive burn)
+# ----------------------------------------------------------------------
+
+BACKFILL_NAME = "backfill.json"
+
+BACKFILL_VERSION = 1
+
+#: default lowest fraction of its configured WRR weight a backfill
+#: tenant keeps while an interactive tenant is burning hard — the
+#: campaign never fully starves (it would otherwise never finish),
+#: it just slows to a trickle
+BACKFILL_FLOOR = 0.05
+
+
+def backfill_path(fleetdir: str) -> str:
+    return os.path.join(os.path.abspath(fleetdir), BACKFILL_NAME)
+
+
+def save_backfill(fleetdir: str, tenants: Sequence[str],
+                  yield_factor: float = 1.0,
+                  floor: float = BACKFILL_FLOOR) -> str:
+    """Durably declare the backfill tenant set (atomic, versioned —
+    the campaign driver writes this once at start; the live
+    ``yield`` field is then maintained by update_backfill_yield)."""
+    path = backfill_path(fleetdir)
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    atomic_write_text(path, json.dumps(
+        {"version": BACKFILL_VERSION,
+         "tenants": sorted(str(t) for t in tenants),
+         "floor": float(floor),
+         "yield": float(yield_factor)},
+        indent=1, sort_keys=True) + "\n")
+    return path
+
+
+def load_backfill(fleetdir: str) -> Optional[dict]:
+    """The backfill declaration (None when absent/unreadable — no
+    backfill lane, nothing yields)."""
+    try:
+        with open(backfill_path(fleetdir)) as f:
+            doc = json.load(f)
+        if int(doc.get("version", -1)) != BACKFILL_VERSION:
+            return None
+        return doc
+    except (OSError, ValueError, TypeError):
+        return None
+
+
+def backfill_yield_factor(evals: Dict[str, dict],
+                          exclude: Iterable[str] = (),
+                          floor: float = BACKFILL_FLOOR) -> float:
+    """The backfill-yield rule, a pure function over per-tenant SLO
+    evaluations: while every interactive tenant burns its error
+    budget at <= 1x (the sustainable rate), backfill keeps its full
+    configured weight (factor 1.0); past that the factor shrinks as
+    ``1 / worst_burn`` — a gold tenant burning 14x shrinks the
+    campaign lane 14x — floored so the campaign never fully starves.
+    ``exclude`` names the backfill tenants themselves (their own
+    burn must not throttle them)."""
+    excl = set(exclude)
+    worst = 0.0
+    for tenant, ev in (evals or {}).items():
+        if tenant in excl:
+            continue
+        for w in ev.get("windows") or ():
+            if int(w.get("fast_events", 0)) > 0:
+                worst = max(worst, float(w.get("fast_burn", 0.0)))
+    if worst <= 1.0:
+        return 1.0
+    return max(min(floor, 1.0), 1.0 / worst)
+
+
+def update_backfill_yield(fleetdir: str,
+                          evals: Dict[str, dict]) -> Optional[float]:
+    """Recompute the live yield factor from interactive burn and
+    persist it (atomically) when it moved: the job ledger's lease
+    policy stat-caches `backfill.json`, so the write IS the
+    actuation.  Returns the factor, or None when no backfill lane is
+    declared.  Callers (the router's SLO pass, the campaign driver's
+    pulse) emit their own events on change."""
+    doc = load_backfill(fleetdir)
+    if doc is None:
+        return None
+    factor = backfill_yield_factor(
+        evals, exclude=doc.get("tenants") or (),
+        floor=float(doc.get("floor", BACKFILL_FLOOR)))
+    if abs(factor - float(doc.get("yield", 1.0))) > 1e-9:
+        save_backfill(fleetdir, doc.get("tenants") or (),
+                      yield_factor=factor,
+                      floor=float(doc.get("floor", BACKFILL_FLOOR)))
+    return factor
+
+
+# ----------------------------------------------------------------------
 # usage rollups (device-seconds accounting)
 # ----------------------------------------------------------------------
 

@@ -236,6 +236,49 @@ SUPERVISOR_METRICS = frozenset({
     "supervisor_holds_total",
 })
 
+#: campaign-engine event kinds — the archive-reprocessing vocabulary
+#: of serve/campaign.py (bounded-wave admission, fence-checked
+#: settling, backfill-yield throttle decisions) plus the
+#: supervisor's paced preemption of campaign-leased replicas
+#: (serve/supervisor.py).  Every decision lands on the durable
+#: per-campaign `campaign_events.jsonl` stream so a whole campaign —
+#: including every preemption and every yield change — replays from
+#: telemetry alone.  Enforced BOTH directions by obs-coverage check
+#: 17 across campaign.py + router.py + supervisor.py.
+CAMPAIGN_EVENTS = frozenset({
+    "campaign-create",
+    "campaign-resume",
+    "campaign-wave-admit",
+    "campaign-obs-done",
+    "campaign-obs-failed",
+    "campaign-yield",
+    "campaign-preempt",
+    "campaign-complete",
+})
+
+#: campaign-engine span names (check 17, both directions, subset of
+#: SERVE_SPANS): creation, the driver pulse, each idempotent DAG
+#: admission, and each supervisor preemption
+CAMPAIGN_SPANS = frozenset({
+    "campaign:create",
+    "campaign:pulse",
+    "campaign:admit",
+    "campaign:preempt",
+})
+
+#: campaign-engine metrics (check 17, both directions, subset of
+#: METRICS): wave/admission/settle counters, the outstanding-DAG
+#: bound, the live backfill-yield factor, and the supervisor's
+#: preemption pacer
+CAMPAIGN_METRICS = frozenset({
+    "campaign_waves_total",
+    "campaign_admitted_total",
+    "campaign_settled_total",
+    "campaign_outstanding",
+    "campaign_yield_factor",
+    "campaign_preemptions_total",
+})
+
 #: streaming-layer event kinds — every `events.emit("<kind>", ...)`
 #: in presto_tpu/stream/ (enforced both directions by obs_lint check
 #: 7: the live trigger path may not emit unregistered kinds, and the
@@ -271,6 +314,10 @@ SERVE_SPANS = frozenset({
     "supervisor:spawn",
     "supervisor:drain",
     "supervisor:replace",
+    "campaign:create",
+    "campaign:pulse",
+    "campaign:admit",
+    "campaign:preempt",
 })
 
 #: discovery-DAG event kinds — the dependency-aware job-graph
@@ -519,6 +566,15 @@ METRICS = frozenset({
     "supervisor_drains_total",
     "supervisor_replacements_total",
     "supervisor_holds_total",
+    # campaign engine (serve/campaign.py driver + the supervisor's
+    # preempt-fraction pacer); pinned both directions by obs-coverage
+    # check 17 via CAMPAIGN_METRICS
+    "campaign_waves_total",
+    "campaign_admitted_total",
+    "campaign_settled_total",
+    "campaign_outstanding",
+    "campaign_yield_factor",
+    "campaign_preemptions_total",
     # streaming search (presto_tpu/stream); every stream_* name here
     # must be registered by the stream layer (obs_lint check 7)
     "stream_blocks_total",

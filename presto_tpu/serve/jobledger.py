@@ -167,10 +167,38 @@ class JobLedger(LeaseLedger):
         self._class_cache = (key, weights)
         return weights
 
+    def _backfill_factors(self) -> Dict[str, float]:
+        """Per-tenant lease-weight yield factors for the backfill
+        lane, from `<fleet>/backfill.json` (cached by file stat, like
+        `_class_weights`): tenants the campaign driver declared as
+        backfill have their WRR weight multiplied by the live yield
+        factor the SLO pass maintains — when an interactive tenant
+        burns its error budget, backfill leases thin out in
+        proportion, without touching the configured weights."""
+        from presto_tpu.obs import slo
+        try:
+            st = os.stat(slo.backfill_path(self.workdir))
+            key = (st.st_mtime_ns, st.st_size)
+        except OSError:
+            key = None
+        cached = getattr(self, "_backfill_cache", None)
+        if cached is not None and cached[0] == key:
+            return cached[1]
+        factors: Dict[str, float] = {}
+        if key is not None:
+            doc = slo.load_backfill(self.workdir)
+            if doc is not None:
+                y = min(max(float(doc.get("yield", 1.0)), 1e-9), 1.0)
+                for t in doc.get("tenants") or ():
+                    factors[str(t)] = y
+        self._backfill_cache = (key, factors)
+        return factors
+
     def _tenant_cfg(self, state: dict, tenant: str) -> dict:
         cfg = state.get("tenants", {}).get(tenant) or {}
         weight = max(float(cfg.get("weight", 1.0)), 1e-9)
         weight *= self._class_weights().get(tenant, 1.0)
+        weight *= self._backfill_factors().get(tenant, 1.0)
         return {"weight": weight,
                 "quota": cfg.get("quota"),
                 "ds_quota": cfg.get("ds_quota")}
@@ -509,6 +537,26 @@ class JobLedger(LeaseLedger):
                         changed = True
                         break
         for jid in failed:
+            row = items[jid]
+            if self.usage.enabled:
+                # a cascade-failed node never executed, but it is
+                # terminal: meter a zero-execute row so accounting
+                # conserves (admitted == done + failed exactly) and
+                # campaign ETA math cannot diverge on a failing
+                # observation.  Re-appending after a crash before the
+                # ledger save is harmless — rows() dedups by job_id.
+                self.usage.append({
+                    "job_id": jid,
+                    "tenant": str(row.get("tenant")
+                                  or DEFAULT_TENANT),
+                    "bucket": row.get("bucket"),
+                    "dag": row.get("dag"),
+                    "state": FAILED,
+                    "ts": now,
+                    "phases": {},
+                    "cascade": True,
+                })
+        for jid in failed:
             self._event("dag-cascade-fail", item=jid,
                         error=items[jid]["error"])
         reg = self._registry()
@@ -791,6 +839,25 @@ class JobLedger(LeaseLedger):
         shedding signal, mirroring the in-process queue's bound."""
         counts = self.counts()
         return counts.get(PENDING, 0) + counts.get(LEASED, 0)
+
+    def lease_owners(self, tenant: Optional[str] = None) \
+            -> Dict[str, int]:
+        """Replica -> count of currently leased jobs (optionally one
+        tenant's only) — the supervisor's preempt-target census: a
+        ``preempt_fraction`` supervisor kills replicas holding
+        campaign-tenant leases, and the lease reaper + epoch fence
+        make that lossless."""
+        out: Dict[str, int] = {}
+        for row in self._load()[self.ITEMS_KEY].values():
+            if row["state"] != LEASED:
+                continue
+            if (tenant is not None
+                    and str(row.get("tenant")) != str(tenant)):
+                continue
+            owner = row.get("owner")
+            if owner:
+                out[str(owner)] = out.get(str(owner), 0) + 1
+        return out
 
     def tenant_counts(self) -> Dict[str, Dict[str, int]]:
         out: Dict[str, Dict[str, int]] = {}

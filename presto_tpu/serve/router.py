@@ -49,6 +49,22 @@ Wire protocol (stdlib HTTP + JSON, like server.py):
                           admitted as ONE durable transaction
                           (serve/dag.py); same 429/503 semantics
   GET  /dag/<id>          aggregate DAG view (per-node states)
+  POST /campaign          {"id": "...", "manifest": [<POST /dag
+                           specs>], "wave_size": int, "tenant": ...,
+                           "weight": float, "priority": int}
+                          -> 202 campaign status.  Creation is
+                          idempotent (re-POSTing an existing id
+                          resumes it); the first wave is admitted
+                          inline and the router's poll loop keeps
+                          pulsing every campaign it has touched —
+                          safely alongside an external
+                          presto-campaign driver (serve/campaign.py
+                          serializes pulses per campaign).  No shed
+                          or ready-replica gate: a campaign IS the
+                          backlog, bounded to wave_size outstanding
+                          DAGs by its own ledger.
+  GET  /campaign          campaign ids with state + counts
+  GET  /campaign/<id>     full status + live ETA/cost projection
   GET  /jobs/<id>         ledger job view (404 unknown)
   GET  /jobs/<id>/result  committed result.json (409 until done)
   GET  /fleet             topology + readiness + tenant counts
@@ -205,6 +221,15 @@ class FleetRouter:
             target_drain_s=cfg.scale_target_drain_s,
             min_replicas=cfg.scale_min_replicas,
             max_replicas=cfg.scale_max_replicas)
+        # campaign drivers this router has touched (POST /campaign
+        # or a status read): the poll loop pulses the running ones so
+        # a campaign created through the front door advances without
+        # a dedicated presto-campaign process.  In-memory only — a
+        # restarted router re-adopts a campaign on the next POST or
+        # status read (idempotent), and an external driver can run
+        # concurrently (the per-campaign lockdir serializes pulses).
+        self._campaigns: Dict[str, object] = {}
+        self._campaigns_lock = threading.Lock()  # presto-lint: guards(_campaigns)
         self._slo_lock = threading.Lock()  # presto-lint: guards(_slo_view, _alerting, _last_wanted)
         self._slo_view: Optional[dict] = None
         self._alerting: set = set()     # (tenant, window) pairs live
@@ -262,6 +287,11 @@ class FleetRouter:
         self._stop.set()
         if self._poll_t is not None:
             self._poll_t.join(timeout=10.0)
+        with self._campaigns_lock:
+            drivers = list(self._campaigns.values())
+            self._campaigns.clear()
+        for drv in drivers:
+            drv.close()
         self.events.close()
         self.obs.tracer.close()
 
@@ -309,6 +339,7 @@ class FleetRouter:
             self.evaluate_slo()
         except Exception:
             self.obs.event("router-poll-error")
+        self._pulse_campaigns()
         return out
 
     def ready_replicas(self) -> List[str]:
@@ -509,6 +540,104 @@ class FleetRouter:
     def dag_status(self, dag_id: str) -> Optional[dict]:
         return self.ledger.dag_view(dag_id)
 
+    # ---- campaign engine ----------------------------------------------
+
+    def _campaign_driver(self, campaign_id: str,
+                         cfg_kw: Optional[dict] = None):
+        """The cached per-campaign driver (created on first touch).
+        Sharing the router's obs handle and job ledger means
+        campaign telemetry rides the router's /metrics and span
+        stream; sharing the ledger's stat-cache keeps status reads
+        cheap."""
+        from presto_tpu.serve.campaign import (CampaignConfig,
+                                               CampaignDriver,
+                                               _safe_id)
+        cid = _safe_id(str(campaign_id))
+        with self._campaigns_lock:
+            drv = self._campaigns.get(cid)
+            if drv is None:
+                ccfg = CampaignConfig(fleetdir=self.cfg.fleetdir,
+                                      campaign_id=cid,
+                                      **dict(cfg_kw or {}))
+                drv = CampaignDriver(ccfg, obs=self.obs,
+                                     ledger=self.ledger)
+                self._campaigns[cid] = drv
+            return drv
+
+    def submit_campaign(self, spec: dict) -> dict:
+        """Durably create (or idempotently resume) a campaign from
+        `{"id", "manifest", ...}` and run its first pulse — the
+        manifest lands in `<fleet>/campaigns/<id>/campaign.json` and
+        the first wave of discovery DAGs is admitted before the 202
+        returns.  No shed/ready gate on purpose: the campaign ledger
+        bounds outstanding work to wave_size DAGs, so an archive of
+        any size never floods jobs.json the way a /submit firehose
+        could."""
+        if not isinstance(spec, dict):
+            raise ValueError("spec must be a JSON object")
+        manifest = spec.get("manifest")
+        if not isinstance(manifest, list) or not manifest:
+            raise ValueError(
+                "manifest must be a non-empty list of observation "
+                "specs (each the POST /dag wire schema)")
+        kw = {}
+        for key, cast in (("wave_size", int), ("tenant", str),
+                          ("weight", float), ("priority", int),
+                          ("yield_floor", float)):
+            if spec.get(key) is not None:
+                kw[key] = cast(spec[key])
+        drv = self._campaign_driver(spec.get("id") or "campaign", kw)
+        drv.create(manifest)
+        return drv.pulse()
+
+    def campaign_view(self, campaign_id: str) -> Optional[dict]:
+        """`GET /campaign/<id>`: status + live ETA/cost projection
+        (None for an unknown id — checked BEFORE a driver is built,
+        so probing never creates an empty campaign directory).
+        Reading a campaign adopts it into the poll loop's pulse set:
+        a restarted router resumes driving a campaign the moment
+        anyone asks about it."""
+        from presto_tpu.serve.campaign import load_campaign
+        if load_campaign(self.cfg.fleetdir, campaign_id) is None:
+            return None
+        return self._campaign_driver(campaign_id).status()
+
+    def campaigns_view(self) -> dict:
+        """`GET /campaign`: every campaign under the fleet with its
+        state and per-state observation counts (ledger reads only —
+        no drivers are built or adopted)."""
+        from presto_tpu.serve.campaign import (CampaignDriver,
+                                               list_campaigns,
+                                               load_campaign)
+        out = {}
+        for cid in list_campaigns(self.cfg.fleetdir):
+            doc = load_campaign(self.cfg.fleetdir, cid)
+            if doc is None:
+                continue
+            out[cid] = {"state": doc.get("state"),
+                        "observations": len(doc["observations"]),
+                        "waves": int(doc.get("waves", 0)),
+                        "counts": CampaignDriver._counts(doc)}
+        return {"campaigns": out}
+
+    def _pulse_campaigns(self) -> None:
+        """One poll-loop pass over the adopted campaigns: pulse every
+        one still running (settle landed DAGs, admit the next wave,
+        refresh the backfill yield).  Terminal campaigns stay in the
+        cache for cheap status reads but are not pulsed."""
+        from presto_tpu.serve.campaign import load_campaign
+        with self._campaigns_lock:
+            drivers = list(self._campaigns.values())
+        for drv in drivers:
+            try:
+                doc = load_campaign(self.cfg.fleetdir,
+                                    drv.cfg.campaign_id)
+                if doc is None or doc.get("state") != "running":
+                    continue
+                drv.pulse()
+            except Exception:
+                self.obs.event("router-poll-error")
+
     # ---- introspection ------------------------------------------------
 
     def status(self, job_id: str) -> Optional[dict]:
@@ -643,6 +772,15 @@ class FleetRouter:
             rows = self.ledger.usage.rows()
             evals = {spec.tenant: slo.evaluate(spec, rows, now)
                      for spec in self._slo_specs}
+            # backfill actuation: while any interactive tenant burns
+            # error budget, shrink the campaign lane's live weight —
+            # update_backfill_yield excludes the declared backfill
+            # tenants from the burn census, writes <fleet>/
+            # backfill.json atomically, and the lease policy's
+            # stat-cache picks it up on the next lease (None when no
+            # backfill lane is declared)
+            backfill_yield = slo.update_backfill_yield(
+                self.cfg.fleetdir, evals)
             alerts = []
             for tenant, ev in sorted(evals.items()):
                 self._g_budget.labels(tenant=tenant).set(
@@ -676,6 +814,7 @@ class FleetRouter:
                 "tenants": evals,
                 "usage": slo.usage_rollup(rows),
                 "scale": advice,
+                "backfill_yield": backfill_yield,
             }
             self._slo_view = view
         for tenant, window, w in rising:
@@ -784,6 +923,14 @@ class _RouterHandler(BaseHTTPRequestHandler):
                 n = int(parse_qs(url.query).get("n", ["100"])[0])
                 self._json(200,
                            {"events": self.router.events.tail(n)})
+            elif url.path == "/campaign":
+                self._json(200, self.router.campaigns_view())
+            elif len(parts) == 2 and parts[0] == "campaign":
+                view = self.router.campaign_view(parts[1])
+                if view is None:
+                    self._json(404, {"error": "no such campaign"})
+                else:
+                    self._json(200, view)
             elif len(parts) == 2 and parts[0] == "dag":
                 view = self.router.dag_status(parts[1])
                 if view is None:
@@ -814,13 +961,15 @@ class _RouterHandler(BaseHTTPRequestHandler):
 
     def do_POST(self) -> None:
         path = urlparse(self.path).path
-        if path not in ("/submit", "/dag"):
+        if path not in ("/submit", "/dag", "/campaign"):
             self._json(404, {"error": "unknown endpoint"})
             return
         try:
             length = int(self.headers.get("Content-Length", "0"))
             spec = json.loads(self.rfile.read(length) or b"{}")
-            if path == "/dag":
+            if path == "/campaign":
+                self._json(202, self.router.submit_campaign(spec))
+            elif path == "/dag":
                 self._json(202, self.router.submit_dag(spec))
             else:
                 self._json(202, self.router.submit(spec))
@@ -938,8 +1087,8 @@ def main(argv=None) -> int:
     httpd = start_http(router, args.host, args.port)
     host, port = httpd.server_address[:2]
     print("presto-router: fleet %s on http://%s:%d "
-          "(POST /submit, GET /jobs/<id>, /fleet, /metrics, "
-          "/slo, /usage, /scale)"
+          "(POST /submit, /dag, /campaign; GET /jobs/<id>, /fleet, "
+          "/metrics, /slo, /usage, /scale, /campaign/<id>)"
           % (args.fleetdir, host, port))
     try:
         while True:

@@ -106,6 +106,17 @@ class SupervisorConfig:
     hb_timeout: float = 5.0
     #: extra presto-serve argv appended verbatim to every spawn
     replica_args: List[str] = field(default_factory=list)
+    #: spot capacity as steady state: every `preempt_interval_s`, kill
+    #: and replace this fraction of the replicas currently holding
+    #: campaign-tenant leases (at least one while any holds one).
+    #: 0.0 disables.  Deliberate SIGKILL — the lease reaper and epoch
+    #: fence make the loss a latency cost, never a correctness one,
+    #: and running it continuously keeps that path exercised rather
+    #: than special
+    preempt_fraction: float = 0.0
+    preempt_interval_s: float = 10.0
+    #: the backfill tenant whose lease-holders are preemptable
+    preempt_tenant: str = "campaign"
 
 
 def registry_path(fleetdir: str) -> str:
@@ -156,7 +167,7 @@ class FleetSupervisor:
         self._procs: Dict[str, subprocess.Popen] = {}
         self._stop = threading.Event()
         self._loop_t: Optional[threading.Thread] = None
-        self._lock = threading.Lock()  # presto-lint: guards(_reg, _procs, _up_streak, _down_streak, _last_actuation)
+        self._lock = threading.Lock()  # presto-lint: guards(_reg, _procs, _up_streak, _down_streak, _last_actuation, _last_preempt)
         self._up_streak = 0
         self._down_streak = 0
         self._last_actuation = None  # no cooldown before 1st action
@@ -181,6 +192,12 @@ class FleetSupervisor:
             "supervisor_holds_total",
             "Actuations withheld by hysteresis or cooldown while the "
             "advisory disagreed with the current fleet size")
+        self._c_preemptions = reg.counter(
+            "campaign_preemptions_total",
+            "Campaign-leased replicas deliberately killed and "
+            "replaced by the supervisor's preempt-fraction pacing "
+            "(spot capacity as steady state)")
+        self._last_preempt: Optional[float] = None
 
     # ---- process-table seams (overridden by the fake-table tests) ----
 
@@ -498,12 +515,66 @@ class FleetSupervisor:
         now = time.time() if now is None else now
         with self._lock:
             self._reconcile(now)
+            self._preempt(now)
             advice = self._fetch_advice()
             current = self._count_serving()
             decision = self._decide(now, advice, current)
             self._g_replicas.set(self._count_serving())
         self.last_decision = decision
         return decision
+
+    # presto-lint: holds(_lock)
+    def _preempt(self, now: float) -> List[str]:
+        """The preempt-fraction pacer: every `preempt_interval_s`,
+        SIGKILL-and-replace a paced number of UP replicas currently
+        holding campaign-tenant leases — spot capacity as a normal
+        operating mode, not a chaos-test special case.  Deliberately
+        the rudest path (no drain): the leases are reaped, the epoch
+        fence rejects the dead replica's late commits, and the
+        replacement rides the ordinary spawn path — exactly the
+        machinery FLEET_CHAOS.json proves lossless.  Interactive
+        tenants are untouched: only holders of `preempt_tenant`
+        leases qualify."""
+        cfg = self.cfg
+        if cfg.preempt_fraction <= 0.0:
+            return []
+        if (self._last_preempt is not None
+                and now - self._last_preempt < cfg.preempt_interval_s):
+            return []
+        try:
+            owners = self.ledger.lease_owners(cfg.preempt_tenant)
+        except Exception:
+            return []
+        holders = sorted(
+            (n for n, r in self._reg["replicas"].items()
+             if r["state"] == UP and owners.get(n)),
+            key=lambda n: -owners[n])
+        if not holders:
+            return []
+        n_kill = min(len(holders),
+                     max(1, int(round(cfg.preempt_fraction
+                                      * len(holders)))))
+        preempted: List[str] = []
+        self._last_preempt = now
+        for name in holders[:n_kill]:
+            row = self._reg["replicas"][name]
+            with self.obs.span("campaign:preempt",
+                               replica=name) as span:
+                span.set_attr("leases", owners.get(name, 0))
+                self._signal(name, row.get("pid"), signal.SIGKILL)
+                self._reap(name)
+                del self._reg["replicas"][name]
+                new = self._spawn_one(
+                    now, "preempt %s (campaign lane)" % name, None)
+                span.set_attr("replacement", new)
+            self._c_preemptions.inc()
+            self.events.emit("campaign-preempt", replica=name,
+                             replacement=new,
+                             leases=owners.get(name, 0),
+                             tenant=cfg.preempt_tenant)
+            self.obs.event("campaign-preempt", replica=name)
+            preempted.append(name)
+        return preempted
 
     # presto-lint: holds(_lock)
     def _decide(self, now: float, advice: Optional[dict],

@@ -46,6 +46,7 @@ import json
 import os
 from typing import Dict, List, Optional
 
+from presto_tpu.io.atomic import atomic_write_bytes
 from presto_tpu.pipeline.leaseledger import _LockDir
 
 USAGE_NAME = "usage.jsonl"
@@ -65,6 +66,15 @@ class UsageLedger:
             enabled = os.environ.get("PRESTO_TPU_USAGE", "1") != "0"
         self.enabled = bool(enabled)
         self._lock = _LockDir(self.path + ".lock", timeout=10.0)
+        # offset-checkpointed read state: (inode, byte offset) of the
+        # consumed complete-line prefix plus its parsed rows, so a
+        # campaign-scale ledger is parsed O(new rows) per read, not
+        # O(ledger).  A compaction (os.replace -> new inode) or a
+        # truncation beneath the checkpoint resets to a full reread.
+        self._ckpt: Optional[tuple] = None
+        self._raw: List[dict] = []
+        self._dedup_byid: Dict[str, int] = {}
+        self._dedup_rows: List[dict] = []
 
     # -- writing --------------------------------------------------------
 
@@ -111,17 +121,49 @@ class UsageLedger:
                     os.close(fd)
         return self.path
 
+    # -- compaction -----------------------------------------------------
+
+    def compact(self) -> int:
+        """Rewrite the ledger as its deduplicated row set (one line
+        per surviving job_id, last row wins) via an atomic same-dir
+        replace under the writer lock.  Superseded redo rows — the
+        only rows dedup ever drops — are garbage a campaign-scale
+        ledger accretes under churn; dropping them changes no reader's
+        view (`rows()` is byte-for-byte the same before and after).
+        Returns the number of rows dropped.  A torn final line is
+        repaired first, exactly as a writer would, so torn-tail
+        semantics are unchanged."""
+        try:
+            st = os.stat(self.path)
+        except OSError:
+            return 0
+        if st.st_size == 0:
+            return 0
+        with self._lock():
+            fd = os.open(self.path, os.O_RDWR, 0o644)
+            try:
+                self._repair(fd)
+                os.lseek(fd, 0, os.SEEK_SET)
+                data = os.read(fd, os.fstat(fd).st_size)
+            finally:
+                with contextlib.suppress(OSError):
+                    os.close(fd)
+            raw = self._parse(data)
+            kept = self._dedup(raw)
+            if len(kept) == len(raw):
+                return 0
+            out = b"".join(
+                json.dumps(rec, sort_keys=True).encode() + b"\n"
+                for rec in kept)
+            atomic_write_bytes(self.path, out)
+        self._reset_cache()
+        return len(raw) - len(kept)
+
     # -- reading --------------------------------------------------------
 
-    def raw_rows(self) -> List[dict]:
-        """Every complete parseable row, in append order (torn or
-        corrupt lines skipped, never fatal)."""
+    @staticmethod
+    def _parse(data: bytes) -> List[dict]:
         out: List[dict] = []
-        try:
-            with open(self.path, "rb") as f:
-                data = f.read()
-        except OSError:
-            return out
         for line in data.split(b"\n"):
             if not line.strip():
                 continue
@@ -133,13 +175,11 @@ class UsageLedger:
                 out.append(rec)
         return out
 
-    def rows(self) -> List[dict]:
-        """raw_rows deduplicated by job_id (last row wins — a redo
-        after a crash-between-commit-and-append supersedes), append
-        order preserved."""
+    @staticmethod
+    def _dedup(raw: List[dict]) -> List[dict]:
         byid: Dict[str, int] = {}
         out: List[dict] = []
-        for rec in self.raw_rows():
+        for rec in raw:
             jid = rec.get("job_id")
             if jid is None:
                 out.append(rec)
@@ -150,3 +190,72 @@ class UsageLedger:
                 byid[jid] = len(out)
                 out.append(rec)
         return out
+
+    def _reset_cache(self) -> None:
+        self._ckpt = None
+        self._raw = []
+        self._dedup_byid = {}
+        self._dedup_rows = []
+
+    def _absorb(self, fresh: List[dict]) -> None:
+        """Fold newly-read rows into both caches (raw append order and
+        the job_id-deduplicated view) — O(new rows)."""
+        self._raw.extend(fresh)
+        for rec in fresh:
+            jid = rec.get("job_id")
+            if jid is None:
+                self._dedup_rows.append(rec)
+                continue
+            at = self._dedup_byid.get(jid)
+            if at is None:
+                self._dedup_byid[jid] = len(self._dedup_rows)
+                self._dedup_rows.append(rec)
+            else:
+                self._dedup_rows[at] = rec
+
+    def _refresh(self) -> None:
+        """Advance the checkpoint over any bytes appended since the
+        last read.  Only complete newline-terminated lines are ever
+        consumed, so a torn tail is left for the next pass (and a
+        writer's `_repair` truncation never reaches beneath the
+        checkpoint — it cuts exactly at the last complete line)."""
+        try:
+            st = os.stat(self.path)
+        except OSError:
+            self._reset_cache()
+            return
+        ino, off = self._ckpt if self._ckpt else (None, 0)
+        if ino != st.st_ino or st.st_size < off:
+            # replaced (compacted) or rewritten: reread from byte 0
+            self._reset_cache()
+            off = 0
+        if st.st_size == off:
+            self._ckpt = (st.st_ino, off)
+            return
+        try:
+            with open(self.path, "rb") as f:
+                f.seek(off)
+                data = f.read()
+        except OSError:
+            self._reset_cache()
+            return
+        nl = data.rfind(b"\n")
+        if nl < 0:
+            self._ckpt = (st.st_ino, off)
+            return
+        self._absorb(self._parse(data[:nl + 1]))
+        self._ckpt = (st.st_ino, off + nl + 1)
+
+    def raw_rows(self) -> List[dict]:
+        """Every complete parseable row, in append order (torn or
+        corrupt lines skipped, never fatal).  Incremental: repeat
+        calls parse only bytes appended since the previous call."""
+        self._refresh()
+        return list(self._raw)
+
+    def rows(self) -> List[dict]:
+        """raw_rows deduplicated by job_id (last row wins — a redo
+        after a crash-between-commit-and-append supersedes), append
+        order preserved.  Incremental like raw_rows."""
+        self._refresh()
+        return list(self._dedup_rows)

@@ -1,0 +1,672 @@
+"""Campaign engine (ISSUE 17): durable campaign ledger above the job
+ledger — bounded-wave admission, the admit-mark-then-admit_dag crash
+protocol (SimulatedCrash at wave-admit / mid-wave / pre-count-commit,
+restart resumes with nothing lost and nothing admitted twice),
+fence-checked completion counting, exactly-once usage accounting
+(admitted == done + failed conserves, cascade-failed nodes meter
+zero-execute rows), conservation re-pinned on a compacted usage
+ledger, backfill-yield actuation through backfill.json, the live
+ETA/cost projection, the router's /campaign surface, and the
+presto-campaign CLI exit contract."""
+
+import json
+import os
+import time
+
+import pytest
+
+from presto_tpu.obs import slo
+from presto_tpu.serve.campaign import (CampaignConfig, CampaignDriver,
+                                       SimulatedCrash, campaign_dir,
+                                       events_path, ledger_path,
+                                       list_campaigns, load_campaign)
+from presto_tpu.serve.jobledger import JobLedger, JobLedgerError
+
+#: per-node fake execute cost metered by the stub replica
+EXEC_S = 0.25
+
+#: the three nodes plan_dag statically admits per observation
+#: (search -> sift -> toa; the stub replica never expands folds)
+NODES_PER_OBS = 3
+
+
+def _spec(i):
+    """One observation spec (the POST /dag wire schema) — rawfiles
+    need not exist: the stub replica completes without executing."""
+    return {"rawfiles": ["/nonexistent/beam%03d.fil" % i],
+            "config": {"lodm": 50.0, "hidm": 56.0, "nsub": 8}}
+
+
+def _manifest(n):
+    return [dict(_spec(i), id="obs-%03d" % i) for i in range(n)]
+
+
+def _driver(fleetdir, cid="camp", **kw):
+    return CampaignDriver(CampaignConfig(
+        fleetdir=str(fleetdir), campaign_id=cid, **kw))
+
+
+def _drain_leases(led, host="r1", fail_dags=()):
+    """Stub replica: lease everything currently grantable and commit
+    it through the fence (fail_terminal for dags in fail_dags —
+    injected on their search node so the subtree cascades)."""
+    n = 0
+    while True:
+        lease = led.lease(host, ttl=30.0)
+        if lease is None:
+            return n
+        if any(lease.item_id.startswith(d + "-")
+               for d in fail_dags):
+            led.fail_terminal(lease, host, "injected failure",
+                              usage={"phases": {"execute": 0.0}})
+        else:
+            led.complete(lease, host, {},
+                         usage={"phases": {"execute": EXEC_S,
+                                           "total": EXEC_S}})
+        n += 1
+
+
+def _run_to_done(drv, led, fail_dags=(), max_pulses=200,
+                 wave_watch=None):
+    """Pulse + drain until the campaign is terminal; optionally
+    record the outstanding count after every pulse."""
+    led.join("r1")
+    for _ in range(max_pulses):
+        st = drv.pulse()
+        if wave_watch is not None:
+            wave_watch.append(st["outstanding"])
+        if st["state"] != "running":
+            return st
+        _drain_leases(led, fail_dags=fail_dags)
+    raise AssertionError("campaign did not finish in %d pulses"
+                         % max_pulses)
+
+
+def _events(fleetdir, cid):
+    try:
+        with open(events_path(str(fleetdir), cid)) as f:
+            return [json.loads(ln) for ln in f if ln.strip()]
+    except OSError:
+        return []
+
+
+def _census(fleetdir, cid):
+    out = {}
+    for ev in _events(fleetdir, cid):
+        out[ev["kind"]] = out.get(ev["kind"], 0) + 1
+    return out
+
+
+# ----------------------------------------------------------------------
+# creation + the durable ledger
+# ----------------------------------------------------------------------
+
+def test_create_is_durable_validated_and_idempotent(tmp_path):
+    drv = _driver(tmp_path, wave_size=2)
+    try:
+        doc = drv.create(_manifest(3))
+        assert os.path.exists(ledger_path(str(tmp_path), "camp"))
+        assert doc["state"] == "running"
+        assert len(doc["observations"]) == 3
+        assert all(r["state"] == "pending"
+                   for r in doc["observations"].values())
+        # deterministic dag ids key idempotent re-admission
+        assert doc["observations"]["obs-000"]["dag_id"] \
+            == "camp.obs-000"
+        # re-create returns the existing ledger untouched
+        before = open(ledger_path(str(tmp_path), "camp")).read()
+        doc2 = drv.create(_manifest(3))
+        assert doc2["observations"].keys() == doc["observations"].keys()
+        assert open(ledger_path(str(tmp_path), "camp")).read() \
+            == before
+        # the backfill lane is declared for the lease policy
+        bf = slo.load_backfill(str(tmp_path))
+        assert bf is not None and bf["tenants"] == ["campaign"]
+    finally:
+        drv.close()
+
+
+def test_create_validates_manifest_before_persisting(tmp_path):
+    drv = _driver(tmp_path, cid="bad")
+    try:
+        with pytest.raises(ValueError):
+            drv.create([{"config": {}}])          # no rawfiles
+        assert load_campaign(str(tmp_path), "bad") is None
+    finally:
+        drv.close()
+
+
+def test_duplicate_observation_ids_rejected(tmp_path):
+    drv = _driver(tmp_path, cid="dup")
+    try:
+        with pytest.raises(JobLedgerError, match="duplicate"):
+            drv.create([dict(_spec(0), id="a"),
+                        dict(_spec(1), id="a")])
+    finally:
+        drv.close()
+
+
+def test_resume_requires_a_ledger(tmp_path):
+    drv = _driver(tmp_path, cid="ghost")
+    try:
+        with pytest.raises(JobLedgerError, match="no ledger"):
+            drv.resume()
+    finally:
+        drv.close()
+
+
+# ----------------------------------------------------------------------
+# bounded waves + completion + conservation
+# ----------------------------------------------------------------------
+
+def test_bounded_waves_to_completion_exactly_once(tmp_path,
+                                                  monkeypatch):
+    monkeypatch.setenv("PRESTO_TPU_USAGE", "1")
+    n_obs, wave = 5, 2
+    drv = _driver(tmp_path, wave_size=wave)
+    led = drv.ledger
+    try:
+        drv.create(_manifest(n_obs))
+        watch = []
+        st = _run_to_done(drv, led, wave_watch=watch)
+    finally:
+        drv.close()
+    assert st["state"] == "done"
+    assert st["counts"]["done"] == n_obs
+    assert st["counts"]["failed"] == 0
+    # jobs.json stays bounded: never more than wave_size DAGs out
+    assert max(watch) <= wave
+    assert st["waves"] >= (n_obs + wave - 1) // wave
+    # every DAG node admitted exactly once, all done
+    rows = led.read()["jobs"]
+    assert len(rows) == n_obs * NODES_PER_OBS
+    assert all(r["state"] == "done" for r in rows.values())
+    assert all(r["tenant"] == "campaign" for r in rows.values())
+    # exactly-once metering: one done usage row per node
+    per_job = {}
+    for r in led.usage.raw_rows():
+        if r["state"] == "done":
+            per_job[r["job_id"]] = per_job.get(r["job_id"], 0) + 1
+    assert sorted(per_job) == sorted(rows)
+    assert all(c == 1 for c in per_job.values())
+    # the episode is reconstructable from campaign_events.jsonl
+    census = _census(tmp_path, "camp")
+    assert census["campaign-create"] == 1
+    assert census["campaign-wave-admit"] == st["waves"]
+    assert census["campaign-obs-done"] == n_obs
+    assert census["campaign-complete"] == 1
+
+
+def test_failed_observation_conserves_with_cascade_rows(tmp_path,
+                                                        monkeypatch):
+    """admitted == done + failed even when an observation poisons:
+    the failed search cascades its subtree, and every cascade node
+    meters a zero-execute terminal row (satellite: the accounting
+    cannot diverge on a failing observation)."""
+    monkeypatch.setenv("PRESTO_TPU_USAGE", "1")
+    drv = _driver(tmp_path, wave_size=3)
+    led = drv.ledger
+    try:
+        drv.create(_manifest(3))
+        st = _run_to_done(drv, led, fail_dags=("camp.obs-001",))
+    finally:
+        drv.close()
+    assert st["state"] == "done"
+    assert st["counts"]["done"] == 2
+    assert st["counts"]["failed"] == 1
+    assert st["counts"]["done"] + st["counts"]["failed"] == 3
+    bad = load_campaign(str(tmp_path), "camp")["observations"][
+        "obs-001"]
+    assert bad["state"] == "failed"
+    # conservation: EVERY terminal node metered exactly once —
+    # executed nodes with their cost, cascaded ones at zero
+    rows = led.read()["jobs"]
+    usage = {}
+    for r in led.usage.raw_rows():
+        usage.setdefault(r["job_id"], []).append(r)
+    assert sorted(usage) == sorted(rows)
+    assert all(len(v) == 1 for v in usage.values())
+    cascaded = [j for j, rs in usage.items()
+                if rs[0].get("cascade")]
+    assert sorted(cascaded) == ["camp.obs-001-sift",
+                                "camp.obs-001-toa"]
+    for j in cascaded:
+        assert usage[j][0]["state"] == "failed"
+        assert not usage[j][0]["phases"]      # zero-execute
+        assert usage[j][0]["dag"] == "camp.obs-001"
+    census = _census(tmp_path, "camp")
+    assert census["campaign-obs-done"] == 2
+    assert census["campaign-obs-failed"] == 1
+
+
+def test_projection_converges_to_measured_total(tmp_path,
+                                                monkeypatch):
+    monkeypatch.setenv("PRESTO_TPU_USAGE", "1")
+    n_obs = 4
+    drv = _driver(tmp_path, wave_size=2)
+    try:
+        drv.create(_manifest(n_obs))
+        st = _run_to_done(drv, drv.ledger)
+    finally:
+        drv.close()
+    proj = st["projection"]
+    assert proj["settled"] == n_obs
+    assert proj["remaining"] == 0
+    assert proj["eta_s"] == 0.0
+    total = n_obs * NODES_PER_OBS * EXEC_S
+    assert proj["device_seconds_settled"] == pytest.approx(total)
+    assert proj["projected_total_device_seconds"] \
+        == pytest.approx(total)
+
+
+# ----------------------------------------------------------------------
+# crash atomicity: the admit-mark-then-admit_dag protocol
+# ----------------------------------------------------------------------
+
+class CrashingDriver(CampaignDriver):
+    """Driver that dies (SimulatedCrash) the first time a chosen
+    seam is crossed — the chaos model for every test below."""
+
+    def __init__(self, *args, crash_at=None, skip=0, **kw):
+        super().__init__(*args, **kw)
+        self.crash_at = crash_at
+        self.skip = skip
+
+    def _seam(self, point):
+        if point == self.crash_at:
+            if self.skip > 0:
+                self.skip -= 1
+                return
+            self.crash_at = None        # one-shot
+            raise SimulatedCrash(point)
+
+
+def _crashing(fleetdir, crash_at, skip=0, cid="camp", **kw):
+    return CrashingDriver(CampaignConfig(
+        fleetdir=str(fleetdir), campaign_id=cid, **kw),
+        crash_at=crash_at, skip=skip)
+
+
+def test_crash_at_wave_admit_resumes_without_loss(tmp_path,
+                                                  monkeypatch):
+    """Death after the durable ``admitting`` mark but BEFORE
+    admit_dag: the restarted driver re-admits from the mark alone —
+    nothing lost, nothing duplicated."""
+    monkeypatch.setenv("PRESTO_TPU_USAGE", "1")
+    drv = _crashing(tmp_path, "wave-admit", wave_size=2)
+    led = drv.ledger
+    try:
+        drv.create(_manifest(3))
+        with pytest.raises(SimulatedCrash):
+            drv.pulse()
+    finally:
+        drv.close()
+    doc = load_campaign(str(tmp_path), "camp")
+    marks = [o for o, r in doc["observations"].items()
+             if r["state"] == "admitting"]
+    assert marks == ["obs-000"]          # the mark is durable...
+    assert led.read()["jobs"] == {}      # ...but no DAG exists yet
+    # restart IS the normal path
+    drv2 = _driver(tmp_path, wave_size=2)
+    try:
+        drv2.resume()
+        st = _run_to_done(drv2, drv2.ledger)
+    finally:
+        drv2.close()
+    assert st["state"] == "done" and st["counts"]["done"] == 3
+    rows = drv2.ledger.read()["jobs"]
+    assert len(rows) == 3 * NODES_PER_OBS     # no double-admit
+    census = _census(tmp_path, "camp")
+    assert census["campaign-obs-done"] == 3
+    assert census["campaign-resume"] == 1
+
+
+def test_crash_mid_wave_resumes_remainder(tmp_path, monkeypatch):
+    """Death between two admissions of one wave: the first
+    observation is admitted (its DAG exists), the rest are still
+    pending — the restart admits only the remainder."""
+    monkeypatch.setenv("PRESTO_TPU_USAGE", "1")
+    drv = _crashing(tmp_path, "mid-wave", wave_size=2)
+    led = drv.ledger
+    try:
+        drv.create(_manifest(3))
+        with pytest.raises(SimulatedCrash):
+            drv.pulse()
+    finally:
+        drv.close()
+    doc = load_campaign(str(tmp_path), "camp")
+    assert doc["observations"]["obs-000"]["state"] == "admitted"
+    assert doc["observations"]["obs-001"]["state"] == "pending"
+    rows = led.read()["jobs"]
+    assert sorted({r.get("dag") for r in rows.values()}) \
+        == ["camp.obs-000"]
+    drv2 = _driver(tmp_path, wave_size=2)
+    try:
+        st = _run_to_done(drv2, drv2.ledger)
+    finally:
+        drv2.close()
+    assert st["state"] == "done" and st["counts"]["done"] == 3
+    assert len(drv2.ledger.read()["jobs"]) == 3 * NODES_PER_OBS
+
+
+def test_zombie_admit_window_is_fenced_by_duplicate_id(tmp_path,
+                                                       monkeypatch):
+    """The one re-admission window the protocol leaves open: the
+    driver died AFTER admit_dag landed but BEFORE the ``admitted``
+    save.  The replayed admit_dag must bounce off ``duplicate
+    job_id`` (the idempotence signal) and mark the row admitted —
+    never create a second DAG."""
+    monkeypatch.setenv("PRESTO_TPU_USAGE", "1")
+    drv = _driver(tmp_path, wave_size=2)
+    led = drv.ledger
+    try:
+        drv.create(_manifest(2))
+        drv.pulse()                       # both observations admitted
+    finally:
+        drv.close()
+    n_rows = len(led.read()["jobs"])
+    assert n_rows == 2 * NODES_PER_OBS
+    # simulate the lost save: roll obs-000 back to ``admitting``
+    doc = load_campaign(str(tmp_path), "camp")
+    doc["observations"]["obs-000"]["state"] = "admitting"
+    with open(ledger_path(str(tmp_path), "camp"), "w") as f:
+        json.dump(doc, f)
+    drv2 = _driver(tmp_path, wave_size=2)
+    try:
+        drv2.pulse()                      # replays the admit
+        doc2 = load_campaign(str(tmp_path), "camp")
+        assert doc2["observations"]["obs-000"]["state"] == "admitted"
+        assert len(drv2.ledger.read()["jobs"]) == n_rows
+        st = _run_to_done(drv2, drv2.ledger)
+    finally:
+        drv2.close()
+    assert st["counts"]["done"] == 2
+    assert len(drv2.ledger.read()["jobs"]) == n_rows
+
+
+def test_crash_pre_count_commit_settles_exactly_once(tmp_path,
+                                                     monkeypatch):
+    """Death inside settle, before the count commits: the restarted
+    driver settles the observation once — one campaign-obs-done
+    event, one terminal transition, never two."""
+    monkeypatch.setenv("PRESTO_TPU_USAGE", "1")
+    drv = _driver(tmp_path, wave_size=1)
+    led = drv.ledger
+    try:
+        drv.create(_manifest(1))
+        drv.pulse()
+        led.join("r1")
+        _drain_leases(led)                # the DAG lands terminal
+    finally:
+        drv.close()
+    crash = _crashing(tmp_path, "pre-count-commit", wave_size=1)
+    try:
+        with pytest.raises(SimulatedCrash):
+            crash.pulse()
+    finally:
+        crash.close()
+    doc = load_campaign(str(tmp_path), "camp")
+    assert doc["observations"]["obs-000"]["state"] == "admitted"
+    assert _census(tmp_path, "camp").get("campaign-obs-done", 0) == 0
+    drv2 = _driver(tmp_path, wave_size=1)
+    try:
+        st = drv2.pulse()
+        st2 = drv2.pulse()                # settling is write-once
+    finally:
+        drv2.close()
+    assert st["state"] == "done" and st["counts"]["done"] == 1
+    assert st2["counts"]["done"] == 1
+    census = _census(tmp_path, "camp")
+    assert census["campaign-obs-done"] == 1
+    assert census["campaign-complete"] == 1
+
+
+def test_crash_matrix_final_state_equals_clean_run(tmp_path,
+                                                   monkeypatch):
+    """A campaign crashed at every seam in turn and resumed each
+    time converges to the same final state as a never-crashed twin:
+    same observation states, same admitted node set, same
+    exactly-once usage accounting."""
+    monkeypatch.setenv("PRESTO_TPU_USAGE", "1")
+
+    def run(root, crashes):
+        fleet = tmp_path / root
+        drv = _driver(fleet, wave_size=2)
+        drv.create(_manifest(4))
+        drv.close()
+        for point in crashes:
+            c = _crashing(fleet, point, wave_size=2)
+            try:
+                c.pulse()
+                c.ledger.join("r1")
+                _drain_leases(c.ledger)
+                c.pulse()
+            except SimulatedCrash:
+                pass
+            finally:
+                c.close()
+        drv = _driver(fleet, wave_size=2)
+        try:
+            drv.resume()
+            st = _run_to_done(drv, drv.ledger)
+            rows = drv.ledger.read()["jobs"]
+            usage = {}
+            for r in drv.ledger.usage.raw_rows():
+                if r["state"] == "done":
+                    usage[r["job_id"]] = usage.get(r["job_id"],
+                                                   0) + 1
+        finally:
+            drv.close()
+        obs = {o: r["state"] for o, r in load_campaign(
+            str(fleet), "camp")["observations"].items()}
+        return st, sorted(rows), usage, obs
+
+    clean = run("clean", [])
+    chaotic = run("chaos", ["wave-admit", "mid-wave",
+                            "pre-count-commit"])
+    assert clean[0]["counts"] == chaotic[0]["counts"]
+    assert clean[1] == [j.replace("camp.", "camp.")
+                        for j in chaotic[1]]
+    assert clean[3] == chaotic[3]
+    for _, _, usage, _ in (clean, chaotic):
+        assert all(n == 1 for n in usage.values())
+        assert len(usage) == 4 * NODES_PER_OBS
+
+
+# ----------------------------------------------------------------------
+# conservation survives usage-ledger compaction (satellite)
+# ----------------------------------------------------------------------
+
+def test_conservation_repinned_on_compacted_ledger(tmp_path,
+                                                   monkeypatch):
+    """Compacting the usage ledger (dropping superseded redo rows)
+    changes no reader's view: rows() is identical before and after,
+    exactly-once conservation still holds, and a torn tail never
+    breaks the rewrite."""
+    monkeypatch.setenv("PRESTO_TPU_USAGE", "1")
+    drv = _driver(tmp_path, wave_size=2)
+    led = drv.ledger
+    try:
+        drv.create(_manifest(3))
+        st = _run_to_done(drv, led)
+    finally:
+        drv.close()
+    assert st["counts"]["done"] == 3
+    # churn garbage: a superseded redo row + a torn final line
+    redo = dict(led.usage.rows()[0])
+    led.usage.append(redo)
+    with open(led.usage.path, "a") as f:
+        f.write('{"job_id": "torn-')
+    fresh = JobLedger(str(tmp_path))
+    before = fresh.usage.rows()
+    dropped = fresh.usage.compact()
+    assert dropped >= 1
+    after = fresh.usage.rows()
+    assert after == before
+    # conservation re-pinned on the compacted ledger
+    rows = fresh.read()["jobs"]
+    per_job = {}
+    for r in after:
+        if r["state"] == "done":
+            per_job[r["job_id"]] = per_job.get(r["job_id"], 0) + 1
+    assert sorted(per_job) == sorted(
+        j for j, row in rows.items() if row["state"] == "done")
+    assert all(n == 1 for n in per_job.values())
+    # raw view shrank to the dedup set (the redo garbage is gone)
+    assert len(fresh.usage.raw_rows()) == len(after)
+
+
+# ----------------------------------------------------------------------
+# backfill yield: burn -> backfill.json -> effective lease weight
+# ----------------------------------------------------------------------
+
+def test_backfill_yield_actuates_lease_weight(tmp_path):
+    """The actuation chain: a burning interactive tenant shrinks the
+    declared backfill tenants' effective WRR weight through
+    backfill.json (stat-cached by the lease policy) — floored, and
+    restored to 1.0 when the burn clears."""
+    led = JobLedger(str(tmp_path))
+    led.set_tenant("campaign", weight=0.5)
+    slo.save_backfill(str(tmp_path), ["campaign"], floor=0.05)
+    burning = {"gold": {"windows": [
+        {"fast_events": 3, "fast_burn": 10.0}]}}
+    factor = slo.update_backfill_yield(str(tmp_path), burning)
+    assert factor == pytest.approx(0.1)
+    cfg = led._tenant_cfg(led._load(), "campaign")
+    assert cfg["weight"] == pytest.approx(0.5 * 0.1)
+    # burn clears -> full configured weight again
+    calm = {"gold": {"windows": [
+        {"fast_events": 0, "fast_burn": 50.0}]}}
+    assert slo.update_backfill_yield(str(tmp_path), calm) == 1.0
+    cfg = led._tenant_cfg(led._load(), "campaign")
+    assert cfg["weight"] == pytest.approx(0.5)
+    # the floor holds against any burn
+    inferno = {"gold": {"windows": [
+        {"fast_events": 9, "fast_burn": 1e6}]}}
+    assert slo.update_backfill_yield(str(tmp_path), inferno) \
+        == pytest.approx(0.05)
+
+
+def test_campaign_pulse_records_yield_decisions(tmp_path,
+                                                monkeypatch):
+    """Every yield change lands as a campaign-yield event with the
+    burning tenants named — the throttle trail a post-mortem reads."""
+    monkeypatch.setenv("PRESTO_TPU_USAGE", "1")
+    fleet = str(tmp_path)
+    slo.save_specs(fleet, [slo.SloSpec(tenant="gold",
+                                       objective=0.999,
+                                       latency_s=0.001)])
+    drv = _driver(tmp_path, wave_size=1)
+    try:
+        drv.create(_manifest(1))
+        # a slow gold job burns the 99.9% budget instantly
+        drv.ledger.usage.append(
+            {"tenant": "gold", "job_id": "g1", "ts": time.time(),
+             "state": "done", "bucket": "b",
+             "phases": {"execute": 5.0, "total": 5.0}})
+        st = drv.pulse()
+    finally:
+        drv.close()
+    assert st["yield"] < 1.0
+    evs = [e for e in _events(tmp_path, "camp")
+           if e["kind"] == "campaign-yield"]
+    assert len(evs) == 1
+    assert evs[0]["burning"] == ["gold"]
+    assert evs[0]["factor"] == st["yield"]
+    bf = slo.load_backfill(fleet)
+    assert bf["yield"] == pytest.approx(st["yield"])
+
+
+# ----------------------------------------------------------------------
+# router surface + CLI exit contract
+# ----------------------------------------------------------------------
+
+def test_router_campaign_surface(tmp_path, monkeypatch):
+    monkeypatch.setenv("PRESTO_TPU_USAGE", "1")
+    from presto_tpu.serve.router import FleetRouter, RouterConfig
+    router = FleetRouter(RouterConfig(fleetdir=str(tmp_path / "f")))
+    try:
+        with pytest.raises(ValueError):
+            router.submit_campaign({"id": "x", "manifest": []})
+        st = router.submit_campaign(
+            {"id": "survey#1", "manifest": _manifest(3),
+             "wave_size": 2})
+        assert st["campaign_id"] == "survey-1"    # sanitized
+        assert st["outstanding"] == 2             # first wave landed
+        # idempotent re-POST: same ledger, nothing re-admitted
+        st2 = router.submit_campaign(
+            {"id": "survey#1", "manifest": _manifest(3),
+             "wave_size": 2})
+        assert st2["outstanding"] == 2
+        assert len(router.ledger.read()["jobs"]) \
+            == 2 * NODES_PER_OBS
+        # unknown id: None, and no campaign dir is created by probing
+        assert router.campaign_view("nope") is None
+        assert not os.path.isdir(campaign_dir(str(tmp_path / "f"),
+                                              "nope"))
+        view = router.campaigns_view()["campaigns"]
+        assert list(view) == ["survey-1"]
+        assert view["survey-1"]["observations"] == 3
+        assert list_campaigns(str(tmp_path / "f")) == ["survey-1"]
+        router._pulse_campaigns()                 # must not throw
+    finally:
+        router.stop()
+
+
+def test_cli_exit_contract(tmp_path, monkeypatch, capsys):
+    monkeypatch.setenv("PRESTO_TPU_USAGE", "1")
+    from presto_tpu.apps.campaign import main as campaign_main
+    fleet = str(tmp_path / "fleet")
+    man = tmp_path / "manifest.json"
+    man.write_text(json.dumps(_manifest(2)))
+    # resume without a ledger: rc 1, actionable message
+    assert campaign_main(["-fleet", fleet, "-id", "c", "-resume"]) \
+        == 1
+    assert "no ledger" in capsys.readouterr().err
+    # create + one pulse: rc 0, first wave admitted
+    assert campaign_main(["-fleet", fleet, "-id", "c", "-manifest",
+                          str(man), "-wave-size", "1", "-once"]) == 0
+    led = JobLedger(fleet)
+    assert len(led.read()["jobs"]) == NODES_PER_OBS
+    # drain everything, then -resume runs to completion: rc 0
+    led.join("r1")
+    while True:
+        drained = _drain_leases(led)
+        drv = _driver(tmp_path / "fleet", cid="c")
+        st = drv.pulse()
+        drv.close()
+        if st["state"] != "running":
+            break
+        assert drained or st["outstanding"]
+    assert campaign_main(["-fleet", fleet, "-id", "c",
+                          "-resume"]) == 0
+    capsys.readouterr()                   # drop the progress lines
+    # -status prints the projection JSON
+    assert campaign_main(["-fleet", fleet, "-id", "c",
+                          "-status"]) == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["state"] == "done"
+    assert out["projection"]["remaining"] == 0
+
+
+def test_report_campaign_convergence(tmp_path, monkeypatch):
+    """presto-report -campaign: the convergence series replays the
+    settle history and lands exactly on the measured total."""
+    monkeypatch.setenv("PRESTO_TPU_USAGE", "1")
+    from presto_tpu.apps.report import collect_campaign
+    drv = _driver(tmp_path, wave_size=2)
+    try:
+        drv.create(_manifest(4))
+        _run_to_done(drv, drv.ledger)
+    finally:
+        drv.close()
+    info = collect_campaign(str(tmp_path), "camp")
+    assert info is not None
+    conv = info["convergence"]
+    assert len(conv) == 4
+    assert conv[-1]["settled"] == 4
+    total = 4 * NODES_PER_OBS * EXEC_S
+    assert conv[-1]["device_seconds"] == pytest.approx(total)
+    assert conv[-1]["projected_total_device_seconds"] \
+        == pytest.approx(total)
+    assert collect_campaign(str(tmp_path), "ghost") is None

@@ -492,6 +492,81 @@ def test_fleet_report_renders_supervisor_timeline(tmp_path):
 
 
 # ----------------------------------------------------------------------
+# preempt-fraction pacing (ISSUE 17: spot capacity as steady state)
+# ----------------------------------------------------------------------
+
+def test_preempt_kills_most_loaded_campaign_holder(tmp_path):
+    """The pacer SIGKILLs (no drain) the replica holding the most
+    campaign-tenant leases, spawns a replacement outside the scaling
+    gates, and leaves interactive replicas untouched."""
+    sup = _mksup(tmp_path, preempt_fraction=0.5,
+                 preempt_interval_s=10.0, max_replicas=8,
+                 cooldown_s=0.0, heartbeat_timeout=100.0)
+    sup.advice = {"wanted_replicas": 4, "reason": "t", "inputs": {}}
+    sup.step(now=0.0)
+    sup.step(now=1.0)
+    names = sorted(sup.replicas())
+    assert len(names) == 4
+    for n in names:
+        sup.ledger.heartbeat(n, 0, now=1.5)
+    sup.step(now=2.0)                     # all UP; no holders yet
+    assert all(s != signal.SIGKILL for _, s in sup.signals)
+    # two of four replicas hold campaign leases
+    sup.ledger.lease_owners = \
+        lambda tenant=None: {names[0]: 1, names[1]: 3}
+    sup.step(now=3.0)
+    # fraction 0.5 of 2 holders -> exactly 1 kill, most-loaded first
+    assert (names[1], signal.SIGKILL) in sup.signals
+    assert names[1] not in sup.replicas()
+    assert len(sup.replicas()) == 4       # replacement spawned
+    ev = [e for e in _events(tmp_path)
+          if e["kind"] == "campaign-preempt"]
+    assert len(ev) == 1
+    assert ev[0]["replica"] == names[1]
+    assert ev[0]["leases"] == 3
+    assert ev[0]["tenant"] == "campaign"
+    assert ev[0]["replacement"] in sup.replicas()
+    # the replacement rode the ordinary spawn path, labelled
+    spawn_whys = [e.get("why", "") for e in _events(tmp_path)
+                  if e["kind"] == "supervisor-spawn"]
+    assert any("campaign lane" in w for w in spawn_whys)
+    # interval gate: the next step is inside preempt_interval_s
+    sup.step(now=5.0)
+    assert len([e for e in _events(tmp_path)
+                if e["kind"] == "campaign-preempt"]) == 1
+    # past the interval: at least one preempted while any holds one
+    sup.ledger.lease_owners = lambda tenant=None: {names[0]: 1}
+    sup.step(now=14.0)
+    ev = [e for e in _events(tmp_path)
+          if e["kind"] == "campaign-preempt"]
+    assert len(ev) == 2 and ev[1]["replica"] == names[0]
+
+
+def test_preempt_disabled_and_floored(tmp_path):
+    """fraction 0.0 never preempts even with holders; a tiny
+    fraction still preempts at least one (the floor keeps the path
+    exercised, never special)."""
+    sup = _mksup(tmp_path, cooldown_s=0.0)     # fraction defaults 0
+    sup.advice = {"wanted_replicas": 2, "reason": "t", "inputs": {}}
+    sup.step(now=0.0)
+    sup.step(now=1.0)
+    names = sorted(sup.replicas())
+    for n in names:
+        sup.ledger.heartbeat(n, 0, now=1.5)
+    sup.ledger.lease_owners = \
+        lambda tenant=None: {n: 1 for n in names}
+    sup.step(now=2.0)
+    assert all(s != signal.SIGKILL for _, s in sup.signals)
+    assert not [e for e in _events(tmp_path)
+                if e["kind"] == "campaign-preempt"]
+    # fraction 0.1 of 2 holders rounds to 0 -> floored to 1 kill
+    sup.cfg.preempt_fraction = 0.1
+    sup.step(now=3.0)
+    killed = [n for n, s in sup.signals if s == signal.SIGKILL]
+    assert len(killed) == 1 and killed[0] in names
+
+
+# ----------------------------------------------------------------------
 # taxonomy + lint check 16
 # ----------------------------------------------------------------------
 

@@ -34,11 +34,25 @@ itself (the fleet degrades to advisory-only and a second job wave
 still completes) and restart it (adoption from the persisted
 registry, no orphans).
 
+`-campaign` runs the ISSUE 17 archive-churn trial: a campaign of
+observation DAGs is driven in bounded waves while (a) the campaign
+driver is crashed at a randomized seam of the
+admit-mark-then-admit_dag protocol (wave-admit / mid-wave /
+pre-count-commit) and resumed crash-only from its ledger, and (b) a
+replica is killed SIGKILL-style mid-campaign with a replacement
+riding in — preemption as a normal operating mode.  The trial passes
+iff the finished campaign is indistinguishable from an undisturbed
+sequential run: every observation done, every DAG node admitted and
+usage-metered exactly once, search artifacts and the sifted
+candidate list byte-equal to the reference, and the whole episode
+reconstructable from campaign_events.jsonl (-> CAMPAIGN_CHAOS.json).
+
 Writes FLEET_CHAOS.json (committed at the repo root).  Run:
 
   python tools/fleet_chaos.py -trials 3 -seed 9
   python tools/fleet_chaos.py --fast          # 1-trial smoke
   python tools/fleet_chaos.py -trials 3 -supervisor -commit
+  python tools/fleet_chaos.py -trials 3 -campaign -commit
 """
 
 from __future__ import annotations
@@ -565,6 +579,206 @@ def run_dag_trial(trial: int, rng: random.Random, beam: str,
     return rec
 
 
+#: campaign driver crash seams (-campaign): the driver dies at the
+#: worst instants of the admit-mark-then-admit_dag protocol —
+#: "wave-admit" after the durable ``admitting`` mark but before the
+#: DAG lands, "mid-wave" between two admissions of one wave,
+#: "pre-count-commit" inside settle before the count commits.  Every
+#: trial ALSO loses a replica mid-campaign to a SIGKILL-equivalent
+#: death with a replacement riding in (preemption as a normal
+#: operating mode, not a special case).
+CAMPAIGN_KILL_POINTS = ("wave-admit", "mid-wave", "pre-count-commit")
+
+
+def run_campaign_trial(trial: int, rng: random.Random, beam: str,
+                       ref: dict, ref_sift: bytes, workdir: str,
+                       replicas: int, observations: int,
+                       timeout: float) -> dict:
+    """One campaign churn trial (ISSUE 17): admit an archive of
+    observations through the campaign driver, crash the driver at a
+    randomized ledger seam mid-campaign AND kill a replica holding
+    campaign leases (replacement spawned), resume crash-only from the
+    ledger, and check that the finished campaign is indistinguishable
+    from an undisturbed sequential run: every observation done, every
+    DAG node admitted and metered exactly once, search artifacts and
+    the sifted candidate list byte-equal to the reference."""
+    from presto_tpu.serve.campaign import (CampaignConfig,
+                                           CampaignDriver,
+                                           SimulatedCrash)
+    from presto_tpu.serve.fleet import FleetConfig, FleetReplica
+    from presto_tpu.serve.jobledger import JobLedger
+    from presto_tpu.serve.server import SearchService
+
+    os.environ["PRESTO_TPU_USAGE"] = "1"
+    base = os.path.join(workdir, "camptrial%02d" % trial)
+    fleetdir = os.path.join(base, "fleet")
+    cid = "camp"
+    wave = 2
+
+    class CrashOnce(CampaignDriver):
+        def __init__(self, cfg, crash_at, skip):
+            super().__init__(cfg)
+            self.crash_at, self.skip = crash_at, skip
+
+        def _seam(self, point):
+            if point == self.crash_at:
+                if self.skip > 0:
+                    self.skip -= 1
+                    return
+                self.crash_at = None
+                raise SimulatedCrash(point)
+
+    crash_point = (CAMPAIGN_KILL_POINTS[trial
+                                        % len(CAMPAIGN_KILL_POINTS)]
+                   if trial < len(CAMPAIGN_KILL_POINTS)
+                   else rng.choice(CAMPAIGN_KILL_POINTS))
+    skip = rng.randrange(0, 2)
+    kill_delay = rng.uniform(0.5, 3.0)
+    victim_idx = rng.randrange(replicas)
+    rec = {"trial": trial, "mode": "campaign",
+           "crash_point": crash_point, "crash_skip": skip,
+           "victim": "rep%d" % victim_idx,
+           "kill_delay_s": round(kill_delay, 3), "ok": False,
+           "checks": {}}
+    manifest = [{"id": "obs-%03d" % i, "rawfiles": [beam],
+                 "config": dict(TINY_CFG),
+                 "sift": {"min_dm_hits": 2, "low_dm_cutoff": 2.0},
+                 "toa": {"ntoa": 1}}
+                for i in range(observations)]
+
+    def mkcfg():
+        return CampaignConfig(fleetdir=fleetdir, campaign_id=cid,
+                              wave_size=wave)
+
+    def mkfleet(name):
+        svc = SearchService(os.path.join(base, name),
+                            queue_depth=8).start()
+        rep = FleetReplica(svc, FleetConfig(
+            fleetdir=fleetdir, replica=name, lease_ttl=30.0,
+            heartbeat_s=0.1, heartbeat_timeout=0.8, poll_s=0.05,
+            max_inflight=1, prewarm=False))
+        return svc, rep
+
+    members = []
+    drv = CrashOnce(mkcfg(), crash_point, skip)
+    try:
+        drv.create(manifest)
+        for i in range(replicas):
+            members.append(mkfleet("rep%d" % i))
+        for _svc, rep in members:
+            rep.start()
+        victim = members[victim_idx][1]
+        crashes = 0
+        killed = False
+        max_out = 0
+        st = drv.status()
+        kill_at = time.time() + kill_delay
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            if not killed and time.time() >= kill_at:
+                # SIGKILL-equivalent replica death: heartbeats stop,
+                # leases stay claimed; a replacement rides in the way
+                # the supervisor's preempt pacer replaces capacity
+                victim.kill()
+                killed = True
+                members.append(mkfleet("rep-replace"))
+                members[-1][1].start()
+            try:
+                st = drv.pulse()
+            except SimulatedCrash:
+                crashes += 1
+                drv.close()
+                # crash-only restart: a fresh driver, the durable
+                # ledger is the whole handoff
+                drv = CampaignDriver(mkcfg())
+                drv.resume()
+                continue
+            max_out = max(max_out, st["outstanding"])
+            if st["state"] != "running":
+                break
+            time.sleep(0.2)
+        rec["crashes"] = crashes
+        rec["waves"] = st["waves"]
+        rec["counts"] = st["counts"]
+        rec["checks"]["driver_crashed"] = crashes >= 1
+        rec["checks"]["victim_killed"] = killed
+        rec["checks"]["campaign_done"] = (st["state"] == "done")
+        rec["checks"]["zero_lost"] = (
+            st["counts"]["done"] == observations
+            and st["counts"]["failed"] == 0)
+        rec["checks"]["wave_bound_held"] = (max_out <= wave)
+        led = JobLedger(fleetdir)
+        jobs = led.read()["jobs"]
+        done = [j for j, r in jobs.items() if r["state"] == "done"]
+        # the crash matrix never double-admits: 3 nodes per
+        # observation (search -> sift -> toa), each exactly once
+        rec["checks"]["single_admission"] = (
+            len(jobs) == 3 * observations
+            and sorted(done) == sorted(jobs))
+        per_job = {}
+        for r in led.usage.raw_rows():
+            if r.get("state") == "done":
+                per_job[r["job_id"]] = per_job.get(r["job_id"],
+                                                   0) + 1
+        rec["checks"]["usage_exactly_once"] = (
+            sorted(per_job) == sorted(done)
+            and all(n == 1 for n in per_job.values()))
+        rec["device_seconds"] = round(
+            sum(float(r["phases"].get("execute") or 0.0)
+                for r in led.usage.rows()
+                if r.get("state") == "done"), 6)
+        rec["redos"] = {j: r["redos"] for j, r in jobs.items()
+                       if r["redos"]}
+
+        def committed(jid, name=None):
+            detail = json.load(open(os.path.join(
+                fleetdir, "jobs", jid, "result.json")))
+            if name is None:
+                return detail["artifacts"]
+            p = os.path.join(fleetdir, "jobs", jid,
+                             detail["attempt_dir"], name)
+            with open(p, "rb") as f:
+                return f.read()
+
+        equal = True
+        try:
+            for i in range(observations):
+                dag = "%s.obs-%03d" % (cid, i)
+                if committed(dag + "-search") != ref:
+                    equal = False
+                if committed(dag + "-sift",
+                             "cands_sifted.txt") != ref_sift:
+                    equal = False
+        except (OSError, ValueError, KeyError):
+            equal = False
+        rec["checks"]["byte_equal_reference"] = equal
+        # the whole disturbed episode reconstructs from the durable
+        # campaign event journal alone
+        kinds = {}
+        try:
+            from presto_tpu.serve.campaign import events_path
+            with open(events_path(fleetdir, cid)) as f:
+                for ln in f:
+                    if ln.strip():
+                        k = json.loads(ln)["kind"]
+                        kinds[k] = kinds.get(k, 0) + 1
+        except OSError:
+            pass
+        rec["events_by_kind"] = kinds
+        rec["checks"]["episode_reconstructable"] = (
+            kinds.get("campaign-create", 0) == 1
+            and kinds.get("campaign-resume", 0) == crashes
+            and kinds.get("campaign-obs-done", 0) == observations
+            and kinds.get("campaign-complete", 0) >= 1)
+        rec["ok"] = all(rec["checks"].values())
+    finally:
+        drv.close()
+        for svc, rep in members:
+            rep.stop()
+            svc.stop()
+    return rec
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="fleet_chaos")
     p.add_argument("-trials", type=int, default=3)
@@ -582,6 +796,15 @@ def main(argv=None) -> int:
                    help="DAG mode: kill-one trials over whole "
                         "discovery DAGs at DAG-aware kill points "
                         "(-> DAG_CHAOS.json with -commit)")
+    p.add_argument("-campaign", action="store_true",
+                   help="Campaign mode (ISSUE 17): crash the "
+                        "campaign driver at a randomized ledger seam "
+                        "mid-archive AND kill/replace a replica, "
+                        "resume crash-only, and require the result "
+                        "byte-equal to an undisturbed run "
+                        "(-> CAMPAIGN_CHAOS.json with -commit)")
+    p.add_argument("-observations", type=int, default=4,
+                   help="Observations per campaign trial")
     p.add_argument("-supervisor", action="store_true",
                    help="Also run the supervised-fleet kill trial: "
                         "SIGKILL a supervisor-spawned replica "
@@ -633,6 +856,54 @@ def main(argv=None) -> int:
             "failed": sum(1 for r in trials if not r["ok"]),
         }
         out = args.out or (os.path.join(REPO, "DAG_CHAOS.json")
+                           if args.commit else None)
+        text = json.dumps(report, indent=1, sort_keys=True)
+        if out:
+            with open(out, "w") as f:
+                f.write(text + "\n")
+            print("fleet_chaos: report -> %s" % out)
+        else:
+            print(text)
+        return 0 if report["failed"] == 0 else 1
+
+    if args.campaign:
+        import glob as _glob
+        from presto_tpu.pipeline.sifting import sift_candidates
+        beam = make_beams(workdir, 1, nsamp=args.nsamp,
+                          nchan=args.nchan)[0]
+        # the undisturbed reference: one sequential survey + sift
+        refdir = os.path.join(workdir, "campaign-reference")
+        run_survey([beam], SurveyConfig(**TINY_CFG), workdir=refdir)
+        ref = artifact_digests(refdir)
+        accs = sorted(_glob.glob(os.path.join(refdir, "*_ACCEL_0")))
+        cl = sift_candidates(accs, numdms_min=2, low_DM_cutoff=2.0)
+        sift_path = os.path.join(refdir, "cands_sifted.txt")
+        cl.to_file(sift_path)
+        with open(sift_path, "rb") as f:
+            ref_sift = f.read()
+        for t in range(args.trials):
+            rec = run_campaign_trial(t, rng, beam, ref, ref_sift,
+                                     workdir, args.replicas,
+                                     args.observations, args.timeout)
+            print("fleet_chaos: campaign trial %d crash=%s victim=%s"
+                  " crashes=%d -> %s"
+                  % (t, rec["crash_point"], rec["victim"],
+                     rec.get("crashes", 0),
+                     "PASS" if rec["ok"] else "FAIL"), flush=True)
+            trials.append(rec)
+        report = {
+            "mode": "campaign",
+            "seed": args.seed,
+            "replicas": args.replicas,
+            "observations_per_trial": args.observations,
+            "config": TINY_CFG,
+            "crash_points": list(CAMPAIGN_KILL_POINTS),
+            "reference_artifacts": len(ref),
+            "trials": trials,
+            "passed": sum(1 for r in trials if r["ok"]),
+            "failed": sum(1 for r in trials if not r["ok"]),
+        }
+        out = args.out or (os.path.join(REPO, "CAMPAIGN_CHAOS.json")
                            if args.commit else None)
         text = json.dumps(report, indent=1, sort_keys=True)
         if out:

@@ -46,6 +46,13 @@ own job_total p50/p99 from /metrics.
   # supervisor_events.jsonl -> SUPERVISOR_r16.json
   python tools/serve_loadgen.py -supervisor -commit
 
+  # campaign-engine verdict (ISSUE 17): an archive campaign backfills
+  # through bounded waves while a gold-SLO interactive tenant keeps
+  # submitting — the campaign drains with exactly-once commits, gold
+  # p99 stays within objective, the backfill lane yields under gold
+  # burn, and the ETA/cost projection converges -> CAMPAIGN_r17.json
+  python tools/serve_loadgen.py -campaign -commit
+
 Also importable (`run_loadgen`, `run_fleet_loadgen`,
 `run_stacked_loadgen`) — the `-m slow` serve smoke test drives it
 in-process, and tools/fleet_chaos.py + FLEET_r09.json +
@@ -1394,6 +1401,238 @@ def run_supervisor_loadgen(workdir: str, jobs_per_tenant: int = 5,
     }
 
 
+# ----------------------------------------------------------------------
+# campaign-engine verdict mode (ISSUE 17)
+# ----------------------------------------------------------------------
+
+#: interactive-tenant p99 objective for the campaign verdict.  The CI
+#: container serializes everything on ONE core, so this pins bounded
+#: latency (gold work is never starved behind the archive lane), not
+#: a production target — the burn-driven SLO machinery that shrinks
+#: the backfill lane still uses SLO_SPECS' 2 s objective internally.
+CAMPAIGN_GOLD_OBJECTIVE_S = 30.0
+
+#: per-observation DAG policies: one fold pass + a timing node, so a
+#: campaign observation exercises the whole discovery DAG shape
+CAMPAIGN_OBS_SPEC = {"sift": {"min_dm_hits": 2, "low_dm_cutoff": 2.0},
+                     "fold": {"fold_top": 1}, "toa": {"ntoa": 1}}
+
+
+def run_campaign_loadgen(workdir: str, observations: int = 4,
+                         gold_jobs: int = 6, wave_size: int = 2,
+                         timeout: float = 900.0) -> dict:
+    """The CAMPAIGN_r17.json verdict (campaign engine): an archive
+    campaign backfills through a real router + 2 replicas while a
+    gold-SLO interactive tenant keeps submitting.
+
+    1. the campaign drains to done with never more than `wave_size`
+       observations outstanding (jobs.json stays bounded at any
+       archive size) and admitted == done + failed conserves;
+    2. every terminal job — campaign DAG nodes and interactive gold
+       jobs alike — commits exactly once in the durable usage ledger
+       (zero lost, zero double-counted);
+    3. the gold tenant's p99 end-to-end latency stays within the
+       objective, and the backfill lane visibly yields (live WRR
+       weight < configured) whenever gold latency actually burns
+       its SLO budget;
+    4. the live ETA/cost projection converges onto the measured
+       total device-seconds as the archive drains;
+    5. the whole episode is reconstructable from
+       campaign_events.jsonl alone: one create, one wave-admit per
+       wave, one obs-done per observation, one complete.
+    """
+    from presto_tpu.apps.report import collect_campaign
+    from presto_tpu.serve.fleet import FleetConfig, FleetReplica
+    from presto_tpu.serve.router import (FleetRouter, RouterConfig,
+                                         start_http as router_http)
+    from presto_tpu.serve.server import SearchService, start_http
+    from presto_tpu.serve.usage import UsageLedger
+    prev_usage = os.environ.get("PRESTO_TPU_USAGE")
+    os.environ["PRESTO_TPU_USAGE"] = "1"
+    beams = make_beams(workdir, observations + 1, nsamp=4096,
+                       nchan=8)
+    gold_beam = beams[observations]
+    fleetdir = os.path.join(workdir, "fleet")
+    router = FleetRouter(RouterConfig(
+        fleetdir=fleetdir, high_water=256, poll_s=0.2,
+        heartbeat_timeout=3.0, slo=list(SLO_SPECS),
+        slo_windows=SLO_WINDOWS, scale_target_drain_s=5.0,
+        scale_max_replicas=4)).start()
+    rhttpd = router_http(router)
+    url = "http://%s:%d" % rhttpd.server_address[:2]
+    members = []
+    for i in range(2):
+        svc = SearchService(os.path.join(workdir, "rep%d" % i),
+                            queue_depth=64).start()
+        httpd = start_http(svc)
+        addr = "http://%s:%d" % httpd.server_address[:2]
+        rep = FleetReplica(svc, FleetConfig(
+            fleetdir=fleetdir, replica="rep%d" % i, lease_ttl=60.0,
+            heartbeat_s=0.2, heartbeat_timeout=3.0, poll_s=0.05,
+            max_inflight=1, snapshot_s=0.2), addr=addr).start()
+        members.append((svc, rep, httpd))
+    deadline = time.time() + 60.0
+    while time.time() < deadline:
+        router.poll_replicas()
+        if len(router.ready_replicas()) >= 2:
+            break
+        time.sleep(0.2)
+
+    cid = "loadgen-r17"
+    manifest = [dict(CAMPAIGN_OBS_SPEC, id="obs-%03d" % i,
+                     rawfiles=[beams[i]], config=dict(SLO_CFG))
+                for i in range(observations)]
+    series = []
+    submitted = {}
+    finished = {}
+    try:
+        t0 = time.time()
+        first = _http_json(url + "/campaign",
+                           {"id": cid, "manifest": manifest,
+                            "wave_size": wave_size, "weight": 0.1,
+                            "priority": 50})
+        next_gold = t0
+        n_gold = 0
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            now = time.time()
+            if n_gold < gold_jobs and now >= next_gold:
+                view = _http_json(url + "/submit",
+                                  {"rawfiles": [gold_beam],
+                                   "config": dict(SLO_CFG),
+                                   "tenant": "gold"})
+                submitted[view["job_id"]] = time.time()
+                n_gold += 1
+                next_gold = now + 2.5
+            st = _http_json(url + "/campaign/" + cid)
+            series.append({
+                "t": round(now - t0, 3),
+                "state": st["state"],
+                "outstanding": st["outstanding"],
+                "yield": st["yield"],
+                "done": st["counts"]["done"],
+                "failed": st["counts"]["failed"],
+                "eta_s": (st.get("projection") or {}).get("eta_s"),
+            })
+            for jid in submitted:
+                if jid in finished:
+                    continue
+                v = router.status(jid)
+                if v and v["state"] in ("done", "failed"):
+                    finished[jid] = (time.time(), v["state"])
+            if (st["state"] != "running" and n_gold == gold_jobs
+                    and len(finished) == len(submitted)):
+                break
+            time.sleep(0.4)
+        final_status = _http_json(url + "/campaign/" + cid)
+        terminal_rows = {jid: row["state"] for jid, row in
+                        router.ledger.read()["jobs"].items()
+                        if row["state"] in ("done", "failed")}
+    finally:
+        for svc, rep, httpd in members:
+            httpd.shutdown()
+            svc.shutdown(drain=True, timeout=30.0)
+        rhttpd.shutdown()
+        router.stop()
+        if prev_usage is None:
+            os.environ.pop("PRESTO_TPU_USAGE", None)
+        else:
+            os.environ["PRESTO_TPU_USAGE"] = prev_usage
+
+    usage = UsageLedger(fleetdir, enabled=True)
+    per_done = {}
+    for r in usage.raw_rows():
+        if r.get("state") == "done":
+            per_done[r["job_id"]] = per_done.get(r["job_id"], 0) + 1
+    done_jobs = {j for j, s in terminal_rows.items() if s == "done"}
+    info = collect_campaign(fleetdir, cid)
+    conv = info["convergence"]
+    by_kind = info["by_kind"]
+    final_total = conv[-1]["device_seconds"] if conv else 0.0
+    errs = [abs(e["projected_total_device_seconds"] - final_total)
+            / max(final_total, 1e-9) for e in conv]
+    half = max(1, len(errs) // 2)
+    err_early = sum(errs[:half]) / half
+    err_late = sum(errs[half:]) / max(1, len(errs) - half)
+    gold_e2e = [t_end - submitted[j]
+                for j, (t_end, st) in finished.items()
+                if st == "done"]
+    gold_p99 = _p99(gold_e2e)
+    counts = final_status["counts"]
+    yields = [s["yield"] for s in series]
+    checks = {
+        "first_wave_admitted_before_202":
+            first["outstanding"] >= min(wave_size, observations),
+        "campaign_done": (final_status["state"] == "done"
+                          and counts["done"] == observations
+                          and counts["failed"] == 0),
+        "conservation": (counts["done"] + counts["failed"]
+                         == observations
+                         and final_status["outstanding"] == 0),
+        "wave_bound_held": max(s["outstanding"]
+                               for s in series) <= wave_size,
+        "gold_all_done": (len(finished) == gold_jobs
+                          and all(st == "done" for _, st
+                                  in finished.values())),
+        "gold_p99_within_objective": (
+            gold_p99 is not None
+            and gold_p99 <= CAMPAIGN_GOLD_OBJECTIVE_S),
+        "exactly_once_commits": (
+            set(per_done) == done_jobs and bool(done_jobs)
+            and all(n == 1 for n in per_done.values())),
+        "backfill_lane_yields": (
+            min(yields) < 1.0
+            or (gold_p99 is not None
+                and gold_p99 <= SLO_LATENCY_S)),
+        "eta_converges": (bool(conv) and errs[-1] <= 1e-6
+                          and err_late <= err_early + 0.05),
+        "episode_reconstructable": (
+            by_kind.get("campaign-create", 0) >= 1
+            and by_kind.get("campaign-wave-admit", 0)
+            == final_status["waves"]
+            and by_kind.get("campaign-obs-done", 0)
+            == counts["done"]
+            and by_kind.get("campaign-complete", 0) >= 1),
+    }
+    print("# campaign verdict: %d obs in %d wave(s)  gold p99 %.2fs "
+          "(objective %.0fs)  yield min %.2f  proj err %.1f%%->%.1f%%"
+          % (counts["done"], final_status["waves"],
+             gold_p99 if gold_p99 is not None else -1.0,
+             CAMPAIGN_GOLD_OBJECTIVE_S, min(yields),
+             100 * err_early, 100 * err_late), file=sys.stderr)
+    return {
+        "mode": "campaign",
+        "config": SLO_CFG,
+        "observations": observations,
+        "wave_size": wave_size,
+        "gold_jobs": gold_jobs,
+        "campaign": {"state": final_status["state"],
+                     "waves": final_status["waves"],
+                     "counts": counts,
+                     "projection": final_status.get("projection")},
+        "series": series,
+        "convergence": conv,
+        "events_by_kind": by_kind,
+        "gold_latency_s": {
+            "n": len(gold_e2e),
+            "p99": round(gold_p99, 3) if gold_p99 is not None
+            else None,
+            "mean": round(sum(gold_e2e) / len(gold_e2e), 3)
+            if gold_e2e else None,
+        },
+        "yield": {"min": min(yields), "max": max(yields)},
+        "checks": checks,
+        "verdict": "PASS" if all(checks.values()) else "FAIL",
+        "caveat": (
+            "CI container exposes ONE cpu core, so gold latencies "
+            "are serialized worst cases and the objective here is a "
+            "bounded-latency pin, not a production target; the "
+            "byte-equality of a churned + preempted campaign against "
+            "the sequential CLI is pinned separately by "
+            "tools/fleet_chaos.py -campaign (CAMPAIGN_CHAOS.json)."),
+    }
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="serve_loadgen")
     p.add_argument("-url", type=str, default=None,
@@ -1443,6 +1682,16 @@ def main(argv=None) -> int:
                         "lost jobs, episode reconstructable from "
                         "supervisor_events.jsonl (-> "
                         "SUPERVISOR_r16.json with -commit)")
+    p.add_argument("-campaign", action="store_true",
+                   help="Campaign-engine verdict mode: an archive "
+                        "campaign backfills in bounded waves while "
+                        "a gold-SLO tenant keeps submitting — "
+                        "campaign drains with exactly-once commits, "
+                        "gold p99 within objective, backfill lane "
+                        "yields under burn, ETA/cost projection "
+                        "converges, episode reconstructable from "
+                        "campaign_events.jsonl (-> CAMPAIGN_r17.json "
+                        "with -commit)")
     p.add_argument("-Ns", type=str, default="1,4,8",
                    help="Stacked/dag mode: comma list of batch sizes")
     p.add_argument("-commit", action="store_true",
@@ -1450,8 +1699,9 @@ def main(argv=None) -> int:
                         "to <repo>/SERVE_BATCH_r10.json (stacked), "
                         "<repo>/DAG_r11.json (dag), "
                         "<repo>/OBS_r12.json (obs), "
-                        "<repo>/SLO_r14.json (slo), or "
-                        "<repo>/SUPERVISOR_r16.json (supervisor)")
+                        "<repo>/SLO_r14.json (slo), "
+                        "<repo>/SUPERVISOR_r16.json (supervisor), or "
+                        "<repo>/CAMPAIGN_r17.json (campaign)")
     p.add_argument("-beams", type=int, default=4)
     p.add_argument("-rate", type=float, default=2.0,
                    help="Submission rate, jobs/s")
@@ -1463,13 +1713,30 @@ def main(argv=None) -> int:
     args = p.parse_args(argv)
     if (not args.url and not args.selfhost and not args.replicas
             and not args.stacked and not args.dag and not args.obs
-            and not args.slo and not args.supervisor):
+            and not args.slo and not args.supervisor
+            and not args.campaign):
         p.error("need -url, -selfhost, -replicas, -stacked, -dag, "
-                "-obs, -slo, or -supervisor")
+                "-obs, -slo, -supervisor, or -campaign")
 
     sys.path.insert(0, os.path.dirname(
         os.path.dirname(os.path.abspath(__file__))))
     workdir = args.workdir or tempfile.mkdtemp(prefix="loadgen_")
+
+    if args.campaign:
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        from presto_tpu.apps.common import ensure_backend
+        ensure_backend()
+        report = run_campaign_loadgen(workdir, timeout=args.timeout)
+        text = json.dumps(report, indent=1, sort_keys=True)
+        if args.commit:
+            out = os.path.join(os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__))), "CAMPAIGN_r17.json")
+            with open(out, "w") as f:
+                f.write(text + "\n")
+            print("serve_loadgen: report -> %s" % out)
+        else:
+            print(text)
+        return 0 if report["verdict"] == "PASS" else 1
 
     if args.supervisor:
         os.environ.setdefault("JAX_PLATFORMS", "cpu")
