@@ -185,6 +185,12 @@ def collect(workdir: str) -> dict:
                 "scrubbed_samples": q.get("scrubbed_samples", 0),
                 "counts": q.get("counts", {}),
             })
+
+    # beam-multiplexer health (stream/beams.py writes beams.json at
+    # end of observation: totals + per-beam QoS/veto/hand-off rows)
+    beams = _load_json(os.path.join(workdir, "beams.json"))
+    if beams:
+        info["beams"] = beams
     return info
 
 
@@ -822,6 +828,29 @@ def render(info: dict, max_spans: int = 15, file=None) -> None:
           % (q["bad_spectra"], q["nspectra"], q["scrubbed_samples"]))
         for reason, n in sorted(q.get("counts", {}).items()):
             w("    %-12s %d" % (reason, n))
+
+    beams = info.get("beams")
+    if beams:
+        w()
+        w("Beam multiplexer (beams.json): %d beams on %s — "
+          "%d triggers, %d vetoed, %d hand-off(s), %d replayed"
+          % (beams.get("beams", 0), beams.get("host", "?"),
+             beams.get("triggers", 0), beams.get("vetoed", 0),
+             beams.get("handoffs", 0), beams.get("replayed", 0)))
+        lat = beams.get("latency", {})
+        w("  %-10s %-9s %8s %8s %6s %8s %8s %4s %9s"
+          % ("beam", "state", "spectra", "triggers", "veto",
+             "stalled", "dropped", "ho", "p99 ms"))
+        for row in beams.get("per_beam", []):
+            p = lat.get(row.get("beam", ""), {})
+            p99 = p.get("p99") if isinstance(p, dict) else None
+            w("  %-10s %-9s %8d %8d %6d %8d %8d %4s %9s"
+              % (row.get("beam", "?"), row.get("state", "?"),
+                 row.get("spectra", 0), row.get("triggers", 0),
+                 row.get("vetoed", 0), row.get("stalled_spectra", 0),
+                 row.get("dropped_spectra", 0),
+                 "yes" if row.get("handoff") else "-",
+                 "%.1f" % (1e3 * p99) if p99 is not None else "-"))
 
 
 def build_parser():

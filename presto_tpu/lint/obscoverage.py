@@ -817,6 +817,84 @@ def lint(root: Optional[str] = None) -> List[str]:
         problems.append(
             "campaign layer: metric %r is not registered in "
             "obs/taxonomy.CAMPAIGN_METRICS" % name)
+
+    # 18. the beam multiplexer (stream/beams.py): BEAM_EVENTS /
+    # BEAM_SPANS / BEAM_METRICS pinned BOTH directions (and as subsets
+    # of their parent catalogs), plus the three-way kill-point pin
+    # (taxonomy == beams.BEAM_KILL_POINTS == testing/chaos re-export).
+    # The hand-off audit trail — which replica leased which beam, what
+    # it committed, why a write was fenced — must be reconstructable
+    # from events + metrics alone, so the vocabulary may neither go
+    # dark nor go stale.  The beam ledger declares its event kinds as
+    # EV_* class attributes (the leaseledger idiom, cf. check 2b),
+    # which count as emitted.
+    try:
+        beams_src = _read("presto_tpu/stream/beams.py", root)
+    except OSError:
+        beams_src = ""
+    b_events = set(EMIT_RE.findall(beams_src))
+    b_events |= set(EVENT_ATTR_RE.findall(beams_src))
+    b_events = {k for k in b_events if k.startswith("beam-")}
+    b_spans = set(SPAN_RE.findall(beams_src))
+    b_metrics = {m for m in METRIC_RE.findall(beams_src)
+                 if m.startswith("stream_beam")}
+    b_points = set(POINT_RE.findall(beams_src))
+    for k in sorted(taxonomy.BEAM_EVENTS - b_events):
+        problems.append(
+            "obs/taxonomy.py: BEAM_EVENTS lists %r but stream/beams.py "
+            "never emits it" % k)
+    for k in sorted(b_events - taxonomy.BEAM_EVENTS):
+        problems.append(
+            "stream/beams.py: event kind %r is not registered in "
+            "obs/taxonomy.BEAM_EVENTS" % k)
+    for s in sorted(taxonomy.BEAM_SPANS - taxonomy.STREAM_SPANS):
+        problems.append(
+            "obs/taxonomy.py: BEAM_SPANS lists %r which is not in "
+            "STREAM_SPANS" % s)
+    for s in sorted(taxonomy.BEAM_SPANS - b_spans):
+        problems.append(
+            "obs/taxonomy.py: BEAM_SPANS lists %r but stream/beams.py "
+            "never opens it" % s)
+    for s in sorted({x for x in b_spans if "beam" in x}
+                    - taxonomy.BEAM_SPANS):
+        problems.append(
+            "stream/beams.py: span %r is not registered in "
+            "obs/taxonomy.BEAM_SPANS" % s)
+    for name in sorted(taxonomy.BEAM_METRICS - taxonomy.METRICS):
+        problems.append(
+            "obs/taxonomy.py: BEAM_METRICS lists %r which is not in "
+            "METRICS" % name)
+    for name in sorted(taxonomy.BEAM_METRICS - b_metrics):
+        problems.append(
+            "obs/taxonomy.py: BEAM_METRICS lists %r but "
+            "stream/beams.py never registers it" % name)
+    for name in sorted(b_metrics - taxonomy.BEAM_METRICS):
+        problems.append(
+            "stream/beams.py: metric %r is not registered in "
+            "obs/taxonomy.BEAM_METRICS" % name)
+    for p in sorted(b_points - taxonomy.BEAM_KILL_POINTS):
+        problems.append(
+            "stream/beams.py: kill point %r is not registered in "
+            "obs/taxonomy.BEAM_KILL_POINTS" % p)
+    for p in sorted(taxonomy.BEAM_KILL_POINTS - b_points):
+        problems.append(
+            "obs/taxonomy.py: BEAM_KILL_POINTS lists %r but "
+            "stream/beams.py never fires it" % p)
+    try:
+        from presto_tpu.stream import beams as _beams_mod
+        from presto_tpu.testing import chaos as _chaos_mod
+        if set(_beams_mod.BEAM_KILL_POINTS) != taxonomy.BEAM_KILL_POINTS:
+            problems.append(
+                "stream/beams.py: BEAM_KILL_POINTS disagrees with "
+                "obs/taxonomy.BEAM_KILL_POINTS")
+        if set(_chaos_mod.BEAM_KILL_POINTS) != taxonomy.BEAM_KILL_POINTS:
+            problems.append(
+                "testing/chaos.py: BEAM_KILL_POINTS disagrees with "
+                "obs/taxonomy.BEAM_KILL_POINTS")
+    except Exception as e:  # pragma: no cover - import failure is a lint
+        problems.append(
+            "beam kill-point pin: could not import the runtime copies "
+            "(%s)" % e)
     return problems
 
 

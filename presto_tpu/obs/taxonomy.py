@@ -289,6 +289,35 @@ STREAM_EVENTS = frozenset({
     "stream-drop",
     "stream-quarantine",
     "trigger",
+    "beam-start",
+    "beam-stall",
+    "beam-drop",
+    "beam-veto",
+    "beam-eof",
+    "beam-handoff",
+})
+
+#: beam-multiplexer event kinds (stream/beams.py): the assembler's
+#: per-beam lifecycle plus the beam ledger's EV_* flight-recorder
+#: kinds (lease/fence transitions for beam hand-off across replicas).
+#: The emit-style kinds are a subset of STREAM_EVENTS (check 7 covers
+#: the stream tree); check 18 pins the full set — including the EV_*
+#: attributes check 7's EMIT_RE cannot see — both directions against
+#: stream/beams.py, so the hand-off audit trail may neither go dark
+#: nor go stale.
+BEAM_EVENTS = frozenset({
+    "beam-start",
+    "beam-stall",
+    "beam-drop",
+    "beam-veto",
+    "beam-eof",
+    "beam-handoff",
+    "beam-lease",
+    "beam-done",
+    "beam-redo",
+    "beam-stale-write",
+    "beam-replica-dead",
+    "beam-epoch-bump",
 })
 
 #: streaming-layer span names — every `obs.span("stream:...")` in
@@ -297,6 +326,34 @@ STREAM_SPANS = frozenset({
     "stream:block",
     "stream:dedisp",
     "stream:search",
+    "stream:beam-tick",
+})
+
+#: beam-multiplexer span names (subset of STREAM_SPANS; check 18 pins
+#: the subset relation and both directions against stream/beams.py)
+BEAM_SPANS = frozenset({
+    "stream:beam-tick",
+})
+
+#: beam-multiplexer metric names (subset of METRICS; check 18 pins
+#: both directions against stream/beams.py): the live-beam gauge and
+#: the per-beam QoS/veto/hand-off counters
+BEAM_METRICS = frozenset({
+    "stream_beams",
+    "stream_beam_stalled_total",
+    "stream_beam_dropped_total",
+    "stream_beam_vetoed_total",
+    "stream_beam_handoffs_total",
+})
+
+#: beam-multiplexer chaos kill points — the seams stream/beams.py
+#: fires through its FaultInjector hook (`self._point(...)`); the
+#: runtime copy is stream/beams.BEAM_KILL_POINTS (re-exported by
+#: testing/chaos.py) and check 18 pins all three copies to each other
+BEAM_KILL_POINTS = frozenset({
+    "beam-tick",
+    "beam-commit",
+    "beam-handoff",
 })
 
 #: serve-layer span names — every `obs.span("...")` in
@@ -584,6 +641,13 @@ METRICS = frozenset({
     "stream_gap_spectra_total",
     "stream_backlog_blocks",
     "stream_latency_seconds",
+    # beam multiplexer (stream/beams.py); pinned both directions by
+    # obs_lint check 18 via BEAM_METRICS
+    "stream_beams",
+    "stream_beam_stalled_total",
+    "stream_beam_dropped_total",
+    "stream_beam_vetoed_total",
+    "stream_beam_handoffs_total",
     # discovery DAGs (serve/dag.py + jobledger.py + router.py);
     # pinned both directions by obs_lint check 12 via DAG_METRICS
     "dag_submitted_total",

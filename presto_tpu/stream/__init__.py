@@ -13,6 +13,10 @@ engine with a bounded latency budget:
     that matches the batch search on the same bytes.
   * service.py — the presto-stream CLI and the deadline-lane glue
     into the serve scheduler; triggers stream on serve's /events.
+  * beams.py   — the presto-beams multiplexer: N same-geometry beam
+    feeds stacked into ONE jitted rolling-dedispersion chain per
+    deadline tick, with per-beam QoS degradation, a cross-beam
+    coincidence veto, and lease/fence beam hand-off across replicas.
 
 See docs/STREAMING.md for the architecture and the latency budget.
 """
@@ -23,9 +27,15 @@ from presto_tpu.stream.source import (FileTailProducer,
                                       RingBlockSource, SocketProducer,
                                       StreamBlock, feed_stream)
 from presto_tpu.stream.service import StreamService
+from presto_tpu.stream.beams import (BeamLedger, BeamMultiplexer,
+                                     CoincidenceVeto,
+                                     StackedRollingDedisp,
+                                     make_beam_block_step)
 
 __all__ = [
     "RollingDedisp", "StreamConfig", "StreamSearch", "Trigger",
     "FileTailProducer", "RingBlockSource", "SocketProducer",
     "StreamBlock", "feed_stream", "StreamService",
+    "BeamLedger", "BeamMultiplexer", "CoincidenceVeto",
+    "StackedRollingDedisp", "make_beam_block_step",
 ]

@@ -272,10 +272,38 @@ class StreamSearch:
         every DM search, emit remaining triggers."""
         if self._finished:
             return []
+        return self.finish_series(
+            self.rolling.flush(self.blocklen, self.hdr.nchans))
+
+    # -- external-dedispersion entry points ---------------------------
+    # The beam multiplexer (stream/beams.py) computes the rolling
+    # series for many beams in ONE stacked jit step and hands each
+    # beam's slice back here, so the trigger logic — holdback, valid
+    # trim, offregions, dedup — is literally this class's code and
+    # per-beam triggers stay byte-equal to an independent stream.
+
+    def feed_series(self, series: Optional[np.ndarray],
+                    nreal: int) -> List[Trigger]:
+        """Account `nreal` real spectra and absorb one externally
+        dedispersed series block ([numdms, blocklen // downsamp], or
+        None while the external carry is still priming).  Equivalent
+        to feed_block when `series` is what rolling.feed would have
+        produced for the same raw block."""
+        if self._finished:
+            raise RuntimeError("stream already finished")
+        self._nreal += int(nreal)
+        self.rolling.blocks_in += 1     # keep summary()/spans honest
+        return self._dedup(self._advance(series))
+
+    def finish_series(self,
+                      flush_series: List[np.ndarray]) -> List[Trigger]:
+        """finish() with externally computed flush blocks (what
+        rolling.flush would have produced from two zero blocks)."""
+        if self._finished:
+            return []
         self._finished = True
         cands: List[SPCandidate] = []
-        for series in self.rolling.flush(self.blocklen,
-                                         self.hdr.nchans):
+        for series in flush_series:
             cands.extend(self._advance(series))
         cands.extend(self._advance(None))   # drain the lag to `valid`
         for s in self.streams:

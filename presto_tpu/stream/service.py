@@ -91,9 +91,12 @@ class StreamService:
             "truncation, zero fill)")
         self._g_backlog = reg.gauge(
             "stream_backlog_blocks", "Ring blocks awaiting the search")
+        # `beam` label: "-" for a single-beam stream; the beam
+        # multiplexer (stream/beams.py) shares this family with one
+        # series per beam so latency is attributable per beam
         self._h_latency = reg.histogram(
             "stream_latency_seconds",
-            "Sample arrival -> trigger emitted", ("stream",),
+            "Sample arrival -> trigger emitted", ("stream", "beam"),
             buckets=LATENCY_BUCKETS)
 
     # ---- lifecycle ----------------------------------------------------
@@ -224,8 +227,8 @@ class StreamService:
         now = time.time()
         for tr in trigs:
             tr.latency_s = max(now - t_arrival, 0.0)
-            self._h_latency.labels(stream=self.stream_id).observe(
-                tr.latency_s)
+            self._h_latency.labels(stream=self.stream_id,
+                                   beam="-").observe(tr.latency_s)
             self._c_trigs.inc()
             self.events.emit("trigger", stream=self.stream_id,
                              **tr.to_json())
@@ -254,7 +257,8 @@ class StreamService:
         if self.engine is not None:
             out["engine"] = self.engine.summary()
             out["latency"] = self._h_latency.labels(
-                stream=self.stream_id).percentiles((50, 90, 99))
+                stream=self.stream_id,
+                beam="-").percentiles((50, 90, 99))
         return out
 
 

@@ -99,6 +99,7 @@ class RingBlockSource:
         self._dropped_blocks = 0
         self._dropped_spectra = 0
         self._stall_spectra = 0
+        self._stall_debt = 0        # stale spectra owed after a stall
         self._eof = False
         self._error: Optional[BaseException] = None
 
@@ -173,6 +174,26 @@ class RingBlockSource:
                              "ring-drop")
         self._ring.append(blk)
         self._cond.notify_all()
+
+    def note_stall_fill(self, n: int) -> None:
+        """Producer inserted `n` zero-fill spectra to hold cadence
+        through a stall: count them and remember the debt so the SAME
+        producer's late data is discarded on resume.  The debt lives
+        on the source — with many feeds in one process, one stalled
+        beam must never re-sync the wall clock (drop spectra) for
+        healthy feeds."""
+        with self._lock:
+            self._stall_spectra += n
+            self._stall_debt += n
+
+    def settle_stall_debt(self, navail: int) -> int:
+        """How many of `navail` just-arrived spectra are stale (their
+        slots were already zero-filled during this source's stall) and
+        must be discarded; decrements the debt by that amount."""
+        with self._lock:
+            drop = min(self._stall_debt, int(navail))
+            self._stall_debt -= drop
+            return drop
 
     def eof(self) -> None:
         """Producer is done: flush the partial block (zero-padded, the
@@ -257,6 +278,7 @@ class RingBlockSource:
                 "dropped_blocks": self._dropped_blocks,
                 "dropped_spectra": self._dropped_spectra,
                 "stall_spectra": self._stall_spectra,
+                "stall_debt": self._stall_debt,
                 "backlog_blocks": len(self._ring),
                 "eof": self._eof,
             }
@@ -339,8 +361,11 @@ def feed_stream(source: RingBlockSource, fileobj,
     A None read (only the socket adapter produces one, on its read
     timeout) is a producer stall: zero fill is inserted to hold the
     real-time cadence, quarantined as "stall", and the equal count of
-    late spectra is discarded when the feed resumes (stall_debt) so
-    the stream position stays aligned with the wall clock.
+    late spectra is discarded when the feed resumes so the stream
+    position stays aligned with the wall clock.  The debt is tracked
+    PER SOURCE (RingBlockSource.note_stall_fill / settle_stall_debt),
+    never in shared state: one stalled feed re-syncing the clock for
+    every healthy feed in the process would skew their gap synthesis.
 
     `faults` is the chaos seam (testing/chaos.StreamFaults): called as
     faults(spectra_so_far) before every read; it may sleep (stall),
@@ -352,7 +377,6 @@ def feed_stream(source: RingBlockSource, fileobj,
         dec = _SpectraDecoder(hdr)
         reader = (fileobj.read1 if hasattr(fileobj, "read1")
                   else fileobj.read)
-        stall_debt = 0
         pushed = 0
         while True:
             if faults is not None:
@@ -369,18 +393,16 @@ def feed_stream(source: RingBlockSource, fileobj,
                 source.push_spectra(
                     np.zeros((n, hdr.nchans), np.float32),
                     quarantine="stall")
-                with source._lock:
-                    source._stall_spectra += n
-                stall_debt += n
+                source.note_stall_fill(n)
                 pushed += n
                 continue
             if not data:
                 break
             spectra = dec.feed(data)
-            if stall_debt and len(spectra):
-                drop = min(stall_debt, len(spectra))
-                spectra = spectra[drop:]
-                stall_debt -= drop
+            if len(spectra):
+                drop = source.settle_stall_debt(len(spectra))
+                if drop:
+                    spectra = spectra[drop:]
             if len(spectra):
                 source.push_spectra(spectra)
                 pushed += len(spectra)

@@ -149,6 +149,14 @@ class TransientFaults:
                            % (getattr(job, "job_id", "?"), attempt))
 
 
+# Beam-multiplexer kill points (stream/beams.py fires these through
+# its FaultInjector hook).  The authoritative runtime copy lives next
+# to the code that fires them; re-exported here so chaos harnesses can
+# schedule beam kills without importing the stream layer, and pinned
+# against obs/taxonomy.BEAM_KILL_POINTS by obs_lint check 18.
+BEAM_KILL_POINTS = ("beam-tick", "beam-commit", "beam-handoff")
+
+
 class StreamFaults:
     """Live-feed fault schedule: the producer-side chaos seam for
     presto_tpu/stream (feed_stream / FileTailProducer call this as

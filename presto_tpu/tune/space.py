@@ -33,6 +33,9 @@ measure a candidate:
                       sub-stack pad-bucket scheme, measured on a
                       miniature stacked chain (stack -> batched rFFT
                       -> candidate-collection reduce)
+  beam_stack_size     beams per stacked rolling-dedisp dispatch in
+                      the beam multiplexer (stream/beams.py),
+                      measured on a miniature stacked rolling chain
 
 Families are device-agnostic declarations; ``tune.runner`` does the
 measuring and ``tune.db`` the remembering.  Every family has a tiny
@@ -401,6 +404,64 @@ def _stack_bench(shape, config):
 
 
 # ----------------------------------------------------------------------
+# beam_stack_size
+# ----------------------------------------------------------------------
+
+def _beam_stack_candidates(shape) -> List[dict]:
+    nbeams = int(shape.get("beams", 64))
+    stacks = shape.get("stacks") or (4, 8, 16, 32, 64)
+    return [{"stack": int(s)} for s in stacks
+            if int(s) <= nbeams]
+
+
+def _beam_stack_bench(shape, config):
+    """The beam multiplexer's stacked rolling-dedisp chain in
+    miniature: `beams` same-geometry feeds partitioned into groups of
+    the candidate stack size, each group one StackedRollingDedisp
+    whose fed block costs ONE dispatch (stream/beams.py).  Smaller
+    stacks mean more dispatches per tick; larger stacks mean bigger
+    compiled graphs and more device residency per dispatch.  Stacking
+    never changes per-beam floats (each beam is an independent
+    subgraph), so the figure of merit is pure chain wall time."""
+    from presto_tpu.stream.beams import StackedRollingDedisp
+    nbeams = int(shape.get("beams", 64))
+    nsub = int(shape.get("nsub", 8))
+    nchan = int(shape.get("nchan", 16))
+    numdms = int(shape.get("numdms", 4))
+    blocklen = int(shape.get("blocklen", 512))
+    nblocks = int(shape.get("nblocks", 4))
+    rng = np.random.default_rng(37)
+    chan_bins = np.sort(rng.integers(
+        0, blocklen // 4, size=nchan)).astype(np.int32)
+    chan_bins[0] = 0
+    dm_bins = np.sort(rng.integers(
+        0, blocklen // 4, size=(numdms, nsub)), axis=1).astype(np.int32)
+    dm_bins[:, 0] = 0
+    blocks = [rng.random((nbeams, blocklen, nchan))
+              .astype(np.float32) for _ in range(nblocks)]
+    stack = int(config["stack"])
+    groups = [list(range(lo, min(lo + stack, nbeams)))
+              for lo in range(0, nbeams, stack)]
+    # one roller per group, compiled once; fn resets the two-block
+    # carries so repeated calls measure steady-state dispatch cost,
+    # not recompilation
+    rollers = [StackedRollingDedisp(chan_bins, dm_bins, nsub)
+               for _ in groups]
+
+    def fn():
+        out = None
+        for roller in rollers:
+            roller._prev_raw = roller._prev_sub = None
+        for blk in blocks:
+            for roller, idxs in zip(rollers, groups):
+                series, _ = roller.feed(blk[idxs])
+                if series is not None:
+                    out = series
+        return out
+    return fn
+
+
+# ----------------------------------------------------------------------
 # plancache_bucket (modeled)
 # ----------------------------------------------------------------------
 
@@ -550,6 +611,22 @@ FAMILIES: Dict[str, Family] = {
             [{"jobs": 4, "numdms": 2, "n": 1 << 10,
               "stacks": (2, 4)}] if smoke else
             [{"jobs": 8, "numdms": 32, "n": 1 << 18}]),
+        available=_jax_ok,
+    ),
+    "beam_stack_size": Family(
+        name="beam_stack_size",
+        doc="Beams per stacked rolling-dedisp dispatch in the beam "
+            "multiplexer (stream/beams.py); identical per-beam "
+            "floats at any stack, pure chain wall time",
+        shape_key=lambda s: tune.GLOBAL_KEY,
+        candidates=_beam_stack_candidates,
+        bench=_beam_stack_bench,
+        shapes=lambda smoke: (
+            [{"beams": 4, "nchan": 8, "nsub": 4, "numdms": 2,
+              "blocklen": 128, "nblocks": 3, "stacks": (2, 4)}]
+            if smoke else
+            [{"beams": 64, "nchan": 64, "nsub": 16, "numdms": 16,
+              "blocklen": 4096, "nblocks": 6}]),
         available=_jax_ok,
     ),
     "plancache_bucket": Family(

@@ -1,0 +1,229 @@
+"""presto_tpu/stream/beams: the beam multiplexer.
+
+Pins the acceptance contract of the beam-mux PR:
+
+  * Stacked-step identity: StackedRollingDedisp produces bit-identical
+    per-beam series to N independent RollingDedisp carries (stacking
+    is a dispatch optimisation, never a numerics change).
+  * CoincidenceVeto: cross-beam clustering, k-of-N veto, frontier
+    holdback, dm_tol separation, and the k<=1 pass-through mode.
+  * Per-source stall debt: one stalled producer's debt never leaks
+    into a healthy sibling source.
+  * E2E byte-equality: the multiplexer's per-beam trigger sets equal
+    N independent presto-stream instances (veto off), with O(1)
+    device dispatches per tick and full-spectra accounting on burst
+    feeds (the assembler/tick state-race regression guard).
+  * Chaos: a replica killed mid-observation hands its beams off via
+    the ledger with zero lost and zero duplicated triggers.
+"""
+
+import os
+import sys
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir,
+                                "tools"))
+
+from presto_tpu.stream import StreamConfig
+from presto_tpu.stream.beams import CoincidenceVeto, StackedRollingDedisp
+from presto_tpu.stream.rolling import RollingDedisp, Trigger
+from presto_tpu.stream.source import RingBlockSource
+
+DT = 1e-3
+NCHAN = 16
+
+
+def _cfg():
+    return StreamConfig(lodm=10.0, dmstep=5.0, numdms=4, nsub=8,
+                        threshold=6.5, blocklen=4096,
+                        ring_capacity=64)
+
+
+def _feeds(nbeams, pulse_beams, seed=4):
+    import stream_loadgen
+    return stream_loadgen.make_beam_feeds(
+        nbeams, pulse_beams=pulse_beams, seed=seed, nchan=NCHAN,
+        dt=DT, seconds=16.0, npulses=2, nrfi=0, dm=20.0, amp=4.0)
+
+
+# ----------------------------------------------------------------------
+# Stacked rolling dedispersion: identity with independent carries
+# ----------------------------------------------------------------------
+
+class TestStackedRollingDedisp:
+    def test_bit_identical_to_per_beam_carries(self):
+        rng = np.random.default_rng(11)
+        beams, nchan, nsub, numdms, blocklen = 3, 8, 4, 5, 256
+        chan_bins = np.sort(rng.integers(
+            0, blocklen // 4, size=nchan)).astype(np.int32)
+        chan_bins[0] = 0
+        dm_bins = np.sort(rng.integers(
+            0, blocklen // 4, size=(numdms, nsub)),
+            axis=1).astype(np.int32)
+        dm_bins[:, 0] = 0
+        stacked = StackedRollingDedisp(chan_bins, dm_bins, nsub)
+        singles = [RollingDedisp(chan_bins, dm_bins, nsub)
+                   for _ in range(beams)]
+        emitted = 0
+        for _ in range(5):
+            blocks = rng.normal(0, 1, (beams, blocklen, nchan)
+                                ).astype(np.float32)
+            out, dispatched = stacked.feed(blocks)
+            refs = [s.feed(blocks[b])
+                    for b, s in enumerate(singles)]
+            if out is None:
+                assert all(r is None for r in refs)
+                continue
+            assert dispatched >= 1
+            emitted += 1
+            for b in range(beams):
+                np.testing.assert_array_equal(
+                    np.asarray(out[b]), np.asarray(refs[b]))
+        assert emitted >= 3     # two-block carry then steady state
+
+    def test_carry_needs_two_blocks(self):
+        chan_bins = np.zeros(4, np.int32)
+        dm_bins = np.zeros((2, 2), np.int32)
+        stacked = StackedRollingDedisp(chan_bins, dm_bins, 2)
+        blk = np.ones((2, 64, 4), np.float32)
+        assert stacked.feed(blk)[0] is None      # primes raw carry
+        assert stacked.feed(blk)[0] is None      # primes subband
+        assert stacked.feed(blk)[0] is not None  # steady state
+
+
+# ----------------------------------------------------------------------
+# Cross-beam coincidence veto
+# ----------------------------------------------------------------------
+
+def _trig(t, dm=20.0, sigma=8.0):
+    return Trigger(time=t, dm=dm, sigma=sigma, downfact=1,
+                   bin=int(t / DT))
+
+
+class TestCoincidenceVeto:
+    def test_pass_through_when_disabled(self):
+        assert not CoincidenceVeto(0).enabled
+        assert not CoincidenceVeto(1).enabled
+        assert CoincidenceVeto(2).enabled
+
+    def test_k_beam_cluster_vetoed_whole(self):
+        v = CoincidenceVeto(2, window_s=0.1)
+        v.add("beam-0", _trig(5.000, sigma=9.0))
+        v.add("beam-1", _trig(5.020, sigma=8.0))
+        v.add("beam-0", _trig(7.000))           # lone pulse survives
+        emit, vetoes = v.drain(frontier_s=100.0)
+        assert [b for b, _ in emit] == ["beam-0"]
+        assert emit[0][1].time == 7.0
+        assert len(vetoes) == 1
+        d = vetoes[0].to_json()
+        assert d["nbeams"] == 2
+        assert set(d["evidence"]) == {"beam-0", "beam-1"}
+        assert d["evidence"]["beam-0"]["sigma"] == 9.0
+
+    def test_same_beam_repeats_never_veto(self):
+        v = CoincidenceVeto(2, window_s=0.1)
+        v.add("beam-0", _trig(5.00))
+        v.add("beam-0", _trig(5.05))
+        emit, vetoes = v.drain(frontier_s=100.0)
+        assert len(emit) == 2 and not vetoes
+
+    def test_frontier_holds_open_windows(self):
+        v = CoincidenceVeto(2, window_s=0.5)
+        v.add("beam-0", _trig(5.0))
+        emit, vetoes = v.drain(frontier_s=5.2)   # window still open
+        assert emit == [] and vetoes == []
+        v.add("beam-1", _trig(5.3))              # late corroboration
+        emit, vetoes = v.drain(frontier_s=10.0)
+        assert emit == [] and len(vetoes) == 1
+
+    def test_final_drain_flushes_everything(self):
+        v = CoincidenceVeto(2, window_s=0.5)
+        v.add("beam-0", _trig(5.0))
+        emit, vetoes = v.drain(frontier_s=0.0, final=True)
+        assert len(emit) == 1 and not vetoes
+
+    def test_dm_tol_splits_clusters(self):
+        v = CoincidenceVeto(2, window_s=0.1, dm_tol=2.0)
+        v.add("beam-0", _trig(5.0, dm=20.0))
+        v.add("beam-1", _trig(5.01, dm=45.0))    # same time, far DM
+        emit, vetoes = v.drain(frontier_s=100.0)
+        assert len(emit) == 2 and not vetoes
+
+
+# ----------------------------------------------------------------------
+# Per-source stall debt (stream/source.py)
+# ----------------------------------------------------------------------
+
+class TestStallDebt:
+    def test_debt_settles_against_late_data_only(self):
+        src = RingBlockSource(capacity=8)
+        src.note_stall_fill(100)
+        assert src.stats()["stall_debt"] == 100
+        assert src.settle_stall_debt(60) == 60   # stale, discard
+        assert src.stats()["stall_debt"] == 40
+        assert src.settle_stall_debt(100) == 40  # only the remainder
+        assert src.stats()["stall_debt"] == 0
+        assert src.settle_stall_debt(50) == 0    # healthy data flows
+
+    def test_debt_is_per_source(self):
+        a, b = RingBlockSource(capacity=8), RingBlockSource(capacity=8)
+        a.note_stall_fill(64)
+        assert b.settle_stall_debt(64) == 0
+        assert b.stats()["stall_debt"] == 0
+        assert a.stats()["stall_debt"] == 64
+
+
+# ----------------------------------------------------------------------
+# E2E: byte-equality, O(1) dispatch, burst accounting
+# ----------------------------------------------------------------------
+
+class TestBeamMuxE2E:
+    def test_byte_equal_o1_dispatch_full_accounting(self, tmp_path):
+        import stream_loadgen
+        hdr, datas, truth, _ = _feeds(2, (0, 1))
+        cfg = _cfg()
+        ref = stream_loadgen._run_beam_reference(
+            str(tmp_path / "ref"), hdr, datas, cfg, 300.0)
+        mux = stream_loadgen._run_beam_mux(
+            str(tmp_path / "mux"), hdr, datas, cfg, 0, 0.1, None,
+            300.0)
+        assert mux["finished"] and mux["failed"] is None, mux
+        # byte-equality with the veto off: per-beam trigger payloads
+        for b in range(2):
+            beam = "beam-%d" % b
+            assert sorted(mux["per_beam"][beam]) == sorted(ref[beam])
+            assert len(ref[beam]) == len(truth)
+        # ONE stacked dispatch per tick, independent of beam count
+        assert mux["ticks"] >= 1
+        assert mux["dispatches"] <= mux["ticks"]
+        # burst-feed full-spectra accounting: the tick thread must
+        # consume every pushed spectrum even when the assembler runs
+        # many bundles ahead (the feed_state/pads regression)
+        for row in mux["summary"]["per_beam"]:
+            assert row["spectra"] == hdr.N, row
+            assert row["state"] == "done"
+            assert row["dropped_spectra"] == 0
+            assert row["stalled_spectra"] == 0
+
+
+# ----------------------------------------------------------------------
+# Chaos: replica kill mid-observation, beam hand-off exactly once
+# ----------------------------------------------------------------------
+
+@pytest.mark.chaos
+class TestBeamChaos:
+    def test_handoff_exactly_once(self, tmp_path):
+        import stream_chaos
+        res = stream_chaos.trial_beam_handoff(str(tmp_path / "h"))
+        assert res["ok"], res
+        assert res["committed_before_kill"] >= 1
+        assert res["replayed"] == res["committed_before_kill"]
+        assert res["byte_equal"] and res["no_duplicates"]
+
+    def test_stalled_beam_quarantined_not_fatal(self, tmp_path):
+        import stream_chaos
+        res = stream_chaos.trial_beam_stall(str(tmp_path / "s"))
+        assert res["ok"], res
+        assert res["quarantine"].get("stall", 0) > 0

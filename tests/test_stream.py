@@ -533,7 +533,8 @@ class TestStreamServiceE2E:
         assert lanes.labels(lane="deadline").value >= 1
         # latency histogram populated per trigger
         h = svc.obs.metrics.get("stream_latency_seconds")
-        assert h.labels(stream="stream-0").count == len(trigs)
+        assert h.labels(stream="stream-0",
+                        beam="-").count == len(trigs)
         svc.stop()
         prod.close()
 

@@ -21,6 +21,18 @@ exactly once.
                 blocks (drop-oldest), every shed block is quarantined
                 ("ring-drop") and counted, and no trigger duplicates.
 
+Three more against the beam multiplexer (stream/beams.py):
+
+  beam-stall      — one beam's feeder goes quiet: its lane degrades
+                    to quarantined gap fill, siblings keep ticking,
+                    late data is shed on resume.
+  beam-truncation — one beam's feed dies halfway: that lane flushes
+                    early while siblings run to completion.
+  beam-handoff    — a replica is killed at a beam-tick kill point
+                    mid-observation; a successor reaps it via the
+                    beam ledger and finishes the beams with zero
+                    lost and zero duplicated triggers.
+
 Writes the committed STREAM_CHAOS.json verdict:
 
   python tools/stream_chaos.py --out STREAM_CHAOS.json
@@ -217,16 +229,296 @@ def trial_ringdrop(workdir: str, seed: int = 3) -> dict:
                            for i, c in counts.items()}}
 
 
+# ----------------------------------------------------------------------
+# Beam-multiplexer trials (stream/beams.py): a stalled beam, a
+# truncated beam, and a replica killed mid-observation with beam
+# hand-off — each against the multi-beam contract: a sick beam never
+# stalls the tick or its siblings, every gap is quarantined per beam,
+# and hand-off re-emits nothing and loses nothing.
+# ----------------------------------------------------------------------
+
+def _beam_setup(workdir, nbeams, pulse_beams, seed, seconds=16.0,
+                npulses=3):
+    """Proven-sensitive beam geometry (see stream_loadgen): per-beam
+    ascending-order spectra plus the StreamConfig the mux and the
+    independent reference share."""
+    from presto_tpu.stream import StreamConfig
+    hdr, datas, t_signal, _ = stream_loadgen.make_beam_feeds(
+        nbeams, pulse_beams=pulse_beams, seed=seed, nchan=64,
+        dt=5e-4, seconds=seconds, npulses=npulses, nrfi=0)
+    cfg = StreamConfig(lodm=25.0, dmstep=5.0, numdms=9, nsub=32,
+                       threshold=7.0, blocklen=4096,
+                       ring_capacity=64)
+    return hdr, datas, t_signal, cfg
+
+
+def _beam_triggers(service):
+    """beam id -> [trigger events] from the service event log."""
+    out = {}
+    for ev in service.events.tail(100000):
+        if ev["kind"] == "trigger":
+            out.setdefault(ev["beam"], []).append(ev)
+    return out
+
+
+def trial_beam_stall(workdir: str, seed: int = 4) -> dict:
+    """One beam's feeder goes quiet mid-observation: the mux must
+    gap-fill that lane (quarantine reason "stall"), keep the tick
+    cadence for its siblings, and discard the late data on resume —
+    the healthy beam's pulses trigger exactly once throughout."""
+    from presto_tpu.serve.server import SearchService
+    from presto_tpu.stream import BeamMultiplexer, RingBlockSource
+
+    hdr, datas, truth, cfg = _beam_setup(workdir, 2, (0,), seed)
+    service = SearchService(os.path.join(workdir, "serve"),
+                            heartbeat_s=0.5)
+    service.start()
+    sources = [RingBlockSource(capacity=cfg.ring_capacity,
+                               policy=cfg.ring_policy)
+               for _ in datas]
+    # beam 0: full burst feed; beam 1: half its data, then silence
+    # until the mux has declared it a straggler
+    threading.Thread(target=stream_loadgen._push_beam,
+                     args=(sources[0], hdr, datas[0]),
+                     daemon=True).start()
+    half = (len(datas[1]) // (2 * cfg.blocklen)) * cfg.blocklen
+
+    def push_half():
+        sources[1].set_header(hdr)
+        for lo in range(0, half, 1024):
+            sources[1].push_spectra(datas[1][lo:lo + 1024])
+
+    threading.Thread(target=push_half, daemon=True).start()
+    mux = BeamMultiplexer(service, sources, cfg,
+                          qos_wait_s=0.25).start()
+    # wait for the straggler verdict (gap fill on beam 1)
+    deadline = time.time() + 240.0
+    while time.time() < deadline:
+        if (len(mux.lanes) == 2
+                and mux.lanes[1].stalled_spectra > 0):
+            break
+        time.sleep(0.05)
+    stalled = (len(mux.lanes) == 2
+               and mux.lanes[1].stalled_spectra > 0)
+
+    def push_rest():     # resume: this data is stale, must be shed
+        for lo in range(half, len(datas[1]), 1024):
+            sources[1].push_spectra(datas[1][lo:lo + 1024])
+        sources[1].eof()
+
+    threading.Thread(target=push_rest, daemon=True).start()
+    finished = mux.wait(240.0)
+    per_beam = _beam_triggers(service)
+    counts = _matched(per_beam.get("beam-0", []), truth)
+    lane1 = mux.lanes[1].health() if len(mux.lanes) == 2 else {}
+    alive = _scheduler_alive(service)
+    shed = (lane1.get("dropped_spectra", 0)
+            + lane1.get("stalled_spectra", 0))
+    ok = (finished and mux.failed is None and alive and stalled
+          and lane1.get("quarantine", {}).get("stall", 0) > 0
+          and shed > 0
+          and all(c == 1 for c in counts.values()))
+    service.stop()
+    return {"trial": "beam-stall", "ok": bool(ok),
+            "finished": bool(finished), "scheduler_alive": alive,
+            "stalled_spectra": lane1.get("stalled_spectra", 0),
+            "dropped_spectra": lane1.get("dropped_spectra", 0),
+            "quarantine": lane1.get("quarantine", {}),
+            "healthy_beam_hits": {round(t, 2): counts[i]
+                                  for i, t in enumerate(truth)}}
+
+
+def trial_beam_truncation(workdir: str, seed: int = 5) -> dict:
+    """One beam's feed dies halfway through: that lane EOFs and
+    flushes early while its siblings run to completion — pre-cut
+    pulses on the dead beam and every pulse on the healthy beam
+    trigger exactly once, with no duplicates anywhere."""
+    from presto_tpu.serve.server import SearchService
+    from presto_tpu.stream import BeamMultiplexer, RingBlockSource
+
+    hdr, datas, truth, cfg = _beam_setup(workdir, 2, (0, 1), seed)
+    service = SearchService(os.path.join(workdir, "serve"),
+                            heartbeat_s=0.5)
+    service.start()
+    sources = [RingBlockSource(capacity=cfg.ring_capacity,
+                               policy=cfg.ring_policy)
+               for _ in datas]
+    cut = len(datas[1]) // 2
+    threading.Thread(target=stream_loadgen._push_beam,
+                     args=(sources[0], hdr, datas[0]),
+                     daemon=True).start()
+    threading.Thread(target=stream_loadgen._push_beam,
+                     args=(sources[1], hdr, datas[1][:cut]),
+                     daemon=True).start()
+    mux = BeamMultiplexer(service, sources, cfg).start()
+    finished = mux.wait(240.0)
+    per_beam = _beam_triggers(service)
+    counts0 = _matched(per_beam.get("beam-0", []), truth)
+    counts1 = _matched(per_beam.get("beam-1", []), truth)
+    alive = _scheduler_alive(service)
+    cut_s = cut * hdr.tsamp
+    margin = 1.5    # dedispersion sweep + detrend/chunk holdback
+    expected1 = [i for i, t in enumerate(truth) if t < cut_s - margin]
+    states = [lane.state for lane in mux.lanes]
+    ok = (finished and mux.failed is None and alive
+          and states == ["done", "done"]
+          and all(counts0[i] == 1 for i in range(len(truth)))
+          and all(counts1[i] == 1 for i in expected1)
+          and all(counts1[i] == 0 for i, t in enumerate(truth)
+                  if t > cut_s)
+          and all(c <= 1 for c in counts1.values()))
+    service.stop()
+    return {"trial": "beam-truncation", "ok": bool(ok),
+            "finished": bool(finished), "scheduler_alive": alive,
+            "cut_at_s": round(cut_s, 2), "lane_states": states,
+            "healthy_beam_hits": {round(t, 2): counts0[i]
+                                  for i, t in enumerate(truth)},
+            "truncated_beam_hits": {round(t, 2): counts1[i]
+                                    for i, t in enumerate(truth)},
+            "expected_on_truncated": [round(truth[i], 2)
+                                      for i in expected1]}
+
+
+def trial_beam_handoff(workdir: str, seed: int = 6) -> dict:
+    """Replica A is killed at a beam-tick kill point mid-observation
+    (after committing early triggers to the beam ledger); replica B
+    reaps the dead host, adopts the leases, replays the feeds and
+    suppresses A's committed set.  The ledger's final per-beam
+    trigger sets must be byte-equal to an untouched independent
+    reference: zero lost, zero duplicated."""
+    from presto_tpu.serve.server import SearchService
+    from presto_tpu.stream import BeamMultiplexer, RingBlockSource
+    from presto_tpu.testing.chaos import FaultInjector
+
+    hdr, datas, truth, cfg = _beam_setup(workdir, 2, (0, 1), seed)
+    ref = stream_loadgen._run_beam_reference(
+        os.path.join(workdir, "ref"), hdr, datas, cfg, 240.0)
+    fleet = os.path.join(workdir, "fleet")
+    os.makedirs(fleet, exist_ok=True)
+
+    # replica A: the injector is armed only once the ledger holds a
+    # committed trigger, so the kill lands mid-observation — after a
+    # partial commit, before the feeds finish
+    service_a = SearchService(os.path.join(workdir, "replica-A"),
+                              heartbeat_s=0.5)
+    service_a.start()
+    faults = FaultInjector(kill_at="beam-tick", kill_after=1,
+                           mode="off")
+    sources_a = [RingBlockSource(capacity=cfg.ring_capacity,
+                                 policy=cfg.ring_policy)
+                 for _ in datas]
+    # gate each feed after 7 blocks: enough ticks for the first pulse
+    # to commit, with the rest of the observation still unpushed, so
+    # the armed kill is guaranteed to land mid-observation
+    gate = threading.Event()
+    hold = 7 * cfg.blocklen
+
+    def push_gated(source, data):
+        source.set_header(hdr)
+        for lo in range(0, len(data), 1024):
+            if lo >= hold:
+                gate.wait(240.0)
+            source.push_spectra(data[lo:lo + 1024])
+        source.eof()
+
+    for s, d in zip(sources_a, datas):
+        threading.Thread(target=push_gated, args=(s, d),
+                         daemon=True).start()
+    mux_a = BeamMultiplexer(service_a, sources_a, cfg,
+                            fleet_dir=fleet, host="replica-A",
+                            lease_ttl=5.0, heartbeat_ttl=1.0,
+                            faults=faults).start()
+
+    def _ledger_triggers():
+        try:
+            with open(os.path.join(fleet, "beams.json")) as f:
+                rows = json.load(f)["beams"]
+        except (OSError, ValueError, KeyError):
+            return 0
+        return sum(len(row.get("triggers") or [])
+                   for row in rows.values())
+
+    deadline = time.time() + 120.0
+    while _ledger_triggers() == 0 and time.time() < deadline:
+        time.sleep(0.05)
+    faults.mode = "raise"     # arm: the next beam tick dies
+    gate.set()                # release the rest of the feeds
+    while faults.fired is None and time.time() < deadline:
+        time.sleep(0.05)
+    killed = faults.fired is not None
+    # release A's (now headless) assembler/reader threads
+    mux_a._failed = mux_a._failed or RuntimeError("replica killed")
+    with open(os.path.join(fleet, "beams.json")) as f:
+        mid = json.load(f)["beams"]
+    a_committed = sum(len(row.get("triggers") or [])
+                      for row in mid.values())
+    service_a.stop()
+    time.sleep(1.5)      # let A's ledger heartbeat expire (ttl 1.0)
+
+    # replica B: adopt=True reaps A, leases the beams, replays
+    service_b = SearchService(os.path.join(workdir, "replica-B"),
+                              heartbeat_s=0.5)
+    service_b.start()
+    sources_b = [RingBlockSource(capacity=cfg.ring_capacity,
+                                 policy=cfg.ring_policy)
+                 for _ in datas]
+    for s, d in zip(sources_b, datas):
+        threading.Thread(target=stream_loadgen._push_beam,
+                         args=(s, hdr, d), daemon=True).start()
+    mux_b = BeamMultiplexer(service_b, sources_b, cfg,
+                            fleet_dir=fleet, host="replica-B",
+                            lease_ttl=5.0, heartbeat_ttl=1.0,
+                            adopt=True).start()
+    finished = mux_b.wait(240.0)
+    totals = mux_b.summary_totals()
+    alive = _scheduler_alive(service_b)
+    with open(os.path.join(fleet, "beams.json")) as f:
+        rows = json.load(f)["beams"]
+    ledger = {beam: sorted(json.dumps(t, sort_keys=True)
+                           for t in (row.get("triggers") or []))
+              for beam, row in rows.items()}
+    byte_equal = all(ledger.get(b, []) == sorted(ref[b])
+                    for b in ref)
+    no_dups = all(len(set(trigs)) == len(trigs)
+                  for trigs in ledger.values())
+    states = [row.get("state") for _, row in sorted(rows.items())]
+    ok = (killed and finished and mux_b.failed is None and alive
+          and a_committed >= 1
+          and totals["handoffs"] == len(datas)
+          and totals["replayed"] == a_committed
+          and byte_equal and no_dups
+          and states == ["done", "done"])
+    service_b.stop()
+    return {"trial": "beam-handoff", "ok": bool(ok),
+            "killed_at": faults.fired, "finished": bool(finished),
+            "scheduler_alive": alive,
+            "committed_before_kill": a_committed,
+            "handoffs": totals["handoffs"],
+            "replayed": totals["replayed"],
+            "byte_equal": bool(byte_equal),
+            "no_duplicates": bool(no_dups),
+            "ledger_states": states,
+            "ledger_triggers": {b: len(v)
+                                for b, v in sorted(ledger.items())},
+            "reference_triggers": {b: len(v)
+                                   for b, v in sorted(ref.items())}}
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(prog="stream_chaos")
     ap.add_argument("--out", type=str, default=None,
                     help="Write the verdict JSON here (the committed "
                          "STREAM_CHAOS.json artifact)")
     ap.add_argument("--trials", type=str,
-                    default="stall,truncation,ring-drop")
+                    default="stall,truncation,ring-drop,"
+                            "beam-stall,beam-truncation,"
+                            "beam-handoff")
     args = ap.parse_args(argv)
     runners = {"stall": trial_stall, "truncation": trial_truncation,
-               "ring-drop": trial_ringdrop}
+               "ring-drop": trial_ringdrop,
+               "beam-stall": trial_beam_stall,
+               "beam-truncation": trial_beam_truncation,
+               "beam-handoff": trial_beam_handoff}
     results = []
     for name in args.trials.split(","):
         workdir = tempfile.mkdtemp(prefix="streamchaos-")

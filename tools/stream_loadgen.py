@@ -20,8 +20,16 @@ The JSON report is the committed STREAM_r06.json artifact:
   python tools/stream_loadgen.py --mode paced --speed 8 \
       --out STREAM_r06.json
 
+With --beams N it instead verifies the beam multiplexer
+(stream/beams.py): per-beam trigger sets byte-equal to N independent
+presto-stream instances with the veto off, device-chain dispatches
+per tick O(1) in beam count, coincidence-veto precision/recall on
+correlated bursts vs single-beam pulses, and trigger-latency p99
+under an obs/slo.py objective as beams scale — the committed
+STREAM_r18.json artifact.
+
 Also importable: tests and tools/stream_chaos.py drive make_feed /
-run_trial in-process.
+run_trial / run_beam_trial in-process.
 """
 
 from __future__ import annotations
@@ -189,7 +197,7 @@ def run_trial(workdir: str, mode: str = "paced", speed: float = 8.0,
 
     lat = stream.summary().get("latency", {})
     hist = service.obs.metrics.get("stream_latency_seconds")
-    count = (hist.labels(stream=stream.stream_id).count
+    count = (hist.labels(stream=stream.stream_id, beam="-").count
              if hist is not None else 0)
     ok = (finished and stream.failed is None and not missed
           and not dupes and not unmatched and dm_ok and accounted
@@ -225,6 +233,293 @@ def run_trial(workdir: str, mode: str = "paced", speed: float = 8.0,
     return verdict
 
 
+# ----------------------------------------------------------------------
+# beam-multiplexer verdict mode (-beams N): the STREAM_r18.json
+# acceptance artifact
+# ----------------------------------------------------------------------
+
+def make_beam_feeds(nbeams: int, pulse_beams=(0,), seed: int = 0,
+                    nchan: int = 32, dt: float = 5e-4,
+                    seconds: float = 16.0, npulses: int = 2,
+                    nrfi: int = 2, dm: float = 45.0, amp: float = 3.0,
+                    rfi_amp: float = 3.5, width_s: float = 0.003,
+                    fch1: float = 400.0, foff: float = -1.0,
+                    noise_sigma: float = 2.0, t_margin: float = 3.0):
+    """(header, [per-beam spectra], t_signal, t_rfi): independent
+    noise per beam, `npulses` dispersed pulses injected ONLY into
+    `pulse_beams` (the astrophysical signal a coincidence veto must
+    keep), and `nrfi` correlated bursts injected into EVERY beam at
+    shared times (the broadband-RFI signature the veto must kill)."""
+    from presto_tpu.io import sigproc
+    from presto_tpu.models.inject import InjectParams, inject_pulsar
+    from presto_tpu.ops.dedispersion import delay_from_dm
+
+    N = int(seconds / dt)
+    freqs = (fch1 + foff * (nchan - 1)) + np.arange(nchan) * abs(foff)
+    sweep = float(delay_from_dm(dm, freqs.min())
+                  - delay_from_dm(dm, freqs.max()))
+    period = max(4096 * dt, (sweep + 12 * width_s + 0.4) * 1.05)
+    f = 1.0 / period
+    nev = npulses + nrfi
+    span = (seconds - 2 * t_margin) / max(nev, 1)
+    rng = np.random.default_rng(seed)
+    times = [t_margin + span * (i + 0.5)
+             + float(rng.uniform(-0.15, 0.15) * span)
+             for i in range(nev)]
+    t_signal, t_rfi = times[:npulses], times[npulses:]
+
+    def _inject(data, t0, a):
+        lo = max(int((t0 - 0.1) / dt), 0)
+        hi = min(int((t0 + sweep + 6 * width_s + 0.2) / dt), N)
+        p = InjectParams(f=f, dm=dm, amp=a, width=width_s * f,
+                         phase0=(-t0 * f) % 1.0)
+        data[lo:hi] = inject_pulsar(data[lo:hi], dt, freqs, p,
+                                    start_sec=lo * dt)
+
+    datas = []
+    for b in range(nbeams):
+        brng = np.random.default_rng(seed + 1000 * (b + 1))
+        data = brng.normal(10.0, noise_sigma,
+                           (N, nchan)).astype(np.float32)
+        if b in pulse_beams:
+            for t0 in t_signal:
+                _inject(data, t0, amp)
+        for t0 in t_rfi:
+            _inject(data, t0, rfi_amp)
+        # injection and push_spectra both speak ascending-frequency
+        # channel order (the reader seam normalizes wire order on
+        # decode), so the arrays go in as-built
+        datas.append(data)
+    hdr = sigproc.FilterbankHeader(
+        nbits=32, nchans=nchan, nifs=1, tsamp=dt, fch1=fch1,
+        foff=foff, tstart=60000.0, source_name="loadgen", N=N)
+    return hdr, datas, t_signal, t_rfi
+
+
+def _push_beam(source, hdr, data, chunk: int = 1024) -> None:
+    source.set_header(hdr)
+    for lo in range(0, len(data), chunk):
+        source.push_spectra(data[lo:lo + chunk])
+    source.eof()
+
+
+_STRIP = ("seq", "ts", "kind", "stream", "beam", "latency_s")
+
+
+def _payload(ev: dict) -> str:
+    return json.dumps({k: v for k, v in ev.items()
+                       if k not in _STRIP}, sort_keys=True)
+
+
+def _run_beam_mux(workdir: str, hdr, datas, cfg, coincidence_k: int,
+                  veto_window_s: float, dm_tol, timeout: float) -> dict:
+    """One in-process BeamMultiplexer pass over pre-decoded per-beam
+    spectra; returns per-beam trigger payloads, veto decisions, the
+    device-dispatch ledger, and the per-beam latency histograms."""
+    from presto_tpu.serve.server import SearchService
+    from presto_tpu.stream import BeamMultiplexer, RingBlockSource
+
+    service = SearchService(workdir, heartbeat_s=5.0)
+    service.start()
+    try:
+        sources = [RingBlockSource(capacity=cfg.ring_capacity,
+                                   policy=cfg.ring_policy)
+                   for _ in datas]
+        feeders = [threading.Thread(target=_push_beam,
+                                    args=(s, hdr, d), daemon=True)
+                   for s, d in zip(sources, datas)]
+        for t in feeders:
+            t.start()
+        mux = BeamMultiplexer(service, sources, cfg,
+                              coincidence_k=coincidence_k,
+                              veto_window_s=veto_window_s,
+                              dm_tol=dm_tol).start()
+        finished = mux.wait(timeout)
+        evs = service.events.tail(100000)
+        per_beam = {lane.beam_id: [] for lane in mux.lanes}
+        for ev in evs:
+            if ev["kind"] == "trigger":
+                per_beam[ev["beam"]].append(_payload(ev))
+        disp = service.obs.metrics.get("jax_dispatches_total")
+        dispatches = (disp.labels(kind="beam_dedisp").value
+                      if disp is not None else 0)
+        summary = mux.summary()
+        return {
+            "finished": bool(finished),
+            "failed": None if mux.failed is None
+            else "%s: %s" % (type(mux.failed).__name__, mux.failed),
+            "per_beam": per_beam,
+            "vetoes": [e for e in evs if e["kind"] == "beam-veto"],
+            "ticks": max(lane.ticks for lane in mux.lanes),
+            "dispatches": int(dispatches),
+            "latency": summary.get("latency", {}),
+            "summary": summary,
+        }
+    finally:
+        service.stop()
+
+
+def _run_beam_reference(workdir: str, hdr, datas, cfg,
+                        timeout: float) -> dict:
+    """N independent presto-stream instances on the same spectra: the
+    byte-equality reference the multiplexer must match."""
+    from presto_tpu.serve.server import SearchService
+    from presto_tpu.stream import RingBlockSource, StreamService
+
+    out = {}
+    for b, data in enumerate(datas):
+        service = SearchService(os.path.join(workdir, "ref-%d" % b),
+                                heartbeat_s=5.0)
+        service.start()
+        try:
+            source = RingBlockSource(capacity=cfg.ring_capacity,
+                                     policy=cfg.ring_policy)
+            feeder = threading.Thread(target=_push_beam,
+                                      args=(source, hdr, data),
+                                      daemon=True)
+            feeder.start()
+            stream = StreamService(service, source, cfg).start()
+            if not stream.wait(timeout) or stream.failed is not None:
+                raise RuntimeError(
+                    "reference stream %d did not finish cleanly: %r"
+                    % (b, stream.failed))
+            out["beam-%d" % b] = [
+                _payload(e) for e in service.events.tail(100000)
+                if e["kind"] == "trigger"]
+        finally:
+            service.stop()
+    return out
+
+
+def run_beam_trial(workdir: str, nbeams: int = 4,
+                   beam_counts=(2, 4), pulse_beams=(0,),
+                   coincidence_k: int = 0, veto_window_s: float = 0.1,
+                   seed: int = 0, seconds: float = 16.0,
+                   npulses: int = 2, nrfi: int = 2,
+                   nchan: int = 64, dt: float = 5e-4,
+                   dm: float = 45.0, numdms: int = 9,
+                   lodm: float = 25.0, dmstep: float = 5.0,
+                   nsub: int = 32, threshold: float = 7.0,
+                   blocklen: int = 4096, ring: int = 64,
+                   match_tol_s: float = 0.15,
+                   slo_latency_s: float = 30.0,
+                   timeout: float = 600.0) -> dict:
+    """The -beams verdict: (1) the multiplexer's per-beam trigger sets
+    are byte-equal to N independent presto-stream instances with the
+    veto off, (2) device-chain dispatches per tick are O(1) in beam
+    count, (3) the coincidence veto kills every correlated burst and
+    keeps every single-beam pulse (precision/recall), (4) trigger
+    latency p99 stays under an obs/slo.py-backed objective as beams
+    scale."""
+    from presto_tpu.obs.slo import SloSpec
+    from presto_tpu.stream import StreamConfig
+
+    k = coincidence_k or max(2, min(nbeams, 3))
+    hdr, datas, t_signal, t_rfi = make_beam_feeds(
+        nbeams, pulse_beams=pulse_beams, seed=seed, nchan=nchan,
+        dt=dt, seconds=seconds, npulses=npulses, nrfi=nrfi, dm=dm)
+    cfg = StreamConfig(lodm=lodm, dmstep=dmstep, numdms=numdms,
+                       nsub=nsub, threshold=threshold,
+                       blocklen=blocklen, ring_capacity=ring)
+
+    # (1) byte-equality at full beam count, veto off
+    ref = _run_beam_reference(os.path.join(workdir, "ref"),
+                              hdr, datas, cfg, timeout)
+    flat = _run_beam_mux(os.path.join(workdir, "mux-flat"),
+                         hdr, datas, cfg, 0, veto_window_s, None,
+                         timeout)
+    byte_equal = all(
+        sorted(flat["per_beam"].get("beam-%d" % b, []))
+        == sorted(ref["beam-%d" % b])
+        for b in range(nbeams))
+
+    # (2)+(4) the beams axis: dispatches/tick + latency p99 per count
+    spec = SloSpec(tenant="beams", objective=0.99,
+                   latency_s=slo_latency_s)
+    axis = []
+    for count in beam_counts:
+        count = min(int(count), nbeams)
+        run = (flat if count == nbeams else
+               _run_beam_mux(
+                   os.path.join(workdir, "mux-%d" % count), hdr,
+                   datas[:count], cfg, 0, veto_window_s, None,
+                   timeout))
+        lat = run["latency"]
+        p99 = max(float(p.get("p99") or 0.0)
+                  for p in lat.values()) if lat else None
+        axis.append({
+            "beams": count,
+            "finished": run["finished"],
+            "triggers": sum(len(v) for v in run["per_beam"].values()),
+            "ticks": run["ticks"],
+            "dispatches": run["dispatches"],
+            "dispatch_per_tick": round(
+                run["dispatches"] / max(run["ticks"], 1), 3),
+            "latency_p99_s": None if p99 is None else round(p99, 4),
+            "slo_ok": p99 is None or p99 <= spec.latency_s,
+        })
+    o1_dispatch = all(row["dispatch_per_tick"] <= 1.0 + 1e-9
+                      for row in axis)
+    slo_ok = all(row["slo_ok"] for row in axis)
+
+    # (3) coincidence veto: every correlated burst killed (recall),
+    # no single-beam pulse killed (precision of the kept set)
+    veto = _run_beam_mux(os.path.join(workdir, "mux-veto"),
+                         hdr, datas, cfg, k, veto_window_s, None,
+                         timeout)
+    veto_times = [float(v["time"]) for v in veto["vetoes"]]
+    rfi_killed = [t for t in t_rfi
+                  if any(abs(vt - t) <= match_tol_s
+                         for vt in veto_times)]
+    false_vetoes = [vt for vt in veto_times
+                    if not any(abs(vt - t) <= match_tol_s
+                               for t in t_rfi)]
+    kept = [json.loads(p) for ps in veto["per_beam"].values()
+            for p in ps]
+    signal_kept = [t for t in t_signal
+                   if any(abs(float(tr["time"]) - t) <= match_tol_s
+                          for tr in kept)]
+    rfi_leaked = [tr["time"] for tr in kept
+                  if any(abs(float(tr["time"]) - t) <= match_tol_s
+                         for t in t_rfi)]
+    recall = len(rfi_killed) / max(len(t_rfi), 1)
+    precision = (len(veto_times) - len(false_vetoes)) \
+        / max(len(veto_times), 1)
+    veto_ok = (recall == 1.0 and not false_vetoes
+               and len(signal_kept) == len(t_signal)
+               and not rfi_leaked)
+
+    ok = (byte_equal and o1_dispatch and slo_ok and veto_ok
+          and flat["finished"] and veto["finished"]
+          and flat["failed"] is None and veto["failed"] is None)
+    return {
+        "ok": bool(ok),
+        "beams": nbeams,
+        "pulse_beams": list(pulse_beams),
+        "pulses_injected": [round(t, 3) for t in t_signal],
+        "rfi_injected": [round(t, 3) for t in t_rfi],
+        "byte_equal": bool(byte_equal),
+        "o1_dispatch": bool(o1_dispatch),
+        "beams_axis": axis,
+        "slo": dict(spec.to_dict(), p99_ok=bool(slo_ok)),
+        "veto": {
+            "k": k,
+            "window_s": veto_window_s,
+            "decisions": len(veto_times),
+            "rfi_killed": len(rfi_killed),
+            "false_vetoes": [round(t, 3) for t in false_vetoes],
+            "rfi_leaked": [round(float(t), 3) for t in rfi_leaked],
+            "signal_kept": len(signal_kept),
+            "precision": round(precision, 3),
+            "recall": round(recall, 3),
+            "ok": bool(veto_ok),
+        },
+        "mux_totals": {kk: vv for kk, vv in
+                       flat["summary"].items()
+                       if isinstance(vv, (int, float, str))},
+    }
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(prog="stream_loadgen")
     ap.add_argument("--mode", choices=("paced", "burst"),
@@ -239,6 +534,14 @@ def main(argv=None) -> int:
     ap.add_argument("--numdms", type=int, default=9)
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--workdir", type=str, default=None)
+    ap.add_argument("--beams", "-beams", type=int, default=0,
+                    help="Beam-multiplexer verdict mode: byte-equality"
+                         " vs N independent streams, O(1) dispatch, "
+                         "coincidence veto precision/recall, p99 vs "
+                         "beam count (the STREAM_r18.json artifact)")
+    ap.add_argument("--coincidence", type=int, default=0,
+                    help="Veto threshold K for --beams (default: "
+                         "min(beams, 3))")
     ap.add_argument("--out", type=str, default=None,
                     help="Write the verdict JSON here (the committed "
                          "STREAM_r06.json artifact)")
@@ -246,10 +549,17 @@ def main(argv=None) -> int:
 
     import tempfile
     workdir = args.workdir or tempfile.mkdtemp(prefix="streamload-")
-    verdict = run_trial(workdir, mode=args.mode, speed=args.speed,
-                        seed=args.seed, seconds=args.seconds,
-                        npulses=args.pulses, nchan=args.nchan,
-                        dt=args.dt, dm=args.dm, numdms=args.numdms)
+    if args.beams > 0:
+        counts = sorted({max(2, args.beams // 2), args.beams})
+        verdict = run_beam_trial(workdir, nbeams=args.beams,
+                                 beam_counts=counts,
+                                 coincidence_k=args.coincidence,
+                                 seed=args.seed)
+    else:
+        verdict = run_trial(workdir, mode=args.mode, speed=args.speed,
+                            seed=args.seed, seconds=args.seconds,
+                            npulses=args.pulses, nchan=args.nchan,
+                            dt=args.dt, dm=args.dm, numdms=args.numdms)
     print(json.dumps(verdict, indent=1, sort_keys=True))
     if args.out:
         from presto_tpu.io.atomic import atomic_write_text
