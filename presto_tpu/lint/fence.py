@@ -46,6 +46,7 @@ LEDGER_MODULES = (
     "presto_tpu/pipeline/leaseledger.py",
     "presto_tpu/pipeline/shardledger.py",
     "presto_tpu/serve/jobledger.py",
+    "presto_tpu/serve/federation.py",
 )
 
 #: where direct mutations would be reachable from
@@ -56,7 +57,7 @@ PRIVATE_API = {"_save", "_load", "_commit_row", "_readmit",
 
 #: filename markers of ledger-owned state
 OWNED_MARKERS = ("jobs.json", "shards.json", "items.json",
-                 "result.json", ".hb-")
+                 "result.json", ".hb-", "fleets.json")
 
 WRITE_CALLS = {"atomic_write_text", "atomic_write_bytes",
                "os.replace", "os.rename"}

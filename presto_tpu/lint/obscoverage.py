@@ -1,4 +1,4 @@
-"""obs-coverage: the instrumentation-coverage contract (17 checks).
+"""obs-coverage: the instrumentation-coverage contract (19 checks).
 
 Formerly ``tools/obs_lint.py`` (a thin shim remains there for the
 historical entry point); now the fifth presto-lint family.  The
@@ -90,7 +90,19 @@ code path cannot ship silently:
      from a durable ledger, so every admission wave, yield decision,
      and paced preemption must land on telemetry a post-mortem can
      replay; a campaign code path without its vocabulary (or a stale
-     vocabulary entry) fails here.
+     vocabulary entry) fails here;
+  18. the beam multiplexer (stream/beams.py): BEAM_EVENTS /
+     BEAM_SPANS / BEAM_METRICS pinned BOTH directions (and as
+     subsets of their parent catalogs), plus the three-way
+     kill-point pin (taxonomy == beams.BEAM_KILL_POINTS ==
+     testing/chaos re-export);
+  19. the federation front door (serve/federation.py): FED_EVENTS /
+     FED_SPANS / FED_METRICS pinned BOTH directions (and as subsets
+     of their parent catalogs), plus the three-way kill-point pin
+     (taxonomy == federation.FED_KILL_POINTS == testing/chaos
+     re-export) — whole-fleet failover runs exactly while a site is
+     dying, so every placement, spill, re-admission, and fenced
+     zombie commit must land on telemetry a post-mortem can replay.
 
 Run via tools/presto_lint.py (exit-1 CLI over every family), the
 legacy tools/obs_lint.py shim, or tests/test_obs_lint.py.
@@ -221,7 +233,7 @@ def lint(root: Optional[str] = None) -> List[str]:
     serve_ok = (taxonomy.SERVE_EVENTS | taxonomy.FLEET_EVENTS
                 | taxonomy.DAG_EVENTS | taxonomy.SLO_EVENTS
                 | taxonomy.SUPERVISOR_EVENTS
-                | taxonomy.CAMPAIGN_EVENTS)
+                | taxonomy.CAMPAIGN_EVENTS | taxonomy.FED_EVENTS)
     emitted: Set[str] = set()
     for rel, src in sorted(serve_srcs.items()):
         kinds = set(EMIT_RE.findall(src))
@@ -230,8 +242,8 @@ def lint(root: Optional[str] = None) -> List[str]:
             problems.append(
                 "%s: event kind %r is not registered in "
                 "obs/taxonomy.SERVE_EVENTS, FLEET_EVENTS, "
-                "DAG_EVENTS, SLO_EVENTS, SUPERVISOR_EVENTS, or "
-                "CAMPAIGN_EVENTS" % (rel, k))
+                "DAG_EVENTS, SLO_EVENTS, SUPERVISOR_EVENTS, "
+                "CAMPAIGN_EVENTS, or FED_EVENTS" % (rel, k))
 
     # 4. every job lifecycle state announces itself (scoped to the
     # JobStatus class body: queue.py also defines the Lanes constants,
@@ -894,6 +906,86 @@ def lint(root: Optional[str] = None) -> List[str]:
     except Exception as e:  # pragma: no cover - import failure is a lint
         problems.append(
             "beam kill-point pin: could not import the runtime copies "
+            "(%s)" % e)
+
+    # 19. the federation front door (serve/federation.py):
+    # FED_EVENTS / FED_SPANS / FED_METRICS pinned BOTH directions (and
+    # as subsets of their parent catalogs), plus the three-way
+    # kill-point pin (taxonomy == federation.FED_KILL_POINTS ==
+    # testing/chaos re-export).  Whole-fleet failover runs exactly
+    # while a site is dying: which fleet held which placement, why a
+    # job spilled, when the epoch fenced a zombie commit — all of it
+    # must be reconstructable from fed_events.jsonl + spans + metrics
+    # alone.  The federation ledger declares its event kinds as EV_*
+    # class attributes (the leaseledger idiom, cf. checks 2b/10/18),
+    # which count as emitted.
+    try:
+        fed_src = _read("presto_tpu/serve/federation.py", root)
+    except OSError:
+        fed_src = ""
+    fd_events = set(EMIT_RE.findall(fed_src))
+    fd_events |= set(EVENT_ATTR_RE.findall(fed_src))
+    fd_events = {k for k in fd_events if k.startswith("fed-")}
+    fd_spans = {s for s in SPAN_RE.findall(fed_src)
+                if s.startswith("fed:")}
+    fd_metrics = {m for m in METRIC_RE.findall(fed_src)
+                  if m.startswith("fed_")}
+    fd_points = set(POINT_RE.findall(fed_src))
+    for k in sorted(taxonomy.FED_EVENTS - fd_events):
+        problems.append(
+            "obs/taxonomy.py: FED_EVENTS lists %r but "
+            "serve/federation.py never emits it" % k)
+    for k in sorted(fd_events - taxonomy.FED_EVENTS):
+        problems.append(
+            "serve/federation.py: event kind %r is not registered "
+            "in obs/taxonomy.FED_EVENTS" % k)
+    for s in sorted(taxonomy.FED_SPANS - taxonomy.SERVE_SPANS):
+        problems.append(
+            "obs/taxonomy.py: FED_SPANS lists %r which is not in "
+            "SERVE_SPANS" % s)
+    for s in sorted(taxonomy.FED_SPANS - fd_spans):
+        problems.append(
+            "obs/taxonomy.py: FED_SPANS lists %r but "
+            "serve/federation.py never opens it" % s)
+    for s in sorted(fd_spans - taxonomy.FED_SPANS):
+        problems.append(
+            "serve/federation.py: span %r is not registered in "
+            "obs/taxonomy.FED_SPANS" % s)
+    for name in sorted(taxonomy.FED_METRICS - taxonomy.METRICS):
+        problems.append(
+            "obs/taxonomy.py: FED_METRICS lists %r which is not in "
+            "METRICS" % name)
+    for name in sorted(taxonomy.FED_METRICS - fd_metrics):
+        problems.append(
+            "obs/taxonomy.py: FED_METRICS lists %r but "
+            "serve/federation.py never registers it" % name)
+    for name in sorted(fd_metrics - taxonomy.FED_METRICS):
+        problems.append(
+            "serve/federation.py: metric %r is not registered in "
+            "obs/taxonomy.FED_METRICS" % name)
+    for p in sorted(fd_points - taxonomy.FED_KILL_POINTS):
+        problems.append(
+            "serve/federation.py: kill point %r is not registered "
+            "in obs/taxonomy.FED_KILL_POINTS" % p)
+    for p in sorted(taxonomy.FED_KILL_POINTS - fd_points):
+        problems.append(
+            "obs/taxonomy.py: FED_KILL_POINTS lists %r but "
+            "serve/federation.py never fires it" % p)
+    try:
+        from presto_tpu.serve import federation as _fed_mod
+        from presto_tpu.testing import chaos as _fchaos_mod
+        if set(_fed_mod.FED_KILL_POINTS) != taxonomy.FED_KILL_POINTS:
+            problems.append(
+                "serve/federation.py: FED_KILL_POINTS disagrees "
+                "with obs/taxonomy.FED_KILL_POINTS")
+        if set(_fchaos_mod.FED_KILL_POINTS) \
+                != taxonomy.FED_KILL_POINTS:
+            problems.append(
+                "testing/chaos.py: FED_KILL_POINTS disagrees with "
+                "obs/taxonomy.FED_KILL_POINTS")
+    except Exception as e:  # pragma: no cover - import failure is a lint
+        problems.append(
+            "fed kill-point pin: could not import the runtime copies "
             "(%s)" % e)
     return problems
 

@@ -576,7 +576,8 @@ def scale_advice(backlog_buckets: Sequence[Optional[str]],
                  evals: Dict[str, dict],
                  ready_replicas: int,
                  cfg: Optional[ScaleConfig] = None,
-                 now: float = 0.0) -> dict:
+                 now: float = 0.0,
+                 campaign_remaining_s: float = 0.0) -> dict:
     """The advisory `/scale` signal: wanted replica count + reason.
 
     ``backlog_buckets`` is one entry per pending/leased ledger job
@@ -584,19 +585,27 @@ def scale_advice(backlog_buckets: Sequence[Optional[str]],
     expected device-seconds via the per-bucket execute cost model,
     divided by per-replica measured capacity and the target drain
     time; tenants with an active burn alert add SLO-debt pressure
-    (at least one replica above current ready).  Pure function —
-    a supervisor (or tools/fleet_chaos.py in reverse) can replay
-    every decision from telemetry alone."""
+    (at least one replica above current ready).
+    ``campaign_remaining_s`` is the running campaigns' projected
+    remaining-archive device-seconds (`CampaignDriver.project`) —
+    work the bounded-wave admission has not put in the ledger yet, so
+    the count-based backlog cannot see it; folding it in lets a
+    supervisor spin capacity up for an archive instead of chasing one
+    wave at a time.  Pure function — a supervisor (or
+    tools/fleet_chaos.py in reverse) can replay every decision from
+    telemetry alone."""
     cfg = cfg or ScaleConfig()
     means, global_mean = bucket_cost_model(rows)
     fallback = global_mean if global_mean is not None \
         else cfg.default_job_s
-    backlog_s = sum(means.get(str(b or ""), fallback)
-                    for b in backlog_buckets)
+    ledger_s = sum(means.get(str(b or ""), fallback)
+                   for b in backlog_buckets)
+    campaign_s = max(0.0, float(campaign_remaining_s))
+    backlog_s = ledger_s + campaign_s
     capacity = measured_capacity(rows, now, cfg,
                                  max(ready_replicas, 1))
     demand = 0
-    if backlog_buckets:
+    if backlog_buckets or campaign_s > 0.0:
         demand = int(math.ceil(
             backlog_s / (cfg.target_drain_s * capacity)))
     pressure = sorted(t for t, ev in (evals or {}).items()
@@ -609,11 +618,12 @@ def scale_advice(backlog_buckets: Sequence[Optional[str]],
         reason = ("slo-debt: %s burning error budget; "
                   "backlog %.1f device-s wants %d"
                   % (",".join(pressure), backlog_s, demand))
-    elif backlog_buckets:
-        reason = ("backlog %.1f device-s / (%.0fs drain x %.2f "
-                  "cap/replica) -> %d"
-                  % (backlog_s, cfg.target_drain_s, capacity,
-                     demand))
+    elif backlog_buckets or campaign_s > 0.0:
+        reason = ("backlog %.1f device-s (%.1f ledger + %.1f "
+                  "campaign) / (%.0fs drain x %.2f cap/replica) "
+                  "-> %d"
+                  % (backlog_s, ledger_s, campaign_s,
+                     cfg.target_drain_s, capacity, demand))
     else:
         reason = "idle: no backlog, no SLO pressure"
     return {
@@ -622,6 +632,8 @@ def scale_advice(backlog_buckets: Sequence[Optional[str]],
         "inputs": {
             "backlog_jobs": len(backlog_buckets),
             "backlog_device_seconds": round(backlog_s, 3),
+            "campaign_remaining_device_seconds": round(
+                campaign_s, 3),
             "per_replica_capacity": round(capacity, 4),
             "ready_replicas": int(ready_replicas),
             "target_drain_s": cfg.target_drain_s,

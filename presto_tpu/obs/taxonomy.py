@@ -279,6 +279,65 @@ CAMPAIGN_METRICS = frozenset({
     "campaign_preemptions_total",
 })
 
+#: federation event kinds — the many-fleets-behind-one-front-door
+#: vocabulary of serve/federation.py: fleet membership and liveness
+#: (the `LeaseLedger` core re-bound a third time, after DM shards and
+#: beams — now the *hosts* are whole fleets), priced placement,
+#: saturation spill-over, and the whole-fleet failover protocol
+#: (dead-fleet detection, re-admission of its uncommitted work on
+#: survivors, and the epoch fence that rejects a zombie fleet's late
+#: commit).  Enforced BOTH directions by obs-coverage check 19
+#: against serve/federation.py — the cross-site recovery path may
+#: neither go dark nor go stale.
+FED_EVENTS = frozenset({
+    "fed-fleet-join",
+    "fed-admit",
+    "fed-place",
+    "fed-commit",
+    "fed-readmit",
+    "fed-stale-commit",
+    "fed-fleet-dead",
+    "fed-epoch-bump",
+    "fed-spill",
+    "fed-push-error",
+    "fed-probe-error",
+    "fed-chaos-point",
+})
+
+#: federation span names (check 19, both directions, subset of
+#: SERVE_SPANS): the front door's admission spans, each priced
+#: placement decision, and each whole-fleet failover pass
+FED_SPANS = frozenset({
+    "fed:submit",
+    "fed:dag-submit",
+    "fed:place",
+    "fed:failover",
+})
+
+#: federation metrics (check 19, both directions, subset of METRICS):
+#: the liveness gauge pair plus admission/spill/failover counters —
+#: the one-level-up mirror of the fleet_* recovery counters
+FED_METRICS = frozenset({
+    "fed_fleets_alive",
+    "fed_epoch",
+    "fed_submissions_total",
+    "fed_spills_total",
+    "fed_readmits_total",
+    "fed_stale_commits_total",
+    "fed_commits_total",
+})
+
+#: federation chaos kill points — the seams serve/federation.py fires
+#: through its FaultInjector hook (`self._point(...)`); the runtime
+#: copy is serve/federation.FED_KILL_POINTS (re-exported by
+#: testing/chaos.py) and check 19 pins all three copies to each other
+FED_KILL_POINTS = frozenset({
+    "fleet-dead",
+    "pre-readmit",
+    "post-readmit",
+    "zombie-fleet-commit",
+})
+
 #: streaming-layer event kinds — every `events.emit("<kind>", ...)`
 #: in presto_tpu/stream/ (enforced both directions by obs_lint check
 #: 7: the live trigger path may not emit unregistered kinds, and the
@@ -375,6 +434,10 @@ SERVE_SPANS = frozenset({
     "campaign:pulse",
     "campaign:admit",
     "campaign:preempt",
+    "fed:submit",
+    "fed:dag-submit",
+    "fed:place",
+    "fed:failover",
 })
 
 #: discovery-DAG event kinds — the dependency-aware job-graph
@@ -632,6 +695,15 @@ METRICS = frozenset({
     "campaign_outstanding",
     "campaign_yield_factor",
     "campaign_preemptions_total",
+    # federation front door (serve/federation.py); pinned both
+    # directions by obs-coverage check 19 via FED_METRICS
+    "fed_fleets_alive",
+    "fed_epoch",
+    "fed_submissions_total",
+    "fed_spills_total",
+    "fed_readmits_total",
+    "fed_stale_commits_total",
+    "fed_commits_total",
     # streaming search (presto_tpu/stream); every stream_* name here
     # must be registered by the stream layer (obs_lint check 7)
     "stream_blocks_total",

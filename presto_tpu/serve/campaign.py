@@ -63,6 +63,7 @@ docs/ROBUSTNESS.md for the failure model.
 from __future__ import annotations
 
 import json
+import math
 import os
 import re
 import time
@@ -88,6 +89,11 @@ OBS_DONE = "done"
 OBS_FAILED = "failed"
 
 TERMINAL = (OBS_DONE, OBS_FAILED)
+
+#: smoothing factor for the settle-throughput EWMAs (rate and
+#: latency) that size waves — recent pulses dominate, but one noisy
+#: settle burst cannot swing the budget by itself
+EWMA_ALPHA = 0.3
 
 _ID_RE = re.compile(r"[^A-Za-z0-9_.-]+")
 
@@ -139,13 +145,56 @@ def load_campaign(fleetdir: str, campaign_id: str) -> Optional[dict]:
         return None
 
 
+def fleet_remaining_device_seconds(fleetdir: str,
+                                   usage_rows,
+                                   now: Optional[float] = None
+                                   ) -> float:
+    """Every running campaign's projected remaining-archive
+    device-seconds, summed — the term the `/scale` advisory folds
+    into its backlog so a supervisor sees the whole archive, not just
+    the currently-admitted wave (`CampaignDriver.project` is the
+    per-campaign version; this is the fleet fold of the same math).
+
+    Pure read: campaign ledgers + the usage rows the caller already
+    holds.  A campaign with no settled observation yet is un-priced
+    and contributes 0.0 (the admitted wave is still visible to the
+    count-based backlog, so nothing is hidden — the projection just
+    has no cost model until the first settle lands)."""
+    total = 0.0
+    for campaign_id in list_campaigns(fleetdir):
+        doc = load_campaign(fleetdir, campaign_id)
+        if doc is None or doc.get("state") != "running":
+            continue
+        dags = {str(r.get("dag_id") or ""): obs_id
+                for obs_id, r in doc["observations"].items()}
+        ds_by_obs: Dict[str, float] = {}
+        for urow in usage_rows:
+            obs_id = dags.get(str(urow.get("dag") or ""))
+            if obs_id is None:
+                continue
+            ex = float((urow.get("phases") or {}).get("execute")
+                       or 0.0)
+            ds_by_obs[obs_id] = ds_by_obs.get(obs_id, 0.0) + ex
+        settled = [o for o, r in doc["observations"].items()
+                   if r["state"] in TERMINAL]
+        if not settled:
+            continue
+        remaining = len(doc["observations"]) - len(settled)
+        mean_obs = (sum(ds_by_obs.get(o, 0.0) for o in settled)
+                    / len(settled))
+        total += mean_obs * remaining
+    return total
+
+
 @dataclass
 class CampaignConfig:
     """Knobs of one campaign (persisted into the ledger at create so
     a resumed driver needs nothing but the fleet dir + id)."""
     fleetdir: str
     campaign_id: str
-    wave_size: int = 4            # max DAGs outstanding at once
+    wave_size: int = 4            # outstanding-DAG ceiling; measured
+                                  # settle throughput sizes waves
+                                  # below it (see _wave_budget)
     tenant: str = "campaign"      # the backfill lane's tenant name
     weight: float = 0.1           # configured WRR weight (low: backfill)
     priority: int = 50            # worse than interactive default 10
@@ -350,8 +399,54 @@ class CampaignDriver:
             row["counts"] = dict(view.get("counts") or {})
             settled.append(obs_id)
         if settled:
+            self._observe_settles(doc, settled, now)
             self._save(doc)
         return settled
+
+    # presto-lint: holds(_lock)
+    def _observe_settles(self, doc: dict, settled: List[str],
+                         now: float) -> None:
+        """Fold this pulse's settles into the throughput EWMAs that
+        size waves: settle rate (obs/s between settle-bearing pulses)
+        and admit→settle latency (s/obs).  Persisted in the campaign
+        ledger by the caller's save, so a resumed driver sizes its
+        first wave from the dead driver's measurements."""
+        last = float(doc.get("last_settle_ts")
+                     or doc.get("created", now))
+        dt = max(now - last, 1e-6)
+        rate_sample = len(settled) / dt
+        lat_samples = [
+            max(now - float(doc["observations"][o].get("admitted_at")
+                            or now), 1e-6)
+            for o in settled]
+        lat_sample = sum(lat_samples) / len(lat_samples)
+        prev_rate = doc.get("ewma_settle_rate")
+        prev_lat = doc.get("ewma_settle_latency_s")
+        doc["ewma_settle_rate"] = (
+            rate_sample if prev_rate is None
+            else EWMA_ALPHA * rate_sample
+            + (1.0 - EWMA_ALPHA) * float(prev_rate))
+        doc["ewma_settle_latency_s"] = (
+            lat_sample if prev_lat is None
+            else EWMA_ALPHA * lat_sample
+            + (1.0 - EWMA_ALPHA) * float(prev_lat))
+        doc["last_settle_ts"] = now
+
+    @staticmethod
+    def _wave_budget(doc: dict) -> int:
+        """The measured wave bound: Little's-law concurrency (settle
+        rate × admit→settle latency — the in-flight level the fleet
+        actually sustains) rounded up, clamped to [1, wave_size].
+        The configured ``wave_size`` constant is the ceiling and the
+        pre-measurement default — until the first settle lands there
+        is no throughput sample, so the bound starts at the constant
+        and adapts from evidence only."""
+        cap = max(int(doc["wave_size"]), 1)
+        rate = float(doc.get("ewma_settle_rate") or 0.0)
+        latency = float(doc.get("ewma_settle_latency_s") or 0.0)
+        if rate <= 0.0 or latency <= 0.0:
+            return cap
+        return min(max(int(math.ceil(rate * latency)), 1), cap)
 
     # presto-lint: holds(_lock)
     def _admit_wave(self, doc: dict, now: float) -> List[str]:
@@ -365,7 +460,9 @@ class CampaignDriver:
         # already count as outstanding, so replaying them never
         # exceeds the wave bound — and they MUST replay even when the
         # budget is full, or a driver killed mid-wave would stall.
-        budget = int(doc["wave_size"]) - self._outstanding(doc)
+        # The bound itself is measured (settle-throughput EWMAs via
+        # Little's law), with the wave_size constant as ceiling.
+        budget = self._wave_budget(doc) - self._outstanding(doc)
         pending = [o for o in sorted(doc["observations"])
                    if doc["observations"][o]["state"] == OBS_PENDING]
         recovering = [o for o in sorted(doc["observations"])
@@ -480,7 +577,8 @@ class CampaignDriver:
                              campaign=self.cfg.campaign_id,
                              wave=int(doc.get("waves", 0)),
                              observations=admitted,
-                             outstanding=self._outstanding(doc))
+                             outstanding=self._outstanding(doc),
+                             wave_budget=self._wave_budget(doc))
             self.obs.event("campaign-wave-admit",
                            campaign=self.cfg.campaign_id)
         self._update_yield(doc, now)
@@ -527,6 +625,10 @@ class CampaignDriver:
             "state": doc["state"],
             "tenant": doc["tenant"],
             "wave_size": doc["wave_size"],
+            "wave_budget": self._wave_budget(doc),
+            "ewma_settle_rate": doc.get("ewma_settle_rate"),
+            "ewma_settle_latency_s": doc.get(
+                "ewma_settle_latency_s"),
             "waves": int(doc.get("waves", 0)),
             "observations": len(doc["observations"]),
             "counts": counts,

@@ -118,6 +118,7 @@ from typing import Dict, List, Optional
 from urllib.parse import urlparse, parse_qs
 
 from presto_tpu.obs import fleetagg, slo
+from presto_tpu.serve import campaign
 from presto_tpu.serve.events import EventLog
 from presto_tpu.serve.jobledger import (DEFAULT_TENANT, JobLedger,
                                         TenantQuotaExceeded)
@@ -792,11 +793,17 @@ class FleetRouter:
                     if w["alerting"]:
                         alerts.append((tenant, w["window"], w))
             # capacity clamps to ready NON-DRAINING replicas: a
-            # draining one is leaving and must not mask pressure
+            # draining one is leaving and must not mask pressure;
+            # running campaigns' projected remaining-archive
+            # device-seconds ride along so the advisory prices the
+            # whole archive, not just the admitted wave
+            campaign_s = campaign.fleet_remaining_device_seconds(
+                self.cfg.fleetdir, rows, now=now)
             advice = slo.scale_advice(
                 self._backlog_buckets(), rows, evals,
                 len(self.serving_replicas()),
-                cfg=self._scale_cfg, now=now)
+                cfg=self._scale_cfg, now=now,
+                campaign_remaining_s=campaign_s)
             wanted = advice["wanted_replicas"]
             span.set_attr("tenants", len(evals))
             span.set_attr("wanted_replicas", wanted)

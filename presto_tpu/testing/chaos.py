@@ -156,6 +156,14 @@ class TransientFaults:
 # against obs/taxonomy.BEAM_KILL_POINTS by obs_lint check 18.
 BEAM_KILL_POINTS = ("beam-tick", "beam-commit", "beam-handoff")
 
+# Federation kill points (serve/federation.py fires these through its
+# FaultInjector hook).  The authoritative runtime copy lives next to
+# the code that fires them; re-exported here so chaos harnesses can
+# kill whole fleets without importing the serve layer, and pinned
+# against obs/taxonomy.FED_KILL_POINTS by obs_lint check 19.
+FED_KILL_POINTS = ("fleet-dead", "pre-readmit", "post-readmit",
+                   "zombie-fleet-commit")
+
 
 class StreamFaults:
     """Live-feed fault schedule: the producer-side chaos seam for

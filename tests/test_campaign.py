@@ -670,3 +670,102 @@ def test_report_campaign_convergence(tmp_path, monkeypatch):
     assert conv[-1]["projected_total_device_seconds"] \
         == pytest.approx(total)
     assert collect_campaign(str(tmp_path), "ghost") is None
+
+
+# ----------------------------------------------------------------------
+# measured wave sizing (ISSUE 19 satellite): settle-throughput EWMAs
+# replace the wave_size constant, which stays as floor/ceiling
+# ----------------------------------------------------------------------
+
+def test_wave_budget_defaults_to_constant_then_adapts():
+    doc = {"wave_size": 8}
+    # pre-measurement: the configured constant
+    assert CampaignDriver._wave_budget(doc) == 8
+    # Little's law: 0.5 obs/s sustained at 4 s/obs -> 2 in flight
+    doc["ewma_settle_rate"] = 0.5
+    doc["ewma_settle_latency_s"] = 4.0
+    assert CampaignDriver._wave_budget(doc) == 2
+    # the constant is the ceiling...
+    doc["ewma_settle_rate"] = 100.0
+    assert CampaignDriver._wave_budget(doc) == 8
+    # ...and one observation is the floor
+    doc["ewma_settle_rate"] = 1e-4
+    doc["ewma_settle_latency_s"] = 1e-4
+    assert CampaignDriver._wave_budget(doc) == 1
+
+
+def test_observe_settles_seeds_then_folds_ewma():
+    from presto_tpu.serve.campaign import EWMA_ALPHA
+    doc = {"created": 900.0, "wave_size": 4,
+           "observations": {"o1": {"admitted_at": 990.0},
+                            "o2": {"admitted_at": 980.0}}}
+    CampaignDriver._observe_settles(None, doc, ["o1", "o2"], 1000.0)
+    # the first settle-bearing pulse seeds the EWMAs directly
+    assert doc["ewma_settle_rate"] == pytest.approx(2 / 100.0)
+    assert doc["ewma_settle_latency_s"] == pytest.approx(15.0)
+    assert doc["last_settle_ts"] == 1000.0
+    # later pulses fold in at alpha against the previous estimate
+    doc["observations"]["o3"] = {"admitted_at": 1005.0}
+    CampaignDriver._observe_settles(None, doc, ["o3"], 1010.0)
+    assert doc["ewma_settle_rate"] == pytest.approx(
+        EWMA_ALPHA * (1 / 10.0) + (1.0 - EWMA_ALPHA) * 0.02)
+    assert doc["ewma_settle_latency_s"] == pytest.approx(
+        EWMA_ALPHA * 5.0 + (1.0 - EWMA_ALPHA) * 15.0)
+
+
+def test_wave_sizing_measured_persisted_and_resumable(tmp_path):
+    drv = _driver(tmp_path, wave_size=3)
+    try:
+        drv.create(_manifest(6))
+        _run_to_done(drv, drv.ledger)
+    finally:
+        drv.close()
+    doc = load_campaign(str(tmp_path), "camp")
+    assert doc["ewma_settle_rate"] > 0.0
+    assert doc["ewma_settle_latency_s"] > 0.0
+    assert 1 <= CampaignDriver._wave_budget(doc) <= 3
+    # a resumed driver sizes its first wave from the dead driver's
+    # measurements: the EWMAs live in the ledger, not driver memory
+    drv2 = _driver(tmp_path, wave_size=3)
+    try:
+        st = drv2.status()
+        assert st["wave_budget"] == CampaignDriver._wave_budget(doc)
+        assert st["ewma_settle_rate"] \
+            == pytest.approx(doc["ewma_settle_rate"])
+        assert st["ewma_settle_latency_s"] \
+            == pytest.approx(doc["ewma_settle_latency_s"])
+    finally:
+        drv2.close()
+
+
+def test_fleet_remaining_device_seconds_projection(tmp_path):
+    from presto_tpu.serve.campaign import (CAMPAIGN_VERSION,
+                                           fleet_remaining_device_seconds)
+
+    def _write(cid, doc):
+        path = ledger_path(str(tmp_path), cid)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        with open(path, "w") as f:
+            json.dump(dict(doc, version=CAMPAIGN_VERSION,
+                           campaign_id=cid), f)
+
+    _write("c1", {"state": "running", "observations": {
+        "o1": {"state": "done", "dag_id": "c1.o1"},
+        "o2": {"state": "pending", "dag_id": "c1.o2"},
+        "o3": {"state": "pending", "dag_id": "c1.o3"}}})
+    rows = [{"dag": "c1.o1", "phases": {"execute": 2.0}},
+            {"dag": "c1.o1", "phases": {"execute": 1.0}},
+            {"dag": "elsewhere", "phases": {"execute": 50.0}}]
+    # one settled obs cost 3.0 device-seconds; two remain -> 6.0
+    assert fleet_remaining_device_seconds(str(tmp_path), rows) \
+        == pytest.approx(6.0)
+    # an unpriced campaign (nothing settled) contributes zero
+    _write("c2", {"state": "running", "observations": {
+        "p1": {"state": "pending", "dag_id": "c2.p1"}}})
+    assert fleet_remaining_device_seconds(str(tmp_path), rows) \
+        == pytest.approx(6.0)
+    # a finished campaign has no remaining archive
+    _write("c3", {"state": "done", "observations": {
+        "q1": {"state": "done", "dag_id": "c3.q1"}}})
+    assert fleet_remaining_device_seconds(str(tmp_path), rows) \
+        == pytest.approx(6.0)

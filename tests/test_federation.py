@@ -1,0 +1,359 @@
+"""Federation (ISSUE 19): the fleet liveness + placement ledger
+(LeaseLedger re-bound a third time), device-second placement pricing
+with the documented uniform fallback and data-locality discount,
+spill-over past saturated fleets, whole-fleet failover through the
+epoch fence (a zombie fleet's late commit is rejected), and the
+federated observability folds — /slo burn rates and /fleet/metrics
+must EQUAL the single-fleet computation on merged windows."""
+
+import json
+import os
+import random
+import time
+from types import SimpleNamespace
+
+import pytest
+
+from presto_tpu.obs import Observability, ObsConfig, fleetagg, slo
+from presto_tpu.obs.metrics import MetricsRegistry
+from presto_tpu.serve.federation import (FederationConfig,
+                                         FederationRouter,
+                                         FedLedger, FedStaleCommit,
+                                         FleetMember, parse_fleet)
+from presto_tpu.serve.jobledger import JobLedger
+from presto_tpu.serve.usage import UsageLedger
+from presto_tpu.testing.chaos import FaultInjector
+
+
+def _obs():
+    return Observability(ObsConfig(enabled=True,
+                                   service="presto-fed"))
+
+
+class FakePush:
+    """Records pushes; fleets in `shed` answer 429, fleets in `down`
+    are unreachable — the member-router wire protocol without HTTP."""
+
+    def __init__(self, shed=(), down=()):
+        self.shed = set(shed)
+        self.down = set(down)
+        self.pushed = []
+
+    def __call__(self, member, iid, kind, spec):
+        self.pushed.append((member.name, iid))
+        if member.name in self.down:
+            return "unreachable", {"error": "down"}
+        if member.name in self.shed:
+            return "shed", {"retry_after": 0.5}
+        return "ok", {}
+
+
+def _fed(tmp_path, names=("A", "B"), injector=None, **kw):
+    members = []
+    for i, name in enumerate(names):
+        fleetdir = str(tmp_path / name / "fleet")
+        os.makedirs(fleetdir, exist_ok=True)
+        members.append(FleetMember(name=name, fleetdir=fleetdir))
+    kw.setdefault("heartbeat_ttl", 5.0)
+    cfg = FederationConfig(feddir=str(tmp_path / "fed"),
+                           fleets=members,
+                           fault_injector=injector, **kw)
+    return FederationRouter(cfg, obs=_obs())
+
+
+def _keep_alive(fed, names, now):
+    for name in names:
+        fed.fedledger.heartbeat(name, fed.fedledger.epoch, now=now)
+
+
+# ----------------------------------------------------------------------
+# FedLedger: the LeaseLedger core re-bound to fleets
+# ----------------------------------------------------------------------
+
+def test_fedledger_place_and_commit_roundtrip(tmp_path):
+    led = FedLedger(str(tmp_path / "fed"))
+    led.join("A")
+    led.admit("it-1", "job", {"rawfiles": ["x"]}, "default", "bkt")
+    assert led.placements()["it-1"]["state"] == "pending"
+    lease = led.place("it-1", "A", ttl=60.0, now=100.0)
+    assert lease is not None
+    # placing again while leased is the idempotent-resume None
+    assert led.place("it-1", "A", ttl=60.0, now=101.0) is None
+    staged = str(tmp_path / ".staged.json")
+    os.makedirs(str(tmp_path / "out"), exist_ok=True)
+    final = str(tmp_path / "out" / "it-1.json")
+    with open(staged, "w") as f:
+        f.write("{}\n")
+    led.complete(lease, "A", {final: staged}, now=102.0)
+    row = led.placements()["it-1"]
+    assert row["state"] == "done" and row["owner"] == "A"
+    assert os.path.exists(final) and not os.path.exists(staged)
+
+
+def test_fedledger_reap_readmits_and_fences_zombie(tmp_path):
+    led = FedLedger(str(tmp_path / "fed"))
+    led.join("A", now=0.0)
+    led.heartbeat("A", led.epoch, now=0.0)
+    led.admit("it-1", "job", {}, "default", None)
+    lease = led.place("it-1", "A", ttl=600.0, now=1.0)
+    report = led.reap(5.0, now=60.0)     # heartbeat long gone
+    assert "A" in report.dead_hosts
+    assert report.bumped and "it-1" in report.redone
+    assert led.placements()["it-1"]["state"] == "pending"
+    # the dead fleet's late commit dies on the epoch fence
+    staged = str(tmp_path / ".staged.json")
+    with open(staged, "w") as f:
+        f.write("{}\n")
+    with pytest.raises(FedStaleCommit):
+        led.complete(lease, "A",
+                     {str(tmp_path / "it-1.json"): staged},
+                     now=61.0)
+    assert not os.path.exists(str(tmp_path / "it-1.json"))
+
+
+# ----------------------------------------------------------------------
+# placement pricing: the ladder, the fallback, the locality discount
+# ----------------------------------------------------------------------
+
+def test_price_fleet_uniform_fallback_then_usage(tmp_path):
+    fed = _fed(tmp_path, default_job_s=7.0)
+    a = fed.cfg.fleets[0]
+    # cold fleet, no fingerprint: the documented uniform fallback
+    assert fed.price_fleet(a, "bkt") == (7.0, "uniform")
+    # committed usage rows promote the price up the ladder
+    ul = UsageLedger(a.fleetdir, enabled=True)
+    for i in range(3):
+        ul.append({"job_id": "j%d" % i, "state": "done",
+                   "bucket": "bkt", "tenant": "default",
+                   "ts": 100.0 + i,
+                   "phases": {"execute": 2.0, "total": 2.5}})
+    price, source = fed.price_fleet(a, "bkt")
+    assert source == "usage-bucket" and price == pytest.approx(2.0)
+    # a bucket this fleet never ran prices at its median cost
+    price, source = fed.price_fleet(a, "other-bkt")
+    assert source == "usage-median" and price == pytest.approx(2.0)
+
+
+def test_candidates_prefer_local_then_spill_past_saturated(
+        tmp_path):
+    datadir = tmp_path / "data"
+    os.makedirs(datadir, exist_ok=True)
+    beam = str(datadir / "beam.fil")
+    fed = _fed(tmp_path, locality_discount=0.5)
+    fed.cfg.fleets[0].data_roots = (str(datadir),)
+    now = time.time()
+    spec = {"rawfiles": [beam]}
+    cands = fed.candidates(None, spec, now)
+    assert [c["fleet"] for c in cands] == ["A", "B"]
+    assert cands[0]["local"] and not cands[1]["local"]
+    assert cands[0]["effective_s"] == pytest.approx(
+        cands[1]["effective_s"] * 0.5)
+    # a saturated local fleet sorts behind an unsaturated sibling
+    fed._shed_until["A"] = now + 60.0
+    cands = fed.candidates(None, spec, now)
+    assert [c["fleet"] for c in cands] == ["B", "A"]
+    assert cands[1]["saturated"]
+
+
+def test_submit_spills_to_sibling_when_fleet_sheds(tmp_path):
+    fed = _fed(tmp_path)
+    push = FakePush(shed={"A"})
+    fed._push = push
+    out = fed.submit({"job_id": "j1", "rawfiles": ["x"]})
+    assert out["placement"]["fleet"] == "B"
+    # the walk tried A (price order) first, then spilled
+    assert [f for f, _ in push.pushed] == ["A", "B"]
+    assert fed.obs.metrics.get("fed_spills_total").value >= 1
+    kinds = [e["kind"] for e in fed.events.tail(50)]
+    assert "fed-spill" in kinds
+    # the shed mark now routes follow-ups straight to the sibling
+    out2 = fed.submit({"job_id": "j2", "rawfiles": ["x"]})
+    assert out2["placement"]["fleet"] == "B"
+
+
+# ----------------------------------------------------------------------
+# whole-fleet failover: fleet death as replica death one level up
+# ----------------------------------------------------------------------
+
+def _run_job_on(fleetdir, iid, state="done"):
+    """Play one member fleet's scheduler: lease the pushed job and
+    commit it through the fleet's own job ledger."""
+    led = JobLedger(fleetdir)
+    led.join("r1")
+    if led.view(iid) is None:
+        led.admit({"rawfiles": ["x"]}, job_id=iid)
+    lease = led.lease("r1", ttl=60.0)
+    assert lease is not None and lease.item_id == iid
+    if state == "done":
+        led.complete(lease, "r1", {})
+    else:
+        led.fail_terminal(lease, "r1", "boom")
+    return led
+
+
+def test_whole_fleet_death_readmits_on_survivor(tmp_path):
+    injector = FaultInjector(mode="off")
+    fed = _fed(tmp_path, injector=injector)
+    push = FakePush()
+    fed._push = push
+    t0 = time.time()
+    out = fed.submit({"job_id": "j1", "rawfiles": ["x"]})
+    victim = out["placement"]["fleet"]
+    survivor = "B" if victim == "A" else "A"
+    # the victim's heartbeat goes silent; the survivor stays fresh
+    t1 = t0 + fed.cfg.heartbeat_ttl + 1.0
+    _keep_alive(fed, [survivor], t1)
+    report = fed.failover(now=t1)
+    assert victim in report["dead_fleets"]
+    assert "j1" in report["readmitted"]
+    row = fed.fedledger.placements()["j1"]
+    assert row["owner"] == survivor and row["redos"] == 1
+    assert fed.fedledger.epoch >= 1
+    assert {"fleet-dead", "pre-readmit", "post-readmit"} \
+        <= set(injector.points_seen)
+    assert fed.obs.metrics.get("fed_readmits_total").value >= 1
+    # the survivor runs it; the pump lands the federated commit
+    member = fed._members[survivor]
+    _run_job_on(member.fleetdir, "j1")
+    _keep_alive(fed, [survivor], t1)
+    fed.pump(now=t1)
+    res = fed.result("j1")
+    assert res is not None and res["fleet"] == survivor
+    assert fed.fedledger.placements()["j1"]["state"] == "done"
+
+
+def test_zombie_fleet_late_commit_is_fenced(tmp_path):
+    injector = FaultInjector(mode="off")
+    fed = _fed(tmp_path, injector=injector)
+    fed._push = FakePush()
+    t0 = time.time()
+    out = fed.submit({"job_id": "j1", "rawfiles": ["x"]})
+    victim = out["placement"]["fleet"]
+    survivor = "B" if victim == "A" else "A"
+    # the victim's replica holds the job when the fleet is lost
+    vled = JobLedger(fed._members[victim].fleetdir)
+    vled.join("r1")
+    vled.admit({"rawfiles": ["x"]}, job_id="j1")
+    vlease = vled.lease("r1", ttl=600.0)
+    t1 = t0 + fed.cfg.heartbeat_ttl + 1.0
+    _keep_alive(fed, [survivor], t1)
+    fed.failover(now=t1)
+    _run_job_on(fed._members[survivor].fleetdir, "j1")
+    _keep_alive(fed, [survivor], t1)
+    fed.pump(now=t1)
+    assert fed.result("j1")["fleet"] == survivor
+    committed = fed.obs.metrics.get("fed_commits_total").value
+    # the partitioned fleet finishes late — the textbook zombie
+    vled.complete(vlease, "r1", {})
+    _keep_alive(fed, [survivor], t1)
+    fed.pump(now=t1)
+    assert "zombie-fleet-commit" in injector.points_seen
+    assert fed.obs.metrics.get("fed_stale_commits_total").value >= 1
+    assert fed.obs.metrics.get("fed_commits_total").value \
+        == committed
+    # the journaled result is untouched: exactly once, on the
+    # survivor
+    assert fed.result("j1")["fleet"] == survivor
+
+
+def test_remote_terminal_failure_is_terminal_not_bounced(tmp_path):
+    fed = _fed(tmp_path)
+    fed._push = FakePush()
+    out = fed.submit({"job_id": "j1", "rawfiles": ["x"]})
+    fleet = out["placement"]["fleet"]
+    _run_job_on(fed._members[fleet].fleetdir, "j1", state="failed")
+    now = time.time()
+    _keep_alive(fed, ["A", "B"], now)
+    fed.pump(now=now)
+    row = fed.fedledger.placements()["j1"]
+    assert row["state"] == "failed"
+    assert "failed" in row.get("failed_why", "")
+
+
+# ----------------------------------------------------------------------
+# federated folds == single-fleet computation on merged windows
+# ----------------------------------------------------------------------
+
+def _usage_row(rng, jid, now):
+    good = rng.random() < 0.8
+    total = rng.uniform(0.1, 20.0)
+    return {"job_id": jid, "tenant": "default",
+            "state": "done" if good else "failed",
+            "ts": now - rng.uniform(0.0, 7200.0),
+            "bucket": rng.choice(("b1", "b2")),
+            "phases": {"execute": total * 0.8, "total": total}}
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_federated_burn_rates_equal_merged_window_math(tmp_path,
+                                                       seed):
+    """Property: for ANY split of usage rows over member fleets, the
+    federated /slo burn rates equal `slo.evaluate` run flat on the
+    concatenated rows — merge_states then evaluate_state commutes
+    with evaluating the union."""
+    rng = random.Random(seed)
+    fed = _fed(tmp_path)
+    now = time.time()
+    spec = slo.parse_spec("default:0.95")
+    all_rows = []
+    ledgers = [UsageLedger(m.fleetdir, enabled=True)
+               for m in fed.cfg.fleets]
+    for m in fed.cfg.fleets:
+        slo.save_specs(m.fleetdir, [spec])
+    for i in range(rng.randint(5, 60)):
+        row = _usage_row(rng, "j%d" % i, now)
+        all_rows.append(row)
+        rng.choice(ledgers).append(row)
+    view = fed.slo_view(now)
+    assert view["tenants"]["default"] \
+        == slo.evaluate(spec, all_rows, now)
+
+
+@pytest.mark.parametrize("seed", [3, 4])
+def test_fed_metrics_fold_equals_flat_snapshot_merge(tmp_path,
+                                                     seed):
+    """Property: the federated /fleet/metrics fold (per-fleet
+    aggregate, then merge across fleets) equals one flat
+    `merge_states` over every replica snapshot — including replicas
+    on heterogeneous devices whose histogram bucket layouts differ."""
+    rng = random.Random(seed)
+    fed = _fed(tmp_path)
+    now = time.time()
+    layouts = {"A": (0.1, 1.0, 10.0), "B": (0.5, 5.0)}
+    states = {}
+    for m in fed.cfg.fleets:
+        for r in range(rng.randint(1, 3)):
+            reg = MetricsRegistry()
+            h = reg.histogram("job_e2e_seconds", "e2e", ("phase",),
+                              buckets=layouts[m.name])
+            for _ in range(rng.randint(1, 40)):
+                h.labels(phase="total").observe(
+                    rng.uniform(0.01, 30.0))
+            reg.counter("fleet_jobs_committed_total", "c").inc(
+                rng.randint(0, 9))
+            name = "%s-r%d" % (m.name, r)
+            fleetagg.publish_snapshot(
+                m.fleetdir, name, SimpleNamespace(metrics=reg),
+                now=now)
+            states[name] = reg.export_state()
+    fed_view = fed.fed_metrics(now)
+    flat = fleetagg.to_json(fleetagg.merge_states(states))
+    assert fed_view["metrics"] == flat
+    # mixed layouts merged across fleets: counts survive, the
+    # unmergeable bucket counts are dropped, percentiles remain
+    fam = fed_view["metrics"]["job_e2e_seconds"]
+    (series,) = fam["series"]
+    assert series["count"] == sum(
+        s["families"]["job_e2e_seconds"]["series"][0]["count"]
+        for s in states.values())
+
+
+def test_fleets_view_and_parse_fleet(tmp_path):
+    fed = _fed(tmp_path)
+    view = fed.fleets_view(time.time())
+    assert set(view["fleets"]) == {"A", "B"}
+    assert all(f["alive"] for f in view["fleets"].values())
+    assert {c["source"] for c in view["pricing"]} == {"uniform"}
+    m = parse_fleet("west:/data/west:http://h:9001")
+    assert (m.name, m.fleetdir, m.url) \
+        == ("west", "/data/west", "http://h:9001")

@@ -598,3 +598,74 @@ def test_fleet_report_renders_everything(tmp_path, capsys):
     assert doc["job_e2e"]["total"]["count"] >= 1
     assert doc["traces"]["orphan_spans"] == 0
     assert doc["flightrec"][0]["replica"] == "r2"
+
+
+# ----------------------------------------------------------------------
+# heterogeneous device fingerprints (ISSUE 19 satellite): mixed
+# bucket layouts merge, and the federated two-level fold equals the
+# flat single-registry computation
+# ----------------------------------------------------------------------
+
+def test_mixed_fingerprint_bucket_layouts_merge(tmp_path):
+    """Replicas on different device generations export the same
+    histogram family with different bucket layouts; the merge keeps
+    counts/sums/samples (percentiles stay exact) and drops only the
+    unmergeable bucket counts."""
+    fast = MetricsRegistry()
+    slow = MetricsRegistry()
+    ref = MetricsRegistry()
+    href = ref.histogram("job_e2e_seconds", "e2e", ("phase",))
+    for reg, vals in ((fast, (0.05, 0.2, 0.4)),
+                      (slow, (3.0, 9.0))):
+        buckets = (0.1, 1.0) if reg is fast else (5.0, 50.0)
+        h = reg.histogram("job_e2e_seconds", "e2e", ("phase",),
+                          buckets=buckets)
+        for v in vals:
+            h.labels(phase="total").observe(v)
+            href.labels(phase="total").observe(v)
+    merged = fleetagg.merge_states(
+        {"tpu-v4-r1": fast.export_state(),
+         "tpu-v2-r1": slow.export_state()})
+    (series,) = merged["job_e2e_seconds"]["series"].values()
+    assert series["count"] == 5
+    assert series["bucket_counts"] is None      # layouts disagree
+    assert fleetagg.percentiles(series["samples"]) \
+        == href.labels(phase="total").percentiles()
+
+
+@pytest.mark.parametrize("seed", [11, 12, 13])
+def test_two_level_federated_fold_equals_flat_merge(seed):
+    """Property: merging per-fleet merged states across fleets (the
+    federation's /fleet/metrics fold) equals one flat merge over
+    every replica snapshot — for ANY assignment of samples to
+    fleets/replicas, including mixed bucket layouts per fleet."""
+    rng = random.Random(seed)
+    layouts = {"A": (0.1, 1.0, 10.0), "B": (0.5, 5.0)}
+    fleets = {"A": {}, "B": {}}
+    ref = MetricsRegistry()
+    href = ref.histogram("latency_seconds", "lat", ("name",))
+    for fleet in fleets:
+        for r in range(rng.randint(1, 3)):
+            reg = MetricsRegistry()
+            h = reg.histogram("latency_seconds", "lat", ("name",),
+                              buckets=layouts[fleet])
+            for _ in range(rng.randint(1, 50)):
+                v = rng.uniform(0.001, 60.0)
+                h.labels(name="job_total").observe(v)
+                href.labels(name="job_total").observe(v)
+            reg.counter("fleet_jobs_committed_total", "c").inc(
+                rng.randint(0, 5))
+            fleets[fleet]["%s-r%d" % (fleet, r)] = \
+                reg.export_state()
+    per_fleet = [fleetagg.merge_states(states)
+                 for _, states in sorted(fleets.items())]
+    fed = {}
+    for m in per_fleet:
+        fed = fleetagg.merge(fed, m)
+    flat = fleetagg.merge_states(
+        {name: st for states in fleets.values()
+         for name, st in states.items()})
+    assert fleetagg.to_json(fed) == fleetagg.to_json(flat)
+    (series,) = fed["latency_seconds"]["series"].values()
+    assert fleetagg.percentiles(series["samples"]) \
+        == href.labels(name="job_total").percentiles()

@@ -721,3 +721,37 @@ def test_obs_lint_check14_clean_and_detects_drift(tmp_path,
         frozenset(taxonomy.SLO_METRICS | {"slo_ghost_total"}))
     problems = obscoverage.lint()
     assert any("slo_ghost_total" in p for p in problems)
+
+
+def test_scale_advice_folds_campaign_remaining_term():
+    """ISSUE 19 satellite: the /scale advisory prices a running
+    campaign's projected remaining-archive device-seconds into its
+    backlog, so a supervisor sees the whole archive, not just the
+    admitted wave."""
+    cfg = slo.ScaleConfig(target_drain_s=10.0, min_replicas=1,
+                          max_replicas=16)
+    now = 1000.0
+    rows = [_row(job="j%d" % i, ts=now - 30.0, execute=5.0)
+            for i in range(10)]
+    # an empty ledger backlog with a campaign remainder still scales
+    adv = slo.scale_advice([], rows, {}, 2, cfg, now,
+                           campaign_remaining_s=60.0)
+    assert adv["wanted_replicas"] > 1
+    assert adv["inputs"]["campaign_remaining_device_seconds"] \
+        == pytest.approx(60.0)
+    assert adv["inputs"]["backlog_device_seconds"] \
+        == pytest.approx(60.0)
+    assert "campaign" in adv["reason"]
+    # the terms sum: ledger backlog + campaign remainder
+    both = slo.scale_advice(["b"] * 4, rows, {}, 2, cfg, now,
+                            campaign_remaining_s=40.0)
+    assert both["inputs"]["backlog_device_seconds"] \
+        == pytest.approx(4 * 5.0 + 40.0)
+    only_ledger = slo.scale_advice(["b"] * 4, rows, {}, 2, cfg, now)
+    assert both["wanted_replicas"] >= only_ledger["wanted_replicas"]
+    assert only_ledger["inputs"][
+        "campaign_remaining_device_seconds"] == 0.0
+    # no backlog, no campaign: still idle
+    idle = slo.scale_advice([], rows, {}, 2, cfg, now,
+                            campaign_remaining_s=0.0)
+    assert idle["wanted_replicas"] == 1
