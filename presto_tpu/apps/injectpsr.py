@@ -50,6 +50,10 @@ def build_parser():
     p.add_argument("-torb", type=float, default=0.0,
                    help="Time of periastron passage, s")
     p.add_argument("-o", type=str, required=True, help="Output .fil")
+    p.add_argument("-truth-out", dest="truth_out", type=str,
+                   default=None,
+                   help="Ground-truth sidecar path (default: "
+                        "<out>_injected.json; 'none' disables)")
     p.add_argument("infile")
     return p
 
@@ -80,7 +84,11 @@ def main(argv=None) -> int:
         params.amp = amp_for_snr(args.snr, params, N, args.noise, nchan)
     else:
         raise SystemExit("one of -amp / -snr is required")
-    inject_into_filterbank(args.infile, args.o, params)
+    write_truth = (args.truth_out or "").lower() != "none"
+    inject_into_filterbank(
+        args.infile, args.o, params,
+        truth_out=args.truth_out if write_truth else None,
+        write_truth=write_truth)
     print("injectpsr: %s + (f=%.6g Hz, DM=%.2f, amp=%.4g%s%s) -> %s"
           % (args.infile, f, args.dm, params.amp,
              ", orbit" if orbit else "",

@@ -43,6 +43,21 @@ def build_parser():
                         "(the GBT350_drift_search.py flow)")
     p.add_argument("-orign", type=int, default=None,
                    help="with --driftprep: samples per pointing")
+    p.add_argument("-triage", action="store_true",
+                   help="learned candidate triage (presto_tpu/triage):"
+                        " rank the heuristic fold selection with the "
+                        "trained scorer and fold only the top budget; "
+                        "degrades to the unchanged heuristic when no "
+                        "valid weights file exists")
+    p.add_argument("-triage-budget", dest="triage_budget", type=int,
+                   default=None,
+                   help="with -triage: fold at most this many "
+                        "candidates (default: the heuristic count)")
+    p.add_argument("-triage-weights", dest="triage_weights", type=str,
+                   default=None,
+                   help="with -triage: weights file (default: "
+                        "$PRESTO_TPU_TRIAGE_WEIGHTS or the user "
+                        "cache)")
     p.add_argument("rawfiles", nargs="+")
     return p
 
@@ -73,6 +88,9 @@ def main(argv=None) -> int:
             rfi_time=args.rfitime, zaplist=args.zaplist,
             fold_top=args.foldtop, singlepulse=not args.nosp,
             skip_rfifind=args.norfi)
+    if args.triage:
+        cfg.triage = {"budget": args.triage_budget,
+                      "weights": args.triage_weights}
     if args.driftprep:
         # drift-scan mode: prep the pointings, then one survey per
         # pointing in its own subdirectory (each pointing is an

@@ -629,6 +629,58 @@ def collect_campaign(fleetdir: str, campaign_id: str) \
         "convergence": series,
         "events": events,
         "by_kind": by_kind,
+        "triage": _collect_campaign_triage(fleetdir, doc),
+    }
+
+
+def _collect_campaign_triage(fleetdir: str, doc: dict) \
+        -> Optional[dict]:
+    """Injection-recall roll-up across a campaign's triage nodes —
+    read-only, from each DAG's committed `<dag_id>-triage` result
+    summary (None when no observation ran triage).  Recall is only
+    aggregated over observations whose traffic carried ground-truth
+    sidecars (models/inject.py)."""
+    scored = avoided = heur = folds = 0
+    injected = recovered = 0
+    n_triage = n_fallback = n_truth = 0
+    for oid, row in sorted(doc.get("observations", {}).items()):
+        dag_id = str(row.get("dag_id") or "")
+        if not dag_id:
+            continue
+        path = os.path.join(fleetdir, "jobs", dag_id + "-triage",
+                            "result.json")
+        try:
+            with open(path) as f:
+                res = json.load(f).get("result") or {}
+        except (OSError, ValueError):
+            continue
+        if res.get("mode") == "triage":
+            n_triage += 1
+        else:
+            n_fallback += 1
+        scored += int(res.get("scored") or 0)
+        avoided += int(res.get("folds_avoided") or 0)
+        heur += int(res.get("heuristic_folds") or 0)
+        folds += int(res.get("folds") or 0)
+        if res.get("injected"):
+            n_truth += 1
+            injected += int(res["injected"])
+            recovered += int(res.get("recovered") or 0)
+    if not (n_triage + n_fallback):
+        return None
+    return {
+        "observations": n_triage + n_fallback,
+        "learned": n_triage,
+        "fallback": n_fallback,
+        "scored": scored,
+        "heuristic_folds": heur,
+        "folds": folds,
+        "folds_avoided": avoided,
+        "fold_reduction": (heur / folds) if folds else None,
+        "with_truth": n_truth,
+        "injected": injected,
+        "recovered": recovered,
+        "recall": (recovered / injected) if injected else None,
     }
 
 
@@ -670,6 +722,25 @@ def render_campaign(info: dict, file=None) -> None:
              "%.1fs" % proj["eta_s"]
              if proj.get("eta_s") is not None else "?",
              proj["throughput_obs_per_s"]))
+
+    tri = info.get("triage")
+    if tri:
+        w()
+        w("Triage (learned fold selection, %d/%d observation(s) "
+          "learned, %d fallback):"
+          % (tri["learned"], tri["observations"], tri["fallback"]))
+        w("  scored %d   folds %d of %d heuristic  (%d avoided%s)"
+          % (tri["scored"], tri["folds"], tri["heuristic_folds"],
+             tri["folds_avoided"],
+             ", %.2fx reduction" % tri["fold_reduction"]
+             if tri.get("fold_reduction") else ""))
+        if tri["with_truth"]:
+            w("  injection recall %s  (%d/%d injected pulsars kept, "
+              "%d obs with truth sidecars)"
+              % ("%.3f" % tri["recall"]
+                 if tri.get("recall") is not None else "?",
+                 tri["recovered"], tri["injected"],
+                 tri["with_truth"]))
 
     series = info.get("convergence") or []
     if series:

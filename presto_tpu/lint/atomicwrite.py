@@ -41,7 +41,7 @@ CHECK = "atomic-write"
 #: io-layer writers that are covered transitively)
 SCOPES = ("presto_tpu/pipeline/", "presto_tpu/serve/",
           "presto_tpu/obs/", "presto_tpu/stream/",
-          "presto_tpu/tune/")
+          "presto_tpu/tune/", "presto_tpu/triage/")
 
 WRITE_MODES = ("w", "wb", "w+", "wb+", "wt")
 

@@ -55,9 +55,13 @@ SCOPES = ("presto_tpu/serve/", "presto_tpu/pipeline/", "tools/")
 PRIVATE_API = {"_save", "_load", "_commit_row", "_readmit",
                "_items", "_fence_why", "_reject_stale"}
 
-#: filename markers of ledger-owned state
+#: filename markers of ledger-owned state; the triage weights file is
+#: owned by presto_tpu/triage/model.py (schema-versioned, atomic,
+#: defensive load) — a direct write from serve// pipeline// tools/
+#: would be exactly the poisoned-model path ROBUSTNESS.md rules out
 OWNED_MARKERS = ("jobs.json", "shards.json", "items.json",
-                 "result.json", ".hb-", "fleets.json")
+                 "result.json", ".hb-", "fleets.json",
+                 "triage_weights.json")
 
 WRITE_CALLS = {"atomic_write_text", "atomic_write_bytes",
                "os.replace", "os.rename"}

@@ -1,4 +1,4 @@
-"""obs-coverage: the instrumentation-coverage contract (19 checks).
+"""obs-coverage: the instrumentation-coverage contract (20 checks).
 
 Formerly ``tools/obs_lint.py`` (a thin shim remains there for the
 historical entry point); now the fifth presto-lint family.  The
@@ -102,7 +102,14 @@ code path cannot ship silently:
      (taxonomy == federation.FED_KILL_POINTS == testing/chaos
      re-export) — whole-fleet failover runs exactly while a site is
      dying, so every placement, spill, re-admission, and fenced
-     zombie commit must land on telemetry a post-mortem can replay.
+     zombie commit must land on telemetry a post-mortem can replay;
+  20. learned candidate triage (presto_tpu/triage/ + the serve/dag.py
+     triage node + apps/triage.py): TRIAGE_EVENTS / TRIAGE_SPANS /
+     TRIAGE_METRICS pinned BOTH directions (and as subsets of their
+     parent catalogs) — triage decides which candidates are never
+     folded, so every learned selection, heuristic degrade
+     (missing/corrupt weights), and calibration run must land on
+     telemetry a post-mortem can replay.
 
 Run via tools/presto_lint.py (exit-1 CLI over every family), the
 legacy tools/obs_lint.py shim, or tests/test_obs_lint.py.
@@ -233,7 +240,8 @@ def lint(root: Optional[str] = None) -> List[str]:
     serve_ok = (taxonomy.SERVE_EVENTS | taxonomy.FLEET_EVENTS
                 | taxonomy.DAG_EVENTS | taxonomy.SLO_EVENTS
                 | taxonomy.SUPERVISOR_EVENTS
-                | taxonomy.CAMPAIGN_EVENTS | taxonomy.FED_EVENTS)
+                | taxonomy.CAMPAIGN_EVENTS | taxonomy.FED_EVENTS
+                | taxonomy.TRIAGE_EVENTS)
     emitted: Set[str] = set()
     for rel, src in sorted(serve_srcs.items()):
         kinds = set(EMIT_RE.findall(src))
@@ -243,7 +251,8 @@ def lint(root: Optional[str] = None) -> List[str]:
                 "%s: event kind %r is not registered in "
                 "obs/taxonomy.SERVE_EVENTS, FLEET_EVENTS, "
                 "DAG_EVENTS, SLO_EVENTS, SUPERVISOR_EVENTS, "
-                "CAMPAIGN_EVENTS, or FED_EVENTS" % (rel, k))
+                "CAMPAIGN_EVENTS, FED_EVENTS, or TRIAGE_EVENTS"
+                % (rel, k))
 
     # 4. every job lifecycle state announces itself (scoped to the
     # JobStatus class body: queue.py also defines the Lanes constants,
@@ -987,6 +996,65 @@ def lint(root: Optional[str] = None) -> List[str]:
         problems.append(
             "fed kill-point pin: could not import the runtime copies "
             "(%s)" % e)
+
+    # 20. learned candidate triage (presto_tpu/triage/ + the
+    # serve/dag.py triage node + apps/triage.py): TRIAGE_EVENTS /
+    # TRIAGE_SPANS / TRIAGE_METRICS pinned BOTH directions (and as
+    # subsets of their parent catalogs).  Triage decides which
+    # candidates are NEVER folded — a silent selection path would be
+    # indistinguishable from a lost pulsar, so the learned selection
+    # ("triage-score"), the heuristic degrade ("triage-fallback",
+    # the poisoned-model row of ROBUSTNESS.md), and each calibration
+    # run ("triage-calibrate") may neither go dark nor go stale.
+    tr_srcs = dict(_tree_sources(root, "presto_tpu/triage"))
+    for rel in ("presto_tpu/serve/dag.py",
+                "presto_tpu/apps/triage.py"):
+        try:
+            tr_srcs[rel] = _read(rel, root)
+        except OSError:
+            pass
+    tr_events: Set[str] = set()
+    tr_spans: Set[str] = set()
+    tr_metrics: Set[str] = set()
+    for src in tr_srcs.values():
+        tr_events |= {k for k in EMIT_RE.findall(src)
+                      if k.startswith("triage-")}
+        tr_spans |= {s for s in SPAN_RE.findall(src)
+                     if s.startswith("serve:triage")}
+        tr_metrics |= {m for m in METRIC_RE.findall(src)
+                       if m.startswith("triage_")}
+    for k in sorted(taxonomy.TRIAGE_EVENTS - tr_events):
+        problems.append(
+            "obs/taxonomy.py: TRIAGE_EVENTS lists %r but the triage "
+            "layer never emits it" % k)
+    for k in sorted(tr_events - taxonomy.TRIAGE_EVENTS):
+        problems.append(
+            "triage layer: event kind %r is not registered in "
+            "obs/taxonomy.TRIAGE_EVENTS" % k)
+    for s in sorted(taxonomy.TRIAGE_SPANS - taxonomy.SERVE_SPANS):
+        problems.append(
+            "obs/taxonomy.py: TRIAGE_SPANS lists %r which is not in "
+            "SERVE_SPANS" % s)
+    for s in sorted(taxonomy.TRIAGE_SPANS - tr_spans):
+        problems.append(
+            "obs/taxonomy.py: TRIAGE_SPANS lists %r but the triage "
+            "layer never opens it" % s)
+    for s in sorted(tr_spans - taxonomy.TRIAGE_SPANS):
+        problems.append(
+            "triage layer: span %r is not registered in "
+            "obs/taxonomy.TRIAGE_SPANS" % s)
+    for name in sorted(taxonomy.TRIAGE_METRICS - taxonomy.METRICS):
+        problems.append(
+            "obs/taxonomy.py: TRIAGE_METRICS lists %r which is not "
+            "in METRICS" % name)
+    for name in sorted(taxonomy.TRIAGE_METRICS - tr_metrics):
+        problems.append(
+            "obs/taxonomy.py: TRIAGE_METRICS lists %r but the triage "
+            "layer never registers it" % name)
+    for name in sorted(tr_metrics - taxonomy.TRIAGE_METRICS):
+        problems.append(
+            "triage layer: metric %r is not registered in "
+            "obs/taxonomy.TRIAGE_METRICS" % name)
     return problems
 
 

@@ -150,10 +150,57 @@ def amp_for_snr(snr: float, params: InjectParams, N: int,
     return float(snr * noise_sigma / np.sqrt(N * nchan * p2))
 
 
+def truth_record(params: InjectParams, t: float = 0.0,
+                 snr: Optional[float] = None) -> dict:
+    """One injected pulsar as a ground-truth sidecar record.  This is
+    the single schema every producer (injectpsr, the stream loadgen,
+    synthetic campaigns) shares, so triage calibration can label
+    candidates against any of them."""
+    f = float(params.f)
+    return {
+        "t": float(t),
+        "dm": float(params.dm),
+        "f": f,
+        "period": (1.0 / f) if f > 0 else 0.0,
+        "fdot": float(params.fdot),
+        "snr": float(snr) if snr is not None else None,
+        "amp": float(params.amp),
+        "width": float(params.width),
+    }
+
+
+def truth_sidecar_path(datapath: str) -> str:
+    """``<out>_injected.json`` beside an injected data file."""
+    import os
+    return os.path.splitext(datapath)[0] + "_injected.json"
+
+
+def write_truth_sidecar(datapath: str, records: list,
+                        truth_out: Optional[str] = None) -> str:
+    """Atomically write the ground-truth sidecar for an injected
+    file; returns the path written."""
+    import json
+
+    from presto_tpu.io.atomic import atomic_write_text
+
+    path = truth_out or truth_sidecar_path(datapath)
+    atomic_write_text(path, json.dumps(
+        {"schema": 1, "datafile": datapath,
+         "injected": list(records)}, indent=1, sort_keys=True) + "\n")
+    return path
+
+
 def inject_into_filterbank(inpath: str, outpath: str,
                            params: InjectParams,
-                           block: int = 1 << 14) -> None:
-    """Stream a .fil through the injector (chunked; constant memory)."""
+                           block: int = 1 << 14,
+                           truth_out: Optional[str] = None,
+                           write_truth: bool = True) -> None:
+    """Stream a .fil through the injector (chunked; constant memory).
+
+    Unless ``write_truth`` is False, a ground-truth sidecar
+    (``<out>_injected.json``, or ``truth_out``) records what was
+    injected — downstream triage calibration labels its candidates
+    against this for free."""
     from presto_tpu.io import sigproc
 
     with sigproc.FilterbankFile(inpath) as fb:
@@ -177,3 +224,6 @@ def inject_into_filterbank(inpath: str, outpath: str,
                 packed = sigproc.pack_bits(
                     arr.reshape(-1), hdr.nbits)
                 packed.tofile(f)
+    if write_truth:
+        write_truth_sidecar(outpath, [truth_record(params)],
+                            truth_out=truth_out)

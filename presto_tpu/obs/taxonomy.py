@@ -338,6 +338,36 @@ FED_KILL_POINTS = frozenset({
     "zombie-fleet-commit",
 })
 
+#: learned-triage event kinds — the score-then-fold vocabulary of
+#: presto_tpu/triage + the serve/dag.py triage node: a learned
+#: selection ("triage-score"), the heuristic degrade when the weights
+#: file is missing/corrupt/stale ("triage-fallback" — the poisoned-
+#: model row of ROBUSTNESS.md), and each calibration run
+#: ("triage-calibrate").  Enforced BOTH directions by obs-coverage
+#: check 20 across presto_tpu/triage/ + serve/dag.py: the selection
+#: path that decides which candidates are never folded may neither go
+#: dark nor go stale.
+TRIAGE_EVENTS = frozenset({
+    "triage-score",
+    "triage-fallback",
+    "triage-calibrate",
+})
+
+#: learned-triage span names (check 20, both directions, subset of
+#: SERVE_SPANS): the DAG triage node's score+fan-out transaction
+TRIAGE_SPANS = frozenset({
+    "serve:triage-node",
+})
+
+#: learned-triage metrics (check 20, both directions, subset of
+#: METRICS): scored/avoided counters plus the recall gauge fed by
+#: injection ground-truth sidecars when traffic carries them
+TRIAGE_METRICS = frozenset({
+    "triage_candidates_scored_total",
+    "triage_folds_avoided_total",
+    "triage_recall",
+})
+
 #: streaming-layer event kinds — every `events.emit("<kind>", ...)`
 #: in presto_tpu/stream/ (enforced both directions by obs_lint check
 #: 7: the live trigger path may not emit unregistered kinds, and the
@@ -438,6 +468,7 @@ SERVE_SPANS = frozenset({
     "fed:dag-submit",
     "fed:place",
     "fed:failover",
+    "serve:triage-node",
 })
 
 #: discovery-DAG event kinds — the dependency-aware job-graph
@@ -727,4 +758,10 @@ METRICS = frozenset({
     "dag_cascade_failures_total",
     "dag_nodes_done_total",
     "dag_folds_stacked_total",
+    # learned candidate triage (presto_tpu/triage + the serve/dag.py
+    # triage node); pinned both directions by obs-coverage check 20
+    # via TRIAGE_METRICS
+    "triage_candidates_scored_total",
+    "triage_folds_avoided_total",
+    "triage_recall",
 })

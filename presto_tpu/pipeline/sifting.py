@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import os
 import re
+import warnings
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -406,33 +407,83 @@ def select_fold_candidates(cl: Candlist, fold_top: int = 3,
                            fold_sigma: Optional[float] = None,
                            max_folds: int = 150,
                            max_folds_per_pass: Optional[tuple] = None,
-                           pass_zmaxes: Sequence[int] = ()
+                           pass_zmaxes: Sequence[int] = (),
+                           policy=None,
+                           accounting: Optional[dict] = None
                            ) -> List[Candidate]:
     """The survey drivers' fold-selection policy, factored so the
-    batch survey (pipeline/survey.py) and the discovery-DAG sift node
-    (serve/dag.py) fan out the SAME candidates.
+    batch survey (pipeline/survey.py) and the discovery-DAG sift /
+    triage nodes (serve/dag.py) fan out the SAME candidates.
 
     With ``fold_sigma`` set: fold everything at or above it, capped at
     ``max_folds`` — or, with ``max_folds_per_pass``, capped per accel
     pass (aligned with ``pass_zmaxes``, e.g. GBNCC's 20-lo + 10-hi
-    split).  Otherwise: the top ``fold_top`` by sigma."""
+    split).  Otherwise: the top ``fold_top`` by sigma.
+
+    ``policy`` is the opt-in triage seam: a callable
+    ``policy(selected, cl, accounting) -> selected`` (e.g.
+    `triage.TriagePolicy`) applied to the heuristic selection.  A
+    policy may only reorder/drop — it sees the heuristic result, so
+    every survivor folds with exactly the parameters an untriaged
+    run would use.  ``None`` (the default) is the byte-stable
+    heuristic path.
+
+    ``accounting``, when a dict is passed, is filled with selection
+    bookkeeping: ``above_sigma``, ``selected``, and — the per-pass
+    trap this signature grew around — ``untagged_dropped``, the
+    above-sigma candidates whose filename matched NO ``_ACCEL_<z>``
+    pass tag and which the per-pass caps therefore silently excluded
+    (also surfaced as a RuntimeWarning)."""
     ranked = sorted(cl.cands, key=lambda c: -c.sigma)
+    acct = accounting if accounting is not None else {}
+    acct.setdefault("untagged_dropped", 0)
+    acct.setdefault("untagged", [])
     if fold_sigma is not None:
         above = [c for c in ranked if c.sigma >= fold_sigma]
+        acct["above_sigma"] = len(above)
         if max_folds_per_pass:
             if len(max_folds_per_pass) != len(pass_zmaxes):
                 raise ValueError(
                     "max_folds_per_pass has %d caps for %d accel "
                     "passes" % (len(max_folds_per_pass),
                                 len(pass_zmaxes)))
+            tags = tuple("_ACCEL_%d" % z for z in pass_zmaxes)
+            untagged = [c for c in above
+                        if not any(c.filename.endswith(t)
+                                   for t in tags)]
+            if untagged:
+                # historically a SILENT drop: an above-sigma
+                # candidate from a pass the caps don't name (stale
+                # pass_zmaxes, a renamed ACCEL table) simply never
+                # folded.  The exclusion stands (the caps define the
+                # budget) but it is now counted and surfaced.
+                acct["untagged_dropped"] = len(untagged)
+                acct["untagged"] = [
+                    (c.filename, c.candnum, c.sigma)
+                    for c in untagged]
+                warnings.warn(
+                    "select_fold_candidates: %d above-sigma "
+                    "candidate(s) match no _ACCEL_<zmax> pass tag "
+                    "(passes %s) and are excluded from the per-pass "
+                    "fold caps — first: %s:%d (sigma %.2f)"
+                    % (len(untagged),
+                       list(pass_zmaxes), untagged[0].filename,
+                       untagged[0].candnum, untagged[0].sigma),
+                    RuntimeWarning, stacklevel=2)
             top = []
-            for zmax, cap in zip(pass_zmaxes, max_folds_per_pass):
-                tag = "_ACCEL_%d" % zmax
+            for tag, cap in zip(tags, max_folds_per_pass):
                 top += [c for c in above
                         if c.filename.endswith(tag)][:cap]
-            return top
-        return above[:max_folds]
-    return ranked[:fold_top]
+        else:
+            top = above[:max_folds]
+    else:
+        acct["above_sigma"] = len(ranked)
+        top = ranked[:fold_top]
+    acct["selected"] = len(top)
+    if policy is not None:
+        top = policy(top, cl, acct)
+        acct["selected"] = len(top)
+    return top
 
 
 def sift_candidates(filenames: Sequence[str], numdms_min: int = 2,

@@ -120,6 +120,14 @@ class SurveyConfig:
     # pipeline_inflight_depth family, else the built-in default of 2.
     # Depth only changes dispatch overlap, never output bytes.
     inflight_depth: Optional[int] = None
+    # learned candidate triage (presto_tpu/triage): None/False keeps
+    # the byte-stable heuristic fold selection; True or a dict
+    # {"budget"|"budget_frac", "weights", "borderline_frac"} (or a
+    # ready triage.TriagePolicy) reorders/truncates the heuristic
+    # selection under a learned score before folding.  Policy, never
+    # data path: a missing/corrupt weights file degrades to the
+    # heuristic selection unchanged.
+    triage: Optional[object] = None
 
     @property
     def all_passes(self):
@@ -951,6 +959,28 @@ def _batched_accelsearch(fftfiles, cfg, manifest=None, obs=None):
               % len(todo))
 
 
+def resolve_triage_policy(spec, datdir):
+    """cfg.triage -> a sifting policy callable (or None).
+
+    Accepts None/False (off), True (defaults), a dict with any of
+    {"budget", "budget_frac", "weights", "borderline_frac"}, or an
+    already-built triage.TriagePolicy (returned as-is, datdir filled
+    if unset)."""
+    if not spec:
+        return None
+    from presto_tpu.triage import TriagePolicy
+    if isinstance(spec, TriagePolicy):
+        if spec.datdir is None:
+            spec.datdir = datdir
+        return spec
+    kw = spec if isinstance(spec, dict) else {}
+    return TriagePolicy(weights_path=kw.get("weights"),
+                        budget=kw.get("budget"),
+                        budget_frac=kw.get("budget_frac"),
+                        borderline_frac=kw.get("borderline_frac", 0.25),
+                        datdir=datdir)
+
+
 def _finish_survey_stages(rawfiles, cfg, workdir, base, res, timer,
                           manifest=None, obs=None, seam=None):
     # ---- 7. sift ------------------------------------------------------
@@ -981,11 +1011,20 @@ def _finish_survey_stages(rawfiles, cfg, workdir, base, res, timer,
     # fans out exactly the folds this driver would run.
     from presto_tpu.apps.prepfold import main as prepfold_main
     from presto_tpu.pipeline.sifting import select_fold_candidates
+    accounting = {}
     top = select_fold_candidates(
         cl, fold_top=cfg.fold_top, fold_sigma=cfg.fold_sigma,
         max_folds=cfg.max_folds,
         max_folds_per_pass=cfg.max_folds_per_pass,
-        pass_zmaxes=[z for (z, _nh, _sg, _flo) in cfg.all_passes])
+        pass_zmaxes=[z for (z, _nh, _sg, _flo) in cfg.all_passes],
+        policy=resolve_triage_policy(cfg.triage, workdir),
+        accounting=accounting)
+    tacct = accounting.get("triage")
+    if tacct:
+        print("survey: triage %s: scored %d, folding %d (%d avoided)"
+              % (tacct.get("mode"), tacct.get("scored", 0),
+                 tacct.get("selected", len(top)),
+                 tacct.get("folds_avoided", 0)))
     for i, c in enumerate(top):
         accpath = os.path.join(workdir, c.filename) \
             if not os.path.dirname(c.filename) else c.filename

@@ -53,8 +53,10 @@ from typing import List, Optional
 
 from presto_tpu.serve.queue import Job, JobStatus
 
-#: DAG node kinds (``survey`` is the ordinary search job)
-NODE_KINDS = ("survey", "sift", "fold", "toa")
+#: DAG node kinds (``survey`` is the ordinary search job; ``triage``
+#: is the opt-in learned scorer between sift and fold —
+#: presto_tpu/triage/, docs/TRIAGE.md)
+NODE_KINDS = ("survey", "sift", "triage", "fold", "toa")
 
 
 def _bucket_hint(rawfiles, config) -> Optional[str]:
@@ -92,8 +94,21 @@ def plan_dag(spec: dict):
          "config":   {...},          # SurveyConfig fields (search)
          "sift":     {"min_dm_hits", "low_dm_cutoff"},
          "fold":     {"fold_top", "fold_sigma", "max_folds"},
+         "triage":   true | {"budget", "budget_frac",
+                             "weights", "borderline_frac"},
          "toa":      {"ntoa", "gauss_fwhm", "fmt"},
          "tenant":   "...", "priority": int}
+
+    With ``triage`` set, a fifth node kind slots between sift and
+    fold: search -> sift -> triage -> folds -> toa.  The sift node
+    keeps writing the sifted list but hands its fan-out to the
+    triage node, which scores the heuristic fold selection with the
+    learned ranker (presto_tpu/triage/) and fans out only the
+    surviving budget — the SAME `complete_and_expand` transaction,
+    cascade-fail, and chaos seams the sift fan-out rides.  Truth
+    sidecars (``<rawfile>_injected.json``, models/inject.py) found
+    beside the rawfiles at submission are stamped into the node spec
+    so injection recall rides real traffic.
 
     The search node is an ordinary survey job (it stacks with plain
     search traffic) with folding disabled — folds are DAG nodes —
@@ -120,10 +135,36 @@ def plan_dag(spec: dict):
         "parents": {"fold": []},
         "toa": dict(spec.get("toa") or {}),
     }
+    tpol = spec.get("triage")
+    if not tpol:
+        return [
+            ("search", search_spec, _bucket_hint(rawfiles, config),
+             []),
+            ("sift", sift_spec, None, ["search"]),
+            ("toa", toa_spec, None, ["sift"]),
+        ]
+    tpol = dict(tpol) if isinstance(tpol, dict) else {}
+    if "truth" not in tpol:
+        from presto_tpu.triage.calibrate import find_truth_sidecars
+        tpol["truth"] = find_truth_sidecars(list(rawfiles))
+    # the sift node keeps its durable artifact but hands fan-out (and
+    # the toa retarget) to the triage node
+    sift_spec.pop("retarget", None)
+    sift_spec["fanout"] = False
+    triage_spec = {
+        "kind": "triage",
+        "parents": {"search": "search", "sift": "sift"},
+        "retarget": "toa",
+        "zmaxes": _pass_zmaxes(config),
+        "sift": dict(spec.get("sift") or {}),
+        "fold": dict(spec.get("fold") or {}),
+        "triage": tpol,
+    }
     return [
         ("search", search_spec, _bucket_hint(rawfiles, config), []),
         ("sift", sift_spec, None, ["search"]),
-        ("toa", toa_spec, None, ["sift"]),
+        ("triage", triage_spec, None, ["sift"]),
+        ("toa", toa_spec, None, ["triage"]),
     ]
 
 
@@ -182,6 +223,8 @@ def execute_node(service, job: Job) -> dict:
     try:
         if job.kind == "sift":
             result = _execute_sift(service, job)
+        elif job.kind == "triage":
+            result = _execute_triage(service, job)
         elif job.kind == "fold":
             result = _execute_fold(service, job)
         elif job.kind == "toa":
@@ -198,22 +241,13 @@ def execute_node(service, job: Job) -> dict:
 
 # ---- sift: candidates in, fold fan-out + timing fan-in out -----------
 
-def _execute_sift(service, job: Job) -> dict:
-    """Sift the search node's ACCEL tables, write the sifted list,
-    and COMPUTE the dynamic fan-out: one fold child per surviving
-    candidate (under the shared fold-selection policy) plus the
-    timing node's retarget.  The fan-out is *returned*, not applied —
-    the replica hands it to `JobLedger.complete_and_expand`, so
-    children exist exactly when the sift result's fenced commit
-    lands."""
-    from presto_tpu.apps.prepfold import (accel_cand_fold_params,
-                                          fold_geometry,
-                                          fold_stack_key)
-    from presto_tpu.io.infodata import read_inf
-    from presto_tpu.pipeline.sifting import (select_fold_candidates,
-                                             sift_candidates)
+def _sift_parent_candlist(job: Job, pdir: str):
+    """(Candlist, zmaxes): the sifted survivors of the search
+    parent's ACCEL tables — deterministic (sorted glob, sorted
+    reads), so the sift node and a downstream triage node derive the
+    IDENTICAL list from the same committed parent dir."""
+    from presto_tpu.pipeline.sifting import sift_candidates
     spec = job.spec
-    pdir = _parent_dirs(job, "search")
     zmaxes = [int(z) for z in (spec.get("zmaxes") or [0])]
     accfiles = []
     for z in zmaxes:
@@ -223,19 +257,39 @@ def _execute_sift(service, job: Job) -> dict:
     cl = sift_candidates(
         accfiles, numdms_min=int(pol.get("min_dm_hits", 2)),
         low_DM_cutoff=float(pol.get("low_dm_cutoff", 2.0)))
-    os.makedirs(job.workdir, exist_ok=True)
-    candfile = os.path.join(job.workdir, "cands_sifted.txt")
-    cl.to_file(candfile)
+    return cl, zmaxes
 
-    fpol = spec.get("fold") or {}
+
+def _heuristic_selection(job: Job, cl, zmaxes) -> tuple:
+    """(selected, accounting): the shared fold-selection policy the
+    batch survey uses, heuristic arm only — the safe superset a
+    triage policy may truncate."""
+    from presto_tpu.pipeline.sifting import select_fold_candidates
+    fpol = job.spec.get("fold") or {}
     per_pass = fpol.get("max_folds_per_pass")
+    accounting: dict = {}
     top = select_fold_candidates(
         cl, fold_top=int(fpol.get("fold_top", 3)),
         fold_sigma=fpol.get("fold_sigma"),
         max_folds=int(fpol.get("max_folds", 150)),
         max_folds_per_pass=tuple(per_pass) if per_pass else None,
-        pass_zmaxes=zmaxes)
+        pass_zmaxes=zmaxes, accounting=accounting)
+    return top, accounting
 
+
+def _fold_fanout(job: Job, top, pdir: str) -> tuple:
+    """(children, retarget): one fold child per selected candidate,
+    bucketed by the exact stack signature fold_dat_cands will group
+    by, plus the timing node's fan-in retarget.  Shared verbatim by
+    the sift node (heuristic path) and the triage node (scored
+    path), which is what keeps triage policy-not-data-path: a
+    candidate selected by either node fans out the identical fold
+    spec, so the fold artifacts are byte-equal."""
+    from presto_tpu.apps.prepfold import (accel_cand_fold_params,
+                                          fold_geometry,
+                                          fold_stack_key)
+    from presto_tpu.io.infodata import read_inf
+    spec = job.spec
     dag_id = spec.get("dag") or job.job_id
     search_id = (spec.get("parents") or {}).get("search")
     children, fold_ids = [], []
@@ -271,16 +325,166 @@ def _execute_sift(service, job: Job) -> dict:
     if toa_id:
         retarget[toa_id] = {"blocked_on": list(fold_ids),
                             "parents": {"fold": list(fold_ids)}}
+    return children, retarget
+
+
+def _execute_sift(service, job: Job) -> dict:
+    """Sift the search node's ACCEL tables, write the sifted list,
+    and COMPUTE the dynamic fan-out: one fold child per surviving
+    candidate (under the shared fold-selection policy) plus the
+    timing node's retarget.  The fan-out is *returned*, not applied —
+    the replica hands it to `JobLedger.complete_and_expand`, so
+    children exist exactly when the sift result's fenced commit
+    lands.  With ``spec.fanout`` false (a triage DAG), the node
+    stops at the durable sifted list — the triage node downstream
+    owns the fan-out."""
+    spec = job.spec
+    pdir = _parent_dirs(job, "search")
+    cl, zmaxes = _sift_parent_candlist(job, pdir)
+    os.makedirs(job.workdir, exist_ok=True)
+    candfile = os.path.join(job.workdir, "cands_sifted.txt")
+    cl.to_file(candfile)
     nbad = sum(len(v) for v in cl.badcands.values())
-    return {
+    result = {
         "candfile": os.path.basename(candfile),
         "n_cands": len(cl),
         "n_rejected": nbad,
         "n_duplicates": len(cl.duplicates),
-        "folds": len(fold_ids),
+    }
+    if spec.get("fanout", True) is False:
+        result["folds"] = 0
+        result["deferred_to_triage"] = True
+        return result
+    top, accounting = _heuristic_selection(job, cl, zmaxes)
+    children, retarget = _fold_fanout(job, top, pdir)
+    result.update({
+        "folds": len(children),
+        "n_untagged_dropped": accounting.get("untagged_dropped", 0),
+        "dag_children": children,
+        "dag_retarget": retarget,
+    })
+    return result
+
+
+# ---- triage: score the heuristic selection, fold only the budget -----
+
+def _execute_triage(service, job: Job) -> dict:
+    """Score the heuristic fold selection with the learned ranker
+    and fan out only the surviving budget (presto_tpu/triage/,
+    docs/TRIAGE.md).
+
+    Semantics are the sift node's, inherited wholesale: the fan-out
+    is returned for `complete_and_expand` (atomic, idempotent,
+    zombie-fenced), a failure cascades to the toa node, and the
+    replica's fold-fanout / post-sift-commit chaos seams fire around
+    the commit because they key on the result's children, not the
+    node kind.  On ANY weights problem the selection degrades to the
+    heuristic list unchanged — the byte-stable default — and says so
+    (``triage-fallback`` event, ``mode`` in the result)."""
+    from presto_tpu.triage.calibrate import load_truth, truth_matches
+    from presto_tpu.triage.model import TriagePolicy
+    spec = job.spec
+    pdir = _parent_dirs(job, "search")
+    span = service.obs.span("serve:triage-node", job=job.job_id,
+                            dag=spec.get("dag"))
+    try:
+        cl, zmaxes = _sift_parent_candlist(job, pdir)
+        heuristic, accounting = _heuristic_selection(job, cl, zmaxes)
+        tpol = spec.get("triage") or {}
+        policy = TriagePolicy(
+            weights_path=tpol.get("weights") or None,
+            budget=tpol.get("budget"),
+            budget_frac=tpol.get("budget_frac"),
+            borderline_frac=float(tpol.get("borderline_frac", 0.25)),
+            datdir=pdir)
+        selected, acct = policy.select(heuristic, obs=service.obs)
+        scores = acct.pop("scores", None)
+
+        truth = []
+        for side in tpol.get("truth") or ():
+            truth += load_truth(side)
+        recall = None
+        recovered = 0
+        if truth:
+            matched = {m for m in truth_matches(selected, truth)
+                       if m is not None}
+            recovered = len(matched)
+            recall = len(matched) / len(truth)
+            service.obs.metrics.gauge(
+                "triage_recall",
+                "Injected-pulsar recall of the triage fold "
+                "selection, from truth sidecars riding the "
+                "traffic").set(recall)
+        service.obs.metrics.counter(
+            "triage_candidates_scored_total",
+            "Sift survivors scored by the triage "
+            "ranker").inc(acct["scored"])
+        service.obs.metrics.counter(
+            "triage_folds_avoided_total",
+            "Folds the triage budget cut from the heuristic "
+            "selection").inc(acct["folds_avoided"])
+
+        os.makedirs(job.workdir, exist_ok=True)
+        _write_scores(job, heuristic, selected, scores, acct,
+                      recall)
+        children, retarget = _fold_fanout(job, selected, pdir)
+        if acct["mode"] == "triage":
+            service.events.emit(
+                "triage-score", job=job.job_id, dag=spec.get("dag"),
+                scored=acct["scored"], selected=acct["selected"],
+                folds_avoided=acct["folds_avoided"],
+                recall=recall)
+        else:
+            service.events.emit(
+                "triage-fallback", job=job.job_id,
+                dag=spec.get("dag"),
+                load_error=acct.get("load_error"))
+    except Exception as e:
+        span.finish("error: %s" % type(e).__name__)
+        raise
+    span.finish()
+    return {
+        "mode": acct["mode"],
+        "scored": acct["scored"],
+        "heuristic_folds": len(heuristic),
+        "folds": len(children),
+        "folds_avoided": acct["folds_avoided"],
+        "load_error": acct.get("load_error"),
+        "recall": recall,
+        "recovered": recovered,
+        "injected": len(truth),
+        "n_untagged_dropped": accounting.get("untagged_dropped", 0),
+        "scorefile": "triage_scores.json",
         "dag_children": children,
         "dag_retarget": retarget,
     }
+
+
+def _write_scores(job: Job, heuristic, selected, scores, acct,
+                  recall) -> None:
+    """The node's durable artifact: every scored candidate with its
+    score and the selection verdict (atomic write; read by
+    presto-report and the calibration loop)."""
+    import json
+
+    from presto_tpu.io.atomic import atomic_write_text
+    chosen = {(c.filename, c.candnum) for c in selected}
+    rows = []
+    for i, c in enumerate(heuristic):
+        rows.append({
+            "filename": c.filename, "candnum": int(c.candnum),
+            "sigma": float(c.sigma), "dm": float(c.DM),
+            "f": float(c.f),
+            "score": (float(scores[i]) if scores is not None
+                      else None),
+            "selected": (c.filename, c.candnum) in chosen,
+        })
+    atomic_write_text(
+        os.path.join(job.workdir, "triage_scores.json"),
+        json.dumps({"schema": 1, "mode": acct["mode"],
+                    "budget": acct.get("budget"),
+                    "recall": recall, "candidates": rows},
+                   indent=1, sort_keys=True))
 
 
 # ---- fold: one candidate, CLI-parity artifacts -----------------------

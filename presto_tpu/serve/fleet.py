@@ -528,6 +528,11 @@ class FleetReplica:
         if kind == "fold":
             # chaos seam: die holding a leased fold mid-DAG
             self._chaos("mid-fold")
+        if kind == "triage":
+            # chaos seam: die holding a leased triage node mid-score
+            # (the fan-out is never computed; a survivor re-leases
+            # the node and scores identically — seeded model)
+            self._chaos("mid-triage")
         return True
 
     def _check_inflight(self) -> None:

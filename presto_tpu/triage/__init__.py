@@ -1,0 +1,36 @@
+"""Learned candidate triage: score sift survivors, fold only the
+ones that matter.
+
+At campaign scale the fold stage is O(candidates) device work for
+O(few) pulsars, and the shared fold-selection policy
+(pipeline/sifting.select_fold_candidates) is a blunt sigma rank.
+This package is the AutoTVM-shaped answer the tune layer already
+uses for kernels (PAPERS.md: Chen et al. 2018): cheap *measured*
+features per candidate (triage/features.py), a small learned ranker
+persisted in a schema-versioned weights file (triage/model.py, the
+tune/db.py durability rules — atomic writes, corrupted-load degrades
+to the heuristic), and continuous calibration against injected
+ground truth riding real traffic (triage/calibrate.py +
+presto-triage).
+
+Triage is POLICY, never data path: it chooses *which* folds run,
+so every fold artifact stays byte-equal to an untriaged run of the
+same selection, and the heuristic sigma rank remains the byte-stable
+default whenever triage is off, unconfigured, or its weights file is
+unloadable.  See docs/TRIAGE.md.
+"""
+
+from presto_tpu.triage.features import (FEATURE_NAMES, featurize,
+                                        fold_profile_features)
+from presto_tpu.triage.model import (ENV_WEIGHTS, SCHEMA_VERSION,
+                                     WEIGHTS_BASENAME, TriageModel,
+                                     TriagePolicy,
+                                     default_weights_path,
+                                     load_model, train_model)
+
+__all__ = [
+    "FEATURE_NAMES", "featurize", "fold_profile_features",
+    "TriageModel", "TriagePolicy", "SCHEMA_VERSION",
+    "WEIGHTS_BASENAME", "ENV_WEIGHTS", "default_weights_path",
+    "load_model", "train_model",
+]

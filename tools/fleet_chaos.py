@@ -83,9 +83,11 @@ KILL_POINTS = ("job-leased", "job-enqueued", "batch-leased", "timed")
 #: fan-out computed but UNcommitted (the expand is lost with the
 #: attempt; a survivor redoes the sift and expands identically),
 #: "post-sift-commit" dies right after the fenced expand landed,
-#: "mid-fold" dies holding a leased fold job.
+#: "mid-fold" dies holding a leased fold job, "mid-triage" dies
+#: holding a leased triage node before its score+fan-out commits (a
+#: survivor re-scores with the seeded model and expands identically).
 DAG_KILL_POINTS = ("fold-fanout", "post-sift-commit", "mid-fold",
-                   "timed")
+                   "mid-triage", "timed")
 
 #: DAG trial search config (needs a sift-surviving candidate, so the
 #: beam is longer/stronger than the classic trials')
@@ -476,16 +478,23 @@ def run_dag_trial(trial: int, rng: random.Random, beam: str,
 
     fleetdir = os.path.join(workdir, "dagtrial%02d" % trial, "fleet")
     led = JobLedger(fleetdir)
-    out = led.admit_dag(plan_dag(
-        {"rawfiles": [beam], "config": dict(DAG_CFG),
-         "sift": {"min_dm_hits": 2, "low_dm_cutoff": 2.0},
-         "fold": {"fold_top": 3}, "toa": {"ntoa": 1}}))
     # first len(DAG_KILL_POINTS) trials sweep every point once (the
     # committed artifact must cover the whole matrix); extra trials
     # randomize
     kill_point = (DAG_KILL_POINTS[trial % len(DAG_KILL_POINTS)]
                   if trial < len(DAG_KILL_POINTS)
                   else rng.choice(DAG_KILL_POINTS))
+    payload = {"rawfiles": [beam], "config": dict(DAG_CFG),
+               "sift": {"min_dm_hits": 2, "low_dm_cutoff": 2.0},
+               "fold": {"fold_top": 3}, "toa": {"ntoa": 1}}
+    if kill_point == "mid-triage":
+        # the seam only exists on a triage-bearing DAG; pointing at a
+        # weights file that cannot exist pins the node to its
+        # heuristic degrade, so the byte-equality reference holds no
+        # matter what lives in the user's weights cache
+        payload["triage"] = {"weights": os.path.join(
+            fleetdir, "no-such-weights.json")}
+    out = led.admit_dag(plan_dag(payload))
     kill_delay = rng.uniform(0.5, 4.0)
     victim_idx = rng.randrange(replicas)
     rec = {"trial": trial, "mode": "dag", "kill_point": kill_point,

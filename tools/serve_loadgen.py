@@ -1613,6 +1613,10 @@ def run_campaign_loadgen(workdir: str, observations: int = 4,
         "series": series,
         "convergence": conv,
         "events_by_kind": by_kind,
+        # injection-recall roll-up over the campaign's triage nodes
+        # (None when no observation opted into triage — the
+        # byte-stable heuristic default)
+        "triage": info.get("triage"),
         "gold_latency_s": {
             "n": len(gold_e2e),
             "p99": round(gold_p99, 3) if gold_p99 is not None

@@ -58,9 +58,14 @@ def make_feed(seed: int = 0, nchan: int = 64, dt: float = 5e-4,
               t_margin: float = 4.0):
     """(header, wire_bytes, pulse_times): a SIGPROC byte stream with
     `npulses` dispersed single pulses at known top-of-band arrival
-    times, evenly spread with jitter, away from the stream edges."""
+    times, evenly spread with jitter, away from the stream edges.
+
+    Truth comes from models/inject.truth_record at injection time —
+    the same schema injectpsr writes to its `_injected.json` sidecar
+    — instead of being re-derived after the fact."""
     from presto_tpu.io import sigproc
-    from presto_tpu.models.inject import InjectParams, inject_pulsar
+    from presto_tpu.models.inject import (InjectParams, inject_pulsar,
+                                          truth_record)
 
     from presto_tpu.ops.dedispersion import delay_from_dm
 
@@ -82,6 +87,7 @@ def make_feed(seed: int = 0, nchan: int = 64, dt: float = 5e-4,
                   - delay_from_dm(dm, freqs.max()))
     period = max(4096 * dt, (sweep + 12 * width_s + 0.4) * 1.05)
     f = 1.0 / period
+    truth = []
     for t0 in times:
         lo = max(int((t0 - 0.1) / dt), 0)
         hi = min(int((t0 + sweep + 6 * width_s + 0.2) / dt), N)
@@ -89,6 +95,7 @@ def make_feed(seed: int = 0, nchan: int = 64, dt: float = 5e-4,
                          phase0=(-t0 * f) % 1.0)
         data[lo:hi] = inject_pulsar(data[lo:hi], dt, freqs, p,
                                     start_sec=lo * dt)
+        truth.append(truth_record(p, t=t0))
     hdr = sigproc.FilterbankHeader(
         nbits=32, nchans=nchan, nifs=1, tsamp=dt, fch1=fch1,
         foff=foff, tstart=60000.0, source_name="loadgen", N=N)
@@ -97,7 +104,7 @@ def make_feed(seed: int = 0, nchan: int = 64, dt: float = 5e-4,
     arr = data[:, ::-1] if foff < 0 else data
     buf.write(sigproc.pack_bits(np.ascontiguousarray(arr).ravel(),
                                 32).tobytes())
-    return hdr, buf.getvalue(), times
+    return hdr, buf.getvalue(), [r["t"] for r in truth]
 
 
 def send_wire(address, wire: bytes, hdr, mode: str = "burst",
@@ -249,9 +256,12 @@ def make_beam_feeds(nbeams: int, pulse_beams=(0,), seed: int = 0,
     noise per beam, `npulses` dispersed pulses injected ONLY into
     `pulse_beams` (the astrophysical signal a coincidence veto must
     keep), and `nrfi` correlated bursts injected into EVERY beam at
-    shared times (the broadband-RFI signature the veto must kill)."""
+    shared times (the broadband-RFI signature the veto must kill).
+    Truth is stamped by models/inject.truth_record at injection
+    time, same schema as the injectpsr sidecar."""
     from presto_tpu.io import sigproc
-    from presto_tpu.models.inject import InjectParams, inject_pulsar
+    from presto_tpu.models.inject import (InjectParams, inject_pulsar,
+                                          truth_record)
     from presto_tpu.ops.dedispersion import delay_from_dm
 
     N = int(seconds / dt)
@@ -266,7 +276,12 @@ def make_beam_feeds(nbeams: int, pulse_beams=(0,), seed: int = 0,
     times = [t_margin + span * (i + 0.5)
              + float(rng.uniform(-0.15, 0.15) * span)
              for i in range(nev)]
-    t_signal, t_rfi = times[:npulses], times[npulses:]
+    truth = [truth_record(
+        InjectParams(f=f, dm=dm, amp=amp, width=width_s * f,
+                     phase0=(-t0 * f) % 1.0), t=t0)
+        for t0 in times]
+    t_signal = [r["t"] for r in truth[:npulses]]
+    t_rfi = [r["t"] for r in truth[npulses:]]
 
     def _inject(data, t0, a):
         lo = max(int((t0 - 0.1) / dt), 0)
